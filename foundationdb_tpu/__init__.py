@@ -1,0 +1,28 @@
+"""foundationdb_tpu — a TPU-native distributed transactional key-value store.
+
+A ground-up rebuild of FoundationDB's capabilities (reference:
+wesleypeck/foundationdb, i.e. the apple/foundationdb architecture:
+REF:flow/, REF:fdbrpc/, REF:fdbclient/, REF:fdbserver/) designed TPU-first:
+
+- Python/asyncio structured concurrency replaces the Flow actor runtime
+  (REF:flow/flow.h ACTOR/Future/Promise), with a deterministic virtual-time
+  event loop replacing the Sim2 simulator (REF:fdbrpc/sim2.actor.cpp).
+- The OCC conflict-detection data plane (REF:fdbserver/SkipList.cpp,
+  REF:fdbserver/Resolver.actor.cpp) is a vectorized JAX interval-overlap
+  kernel with persistent on-device state, sharded across TPU cores via
+  shard_map for multi-resolver clusters.
+- A C++ sorted-structure conflict set (skiplist-analog) provides the CPU
+  baseline and a NumPy twin keeps simulation deterministic off-TPU.
+
+Package layout:
+  runtime/   L0: event loop, sim, knobs, trace, errors, RNG   (REF:flow/)
+  ops/       conflict-detection kernels + key encoding        (REF:fdbserver/SkipList.cpp)
+  parallel/  mesh/shard_map multi-resolver partitioning       (REF:fdbserver/Resolver.actor.cpp)
+  models/    flagship pipeline models (resolver step)         —
+  core/      txn system roles: sequencer/proxy/resolver/storage (REF:fdbserver/)
+  rpc/       typed endpoint RPC over asyncio / sim transports (REF:fdbrpc/)
+  utils/     tuple & directory layers, misc                   (REF:bindings/python/)
+  native/    C++ components (conflict-set baseline, IO)       —
+"""
+
+__version__ = "0.1.0"

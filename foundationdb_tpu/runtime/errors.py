@@ -1,0 +1,80 @@
+"""Numbered error system, mirroring FDB's error model.
+
+Reference: REF:flow/Error.h, REF:flow/error_definitions.h — FDB errors are
+small numbered values thrown through futures; clients switch on the code in
+``Transaction::onError`` to decide retry behavior.  We keep the same codes for
+the errors we implement so FDB users find familiar numbers.
+"""
+
+from __future__ import annotations
+
+
+class FdbError(Exception):
+    """An error with an FDB-compatible numeric code."""
+
+    code: int = 0
+    name: str = "unknown_error"
+
+    def __init__(self, *args):
+        super().__init__(*args or (self.name,))
+
+    # --- retry classification (mirrors fdb_error_predicate in REF:bindings/c) ---
+    @property
+    def retryable(self) -> bool:
+        return self.code in _RETRYABLE
+
+    @property
+    def maybe_committed(self) -> bool:
+        return self.code in _MAYBE_COMMITTED
+
+
+_REGISTRY: dict[int, type[FdbError]] = {}
+
+
+def _err(code: int, name: str, doc: str) -> type[FdbError]:
+    cls = type(name, (FdbError,), {"code": code, "name": name, "__doc__": doc})
+    _REGISTRY[code] = cls
+    return cls
+
+
+def error_from_code(code: int) -> FdbError:
+    cls = _REGISTRY.get(code)
+    if cls is None:
+        e = FdbError(f"error code {code}")
+        e.code = code
+        return e
+    return cls()
+
+
+# Codes match upstream flow/error_definitions.h where an equivalent exists.
+OperationFailed = _err(1000, "operation_failed", "Operation failed")
+TimedOut = _err(1004, "timed_out", "Operation timed out")
+TransactionTooOld = _err(1007, "transaction_too_old", "Read version is too old to be satisfied")
+FutureVersion = _err(1009, "future_version", "Request for a future version")
+NotCommitted = _err(1020, "not_committed", "Transaction not committed due to a conflict")
+CommitUnknownResult = _err(1021, "commit_unknown_result", "Commit result unknown")
+TransactionCancelled = _err(1025, "transaction_cancelled", "Transaction was cancelled")
+TransactionTimedOut = _err(1031, "transaction_timed_out", "Transaction timed out")
+ProcessBehind = _err(1037, "process_behind", "Storage process does not have recent mutations")
+DatabaseLocked = _err(1038, "database_locked", "Database is locked")
+ClusterVersionChanged = _err(1039, "cluster_version_changed", "Cluster has been upgraded to a new protocol version")
+BrokenPromise = _err(1100, "broken_promise", "The promise was never set or was dropped")
+OperationCancelled = _err(1101, "operation_cancelled", "Asynchronous operation cancelled")
+IoError = _err(1510, "io_error", "Disk i/o operation failed")
+PlatformError = _err(1500, "platform_error", "Platform error")
+KeyOutsideLegalRange = _err(2003, "key_outside_legal_range", "Key outside legal range")
+InvertedRange = _err(2005, "inverted_range", "Range begin key exceeds end key")
+InvalidOption = _err(2007, "invalid_option", "Option not valid in this context")
+VersionInvalid = _err(2011, "version_invalid", "Version not valid")
+TransactionReadOnly = _err(2023, "transaction_read_only", "Transaction is read-only and cannot be committed")
+UsedDuringCommit = _err(2017, "used_during_commit", "Operation issued while a commit was outstanding")
+KeyTooLarge = _err(2102, "key_too_large", "Key length exceeds limit")
+ValueTooLarge = _err(2103, "value_too_large", "Value length exceeds limit")
+TransactionTooLarge = _err(2101, "transaction_too_large", "Transaction exceeds byte limit")
+
+# resolver-internal (ours; no upstream equivalent needed on the wire)
+ResolverCapacityExceeded = _err(2900, "resolver_capacity_exceeded",
+                                "Conflict-set history ring overflowed; txn forced too-old")
+
+_RETRYABLE = {1004, 1007, 1009, 1020, 1021, 1031, 1037, 1039, 2900}
+_MAYBE_COMMITTED = {1021}

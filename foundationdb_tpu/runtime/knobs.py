@@ -1,0 +1,98 @@
+"""Typed runtime constants ("knobs"), overridable per-process.
+
+Reference: REF:flow/Knobs.h/.cpp plus ServerKnobs/ClientKnobs
+(REF:fdbclient/ServerKnobs.cpp) — hundreds of typed constants set via
+``--knob_name=value``; BUGGIFY randomizes some of them in simulation.
+
+The north star adds ``RESOLVER_CONFLICT_BACKEND in {cpp, numpy, tpu}``:
+the resolver role selects the conflict-set implementation at role start,
+exactly as Resolver.actor.cpp would consult a server knob.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class Knobs:
+    # --- resolver / conflict detection (north star) ---
+    RESOLVER_CONFLICT_BACKEND: str = "numpy"  # cpp | numpy | tpu (jax)
+    CONFLICT_RING_CAPACITY: int = 1 << 16     # history entries on device
+    KEY_ENCODE_BYTES: int = 32                # fixed-width key prefix lanes (multiple of 8)
+    RESOLVER_BATCH_TXNS: int = 64             # txns per resolve launch (static shape)
+    RESOLVER_RANGES_PER_TXN: int = 8          # padded read/write ranges per txn
+    MAX_WRITE_TRANSACTION_LIFE_VERSIONS: int = 5_000_000  # ~5s at 1M versions/s (REF:fdbclient/ServerKnobs)
+    VERSIONS_PER_SECOND: int = 1_000_000
+
+    # --- commit pipeline ---
+    COMMIT_BATCH_INTERVAL: float = 0.002      # proxy batching window seconds (REF: COMMIT_TRANSACTION_BATCH_INTERVAL_MIN)
+    COMMIT_BATCH_BYTE_LIMIT: int = 1 << 20
+    COMMIT_BATCH_COUNT_LIMIT: int = 1024
+    GRV_BATCH_INTERVAL: float = 0.001
+
+    # --- storage ---
+    STORAGE_VERSION_WINDOW: int = 5_000_000   # in-memory MVCC window, versions
+    STORAGE_DURABILITY_LAG: float = 0.25      # seconds between making versions durable
+    FETCH_KEYS_BYTES_PER_BATCH: int = 1 << 20
+
+    # --- transaction limits (REF:fdbclient/ClientKnobs, Limits in docs) ---
+    KEY_SIZE_LIMIT: int = 10_000
+    VALUE_SIZE_LIMIT: int = 100_000
+    TRANSACTION_SIZE_LIMIT: int = 10_000_000
+    DEFAULT_RETRY_LIMIT: int = -1             # unlimited
+    DEFAULT_TIMEOUT: float = 0.0              # disabled
+    DEFAULT_MAX_RETRY_DELAY: float = 1.0
+
+    # --- rpc / failure detection ---
+    FAILURE_TIMEOUT: float = 1.0
+    PING_INTERVAL: float = 0.25
+    CONNECT_TIMEOUT: float = 2.0
+
+    # --- tlog ---
+    TLOG_SPILL_THRESHOLD: int = 1 << 30
+    DISK_QUEUE_PAGE_SIZE: int = 4096
+
+    # --- ratekeeper ---
+    RATEKEEPER_UPDATE_INTERVAL: float = 0.25
+    TARGET_STORAGE_QUEUE_BYTES: int = 1 << 30
+
+    # --- simulation ---
+    SIM_NETWORK_MIN_DELAY: float = 0.0005
+    SIM_NETWORK_MAX_DELAY: float = 0.005
+    SIM_CONNECT_DELAY: float = 0.01
+    BUGGIFY_ENABLED: bool = False
+
+    def override(self, **kv: Any) -> "Knobs":
+        return dataclasses.replace(self, **kv)
+
+    def set_from_strings(self, overrides: dict[str, str]) -> "Knobs":
+        """Apply --knob_name=value style overrides with type coercion."""
+        kv: dict[str, Any] = {}
+        for name, sval in overrides.items():
+            name = name.upper()
+            field = self.__dataclass_fields__.get(name)
+            if field is None:
+                raise KeyError(f"unknown knob {name}")
+            # field.type is a string under PEP 563; coerce by the type of the
+            # class default, which is authoritative for every knob.
+            t = type(field.default)
+            if t is bool:
+                kv[name] = sval.lower() in ("1", "true", "on", "yes")
+            elif t is int:
+                kv[name] = int(sval)
+            elif t is float:
+                kv[name] = float(sval)
+            else:
+                kv[name] = sval
+        return self.override(**kv)
+
+
+# Process-global default knobs (roles may carry their own copy).
+KNOBS = Knobs()
+
+
+def set_global_knobs(k: Knobs) -> None:
+    global KNOBS
+    KNOBS = k

@@ -1,0 +1,192 @@
+"""Structured event logging — the sole observability substrate.
+
+Reference: REF:flow/Trace.h/.cpp (TraceEvent with .detail(k,v) chaining,
+Severity levels, rolled files, rate limiting) and REF:fdbrpc/Stats.h
+(Counter/CounterCollection emitting periodic *Metrics events).
+
+We emit JSON-lines. In simulation, time comes from the virtual clock so
+logs are deterministic given a seed.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import threading
+import time as _time
+from typing import Any, Callable, Optional
+
+
+def _default_clock() -> float:
+    """Virtual time when called inside a running event loop, else wall time.
+
+    This is what makes sim trace output deterministic by default: under
+    run_simulation the running loop is a SimEventLoop whose time() is the
+    virtual clock.
+    """
+    try:
+        import asyncio
+        return asyncio.get_running_loop().time()
+    except RuntimeError:
+        return _time.time()
+
+
+def _next_roll_gen(path: str) -> int:
+    """Continue the .N roll sequence past any files left by a previous run."""
+    gen = 0
+    d = os.path.dirname(path) or "."
+    base = os.path.basename(path)
+    try:
+        for name in os.listdir(d):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1:]
+                if suffix.isdigit():
+                    gen = max(gen, int(suffix))
+    except OSError:
+        pass
+    return gen
+
+
+class Severity:
+    DEBUG = 5
+    INFO = 10
+    WARN = 20
+    WARN_ALWAYS = 30
+    ERROR = 40
+
+
+class TraceLog:
+    """Destination for trace events: a JSONL stream, optionally rolled."""
+
+    def __init__(self, path: Optional[str] = None, min_severity: int = Severity.INFO,
+                 clock: Optional[Callable[[], float]] = None, roll_bytes: int = 50 << 20):
+        self.min_severity = min_severity
+        self.clock = clock or _default_clock
+        self.path = path
+        self.roll_bytes = roll_bytes
+        self._written = 0
+        self._gen = _next_roll_gen(path) if path else 0
+        self._lock = threading.Lock()
+        self._fh: Optional[io.TextIOBase] = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+        self.event_count = 0
+        self.sink: Optional[Callable[[dict], None]] = None  # test hook
+
+    def emit(self, event: dict) -> None:
+        self.event_count += 1
+        if self.sink is not None:
+            self.sink(event)
+            return
+        line = json.dumps(event, separators=(",", ":"), default=str)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+                self._written += len(line) + 1
+                if self._written >= self.roll_bytes:
+                    self._roll()
+            else:
+                sys.stderr.write(line + "\n")
+
+    def _roll(self) -> None:
+        assert self._fh is not None and self.path is not None
+        self._fh.close()
+        self._gen += 1
+        os.replace(self.path, f"{self.path}.{self._gen}")
+        self._fh = open(self.path, "a", buffering=1)
+        self._written = 0
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+_GLOBAL = TraceLog()
+
+
+def set_trace_log(log: TraceLog) -> None:
+    global _GLOBAL
+    _GLOBAL = log
+
+
+def get_trace_log() -> TraceLog:
+    return _GLOBAL
+
+
+class TraceEvent:
+    """``TraceEvent("CommitBatch", sev=...).detail("Txns", n).log()``.
+
+    Also logs automatically when used as a context-less statement via
+    ``__del__``-free explicit ``log()`` (we do not rely on GC, unlike the
+    C++ destructor-logging idiom).
+    """
+
+    def __init__(self, type_: str, severity: int = Severity.INFO,
+                 log: Optional[TraceLog] = None):
+        self._log = log or _GLOBAL
+        self.severity = severity
+        self.fields: dict[str, Any] = {"Type": type_}
+
+    def detail(self, key: str, value: Any) -> "TraceEvent":
+        self.fields[key] = value
+        return self
+
+    def error(self, e: BaseException) -> "TraceEvent":
+        self.fields["Error"] = getattr(e, "name", type(e).__name__)
+        self.fields["ErrorCode"] = getattr(e, "code", 0)
+        self.severity = max(self.severity, Severity.WARN)
+        return self
+
+    def log(self) -> None:
+        if self.severity < self._log.min_severity:
+            return
+        ev = {"Time": round(self._log.clock(), 6), "Severity": self.severity}
+        ev.update(self.fields)
+        self._log.emit(ev)
+
+
+class Counter:
+    """Monotonic counter with rate; emitted via CounterCollection (REF:fdbrpc/Stats.h)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def add(self, n: int = 1) -> None:
+        self.value += n
+
+    def __iadd__(self, n: int) -> "Counter":
+        self.value += n
+        return self
+
+
+class CounterCollection:
+    def __init__(self, name: str, id_: str = ""):
+        self.name = name
+        self.id = id_
+        self.counters: dict[str, Counter] = {}
+        self._last_values: dict[str, int] = {}
+        self._last_time: Optional[float] = None
+
+    def counter(self, name: str) -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name)
+        return c
+
+    def log_metrics(self, log: Optional[TraceLog] = None) -> None:
+        lg = log or _GLOBAL
+        now = lg.clock()
+        ev = TraceEvent(f"{self.name}Metrics", log=lg).detail("ID", self.id)
+        dt = (now - self._last_time) if self._last_time is not None else None
+        for n, c in self.counters.items():
+            ev.detail(n, c.value)
+            if dt and dt > 0:
+                ev.detail(f"{n}Rate", round((c.value - self._last_values.get(n, 0)) / dt, 3))
+            self._last_values[n] = c.value
+        self._last_time = now
+        ev.log()

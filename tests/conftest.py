@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Tests run on an 8-device *CPU* mesh so multi-resolver sharding
+(shard_map over a jax Mesh) is exercised without TPU hardware, per the
+deterministic-simulation philosophy: everything must be testable on one
+CPU box (REF:fdbrpc/sim2.actor.cpp's raison d'être).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")  # conflict versions are int64

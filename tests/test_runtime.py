@@ -1,0 +1,154 @@
+"""Tests for the L0 runtime: sim loop determinism, RNG, knobs, trace, actors."""
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.runtime import (
+    DeterministicRandom, Knobs, Promise, PromiseStream, ActorCollection,
+    SimQuiescenceError, TraceEvent, TraceLog, run_simulation, timeout_error,
+    deterministic_random, enable_buggify, buggify,
+)
+from foundationdb_tpu.runtime.errors import TimedOut, NotCommitted, error_from_code
+
+
+def test_rng_deterministic():
+    a = DeterministicRandom(42)
+    b = DeterministicRandom(42)
+    assert [a.next_u64() for _ in range(100)] == [b.next_u64() for _ in range(100)]
+    c = DeterministicRandom(43)
+    assert a.next_u64() != c.next_u64()
+
+
+def test_rng_ranges():
+    r = DeterministicRandom(7)
+    vals = [r.random_int(10, 20) for _ in range(1000)]
+    assert min(vals) >= 10 and max(vals) < 20
+    fs = [r.random() for _ in range(1000)]
+    assert all(0.0 <= f < 1.0 for f in fs)
+    assert len(r.random_bytes(33)) == 33
+
+
+def test_errors():
+    e = NotCommitted()
+    assert e.code == 1020 and e.retryable and not e.maybe_committed
+    assert error_from_code(1021).maybe_committed
+    assert error_from_code(999999).code == 999999
+
+
+def test_knobs():
+    k = Knobs()
+    k2 = k.set_from_strings({"resolver_conflict_backend": "tpu",
+                             "conflict_ring_capacity": "1024",
+                             "commit_batch_interval": "0.01",
+                             "buggify_enabled": "true"})
+    assert k2.RESOLVER_CONFLICT_BACKEND == "tpu"
+    assert k2.CONFLICT_RING_CAPACITY == 1024
+    assert k2.COMMIT_BATCH_INTERVAL == 0.01
+    assert k2.BUGGIFY_ENABLED is True
+    assert k.RESOLVER_CONFLICT_BACKEND == "numpy"  # original untouched
+    with pytest.raises(KeyError):
+        k.set_from_strings({"no_such_knob": "1"})
+
+
+def test_sim_virtual_time():
+    async def main():
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await asyncio.sleep(100.0)       # virtual: returns instantly
+        await asyncio.sleep(3600.0)
+        return loop.time() - t0
+
+    elapsed = run_simulation(main(), seed=1)
+    assert abs(elapsed - 3700.0) < 1.0   # clock jumped, not slept
+
+
+def test_sim_determinism():
+    async def main():
+        rng = deterministic_random()
+        log: list = []
+
+        async def worker(i):
+            for _ in range(5):
+                await asyncio.sleep(rng.random() * 0.01)
+                log.append((i, round(asyncio.get_running_loop().time(), 9)))
+
+        await asyncio.gather(*[worker(i) for i in range(5)])
+        return log
+
+    a = run_simulation(main(), seed=99)
+    b = run_simulation(main(), seed=99)
+    c = run_simulation(main(), seed=100)
+    assert a == b
+    assert a != c
+
+
+def test_sim_quiescence_detected():
+    async def main():
+        await Promise().future  # never set, nothing else scheduled
+
+    with pytest.raises(SimQuiescenceError):
+        run_simulation(main(), seed=0)
+
+
+def test_timeout_error():
+    async def main():
+        with pytest.raises(TimedOut):
+            await timeout_error(asyncio.sleep(10.0), 0.5)
+        return asyncio.get_running_loop().time()
+
+    t = run_simulation(main(), seed=0)
+    assert 0.4 < t < 1.0
+
+
+def test_promise_stream_and_actor_collection():
+    async def main():
+        ps = PromiseStream()
+        out = []
+
+        async def consumer():
+            async for v in ps:
+                out.append(v)
+                if v == 2:
+                    return "done"
+
+        ac = ActorCollection()
+        t = ac.add(consumer())
+        ps.send(1)
+        ps.send(2)
+        r = await t
+
+        async def boom():
+            raise ValueError("x")
+
+        ac.add(boom())
+        with pytest.raises(ValueError):
+            await ac.wait_for_error()
+        await ac.aclose()
+        return out, r
+
+    out, r = run_simulation(main(), seed=0)
+    assert out == [1, 2] and r == "done"
+
+
+def test_trace_events():
+    seen = []
+    log = TraceLog()
+    log.sink = seen.append
+    TraceEvent("TestEvent", log=log).detail("K", 5).log()
+    TraceEvent("Quiet", severity=5, log=log).log()  # below min severity
+    assert len(seen) == 1
+    assert seen[0]["Type"] == "TestEvent" and seen[0]["K"] == 5
+
+
+def test_buggify_deterministic():
+    from foundationdb_tpu.runtime import set_deterministic_random
+    set_deterministic_random(DeterministicRandom(5))
+    enable_buggify(True)
+    a = [buggify("site1") for _ in range(200)]
+    set_deterministic_random(DeterministicRandom(5))
+    enable_buggify(True)
+    b = [buggify("site1") for _ in range(200)]
+    assert a == b
+    enable_buggify(False)
+    assert not any(buggify("site1") for _ in range(50))
