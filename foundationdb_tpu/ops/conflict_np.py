@@ -1,0 +1,117 @@
+"""NumPy twin of the TPU conflict kernel — the deterministic CPU reference.
+
+Same state layout and arithmetic as ops/conflict_jax.py, so TPU and CPU
+produce bit-identical verdicts; simulation always runs this twin
+(SURVEY.md §4: determinism with a TPU in the loop is hard part #1, solved
+by never putting the TPU in the sim loop).
+
+Replaces the reference's ConflictSet (REF:fdbserver/SkipList.cpp): where
+the reference walks a probabilistic skip list per range with SSE prefetch,
+we brute-force compare every read range in the batch against a
+fixed-capacity ring of (interval, version) write records — embarrassingly
+parallel, exactly what a TPU's VPU wants, and O(B·R·C) instead of
+O(B·R·log C), a trade that wins because the comparisons are 8-bit-wide
+vector lanes, not pointer chases.
+
+Ring-overflow semantics: inserting over a still-live entry raises the
+``floor`` version to the overwritten entry's version, so any transaction
+whose snapshot predates it gets TOO_OLD — the same safe fallback the
+reference applies when history is compacted (setOldestVersion /
+MAX_WRITE_TRANSACTION_LIFE_VERSIONS, REF:fdbserver/Resolver.actor.cpp).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import keycode
+from .batch import COMMITTED, CONFLICT, TOO_OLD, EncodedBatch
+from .keycode import DEFAULT_WIDTH
+
+
+def _possibly_lt(a, b, width):
+    both_trunc = (a[..., -1] == width + 1) & (b[..., -1] == width + 1)
+    return keycode.lex_lt(a, b) | (keycode.lex_eq(a, b) & both_trunc)
+
+
+def _overlap(ab, ae, bb, be, width):
+    """Conservative interval overlap: [ab,ae) might intersect [bb,be)."""
+    return _possibly_lt(ab, be, width) & _possibly_lt(bb, ae, width)
+
+
+class NumpyConflictSet:
+    """Fixed-capacity conflict history ring + batch resolve."""
+
+    def __init__(self, capacity: int, width: int = DEFAULT_WIDTH,
+                 oldest_version: int = 0):
+        self.capacity = capacity
+        self.width = width
+        L = keycode.nlanes(width)
+        S = keycode.sentinel(width)
+        self.hb = np.tile(S, (capacity, 1))          # history begins [C, L]
+        self.he = np.tile(S, (capacity, 1))          # history ends   [C, L]
+        self.hver = np.full(capacity, -1, np.int64)  # history versions (-1 = empty)
+        self.ptr = 0
+        self.floor = np.int64(oldest_version)
+
+    # --- ConflictSet API (mirrors newConflictSet/setOldestVersion/resolve) ---
+
+    def set_oldest_version(self, v: int) -> None:
+        self.floor = max(self.floor, np.int64(v))
+
+    @property
+    def oldest_version(self) -> int:
+        return int(self.floor)
+
+    def resolve_encoded(self, eb: EncodedBatch, commit_version: int) -> np.ndarray:
+        """Returns verdicts [B] int8; updates the ring with committed writes."""
+        B, R, L = eb.shape
+        if B * R > self.capacity:
+            raise ValueError("batch write slots exceed ring capacity")
+        w = self.width
+        snap = eb.read_snapshot  # [B]
+
+        too_old = snap < self.floor
+
+        # 1. reads vs history ring: [B,R,1,L] x [1,1,C,L] -> [B,R,C]
+        hit = _overlap(eb.read_begin[:, :, None, :], eb.read_end[:, :, None, :],
+                       self.hb[None, None, :, :], self.he[None, None, :, :], w)
+        newer = self.hver[None, None, :] > snap[:, None, None]   # [B,1,C] (hver=-1 never passes)
+        hist_conflict = (hit & newer).any(axis=(1, 2))           # [B]
+
+        # 2. intra-batch: reads of i vs writes of j: [B,R,1,1,L] x [1,1,B,R,L] -> [B,B]
+        m = _overlap(eb.read_begin[:, :, None, None, :], eb.read_end[:, :, None, None, :],
+                     eb.write_begin[None, None, :, :, :], eb.write_end[None, None, :, :, :], w)
+        M = m.any(axis=(1, 3))
+        np.fill_diagonal(M, False)
+
+        # 3. sequential commit resolution (order within batch matters; the
+        #    reference's checkIntraBatchConflicts walks txns in order too)
+        committed = np.zeros(B, dtype=bool)
+        verdict = np.full(B, COMMITTED, dtype=np.int8)
+        for i in range(B):
+            if snap[i] < 0:           # padding txn
+                continue
+            if too_old[i]:
+                verdict[i] = TOO_OLD
+            elif hist_conflict[i] or (committed[:i] & M[i, :i]).any():
+                verdict[i] = CONFLICT
+            else:
+                committed[i] = True
+
+        # 4. insert committed writes at commit_version; raise floor over
+        #    any live entry we overwrite
+        valid_w = eb.write_begin[..., -1] != 0xFFFFFFFF          # [B,R] non-sentinel
+        ins = committed[:, None] & valid_w
+        idx_b, idx_r = np.nonzero(ins)
+        p = self.ptr
+        for bi, ri in zip(idx_b, idx_r):
+            old = self.hver[p]
+            if old >= 0:
+                self.floor = max(self.floor, old)
+            self.hb[p] = eb.write_begin[bi, ri]
+            self.he[p] = eb.write_end[bi, ri]
+            self.hver[p] = commit_version
+            p = (p + 1) % self.capacity
+        self.ptr = p
+        return verdict

@@ -1,0 +1,105 @@
+"""Order-preserving fixed-width key encoding for the TPU conflict kernel.
+
+FDB keys are variable-length byte strings compared lexicographically
+(REF:flow/Arena.h StringRef::compare, used throughout
+REF:fdbserver/SkipList.cpp).  TPUs want fixed shapes, so keys are encoded
+into a fixed number of uint32 *lanes*:
+
+    lanes[0 : W/4]  — the first W key bytes, big-endian, zero-padded
+    lanes[W/4]      — min(len(key), W+1); W+1 marks ">W bytes, truncated"
+
+Properties (proved by tests/test_keycode.py against random byte strings):
+
+1. For keys with len <= W the encoding is injective and order-preserving:
+   lexicographic comparison of lane vectors == lexicographic comparison of
+   the byte strings.  (Zero-padding alone is not injective — b"ab" and
+   b"ab\\x00" collide — which is why the length lane exists.)
+2. For longer keys the encoding is monotone (a <= b implies enc(a) <= enc(b))
+   and the only information loss is between two truncated keys sharing
+   their first W bytes, whose encodings are equal.  ``possibly_lt`` treats
+   that case as "maybe <", which makes conflict detection *conservative*:
+   it can report a false conflict (safe — an unnecessary retry) but never
+   a false negative (which would break serializability).
+
+The all-ones lane vector is reserved as a padding sentinel: no real key
+encodes to it (the length lane is at most W+1), so a padded range
+[SENTINEL, SENTINEL) can never overlap anything.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_WIDTH = 32  # bytes of exact prefix; KEY_ENCODE_BYTES knob
+
+
+def nlanes(width: int = DEFAULT_WIDTH) -> int:
+    assert width % 4 == 0
+    return width // 4 + 1
+
+
+def sentinel(width: int = DEFAULT_WIDTH) -> np.ndarray:
+    return np.full(nlanes(width), 0xFFFFFFFF, dtype=np.uint32)
+
+
+def encode_key(key: bytes, width: int = DEFAULT_WIDTH) -> np.ndarray:
+    out = np.zeros(nlanes(width), dtype=np.uint32)
+    prefix = key[:width]
+    for i in range(0, len(prefix), 4):
+        chunk = prefix[i:i + 4]
+        out[i // 4] = int.from_bytes(chunk.ljust(4, b"\x00"), "big")
+    out[-1] = min(len(key), width + 1)
+    return out
+
+
+def encode_keys(keys: list[bytes], width: int = DEFAULT_WIDTH) -> np.ndarray:
+    """Vectorized batch encode → [N, nlanes] uint32."""
+    n = len(keys)
+    L = nlanes(width)
+    if n == 0:
+        return np.zeros((0, L), dtype=np.uint32)
+    buf = np.zeros((n, width), dtype=np.uint8)
+    lens = np.empty(n, dtype=np.uint32)
+    for i, k in enumerate(keys):
+        p = k[:width]
+        buf[i, :len(p)] = np.frombuffer(p, dtype=np.uint8)
+        lens[i] = min(len(k), width + 1)
+    lanes = buf.reshape(n, width // 4, 4).astype(np.uint32)
+    packed = (lanes[:, :, 0] << 24) | (lanes[:, :, 1] << 16) | (lanes[:, :, 2] << 8) | lanes[:, :, 3]
+    return np.concatenate([packed, lens[:, None]], axis=1)
+
+
+def decode_trunc_flag(enc: np.ndarray, width: int = DEFAULT_WIDTH):
+    """True where the encoded key was truncated (len lane == W+1)."""
+    return enc[..., -1] == width + 1
+
+
+# --- numpy comparison primitives (the jax kernel mirrors these exactly) ---
+
+def lex_lt(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Strict lexicographic < over the last (lane) axis, broadcasting the rest."""
+    L = a.shape[-1]
+    lt = np.zeros(np.broadcast_shapes(a.shape[:-1], b.shape[:-1]), dtype=bool)
+    eq = np.ones_like(lt)
+    for l in range(L):
+        al, bl = a[..., l], b[..., l]
+        lt = lt | (eq & (al < bl))
+        eq = eq & (al == bl)
+    return lt
+
+
+def lex_eq(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    L = a.shape[-1]
+    eq = np.ones(np.broadcast_shapes(a.shape[:-1], b.shape[:-1]), dtype=bool)
+    for l in range(L):
+        eq = eq & (a[..., l] == b[..., l])
+    return eq
+
+
+def possibly_lt(a: np.ndarray, b: np.ndarray, width: int = DEFAULT_WIDTH) -> np.ndarray:
+    """True where the *true* byte strings might satisfy a < b.
+
+    Exact (== definite) unless both keys were truncated to the same prefix.
+    """
+    both_trunc = (a[..., -1] == width + 1) & (b[..., -1] == width + 1)
+    return lex_lt(a, b) | (lex_eq(a, b) & both_trunc)

@@ -40,8 +40,11 @@ def now() -> float:
 class Promise:
     """Single-assignment variable; the consumer side is ``.future``.
 
-    Mirrors Flow's Promise/Future pair (REF:flow/flow.h SAV<T>). Dropping
-    all promises without sending → BrokenPromise on waiters.
+    Mirrors Flow's Promise/Future pair (REF:flow/flow.h SAV<T>), except
+    drop-detection: Flow sends broken_promise when the last Promise copy is
+    destroyed; here the owner must call ``break_promise()`` explicitly (we
+    do not rely on GC finalizers).  An abandoned waiter surfaces as
+    SimQuiescenceError in simulation rather than hanging silently.
 
     The underlying asyncio.Future is created lazily on first ``.future``
     access so a Promise may be constructed before the (sim) loop exists

@@ -23,6 +23,12 @@ def enable_buggify(on: bool = True) -> None:
     _site_enabled.clear()
 
 
+def reset_buggify_sites() -> None:
+    """Clear per-run site activations (called by run_simulation so the same
+    seed replays identically within one process)."""
+    _site_enabled.clear()
+
+
 def buggify_enabled() -> bool:
     return _enabled
 

@@ -89,6 +89,8 @@ def run_simulation(main: Coroutine[Any, Any, Any], seed: int = 0,
     """
     if install_global_rng:
         set_deterministic_random(DeterministicRandom(seed))
+        from .buggify import reset_buggify_sites
+        reset_buggify_sites()
     loop = SimEventLoop()
     try:
         return loop.run_until_complete(main)

@@ -72,6 +72,10 @@ class TraceLog:
         self._fh: Optional[io.TextIOBase] = None
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            try:
+                self._written = os.path.getsize(path)
+            except OSError:
+                pass
             self._fh = open(path, "a", buffering=1)
         self.event_count = 0
         self.sink: Optional[Callable[[dict], None]] = None  # test hook

@@ -4,12 +4,19 @@ Tests run on an 8-device *CPU* mesh so multi-resolver sharding
 (shard_map over a jax Mesh) is exercised without TPU hardware, per the
 deterministic-simulation philosophy: everything must be testable on one
 CPU box (REF:fdbrpc/sim2.actor.cpp's raison d'être).
+
+Note: a pytest plugin imports jax before this conftest runs, so env vars
+(JAX_ENABLE_X64 / JAX_PLATFORMS) are read too late — we must go through
+jax.config.update, and set XLA_FLAGS before the first backend init.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")  # conflict versions are int64
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")   # never touch the real TPU from tests
+jax.config.update("jax_enable_x64", True)   # conflict versions are int64

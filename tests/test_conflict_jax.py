@@ -1,0 +1,89 @@
+"""JAX kernel vs NumPy twin: bit-identical verdicts and state."""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.ops.batch import TxnRequest, encode_batch
+from foundationdb_tpu.ops.conflict_jax import JaxConflictSet
+from foundationdb_tpu.ops.conflict_np import NumpyConflictSet
+from foundationdb_tpu.ops.oracle import OracleConflictSet
+from foundationdb_tpu.runtime import DeterministicRandom
+
+W = 16
+B, R = 8, 4
+
+
+def rand_key(rng, maxlen, alphabet=3):
+    n = rng.random_int(1, maxlen + 1)
+    return bytes(rng.random_int(0, alphabet) for _ in range(n))
+
+
+def rand_range(rng, maxlen):
+    a, b = rand_key(rng, maxlen), rand_key(rng, maxlen)
+    if a == b:
+        b = a + b"\x00"
+    return (min(a, b), max(a, b))
+
+
+def rand_txn(rng, snap_lo, snap_hi, maxlen):
+    return TxnRequest(
+        read_ranges=[rand_range(rng, maxlen) for _ in range(rng.random_int(0, R + 1))],
+        write_ranges=[rand_range(rng, maxlen) for _ in range(rng.random_int(0, R + 1))],
+        read_snapshot=rng.random_int(snap_lo, snap_hi),
+    )
+
+
+@pytest.mark.parametrize("seed,maxlen", [(0, W), (1, W), (2, 3 * W), (3, 3 * W)])
+def test_jax_numpy_bit_parity(seed, maxlen):
+    """Full trace: verdicts AND ring state identical every batch, including
+    ring wraparound (small capacity) and set_oldest_version churn."""
+    rng = DeterministicRandom(seed)
+    capacity = B * R * 2   # force frequent wraparound
+    twin = NumpyConflictSet(capacity, W)
+    kern = JaxConflictSet(capacity, W)
+    version = 100
+    for step in range(40):
+        nt = rng.random_int(1, B + 1)
+        txns = [rand_txn(rng, max(0, version - 50), version + 1, maxlen) for _ in range(nt)]
+        version += rng.random_int(1, 20)
+        eb = encode_batch(txns, B, R, W)
+        tv = twin.resolve_encoded(eb, version)
+        jv = kern.resolve_encoded(eb, version)
+        np.testing.assert_array_equal(tv, jv, err_msg=f"verdicts diverge at step {step}")
+        # state parity over the live ring (slot C is write-only trash)
+        C = capacity
+        np.testing.assert_array_equal(twin.hb, np.asarray(kern.state.hb)[:C])
+        np.testing.assert_array_equal(twin.he, np.asarray(kern.state.he)[:C])
+        np.testing.assert_array_equal(twin.hver, np.asarray(kern.state.hver)[:C])
+        assert twin.ptr == int(kern.state.ptr)
+        assert twin.oldest_version == kern.oldest_version
+        if rng.coinflip(0.2):
+            oldest = version - rng.random_int(10, 60)
+            twin.set_oldest_version(oldest)
+            kern.set_oldest_version(oldest)
+
+
+def test_jax_oracle_parity_short_keys():
+    """Against ground truth directly (keys <= W: kernel is exact)."""
+    rng = DeterministicRandom(77)
+    kern = JaxConflictSet(4096, W)
+    oracle = OracleConflictSet()
+    version = 100
+    for _ in range(25):
+        nt = rng.random_int(1, B + 1)
+        txns = [rand_txn(rng, max(0, version - 50), version + 1, W) for _ in range(nt)]
+        version += rng.random_int(1, 20)
+        jv = kern.resolve_encoded(encode_batch(txns, B, R, W), version)[:nt].tolist()
+        ov = oracle.resolve_batch(txns, version)
+        assert jv == ov
+
+
+def test_requires_x64(monkeypatch):
+    import jax
+    if jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", False)
+        try:
+            with pytest.raises(RuntimeError, match="JAX_ENABLE_X64"):
+                JaxConflictSet(64, W)
+        finally:
+            jax.config.update("jax_enable_x64", True)

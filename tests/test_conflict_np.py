@@ -1,0 +1,162 @@
+"""NumPy conflict-set twin vs. the brute-force oracle.
+
+The randomized-workload-vs-oracle scheme mirrors the reference's
+ConflictRange simulation workload (REF:fdbserver/workloads/ConflictRange.actor.cpp).
+"""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.ops.batch import (COMMITTED, CONFLICT, TOO_OLD,
+                                        TxnRequest, encode_batch)
+from foundationdb_tpu.ops.conflict_np import NumpyConflictSet
+from foundationdb_tpu.ops.oracle import OracleConflictSet
+from foundationdb_tpu.runtime import DeterministicRandom
+
+W = 16
+B, R = 8, 4
+
+
+def rand_key(rng, maxlen, alphabet=3):
+    n = rng.random_int(1, maxlen + 1)
+    return bytes(rng.random_int(0, alphabet) for _ in range(n))
+
+
+def rand_range(rng, maxlen):
+    a, b = rand_key(rng, maxlen), rand_key(rng, maxlen)
+    if a == b:
+        b = a + b"\x00"
+    return (min(a, b), max(a, b))
+
+
+def rand_txn(rng, snap_lo, snap_hi, maxlen):
+    return TxnRequest(
+        read_ranges=[rand_range(rng, maxlen) for _ in range(rng.random_int(0, R + 1))],
+        write_ranges=[rand_range(rng, maxlen) for _ in range(rng.random_int(0, R + 1))],
+        read_snapshot=rng.random_int(snap_lo, snap_hi),
+    )
+
+
+def run_trace(seed, maxlen, n_batches=30, capacity=256):
+    """Drive twin and oracle through identical batches; return verdict traces."""
+    rng = DeterministicRandom(seed)
+    twin = NumpyConflictSet(capacity, W)
+    oracle = OracleConflictSet()
+    version = 100
+    twin_trace, oracle_trace = [], []
+    for _ in range(n_batches):
+        nt = rng.random_int(1, B + 1)
+        txns = [rand_txn(rng, max(0, version - 50), version + 1, maxlen) for _ in range(nt)]
+        version += rng.random_int(1, 20)
+        eb = encode_batch(txns, B, R, W)
+        tv = twin.resolve_encoded(eb, version)[:nt].tolist()
+        ov = oracle.resolve_batch(txns, version)
+        twin_trace.append(tv)
+        oracle_trace.append(ov)
+        if rng.coinflip(0.2):
+            oldest = version - rng.random_int(10, 60)
+            twin.set_oldest_version(oldest)
+            oracle.set_oldest_version(oldest)
+    return twin_trace, oracle_trace
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_exact_parity_short_keys(seed):
+    """Keys <= W bytes: twin must match the oracle verdict-for-verdict."""
+    tt, ot = run_trace(seed, maxlen=W)
+    assert tt == ot
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_safety_long_keys(seed):
+    """Arbitrary-length keys: the committed schedule must be serializable.
+
+    The twin may falsely abort (conservative truncation) but a committed
+    txn must never have read anything a newer committed write touched —
+    checked with exact byte-string math against the twin's own committed
+    history.
+    """
+    rng = DeterministicRandom(seed + 1000)
+    twin = NumpyConflictSet(512, W)
+    shadow = []  # exact committed writes: (begin, end, version)
+    version = 100
+    for _ in range(30):
+        nt = rng.random_int(1, B + 1)
+        txns = [rand_txn(rng, max(0, version - 50), version + 1, maxlen=W * 3)
+                for _ in range(nt)]
+        version += rng.random_int(1, 20)
+        eb = encode_batch(txns, B, R, W)
+        v = twin.resolve_encoded(eb, version)
+        batch_committed = []
+        for i in range(nt):
+            if v[i] != COMMITTED:
+                continue
+            t = txns[i]
+            for (rb, re) in t.read_ranges:
+                for (wb, we, wv) in shadow:
+                    assert not (wv > t.read_snapshot and rb < we and wb < re), \
+                        "committed txn read overlaps newer committed write"
+                for (wb, we) in batch_committed:
+                    assert not (rb < we and wb < re), \
+                        "committed txn read overlaps earlier-in-batch committed write"
+            batch_committed.extend(t.write_ranges)
+        shadow.extend((b, e, version) for (b, e) in batch_committed)
+
+
+def test_too_old_at_floor_boundary():
+    twin = NumpyConflictSet(64, W, oldest_version=100)
+    mk = lambda snap, k: TxnRequest([(k, k + b"\x00")], [(k, k + b"\x00")], snap)
+    txns = [mk(99, b"a"), mk(100, b"b"), mk(101, b"c")]  # disjoint keys
+    v = twin.resolve_encoded(encode_batch(txns, B, R, W), 200)
+    assert v[0] == TOO_OLD           # snapshot < oldest
+    assert v[1] == COMMITTED         # snapshot == oldest is fine
+    assert v[2] == COMMITTED
+    oracle = OracleConflictSet(oldest_version=100)
+    assert oracle.resolve_batch(txns, 200) == [TOO_OLD, COMMITTED, COMMITTED]
+
+
+def test_ring_overflow_forces_too_old():
+    """Overwriting live history raises the floor -> old snapshots abort."""
+    twin = NumpyConflictSet(capacity=B * R, width=W)
+    version = 10
+    # fill the ring with committed writes at increasing versions, then wrap
+    for _ in range(6):
+        txns = [TxnRequest([], [(bytes([i, j]), bytes([i, j, 0]))], version - 1)
+                for i in range(4) for j in range(2)]
+        eb = encode_batch(txns, B, R, W)
+        twin.resolve_encoded(eb, version)
+        version += 10
+    assert twin.oldest_version > 0  # floor was raised by overwrites
+    old_snap = twin.oldest_version - 1
+    eb = encode_batch([TxnRequest([(b"zzz", b"zzzz")], [], old_snap)], B, R, W)
+    assert twin.resolve_encoded(eb, version)[0] == TOO_OLD
+
+
+def test_intra_batch_order_matters():
+    """Earlier txn in batch wins; later reader of its write conflicts."""
+    twin = NumpyConflictSet(64, W)
+    t1 = TxnRequest([], [(b"k", b"k\x00")], 10)       # writes k
+    t2 = TxnRequest([(b"k", b"k\x00")], [], 10)       # reads k
+    v = twin.resolve_encoded(encode_batch([t1, t2], B, R, W), 20)
+    assert v[0] == COMMITTED and v[1] == CONFLICT
+    # reversed order: reader goes first, both commit
+    twin2 = NumpyConflictSet(64, W)
+    v2 = twin2.resolve_encoded(encode_batch([t2, t1], B, R, W), 20)
+    assert v2[0] == COMMITTED and v2[1] == COMMITTED
+
+
+def test_aborted_txn_writes_not_recorded():
+    twin = NumpyConflictSet(64, W)
+    oracle = OracleConflictSet()
+    # batch 1: writer commits at v20
+    w = TxnRequest([], [(b"a", b"a\x00")], 10)
+    twin.resolve_encoded(encode_batch([w], B, R, W), 20)
+    oracle.resolve_batch([w], 20)
+    # batch 2: txn reads a at snapshot 10 -> conflict; its write to b aborted
+    t = TxnRequest([(b"a", b"a\x00")], [(b"b", b"b\x00")], 10)
+    assert twin.resolve_encoded(encode_batch([t], B, R, W), 30)[0] == CONFLICT
+    assert oracle.resolve_batch([t], 30) == [CONFLICT]
+    # batch 3: reader of b at snapshot 25 must COMMIT (b was never written)
+    t3 = TxnRequest([(b"b", b"b\x00")], [], 25)
+    assert twin.resolve_encoded(encode_batch([t3], B, R, W), 40)[0] == COMMITTED
+    assert oracle.resolve_batch([t3], 40) == [COMMITTED]

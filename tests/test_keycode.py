@@ -1,0 +1,70 @@
+"""Property tests for the order-preserving fixed-width key encoding."""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.ops import keycode
+from foundationdb_tpu.runtime import DeterministicRandom
+
+W = 16  # smaller width in tests to hit truncation paths often
+
+
+def rand_key(rng, maxlen=24, alphabet=4):
+    n = rng.random_int(0, maxlen + 1)
+    # tiny alphabet maximizes shared prefixes / ties
+    return bytes(rng.random_int(0, alphabet) for _ in range(n))
+
+
+def test_encode_exact_order_short_keys():
+    rng = DeterministicRandom(1)
+    keys = [rand_key(rng, maxlen=W) for _ in range(300)] + [b"", b"\x00", b"\x00" * W]
+    enc = keycode.encode_keys(keys, W)
+    for i in range(0, len(keys), 7):
+        for j in range(len(keys)):
+            a, b = keys[i], keys[j]
+            lt = keycode.lex_lt(enc[i], enc[j])
+            eq = keycode.lex_eq(enc[i], enc[j])
+            assert bool(lt) == (a < b), (a, b)
+            assert bool(eq) == (a == b), (a, b)
+
+
+def test_encode_monotone_long_keys():
+    rng = DeterministicRandom(2)
+    keys = sorted(rand_key(rng, maxlen=40) for _ in range(300))
+    enc = keycode.encode_keys(keys, W)
+    for i in range(len(keys) - 1):
+        # a <= b  =>  enc(a) <= enc(b):  never enc(b) < enc(a)
+        assert not bool(keycode.lex_lt(enc[i + 1], enc[i])), (keys[i], keys[i + 1])
+
+
+def test_possibly_lt_conservative():
+    """true a<b implies possibly_lt; exact when not both-truncated."""
+    rng = DeterministicRandom(3)
+    keys = [rand_key(rng, maxlen=40) for _ in range(200)]
+    enc = keycode.encode_keys(keys, W)
+    for i in range(0, len(keys), 5):
+        for j in range(len(keys)):
+            a, b = keys[i], keys[j]
+            plt = bool(keycode.possibly_lt(enc[i], enc[j], W))
+            if a < b:
+                assert plt, (a, b)           # no false negatives, ever
+            both_trunc = len(a) > W and len(b) > W and a[:W] == b[:W]
+            if not both_trunc:
+                assert plt == (a < b), (a, b)  # exact outside the ambiguous case
+
+
+def test_encode_key_matches_batch_encode():
+    rng = DeterministicRandom(4)
+    keys = [rand_key(rng, maxlen=40, alphabet=256) for _ in range(100)]
+    batch = keycode.encode_keys(keys, W)
+    for i, k in enumerate(keys):
+        np.testing.assert_array_equal(batch[i], keycode.encode_key(k, W))
+
+
+def test_sentinel_above_everything():
+    rng = DeterministicRandom(5)
+    S = keycode.sentinel(W)
+    for _ in range(100):
+        k = keycode.encode_key(rand_key(rng, maxlen=40, alphabet=256), W)
+        assert bool(keycode.lex_lt(k, S))
+        assert not bool(keycode.possibly_lt(S, k, W))
