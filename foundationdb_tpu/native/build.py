@@ -1,0 +1,46 @@
+"""Build the native components with g++ → shared libraries.
+
+Run directly (``python foundationdb_tpu/native/build.py``) or let
+``native.load_library`` build lazily on first use.  No pybind11 in this
+image, so bindings go through a C ABI + ctypes.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+TARGETS = {
+    "conflictset": ["conflictset.cpp"],
+}
+
+CXXFLAGS = ["-std=c++20", "-O3", "-march=native", "-fPIC", "-shared",
+            "-Wall", "-Wextra", "-fno-exceptions", "-fno-rtti"]
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(HERE, f"lib{name}.so")
+
+
+def build(name: str, force: bool = False) -> str:
+    srcs = [os.path.join(HERE, s) for s in TARGETS[name]]
+    out = lib_path(name)
+    if not force and os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
+        return out
+    cmd = ["g++", *CXXFLAGS, "-o", out, *srcs]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out
+
+
+def build_all(force: bool = False) -> None:
+    for name in TARGETS:
+        print(f"building lib{name}.so ...", file=sys.stderr)
+        build(name, force=force)
+
+
+if __name__ == "__main__":
+    build_all(force="--force" in sys.argv)

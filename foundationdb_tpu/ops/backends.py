@@ -1,0 +1,103 @@
+"""Resolver conflict-backend registry — the RESOLVER_CONFLICT_BACKEND knob.
+
+The resolver role (core/resolver.py) picks its ConflictSet implementation
+here, exactly as Resolver.actor.cpp would consult a server knob
+(SURVEY.md §5.6, BASELINE.json north_star):
+
+    cpp    — C++ interval-version map, exact byte keys (CPU baseline)
+    numpy  — encoded-lane NumPy twin (deterministic; what simulation uses)
+    tpu    — encoded-lane JAX kernel with persistent device state
+
+All backends share one semantic contract, tested against the brute-force
+oracle.  The encoded backends are *conservative*: a verdict may flip
+COMMITTED→CONFLICT (extra retry, safe) but never the reverse.
+
+Shape discipline for the encoded backends:
+- batches larger than B txns are chunked; chunks share the batch's commit
+  version, which preserves intra-batch semantics exactly (later chunks see
+  earlier chunks' writes in history at the same version);
+- transactions with more than R conflict ranges get their ranges
+  *coalesced* (adjacent ranges merged into covering ranges) — a
+  conservative widening that keeps shapes static instead of falling off
+  the TPU path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..runtime.knobs import Knobs
+from . import keycode
+from .batch import EncodedBatch, TxnRequest
+
+
+def coalesce_ranges(ranges: list[tuple[bytes, bytes]], max_n: int) -> list[tuple[bytes, bytes]]:
+    """Merge sorted-adjacent ranges until len <= max_n (conservative)."""
+    if len(ranges) <= max_n:
+        return ranges
+    rs = sorted(ranges)
+    while len(rs) > max_n:
+        merged = []
+        i = 0
+        while i < len(rs):
+            if len(rs) - i + len(merged) > max_n and i + 1 < len(rs):
+                a, b = rs[i], rs[i + 1]
+                merged.append((a[0], max(a[1], b[1])))
+                i += 2
+            else:
+                merged.append(rs[i])
+                i += 1
+        rs = merged
+    return rs
+
+
+class EncodedConflictBackend:
+    """Wraps a lane-encoded conflict set (numpy or jax) behind the
+    byte-string TxnRequest interface."""
+
+    def __init__(self, conflict_set, batch_txns: int, ranges_per_txn: int,
+                 width: int):
+        self.cs = conflict_set
+        self.B = batch_txns
+        self.R = ranges_per_txn
+        self.width = width
+
+    def resolve(self, txns: list[TxnRequest], commit_version: int) -> list[int]:
+        from .batch import encode_batch
+        out: list[int] = []
+        for start in range(0, len(txns), self.B):
+            chunk = txns[start:start + self.B]
+            chunk = [TxnRequest(coalesce_ranges(t.read_ranges, self.R),
+                                coalesce_ranges(t.write_ranges, self.R),
+                                t.read_snapshot) for t in chunk]
+            eb = encode_batch(chunk, self.B, self.R, self.width)
+            v = self.cs.resolve_encoded(eb, commit_version)
+            out.extend(int(x) for x in v[:len(chunk)])
+        return out
+
+    def set_oldest_version(self, v: int) -> None:
+        self.cs.set_oldest_version(v)
+
+    @property
+    def oldest_version(self) -> int:
+        return self.cs.oldest_version
+
+
+def make_conflict_backend(knobs: Knobs, device=None):
+    """Instantiate the backend the RESOLVER_CONFLICT_BACKEND knob names."""
+    kind = knobs.RESOLVER_CONFLICT_BACKEND
+    if kind == "cpp":
+        from .conflict_cpp import CppConflictSet
+        return CppConflictSet()
+    if kind == "numpy":
+        from .conflict_np import NumpyConflictSet
+        cs = NumpyConflictSet(knobs.CONFLICT_RING_CAPACITY, knobs.KEY_ENCODE_BYTES)
+    elif kind == "tpu":
+        from .conflict_jax import JaxConflictSet
+        cs = JaxConflictSet(knobs.CONFLICT_RING_CAPACITY, knobs.KEY_ENCODE_BYTES,
+                            device=device)
+    else:
+        raise ValueError(f"unknown RESOLVER_CONFLICT_BACKEND {kind!r}")
+    return EncodedConflictBackend(cs, knobs.RESOLVER_BATCH_TXNS,
+                                  knobs.RESOLVER_RANGES_PER_TXN,
+                                  knobs.KEY_ENCODE_BYTES)

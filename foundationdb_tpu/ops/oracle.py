@@ -65,3 +65,6 @@ class OracleConflictSet:
         for (b, e) in committed_writes:
             self.history.append((b, e, commit_version))
         return verdicts
+
+    # uniform backend interface (ops/backends.py)
+    resolve = resolve_batch

@@ -1,0 +1,79 @@
+"""Backend registry: knob selection, chunking, coalescing conservatism."""
+
+import pytest
+
+from foundationdb_tpu.ops.backends import coalesce_ranges, make_conflict_backend
+from foundationdb_tpu.ops.batch import COMMITTED, CONFLICT, TxnRequest
+from foundationdb_tpu.ops.oracle import OracleConflictSet
+from foundationdb_tpu.runtime import DeterministicRandom, Knobs
+
+
+def K(**kw):
+    return Knobs().override(CONFLICT_RING_CAPACITY=4096, KEY_ENCODE_BYTES=16,
+                            RESOLVER_BATCH_TXNS=8, RESOLVER_RANGES_PER_TXN=4, **kw)
+
+
+def rand_txn(rng, version, nr):
+    def rr():
+        a = bytes(rng.random_int(0, 3) for _ in range(rng.random_int(1, 12)))
+        return (a, a + b"\x01")
+    return TxnRequest([rr() for _ in range(rng.random_int(0, nr))],
+                      [rr() for _ in range(rng.random_int(0, nr))],
+                      rng.random_int(max(0, version - 40), version + 1))
+
+
+@pytest.mark.parametrize("kind", ["cpp", "numpy", "tpu"])
+def test_all_backends_match_oracle_in_bucket(kind):
+    rng = DeterministicRandom(11)
+    be = make_conflict_backend(K(RESOLVER_CONFLICT_BACKEND=kind))
+    oracle = OracleConflictSet()
+    version = 100
+    for _ in range(20):
+        txns = [rand_txn(rng, version, nr=4) for _ in range(rng.random_int(1, 9))]
+        version += rng.random_int(1, 15)
+        assert be.resolve(txns, version) == oracle.resolve(txns, version)
+        if rng.coinflip(0.2):
+            v = version - rng.random_int(5, 50)
+            be.set_oldest_version(v)
+            oracle.set_oldest_version(v)
+
+
+def test_chunking_preserves_semantics():
+    """Batch of 20 txns through B=8 backend == oracle one-shot."""
+    rng = DeterministicRandom(22)
+    be = make_conflict_backend(K(RESOLVER_CONFLICT_BACKEND="numpy"))
+    oracle = OracleConflictSet()
+    txns = [rand_txn(rng, 100, nr=4) for _ in range(20)]
+    assert be.resolve(txns, 120) == oracle.resolve(txns, 120)
+
+
+def test_coalesce_ranges():
+    rs = [(bytes([i]), bytes([i]) + b"\x00") for i in range(10)]
+    out = coalesce_ranges(rs, 4)
+    assert len(out) <= 4
+    # covering: every original range inside some merged range
+    for (b, e) in rs:
+        assert any(mb <= b and e <= me for (mb, me) in out)
+    assert coalesce_ranges(rs, 10) == rs  # no-op when it fits
+
+
+def test_oversize_txn_is_conservative_not_error():
+    """Txn with 12 ranges through R=4 backend: runs, and any verdict flip
+    vs oracle is COMMITTED->CONFLICT only."""
+    rng = DeterministicRandom(33)
+    be = make_conflict_backend(K(RESOLVER_CONFLICT_BACKEND="numpy"))
+    oracle = OracleConflictSet()
+    version = 100
+    for _ in range(15):
+        txns = [rand_txn(rng, version, nr=12) for _ in range(4)]
+        version += 10
+        bv = be.resolve(txns, version)
+        ov = oracle.resolve(txns, version)
+        for x, o in zip(bv, ov):
+            if x != o:
+                assert (x, o) == (CONFLICT, COMMITTED)
+        # keep oracle's history aligned with what the backend committed:
+        # feed the backend's verdicts forward by re-adding... (divergence is
+        # expected after a flip; stop comparing once they differ)
+        if bv != ov:
+            break

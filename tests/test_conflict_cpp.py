@@ -1,0 +1,86 @@
+"""C++ conflict set vs oracle (exact, all key lengths) and vs kernels."""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.ops.batch import TxnRequest, encode_batch
+from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+from foundationdb_tpu.ops.conflict_np import NumpyConflictSet
+from foundationdb_tpu.ops.oracle import OracleConflictSet
+from foundationdb_tpu.runtime import DeterministicRandom
+
+W = 16
+B, R = 8, 4
+
+
+def rand_key(rng, maxlen, alphabet=3):
+    n = rng.random_int(1, maxlen + 1)
+    return bytes(rng.random_int(0, alphabet) for _ in range(n))
+
+
+def rand_range(rng, maxlen):
+    a, b = rand_key(rng, maxlen), rand_key(rng, maxlen)
+    if a == b:
+        b = a + b"\x00"
+    return (min(a, b), max(a, b))
+
+
+def rand_txn(rng, snap_lo, snap_hi, maxlen):
+    return TxnRequest(
+        read_ranges=[rand_range(rng, maxlen) for _ in range(rng.random_int(0, R + 1))],
+        write_ranges=[rand_range(rng, maxlen) for _ in range(rng.random_int(0, R + 1))],
+        read_snapshot=rng.random_int(snap_lo, snap_hi),
+    )
+
+
+@pytest.mark.parametrize("seed,maxlen", [(0, W), (1, W), (2, 64), (3, 64), (4, 200)])
+def test_cpp_oracle_exact_parity(seed, maxlen):
+    """C++ uses raw byte keys: must match the oracle on every input."""
+    rng = DeterministicRandom(seed)
+    cpp = CppConflictSet()
+    oracle = OracleConflictSet()
+    version = 100
+    for step in range(40):
+        nt = rng.random_int(1, B + 1)
+        txns = [rand_txn(rng, max(0, version - 50), version + 1, maxlen) for _ in range(nt)]
+        version += rng.random_int(1, 20)
+        cv = cpp.resolve_batch(txns, version)
+        ov = oracle.resolve_batch(txns, version)
+        assert cv == ov, f"diverged at step {step}"
+        if rng.coinflip(0.2):
+            oldest = version - rng.random_int(10, 60)
+            cpp.set_oldest_version(oldest)
+            oracle.set_oldest_version(oldest)
+    assert cpp.segment_count > 1
+
+
+def test_cpp_numpy_parity_short_keys():
+    rng = DeterministicRandom(55)
+    cpp = CppConflictSet()
+    twin = NumpyConflictSet(4096, W)
+    version = 100
+    for _ in range(25):
+        nt = rng.random_int(1, B + 1)
+        txns = [rand_txn(rng, max(0, version - 50), version + 1, W) for _ in range(nt)]
+        version += rng.random_int(1, 20)
+        cv = cpp.resolve_batch(txns, version)
+        tv = twin.resolve_encoded(encode_batch(txns, B, R, W), version)[:nt].tolist()
+        assert cv == tv
+
+
+def test_cpp_empty_batch_and_no_ranges():
+    cpp = CppConflictSet()
+    assert cpp.resolve_batch([], 10) == []
+    t = TxnRequest([], [], 5)
+    assert cpp.resolve_batch([t], 10) == [0]
+
+
+def test_cpp_set_oldest_compaction():
+    cpp = CppConflictSet()
+    txns = [TxnRequest([], [(bytes([i]), bytes([i, 0]))], 0) for i in range(50)]
+    cpp.resolve_batch(txns, 10)
+    n_before = cpp.segment_count
+    cpp.set_oldest_version(20)  # all history now stale -> compacts to 1 segment
+    assert cpp.segment_count < n_before
+    assert cpp.resolve_batch([TxnRequest([(b"\x01", b"\x02")], [], 15)], 30) == [2]  # too old
+    assert cpp.resolve_batch([TxnRequest([(b"\x01", b"\x02")], [], 25)], 40) == [0]
