@@ -1,0 +1,11 @@
+"""Benchmark harness components (mako reimplementation lives here).
+
+Reference: REF:bindings/c/test/mako/mako.c — keyed workload generator with
+zipfian hot keys, fixed-width keys, r/w mixes, and TPS/latency percentile
+reporting.  bench.py at the repo root drives these against the resolver
+backends for the north-star metric.
+"""
+
+from .workload import ZipfianGenerator, MakoWorkload
+
+__all__ = ["ZipfianGenerator", "MakoWorkload"]

@@ -82,12 +82,13 @@ def _overlap(ab, ae, bb, be, width):
     return _possibly_lt(ab, be, width) & _possibly_lt(bb, ae, width)
 
 
-@functools.partial(jax.jit, static_argnames=("width",), donate_argnums=(0,))
-def resolve_step(state: ConflictState, read_begin, read_end, write_begin,
+def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
                  write_end, snap, commit_version, *, width: int = DEFAULT_WIDTH):
-    """One resolve launch: (state, batch) -> (state', verdicts[B] int8).
+    """One resolve step: (state, batch) -> (state', verdicts[B] int8).
 
-    Mirrors ConflictBatch::addTransaction + detectConflicts
+    Pure traceable core shared by the single-chip jit (``resolve_step``)
+    and the shard_map multi-resolver path (parallel/sharded.py).  Mirrors
+    ConflictBatch::addTransaction + detectConflicts
     (REF:fdbserver/SkipList.cpp) for a whole proxy batch at once.
     """
     C = state.hver.shape[0] - 1
@@ -138,6 +139,10 @@ def resolve_step(state: ConflictState, read_begin, read_end, write_begin,
     ptr2 = ((state.ptr + jnp.sum(ins)) % C).astype(jnp.int32)
 
     return ConflictState(hb2, he2, hver2, ptr2, floor2), verdicts
+
+
+resolve_step = functools.partial(jax.jit, static_argnames=("width",),
+                                 donate_argnums=(0,))(resolve_core)
 
 
 @jax.jit

@@ -1,0 +1,155 @@
+"""Key-range-partitioned conflict detection sharded over a device mesh.
+
+The reference scales conflict detection with multiple Resolver roles, each
+owning a key-range partition; every CommitProxy broadcasts its batch to all
+resolvers and ANDs the verdicts (REF:fdbserver/Resolver.actor.cpp,
+REF:fdbserver/CommitProxyServer.actor.cpp).  TPU-native, the partitions
+live on the devices of a ``jax.sharding.Mesh`` axis named ``resolvers``:
+
+- each device holds its partition's history ring (state sharded on the
+  leading axis);
+- the encoded batch is replicated to all devices (it is ~100KB — the
+  broadcast rides ICI, the analog of the proxy's fan-out over TCP);
+- each device masks *write* ranges to its partition (reads need no mask:
+  a ring only ever holds writes inside its own partition, so foreign
+  reads simply match nothing), runs the same resolve core as the
+  single-chip kernel, and the per-device verdicts combine with a pmax —
+  TOO_OLD(2) > CONFLICT(1) > COMMITTED(0) gives the reference's verdict
+  precedence for free.
+
+Fidelity note: like the reference's multi-resolver mode, each partition
+decides commits from its *local* view, so a transaction aborted by one
+partition may still have its writes recorded by another ("phantom"
+conflict ranges).  That is conservative (false conflicts only) and is
+exactly the documented behavior of FDB multi-resolver clusters.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import keycode
+from ..ops.conflict_jax import ConflictState, resolve_core
+from ..ops.keycode import DEFAULT_WIDTH
+
+
+class ShardedConflictState(NamedTuple):
+    """ConflictState arrays with a leading resolver-shard axis, plus the
+    partition boundary table (replicated)."""
+    hb: jax.Array     # [S, C+1, L]
+    he: jax.Array     # [S, C+1, L]
+    hver: jax.Array   # [S, C+1]
+    ptr: jax.Array    # [S]
+    floor: jax.Array  # [S]
+    part_lo: jax.Array  # [S, L] partition begin keys (encoded)
+    part_hi: jax.Array  # [S, L] partition end keys
+
+
+def make_partition_boundaries(n_shards: int, width: int = DEFAULT_WIDTH,
+                              split_keys: list[bytes] | None = None) -> np.ndarray:
+    """[S+1, L] boundary table: shard i owns [b[i], b[i+1]).
+
+    Default split: even slices of the first-byte space — data distribution
+    will supply real split keys once shard statistics exist (the analog of
+    ResolverMoveKeys in the reference).
+    """
+    L = keycode.nlanes(width)
+    out = np.zeros((n_shards + 1, L), dtype=np.uint32)
+    if split_keys is not None:
+        assert len(split_keys) == n_shards - 1
+        for i, k in enumerate(split_keys):
+            out[i + 1] = keycode.encode_key(k, width)
+    else:
+        for i in range(1, n_shards):
+            first = (i * 256) // n_shards
+            out[i] = keycode.encode_key(bytes([first]), width)
+    out[0] = 0                      # "" — below every key
+    out[n_shards] = 0xFFFFFFFF      # sentinel — above every key
+    return out
+
+
+def init_sharded_state(mesh: Mesh, capacity_per_shard: int,
+                       width: int = DEFAULT_WIDTH, oldest_version: int = 0,
+                       split_keys: list[bytes] | None = None) -> ShardedConflictState:
+    S = mesh.shape["resolvers"]
+    L = keycode.nlanes(width)
+    C = capacity_per_shard
+    bounds = make_partition_boundaries(S, width, split_keys)
+    state = ShardedConflictState(
+        hb=jnp.full((S, C + 1, L), 0xFFFFFFFF, jnp.uint32),
+        he=jnp.full((S, C + 1, L), 0xFFFFFFFF, jnp.uint32),
+        hver=jnp.full((S, C + 1), -1, jnp.int64),
+        ptr=jnp.zeros(S, jnp.int32),
+        floor=jnp.full(S, oldest_version, jnp.int64),
+        part_lo=jnp.asarray(bounds[:-1]),
+        part_hi=jnp.asarray(bounds[1:]),
+    )
+    shard = NamedSharding(mesh, P("resolvers"))
+    return ShardedConflictState(*[jax.device_put(x, shard) for x in state])
+
+
+def _mask_writes_to_partition(wb, we, lo, hi, width):
+    """Replace write ranges not overlapping [lo, hi) with sentinels."""
+    overlap = (keycode_possibly_lt(wb, hi[None, None, :], width) &
+               keycode_possibly_lt(lo[None, None, :], we, width))   # [B,R]
+    S = jnp.uint32(0xFFFFFFFF)
+    wb2 = jnp.where(overlap[..., None], wb, S)
+    we2 = jnp.where(overlap[..., None], we, S)
+    return wb2, we2
+
+
+def keycode_possibly_lt(a, b, width):
+    from ..ops.conflict_jax import _possibly_lt
+    return _possibly_lt(a, b, width)
+
+
+def make_sharded_resolve_step(mesh: Mesh, width: int = DEFAULT_WIDTH):
+    """Build the jitted multi-resolver step for ``mesh`` (axis 'resolvers').
+
+    step(state, rb, re, wb, we, snap, commit_version) -> (state', verdicts[B])
+    with state sharded over resolvers and the batch replicated.
+    """
+    from jax import shard_map
+
+    def local_step(hb, he, hver, ptr, floor, lo, hi, rb, re, wb, we, snap, cv):
+        # drop the leading length-1 shard axis inside the mapped body
+        st = ConflictState(hb[0], he[0], hver[0], ptr[0], floor[0])
+        wbm, wem = _mask_writes_to_partition(wb, we, lo[0], hi[0], width)
+        st2, verdicts = resolve_core(st, rb, re, wbm, wem, snap, cv, width=width)
+        verdicts = jax.lax.pmax(verdicts, "resolvers")   # combine across partitions
+        return (st2.hb[None], st2.he[None], st2.hver[None], st2.ptr[None],
+                st2.floor[None], verdicts)
+
+    sharded = P("resolvers")
+    repl = P()
+    # check_vma=False: resolve_core is shared with the single-chip jit, so
+    # its internals (scan carry) are not annotated with varying manual axes;
+    # the pmax guarantees the replicated verdict output is truly replicated.
+    fn = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(sharded, sharded, sharded, sharded, sharded, sharded, sharded,
+                  repl, repl, repl, repl, repl, repl),
+        out_specs=(sharded, sharded, sharded, sharded, sharded, repl),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state: ShardedConflictState, rb, re, wb, we, snap, commit_version):
+        hb, he, hver, ptr, floor, verdicts = fn(
+            state.hb, state.he, state.hver, state.ptr, state.floor,
+            state.part_lo, state.part_hi, rb, re, wb, we, snap, commit_version)
+        return ShardedConflictState(hb, he, hver, ptr, floor,
+                                    state.part_lo, state.part_hi), verdicts
+
+    return step
+
+
+# convenience export used by __graft_entry__
+sharded_resolve_step = make_sharded_resolve_step
