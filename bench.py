@@ -26,9 +26,8 @@ import time
 import numpy as np
 
 
-def measure_backend(backend, batches, versions, warmup: int = 3):
+def measure_backend(backend, batches, versions):
     """Resolve every batch; returns (elapsed_s, verdict list, per-batch seconds)."""
-    # warmup (compile + caches) on copies of the first batches, then reset state
     lat = []
     verdicts = []
     t0 = time.perf_counter()
@@ -120,6 +119,12 @@ def main():
 
     out = run(args.batches, args.batch_size, args.keys, args.quiet)
     print(json.dumps(out))
+    if not out["verdict_parity"]:
+        # correctness gate: a kernel that disagrees with the exact CPU
+        # baseline must fail the bench, not just annotate the metric
+        print("FATAL: verdict parity violated between cpp and tpu backends",
+              file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,66 @@
+"""Database handle + the retry-loop helper every binding exposes.
+
+Reference: REF:fdbclient/NativeAPI.actor.h (Database/DatabaseContext) and
+the ``db.run``/``@fdb.transactional`` pattern from
+REF:bindings/python/fdb/impl.py — run a function against a fresh
+transaction, commit, and loop through ``on_error`` on retryable failures.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Awaitable, Callable
+
+from ..core.cluster import Cluster
+from ..core.data import Version
+from .transaction import Transaction
+
+
+class Database:
+    def __init__(self, cluster: Cluster) -> None:
+        self.cluster = cluster
+
+    def create_transaction(self) -> Transaction:
+        return Transaction(self.cluster)
+
+    async def run(self, fn: Callable[[Transaction], Awaitable[Any]],
+                  max_retries: int | None = None) -> Any:
+        """The @transactional retry loop: fn(tr) then commit; retryable
+        errors reset and re-run fn.  fn must be idempotent across retries
+        (same contract as the reference)."""
+        tr = self.create_transaction()
+        attempts = 0
+        while True:
+            try:
+                result = await fn(tr)
+                await tr.commit()
+                return result
+            except BaseException as e:
+                attempts += 1
+                if max_retries is not None and attempts > max_retries:
+                    raise
+                await tr.on_error(e)   # re-raises if not retryable
+
+    # --- one-shot conveniences ---
+
+    async def get(self, key: bytes) -> bytes | None:
+        return await self.run(lambda tr: tr.get(key))
+
+    async def set(self, key: bytes, value: bytes) -> Version:
+        async def go(tr: Transaction):
+            tr.set(key, value)
+        await self.run(go)
+        return 0
+
+    async def clear(self, key: bytes) -> None:
+        async def go(tr: Transaction):
+            tr.clear(key)
+        await self.run(go)
+
+    async def clear_range(self, begin: bytes, end: bytes) -> None:
+        async def go(tr: Transaction):
+            tr.clear_range(begin, end)
+        await self.run(go)
+
+    async def get_range(self, begin, end, limit: int = 0,
+                        reverse: bool = False) -> list[tuple[bytes, bytes]]:
+        return await self.run(lambda tr: tr.get_range(begin, end, limit, reverse))

@@ -1,0 +1,350 @@
+"""Transaction: snapshot reads + RYW + OCC commit.
+
+Reference: REF:fdbclient/NativeAPI.actor.cpp (Transaction::get/getRange/
+commit/onError) and REF:fdbclient/ReadYourWrites.actor.cpp (merging
+buffered writes into reads, conflict-range bookkeeping).  The lifecycle
+and retry contract match the C API: use once, ``on_error`` decides
+retryability and resets, commit makes the txn immutable until reset.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..core.cluster import Cluster
+from ..core.data import (CommitTransactionRequest, KeySelector, MutationType,
+                         Version, key_after)
+from ..runtime.errors import (FdbError, InvalidOption, KeyTooLarge,
+                              TransactionTooLarge, TransactionReadOnly,
+                              UsedDuringCommit, ValueTooLarge)
+from ..runtime.rng import deterministic_random
+from .writemap import WriteMap
+
+
+class Transaction:
+    def __init__(self, cluster: Cluster) -> None:
+        self._cluster = cluster
+        self._knobs = cluster.knobs
+        self.reset()
+
+    # --- lifecycle ---
+
+    def reset(self) -> None:
+        self._writes = WriteMap()
+        self._read_conflicts: list[tuple[bytes, bytes]] = []
+        self._write_conflicts: list[tuple[bytes, bytes]] = []
+        self._read_version: Version | None = None
+        self._grv_task: asyncio.Task | None = None
+        self._committed_version: Version | None = None
+        self._versionstamp: bytes | None = None
+        self._committing = False
+        self._retry_count = 0
+        self._watches_pending: list[tuple[bytes, bytes | None]] = []
+        self._watch_futures: list[asyncio.Future] = []
+
+    def _check_mutable(self) -> None:
+        if self._committing:
+            raise UsedDuringCommit()
+
+    # --- read version ---
+
+    async def get_read_version(self) -> Version:
+        if self._read_version is None:
+            proxy = deterministic_random().choice(self._cluster.grv_proxies)
+            self._read_version = await proxy.get_read_version()
+        return self._read_version
+
+    def set_read_version(self, version: Version) -> None:
+        self._read_version = version
+
+    # --- reads ---
+
+    async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
+        self._check_mutable()
+        self._check_key(key)
+        kind, payload = self._writes.lookup(key)
+        if kind == "value" and not snapshot:
+            # fully determined by this txn's writes; reads of your own
+            # writes add no read conflict (RYW semantics)
+            return payload
+        version = await self.get_read_version()
+        if kind == "value":
+            return payload
+        if not snapshot:
+            self._read_conflicts.append((key, key_after(key)))
+        base = await self._cluster.storage_for_key(key).get_value(key, version)
+        if kind == "stack":
+            return WriteMap.fold_with_base(payload, base)
+        return base
+
+    async def get_range(self, begin, end, limit: int = 0,
+                        reverse: bool = False, snapshot: bool = False
+                        ) -> list[tuple[bytes, bytes]]:
+        """begin/end: bytes or KeySelector.  Returns up to ``limit`` pairs."""
+        self._check_mutable()
+        if isinstance(begin, KeySelector):
+            begin = await self.get_key(begin, snapshot=True)
+        if isinstance(end, KeySelector):
+            end = await self.get_key(end, snapshot=True)
+        if begin >= end:
+            return []
+        out = await self._merged_range(begin, end, limit, reverse)
+        if not snapshot:
+            # conflict range covers what was actually observed: the whole
+            # requested range if exhausted, else up to the last-seen key
+            if limit and len(out) >= limit:
+                if reverse:
+                    self._read_conflicts.append((out[-1][0], end))
+                else:
+                    self._read_conflicts.append((begin, key_after(out[-1][0])))
+            else:
+                self._read_conflicts.append((begin, end))
+        return out
+
+    async def _merged_range(self, begin: bytes, end: bytes, limit: int,
+                            reverse: bool) -> list[tuple[bytes, bytes]]:
+        """Merge snapshot data with buffered writes (RYWIterator analog)."""
+        version = await self.get_read_version()
+        written = self._writes.written_keys_in(begin, end)
+        # over-fetch so rows clobbered by clears/sets still let us reach limit
+        fetch_limit = (limit + len(written) + 16) if limit else 0
+        merged: dict[bytes, bytes] = {}
+        for ss in self._cluster.storages_for_range(begin, end):
+            kvs, _more = await ss.get_key_values(begin, end, version,
+                                                 fetch_limit, reverse)
+            for k, v in kvs:
+                merged[k] = v
+        # apply clears, then writes
+        for b, e in self._writes.clears_in(begin, end):
+            for k in [k for k in merged if b <= k < e]:
+                del merged[k]
+        for k in written:
+            kind, payload = self._writes.lookup(k)
+            if kind == "stack":
+                base = merged.get(k)
+                v = WriteMap.fold_with_base(payload, base)
+            else:
+                v = payload
+            if v is None:
+                merged.pop(k, None)
+            else:
+                merged[k] = v
+        items = sorted(merged.items(), reverse=reverse)
+        return items[:limit] if limit else items
+
+    async def get_key(self, selector: KeySelector, snapshot: bool = False) -> bytes:
+        """Resolve a KeySelector against the merged view
+        (REF:fdbclient/NativeAPI.actor.cpp resolveKey)."""
+        self._check_mutable()
+        k, oe, off = selector.key, selector.or_equal, selector.offset
+        if off > 0:
+            # firstGreaterOrEqual(k)+n / firstGreaterThan(k)+n
+            start = key_after(k) if oe else k
+            rows = await self._merged_range(start, b"\xff", off, False)
+            if len(rows) >= off:
+                result = rows[off - 1][0]
+            else:
+                result = b"\xff"  # off the end: clamp to keyspace end
+        else:
+            # lastLessOrEqual(k)-n / lastLessThan(k)-n
+            stop = key_after(k) if oe else k
+            n = 1 - off
+            rows = await self._merged_range(b"", stop, n, True)
+            if len(rows) >= n:
+                result = rows[n - 1][0]
+            else:
+                result = b""
+        if not snapshot:
+            lo = min(result, k)
+            hi = max(key_after(result), key_after(k) if oe else k)
+            if lo < hi:
+                self._read_conflicts.append((lo, hi))
+        return result
+
+    # --- writes ---
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._check_mutable()
+        self._check_key(key)
+        if len(value) > self._knobs.VALUE_SIZE_LIMIT:
+            raise ValueTooLarge()
+        self._writes.set(key, value)
+        self._write_conflicts.append((key, key_after(key)))
+
+    def clear(self, key: bytes) -> None:
+        self._check_mutable()
+        self._check_key(key)
+        self._writes.clear_range(key, key_after(key))
+        self._write_conflicts.append((key, key_after(key)))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._check_mutable()
+        if begin >= end:
+            return
+        self._writes.clear_range(begin, end)
+        self._write_conflicts.append((begin, end))
+
+    def atomic_op(self, op: MutationType, key: bytes, operand: bytes) -> None:
+        self._check_mutable()
+        self._check_key(key)
+        self._writes.atomic(op, key, operand)
+        self._write_conflicts.append((key, key_after(key)))
+
+    # convenience named atomics (the C API's FDBMutationType surface)
+    def add(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(MutationType.ADD, key, operand)
+
+    def bit_and(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(MutationType.BIT_AND, key, operand)
+
+    def bit_or(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(MutationType.BIT_OR, key, operand)
+
+    def bit_xor(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(MutationType.BIT_XOR, key, operand)
+
+    def max(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(MutationType.MAX, key, operand)
+
+    def min(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(MutationType.MIN, key, operand)
+
+    def byte_min(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(MutationType.BYTE_MIN, key, operand)
+
+    def byte_max(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(MutationType.BYTE_MAX, key, operand)
+
+    def append_if_fits(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(MutationType.APPEND_IF_FITS, key, operand)
+
+    def compare_and_clear(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(MutationType.COMPARE_AND_CLEAR, key, operand)
+
+    def set_versionstamped_key(self, key: bytes, value: bytes) -> None:
+        self.atomic_op(MutationType.SET_VERSIONSTAMPED_KEY, key, value)
+
+    def set_versionstamped_value(self, key: bytes, value: bytes) -> None:
+        self.atomic_op(MutationType.SET_VERSIONSTAMPED_VALUE, key, value)
+
+    # --- explicit conflict ranges ---
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        if begin < end:
+            self._read_conflicts.append((begin, end))
+
+    def add_read_conflict_key(self, key: bytes) -> None:
+        self.add_read_conflict_range(key, key_after(key))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        if begin < end:
+            self._write_conflicts.append((begin, end))
+
+    def add_write_conflict_key(self, key: bytes) -> None:
+        self.add_write_conflict_range(key, key_after(key))
+
+    # --- watch ---
+
+    async def watch(self, key: bytes) -> asyncio.Future:
+        """Returns a future completing when key changes after commit
+        (fdb_transaction_watch).  The watched baseline is the value at
+        this txn's read version (snapshot; adds no conflict)."""
+        value = await self.get(key, snapshot=True)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._watches_pending.append((key, value))
+        self._watch_futures.append(fut)
+        return fut
+
+    # --- commit ---
+
+    async def commit(self) -> Version:
+        self._check_mutable()
+        if not self._writes and not self._write_conflicts:
+            # read-only txn commits trivially at its read version
+            self._committed_version = self._read_version if self._read_version is not None else 0
+            self._arm_watches(self._committed_version)
+            return self._committed_version
+        if self._writes.bytes > self._knobs.TRANSACTION_SIZE_LIMIT:
+            raise TransactionTooLarge()
+        read_snapshot = await self.get_read_version()
+        req = CommitTransactionRequest(
+            read_conflict_ranges=_coalesce(self._read_conflicts),
+            write_conflict_ranges=_coalesce(self._write_conflicts),
+            mutations=list(self._writes.mutations),
+            read_snapshot=read_snapshot,
+        )
+        self._committing = True
+        try:
+            proxy = deterministic_random().choice(self._cluster.commit_proxies)
+            result = await proxy.commit(req)
+        finally:
+            self._committing = False
+        self._committed_version = result.version
+        self._versionstamp = result.versionstamp
+        self._arm_watches(result.version)
+        return result.version
+
+    def _arm_watches(self, commit_version: Version) -> None:
+        loop = asyncio.get_running_loop()
+        for (key, value), fut in zip(self._watches_pending, self._watch_futures):
+            ss = self._cluster.storage_for_key(key)
+
+            async def run(ss=ss, key=key, value=value, fut=fut):
+                try:
+                    await ss.watch_value(key, value, commit_version)
+                    if not fut.done():
+                        fut.set_result(None)
+                except Exception as e:
+                    if not fut.done():
+                        fut.set_exception(e)
+            t = loop.create_task(run(), name="watch")
+            fut.add_done_callback(lambda _f, t=t: None)  # keep task referenced
+        self._watches_pending.clear()
+        self._watch_futures.clear()
+
+    def get_committed_version(self) -> Version:
+        if self._committed_version is None:
+            from ..runtime.errors import VersionInvalid
+            raise VersionInvalid()
+        return self._committed_version
+
+    def get_versionstamp(self) -> bytes:
+        if self._versionstamp is None:
+            from ..runtime.errors import VersionInvalid
+            raise VersionInvalid()
+        return self._versionstamp
+
+    # --- error handling / retry (REF: Transaction::onError) ---
+
+    async def on_error(self, e: BaseException) -> None:
+        if not isinstance(e, FdbError) or not e.retryable:
+            raise e
+        self._retry_count += 1
+        backoff = min(0.001 * (2 ** min(self._retry_count, 10)),
+                      self._knobs.DEFAULT_MAX_RETRY_DELAY)
+        await asyncio.sleep(backoff * (0.5 + deterministic_random().random() * 0.5))
+        retry_count = self._retry_count
+        self.reset()
+        self._retry_count = retry_count
+
+    # --- helpers ---
+
+    def _check_key(self, key: bytes) -> None:
+        if len(key) > self._knobs.KEY_SIZE_LIMIT:
+            raise KeyTooLarge()
+
+
+def _coalesce(ranges: list[tuple[bytes, bytes]]) -> list[tuple[bytes, bytes]]:
+    """Sort + merge overlapping conflict ranges (the reference coalesces in
+    CommitTransactionRef::read_conflict_ranges construction)."""
+    if not ranges:
+        return []
+    rs = sorted(ranges)
+    out = [rs[0]]
+    for b, e in rs[1:]:
+        lb, le = out[-1]
+        if b <= le:
+            out[-1] = (lb, max(le, e))
+        else:
+            out.append((b, e))
+    return out

@@ -1,0 +1,111 @@
+"""In-process cluster assembly — the single-process database.
+
+Reference: the role recruitment that ClusterController performs
+(REF:fdbserver/ClusterController.actor.cpp) reduced to its data plane:
+one sequencer, N GRV proxies, N commit proxies, N resolvers (key-range
+partitioned), N TLogs, N storage servers on a static shard map.  Roles
+talk through direct async calls here; the RPC transport slots in at the
+same method boundaries (each public role method is one RequestStream in
+the reference), so moving a role out of process does not change role code.
+
+Elections/recovery arrive with the coordination layer; this object is
+also what a recovered "generation" of the transaction subsystem looks
+like, so recovery later constructs one of these per epoch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..runtime.knobs import KNOBS, Knobs
+from .commit_proxy import CommitProxy
+from .data import KeyRange, Version
+from .grv_proxy import GrvProxy
+from .resolver import Resolver
+from .sequencer import Sequencer
+from .shard_map import ShardMap
+from .storage_server import StorageServer
+from .tlog import TLog
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """The role counts `fdbcli configure` would set
+    (REF:fdbclient/DatabaseConfiguration.cpp: commit_proxies, grv_proxies,
+    resolvers, logs)."""
+    commit_proxies: int = 1
+    grv_proxies: int = 1
+    resolvers: int = 1
+    logs: int = 1
+    storage_servers: int = 1
+
+
+class Cluster:
+    def __init__(self, config: ClusterConfig | None = None,
+                 knobs: Knobs | None = None,
+                 epoch_begin_version: Version = 0) -> None:
+        self.config = config or ClusterConfig()
+        self.knobs = knobs or KNOBS
+        c, k, v0 = self.config, self.knobs, epoch_begin_version
+
+        self.sequencer = Sequencer(k, v0)
+        self.shard_map = ShardMap.even(c.storage_servers)
+        self.tlogs = [TLog(k, v0) for _ in range(c.logs)]
+
+        # resolver key partitions: even split of the whole keyspace
+        res_map = ShardMap.even(c.resolvers)
+        self.resolvers = [Resolver(k, res_map.shard_range(i), v0)
+                          for i in range(c.resolvers)]
+
+        # storage: tag i lives on tlog i % logs
+        self.storage_servers = []
+        for rng, tags in self.shard_map.ranges():
+            for tag in tags:
+                tlog = self.tlogs[tag % c.logs]
+                self.storage_servers.append(StorageServer(k, tag, rng, tlog, v0))
+
+        self.grv_proxies = [GrvProxy(k, self.sequencer)
+                            for _ in range(c.grv_proxies)]
+        self.commit_proxies = [CommitProxy(k, self.sequencer, self.resolvers,
+                                           self.tlogs, self.shard_map)
+                               for _ in range(c.commit_proxies)]
+        self._started = False
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        for ss in self.storage_servers:
+            ss.start()
+        for cp in self.commit_proxies:
+            cp.start()
+        self._started = True
+
+    async def stop(self) -> None:
+        for cp in self.commit_proxies:
+            await cp.stop()
+        for ss in self.storage_servers:
+            await ss.stop()
+        self._started = False
+
+    async def __aenter__(self) -> "Cluster":
+        self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    # --- client-side location lookup (getKeyLocation analog) ---
+
+    def storage_for_key(self, key: bytes) -> StorageServer:
+        tags = self.shard_map.tags_for_key(key)
+        return self._storage_by_tag(tags[0])
+
+    def storages_for_range(self, begin: bytes, end: bytes) -> list[StorageServer]:
+        return [self._storage_by_tag(t)
+                for t in self.shard_map.tags_for_range(begin, end)]
+
+    def _storage_by_tag(self, tag: int) -> StorageServer:
+        for ss in self.storage_servers:
+            if ss.tag == tag:
+                return ss
+        raise KeyError(f"no storage server with tag {tag}")

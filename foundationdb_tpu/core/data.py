@@ -1,0 +1,271 @@
+"""Shared transaction data types.
+
+Reference: REF:flow/Arena.h (KeyRef/KeyRangeRef/StringRef),
+REF:fdbclient/CommitTransaction.h (MutationRef, CommitTransactionRef),
+REF:fdbclient/FDBTypes.h (KeySelectorRef, Version).  Keys and values are
+plain ``bytes``; Python's refcounted immutable bytes replace the Arena —
+no region allocator is needed because nothing here is manually managed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+
+from ..runtime.errors import InvertedRange, KeyOutsideLegalRange
+
+Version = int
+INVALID_VERSION: Version = -1
+MAX_VERSION: Version = (1 << 63) - 1
+
+# Keys at or above \xff are the system keyspace (REF:fdbclient/SystemData.cpp);
+# \xff\xff is the special-key space handled client-side.
+SYSTEM_PREFIX = b"\xff"
+SPECIAL_PREFIX = b"\xff\xff"
+MAX_KEY = b"\xff\xff\xff"  # allowedRange end for system-access txns
+
+
+def key_after(key: bytes) -> bytes:
+    """Smallest key strictly greater than ``key`` (keyAfter in REF:flow)."""
+    return key + b"\x00"
+
+
+def strinc(key: bytes) -> bytes:
+    """Smallest key greater than every key with prefix ``key`` (strinc).
+
+    Strips trailing 0xff bytes and increments the last remaining byte;
+    all-0xff input has no upper bound and raises, like the reference.
+    """
+    k = key.rstrip(b"\xff")
+    if not k:
+        raise KeyOutsideLegalRange("strinc of empty/all-0xff key")
+    return k[:-1] + bytes([k[-1] + 1])
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class KeyRange:
+    """Half-open [begin, end); empty if begin >= end (KeyRangeRef)."""
+
+    begin: bytes
+    end: bytes
+
+    def __post_init__(self):
+        if self.begin > self.end:
+            raise InvertedRange(f"{self.begin!r} > {self.end!r}")
+
+    @property
+    def empty(self) -> bool:
+        return self.begin >= self.end
+
+    def contains(self, key: bytes) -> bool:
+        return self.begin <= key < self.end
+
+    def intersects(self, other: "KeyRange") -> bool:
+        return self.begin < other.end and other.begin < self.end
+
+    def intersection(self, other: "KeyRange") -> "KeyRange":
+        if not self.intersects(other):
+            return KeyRange(self.begin, self.begin)  # empty
+        return KeyRange(max(self.begin, other.begin), min(self.end, other.end))
+
+    @staticmethod
+    def single(key: bytes) -> "KeyRange":
+        return KeyRange(key, key_after(key))
+
+    @staticmethod
+    def all() -> "KeyRange":
+        return KeyRange(b"", b"\xff")
+
+    @staticmethod
+    def everything() -> "KeyRange":
+        return KeyRange(b"", MAX_KEY)
+
+
+class MutationType(enum.IntEnum):
+    """Mutation opcodes (MutationRef::Type, REF:fdbclient/CommitTransaction.h).
+
+    Numeric values match upstream where an equivalent exists so a future C
+    ABI can pass them through unchanged.
+    """
+
+    SET_VALUE = 0
+    CLEAR_RANGE = 1
+    ADD = 2
+    # upstream has deprecated And/Or at 3/4; we use the *IfExists-correct
+    # versions the C API exposes (fdb_c.h FDBMutationType)
+    BIT_AND = 6
+    BIT_OR = 7
+    BIT_XOR = 8
+    APPEND_IF_FITS = 9
+    MAX = 12
+    MIN = 13
+    SET_VERSIONSTAMPED_KEY = 14
+    SET_VERSIONSTAMPED_VALUE = 15
+    BYTE_MIN = 16
+    BYTE_MAX = 17
+    COMPARE_AND_CLEAR = 20
+
+
+ATOMIC_TYPES = frozenset(
+    t for t in MutationType
+    if t not in (MutationType.SET_VALUE, MutationType.CLEAR_RANGE)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Mutation:
+    """One mutation: set(param1=key, param2=value), clear(param1=begin,
+    param2=end), or atomic(param1=key, param2=operand) — MutationRef."""
+
+    type: MutationType
+    param1: bytes
+    param2: bytes
+
+    @staticmethod
+    def set(key: bytes, value: bytes) -> "Mutation":
+        return Mutation(MutationType.SET_VALUE, key, value)
+
+    @staticmethod
+    def clear_range(begin: bytes, end: bytes) -> "Mutation":
+        return Mutation(MutationType.CLEAR_RANGE, begin, end)
+
+    @property
+    def is_atomic(self) -> bool:
+        return self.type in ATOMIC_TYPES
+
+
+def _pad_to_common(a: bytes, b: bytes) -> tuple[bytes, bytes, int]:
+    n = max(len(a), len(b))
+    return a.ljust(n, b"\x00"), b.ljust(n, b"\x00"), n
+
+
+def _as_le_int(b: bytes) -> int:
+    return int.from_bytes(b, "little", signed=False)
+
+
+def apply_atomic(op: MutationType, existing: bytes | None, operand: bytes) -> bytes | None:
+    """Evaluate an atomic op against the current value (doAtomicOp,
+    REF:fdbserver/storageserver.actor.cpp + flow/Arena atomics).
+
+    Returns the new value, or None meaning "clear the key"
+    (COMPARE_AND_CLEAR match).
+    """
+    if op == MutationType.ADD:
+        old = existing if existing is not None else b""
+        n = len(operand)
+        if n == 0:
+            return b""
+        total = (_as_le_int(old[:n].ljust(n, b"\x00")) + _as_le_int(operand)) % (1 << (8 * n))
+        return total.to_bytes(n, "little")
+    if op in (MutationType.BIT_AND, MutationType.BIT_OR, MutationType.BIT_XOR):
+        # Modern opcodes are the AndV2-style *IfExists semantics: on a
+        # missing key the operand is stored unchanged.
+        if existing is None:
+            return operand
+        a, b, n = _pad_to_common(existing, operand)
+        if op == MutationType.BIT_AND:
+            return bytes(x & y for x, y in zip(a, b))
+        if op == MutationType.BIT_OR:
+            return bytes(x | y for x, y in zip(a, b))
+        return bytes(x ^ y for x, y in zip(a, b))
+    if op == MutationType.APPEND_IF_FITS:
+        old = existing if existing is not None else b""
+        from ..runtime.knobs import KNOBS
+        if len(old) + len(operand) <= KNOBS.VALUE_SIZE_LIMIT:
+            return old + operand
+        return old
+    if op == MutationType.MAX:
+        old = existing if existing is not None else b""
+        a, b, n = _pad_to_common(old, operand)
+        return a if _as_le_int(a) >= _as_le_int(b) else b
+    if op == MutationType.MIN:
+        if existing is None:
+            return operand
+        a, b, n = _pad_to_common(existing, operand)
+        return a if _as_le_int(a) <= _as_le_int(b) else b
+    if op == MutationType.BYTE_MIN:
+        if existing is None:
+            return operand
+        return min(existing, operand)
+    if op == MutationType.BYTE_MAX:
+        if existing is None:
+            return operand
+        return max(existing, operand)
+    if op == MutationType.COMPARE_AND_CLEAR:
+        if existing is not None and existing == operand:
+            return None  # clear
+        return existing
+    raise ValueError(f"unhandled atomic op {op}")
+
+
+@dataclasses.dataclass(frozen=True)
+class KeySelector:
+    """Resolves to a key relative to an anchor (KeySelectorRef).
+
+    Semantics (REF:fdbclient/NativeAPI.actor.cpp resolveKey): start from
+    the anchor key; if or_equal, step past it; then move |offset| keys
+    forward (offset > 0) or backward (offset <= 0) in the database.
+    offset=1, or_equal=False is firstGreaterOrEqual(key).
+    """
+
+    key: bytes
+    or_equal: bool = False
+    offset: int = 1
+
+    @staticmethod
+    def first_greater_or_equal(key: bytes) -> "KeySelector":
+        return KeySelector(key, False, 1)
+
+    @staticmethod
+    def first_greater_than(key: bytes) -> "KeySelector":
+        return KeySelector(key, True, 1)
+
+    @staticmethod
+    def last_less_or_equal(key: bytes) -> "KeySelector":
+        return KeySelector(key, True, 0)
+
+    @staticmethod
+    def last_less_than(key: bytes) -> "KeySelector":
+        return KeySelector(key, False, 0)
+
+    def __add__(self, n: int) -> "KeySelector":
+        return KeySelector(self.key, self.or_equal, self.offset + n)
+
+    def __sub__(self, n: int) -> "KeySelector":
+        return KeySelector(self.key, self.or_equal, self.offset - n)
+
+
+@dataclasses.dataclass
+class CommitTransactionRequest:
+    """The commit payload a client sends to a commit proxy
+    (CommitTransactionRequest wrapping CommitTransactionRef,
+    REF:fdbclient/CommitProxyInterface.h + CommitTransaction.h)."""
+
+    read_conflict_ranges: list[tuple[bytes, bytes]]
+    write_conflict_ranges: list[tuple[bytes, bytes]]
+    mutations: list[Mutation]
+    read_snapshot: Version
+    report_conflicting_keys: bool = False
+
+    def expected_size(self) -> int:
+        n = 0
+        for m in self.mutations:
+            n += len(m.param1) + len(m.param2)
+        for b, e in self.read_conflict_ranges:
+            n += len(b) + len(e)
+        for b, e in self.write_conflict_ranges:
+            n += len(b) + len(e)
+        return n
+
+
+@dataclasses.dataclass
+class CommitResult:
+    """Reply to a commit: the committed version, or raised FdbError."""
+
+    version: Version
+    versionstamp: bytes  # 10-byte commit versionstamp (8B version + 2B batch order)
+
+
+def pack_versionstamp(version: Version, order: int) -> bytes:
+    return struct.pack(">QH", version, order)

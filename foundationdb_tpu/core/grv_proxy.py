@@ -1,0 +1,51 @@
+"""The GRV proxy role — batched GetReadVersion with rate admission.
+
+Reference: REF:fdbserver/GrvProxyServer.actor.cpp — read-version requests
+are batched over a small window, the Ratekeeper-issued transaction budget
+is spent here (admission control), and one liveness round-trip to the
+sequencer serves the whole batch the newest committed version.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..runtime.knobs import Knobs
+from .data import Version
+from .sequencer import Sequencer
+
+
+class GrvProxy:
+    def __init__(self, knobs: Knobs, sequencer: Sequencer,
+                 ratekeeper=None) -> None:
+        self.knobs = knobs
+        self.sequencer = sequencer
+        self.ratekeeper = ratekeeper
+        self._waiters: list[asyncio.Future] = []
+        self._batch_task: asyncio.Task | None = None
+        self.total_grvs = 0
+
+    async def get_read_version(self) -> Version:
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._waiters.append(fut)
+        if self._batch_task is None or self._batch_task.done():
+            self._batch_task = loop.create_task(self._serve_batch(),
+                                                name="grv-batch")
+        return await fut
+
+    async def _serve_batch(self) -> None:
+        await asyncio.sleep(self.knobs.GRV_BATCH_INTERVAL)
+        waiters, self._waiters = self._waiters, []
+        if self.ratekeeper is not None:
+            await self.ratekeeper.admit(len(waiters))
+        try:
+            version = await self.sequencer.get_live_committed_version()
+            self.total_grvs += len(waiters)
+            for fut in waiters:
+                if not fut.done():
+                    fut.set_result(version)
+        except Exception as e:
+            for fut in waiters:
+                if not fut.done():
+                    fut.set_exception(e)

@@ -1,0 +1,69 @@
+"""The sequencer (master) role — the cluster's single version authority.
+
+Reference: REF:fdbserver/masterserver.actor.cpp — ``getVersion`` hands out
+monotonically increasing commit versions advancing at ~VERSIONS_PER_SECOND
+with wall time, and each assignment records the *previous* assigned
+version so downstream roles (resolvers, TLogs) can process batches in
+exact version order even when multiple proxies race
+(GetCommitVersionRequest / prevVersion chaining).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..runtime.knobs import Knobs
+from .data import Version
+
+
+class Sequencer:
+    def __init__(self, knobs: Knobs, epoch_begin_version: Version = 0) -> None:
+        self.knobs = knobs
+        self._last_assigned: Version = epoch_begin_version
+        self._committed: Version = epoch_begin_version
+        self._base_version = epoch_begin_version
+        self._base_time: float | None = None
+        self._committed_waiters: list[tuple[Version, asyncio.Future]] = []
+
+    # --- commit version assignment (GetCommitVersionRequest) ---
+
+    async def get_commit_version(self) -> tuple[Version, Version]:
+        """Returns (prev_version, version) for one commit batch."""
+        loop = asyncio.get_running_loop()
+        if self._base_time is None:
+            self._base_time = loop.time()
+        wall = self._base_version + int(
+            (loop.time() - self._base_time) * self.knobs.VERSIONS_PER_SECOND)
+        prev = self._last_assigned
+        version = max(prev + 1, wall)
+        self._last_assigned = version
+        return prev, version
+
+    # --- committed-version tracking (for GRV) ---
+
+    def report_committed(self, version: Version) -> None:
+        if version > self._committed:
+            self._committed = version
+            still = []
+            for target, fut in self._committed_waiters:
+                if version >= target and not fut.done():
+                    fut.set_result(version)
+                elif not fut.done():
+                    still.append((target, fut))
+            self._committed_waiters = still
+
+    async def get_live_committed_version(self) -> Version:
+        """The version a GRV proxy may serve as a read version
+        (getLiveCommittedVersion in the reference)."""
+        return self._committed
+
+    async def wait_committed(self, version: Version) -> Version:
+        if self._committed >= version:
+            return self._committed
+        fut = asyncio.get_running_loop().create_future()
+        self._committed_waiters.append((version, fut))
+        return await fut
+
+    @property
+    def committed_version(self) -> Version:
+        return self._committed

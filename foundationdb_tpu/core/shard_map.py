@@ -1,0 +1,65 @@
+"""Static shard map: key-range partitions → storage tags.
+
+Reference: the keyServers/serverKeys system-key mapping
+(REF:fdbclient/SystemData.cpp) that DataDistribution maintains and the
+commit proxy consults to tag mutations.  This first version is a static
+even partition; DataDistribution later rewrites it through the same
+interface (splits/moves change boundaries, not callers).
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from .data import KeyRange
+from .tlog import Tag
+
+
+class ShardMap:
+    def __init__(self, boundaries: list[bytes], shard_tags: list[list[Tag]]):
+        """boundaries: interior split points (sorted); len(shard_tags) ==
+        len(boundaries) + 1.  Shard i covers [b[i-1], b[i])."""
+        assert len(shard_tags) == len(boundaries) + 1
+        self.boundaries = boundaries
+        self.shard_tags = shard_tags
+
+    @staticmethod
+    def even(n_shards: int, tags_per_shard: list[list[Tag]] | None = None,
+             keyspace_end: bytes = b"\xff\xff\xff") -> "ShardMap":
+        """Split [b'', end) into n byte-prefix shards; default tag i per shard."""
+        bounds = [bytes([int(256 * i / n_shards)]) for i in range(1, n_shards)]
+        tags = tags_per_shard or [[i] for i in range(n_shards)]
+        return ShardMap(bounds, tags)
+
+    def shard_index(self, key: bytes) -> int:
+        return bisect.bisect_right(self.boundaries, key)
+
+    def tags_for_key(self, key: bytes) -> list[Tag]:
+        return self.shard_tags[self.shard_index(key)]
+
+    def tags_for_range(self, begin: bytes, end: bytes) -> list[Tag]:
+        lo = self.shard_index(begin)
+        hi = self.shard_index(end) if end else len(self.shard_tags) - 1
+        out: list[Tag] = []
+        for i in range(lo, hi + 1):
+            for t in self.shard_tags[i]:
+                if t not in out:
+                    out.append(t)
+        return out
+
+    def shard_range(self, i: int, keyspace_end: bytes = b"\xff\xff\xff") -> KeyRange:
+        begin = self.boundaries[i - 1] if i > 0 else b""
+        end = self.boundaries[i] if i < len(self.boundaries) else keyspace_end
+        return KeyRange(begin, end)
+
+    def ranges(self) -> list[tuple[KeyRange, list[Tag]]]:
+        return [(self.shard_range(i), self.shard_tags[i])
+                for i in range(len(self.shard_tags))]
+
+    def all_tags(self) -> list[Tag]:
+        out: list[Tag] = []
+        for ts in self.shard_tags:
+            for t in ts:
+                if t not in out:
+                    out.append(t)
+        return out

@@ -1,0 +1,164 @@
+"""The storage server role — versioned reads over a pulled mutation stream.
+
+Reference: REF:fdbserver/storageserver.actor.cpp — each storage server
+owns key-range shards, continuously peeks its tag from the TLogs, applies
+mutations in version order into the MVCC window (``update``), and serves
+reads at exact versions (``getValueQ``/``getKeyValuesQ``): a read above
+the applied version waits briefly (future_version), a read below the
+window floor fails with transaction_too_old.  Atomic ops are evaluated
+here, against the latest value, exactly like upstream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..runtime.errors import FutureVersion, TransactionTooOld
+from ..runtime.knobs import Knobs
+from ..storage.versioned_map import VersionedMap
+from .data import KeyRange, Mutation, MutationType, Version, apply_atomic
+from .tlog import TLog, Tag
+
+
+class StorageServer:
+    def __init__(self, knobs: Knobs, tag: Tag, shard: KeyRange,
+                 tlog: TLog, epoch_begin_version: Version = 0) -> None:
+        self.knobs = knobs
+        self.tag = tag
+        self.shard = shard
+        self.tlog = tlog
+        self.vmap = VersionedMap()
+        self.version: Version = epoch_begin_version
+        self.oldest_version: Version = epoch_begin_version
+        self._version_waiters: dict[Version, list[asyncio.Future]] = {}
+        self._watches: dict[bytes, list[tuple[bytes | None, asyncio.Future]]] = {}
+        self._pull_task: asyncio.Task | None = None
+        self.bytes_input = 0
+        self.total_reads = 0
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        self._pull_task = asyncio.get_running_loop().create_task(
+            self._pull_loop(), name=f"storage-{self.tag}-pull")
+
+    async def stop(self) -> None:
+        if self._pull_task is not None:
+            self._pull_task.cancel()
+            try:
+                await self._pull_task
+            except asyncio.CancelledError:
+                pass
+            self._pull_task = None
+
+    # --- the update path (REF: storageserver.actor.cpp::update) ---
+
+    async def _pull_loop(self) -> None:
+        while True:
+            reply = await self.tlog.peek(self.tag, self.version + 1)
+            for version, mutations in reply.entries:
+                self._apply(version, mutations)
+            if reply.end_version - 1 > self.version:
+                self._bump_version(reply.end_version - 1)
+            self.tlog.pop(self.tag, self.version + 1)
+            # slide the MVCC window
+            floor = self.version - self.knobs.STORAGE_VERSION_WINDOW
+            if floor > self.oldest_version:
+                self.oldest_version = floor
+                self.vmap.forget_before(floor)
+
+    def _apply(self, version: Version, mutations: list[Mutation]) -> None:
+        for m in mutations:
+            self.bytes_input += len(m.param1) + len(m.param2)
+            if m.type == MutationType.SET_VALUE:
+                self.vmap.set(version, m.param1, m.param2)
+                self._fire_watches(m.param1, m.param2)
+            elif m.type == MutationType.CLEAR_RANGE:
+                self.vmap.clear_range(version, m.param1, m.param2)
+                self._fire_watch_range(m.param1, m.param2)
+            else:
+                existing = self.vmap.get_latest(m.param1)
+                new = apply_atomic(m.type, existing, m.param2)
+                if new is None:
+                    self.vmap.clear_range(version, m.param1, m.param1 + b"\x00")
+                    self._fire_watches(m.param1, None)
+                else:
+                    self.vmap.set(version, m.param1, new)
+                    self._fire_watches(m.param1, new)
+        self._bump_version(version)
+
+    def _bump_version(self, version: Version) -> None:
+        if version <= self.version:
+            return
+        self.version = version
+        ready = [v for v in self._version_waiters if v <= version]
+        for v in sorted(ready):
+            for fut in self._version_waiters.pop(v):
+                if not fut.done():
+                    fut.set_result(None)
+
+    # --- read path ---
+
+    async def _wait_for_version(self, version: Version) -> None:
+        if version <= self.version:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._version_waiters.setdefault(version, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout=1.0)
+        except asyncio.TimeoutError:
+            raise FutureVersion() from None
+
+    def _check_too_old(self, version: Version) -> None:
+        if version < self.oldest_version:
+            raise TransactionTooOld()
+
+    async def get_value(self, key: bytes, version: Version) -> bytes | None:
+        await self._wait_for_version(version)
+        self._check_too_old(version)
+        self.total_reads += 1
+        return self.vmap.get(key, version)
+
+    async def get_key_values(self, begin: bytes, end: bytes, version: Version,
+                             limit: int = 0, reverse: bool = False,
+                             byte_limit: int = 0
+                             ) -> tuple[list[tuple[bytes, bytes]], bool]:
+        await self._wait_for_version(version)
+        self._check_too_old(version)
+        self.total_reads += 1
+        b = max(begin, self.shard.begin)
+        e = min(end, self.shard.end)
+        if b >= e:
+            return [], False
+        return self.vmap.range_read(b, e, version, limit, reverse, byte_limit)
+
+    # --- watches (REF: storageserver.actor.cpp watchValueQ) ---
+
+    async def watch_value(self, key: bytes, value: bytes | None,
+                          version: Version) -> None:
+        """Completes when the key's value differs from ``value``."""
+        await self._wait_for_version(version)
+        current = self.vmap.get(key, self.version)
+        if current != value:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._watches.setdefault(key, []).append((value, fut))
+        await fut
+
+    def _fire_watches(self, key: bytes, new_value: bytes | None) -> None:
+        ws = self._watches.pop(key, None)
+        if not ws:
+            return
+        keep = []
+        for expected, fut in ws:
+            if new_value != expected:
+                if not fut.done():
+                    fut.set_result(None)
+            else:
+                keep.append((expected, fut))
+        if keep:
+            self._watches[key] = keep
+
+    def _fire_watch_range(self, begin: bytes, end: bytes) -> None:
+        for key in [k for k in self._watches if begin <= k < end]:
+            self._fire_watches(key, None)

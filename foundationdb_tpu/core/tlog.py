@@ -1,0 +1,97 @@
+"""The transaction log (TLog) role — the durability point of every commit.
+
+Reference: REF:fdbserver/TLogServer.actor.cpp — commits arrive as tagged
+message sets at a version; each storage server "peeks" only its tag and
+"pops" versions it has made durable.  Version ordering across proxies is
+enforced the same way as the resolver: a push for (prev_version, version)
+waits until prev_version is the log's tip.
+
+This first implementation keeps messages in memory (the sim-correctness
+target); the DiskQueue-backed durable variant plugs in behind the same
+push/peek/pop surface (see storage/disk_queue.py once durability lands).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+from ..runtime.knobs import Knobs
+from .data import Mutation, Version
+
+Tag = int
+
+
+@dataclasses.dataclass
+class TLogPushRequest:
+    """TLogCommitRequest: messages grouped by destination tag."""
+    prev_version: Version
+    version: Version
+    messages: dict[Tag, list[Mutation]]
+
+
+@dataclasses.dataclass
+class TLogPeekReply:
+    entries: list[tuple[Version, list[Mutation]]]
+    end_version: Version       # caller has everything < end_version for this tag
+
+
+class TLog:
+    def __init__(self, knobs: Knobs, epoch_begin_version: Version = 0) -> None:
+        self.knobs = knobs
+        self.version: Version = epoch_begin_version
+        self._log: dict[Tag, list[tuple[Version, list[Mutation]]]] = {}
+        self._poppable: dict[Tag, Version] = {}
+        self._push_waiters: dict[Version, list[asyncio.Future]] = {}
+        self._peek_waiters: list[asyncio.Future] = []
+        self.total_pushes = 0
+        self.total_bytes = 0
+
+    async def _wait_for_version(self, prev_version: Version) -> None:
+        if self.version >= prev_version:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._push_waiters.setdefault(prev_version, []).append(fut)
+        await fut
+
+    async def push(self, req: TLogPushRequest) -> Version:
+        """Append and make durable; returns the version once fsync'd.
+
+        In-memory engine: durability is immediate.  The version-ordering
+        wait still applies so peeks never observe gaps.
+        """
+        await self._wait_for_version(req.prev_version)
+        for tag, msgs in req.messages.items():
+            if msgs:
+                self._log.setdefault(tag, []).append((req.version, msgs))
+                self.total_bytes += sum(len(m.param1) + len(m.param2) for m in msgs)
+        self.version = req.version
+        self.total_pushes += 1
+        ready = [v for v in self._push_waiters if v <= req.version]
+        for v in sorted(ready):
+            for fut in self._push_waiters.pop(v):
+                if not fut.done():
+                    fut.set_result(None)
+        for fut in self._peek_waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self._peek_waiters.clear()
+        return req.version
+
+    async def peek(self, tag: Tag, begin_version: Version) -> TLogPeekReply:
+        """Long-poll: block until the log tip passes begin_version, then
+        return all of tag's messages in [begin_version, tip]."""
+        while self.version < begin_version:
+            fut = asyncio.get_running_loop().create_future()
+            self._peek_waiters.append(fut)
+            await fut
+        entries = [(v, m) for v, m in self._log.get(tag, ())
+                   if v >= begin_version]
+        return TLogPeekReply(entries, self.version + 1)
+
+    def pop(self, tag: Tag, version: Version) -> None:
+        """Storage server declares everything < version durable; discard."""
+        self._poppable[tag] = max(self._poppable.get(tag, 0), version)
+        log = self._log.get(tag)
+        if log:
+            self._log[tag] = [(v, m) for v, m in log if v >= version]

@@ -52,6 +52,7 @@ class NumpyConflictSet:
         self.he = np.tile(S, (capacity, 1))          # history ends   [C, L]
         self.hver = np.full(capacity, -1, np.int64)  # history versions (-1 = empty)
         self.ptr = 0
+        self.used = 0                                # occupied slots (== capacity once wrapped)
         self.floor = np.int64(oldest_version)
 
     # --- ConflictSet API (mirrors newConflictSet/setOldestVersion/resolve) ---
@@ -73,10 +74,13 @@ class NumpyConflictSet:
 
         too_old = snap < self.floor
 
-        # 1. reads vs history ring: [B,R,1,L] x [1,1,C,L] -> [B,R,C]
+        # 1. reads vs history ring, sliced to occupied slots (the TPU twin
+        #    scans the full fixed-shape ring; sentinel/empty rows compare
+        #    identically to absent ones, so verdicts match exactly)
+        U = self.used
         hit = _overlap(eb.read_begin[:, :, None, :], eb.read_end[:, :, None, :],
-                       self.hb[None, None, :, :], self.he[None, None, :, :], w)
-        newer = self.hver[None, None, :] > snap[:, None, None]   # [B,1,C] (hver=-1 never passes)
+                       self.hb[None, None, :U, :], self.he[None, None, :U, :], w)
+        newer = self.hver[None, None, :U] > snap[:, None, None]  # [B,1,U] (hver=-1 never passes)
         hist_conflict = (hit & newer).any(axis=(1, 2))           # [B]
 
         # 2. intra-batch: reads of i vs writes of j: [B,R,1,1,L] x [1,1,B,R,L] -> [B,B]
@@ -113,5 +117,6 @@ class NumpyConflictSet:
             self.he[p] = eb.write_end[bi, ri]
             self.hver[p] = commit_version
             p = (p + 1) % self.capacity
+            self.used = max(self.used, p if p else self.capacity)
         self.ptr = p
         return verdict

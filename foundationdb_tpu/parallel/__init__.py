@@ -1,7 +1,7 @@
 """Multi-resolver parallelism over a jax device Mesh (REF:fdbserver/Resolver.actor.cpp's
 key-range partitioning, mapped onto TPU cores per SURVEY.md §2.6)."""
 
-from .sharded import ShardedConflictState, make_partition_boundaries, sharded_resolve_step, init_sharded_state
+from .sharded import ShardedConflictState, make_partition_boundaries, make_sharded_resolve_step, init_sharded_state
 
 __all__ = ["ShardedConflictState", "make_partition_boundaries",
-           "sharded_resolve_step", "init_sharded_state"]
+           "make_sharded_resolve_step", "init_sharded_state"]

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import keycode
-from ..ops.conflict_jax import ConflictState, resolve_core
+from ..ops.conflict_jax import ConflictState, _possibly_lt, resolve_core
 from ..ops.keycode import DEFAULT_WIDTH
 
 
@@ -97,17 +97,12 @@ def init_sharded_state(mesh: Mesh, capacity_per_shard: int,
 
 def _mask_writes_to_partition(wb, we, lo, hi, width):
     """Replace write ranges not overlapping [lo, hi) with sentinels."""
-    overlap = (keycode_possibly_lt(wb, hi[None, None, :], width) &
-               keycode_possibly_lt(lo[None, None, :], we, width))   # [B,R]
+    overlap = (_possibly_lt(wb, hi[None, None, :], width) &
+               _possibly_lt(lo[None, None, :], we, width))   # [B,R]
     S = jnp.uint32(0xFFFFFFFF)
     wb2 = jnp.where(overlap[..., None], wb, S)
     we2 = jnp.where(overlap[..., None], we, S)
     return wb2, we2
-
-
-def keycode_possibly_lt(a, b, width):
-    from ..ops.conflict_jax import _possibly_lt
-    return _possibly_lt(a, b, width)
 
 
 def make_sharded_resolve_step(mesh: Mesh, width: int = DEFAULT_WIDTH):
@@ -150,6 +145,3 @@ def make_sharded_resolve_step(mesh: Mesh, width: int = DEFAULT_WIDTH):
 
     return step
 
-
-# convenience export used by __graft_entry__
-sharded_resolve_step = make_sharded_resolve_step

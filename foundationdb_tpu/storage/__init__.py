@@ -1,0 +1,7 @@
+"""Storage engines and versioned structures.
+
+Reference: REF:fdbserver/VersionedMap.h (MVCC in-memory window) and
+REF:fdbserver/IKeyValueStore.h (pluggable persistent engines).
+"""
+
+from .versioned_map import VersionedMap

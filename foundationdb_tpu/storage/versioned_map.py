@@ -1,0 +1,134 @@
+"""MVCC versioned key-value map — the storage server's in-memory window.
+
+Reference: REF:fdbserver/VersionedMap.h — upstream keeps a persistent
+red-black tree (PTree) per version so the last ~5 seconds of versions are
+all readable at once while TLog data ahead of the durable version is
+replayed.  A persistent tree is the right call in C++ where structural
+sharing saves copies; in Python the idiomatic equivalent is *per-key
+version chains* over one sorted key index:
+
+- ``_chains[key]`` is an append-only list of (version, value-or-None)
+  in increasing version order (None = tombstone from a clear).
+- ``_index`` is a sorted list of every key with a chain, for range scans.
+
+Reads at version V binary-search each chain for the newest entry <= V.
+Clears append tombstones to every covered live key — O(keys cleared),
+same cost class as upstream's range insert into the PTree fringe.
+Compaction (``forget_before``) folds chain prefixes below the new oldest
+readable version; fully-dead keys leave the index.
+
+This trades upstream's O(log n) snapshot-copy for chain append, which is
+faster in CPython and keeps GC pressure flat; correctness properties
+(exact-version reads, half-open ranges, tombstone semantics) are identical
+and tested against a brute-force model.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterator
+
+from ..core.data import Version
+
+
+class VersionedMap:
+    def __init__(self) -> None:
+        self._chains: dict[bytes, list[tuple[Version, bytes | None]]] = {}
+        self._index: list[bytes] = []
+        self.oldest_version: Version = 0   # reads below this raise at the role layer
+        self.latest_version: Version = 0   # newest version any entry carries
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    # --- writes (storage role applies mutations in version order) ---
+
+    def set(self, version: Version, key: bytes, value: bytes) -> None:
+        assert version >= self.latest_version, "mutations must arrive in version order"
+        self.latest_version = version
+        chain = self._chains.get(key)
+        if chain is None:
+            self._chains[key] = [(version, value)]
+            bisect.insort(self._index, key)
+        elif chain[-1][0] == version:
+            chain[-1] = (version, value)
+        else:
+            chain.append((version, value))
+
+    def clear_range(self, version: Version, begin: bytes, end: bytes) -> None:
+        assert version >= self.latest_version
+        self.latest_version = version
+        lo = bisect.bisect_left(self._index, begin)
+        hi = bisect.bisect_left(self._index, end)
+        for key in self._index[lo:hi]:
+            chain = self._chains[key]
+            if chain[-1][1] is not None:          # live at tip: tombstone it
+                if chain[-1][0] == version:
+                    chain[-1] = (version, None)
+                else:
+                    chain.append((version, None))
+
+    # --- reads ---
+
+    def get(self, key: bytes, version: Version) -> bytes | None:
+        chain = self._chains.get(key)
+        if chain is None:
+            return None
+        i = bisect.bisect_right(chain, version, key=lambda e: e[0]) - 1
+        if i < 0:
+            return None
+        return chain[i][1]
+
+    def get_latest(self, key: bytes) -> bytes | None:
+        chain = self._chains.get(key)
+        return chain[-1][1] if chain else None
+
+    def range_iter(self, begin: bytes, end: bytes, version: Version,
+                   reverse: bool = False) -> Iterator[tuple[bytes, bytes]]:
+        lo = bisect.bisect_left(self._index, begin)
+        hi = bisect.bisect_left(self._index, end)
+        keys = self._index[lo:hi]
+        if reverse:
+            keys = reversed(keys)
+        for key in keys:
+            v = self.get(key, version)
+            if v is not None:
+                yield key, v
+
+    def range_read(self, begin: bytes, end: bytes, version: Version,
+                   limit: int = 0, reverse: bool = False,
+                   byte_limit: int = 0) -> tuple[list[tuple[bytes, bytes]], bool]:
+        """Returns (kv pairs, more) where more=True means limits truncated."""
+        out: list[tuple[bytes, bytes]] = []
+        nbytes = 0
+        it = self.range_iter(begin, end, version, reverse)
+        for kv in it:
+            out.append(kv)
+            nbytes += len(kv[0]) + len(kv[1])
+            if (limit and len(out) >= limit) or (byte_limit and nbytes >= byte_limit):
+                # one probe to learn if anything remains
+                more = next(it, None) is not None
+                return out, more
+        return out, False
+
+    # --- compaction (setOldestVersion analog) ---
+
+    def forget_before(self, version: Version) -> None:
+        """Drop history below ``version``; reads at >= version unaffected."""
+        if version <= self.oldest_version:
+            return
+        self.oldest_version = version
+        dead: list[bytes] = []
+        for key, chain in self._chains.items():
+            # newest entry <= version becomes the base; older ones go
+            i = len(chain) - 1
+            while i > 0 and chain[i][0] > version:
+                i -= 1
+            if i > 0:
+                del chain[:i]
+            if len(chain) == 1 and chain[0][1] is None and chain[0][0] <= version:
+                dead.append(key)
+        for key in dead:
+            del self._chains[key]
+            i = bisect.bisect_left(self._index, key)
+            del self._index[i]

@@ -1,0 +1,306 @@
+"""End-to-end transaction pipeline tests under deterministic simulation.
+
+Covers the commit call stack of SURVEY.md §3.1 in-process: client RYW txn
+→ GRV/commit proxy → sequencer → resolver (conflict backend) → TLog →
+storage pull/apply → versioned reads.
+"""
+
+import pytest
+
+from foundationdb_tpu.client import Database, KeySelector
+from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+from foundationdb_tpu.core.data import MutationType
+from foundationdb_tpu.runtime.errors import NotCommitted, TransactionTooOld
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+def sim(coro_fn, seed=0, config=None, knobs=None):
+    async def main():
+        async with Cluster(config or ClusterConfig(),
+                           knobs or Knobs()) as cluster:
+            return await coro_fn(Database(cluster))
+    return run_simulation(main(), seed=seed)
+
+
+def multi_config():
+    return ClusterConfig(commit_proxies=2, grv_proxies=2, resolvers=2,
+                         logs=2, storage_servers=4)
+
+
+@pytest.mark.parametrize("config", [None, multi_config()],
+                         ids=["single", "multi-role"])
+def test_set_get(config):
+    async def body(db):
+        await db.set(b"hello", b"world")
+        assert await db.get(b"hello") == b"world"
+        assert await db.get(b"missing") is None
+    sim(body, config=config)
+
+
+@pytest.mark.parametrize("config", [None, multi_config()],
+                         ids=["single", "multi-role"])
+def test_get_range(config):
+    async def body(db):
+        async def fill(tr):
+            for i in range(10):
+                tr.set(b"k%02d" % i, b"v%d" % i)
+        await db.run(fill)
+        rows = await db.get_range(b"k00", b"k99")
+        assert [k for k, _ in rows] == [b"k%02d" % i for i in range(10)]
+        rows = await db.get_range(b"k03", b"k07")
+        assert [k for k, _ in rows] == [b"k03", b"k04", b"k05", b"k06"]
+        rows = await db.get_range(b"k00", b"k99", limit=3)
+        assert [k for k, _ in rows] == [b"k00", b"k01", b"k02"]
+        rows = await db.get_range(b"k00", b"k99", limit=3, reverse=True)
+        assert [k for k, _ in rows] == [b"k09", b"k08", b"k07"]
+    sim(body, config=config)
+
+
+def test_clear_and_clear_range():
+    async def body(db):
+        async def fill(tr):
+            for i in range(10):
+                tr.set(b"k%02d" % i, b"v")
+        await db.run(fill)
+        await db.clear(b"k00")
+        await db.clear_range(b"k03", b"k07")
+        rows = await db.get_range(b"", b"\xff")
+        assert [k for k, _ in rows] == [b"k01", b"k02", b"k07", b"k08", b"k09"]
+    sim(body)
+
+
+def test_ryw_semantics():
+    async def body(db):
+        await db.set(b"a", b"base")
+
+        async def txn(tr):
+            # read-your-writes: uncommitted set visible
+            tr.set(b"b", b"new")
+            assert await tr.get(b"b") == b"new"
+            # clear hides committed data inside the txn
+            tr.clear(b"a")
+            assert await tr.get(b"a") is None
+            # range read merges writes over snapshot
+            tr.set(b"c", b"3")
+            rows = await tr.get_range(b"", b"\xff")
+            assert [k for k, _ in rows] == [b"b", b"c"]
+            # atomic on top of uncommitted state folds client-side
+            tr.add(b"ctr", (5).to_bytes(8, "little"))
+            v = await tr.get(b"ctr")
+            assert int.from_bytes(v, "little") == 5
+        await db.run(txn)
+        assert await db.get(b"a") is None
+        assert await db.get(b"b") == b"new"
+    sim(body)
+
+
+def test_conflict_detection():
+    async def body(db):
+        await db.set(b"x", b"0")
+        tr1 = db.create_transaction()
+        tr2 = db.create_transaction()
+        # both read x, both write x — loser must get not_committed
+        await tr1.get(b"x")
+        await tr2.get(b"x")
+        tr1.set(b"x", b"1")
+        tr2.set(b"x", b"2")
+        await tr1.commit()
+        with pytest.raises(NotCommitted):
+            await tr2.commit()
+        assert await db.get(b"x") == b"1"
+    sim(body)
+
+
+def test_no_conflict_disjoint_keys():
+    async def body(db):
+        tr1 = db.create_transaction()
+        tr2 = db.create_transaction()
+        await tr1.get(b"a")
+        await tr2.get(b"b")
+        tr1.set(b"a", b"1")
+        tr2.set(b"b", b"2")
+        await tr1.commit()
+        await tr2.commit()   # must not raise
+    sim(body)
+
+
+def test_snapshot_read_no_conflict():
+    async def body(db):
+        await db.set(b"x", b"0")
+        tr1 = db.create_transaction()
+        tr2 = db.create_transaction()
+        await tr1.get(b"x", snapshot=True)   # snapshot read: no read conflict
+        await tr2.get(b"x")
+        tr1.set(b"y", b"1")
+        tr2.set(b"x", b"2")
+        await tr2.commit()
+        await tr1.commit()   # must not raise despite x changing
+    sim(body)
+
+
+def test_blind_write_no_conflict():
+    async def body(db):
+        tr1 = db.create_transaction()
+        tr2 = db.create_transaction()
+        tr1.set(b"x", b"1")
+        tr2.set(b"x", b"2")
+        await tr1.commit()
+        await tr2.commit()   # blind writes never conflict
+    sim(body)
+
+
+def test_range_conflict():
+    async def body(db):
+        tr1 = db.create_transaction()
+        tr2 = db.create_transaction()
+        await tr1.get_range(b"a", b"m")     # read conflict on [a, m)
+        tr1.set(b"out", b"1")
+        tr2.set(b"c", b"2")                  # write inside the read range
+        await tr2.commit()
+        with pytest.raises(NotCommitted):
+            await tr1.commit()
+    sim(body)
+
+
+def test_atomic_ops_across_commits():
+    async def body(db):
+        for _ in range(3):
+            async def add(tr):
+                tr.add(b"ctr", (10).to_bytes(8, "little"))
+            await db.run(add)
+        v = await db.get(b"ctr")
+        assert int.from_bytes(v, "little") == 30
+
+        async def amax(tr):
+            tr.max(b"m", (7).to_bytes(8, "little"))
+        await db.run(amax)
+        async def amax2(tr):
+            tr.max(b"m", (3).to_bytes(8, "little"))
+        await db.run(amax2)
+        assert int.from_bytes(await db.get(b"m"), "little") == 7
+    sim(body)
+
+
+def test_key_selectors():
+    async def body(db):
+        async def fill(tr):
+            for k in (b"a", b"c", b"e", b"g"):
+                tr.set(k, b"v")
+        await db.run(fill)
+        tr = db.create_transaction()
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"c")) == b"c"
+        assert await tr.get_key(KeySelector.first_greater_than(b"c")) == b"e"
+        assert await tr.get_key(KeySelector.last_less_or_equal(b"c")) == b"c"
+        assert await tr.get_key(KeySelector.last_less_than(b"c")) == b"a"
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"b")) == b"c"
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"c") + 2) == b"g"
+        # selector range read
+        rows = await tr.get_range(KeySelector.first_greater_than(b"a"),
+                                  KeySelector.first_greater_or_equal(b"g"))
+        assert [k for k, _ in rows] == [b"c", b"e"]
+    sim(body)
+
+
+def test_versionstamped_key():
+    import struct
+    async def body(db):
+        async def vs(tr):
+            # 10-byte placeholder at offset 3, then 4-byte LE offset suffix
+            key = b"vs/" + b"\x00" * 10 + struct.pack("<I", 3)
+            tr.set_versionstamped_key(key, b"payload")
+        await db.run(vs)
+        rows = await db.get_range(b"vs/", b"vs0")
+        assert len(rows) == 1
+        k, v = rows[0]
+        assert v == b"payload" and len(k) == 13
+        stamp_version = struct.unpack(">Q", k[3:11])[0]
+        assert stamp_version > 0
+    sim(body)
+
+
+def test_too_old():
+    async def body(db):
+        import asyncio
+        # two commits spaced > window apart so the second resolve raises
+        # the history floor well above version 1 (the floor lags one
+        # batch, matching the reference's setOldestVersion timing)
+        await db.set(b"x", b"0")
+        await asyncio.sleep(0.01)    # ≈10k versions of virtual time
+        await db.set(b"x", b"1")
+        tr = db.create_transaction()
+        tr.set_read_version(1)       # ancient snapshot far below the floor
+        tr.set(b"x", b"2")
+        tr.add_read_conflict_key(b"x")
+        with pytest.raises(TransactionTooOld):
+            await tr.commit()
+    knobs = Knobs().override(MAX_WRITE_TRANSACTION_LIFE_VERSIONS=1000)
+    sim(body, knobs=knobs)
+
+
+def test_watch():
+    async def body(db):
+        import asyncio
+        await db.set(b"w", b"0")
+        tr = db.create_transaction()
+        fut = await tr.watch(b"w")
+        await tr.commit()
+        assert not fut.done()
+        await db.set(b"w", b"1")
+        await asyncio.wait_for(fut, 5)
+    sim(body)
+
+
+def test_db_run_retries_conflict():
+    async def body(db):
+        await db.set(b"ctr", (0).to_bytes(8, "little"))
+        import asyncio
+
+        async def incr(tr):
+            v = await tr.get(b"ctr")
+            n = int.from_bytes(v, "little") + 1
+            tr.set(b"ctr", n.to_bytes(8, "little"))
+
+        # 10 concurrent read-modify-write txns on one key: conflicts are
+        # certain; db.run must retry each to completion
+        await asyncio.gather(*(db.run(incr) for _ in range(10)))
+        v = await db.get(b"ctr")
+        assert int.from_bytes(v, "little") == 10
+    sim(body)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "cpp"])
+def test_backends_in_pipeline(backend):
+    async def body(db):
+        await db.set(b"x", b"0")
+        tr1 = db.create_transaction()
+        tr2 = db.create_transaction()
+        await tr1.get(b"x")
+        await tr2.get(b"x")
+        tr1.set(b"x", b"1")
+        tr2.set(b"x", b"2")
+        await tr1.commit()
+        with pytest.raises(NotCommitted):
+            await tr2.commit()
+    sim(body, knobs=Knobs().override(RESOLVER_CONFLICT_BACKEND=backend))
+
+
+def test_determinism_same_seed_same_result():
+    async def body(db):
+        import asyncio
+        from foundationdb_tpu.runtime.rng import deterministic_random
+
+        async def writer(i):
+            rng = deterministic_random()
+            for _ in range(5):
+                async def go(tr):
+                    k = b"k%d" % rng.random_int(0, 20)
+                    v = await tr.get(k)
+                    tr.set(k, (len(v or b"") + 1).to_bytes(4, "little"))
+                await db.run(go)
+        await asyncio.gather(*(writer(i) for i in range(4)))
+        return await db.get_range(b"", b"\xff")
+
+    r1 = sim(body, seed=7, config=multi_config())
+    r2 = sim(body, seed=7, config=multi_config())
+    assert r1 == r2
