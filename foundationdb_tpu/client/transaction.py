@@ -15,8 +15,9 @@ from ..core.cluster import Cluster
 from ..core.data import (CommitTransactionRequest, KeySelector, MutationType,
                          Version, key_after)
 from ..runtime.errors import (FdbError, InvalidOption, KeyTooLarge,
-                              TransactionTooLarge, TransactionReadOnly,
-                              UsedDuringCommit, ValueTooLarge)
+                              TransactionCancelled, TransactionTooLarge,
+                              TransactionReadOnly, UsedDuringCommit,
+                              ValueTooLarge)
 from ..runtime.rng import deterministic_random
 from .writemap import WriteMap
 
@@ -30,6 +31,11 @@ class Transaction:
     # --- lifecycle ---
 
     def reset(self) -> None:
+        # watches never armed (txn reset before a successful commit) fail
+        # like upstream rather than leaving their awaiters hung
+        for fut in getattr(self, "_watch_futures", ()):
+            if not fut.done():
+                fut.set_exception(TransactionCancelled())
         self._writes = WriteMap()
         self._read_conflicts: list[tuple[bytes, bytes]] = []
         self._write_conflicts: list[tuple[bytes, bytes]] = []
@@ -101,36 +107,76 @@ class Transaction:
                 self._read_conflicts.append((begin, end))
         return out
 
+    async def _snapshot_stream(self, begin: bytes, end: bytes,
+                               version: Version, reverse: bool,
+                               chunk: int = 128):
+        """Yield storage rows of [begin, end) in key order (or reverse),
+        following each shard's 'more' flag — no row is ever silently
+        dropped by a fetch limit."""
+        servers = self._cluster.storages_for_range(begin, end)
+        servers.sort(key=lambda ss: ss.shard.begin, reverse=reverse)
+        for ss in servers:
+            b = max(begin, ss.shard.begin)
+            e = min(end, ss.shard.end)
+            while b < e:
+                kvs, more = await ss.get_key_values(b, e, version, chunk,
+                                                    reverse)
+                for kv in kvs:
+                    yield kv
+                if not more:
+                    break
+                if reverse:
+                    e = kvs[-1][0]            # exclusive end: continue below
+                else:
+                    b = key_after(kvs[-1][0])
+
     async def _merged_range(self, begin: bytes, end: bytes, limit: int,
                             reverse: bool) -> list[tuple[bytes, bytes]]:
-        """Merge snapshot data with buffered writes (RYWIterator analog)."""
+        """Merge the snapshot stream with buffered writes (the RYWIterator
+        analog, REF:fdbclient/RYWIterator.cpp): two sorted streams —
+        storage rows (clears applied) and written keys — merged until
+        ``limit`` rows are produced or both are exhausted."""
         version = await self.get_read_version()
         written = self._writes.written_keys_in(begin, end)
-        # over-fetch so rows clobbered by clears/sets still let us reach limit
-        fetch_limit = (limit + len(written) + 16) if limit else 0
-        merged: dict[bytes, bytes] = {}
-        for ss in self._cluster.storages_for_range(begin, end):
-            kvs, _more = await ss.get_key_values(begin, end, version,
-                                                 fetch_limit, reverse)
-            for k, v in kvs:
-                merged[k] = v
-        # apply clears, then writes
-        for b, e in self._writes.clears_in(begin, end):
-            for k in [k for k in merged if b <= k < e]:
-                del merged[k]
-        for k in written:
-            kind, payload = self._writes.lookup(k)
-            if kind == "stack":
-                base = merged.get(k)
-                v = WriteMap.fold_with_base(payload, base)
+        if reverse:
+            written = written[::-1]
+        snap = self._snapshot_stream(begin, end, version, reverse)
+        out: list[tuple[bytes, bytes]] = []
+        wi = 0
+        pending_snap: tuple[bytes, bytes] | None = None
+
+        def before(a: bytes, b: bytes) -> bool:
+            return a > b if reverse else a < b
+
+        async def next_snap():
+            async for k, v in snap:
+                if not self._writes.range_cleared(k):
+                    return (k, v)
+            return None
+
+        while not limit or len(out) < limit:
+            if pending_snap is None:
+                pending_snap = await next_snap()
+            wkey = written[wi] if wi < len(written) else None
+            if pending_snap is None and wkey is None:
+                break
+            use_write = wkey is not None and (
+                pending_snap is None or not before(pending_snap[0], wkey))
+            if use_write:
+                base = None
+                if pending_snap is not None and pending_snap[0] == wkey:
+                    base = pending_snap[1]
+                    pending_snap = None     # consumed as the fold base
+                kind, payload = self._writes.lookup(wkey)
+                v = (WriteMap.fold_with_base(payload, base)
+                     if kind == "stack" else payload)
+                if v is not None:
+                    out.append((wkey, v))
+                wi += 1
             else:
-                v = payload
-            if v is None:
-                merged.pop(k, None)
-            else:
-                merged[k] = v
-        items = sorted(merged.items(), reverse=reverse)
-        return items[:limit] if limit else items
+                out.append(pending_snap)
+                pending_snap = None
+        return out
 
     async def get_key(self, selector: KeySelector, snapshot: bool = False) -> bytes:
         """Resolve a KeySelector against the merged view

@@ -17,10 +17,11 @@ from __future__ import annotations
 
 import asyncio
 import struct
+from typing import Callable
 
 from ..ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnRequest
-from ..runtime.errors import (ClusterVersionChanged, NotCommitted,
-                              TransactionTooOld)
+from ..runtime.errors import (ClientInvalidOperation, ClusterVersionChanged,
+                              NotCommitted, TransactionTooOld)
 from ..runtime.knobs import Knobs
 from .data import (CommitResult, CommitTransactionRequest, Mutation,
                    MutationType, Version, pack_versionstamp)
@@ -33,12 +34,16 @@ from .tlog import TLog, TLogPushRequest
 class CommitProxy:
     def __init__(self, knobs: Knobs, sequencer: Sequencer,
                  resolvers: list[Resolver], tlogs: list[TLog],
-                 shard_map: ShardMap) -> None:
+                 shard_map: ShardMap,
+                 tag_to_tlog: Callable[[int], int] | None = None) -> None:
         self.knobs = knobs
         self.sequencer = sequencer
         self.resolvers = resolvers
         self.tlogs = tlogs
         self.shard_map = shard_map
+        # which TLog owns a tag; must match the storage servers' peek
+        # routing or non-owning logs retain unpopped messages forever
+        self.tag_to_tlog = tag_to_tlog or (lambda tag: tag % len(tlogs))
         self._queue: asyncio.Queue = asyncio.Queue()
         self._batcher_task: asyncio.Task | None = None
         self._inflight: set[asyncio.Task] = set()
@@ -97,8 +102,24 @@ class CommitProxy:
 
     async def _commit_batch(self, batch: list[tuple[CommitTransactionRequest,
                                                     asyncio.Future]]) -> None:
-        reqs = [r for r, _ in batch]
-        futs = [f for _, f in batch]
+        # Pre-validate anything that could raise during tagging (malformed
+        # versionstamp offsets) BEFORE a version is assigned, so a bad
+        # request fails alone instead of wedging the version chain.
+        valid: list[tuple[CommitTransactionRequest, asyncio.Future]] = []
+        for req, fut in batch:
+            try:
+                for m in req.mutations:
+                    self._substitute_versionstamp(m, 0, 0)
+                valid.append((req, fut))
+            except Exception:
+                if not fut.done():
+                    fut.set_exception(ClientInvalidOperation())
+        if not valid:
+            return
+        reqs = [r for r, _ in valid]
+        futs = [f for _, f in valid]
+        prev_version = version = None
+        resolved = pushed = False
         try:
             prev_version, version = await self.sequencer.get_commit_version()
             txns = [TxnRequest(r.read_conflict_ranges, r.write_conflict_ranges,
@@ -111,6 +132,7 @@ class CommitProxy:
                     ResolveBatchRequest(prev_version, version, clipped))
                 return reply.verdicts
             all_verdicts = await asyncio.gather(*(ask(r) for r in self.resolvers))
+            resolved = True
 
             # AND the verdicts: TOO_OLD dominates, then CONFLICT
             final = [COMMITTED] * len(reqs)
@@ -119,7 +141,8 @@ class CommitProxy:
                     final[i] = max(final[i], v)
 
             # tag mutations of committed txns, in batch order
-            messages: dict[int, list[Mutation]] = {}
+            per_tlog: list[dict[int, list[Mutation]]] = [
+                {} for _ in self.tlogs]
             order = 0
             orders: list[int] = [0] * len(reqs)
             for i, (req, verdict) in enumerate(zip(reqs, final)):
@@ -133,13 +156,15 @@ class CommitProxy:
                     else:
                         tags = self.shard_map.tags_for_key(m.param1)
                     for t in tags:
-                        messages.setdefault(t, []).append(m)
+                        per_tlog[self.tag_to_tlog(t)].setdefault(t, []).append(m)
                 order += 1
 
-            # push to every TLog (empty pushes keep the version chain intact)
-            await asyncio.gather(*(t.push(TLogPushRequest(prev_version, version,
-                                                          messages))
-                                   for t in self.tlogs))
+            # each TLog gets only the tags it owns; empty pushes still go
+            # to every TLog so all version chains stay gap-free
+            await asyncio.gather(*(
+                t.push(TLogPushRequest(prev_version, version, msgs))
+                for t, msgs in zip(self.tlogs, per_tlog)))
+            pushed = True
             self.sequencer.report_committed(version)
 
             self.total_batches += 1
@@ -165,6 +190,26 @@ class CommitProxy:
             for fut in futs:
                 if not fut.done():
                     fut.set_exception(e)
+            # complete the version chain: downstream roles are waiting on
+            # prev_version ordering, and an abandoned version would wedge
+            # every later batch cluster-wide
+            if version is not None:
+                await self._repair_chain(prev_version, version, resolved, pushed)
+
+    async def _repair_chain(self, prev_version: Version, version: Version,
+                            resolved: bool, pushed: bool) -> None:
+        try:
+            if not resolved:
+                await asyncio.gather(*(r.resolve(
+                    ResolveBatchRequest(prev_version, version, []))
+                    for r in self.resolvers))
+            if not pushed:
+                await asyncio.gather(*(t.push(
+                    TLogPushRequest(prev_version, version, {}))
+                    for t in self.tlogs))
+            self.sequencer.report_committed(version)
+        except Exception:
+            pass  # a failed repair means the epoch is dead; recovery's job
 
     @staticmethod
     def _substitute_versionstamp(m: Mutation, version: Version,
@@ -173,13 +218,19 @@ class CommitProxy:
         trailing 4-byte little-endian offset (API ≥ 520 wire format,
         REF:fdbserver/CommitProxyServer.actor.cpp)."""
         if m.type == MutationType.SET_VERSIONSTAMPED_KEY:
-            pos = struct.unpack("<I", m.param1[-4:])[0]
-            raw = m.param1[:-4]
-            stamped = raw[:pos] + pack_versionstamp(version, order) + raw[pos + 10:]
+            stamped = CommitProxy._splice(m.param1, version, order)
             return Mutation(MutationType.SET_VALUE, stamped, m.param2)
         if m.type == MutationType.SET_VERSIONSTAMPED_VALUE:
-            pos = struct.unpack("<I", m.param2[-4:])[0]
-            raw = m.param2[:-4]
-            stamped = raw[:pos] + pack_versionstamp(version, order) + raw[pos + 10:]
+            stamped = CommitProxy._splice(m.param2, version, order)
             return Mutation(MutationType.SET_VALUE, m.param1, stamped)
         return m
+
+    @staticmethod
+    def _splice(param: bytes, version: Version, order: int) -> bytes:
+        if len(param) < 4:
+            raise ValueError("versionstamp param lacks offset suffix")
+        pos = struct.unpack("<I", param[-4:])[0]
+        raw = param[:-4]
+        if pos + 10 > len(raw):
+            raise ValueError("versionstamp offset out of range")
+        return raw[:pos] + pack_versionstamp(version, order) + raw[pos + 10:]

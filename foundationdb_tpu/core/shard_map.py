@@ -16,12 +16,14 @@ from .tlog import Tag
 
 
 class ShardMap:
-    def __init__(self, boundaries: list[bytes], shard_tags: list[list[Tag]]):
+    def __init__(self, boundaries: list[bytes], shard_tags: list[list[Tag]],
+                 keyspace_end: bytes = b"\xff\xff\xff"):
         """boundaries: interior split points (sorted); len(shard_tags) ==
         len(boundaries) + 1.  Shard i covers [b[i-1], b[i])."""
         assert len(shard_tags) == len(boundaries) + 1
         self.boundaries = boundaries
         self.shard_tags = shard_tags
+        self.keyspace_end = keyspace_end
 
     @staticmethod
     def even(n_shards: int, tags_per_shard: list[list[Tag]] | None = None,
@@ -29,7 +31,7 @@ class ShardMap:
         """Split [b'', end) into n byte-prefix shards; default tag i per shard."""
         bounds = [bytes([int(256 * i / n_shards)]) for i in range(1, n_shards)]
         tags = tags_per_shard or [[i] for i in range(n_shards)]
-        return ShardMap(bounds, tags)
+        return ShardMap(bounds, tags, keyspace_end)
 
     def shard_index(self, key: bytes) -> int:
         return bisect.bisect_right(self.boundaries, key)
@@ -38,8 +40,13 @@ class ShardMap:
         return self.shard_tags[self.shard_index(key)]
 
     def tags_for_range(self, begin: bytes, end: bytes) -> list[Tag]:
+        """Tags of shards intersecting half-open [begin, end)."""
+        if begin >= end:
+            return []
         lo = self.shard_index(begin)
-        hi = self.shard_index(end) if end else len(self.shard_tags) - 1
+        # last shard containing a key < end: bisect_left keeps a range
+        # ending exactly on a shard boundary out of the following shard
+        hi = bisect.bisect_left(self.boundaries, end)
         out: list[Tag] = []
         for i in range(lo, hi + 1):
             for t in self.shard_tags[i]:
@@ -47,9 +54,9 @@ class ShardMap:
                     out.append(t)
         return out
 
-    def shard_range(self, i: int, keyspace_end: bytes = b"\xff\xff\xff") -> KeyRange:
+    def shard_range(self, i: int) -> KeyRange:
         begin = self.boundaries[i - 1] if i > 0 else b""
-        end = self.boundaries[i] if i < len(self.boundaries) else keyspace_end
+        end = self.boundaries[i] if i < len(self.boundaries) else self.keyspace_end
         return KeyRange(begin, end)
 
     def ranges(self) -> list[tuple[KeyRange, list[Tag]]]:

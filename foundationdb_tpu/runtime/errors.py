@@ -62,6 +62,7 @@ BrokenPromise = _err(1100, "broken_promise", "The promise was never set or was d
 OperationCancelled = _err(1101, "operation_cancelled", "Asynchronous operation cancelled")
 IoError = _err(1510, "io_error", "Disk i/o operation failed")
 PlatformError = _err(1500, "platform_error", "Platform error")
+ClientInvalidOperation = _err(2000, "client_invalid_operation", "Invalid API call")
 KeyOutsideLegalRange = _err(2003, "key_outside_legal_range", "Key outside legal range")
 InvertedRange = _err(2005, "inverted_range", "Range begin key exceeds end key")
 InvalidOption = _err(2007, "invalid_option", "Option not valid in this context")

@@ -1,0 +1,11 @@
+"""Simulation workloads — invariant-checking test drivers.
+
+Reference: REF:fdbserver/workloads/ (~100 TestWorkload classes driven by
+.toml specs, REF:fdbserver/tester.actor.cpp).  Each workload has
+setup/start/check phases; fault-injection workloads run concurrently with
+functional ones, and check() asserts a database invariant that would be
+violated by lost/phantom/reordered writes.
+"""
+
+from .workload import TestWorkload, WorkloadContext, register_workload, make_workload, run_workloads
+from . import cycle, serializability, random_rw  # noqa: F401  (register)

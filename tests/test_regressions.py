@@ -1,0 +1,84 @@
+"""Regression tests for review-found pipeline bugs."""
+
+import pytest
+
+from foundationdb_tpu.client import Database
+from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+from foundationdb_tpu.core.shard_map import ShardMap
+from foundationdb_tpu.runtime.errors import (ClientInvalidOperation,
+                                             TransactionCancelled)
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+def sim(coro_fn, seed=0, config=None):
+    async def main():
+        async with Cluster(config or ClusterConfig(), Knobs()) as cluster:
+            return await coro_fn(Database(cluster))
+    return run_simulation(main(), seed=seed)
+
+
+def test_bad_versionstamp_fails_alone_without_wedging_cluster():
+    async def body(db):
+        tr = db.create_transaction()
+        tr.set_versionstamped_key(b"ab", b"v")   # param too short for offset
+        with pytest.raises(ClientInvalidOperation):
+            await tr.commit()
+        # the cluster must still work: the version chain was not wedged
+        await db.set(b"after", b"ok")
+        assert await db.get(b"after") == b"ok"
+    sim(body)
+
+
+def test_limited_range_read_with_large_buffered_clear():
+    async def body(db):
+        async def fill(tr):
+            for i in range(200):
+                tr.set(b"k%03d" % i, b"v")
+        await db.run(fill)
+        tr = db.create_transaction()
+        tr.clear_range(b"k000", b"k100")
+        rows = await tr.get_range(b"", b"\xff", limit=5)
+        assert [k for k, _ in rows] == [b"k100", b"k101", b"k102", b"k103", b"k104"]
+        rows = await tr.get_range(b"", b"\xff", limit=5, reverse=True)
+        assert [k for k, _ in rows] == [b"k199", b"k198", b"k197", b"k196", b"k195"]
+    sim(body, config=ClusterConfig(storage_servers=4))
+
+
+def test_watch_fails_on_reset_instead_of_hanging():
+    async def body(db):
+        tr = db.create_transaction()
+        fut = await tr.watch(b"w")
+        tr.reset()
+        with pytest.raises(TransactionCancelled):
+            await fut
+    sim(body)
+
+
+def test_tlogs_only_retain_owned_tags():
+    async def body(db):
+        for i in range(30):
+            await db.set(b"k%02d" % i, b"v" * 50)
+        cluster = db.cluster
+        # storage pops from its owning tlog; non-owning tlogs must hold
+        # nothing for foreign tags (push routing sends them only empties)
+        for ti, tlog in enumerate(cluster.tlogs):
+            for tag, entries in tlog._log.items():
+                assert tag % len(cluster.tlogs) == ti, \
+                    f"tlog {ti} retains foreign tag {tag}"
+    sim(body, config=ClusterConfig(logs=2, storage_servers=4))
+
+
+def test_shard_map_boundary_range():
+    sm = ShardMap.even(4)
+    # range ending exactly on a shard boundary excludes the next shard
+    assert sm.tags_for_range(b"\x00", b"\x40") == [0]
+    assert sm.tags_for_range(b"\x00", b"\x40\x00") == [0, 1]
+    assert sm.tags_for_range(b"\x40", b"\x80") == [1]
+    assert sm.tags_for_range(b"a", b"a") == []
+    assert sm.tags_for_range(b"", b"\xff") == [0, 1, 2, 3]
+
+
+def test_shard_map_keyspace_end_threaded():
+    sm = ShardMap.even(2, keyspace_end=b"\xff")
+    assert sm.ranges()[-1][0].end == b"\xff"

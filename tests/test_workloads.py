@@ -1,0 +1,61 @@
+"""Simulation workload runs — the `fdbserver -r simulation -f spec` analog."""
+
+import pytest
+
+from foundationdb_tpu.core.cluster import ClusterConfig
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.workloads import run_workloads
+
+
+def multi():
+    return ClusterConfig(commit_proxies=2, grv_proxies=2, resolvers=3,
+                         logs=2, storage_servers=4)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("config", [None, multi()], ids=["single", "multi"])
+def test_cycle(seed, config):
+    res = run_workloads([{"testName": "Cycle", "nodeCount": 12,
+                          "transactionsPerClient": 15}],
+                        seed=seed, config=config, client_count=3)
+    assert res["Cycle"]["transactions"] == 45
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_serializability_oracle(seed):
+    res = run_workloads([{"testName": "Serializability", "keyCount": 24,
+                          "transactionsPerClient": 20}],
+                        seed=seed, config=multi(), client_count=4)
+    assert res["Serializability"]["committed"] > 0
+
+
+@pytest.mark.parametrize("backend", ["numpy", "cpp"])
+def test_cycle_all_backends(backend):
+    knobs = Knobs().override(RESOLVER_CONFLICT_BACKEND=backend)
+    run_workloads([{"testName": "Cycle", "nodeCount": 10,
+                    "transactionsPerClient": 10}],
+                  seed=5, config=multi(), knobs=knobs, client_count=2)
+
+
+def test_readwrite():
+    res = run_workloads([{"testName": "ReadWrite", "nodeCount": 200,
+                          "transactionsPerClient": 30}],
+                        seed=9, config=multi(), client_count=2)
+    assert res["ReadWrite"]["transactions"] == 60
+
+
+def test_mixed_workloads_concurrent():
+    # cycle + readwrite running concurrently against one cluster
+    res = run_workloads([
+        {"testName": "Cycle", "nodeCount": 8, "transactionsPerClient": 10},
+        {"testName": "ReadWrite", "nodeCount": 100, "transactionsPerClient": 20},
+    ], seed=11, config=multi(), client_count=2)
+    assert res["Cycle"]["transactions"] == 20
+
+
+def test_workload_determinism():
+    def go():
+        return run_workloads([{"testName": "Serializability", "keyCount": 16,
+                               "transactionsPerClient": 15}],
+                             seed=21, config=multi(), client_count=3)
+    assert go() == go()
