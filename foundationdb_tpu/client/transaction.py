@@ -14,7 +14,8 @@ import asyncio
 from ..core.cluster import Cluster
 from ..core.data import (CommitTransactionRequest, KeySelector, MutationType,
                          Version, key_after)
-from ..runtime.errors import (FdbError, InvalidOption, KeyTooLarge,
+from ..runtime.errors import (CommitUnknownResult, FdbError, InvalidOption,
+                              KeyTooLarge, RequestMaybeDelivered,
                               TransactionCancelled, TransactionTooLarge,
                               TransactionReadOnly, UsedDuringCommit,
                               ValueTooLarge)
@@ -323,6 +324,10 @@ class Transaction:
         try:
             proxy = deterministic_random().choice(self._cluster.commit_proxies)
             result = await proxy.commit(req)
+        except RequestMaybeDelivered:
+            # the commit reached the proxy but its reply was lost: the
+            # outcome is unknown and retrying blindly could double-commit
+            raise CommitUnknownResult() from None
         finally:
             self._committing = False
         self._committed_version = result.version

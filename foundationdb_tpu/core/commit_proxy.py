@@ -21,7 +21,8 @@ from typing import Callable
 
 from ..ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnRequest
 from ..runtime.errors import (ClientInvalidOperation, ClusterVersionChanged,
-                              NotCommitted, TransactionTooOld)
+                              CommitUnknownResult, NotCommitted,
+                              TransactionTooOld)
 from ..runtime.knobs import Knobs
 from .data import (CommitResult, CommitTransactionRequest, Mutation,
                    MutationType, Version, pack_versionstamp)
@@ -119,7 +120,7 @@ class CommitProxy:
         reqs = [r for r, _ in valid]
         futs = [f for _, f in valid]
         prev_version = version = None
-        resolved = pushed = False
+        resolved = pushed = push_started = False
         try:
             prev_version, version = await self.sequencer.get_commit_version()
             txns = [TxnRequest(r.read_conflict_ranges, r.write_conflict_ranges,
@@ -161,6 +162,7 @@ class CommitProxy:
 
             # each TLog gets only the tags it owns; empty pushes still go
             # to every TLog so all version chains stay gap-free
+            push_started = True
             await asyncio.gather(*(
                 t.push(TLogPushRequest(prev_version, version, msgs))
                 for t, msgs in zip(self.tlogs, per_tlog)))
@@ -187,9 +189,14 @@ class CommitProxy:
                     fut.set_exception(ClusterVersionChanged())
             raise
         except Exception as e:
+            # once any TLog may hold the batch, the outcome is ambiguous:
+            # clients must see commit_unknown_result (maybe-committed), not
+            # a freely-retryable transport error that would double-apply
+            # mutations on retry (REF: NativeAPI tryCommit error mapping)
+            client_err = CommitUnknownResult() if push_started else e
             for fut in futs:
                 if not fut.done():
-                    fut.set_exception(e)
+                    fut.set_exception(client_err)
             # complete the version chain: downstream roles are waiting on
             # prev_version ordering, and an abandoned version would wedge
             # every later batch cluster-wide

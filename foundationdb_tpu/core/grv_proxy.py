@@ -36,16 +36,21 @@ class GrvProxy:
 
     async def _serve_batch(self) -> None:
         await asyncio.sleep(self.knobs.GRV_BATCH_INTERVAL)
-        waiters, self._waiters = self._waiters, []
-        if self.ratekeeper is not None:
-            await self.ratekeeper.admit(len(waiters))
-        try:
-            version = await self.sequencer.get_live_committed_version()
-            self.total_grvs += len(waiters)
-            for fut in waiters:
-                if not fut.done():
-                    fut.set_result(version)
-        except Exception as e:
-            for fut in waiters:
-                if not fut.done():
-                    fut.set_exception(e)
+        # Drain in a loop: requests arriving while we await the (possibly
+        # remote) sequencer join the next round instead of being lost.
+        # The final empty check and the task becoming done() are atomic in
+        # one scheduler step, so get_read_version's done() gate is safe.
+        while self._waiters:
+            waiters, self._waiters = self._waiters, []
+            if self.ratekeeper is not None:
+                await self.ratekeeper.admit(len(waiters))
+            try:
+                version = await self.sequencer.get_live_committed_version()
+                self.total_grvs += len(waiters)
+                for fut in waiters:
+                    if not fut.done():
+                        fut.set_result(version)
+            except Exception as e:
+                for fut in waiters:
+                    if not fut.done():
+                        fut.set_exception(e)

@@ -54,8 +54,17 @@ class StorageServer:
     # --- the update path (REF: storageserver.actor.cpp::update) ---
 
     async def _pull_loop(self) -> None:
+        from ..runtime.errors import FdbError
         while True:
-            reply = await self.tlog.peek(self.tag, self.version + 1)
+            try:
+                reply = await self.tlog.peek(self.tag, self.version + 1)
+            except FdbError as e:
+                # remote TLog unreachable (partition/clog/kill): back off
+                # and retry — the reference's peek cursor does the same
+                if e.retryable:
+                    await asyncio.sleep(0.1)
+                    continue
+                raise
             for version, mutations in reply.entries:
                 self._apply(version, mutations)
             if reply.end_version - 1 > self.version:

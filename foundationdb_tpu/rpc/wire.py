@@ -1,0 +1,237 @@
+"""Self-describing binary wire codec.
+
+Reference: REF:flow/serialize.h + ObjectSerializer/flat_buffers — FDB
+serializes RPC structs into a tagged binary format so old/new versions can
+interoperate.  This codec is deliberately simple and deterministic:
+tag byte + payload, varints for ints, length-prefixed bytes, and a
+registry for dataclass "structs" (encoded as tag + registry id + field
+list).  numpy arrays are supported for the resolver batch path (dtype
+string + shape + raw bytes, C-order).
+
+Not pickle: no code execution on decode, stable across processes, and
+implementable from C++ for the native bridge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct as _struct
+from typing import Any, Type
+
+import numpy as np
+
+# tags
+_NONE, _FALSE, _TRUE, _INT, _NEGINT, _BYTES, _STR, _LIST, _TUPLE, _DICT, \
+    _STRUCT, _FLOAT, _NDARRAY, _ENUM = range(14)
+
+_STRUCTS: dict[int, Type] = {}
+_STRUCT_IDS: dict[Type, int] = {}
+_ENUMS: dict[int, Type] = {}
+_ENUM_IDS: dict[Type, int] = {}
+
+
+def register_struct(cls: Type, *, sid: int | None = None) -> Type:
+    """Register a dataclass for wire encoding.  Ids are assigned in
+    registration order; both sides must register the same structs in the
+    same order (they share the module that defines them)."""
+    i = sid if sid is not None else len(_STRUCTS)
+    assert i not in _STRUCTS, f"struct id {i} taken"
+    _STRUCTS[i] = cls
+    _STRUCT_IDS[cls] = i
+    return cls
+
+
+def register_enum(cls: Type, *, eid: int | None = None) -> Type:
+    i = eid if eid is not None else len(_ENUMS)
+    _ENUMS[i] = cls
+    _ENUM_IDS[cls] = i
+    return cls
+
+
+def _put_varint(out: bytearray, n: int) -> None:
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _get_varint(buf: memoryview, pos: int) -> tuple[int, int]:
+    n = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return n, pos
+        shift += 7
+
+
+def _enc(out: bytearray, obj: Any) -> None:
+    if obj is None:
+        out.append(_NONE)
+    elif obj is False:
+        out.append(_FALSE)
+    elif obj is True:
+        out.append(_TRUE)
+    elif isinstance(obj, enum.Enum):
+        out.append(_ENUM)
+        _put_varint(out, _ENUM_IDS[type(obj)])
+        _put_varint(out, obj.value)
+    elif isinstance(obj, int):
+        if obj >= 0:
+            out.append(_INT)
+            _put_varint(out, obj)
+        else:
+            out.append(_NEGINT)
+            _put_varint(out, -obj)
+    elif isinstance(obj, float):
+        out.append(_FLOAT)
+        out += _struct.pack("<d", obj)
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        out.append(_BYTES)
+        b = bytes(obj)
+        _put_varint(out, len(b))
+        out += b
+    elif isinstance(obj, str):
+        out.append(_STR)
+        b = obj.encode("utf-8")
+        _put_varint(out, len(b))
+        out += b
+    elif isinstance(obj, list):
+        out.append(_LIST)
+        _put_varint(out, len(obj))
+        for x in obj:
+            _enc(out, x)
+    elif isinstance(obj, tuple):
+        out.append(_TUPLE)
+        _put_varint(out, len(obj))
+        for x in obj:
+            _enc(out, x)
+    elif isinstance(obj, dict):
+        out.append(_DICT)
+        _put_varint(out, len(obj))
+        for k, v in obj.items():
+            _enc(out, k)
+            _enc(out, v)
+    elif isinstance(obj, np.ndarray):
+        out.append(_NDARRAY)
+        dt = obj.dtype.str.encode()
+        _put_varint(out, len(dt))
+        out += dt
+        _put_varint(out, obj.ndim)
+        for d in obj.shape:
+            _put_varint(out, d)
+        b = np.ascontiguousarray(obj).tobytes()
+        _put_varint(out, len(b))
+        out += b
+    elif dataclasses.is_dataclass(obj) and type(obj) in _STRUCT_IDS:
+        out.append(_STRUCT)
+        _put_varint(out, _STRUCT_IDS[type(obj)])
+        fields = dataclasses.fields(obj)
+        _put_varint(out, len(fields))
+        for f in fields:
+            _enc(out, getattr(obj, f.name))
+    else:
+        raise TypeError(f"cannot encode {type(obj)}")
+
+
+def encode(obj: Any) -> bytes:
+    out = bytearray()
+    _enc(out, obj)
+    return bytes(out)
+
+
+def _dec(buf: memoryview, pos: int) -> tuple[Any, int]:
+    tag = buf[pos]
+    pos += 1
+    if tag == _NONE:
+        return None, pos
+    if tag == _FALSE:
+        return False, pos
+    if tag == _TRUE:
+        return True, pos
+    if tag == _INT:
+        return _get_varint(buf, pos)
+    if tag == _NEGINT:
+        n, pos = _get_varint(buf, pos)
+        return -n, pos
+    if tag == _FLOAT:
+        return _struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if tag == _BYTES:
+        n, pos = _get_varint(buf, pos)
+        return bytes(buf[pos:pos + n]), pos + n
+    if tag == _STR:
+        n, pos = _get_varint(buf, pos)
+        return bytes(buf[pos:pos + n]).decode("utf-8"), pos + n
+    if tag in (_LIST, _TUPLE):
+        n, pos = _get_varint(buf, pos)
+        items = []
+        for _ in range(n):
+            x, pos = _dec(buf, pos)
+            items.append(x)
+        return (items if tag == _LIST else tuple(items)), pos
+    if tag == _DICT:
+        n, pos = _get_varint(buf, pos)
+        d = {}
+        for _ in range(n):
+            k, pos = _dec(buf, pos)
+            v, pos = _dec(buf, pos)
+            d[k] = v
+        return d, pos
+    if tag == _NDARRAY:
+        n, pos = _get_varint(buf, pos)
+        dt = np.dtype(bytes(buf[pos:pos + n]).decode())
+        pos += n
+        ndim, pos = _get_varint(buf, pos)
+        shape = []
+        for _ in range(ndim):
+            d, pos = _get_varint(buf, pos)
+            shape.append(d)
+        n, pos = _get_varint(buf, pos)
+        arr = np.frombuffer(bytes(buf[pos:pos + n]), dtype=dt).reshape(shape)
+        return arr, pos + n
+    if tag == _ENUM:
+        eid, pos = _get_varint(buf, pos)
+        val, pos = _get_varint(buf, pos)
+        return _ENUMS[eid](val), pos
+    if tag == _STRUCT:
+        sid, pos = _get_varint(buf, pos)
+        cls = _STRUCTS[sid]
+        n, pos = _get_varint(buf, pos)
+        vals = []
+        for _ in range(n):
+            v, pos = _dec(buf, pos)
+            vals.append(v)
+        return cls(*vals), pos
+    raise ValueError(f"bad tag {tag} at {pos - 1}")
+
+
+def decode(data: bytes) -> Any:
+    obj, pos = _dec(memoryview(data), 0)
+    if pos != len(data):
+        raise ValueError(f"trailing {len(data) - pos} bytes")
+    return obj
+
+
+def _register_core_structs() -> None:
+    """Register the shared RPC structs in one canonical order."""
+    from ..core import data as d
+    from ..core import resolver as r
+    from ..core import tlog as t
+    from ..ops import batch as b
+    register_enum(d.MutationType, eid=0)
+    for i, cls in enumerate([
+        d.Mutation, d.KeyRange, d.KeySelector, d.CommitTransactionRequest,
+        d.CommitResult, b.TxnRequest, r.ResolveBatchRequest,
+        r.ResolveBatchReply, t.TLogPushRequest, t.TLogPeekReply,
+    ]):
+        register_struct(cls, sid=i)
+
+
+_register_core_structs()

@@ -54,6 +54,7 @@ FutureVersion = _err(1009, "future_version", "Request for a future version")
 NotCommitted = _err(1020, "not_committed", "Transaction not committed due to a conflict")
 CommitUnknownResult = _err(1021, "commit_unknown_result", "Commit result unknown")
 TransactionCancelled = _err(1025, "transaction_cancelled", "Transaction was cancelled")
+ConnectionFailed = _err(1026, "connection_failed", "Network connection failed")
 TransactionTimedOut = _err(1031, "transaction_timed_out", "Transaction timed out")
 ProcessBehind = _err(1037, "process_behind", "Storage process does not have recent mutations")
 DatabaseLocked = _err(1038, "database_locked", "Database is locked")
@@ -73,9 +74,16 @@ KeyTooLarge = _err(2102, "key_too_large", "Key length exceeds limit")
 ValueTooLarge = _err(2103, "value_too_large", "Value length exceeds limit")
 TransactionTooLarge = _err(2101, "transaction_too_large", "Transaction exceeds byte limit")
 
+RequestMaybeDelivered = _err(1213, "request_maybe_delivered",
+                             "Request may or may not have been delivered")
+
 # resolver-internal (ours; no upstream equivalent needed on the wire)
 ResolverCapacityExceeded = _err(2900, "resolver_capacity_exceeded",
                                 "Conflict-set history ring overflowed; txn forced too-old")
 
-_RETRYABLE = {1004, 1007, 1009, 1020, 1021, 1031, 1037, 1039, 2900}
+# 1213 is retryable for idempotent operations (reads, GRV); the commit
+# path converts it to commit_unknown_result (1021) before the client's
+# retry loop can see it, because re-running a maybe-delivered commit is
+# not idempotent.
+_RETRYABLE = {1004, 1007, 1009, 1020, 1021, 1026, 1031, 1037, 1039, 1213, 2900}
 _MAYBE_COMMITTED = {1021}

@@ -7,5 +7,6 @@ functional ones, and check() asserts a database invariant that would be
 violated by lost/phantom/reordered writes.
 """
 
-from .workload import TestWorkload, WorkloadContext, register_workload, make_workload, run_workloads
+from .workload import (TestWorkload, WorkloadContext, register_workload,
+                       make_workload, run_workloads, run_workloads_on)
 from . import cycle, serializability, random_rw  # noqa: F401  (register)

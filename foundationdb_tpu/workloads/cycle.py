@@ -39,19 +39,24 @@ class CycleWorkload(TestWorkload):
     async def start(self) -> None:
         for _ in range(self.txns):
             a = self.rng.random_int(0, self.n)
-
-            async def rotate(tr, a=a):
-                ka = _key(self.prefix, a)
-                b = int(await tr.get(ka))
-                kb = _key(self.prefix, b)
-                c = int(await tr.get(kb))
-                kc = _key(self.prefix, c)
-                d = int(await tr.get(kc))
-                # rotate b out: a→c, c→b, b→d  (still one cycle)
-                tr.set(ka, b"%08d" % c)
-                tr.set(kc, b"%08d" % b)
-                tr.set(kb, b"%08d" % d)
-            await self.db.run(rotate)
+            tr = self.db.create_transaction()
+            while True:
+                try:
+                    ka = _key(self.prefix, a)
+                    b = int(await tr.get(ka))
+                    kb = _key(self.prefix, b)
+                    c = int(await tr.get(kb))
+                    kc = _key(self.prefix, c)
+                    d = int(await tr.get(kc))
+                    # rotate b out: a→c, c→b, b→d  (still one cycle)
+                    tr.set(ka, b"%08d" % c)
+                    tr.set(kc, b"%08d" % b)
+                    tr.set(kb, b"%08d" % d)
+                    await tr.commit()
+                    break
+                except BaseException as e:
+                    await tr.on_error(e)   # re-raises if not retryable
+                    self.retries += 1
             self.ops_done += 1
 
     async def check(self) -> bool:

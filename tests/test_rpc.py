@@ -1,0 +1,193 @@
+"""RPC layer tests: wire codec, sim network, networked cluster, TCP."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.client import Database
+from foundationdb_tpu.core.cluster import ClusterConfig
+from foundationdb_tpu.core.cluster_rpc import NetworkedCluster
+from foundationdb_tpu.core.data import (CommitTransactionRequest, KeyRange,
+                                        Mutation, MutationType)
+from foundationdb_tpu.rpc.sim_transport import SimNetwork, SimTransport
+from foundationdb_tpu.rpc.transport import Endpoint, NetworkAddress
+from foundationdb_tpu.rpc.wire import decode, encode
+from foundationdb_tpu.runtime.errors import ConnectionFailed, NotCommitted
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+# --- wire codec ---
+
+@pytest.mark.parametrize("obj", [
+    None, True, False, 0, 1, -1, 1 << 62, -(1 << 62), 3.14, b"", b"bytes",
+    "stré", [1, [2, 3]], (1, 2), {"a": 1, b"k": [None, True]},
+    Mutation.set(b"k", b"v"),
+    Mutation(MutationType.ADD, b"k", b"\x01"),
+    KeyRange(b"a", b"b"),
+    CommitTransactionRequest([(b"a", b"b")], [(b"c", b"d")],
+                             [Mutation.set(b"k", b"v")], 42),
+])
+def test_wire_roundtrip(obj):
+    assert decode(encode(obj)) == obj
+
+
+def test_wire_ndarray():
+    a = np.arange(24, dtype=np.uint32).reshape(2, 3, 4)
+    b = decode(encode(a))
+    assert b.dtype == a.dtype and (a == b).all()
+
+
+def test_wire_rejects_unknown():
+    class X:
+        pass
+    with pytest.raises(TypeError):
+        encode(X())
+
+
+# --- sim transport ---
+
+def test_sim_request_reply_and_faults():
+    async def main():
+        net = SimNetwork(Knobs())
+        a = SimTransport(net, NetworkAddress("10.0.0.1", 1))
+        b = SimTransport(net, NetworkAddress("10.0.0.2", 1))
+
+        async def double(x):
+            return x * 2
+        tok = b.dispatcher.register(double)
+        ep = Endpoint(b.address, tok)
+
+        assert await a.request(ep, 21) == 42
+
+        # clog: delivery delayed but succeeds
+        t0 = asyncio.get_running_loop().time()
+        net.clog_pair(a.address, b.address, 0.5)
+        assert await a.request(ep, 5) == 10
+        assert asyncio.get_running_loop().time() - t0 >= 0.5
+
+        # partition: request fails
+        net.partition(a.address, b.address)
+        with pytest.raises(ConnectionFailed):
+            await a.request(ep, 1)
+        net.heal(a.address, b.address)
+        assert await a.request(ep, 1) == 2
+
+        # kill: fails until reboot
+        net.kill(b.address)
+        with pytest.raises(ConnectionFailed):
+            await a.request(ep, 1)
+        net.reboot(b.address)
+        assert await a.request(ep, 3) == 6
+    run_simulation(main(), seed=1)
+
+
+# --- full pipeline over the simulated network ---
+
+def netsim(coro_fn, seed=0, config=None, knobs=None):
+    async def main():
+        async with NetworkedCluster(config or ClusterConfig(),
+                                    knobs or Knobs()) as cluster:
+            return await coro_fn(Database(cluster))
+    return run_simulation(main(), seed=seed)
+
+
+def multi():
+    return ClusterConfig(commit_proxies=2, grv_proxies=2, resolvers=2,
+                         logs=2, storage_servers=4)
+
+
+@pytest.mark.parametrize("config", [None, multi()], ids=["single", "multi"])
+def test_networked_set_get(config):
+    async def body(db):
+        await db.set(b"hello", b"world")
+        assert await db.get(b"hello") == b"world"
+        rows = await db.get_range(b"", b"\xff")
+        assert rows == [(b"hello", b"world")]
+    netsim(body, config=config)
+
+
+def test_networked_conflict():
+    async def body(db):
+        await db.set(b"x", b"0")
+        tr1 = db.create_transaction()
+        tr2 = db.create_transaction()
+        await tr1.get(b"x")
+        await tr2.get(b"x")
+        tr1.set(b"x", b"1")
+        tr2.set(b"x", b"2")
+        await tr1.commit()
+        with pytest.raises(NotCommitted):
+            await tr2.commit()
+    netsim(body, config=multi())
+
+
+def test_networked_cycle_workload():
+    from foundationdb_tpu.workloads import run_workloads_on
+
+    async def main():
+        async with NetworkedCluster(multi(), Knobs()) as cluster:
+            db = Database(cluster)
+            return await run_workloads_on(
+                db, [{"testName": "Cycle", "nodeCount": 10,
+                      "transactionsPerClient": 10}], client_count=2)
+    res = run_simulation(main(), seed=4)
+    assert res["Cycle"]["transactions"] == 20
+
+
+def test_networked_determinism():
+    async def body(db):
+        import asyncio as aio
+        async def incr(tr):
+            v = await tr.get(b"c")
+            n = int.from_bytes(v, "big") if v else 0
+            tr.set(b"c", (n + 1).to_bytes(4, "big"))
+        # serial txns with concurrent pairs
+        for _ in range(3):
+            await aio.gather(db.run(incr), db.run(incr))
+        return await db.get_range(b"", b"\xff")
+    assert netsim(body, seed=17, config=multi()) == \
+        netsim(body, seed=17, config=multi())
+
+
+# --- real TCP transport (real event loop, localhost) ---
+
+def test_tcp_transport_localhost():
+    from foundationdb_tpu.rpc.tcp_transport import TcpTransport
+
+    async def main():
+        a = TcpTransport(NetworkAddress("127.0.0.1", 14601))
+        b = TcpTransport(NetworkAddress("127.0.0.1", 14602))
+        await a.listen()
+        await b.listen()
+
+        async def handler(x):
+            return {"echo": x, "by": "b"}
+        tok = b.dispatcher.register(handler)
+        ep = Endpoint(b.address, tok)
+        out = await a.request(ep, [1, b"two", None])
+        assert out == {"echo": [1, b"two", None], "by": "b"}
+
+        # errors propagate with their code
+        from foundationdb_tpu.runtime.errors import NotCommitted as NC
+
+        async def failing(x):
+            raise NC()
+        tok2 = b.dispatcher.register(failing)
+        with pytest.raises(NC):
+            await a.request(Endpoint(b.address, tok2), 0)
+
+        # one-way delivery
+        got = asyncio.get_running_loop().create_future()
+
+        async def notify(x):
+            if not got.done():
+                got.set_result(x)
+        tok3 = b.dispatcher.register(notify)
+        a.one_way(Endpoint(b.address, tok3), b"ping")
+        assert await asyncio.wait_for(got, 5) == b"ping"
+
+        await a.close()
+        await b.close()
+    asyncio.run(main())
