@@ -43,14 +43,17 @@ class ClusterConfig:
 class Cluster:
     def __init__(self, config: ClusterConfig | None = None,
                  knobs: Knobs | None = None,
-                 epoch_begin_version: Version = 0) -> None:
+                 epoch_begin_version: Version = 0,
+                 tlogs: list[TLog] | None = None,
+                 engines: dict[int, object] | None = None) -> None:
         self.config = config or ClusterConfig()
         self.knobs = knobs or KNOBS
         c, k, v0 = self.config, self.knobs, epoch_begin_version
 
         self.sequencer = Sequencer(k, v0)
         self.shard_map = ShardMap.even(c.storage_servers)
-        self.tlogs = [TLog(k, v0) for _ in range(c.logs)]
+        self.tlogs = tlogs if tlogs is not None else [
+            TLog(k, v0) for _ in range(c.logs)]
 
         # resolver key partitions: even split of the whole keyspace
         res_map = ShardMap.even(c.resolvers)
@@ -62,7 +65,9 @@ class Cluster:
         for rng, tags in self.shard_map.ranges():
             for tag in tags:
                 tlog = self.tlogs[tag % c.logs]
-                self.storage_servers.append(StorageServer(k, tag, rng, tlog, v0))
+                engine = (engines or {}).get(tag)
+                self.storage_servers.append(
+                    StorageServer(k, tag, rng, tlog, v0, engine=engine))
 
         self.grv_proxies = [GrvProxy(k, self.sequencer)
                             for _ in range(c.grv_proxies)]
@@ -70,6 +75,39 @@ class Cluster:
                                            self.tlogs, self.shard_map)
                                for _ in range(c.commit_proxies)]
         self._started = False
+
+    @classmethod
+    async def create(cls, config: ClusterConfig | None = None,
+                     knobs: Knobs | None = None,
+                     fs=None, data_dir: str | None = None) -> "Cluster":
+        """Build a durable cluster from (possibly pre-existing) on-disk
+        state: TLogs recover their DiskQueues, storage servers their
+        engines, and the new epoch starts above every recovered version —
+        the restart-resume half of checkpoint/resume (SURVEY.md §5.4(a))."""
+        if fs is None or data_dir is None:
+            return cls(config, knobs)
+        from ..storage.kv_store import MemoryKVStore
+        config = config or ClusterConfig()
+        knobs = knobs or KNOBS
+        tlogs = [await TLog.open(knobs, fs, f"{data_dir}/tlog-{i}.dq")
+                 for i in range(config.logs)]
+        engines = {}
+        shard_map = ShardMap.even(config.storage_servers)
+        for _rng, tags in shard_map.ranges():
+            for tag in tags:
+                engines[tag] = await MemoryKVStore.open(
+                    fs, f"{data_dir}/storage-{tag}")
+        epoch = max([t.version for t in tlogs]
+                    + [e.meta.get("durable_version", 0)
+                       for e in engines.values()] + [0]) + 1
+        cluster = cls(config, knobs, epoch, tlogs=tlogs, engines=engines)
+        # the sequencer hands out prev_version == epoch on its first batch;
+        # the recovered TLogs (built before cls()) must have their chain
+        # tips bumped to it or the first push would wait forever (the
+        # resolvers are constructed at the epoch already)
+        for t in tlogs:
+            t.version = epoch
+        return cluster
 
     # --- lifecycle ---
 

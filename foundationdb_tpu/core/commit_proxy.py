@@ -76,8 +76,24 @@ class CommitProxy:
     # --- batching (REF: commitBatcher) ---
 
     async def _batcher_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        last_real_commit = loop.time()
         while True:
-            first = await self._queue.get()
+            # while clients are active, emit empty batches during gaps so
+            # versions keep flowing (storage durability floors, resolver
+            # windows, and GRV freshness all ride the version clock —
+            # REF: the master's always-advancing version stream)
+            if loop.time() - last_real_commit < self.knobs.IDLE_COMMIT_LIMIT:
+                try:
+                    first = await asyncio.wait_for(
+                        self._queue.get(),
+                        self.knobs.COMMIT_EMPTY_BATCH_INTERVAL)
+                except asyncio.TimeoutError:
+                    await self._empty_batch()
+                    continue
+            else:
+                first = await self._queue.get()
+            last_real_commit = loop.time()
             batch = [first]
             nbytes = first[0].expected_size()
             deadline = asyncio.get_running_loop().time() + self.knobs.COMMIT_BATCH_INTERVAL
@@ -98,6 +114,24 @@ class CommitProxy:
                 self._commit_batch(batch), name="commit-batch")
             self._inflight.add(t)
             t.add_done_callback(self._inflight.discard)
+
+    async def _empty_batch(self) -> None:
+        """Advance the version chain with no transactions."""
+        prev_version = version = None
+        try:
+            prev_version, version = await self.sequencer.get_commit_version()
+            await asyncio.gather(*(r.resolve(
+                ResolveBatchRequest(prev_version, version, []))
+                for r in self.resolvers))
+            await asyncio.gather(*(t.push(
+                TLogPushRequest(prev_version, version, {}))
+                for t in self.tlogs))
+            self.sequencer.report_committed(version)
+        except Exception:
+            # an assigned version must never be abandoned (re-resolving or
+            # re-pushing an empty batch is harmless)
+            if version is not None:
+                await self._repair_chain(prev_version, version, False, False)
 
     # --- the pipeline (REF: commitBatch) ---
 

@@ -15,6 +15,7 @@ import asyncio
 
 from ..runtime.errors import FutureVersion, TransactionTooOld
 from ..runtime.knobs import Knobs
+from ..storage.kv_store import OP_CLEAR, OP_SET
 from ..storage.versioned_map import VersionedMap
 from .data import KeyRange, Mutation, MutationType, Version, apply_atomic
 from .tlog import TLog, Tag
@@ -22,34 +23,53 @@ from .tlog import TLog, Tag
 
 class StorageServer:
     def __init__(self, knobs: Knobs, tag: Tag, shard: KeyRange,
-                 tlog: TLog, epoch_begin_version: Version = 0) -> None:
+                 tlog: TLog, epoch_begin_version: Version = 0,
+                 engine=None) -> None:
         self.knobs = knobs
         self.tag = tag
         self.shard = shard
         self.tlog = tlog
+        self.engine = engine            # IKeyValueStore when durable
         self.vmap = VersionedMap()
-        self.version: Version = epoch_begin_version
-        self.oldest_version: Version = epoch_begin_version
+        if engine is not None:
+            # resume from the engine's durable version (0 for a fresh
+            # engine — everything newer replays from the TLog)
+            v0 = engine.meta.get("durable_version", 0)
+        else:
+            v0 = epoch_begin_version
+        self.version: Version = v0
+        self.durable_version: Version = v0
+        self.oldest_version: Version = v0
+        self.vmap.oldest_version = v0
+        self.vmap.latest_version = v0
+        self._durability_buffer: list[tuple[Version, tuple[int, bytes, bytes]]] = []
         self._version_waiters: dict[Version, list[asyncio.Future]] = {}
         self._watches: dict[bytes, list[tuple[bytes | None, asyncio.Future]]] = {}
         self._pull_task: asyncio.Task | None = None
+        self._durability_task: asyncio.Task | None = None
         self.bytes_input = 0
         self.total_reads = 0
 
     # --- lifecycle ---
 
     def start(self) -> None:
-        self._pull_task = asyncio.get_running_loop().create_task(
+        loop = asyncio.get_running_loop()
+        self._pull_task = loop.create_task(
             self._pull_loop(), name=f"storage-{self.tag}-pull")
+        if self.engine is not None:
+            self._durability_task = loop.create_task(
+                self._durability_loop(), name=f"storage-{self.tag}-durability")
 
     async def stop(self) -> None:
-        if self._pull_task is not None:
-            self._pull_task.cancel()
-            try:
-                await self._pull_task
-            except asyncio.CancelledError:
-                pass
-            self._pull_task = None
+        for attr in ("_pull_task", "_durability_task"):
+            t = getattr(self, attr)
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, attr, None)
 
     # --- the update path (REF: storageserver.actor.cpp::update) ---
 
@@ -69,30 +89,85 @@ class StorageServer:
                 self._apply(version, mutations)
             if reply.end_version - 1 > self.version:
                 self._bump_version(reply.end_version - 1)
-            self.tlog.pop(self.tag, self.version + 1)
-            # slide the MVCC window
+            if self.engine is None:
+                # memory-only mode: nothing to persist, pop eagerly and
+                # slide the MVCC window by forgetting (folding) history
+                self.tlog.pop(self.tag, self.version + 1)
+                floor = self.version - self.knobs.STORAGE_VERSION_WINDOW
+                if floor > self.oldest_version:
+                    self.oldest_version = floor
+                    self.vmap.forget_before(floor)
+
+    async def _durability_loop(self) -> None:
+        """Migrate aged-out versions from the MVCC window into the engine
+        (REF:fdbserver/storageserver.actor.cpp updateStorage): the window's
+        floor is what becomes durable; newer versions stay memory-only,
+        protected by the TLog, exactly like the reference."""
+        from ..runtime.trace import TraceEvent
+        while True:
+            await asyncio.sleep(self.knobs.STORAGE_DURABILITY_LAG)
             floor = self.version - self.knobs.STORAGE_VERSION_WINDOW
-            if floor > self.oldest_version:
-                self.oldest_version = floor
-                self.vmap.forget_before(floor)
+            if floor <= self.durable_version:
+                continue
+            ops = [op for v, op in self._durability_buffer if v <= floor]
+            try:
+                await self.engine.commit(ops, {
+                    "durable_version": floor,
+                    "tag": self.tag,
+                    "shard": (self.shard.begin, self.shard.end),
+                })
+            except Exception as e:
+                # disk trouble (ENOSPC, IO error): keep the buffer intact
+                # and retry next tick — losing the task would silently
+                # freeze durability and grow memory forever
+                TraceEvent("StorageDurabilityError", severity=40).detail(
+                    "Tag", self.tag).error(e).log()
+                continue
+            self._durability_buffer = [(v, op) for v, op in
+                                       self._durability_buffer if v > floor]
+            self.durable_version = floor
+            self.oldest_version = floor
+            self.vmap.drop_before(floor)     # engine is authoritative <= floor
+            self.tlog.pop(self.tag, floor + 1)
+
+    def _get_latest(self, key: bytes) -> bytes | None:
+        found, v = self.vmap.get2(key, self.vmap.latest_version)
+        if found:
+            return v
+        return self.engine.get(key) if self.engine is not None else None
 
     def _apply(self, version: Version, mutations: list[Mutation]) -> None:
+        durable = self.engine is not None
         for m in mutations:
             self.bytes_input += len(m.param1) + len(m.param2)
             if m.type == MutationType.SET_VALUE:
                 self.vmap.set(version, m.param1, m.param2)
+                if durable:
+                    self._durability_buffer.append(
+                        (version, (OP_SET, m.param1, m.param2)))
                 self._fire_watches(m.param1, m.param2)
             elif m.type == MutationType.CLEAR_RANGE:
                 self.vmap.clear_range(version, m.param1, m.param2)
+                if durable:
+                    self._durability_buffer.append(
+                        (version, (OP_CLEAR, m.param1, m.param2)))
                 self._fire_watch_range(m.param1, m.param2)
             else:
-                existing = self.vmap.get_latest(m.param1)
+                # atomics resolve against the latest value (window or
+                # engine) and store as plain sets/clears downstream
+                existing = self._get_latest(m.param1)
                 new = apply_atomic(m.type, existing, m.param2)
                 if new is None:
                     self.vmap.clear_range(version, m.param1, m.param1 + b"\x00")
+                    if durable:
+                        self._durability_buffer.append(
+                            (version, (OP_CLEAR, m.param1, m.param1 + b"\x00")))
                     self._fire_watches(m.param1, None)
                 else:
                     self.vmap.set(version, m.param1, new)
+                    if durable:
+                        self._durability_buffer.append(
+                            (version, (OP_SET, m.param1, new)))
                     self._fire_watches(m.param1, new)
         self._bump_version(version)
 
@@ -126,7 +201,12 @@ class StorageServer:
         await self._wait_for_version(version)
         self._check_too_old(version)
         self.total_reads += 1
-        return self.vmap.get(key, version)
+        found, v = self.vmap.get2(key, version)
+        if found:
+            return v
+        # no window entry at or <= version: the engine's durable state
+        # (exactly the window floor's state) is authoritative
+        return self.engine.get(key) if self.engine is not None else None
 
     async def get_key_values(self, begin: bytes, end: bytes, version: Version,
                              limit: int = 0, reverse: bool = False,
@@ -139,7 +219,60 @@ class StorageServer:
         e = min(end, self.shard.end)
         if b >= e:
             return [], False
-        return self.vmap.range_read(b, e, version, limit, reverse, byte_limit)
+        if self.engine is None:
+            return self.vmap.range_read(b, e, version, limit, reverse, byte_limit)
+        return self._merged_range_read(b, e, version, limit, reverse, byte_limit)
+
+    def _merged_range_read(self, begin: bytes, end: bytes, version: Version,
+                           limit: int, reverse: bool, byte_limit: int
+                           ) -> tuple[list[tuple[bytes, bytes]], bool]:
+        """Merge the MVCC window over the engine's durable state — the
+        getKeyValuesQ read path when data spans memory and disk.
+
+        ``more`` may be conservatively True when only invisible entries
+        (tombstones / not-found chains) remain; the caller's next fetch
+        then returns ([], False) — one wasted round trip, never a wrong
+        result."""
+        win = self.vmap.overlay_iter(begin, end, version, reverse)
+        eng = self.engine.range(begin, end, reverse)
+        out: list[tuple[bytes, bytes]] = []
+        nbytes = 0
+        w = next(win, None)
+        g = next(eng, None)
+
+        def before(a: bytes, b: bytes) -> bool:
+            return a > b if reverse else a < b
+
+        def emit(k: bytes, v: bytes) -> bool:
+            nonlocal nbytes
+            out.append((k, v))
+            nbytes += len(k) + len(v)
+            return bool((limit and len(out) >= limit)
+                        or (byte_limit and nbytes >= byte_limit))
+
+        while w is not None or g is not None:
+            if w is not None and (g is None or not before(g[0], w[0])):
+                wk, found, wv = w
+                gval = None
+                if g is not None and g[0] == wk:
+                    gval = g[1]
+                    g = next(eng, None)
+                if found:
+                    if wv is not None and emit(wk, wv):
+                        return out, (next(win, None) is not None
+                                     or g is not None)
+                elif gval is not None:
+                    # window has a chain but nothing <= version: durable
+                    # state (the engine row) applies
+                    if emit(wk, gval):
+                        return out, (next(win, None) is not None
+                                     or g is not None)
+                w = next(win, None)
+            else:
+                if emit(g[0], g[1]):
+                    return out, (w is not None or next(eng, None) is not None)
+                g = next(eng, None)
+        return out, False
 
     # --- watches (REF: storageserver.actor.cpp watchValueQ) ---
 
@@ -147,7 +280,7 @@ class StorageServer:
                           version: Version) -> None:
         """Completes when the key's value differs from ``value``."""
         await self._wait_for_version(version)
-        current = self.vmap.get(key, self.version)
+        current = self._get_latest(key)
         if current != value:
             return
         fut = asyncio.get_running_loop().create_future()

@@ -37,15 +37,42 @@ class TLogPeekReply:
 
 
 class TLog:
-    def __init__(self, knobs: Knobs, epoch_begin_version: Version = 0) -> None:
+    def __init__(self, knobs: Knobs, epoch_begin_version: Version = 0,
+                 queue=None) -> None:
         self.knobs = knobs
         self.version: Version = epoch_begin_version
+        self.queue = queue                      # DiskQueue when durable
+        self._frame_ends: list[tuple[Version, int]] = []  # for pop_to
+        self._hosted: set[Tag] = set()          # tags ever pushed here
         self._log: dict[Tag, list[tuple[Version, list[Mutation]]]] = {}
         self._poppable: dict[Tag, Version] = {}
         self._push_waiters: dict[Version, list[asyncio.Future]] = {}
         self._peek_waiters: list[asyncio.Future] = []
+        self._pop_task: asyncio.Task | None = None
+        self._pop_target = 0
         self.total_pushes = 0
         self.total_bytes = 0
+
+    @classmethod
+    async def open(cls, knobs: Knobs, fs, path: str,
+                   epoch_begin_version: Version = 0) -> "TLog":
+        """Open a durable TLog, replaying surviving records (the DiskQueue
+        recovery path of REF:fdbserver/TLogServer.actor.cpp).  A torn tail
+        from a crash is discarded — exactly the unfsynced suffix."""
+        from ..rpc.wire import decode
+        from ..storage.disk_queue import DiskQueue
+        f = fs.open(path)
+        queue, frames = await DiskQueue.open(f)
+        tlog = cls(knobs, epoch_begin_version, queue)
+        for frame, end in frames:
+            rec = decode(frame)
+            version = rec["v"]
+            for tag, msgs in rec["m"].items():
+                tlog._log.setdefault(tag, []).append((version, msgs))
+                tlog._hosted.add(tag)
+            tlog.version = max(tlog.version, version)
+            tlog._frame_ends.append((version, end))
+        return tlog
 
     async def _wait_for_version(self, prev_version: Version) -> None:
         if self.version >= prev_version:
@@ -64,7 +91,14 @@ class TLog:
         for tag, msgs in req.messages.items():
             if msgs:
                 self._log.setdefault(tag, []).append((req.version, msgs))
+                self._hosted.add(tag)
                 self.total_bytes += sum(len(m.param1) + len(m.param2) for m in msgs)
+        if self.queue is not None and req.messages:
+            from ..rpc.wire import encode
+            end = await self.queue.push(encode({"v": req.version,
+                                                "m": req.messages}))
+            self._frame_ends.append((req.version, end))
+            await self.queue.commit()   # the fsync that makes commits durable
         self.version = req.version
         self.total_pushes += 1
         ready = [v for v in self._push_waiters if v <= req.version]
@@ -95,3 +129,41 @@ class TLog:
         log = self._log.get(tag)
         if log:
             self._log[tag] = [(v, m) for v, m in log if v >= version]
+        if self.queue is not None and self._hosted:
+            # the disk queue can advance only past versions every hosted
+            # tag has popped; a tag that never popped pins the queue
+            frontier = min(self._poppable.get(t, 0) for t in self._hosted)
+            keep = 0
+            pop_off = None
+            for v, end in self._frame_ends:
+                if v < frontier:
+                    keep += 1
+                    pop_off = end
+                else:
+                    break
+            if pop_off is not None:
+                del self._frame_ends[:keep]
+                self._schedule_pop(pop_off)
+
+    def _schedule_pop(self, offset: int) -> None:
+        """Serialize disk-queue pops through one strongly-held worker task
+        (concurrent pop_to calls could write the header out of order, and
+        the loop holds tasks only weakly)."""
+        self._pop_target = max(getattr(self, "_pop_target", 0), offset)
+        if self._pop_task is not None and not self._pop_task.done():
+            return
+
+        async def worker():
+            from ..runtime.trace import TraceEvent
+            while True:
+                target = self._pop_target
+                if self.queue._front >= target:
+                    return
+                try:
+                    await self.queue.pop_to(target)
+                except Exception as e:
+                    TraceEvent("TLogPopError", severity=40).detail(
+                        "Error", repr(e)).log()
+                    return
+        self._pop_task = asyncio.get_running_loop().create_task(
+            worker(), name="tlog-pop")

@@ -1,0 +1,146 @@
+"""Async file abstraction with a kill-lossy simulated implementation.
+
+Reference: REF:fdbrpc/IAsyncFile.h — all durable state flows through
+IAsyncFile; in simulation AsyncFileNonDurable *loses writes that were not
+sync()ed* when the process is killed, which is how FDB proves its
+recovery logic against real crash semantics.  That property is the whole
+point of this module: SimFile buffers unsynced writes separately and a
+machine kill drops them.
+
+RealFile uses blocking os I/O directly: individual operations are small
+and the event loop stall is bounded; an io-thread pool (the reference's
+eio) can slot in behind the same interface later without changing callers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol
+
+
+class IAsyncFile(Protocol):
+    async def read(self, offset: int, length: int) -> bytes: ...
+    async def write(self, offset: int, data: bytes) -> None: ...
+    async def sync(self) -> None: ...
+    async def truncate(self, size: int) -> None: ...
+    def size(self) -> int: ...
+    async def close(self) -> None: ...
+
+
+class RealFile:
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+
+    async def read(self, offset: int, length: int) -> bytes:
+        return os.pread(self._fd, length, offset)
+
+    async def write(self, offset: int, data: bytes) -> None:
+        os.pwrite(self._fd, data, offset)
+
+    async def sync(self) -> None:
+        os.fsync(self._fd)
+
+    async def truncate(self, size: int) -> None:
+        os.ftruncate(self._fd, size)
+
+    def size(self) -> int:
+        return os.fstat(self._fd).st_size
+
+    async def close(self) -> None:
+        os.close(self._fd)
+
+
+class SimFile:
+    """In-memory file whose unsynced writes vanish on kill."""
+
+    def __init__(self, fs: "SimFileSystem", path: str) -> None:
+        self.fs = fs
+        self.path = path
+        # synced: survives kill.  _pending: ordered op log since last sync
+        # — ("w", offset, data) and ("t", size, b"") must interleave in
+        # program order or a truncate could chop later appends.
+        if path not in fs.disks:
+            fs.disks[path] = bytearray()
+        self._pending: list[tuple[str, int, bytes]] = []
+
+    @staticmethod
+    def _replay(buf: bytearray, ops) -> None:
+        for kind, arg, data in ops:
+            if kind == "w":
+                if len(buf) < arg + len(data):
+                    buf.extend(b"\x00" * (arg + len(data) - len(buf)))
+                buf[arg:arg + len(data)] = data
+            else:
+                del buf[arg:]
+
+    def _view(self) -> bytes:
+        """Content as a reader would see it (synced + pending)."""
+        buf = bytearray(self.fs.disks[self.path])
+        self._replay(buf, self._pending)
+        return bytes(buf)
+
+    async def read(self, offset: int, length: int) -> bytes:
+        v = self._view()
+        return v[offset:offset + length]
+
+    async def write(self, offset: int, data: bytes) -> None:
+        self._pending.append(("w", offset, bytes(data)))
+
+    async def sync(self) -> None:
+        self._replay(self.fs.disks[self.path], self._pending)
+        self._pending.clear()
+
+    async def truncate(self, size: int) -> None:
+        self._pending.append(("t", size, b""))
+
+    def size(self) -> int:
+        return len(self._view())
+
+    async def close(self) -> None:
+        pass  # unsynced writes remain pending-lost, like a closed-then-killed fd
+
+
+class SimFileSystem:
+    """Shared simulated disk: path → synced bytes.  kill_unsynced()
+    models machine loss (AsyncFileNonDurable semantics)."""
+
+    def __init__(self) -> None:
+        self.disks: dict[str, bytearray] = {}
+        self._open: list[SimFile] = []
+
+    def open(self, path: str) -> SimFile:
+        f = SimFile(self, path)
+        self._open.append(f)
+        return f
+
+    def kill_unsynced(self) -> None:
+        """The machine died: every open file's unsynced writes are gone."""
+        for f in self._open:
+            f._pending.clear()
+
+    def listdir(self, prefix: str) -> list[str]:
+        return sorted(p for p in self.disks if p.startswith(prefix))
+
+    def remove(self, path: str) -> None:
+        self.disks.pop(path, None)
+        self._open = [f for f in self._open if f.path != path]
+
+
+class RealFileSystem:
+    def open(self, path: str) -> RealFile:
+        return RealFile(path)
+
+    def listdir(self, prefix: str) -> list[str]:
+        d = os.path.dirname(prefix) or "."
+        if not os.path.isdir(d):
+            return []
+        return sorted(os.path.join(d, n) for n in os.listdir(d)
+                      if os.path.join(d, n).startswith(prefix))
+
+    def remove(self, path: str) -> None:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass

@@ -31,6 +31,12 @@ class Knobs:
     COMMIT_BATCH_BYTE_LIMIT: int = 1 << 20
     COMMIT_BATCH_COUNT_LIMIT: int = 1024
     GRV_BATCH_INTERVAL: float = 0.001
+    # empty batches keep versions flowing while clients are active so
+    # storage durability floors and resolver windows advance; after
+    # IDLE_COMMIT_LIMIT without a real commit the proxy goes quiet so the
+    # simulator's deadlock detection still works
+    COMMIT_EMPTY_BATCH_INTERVAL: float = 0.25
+    IDLE_COMMIT_LIMIT: float = 5.0
 
     # --- storage ---
     STORAGE_VERSION_WINDOW: int = 5_000_000   # in-memory MVCC window, versions

@@ -1,0 +1,107 @@
+"""DiskQueue — the append-only durable record queue under the TLog.
+
+Reference: REF:fdbserver/DiskQueue.actor.cpp — FDB's TLog writes redo
+records into a page-aligned two-file queue; push appends, commit fsyncs,
+pop logically truncates the front.  Records surviving a crash are exactly
+those up to the last completed sync (proved in sim by AsyncFileNonDurable).
+
+Format: a 4KB header page (magic, physical front offset) followed by
+frames [u32 len][u32 crc32][payload].  Recovery scans frames from the
+header's front until EOF/bad-crc (a torn tail after a crash is discarded).
+
+Offsets handed to callers are *logical* and monotonic: physical
+compaction (copying the live region down over a large popped prefix)
+shifts the mapping internally, so offsets recorded across a compaction
+stay valid.  Compaction only runs when the live region fits inside the
+popped prefix, so a crash mid-copy can never damage bytes the current
+header still references.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+_FRAME = struct.Struct("<II")
+_HEADER = struct.Struct("<QQ")      # magic, physical front offset
+_MAGIC = 0xFDB7D15C  # arbitrary magic for our queue files
+_HEADER_SIZE = 4096
+_COMPACT_SLACK = 1 << 22            # compact when popped prefix > 4MB
+
+
+class DiskQueue:
+    def __init__(self, file) -> None:
+        self.file = file
+        self._front = _HEADER_SIZE   # logical offset of first live frame
+        self._end = _HEADER_SIZE     # logical append position
+        self._shift = 0              # logical - physical
+
+    def _phys(self, logical: int) -> int:
+        return logical - self._shift
+
+    @classmethod
+    async def open(cls, file) -> tuple["DiskQueue", list[tuple[bytes, int]]]:
+        """Open + recover: returns (queue, [(payload, end_offset), ...]) —
+        the end offset is what pop_to() takes to discard through a frame."""
+        q = cls(file)
+        size = file.size()
+        if size >= _HEADER_SIZE:
+            hdr = await file.read(0, _HEADER.size)
+            magic, front = _HEADER.unpack(hdr)
+            if magic == _MAGIC and _HEADER_SIZE <= front:
+                q._front = front     # logical == physical on a fresh open
+        payloads: list[tuple[bytes, int]] = []
+        pos = q._front
+        while pos + _FRAME.size <= size:
+            ln, crc = _FRAME.unpack(await file.read(pos, _FRAME.size))
+            data = await file.read(pos + _FRAME.size, ln)
+            if len(data) < ln or zlib.crc32(data) != crc:
+                break               # torn tail: discard from here
+            pos += _FRAME.size + ln
+            payloads.append((data, pos))
+        q._end = pos
+        await file.truncate(pos)    # drop any torn tail bytes
+        if size < _HEADER_SIZE:
+            await q._write_header()
+        return q, payloads
+
+    async def _write_header(self) -> None:
+        await self.file.write(0, _HEADER.pack(_MAGIC, self._phys(self._front)))
+
+    async def push(self, payload: bytes) -> int:
+        """Append one frame; returns its logical end offset (record this
+        to pop_to() later)."""
+        frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+        await self.file.write(self._phys(self._end), frame)
+        self._end += len(frame)
+        return self._end
+
+    async def commit(self) -> None:
+        """Make all pushed frames durable (the TLog's fsync point)."""
+        await self.file.sync()
+
+    async def pop_to(self, offset: int) -> None:
+        """Discard everything before logical ``offset``; physically
+        compact when worthwhile and safe."""
+        if offset <= self._front:
+            return
+        self._front = min(offset, self._end)
+        await self._write_header()
+        popped_phys = self._phys(self._front) - _HEADER_SIZE
+        live = self._end - self._front
+        if popped_phys > _COMPACT_SLACK and live <= popped_phys:
+            data = await self.file.read(self._phys(self._front), live)
+            await self.file.write(_HEADER_SIZE, data)
+            await self.file.sync()          # live bytes safe at new home
+            self._shift += popped_phys
+            await self._write_header()      # recovery now reads the copy
+            await self.file.truncate(_HEADER_SIZE + live)
+            await self.file.sync()
+
+    @property
+    def end_offset(self) -> int:
+        return self._end
+
+    @property
+    def bytes_used(self) -> int:
+        return self._end - self._front

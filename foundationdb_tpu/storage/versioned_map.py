@@ -71,13 +71,21 @@ class VersionedMap:
     # --- reads ---
 
     def get(self, key: bytes, version: Version) -> bytes | None:
+        found, value = self.get2(key, version)
+        return value if found else None
+
+    def get2(self, key: bytes, version: Version) -> tuple[bool, bytes | None]:
+        """(found, value): found=False means this map has no entry at or
+        below ``version`` — the caller falls through to the persistent
+        engine (the PTree→IKeyValueStore read path of getValueQ,
+        REF:fdbserver/storageserver.actor.cpp)."""
         chain = self._chains.get(key)
         if chain is None:
-            return None
+            return False, None
         i = bisect.bisect_right(chain, version, key=lambda e: e[0]) - 1
         if i < 0:
-            return None
-        return chain[i][1]
+            return False, None
+        return True, chain[i][1]
 
     def get_latest(self, key: bytes) -> bytes | None:
         chain = self._chains.get(key)
@@ -111,6 +119,20 @@ class VersionedMap:
                 return out, more
         return out, False
 
+    def overlay_iter(self, begin: bytes, end: bytes, version: Version,
+                     reverse: bool = False):
+        """Yield (key, found, value) for every key with a chain in range —
+        including not-found and tombstone markers — for merging over an
+        engine's range iterator."""
+        lo = bisect.bisect_left(self._index, begin)
+        hi = bisect.bisect_left(self._index, end)
+        keys = self._index[lo:hi]
+        if reverse:
+            keys = reversed(keys)
+        for key in keys:
+            found, v = self.get2(key, version)
+            yield key, found, v
+
     # --- compaction (setOldestVersion analog) ---
 
     def forget_before(self, version: Version) -> None:
@@ -127,6 +149,27 @@ class VersionedMap:
             if i > 0:
                 del chain[:i]
             if len(chain) == 1 and chain[0][1] is None and chain[0][0] <= version:
+                dead.append(key)
+        for key in dead:
+            del self._chains[key]
+            i = bisect.bisect_left(self._index, key)
+            del self._index[i]
+
+    def drop_before(self, version: Version) -> None:
+        """Remove entries at or below ``version`` entirely (they are now
+        durable in the engine); reads at those versions must fall through.
+        Mirrors the PTree erase after makeVersionDurable."""
+        if version <= self.oldest_version:
+            return
+        self.oldest_version = version
+        dead: list[bytes] = []
+        for key, chain in self._chains.items():
+            i = 0
+            while i < len(chain) and chain[i][0] <= version:
+                i += 1
+            if i > 0:
+                del chain[:i]
+            if not chain:
                 dead.append(key)
         for key in dead:
             del self._chains[key]

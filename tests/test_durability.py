@@ -1,0 +1,169 @@
+"""Durability tests: DiskQueue, KV engine, crash/restart resume.
+
+The crash model is the reference's AsyncFileNonDurable: a kill loses every
+write since the last sync, so recovery must rebuild exactly the synced
+prefix (torn tails discarded) and replay the TLog from the storage
+engine's durable version.
+"""
+
+import pytest
+
+from foundationdb_tpu.client import Database
+from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+from foundationdb_tpu.runtime.errors import NotCommitted
+from foundationdb_tpu.runtime.files import SimFileSystem
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.storage.disk_queue import DiskQueue
+from foundationdb_tpu.storage.kv_store import OP_CLEAR, OP_SET, MemoryKVStore
+
+
+def durable_knobs():
+    # small window so durability happens fast in virtual time
+    return Knobs().override(STORAGE_VERSION_WINDOW=100_000,
+                            STORAGE_DURABILITY_LAG=0.05)
+
+
+# --- DiskQueue ---
+
+def test_disk_queue_sync_survives_kill():
+    async def main():
+        fs = SimFileSystem()
+        q, frames = await DiskQueue.open(fs.open("q"))
+        assert frames == []
+        await q.push(b"one")
+        await q.push(b"two")
+        await q.commit()            # durable point
+        await q.push(b"three")      # never synced
+        fs.kill_unsynced()
+        q2, frames2 = await DiskQueue.open(fs.open("q"))
+        assert [p for p, _ in frames2] == [b"one", b"two"]
+        # queue remains usable after recovery
+        await q2.push(b"four")
+        await q2.commit()
+        _, frames3 = await DiskQueue.open(fs.open("q"))
+        assert [p for p, _ in frames3] == [b"one", b"two", b"four"]
+    run_simulation(main())
+
+
+def test_disk_queue_pop():
+    async def main():
+        fs = SimFileSystem()
+        q, _ = await DiskQueue.open(fs.open("q"))
+        ends = [await q.push(b"p%d" % i) for i in range(5)]
+        await q.commit()
+        await q.pop_to(ends[2])     # drop first three
+        await q.commit()
+        _, frames = await DiskQueue.open(fs.open("q"))
+        assert [p for p, _ in frames] == [b"p3", b"p4"]
+    run_simulation(main())
+
+
+# --- KV engine ---
+
+def test_kv_store_recovery_and_snapshot():
+    async def main():
+        fs = SimFileSystem()
+        kv = await MemoryKVStore.open(fs, "dir/kv")
+        await kv.commit([(OP_SET, b"a", b"1"), (OP_SET, b"b", b"2")],
+                        {"durable_version": 10})
+        await kv.commit([(OP_CLEAR, b"a", b"a\x00"), (OP_SET, b"c", b"3")],
+                        {"durable_version": 20})
+        kv2 = await MemoryKVStore.open(fs, "dir/kv")
+        assert kv2.get(b"a") is None
+        assert kv2.get(b"b") == b"2"
+        assert list(kv2.range(b"", b"\xff")) == [(b"b", b"2"), (b"c", b"3")]
+        assert kv2.meta == {"durable_version": 20}
+        # snapshot + post-snapshot WAL both recover
+        await kv2._snapshot()
+        await kv2.commit([(OP_SET, b"d", b"4")], {"durable_version": 30})
+        kv3 = await MemoryKVStore.open(fs, "dir/kv")
+        assert [k for k, _ in kv3.range(b"", b"\xff")] == [b"b", b"c", b"d"]
+        assert kv3.meta == {"durable_version": 30}
+    run_simulation(main())
+
+
+def test_kv_store_op_order_within_batch():
+    async def main():
+        fs = SimFileSystem()
+        kv = await MemoryKVStore.open(fs, "kv")
+        # set then clear-covering then set again: final state = last set
+        await kv.commit([(OP_SET, b"k", b"1"),
+                         (OP_CLEAR, b"a", b"z"),
+                         (OP_SET, b"k", b"2")], {})
+        kv2 = await MemoryKVStore.open(fs, "kv")
+        assert kv2.get(b"k") == b"2"
+    run_simulation(main())
+
+
+# --- full-cluster restart ---
+
+def test_cluster_restart_preserves_committed_data():
+    async def main():
+        fs = SimFileSystem()
+        cfg = ClusterConfig(storage_servers=2, logs=2)
+        k = durable_knobs()
+
+        cluster = await Cluster.create(cfg, k, fs=fs, data_dir="c1")
+        async with cluster:
+            db = Database(cluster)
+            for i in range(20):
+                await db.set(b"key%02d" % i, b"val%d" % i)
+            await db.clear_range(b"key00", b"key05")
+            # let durability catch up, then crash with unsynced loss
+            import asyncio
+            await asyncio.sleep(1.0)
+        fs.kill_unsynced()
+
+        cluster2 = await Cluster.create(cfg, k, fs=fs, data_dir="c1")
+        async with cluster2:
+            db2 = Database(cluster2)
+            rows = await db2.get_range(b"key", b"kez")
+            assert [k_ for k_, _ in rows] == [b"key%02d" % i for i in range(5, 20)]
+            # and the restarted cluster accepts new commits
+            await db2.set(b"after-restart", b"yes")
+            assert await db2.get(b"after-restart") == b"yes"
+    run_simulation(main(), seed=3)
+
+
+def test_cluster_restart_after_immediate_kill():
+    """Kill before any durability tick: TLog fsync data must be enough."""
+    async def main():
+        import asyncio
+        fs = SimFileSystem()
+        cfg = ClusterConfig(storage_servers=2, logs=1)
+        k = durable_knobs().override(STORAGE_DURABILITY_LAG=30.0)  # never ticks
+
+        cluster = await Cluster.create(cfg, k, fs=fs, data_dir="d")
+        async with cluster:
+            db = Database(cluster)
+            await db.set(b"x", b"1")
+            await db.set(b"y", b"2")
+        fs.kill_unsynced()
+
+        cluster2 = await Cluster.create(cfg, k, fs=fs, data_dir="d")
+        async with cluster2:
+            db2 = Database(cluster2)
+            # engines had nothing durable; replay from the TLog queues
+            assert await db2.get(b"x") == b"1"
+            assert await db2.get(b"y") == b"2"
+    run_simulation(main(), seed=6)
+
+
+def test_restart_determinism():
+    def go(seed):
+        async def main():
+            fs = SimFileSystem()
+            cfg = ClusterConfig(storage_servers=2, logs=2)
+            k = durable_knobs()
+            cluster = await Cluster.create(cfg, k, fs=fs, data_dir="c")
+            async with cluster:
+                db = Database(cluster)
+                for i in range(10):
+                    await db.set(b"k%d" % i, b"v%d" % i)
+            fs.kill_unsynced()
+            cluster2 = await Cluster.create(cfg, k, fs=fs, data_dir="c")
+            async with cluster2:
+                return await Database(cluster2).get_range(b"", b"\xff")
+        return run_simulation(main(), seed=seed)
+    assert go(11) == go(11)
