@@ -95,7 +95,7 @@ def make_conflict_backend(knobs: Knobs, device=None):
     elif kind == "tpu":
         from .conflict_jax import JaxConflictSet
         cs = JaxConflictSet(knobs.CONFLICT_RING_CAPACITY, knobs.KEY_ENCODE_BYTES,
-                            device=device)
+                            device=device, window=knobs.CONFLICT_WINDOW_SLOTS)
     else:
         raise ValueError(f"unknown RESOLVER_CONFLICT_BACKEND {kind!r}")
     return EncodedConflictBackend(cs, knobs.RESOLVER_BATCH_TXNS,

@@ -82,14 +82,29 @@ def _overlap(ab, ae, bb, be, width):
     return _possibly_lt(ab, be, width) & _possibly_lt(bb, ae, width)
 
 
+def _hist_check(read_begin, read_end, hb, he, hver, snap, width):
+    """reads vs a slab of history records -> conflict [B]."""
+    hit = _overlap(read_begin[:, :, None, :], read_end[:, :, None, :],
+                   hb[None, None, :, :], he[None, None, :, :], width)  # [B,R,S]
+    newer = hver[None, None, :] > snap[:, None, None]
+    return (hit & newer).any(axis=(1, 2))
+
+
 def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
-                 write_end, snap, commit_version, *, width: int = DEFAULT_WIDTH):
+                 write_end, snap, commit_version, *, width: int = DEFAULT_WIDTH,
+                 window: int = 0):
     """One resolve step: (state, batch) -> (state', verdicts[B] int8).
 
     Pure traceable core shared by the single-chip jit (``resolve_step``)
     and the shard_map multi-resolver path (parallel/sharded.py).  Mirrors
     ConflictBatch::addTransaction + detectConflicts
     (REF:fdbserver/SkipList.cpp) for a whole proxy batch at once.
+
+    ``window`` > 0 enables the exact fast path: the ring is chronological,
+    so only entries newer than a transaction's snapshot can conflict, and
+    those live in the last ``window`` slots unless a snapshot predates the
+    entry just outside the window — in which case lax.cond falls back to
+    the full-ring scan.  Verdicts are bit-identical either way.
     """
     C = state.hver.shape[0] - 1
     B, R, L = read_begin.shape
@@ -100,10 +115,31 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
     valid = snap >= 0
 
     # 1. reads vs device history ring -> [B]
-    hit = _overlap(read_begin[:, :, None, :], read_end[:, :, None, :],
-                   hb[None, None, :, :], he[None, None, :, :], width)  # [B,R,C]
-    newer = hver[None, None, :] > snap[:, None, None]
-    hist_conflict = (hit & newer).any(axis=(1, 2))
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and window < C:
+        W = window
+        idx = (state.ptr - W + jnp.arange(W)) % C
+        # newest entry outside the window: everything older in the ring
+        # has version <= this, so snapshots at or above it see every
+        # possible conflict inside the window alone.  Padding (~valid)
+        # and too-old txns get their verdicts regardless of hist_conflict,
+        # so they must not force the slow path.
+        v_edge = state.hver[(state.ptr - W - 1) % C]
+        fast_ok = jnp.all(~valid | too_old | (snap >= v_edge))
+
+        def fast(_):
+            return _hist_check(read_begin, read_end, hb[idx], he[idx],
+                               hver[idx], snap, width)
+
+        def full(_):
+            return _hist_check(read_begin, read_end, hb, he, hver, snap,
+                               width)
+
+        hist_conflict = lax.cond(fast_ok, fast, full, None)
+    else:
+        hist_conflict = _hist_check(read_begin, read_end, hb, he, hver,
+                                    snap, width)
 
     # 2. intra-batch read-vs-write overlap matrix -> [B,B]
     m = _overlap(read_begin[:, :, None, None, :], read_end[:, :, None, None, :],
@@ -141,7 +177,7 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
     return ConflictState(hb2, he2, hver2, ptr2, floor2), verdicts
 
 
-resolve_step = functools.partial(jax.jit, static_argnames=("width",),
+resolve_step = functools.partial(jax.jit, static_argnames=("width", "window"),
                                  donate_argnums=(0,))(resolve_core)
 
 
@@ -161,7 +197,7 @@ class JaxConflictSet:
     """
 
     def __init__(self, capacity: int, width: int = DEFAULT_WIDTH,
-                 oldest_version: int = 0, device=None):
+                 oldest_version: int = 0, device=None, window: int = 4096):
         if not jax.config.jax_enable_x64:
             raise RuntimeError(
                 "JaxConflictSet requires 64-bit versions: set JAX_ENABLE_X64=1 "
@@ -169,6 +205,7 @@ class JaxConflictSet:
         self.capacity = capacity
         self.width = width
         self.device = device
+        self.window = window if 0 < window < capacity else 0
         state = init_state(capacity, width, oldest_version)
         if device is not None:
             state = jax.device_put(state, device)
@@ -188,5 +225,5 @@ class JaxConflictSet:
             self.state, jnp.asarray(eb.read_begin), jnp.asarray(eb.read_end),
             jnp.asarray(eb.write_begin), jnp.asarray(eb.write_end),
             jnp.asarray(eb.read_snapshot), jnp.int64(commit_version),
-            width=self.width)
+            width=self.width, window=self.window)
         return np.asarray(verdicts)

@@ -105,11 +105,14 @@ def _mask_writes_to_partition(wb, we, lo, hi, width):
     return wb2, we2
 
 
-def make_sharded_resolve_step(mesh: Mesh, width: int = DEFAULT_WIDTH):
+def make_sharded_resolve_step(mesh: Mesh, width: int = DEFAULT_WIDTH,
+                              window: int = 0):
     """Build the jitted multi-resolver step for ``mesh`` (axis 'resolvers').
 
     step(state, rb, re, wb, we, snap, commit_version) -> (state', verdicts[B])
-    with state sharded over resolvers and the batch replicated.
+    with state sharded over resolvers and the batch replicated.  ``window``
+    enables each shard's exact fast-path scan (CONFLICT_WINDOW_SLOTS knob),
+    same semantics as the single-chip kernel.
     """
     from jax import shard_map
 
@@ -117,7 +120,8 @@ def make_sharded_resolve_step(mesh: Mesh, width: int = DEFAULT_WIDTH):
         # drop the leading length-1 shard axis inside the mapped body
         st = ConflictState(hb[0], he[0], hver[0], ptr[0], floor[0])
         wbm, wem = _mask_writes_to_partition(wb, we, lo[0], hi[0], width)
-        st2, verdicts = resolve_core(st, rb, re, wbm, wem, snap, cv, width=width)
+        st2, verdicts = resolve_core(st, rb, re, wbm, wem, snap, cv,
+                                     width=width, window=window)
         verdicts = jax.lax.pmax(verdicts, "resolvers")   # combine across partitions
         return (st2.hb[None], st2.he[None], st2.hver[None], st2.ptr[None],
                 st2.floor[None], verdicts)

@@ -87,3 +87,27 @@ def test_requires_x64(monkeypatch):
                 JaxConflictSet(64, W)
         finally:
             jax.config.update("jax_enable_x64", True)
+
+
+@pytest.mark.parametrize("seed,window", [(10, 8), (11, 32), (12, 64)])
+def test_windowed_fast_path_parity(seed, window):
+    """The windowed kernel (fast path + lax.cond fallback) must match the
+    full-scan twin bit-for-bit: old snapshots force the fallback, recent
+    ones ride the window — both paths get exercised here."""
+    rng = DeterministicRandom(seed)
+    capacity = B * R * 4
+    twin = NumpyConflictSet(capacity, W)
+    kern = JaxConflictSet(capacity, W, window=window)
+    assert kern.window == window
+    version = 100
+    for step in range(30):
+        nt = rng.random_int(1, B + 1)
+        # mix: some snapshots far in the past (fallback), some recent
+        lo = 0 if rng.coinflip(0.3) else max(0, version - 30)
+        txns = [rand_txn(rng, lo, version + 1, W) for _ in range(nt)]
+        version += rng.random_int(1, 20)
+        eb = encode_batch(txns, B, R, W)
+        tv = twin.resolve_encoded(eb, version)
+        jv = kern.resolve_encoded(eb, version)
+        np.testing.assert_array_equal(tv, jv, err_msg=f"step {step}")
+        np.testing.assert_array_equal(twin.hver, np.asarray(kern.state.hver)[:capacity])
