@@ -21,6 +21,8 @@ from ..runtime.knobs import KNOBS, Knobs
 from .commit_proxy import CommitProxy
 from .data import KeyRange, Version
 from .grv_proxy import GrvProxy
+from .load_balance import ReplicaGroup
+from .ratekeeper import Ratekeeper
 from .resolver import Resolver
 from .sequencer import Sequencer
 from .shard_map import ShardMap
@@ -32,12 +34,13 @@ from .tlog import TLog
 class ClusterConfig:
     """The role counts `fdbcli configure` would set
     (REF:fdbclient/DatabaseConfiguration.cpp: commit_proxies, grv_proxies,
-    resolvers, logs)."""
+    resolvers, logs, redundancy mode)."""
     commit_proxies: int = 1
     grv_proxies: int = 1
     resolvers: int = 1
     logs: int = 1
-    storage_servers: int = 1
+    storage_servers: int = 1      # number of shards
+    replication: int = 1          # replicas per shard (single/double/triple)
 
 
 class Cluster:
@@ -51,7 +54,12 @@ class Cluster:
         c, k, v0 = self.config, self.knobs, epoch_begin_version
 
         self.sequencer = Sequencer(k, v0)
-        self.shard_map = ShardMap.even(c.storage_servers)
+        # storage team per shard: replica r of shard s has tag s*RF+r
+        # (the keyServers team assignment DataDistribution maintains)
+        rf = max(1, c.replication)
+        team_tags = [[s * rf + r for r in range(rf)]
+                     for s in range(c.storage_servers)]
+        self.shard_map = ShardMap.even(c.storage_servers, team_tags)
         self.tlogs = tlogs if tlogs is not None else [
             TLog(k, v0) for _ in range(c.logs)]
 
@@ -62,14 +70,19 @@ class Cluster:
 
         # storage: tag i lives on tlog i % logs
         self.storage_servers = []
+        self._replica_groups: list[ReplicaGroup] = []
         for rng, tags in self.shard_map.ranges():
+            team = []
             for tag in tags:
                 tlog = self.tlogs[tag % c.logs]
                 engine = (engines or {}).get(tag)
-                self.storage_servers.append(
-                    StorageServer(k, tag, rng, tlog, v0, engine=engine))
+                ss = StorageServer(k, tag, rng, tlog, v0, engine=engine)
+                self.storage_servers.append(ss)
+                team.append(ss)
+            self._replica_groups.append(ReplicaGroup(rng, team))
 
-        self.grv_proxies = [GrvProxy(k, self.sequencer)
+        self.ratekeeper = Ratekeeper(k, self.storage_servers, self.tlogs)
+        self.grv_proxies = [GrvProxy(k, self.sequencer, self.ratekeeper)
                             for _ in range(c.grv_proxies)]
         self.commit_proxies = [CommitProxy(k, self.sequencer, self.resolvers,
                                            self.tlogs, self.shard_map)
@@ -92,9 +105,10 @@ class Cluster:
         tlogs = [await TLog.open(knobs, fs, f"{data_dir}/tlog-{i}.dq")
                  for i in range(config.logs)]
         engines = {}
-        shard_map = ShardMap.even(config.storage_servers)
-        for _rng, tags in shard_map.ranges():
-            for tag in tags:
+        rf = max(1, config.replication)
+        for s in range(config.storage_servers):
+            for r in range(rf):
+                tag = s * rf + r
                 engines[tag] = await MemoryKVStore.open(
                     fs, f"{data_dir}/storage-{tag}")
         epoch = max([t.version for t in tlogs]
@@ -116,9 +130,11 @@ class Cluster:
             ss.start()
         for cp in self.commit_proxies:
             cp.start()
+        self.ratekeeper.start()
         self._started = True
 
     async def stop(self) -> None:
+        await self.ratekeeper.stop()
         for cp in self.commit_proxies:
             await cp.stop()
         for ss in self.storage_servers:
@@ -134,13 +150,16 @@ class Cluster:
 
     # --- client-side location lookup (getKeyLocation analog) ---
 
-    def storage_for_key(self, key: bytes) -> StorageServer:
-        tags = self.shard_map.tags_for_key(key)
-        return self._storage_by_tag(tags[0])
+    def storage_for_key(self, key: bytes) -> ReplicaGroup:
+        return self._replica_groups[self.shard_map.shard_index(key)]
 
-    def storages_for_range(self, begin: bytes, end: bytes) -> list[StorageServer]:
-        return [self._storage_by_tag(t)
-                for t in self.shard_map.tags_for_range(begin, end)]
+    def storages_for_range(self, begin: bytes, end: bytes) -> list[ReplicaGroup]:
+        if begin >= end:
+            return []
+        import bisect as _b
+        lo = self.shard_map.shard_index(begin)
+        hi = _b.bisect_left(self.shard_map.boundaries, end)
+        return self._replica_groups[lo:hi + 1]
 
     def _storage_by_tag(self, tag: int) -> StorageServer:
         for ss in self.storage_servers:

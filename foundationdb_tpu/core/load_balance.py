@@ -1,0 +1,73 @@
+"""Replica selection for reads — loadBalance() over a storage team.
+
+Reference: REF:fdbrpc/LoadBalance.actor.h + QueueModel.h — reads go to
+the replica with the lowest modeled queue (outstanding requests +
+failure penalty); on a retryable failure the next-best replica is tried
+before the error surfaces.  This is what makes replication a read
+scale-out axis (SURVEY.md §2.6) and rides over storage failures without
+client-visible errors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..runtime.errors import FdbError
+from ..runtime.rng import deterministic_random
+from .data import KeyRange
+
+
+class _ReplicaModel:
+    """Per-replica queue model (QueueModel analog)."""
+
+    def __init__(self, storage) -> None:
+        self.storage = storage
+        self.outstanding = 0
+        self.penalty_until = 0.0
+
+    def score(self, now: float) -> tuple[int, int]:
+        return (1 if now < self.penalty_until else 0, self.outstanding)
+
+
+class ReplicaGroup:
+    """Storage-compatible read surface over a replication team."""
+
+    def __init__(self, shard: KeyRange, replicas: list) -> None:
+        self.shard = shard
+        self.tag = replicas[0].tag     # representative (for diagnostics)
+        self._models = [_ReplicaModel(s) for s in replicas]
+
+    @property
+    def replicas(self) -> list:
+        return [m.storage for m in self._models]
+
+    async def _call(self, method: str, *args):
+        now = asyncio.get_running_loop().time()
+        order = sorted(self._models,
+                       key=lambda m: (m.score(now), deterministic_random().random()))
+        last_err: BaseException | None = None
+        for m in order:
+            m.outstanding += 1
+            try:
+                return await getattr(m.storage, method)(*args)
+            except FdbError as e:
+                last_err = e
+                if not e.retryable:
+                    raise
+                # penalize this replica and try the next one
+                m.penalty_until = asyncio.get_running_loop().time() + 1.0
+            finally:
+                m.outstanding -= 1
+        raise last_err  # all replicas failed
+
+    async def get_value(self, key: bytes, version: int):
+        return await self._call("get_value", key, version)
+
+    async def get_key_values(self, begin: bytes, end: bytes, version: int,
+                             limit: int = 0, reverse: bool = False,
+                             byte_limit: int = 0):
+        return await self._call("get_key_values", begin, end, version,
+                                limit, reverse, byte_limit)
+
+    async def watch_value(self, key: bytes, value, version: int):
+        return await self._call("watch_value", key, value, version)

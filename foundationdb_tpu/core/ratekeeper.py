@@ -1,0 +1,100 @@
+"""Ratekeeper — cluster-wide transaction admission control.
+
+Reference: REF:fdbserver/Ratekeeper.actor.cpp — a singleton samples every
+storage server's queue depths (bytes not yet durable, version lag) and
+TLog queues, computes a cluster transaction-rate budget from the worst
+offender, and GRV proxies spend that budget before handing out read
+versions.  The effect: writers slow down *before* storage falls over.
+
+The smoothing/PID subtleties of the reference are reduced to the core
+proportional controller: full rate while queues are under target, then
+linear falloff to a floor as the worst queue approaches its limit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..runtime.knobs import Knobs
+from ..runtime.trace import TraceEvent
+
+
+class Ratekeeper:
+    def __init__(self, knobs: Knobs, storage_servers, tlogs) -> None:
+        self.knobs = knobs
+        self.storage_servers = storage_servers
+        self.tlogs = tlogs
+        self.rate_tps: float = knobs.RATEKEEPER_MAX_TPS
+        self._tokens: float = knobs.RATEKEEPER_MAX_TPS
+        self._last_refill: float | None = None
+        self._task: asyncio.Task | None = None
+        self.limiting_reason = "unlimited"
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self._update_loop(), name="ratekeeper")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    # --- rate computation (REF: updateRate) ---
+
+    async def _update_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.knobs.RATEKEEPER_UPDATE_INTERVAL)
+            self._recompute()
+
+    def _recompute(self) -> None:
+        k = self.knobs
+        worst = 0.0
+        reason = "unlimited"
+        for ss in self.storage_servers:
+            if ss.engine is None:
+                continue    # memory-only: applied == effectively durable
+            queue = ss.bytes_input - ss.bytes_durable
+            frac = queue / k.TARGET_STORAGE_QUEUE_BYTES
+            if frac > worst:
+                worst, reason = frac, f"storage_queue_tag_{ss.tag}"
+            lag = ss.version - ss.durable_version
+            lag_frac = lag / max(1, k.TARGET_DURABILITY_LAG_VERSIONS)
+            if lag_frac > worst:
+                worst, reason = lag_frac, f"durability_lag_tag_{ss.tag}"
+        for i, tl in enumerate(self.tlogs):
+            frac = tl.queue.bytes_used / k.TARGET_TLOG_QUEUE_BYTES \
+                if tl.queue is not None else 0.0
+            if frac > worst:
+                worst, reason = frac, f"tlog_queue_{i}"
+        if worst <= 0.5:
+            rate = k.RATEKEEPER_MAX_TPS
+        else:
+            # linear falloff: 1.0 at 50% of target, floor at 100%
+            scale = max(0.0, min(1.0, 2.0 * (1.0 - worst)))
+            rate = max(k.RATEKEEPER_MIN_TPS, k.RATEKEEPER_MAX_TPS * scale)
+            TraceEvent("RkRateLimited").detail("Reason", reason) \
+                .detail("TPSLimit", round(rate, 1)).log()
+        self.rate_tps = rate
+        self.limiting_reason = reason if rate < k.RATEKEEPER_MAX_TPS else "unlimited"
+
+    # --- admission (spent by GRV proxies) ---
+
+    async def admit(self, n_txns: int) -> None:
+        """Block until the token bucket covers n_txns."""
+        loop = asyncio.get_running_loop()
+        while True:
+            now = loop.time()
+            if self._last_refill is None:
+                self._last_refill = now
+            self._tokens = min(self.rate_tps,
+                               self._tokens + (now - self._last_refill) * self.rate_tps)
+            self._last_refill = now
+            if self._tokens >= n_txns:
+                self._tokens -= n_txns
+                return
+            deficit = n_txns - self._tokens
+            await asyncio.sleep(deficit / max(1.0, self.rate_tps))

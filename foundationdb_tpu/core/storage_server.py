@@ -48,6 +48,7 @@ class StorageServer:
         self._pull_task: asyncio.Task | None = None
         self._durability_task: asyncio.Task | None = None
         self.bytes_input = 0
+        self.bytes_durable = 0    # ratekeeper queue metric
         self.total_reads = 0
 
     # --- lifecycle ---
@@ -125,6 +126,7 @@ class StorageServer:
                 continue
             self._durability_buffer = [(v, op) for v, op in
                                        self._durability_buffer if v > floor]
+            self.bytes_durable += sum(len(p1) + len(p2) for _, p1, p2 in ops)
             self.durable_version = floor
             self.oldest_version = floor
             self.vmap.drop_before(floor)     # engine is authoritative <= floor

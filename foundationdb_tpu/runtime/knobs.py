@@ -64,6 +64,10 @@ class Knobs:
     # --- ratekeeper ---
     RATEKEEPER_UPDATE_INTERVAL: float = 0.25
     TARGET_STORAGE_QUEUE_BYTES: int = 1 << 30
+    TARGET_TLOG_QUEUE_BYTES: int = 1 << 31
+    TARGET_DURABILITY_LAG_VERSIONS: int = 20_000_000  # 4x the MVCC window: steady-state lag == window is healthy
+    RATEKEEPER_MAX_TPS: float = 1e6
+    RATEKEEPER_MIN_TPS: float = 10.0
 
     # --- simulation ---
     SIM_NETWORK_MIN_DELAY: float = 0.0005

@@ -1,0 +1,153 @@
+"""Replication, load-balanced reads, and ratekeeper admission."""
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.client import Database
+from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+from foundationdb_tpu.core.data import KeyRange
+from foundationdb_tpu.core.load_balance import ReplicaGroup
+from foundationdb_tpu.core.ratekeeper import Ratekeeper
+from foundationdb_tpu.runtime.errors import ConnectionFailed
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+def sim(coro_fn, seed=0, config=None, knobs=None):
+    async def main():
+        async with Cluster(config or ClusterConfig(),
+                           knobs or Knobs()) as cluster:
+            return await coro_fn(Database(cluster))
+    return run_simulation(main(), seed=seed)
+
+
+def test_double_replication_reads_and_consistency():
+    cfg = ClusterConfig(storage_servers=2, replication=2, logs=2)
+
+    async def body(db):
+        for i in range(30):
+            await db.set(b"k%02d" % i, b"v%d" % i)
+        rows = await db.get_range(b"", b"\xff")
+        assert len(rows) == 30
+        # every replica of every shard applied identical data
+        cluster = db.cluster
+        for group in cluster._replica_groups:
+            replicas = group.replicas
+            assert len(replicas) == 2
+            datas = []
+            for ss in replicas:
+                kvs, _ = await ss.get_key_values(
+                    ss.shard.begin, ss.shard.end, ss.version)
+                datas.append(kvs)
+            assert datas[0] == datas[1], "replicas diverged"
+        # reads were spread across replicas, not pinned to one
+        reads = [ss.total_reads for ss in cluster.storage_servers]
+        assert sum(1 for r in reads if r > 0) >= 3
+    sim(body, config=cfg)
+
+
+def test_load_balance_fails_over():
+    class FlakyStorage:
+        def __init__(self, tag, fail):
+            self.tag = tag
+            self.fail = fail
+            self.calls = 0
+
+        async def get_value(self, key, version):
+            self.calls += 1
+            if self.fail:
+                raise ConnectionFailed()
+            return b"ok"
+
+    async def main():
+        good = FlakyStorage(0, fail=False)
+        bad = FlakyStorage(1, fail=True)
+        group = ReplicaGroup(KeyRange(b"", b"\xff"), [bad, good])
+        # every read succeeds despite one dead replica
+        for _ in range(10):
+            assert await group.get_value(b"k", 1) == b"ok"
+        assert good.calls >= 10
+        # after the first failure the dead replica is penalized, so it is
+        # not hammered on every request
+        assert bad.calls < 10
+    run_simulation(main(), seed=2)
+
+
+def test_load_balance_nonretryable_propagates():
+    from foundationdb_tpu.runtime.errors import TransactionTooOld
+
+    class OldStorage:
+        tag = 0
+
+        async def get_value(self, key, version):
+            raise TransactionTooOld()
+
+    async def main():
+        group = ReplicaGroup(KeyRange(b"", b"\xff"), [OldStorage()])
+        with pytest.raises(TransactionTooOld):
+            await group.get_value(b"k", 1)
+    run_simulation(main())
+
+
+def test_ratekeeper_throttles_on_queue():
+    class FakeSS:
+        def __init__(self):
+            self.tag = 0
+            self.engine = object()
+            self.bytes_input = 10_000
+            self.bytes_durable = 0
+            self.version = 0
+            self.durable_version = 0
+
+    async def main():
+        k = Knobs().override(TARGET_STORAGE_QUEUE_BYTES=10_000,
+                             RATEKEEPER_MAX_TPS=1000.0,
+                             RATEKEEPER_MIN_TPS=5.0)
+        rk = Ratekeeper(k, [FakeSS()], [])
+        rk._recompute()
+        # queue at 100% of target: rate pinned to the floor
+        assert rk.rate_tps == 5.0
+        assert "storage_queue" in rk.limiting_reason
+        # admission now takes real (virtual) time
+        t0 = asyncio.get_running_loop().time()
+        await rk.admit(50)
+        await rk.admit(50)
+        assert asyncio.get_running_loop().time() - t0 >= 50 / 5.0
+    run_simulation(main())
+
+
+def test_ratekeeper_full_rate_when_healthy():
+    class HealthySS:
+        tag = 0
+        engine = None
+
+    async def main():
+        k = Knobs()
+        rk = Ratekeeper(k, [HealthySS()], [])
+        rk._recompute()
+        assert rk.rate_tps == k.RATEKEEPER_MAX_TPS
+        assert rk.limiting_reason == "unlimited"
+    run_simulation(main())
+
+
+def test_replicated_durable_restart():
+    from foundationdb_tpu.runtime.files import SimFileSystem
+
+    async def main():
+        fs = SimFileSystem()
+        cfg = ClusterConfig(storage_servers=2, replication=2, logs=2)
+        k = Knobs().override(STORAGE_VERSION_WINDOW=50_000,
+                             STORAGE_DURABILITY_LAG=0.05)
+        cluster = await Cluster.create(cfg, k, fs=fs, data_dir="r")
+        async with cluster:
+            db = Database(cluster)
+            for i in range(12):
+                await db.set(b"k%02d" % i, b"v")
+            await asyncio.sleep(1.0)
+        fs.kill_unsynced()
+        cluster2 = await Cluster.create(cfg, k, fs=fs, data_dir="r")
+        async with cluster2:
+            rows = await Database(cluster2).get_range(b"", b"\xff")
+            assert len(rows) == 12
+    run_simulation(main(), seed=9)
