@@ -26,6 +26,7 @@ class Ratekeeper:
         self.tlogs = tlogs
         self.rate_tps: float = knobs.RATEKEEPER_MAX_TPS
         self._tokens: float = knobs.RATEKEEPER_MAX_TPS
+        self._admit_lock: asyncio.Lock | None = None
         self._last_refill: float | None = None
         self._task: asyncio.Task | None = None
         self.limiting_reason = "unlimited"
@@ -84,17 +85,44 @@ class Ratekeeper:
     # --- admission (spent by GRV proxies) ---
 
     async def admit(self, n_txns: int) -> None:
-        """Block until the token bucket covers n_txns."""
+        """Block until the token bucket covers n_txns.
+
+        Admission is in installments: a batch larger than one second's rate
+        budget drains whatever tokens exist and sleeps for the remainder,
+        rather than waiting for the bucket (capped at rate_tps) to cover the
+        whole batch at once — which would never happen for
+        n_txns > rate_tps and wedge every GRV proxy behind it.
+
+        The lock makes admission FIFO across GRV proxies sharing this
+        Ratekeeper: without it, a stream of small batches could drain every
+        refill before a sleeping large batch wakes, starving it forever.
+        Tokens consumed by a batch that is cancelled mid-admission are
+        refunded.
+        """
+        if self._admit_lock is None:
+            self._admit_lock = asyncio.Lock()
         loop = asyncio.get_running_loop()
-        while True:
-            now = loop.time()
-            if self._last_refill is None:
-                self._last_refill = now
-            self._tokens = min(self.rate_tps,
-                               self._tokens + (now - self._last_refill) * self.rate_tps)
-            self._last_refill = now
-            if self._tokens >= n_txns:
-                self._tokens -= n_txns
-                return
-            deficit = n_txns - self._tokens
-            await asyncio.sleep(deficit / max(1.0, self.rate_tps))
+        remaining = float(n_txns)
+        async with self._admit_lock:
+            try:
+                while True:
+                    now = loop.time()
+                    if self._last_refill is None:
+                        self._last_refill = now
+                    cap = max(self.rate_tps, 1.0)
+                    self._tokens = min(
+                        cap, self._tokens + (now - self._last_refill) * self.rate_tps)
+                    self._last_refill = now
+                    take = min(self._tokens, remaining)
+                    self._tokens -= take
+                    remaining -= take
+                    if remaining <= 1e-9:
+                        return
+                    # Sleep only long enough to earn one bucket-cap of
+                    # tokens — sleeping for the full remainder would let the
+                    # cap clip most of the refill and stretch admission
+                    # quadratically.
+                    await asyncio.sleep(min(cap, remaining) / cap)
+            except asyncio.CancelledError:
+                self._tokens += float(n_txns) - remaining
+                raise
