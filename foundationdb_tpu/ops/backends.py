@@ -24,11 +24,32 @@ Shape discipline for the encoded backends:
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
+
 import numpy as np
 
 from ..runtime.knobs import Knobs
 from . import keycode
 from .batch import EncodedBatch, TxnRequest
+
+
+async def _completed(value):
+    return value
+
+
+def resolve_begin(backend, txns: list[TxnRequest], commit_version: int):
+    """Split-phase resolve over any backend: submit now, sync later.
+
+    Returns an awaitable yielding the verdict list.  Backends with a
+    ``resolve_begin`` method (the encoded/TPU path) pipeline: device state
+    is updated at submit time, so the caller may hand the version chain to
+    the next batch before awaiting verdicts.  Plain CPU backends resolve
+    synchronously and return a pre-completed awaitable."""
+    begin = getattr(backend, "resolve_begin", None)
+    if begin is not None:
+        return begin(txns, commit_version)
+    return _completed(backend.resolve(txns, commit_version))
 
 
 def coalesce_ranges(ranges: list[tuple[bytes, bytes]], max_n: int) -> list[tuple[bytes, bytes]]:
@@ -61,19 +82,57 @@ class EncodedConflictBackend:
         self.B = batch_txns
         self.R = ranges_per_txn
         self.width = width
+        self._sync_pool: concurrent.futures.ThreadPoolExecutor | None = None
 
-    def resolve(self, txns: list[TxnRequest], commit_version: int) -> list[int]:
+    def _submit_chunks(self, txns: list[TxnRequest], commit_version: int):
+        """Encode + dispatch every chunk; returns [(n_txns, verdicts)] where
+        verdicts is a device array (jax cs) or host ndarray (numpy cs)."""
         from .batch import encode_batch
-        out: list[int] = []
+        submit = getattr(self.cs, "resolve_encoded_submit", self.cs.resolve_encoded)
+        pending = []
         for start in range(0, len(txns), self.B):
             chunk = txns[start:start + self.B]
             chunk = [TxnRequest(coalesce_ranges(t.read_ranges, self.R),
                                 coalesce_ranges(t.write_ranges, self.R),
                                 t.read_snapshot) for t in chunk]
             eb = encode_batch(chunk, self.B, self.R, self.width)
-            v = self.cs.resolve_encoded(eb, commit_version)
-            out.extend(int(x) for x in v[:len(chunk)])
+            pending.append((len(chunk), submit(eb, commit_version)))
+        return pending
+
+    def resolve(self, txns: list[TxnRequest], commit_version: int) -> list[int]:
+        out: list[int] = []
+        for n, v in self._submit_chunks(txns, commit_version):
+            out.extend(int(x) for x in np.asarray(v)[:n])
         return out
+
+    def resolve_begin(self, txns: list[TxnRequest], commit_version: int):
+        """Submit the whole batch to the conflict set now (state is updated
+        before this returns) and hand back an awaitable that syncs the
+        verdicts.  On a real event loop the sync runs in a dedicated
+        single thread so device waits never block the loop; under the
+        virtual-time simulator (where executors are forbidden and the
+        backend is CPU-deterministic anyway) it syncs inline."""
+        pending = self._submit_chunks(txns, commit_version)
+
+        async def finish() -> list[int]:
+            from ..runtime.simloop import SimEventLoop
+            loop = asyncio.get_running_loop()
+            out: list[int] = []
+            for n, v in pending:
+                if isinstance(v, np.ndarray) or isinstance(loop, SimEventLoop):
+                    # Already host data (numpy backend), or under the
+                    # virtual-time simulator where executors are forbidden
+                    # and the device is host CPU anyway: sync inline.
+                    host = np.asarray(v)
+                else:
+                    if self._sync_pool is None:
+                        self._sync_pool = concurrent.futures.ThreadPoolExecutor(
+                            max_workers=1, thread_name_prefix="resolver-sync")
+                    host = await loop.run_in_executor(self._sync_pool, np.asarray, v)
+                out.extend(int(x) for x in host[:n])
+            return out
+
+        return finish()
 
     def set_oldest_version(self, v: int) -> None:
         self.cs.set_oldest_version(v)

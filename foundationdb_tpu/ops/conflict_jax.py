@@ -218,7 +218,13 @@ class JaxConflictSet:
     def oldest_version(self) -> int:
         return int(self.state.floor)
 
-    def resolve_encoded(self, eb: EncodedBatch, commit_version: int) -> np.ndarray:
+    def resolve_encoded_submit(self, eb: EncodedBatch, commit_version: int) -> jax.Array:
+        """Dispatch one resolve to the device and return the (not yet
+        synced) verdict array.  JAX dispatch is asynchronous, so this
+        returns in microseconds; ``self.state`` is already the post-batch
+        state object, so the next batch can be submitted immediately —
+        the device pipeline serializes them.  Call ``np.asarray`` on the
+        returned array (ideally off the event loop) to sync verdicts."""
         if eb.read_begin.shape[0] * eb.read_begin.shape[1] > self.capacity:
             raise ValueError("batch write slots exceed ring capacity")
         self.state, verdicts = resolve_step(
@@ -226,4 +232,7 @@ class JaxConflictSet:
             jnp.asarray(eb.write_begin), jnp.asarray(eb.write_end),
             jnp.asarray(eb.read_snapshot), jnp.int64(commit_version),
             width=self.width, window=self.window)
-        return np.asarray(verdicts)
+        return verdicts
+
+    def resolve_encoded(self, eb: EncodedBatch, commit_version: int) -> np.ndarray:
+        return np.asarray(self.resolve_encoded_submit(eb, commit_version))

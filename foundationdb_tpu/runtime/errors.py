@@ -80,6 +80,9 @@ RequestMaybeDelivered = _err(1213, "request_maybe_delivered",
 # resolver-internal (ours; no upstream equivalent needed on the wire)
 ResolverCapacityExceeded = _err(2900, "resolver_capacity_exceeded",
                                 "Conflict-set history ring overflowed; txn forced too-old")
+ResolverFailed = _err(2901, "resolver_failed",
+                      "Resolver backend failed after history mutation; "
+                      "role is fail-stopped pending recovery")
 
 # 1213 is retryable for idempotent operations (reads, GRV); the commit
 # path converts it to commit_unknown_result (1021) before the client's

@@ -1,0 +1,173 @@
+"""Split-phase (non-blocking) resolver: the TPU backend must not stall the
+event loop, and consecutive batches must pipeline (batch N+1 submits to the
+device while batch N's verdicts are still syncing back).
+
+VERDICT r1 weak #3 / SURVEY §7 hard part 3: the resolver sits on the commit
+critical path; a synchronous device sync per batch would stall every
+coroutine in the process.  These tests run the ``tpu`` backend on the CPU
+device stand-in under a *real* asyncio loop (executor threads are the
+production path; the virtual-time simulator syncs inline instead).
+"""
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.core.resolver import ResolveBatchRequest, Resolver
+from foundationdb_tpu.ops.batch import TxnRequest
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+def _knobs(backend):
+    return Knobs().override(RESOLVER_CONFLICT_BACKEND=backend,
+                            CONFLICT_RING_CAPACITY=4096)
+
+
+def _batches(n_batches, txns_per_batch):
+    """Deterministic batch stream with genuine conflicts."""
+    out = []
+    ver = 0
+    for b in range(n_batches):
+        txns = []
+        for t in range(txns_per_batch):
+            key = b"k%03d" % ((b + t) % 10)
+            txns.append(TxnRequest(
+                read_ranges=[(key, key + b"\x00")],
+                write_ranges=[(key, key + b"\x00")],
+                read_snapshot=max(0, ver - 2_000_000)))
+        prev, ver = ver, ver + 1_000_000
+        out.append(ResolveBatchRequest(prev_version=prev, version=ver, txns=txns))
+    return out
+
+
+def _run_real_loop(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_tpu_backend_parity_on_real_loop():
+    """Same verdicts from the split-phase tpu path and the sync numpy twin."""
+    reqs = _batches(6, 8)
+
+    async def run(backend):
+        r = Resolver(_knobs(backend))
+        return [(await r.resolve(req)).verdicts for req in reqs]
+
+    got_tpu = _run_real_loop(run("tpu"))
+    got_np = _run_real_loop(run("numpy"))
+    assert got_tpu == got_np
+    # batches genuinely contain conflicts, or this test proves nothing
+    assert any(v != 0 for batch in got_np for v in batch)
+
+
+def test_event_loop_live_during_resolve():
+    """Other coroutines make progress while a batch resolves on device."""
+    reqs = _batches(4, 16)
+
+    async def run():
+        r = Resolver(_knobs("tpu"))
+        ticks = 0
+        stop = False
+
+        async def ticker():
+            nonlocal ticks
+            while not stop:
+                ticks += 1
+                await asyncio.sleep(0)
+
+        t = asyncio.ensure_future(ticker())
+        await asyncio.sleep(0)          # let the ticker start
+        before = ticks
+        for req in reqs:
+            await r.resolve(req)
+        during = ticks - before
+        stop = True
+        await t
+        return during
+
+    # every resolve awaits the executor sync, yielding the loop at least
+    # once per batch — a blocking resolver would leave the ticker frozen
+    assert _run_real_loop(run()) >= len(reqs)
+
+
+def test_batches_pipeline_submit_before_prior_finish():
+    """Batch N+1 must be submitted before batch N's verdict sync returns."""
+    reqs = _batches(3, 8)
+    events = []
+
+    async def run():
+        r = Resolver(_knobs("tpu"))
+        orig_begin = r.backend.resolve_begin
+
+        def logged_begin(txns, version):
+            events.append(("submit", version))
+            fin = orig_begin(txns, version)
+
+            async def wrapped():
+                out = await fin
+                events.append(("finish", version))
+                return out
+
+            return wrapped()
+
+        r.backend.resolve_begin = logged_begin
+        await asyncio.gather(*(r.resolve(req) for req in reqs))
+
+    _run_real_loop(run())
+    order = {e: i for i, e in enumerate(events)}
+    v1, v2, v3 = (r.version for r in reqs)
+    # submits happen in version order (serial history contract)...
+    assert order[("submit", v1)] < order[("submit", v2)] < order[("submit", v3)]
+    # ...and each later submit precedes the earlier batch's host sync
+    assert order[("submit", v2)] < order[("finish", v1)]
+    assert order[("submit", v3)] < order[("finish", v2)]
+
+
+def test_resolver_fail_stops_after_sync_failure():
+    """If verdict sync fails after the chain advanced, the resolver must
+    fail-stop — its history may hold the failed batch's writes, so serving
+    more verdicts would be unsound."""
+    from foundationdb_tpu.runtime.errors import ResolverFailed
+
+    reqs = _batches(3, 4)
+
+    async def run():
+        r = Resolver(_knobs("tpu"))
+        await r.resolve(reqs[0])
+
+        async def boom():
+            raise RuntimeError("device lost")
+
+        orig = r.backend.resolve_begin
+        r.backend.resolve_begin = lambda txns, v: boom()
+        with pytest.raises(RuntimeError):
+            await r.resolve(reqs[1])
+        r.backend.resolve_begin = orig
+        with pytest.raises(ResolverFailed):
+            await r.resolve(reqs[2])
+
+    _run_real_loop(run())
+
+
+def test_split_phase_under_simulation():
+    """The sim loop forbids executors; the split-phase path must sync inline
+    and stay deterministic."""
+    reqs = _batches(5, 8)
+
+    async def main():
+        r = Resolver(_knobs("tpu"))
+        return [(await r.resolve(req)).verdicts for req in reqs]
+
+    a = run_simulation(main(), seed=7)
+    b = run_simulation(main(), seed=7)
+    assert a == b
+
+    async def main_np():
+        r = Resolver(_knobs("numpy"))
+        return [(await r.resolve(req)).verdicts for req in reqs]
+
+    assert run_simulation(main_np(), seed=7) == a
