@@ -9,25 +9,92 @@ window, per BASELINE.md's measurement notes.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+and ALWAYS exits 0 with that line present unless verdict parity fails —
+a wedged TPU tunnel degrades to a CPU-twin measurement with
+``backend_used: "cpu"`` and the error recorded, never to a crash.
 
-vs_baseline = TPU-backend commits/sec ÷ C++ sorted-structure baseline
-commits/sec, measured in the same process on identical batches.  Abort-
-rate parity between backends is asserted (verdicts must be identical:
-32-byte keys make the encoded kernel exact).
+TPU access protocol (the tunnel wedges for many minutes if any client is
+killed mid-operation): a detached child process (bench/tpu_probe.py)
+proves the tunnel alive first; this process only initializes the axon
+backend after the probe reports ok.  The probe is never killed — if the
+tunnel is wedged it blocks harmlessly forever while we fall back to CPU.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.abspath(__file__))
+PROBE_DIR = os.path.join(REPO, ".probe")
+
+
+# --------------------------------------------------------------------------
+# TPU tunnel probing
+
+
+def read_status(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def probe_tpu(wait_s: float, quiet: bool) -> tuple[bool, str]:
+    """Return (tpu_ok, detail).  Spawns a detached probe child writing to a
+    status file unique to this spawn (an older never-killed probe must not
+    overwrite ours) and polls it for up to wait_s.  A fresh ok from any
+    previous probe generation is reused without touching the tunnel again."""
+    import glob
+
+    os.makedirs(PROBE_DIR, exist_ok=True)
+    for path in sorted(glob.glob(os.path.join(PROBE_DIR, "bench_tpu_status.*.json")),
+                       reverse=True):
+        st = read_status(path)
+        if st and st.get("state") == "ok" and time.time() - st.get("ts", 0) < 600:
+            return True, "reused fresh probe result"
+
+    status_path = os.path.join(
+        PROBE_DIR, f"bench_tpu_status.{os.getpid()}.{int(time.time() * 1e3)}.json")
+    with open(os.path.join(PROBE_DIR, "bench_tpu_probe.log"), "ab") as log:
+        subprocess.Popen(
+            [sys.executable, "-m", "foundationdb_tpu.bench.tpu_probe",
+             "--out", status_path],
+            cwd=REPO, stdout=log, stderr=log,
+            start_new_session=True)      # detached: never killed, may outlive us
+    deadline = time.time() + wait_s
+    last_state = "no-status"
+    while time.time() < deadline:
+        st = read_status(status_path)
+        if st:
+            last_state = st.get("state", "?")
+            if last_state == "ok":
+                return True, f"probe ok (init {st.get('init_s', 0):.1f}s)"
+            if last_state in ("error", "cpu-only"):
+                return False, f"probe {last_state}: {st.get('error', '')}"
+        if not quiet:
+            print(f"[bench] waiting for TPU probe ({last_state}), "
+                  f"{deadline - time.time():.0f}s left", file=sys.stderr)
+        time.sleep(5.0)
+    return False, f"probe timed out after {wait_s:.0f}s in state {last_state!r}"
+
+
+# --------------------------------------------------------------------------
+# measurement
+
 
 def measure_backend(backend, batches, versions):
-    """Resolve every batch; returns (elapsed_s, verdict list, per-batch seconds)."""
+    """Resolve every batch serially; (elapsed_s, verdicts, per-batch seconds).
+    This is the honest per-batch commit-latency comparison: each verdict is
+    synced to the host before the next batch starts, as a lone resolver on
+    the commit critical path would behave with no pipelining."""
     lat = []
     verdicts = []
     t0 = time.perf_counter()
@@ -38,15 +105,34 @@ def measure_backend(backend, batches, versions):
     return time.perf_counter() - t0, verdicts, lat
 
 
-def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool) -> dict:
+def measure_pipelined(backend, batches, versions):
+    """Submit every batch back-to-back (split-phase), sync at the end —
+    the device-pipelined throughput the async resolver achieves when the
+    proxy keeps it fed.  Falls back to sync resolve for CPU backends."""
+    import asyncio
+
+    from foundationdb_tpu.ops.backends import resolve_begin
+
+    async def run():
+        pending = [resolve_begin(backend, txns, v)
+                   for txns, v in zip(batches, versions)]
+        return [await p for p in pending]
+
+    t0 = time.perf_counter()
+    verdicts = asyncio.run(run())
+    return time.perf_counter() - t0, verdicts
+
+
+def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
+        tpu_device) -> dict:
     from foundationdb_tpu.bench.workload import MakoWorkload
     from foundationdb_tpu.ops.backends import make_conflict_backend
     from foundationdb_tpu.runtime import Knobs
 
     wl = MakoWorkload(n_keys=n_keys, seed=42)
     batches, versions = wl.make_batches(n_batches, batch_size)
-    warm_batches, warm_versions = wl.make_batches(8, batch_size,
-                                                  start_version=versions[-1] + 10_000_000)
+    warm_batches, warm_versions = wl.make_batches(
+        8, batch_size, start_version=versions[-1] + 10_000_000)
 
     knobs = Knobs().override(
         RESOLVER_BATCH_TXNS=batch_size,
@@ -58,19 +144,23 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool) -> dict:
     results = {}
     all_verdicts = {}
     for kind in ("cpp", "tpu"):
-        backend = make_conflict_backend(knobs.override(RESOLVER_CONFLICT_BACKEND=kind))
-        # warmup on separate high-version batches (compiles the kernel;
-        # their writes land at far-future versions, but all measured
-        # snapshots are far below, so verdict effects are nil for cpp and
-        # identical-shape for tpu ring)  -- then measure
+        device = tpu_device if kind == "tpu" else None
+        backend = make_conflict_backend(
+            knobs.override(RESOLVER_CONFLICT_BACKEND=kind), device=device)
+        # warmup on separate high-version batches (compiles the kernel)
         for txns, v in zip(warm_batches, warm_versions):
             backend.resolve(txns, v)
         # fresh backend for the measured run so state matches across kinds
-        backend = make_conflict_backend(knobs.override(RESOLVER_CONFLICT_BACKEND=kind))
+        backend = make_conflict_backend(
+            knobs.override(RESOLVER_CONFLICT_BACKEND=kind), device=device)
         elapsed, verdicts, lat = measure_backend(backend, batches, versions)
         flat = np.array([x for vs in verdicts for x in vs])
         committed = int((flat == 0).sum())
         total = len(flat)
+        backend2 = make_conflict_backend(
+            knobs.override(RESOLVER_CONFLICT_BACKEND=kind), device=device)
+        pipe_elapsed, pipe_verdicts = measure_pipelined(backend2, batches, versions)
+        pipe_flat = np.array([x for vs in pipe_verdicts for x in vs])
         results[kind] = {
             "commits_per_sec": committed / elapsed,
             "txns_per_sec": total / elapsed,
@@ -78,6 +168,8 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool) -> dict:
             "p50_batch_ms": float(np.percentile(lat, 50) * 1e3),
             "p99_batch_ms": float(np.percentile(lat, 99) * 1e3),
             "elapsed_s": elapsed,
+            "pipelined_txns_per_sec": total / pipe_elapsed,
+            "pipelined_matches_serial": bool((pipe_flat == flat).all()),
         }
         all_verdicts[kind] = flat
         if not quiet:
@@ -87,44 +179,105 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool) -> dict:
     mism = int((all_verdicts["cpp"] != all_verdicts["tpu"]).sum())
     parity = mism == 0
 
-    out = {
-        "metric": "resolver_commits_per_sec (mako 50/50 zipf0.99 batch=64, tpu kernel)",
-        "value": round(results["tpu"]["commits_per_sec"], 1),
-        "unit": "commits/s",
-        "vs_baseline": round(results["tpu"]["commits_per_sec"]
-                             / results["cpp"]["commits_per_sec"], 3),
-        "baseline_cpp_commits_per_sec": round(results["cpp"]["commits_per_sec"], 1),
-        "abort_rate": round(results["tpu"]["abort_rate"], 4),
-        "p99_batch_ms_tpu": round(results["tpu"]["p99_batch_ms"], 3),
-        "p99_batch_ms_cpp": round(results["cpp"]["p99_batch_ms"], 3),
-        "verdict_parity": parity,
-        "verdict_mismatches": mism,
+    return {
+        "results": results,
+        "parity": parity,
+        "mismatches": mism,
     }
-    return out
 
 
-def main():
+def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, default=300)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--keys", type=int, default=1_000_000)
     ap.add_argument("--quick", action="store_true", help="small fast run (CI)")
+    ap.add_argument("--cpu", action="store_true", help="skip the TPU probe")
+    ap.add_argument("--tpu-wait", type=float,
+                    default=float(os.environ.get("BENCH_TPU_WAIT", "360")),
+                    help="max seconds to wait for the TPU tunnel probe")
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args()
     if args.quick:
         args.batches, args.keys = 40, 100_000
 
-    import jax
-    jax.config.update("jax_enable_x64", True)
+    backend_used = "cpu"
+    tpu_detail = "skipped (--cpu)"
+    if not args.cpu:
+        tpu_ok, tpu_detail = probe_tpu(args.tpu_wait, args.quiet)
+        backend_used = "tpu" if tpu_ok else "cpu"
+    if not args.quiet:
+        print(f"[bench] backend_used={backend_used}: {tpu_detail}", file=sys.stderr)
 
-    out = run(args.batches, args.batch_size, args.keys, args.quiet)
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    tpu_device = None
+    if backend_used == "tpu":
+        try:
+            devs = jax.devices()
+            if devs[0].platform == "cpu":
+                backend_used, tpu_detail = "cpu", "jax.devices() returned cpu only"
+            else:
+                tpu_device = devs[0]
+        except Exception as e:  # noqa: BLE001 — degrade, never crash
+            backend_used, tpu_detail = "cpu", f"in-process init failed: {e!r}"
+    if backend_used == "cpu":
+        # pin to host CPU before any in-process backend init; the axon
+        # site hook overrides the JAX_PLATFORMS env var, so this config
+        # call is the only reliable way to keep off the (possibly wedged)
+        # tunnel
+        jax.config.update("jax_platforms", "cpu")
+
+    out = {
+        "metric": "resolver_commits_per_sec (mako 50/50 zipf0.99 batch=64, "
+                  "tpu kernel)",
+        "value": 0.0,
+        "unit": "commits/s",
+        "vs_baseline": 0.0,
+        "backend_used": backend_used,
+        "tpu_detail": tpu_detail,
+    }
+    rc = 0
+    try:
+        r = run(args.batches, args.batch_size, args.keys, args.quiet, tpu_device)
+        res = r["results"]
+        out.update({
+            "value": round(res["tpu"]["commits_per_sec"], 1),
+            "vs_baseline": round(res["tpu"]["commits_per_sec"]
+                                 / res["cpp"]["commits_per_sec"], 3),
+            "baseline_cpp_commits_per_sec": round(res["cpp"]["commits_per_sec"], 1),
+            "abort_rate": round(res["tpu"]["abort_rate"], 4),
+            "p99_batch_ms_tpu": round(res["tpu"]["p99_batch_ms"], 3),
+            "p99_batch_ms_cpp": round(res["cpp"]["p99_batch_ms"], 3),
+            "pipelined_txns_per_sec_tpu": round(res["tpu"]["pipelined_txns_per_sec"], 1),
+            "pipelined_txns_per_sec_cpp": round(res["cpp"]["pipelined_txns_per_sec"], 1),
+            "pipelined_verdicts_match": res["tpu"]["pipelined_matches_serial"]
+            and res["cpp"]["pipelined_matches_serial"],
+            "verdict_parity": r["parity"],
+            "verdict_mismatches": r["mismatches"],
+        })
+        if not r["parity"]:
+            # a kernel that disagrees with the exact CPU baseline must fail
+            # the bench, not just annotate the metric
+            print("FATAL: verdict parity violated between cpp and tpu backends",
+                  file=sys.stderr)
+            rc = 1
+        if not out["pipelined_verdicts_match"]:
+            print("FATAL: split-phase pipelined verdicts diverge from serial",
+                  file=sys.stderr)
+            rc = 1
+    except Exception as e:  # noqa: BLE001 — the JSON line must still appear
+        out["error"] = repr(e)[:800]
+        import traceback
+
+        traceback.print_exc()
     print(json.dumps(out))
-    if not out["verdict_parity"]:
-        # correctness gate: a kernel that disagrees with the exact CPU
-        # baseline must fail the bench, not just annotate the metric
-        print("FATAL: verdict parity violated between cpp and tpu backends",
-              file=sys.stderr)
-        sys.exit(1)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # hard-exit: a daemon/probe thread blocked in tunnel init must not
+    # stall interpreter shutdown past the emitted result
+    os._exit(rc)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,65 @@
+"""Detached TPU-tunnel probe.
+
+The axon TPU platform is reached through a tunnel that can wedge for many
+minutes if any client was ever killed mid-operation.  bench.py therefore
+never initializes the TPU backend in-process until a *disposable* child —
+this module — has proven the tunnel alive by writing ``{"state": "ok"}``
+to the status file.  The child is started detached and is never killed:
+if the tunnel is wedged the child simply blocks forever, harmlessly,
+while the parent gives up waiting and falls back to CPU.
+
+Run: ``python -m foundationdb_tpu.bench.tpu_probe --out STATUS.json``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def write_status(path: str, d: dict) -> None:
+    d = dict(d, ts=time.time(), pid=os.getpid())
+    with open(path + ".tmp", "w") as f:
+        json.dump(d, f)
+    os.replace(path + ".tmp", path)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    write_status(args.out, {"state": "starting"})
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        jax.config.update("jax_enable_x64", True)
+        t0 = time.time()
+        devs = jax.devices()          # axon platform per environment default
+        write_status(args.out, {"state": "devices",
+                                "devices": [str(d) for d in devs],
+                                "init_s": time.time() - t0})
+        if devs[0].platform == "cpu":
+            write_status(args.out, {"state": "cpu-only",
+                                    "devices": [str(d) for d in devs]})
+            return 0
+        t1 = time.time()
+        x = jnp.ones((128, 128), dtype=jnp.bfloat16)
+        y = (x @ x).block_until_ready()
+        write_status(args.out, {"state": "ok",
+                                "platform": devs[0].platform,
+                                "device": str(devs[0]),
+                                "init_s": t1 - t0,
+                                "matmul_s": time.time() - t1,
+                                "result_00": float(y[0, 0])})
+        return 0
+    except Exception as e:            # noqa: BLE001 — status file is the contract
+        write_status(args.out, {"state": "error", "error": repr(e)[:800]})
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

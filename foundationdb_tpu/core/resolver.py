@@ -66,34 +66,52 @@ class Resolver:
                 if not fut.done():
                     fut.set_result(None)
 
+    def _poison(self, e: BaseException) -> None:
+        """Fail-stop: conflict history may be partially mutated, so no
+        further verdicts can be trusted.  Every later resolve raises, and
+        batches already parked waiting for the version chain are woken with
+        the error instead of hanging forever.  Recovery replaces the
+        resolver, exactly as the reference kills the role process."""
+        self._poisoned = e
+        waiters = self._version_waiters
+        self._version_waiters = {}
+        for futs in waiters.values():
+            for fut in futs:
+                if not fut.done():
+                    fut.set_exception(ResolverFailed())
+
     async def resolve(self, req: ResolveBatchRequest) -> ResolveBatchReply:
         if self._poisoned is not None:
             raise ResolverFailed() from self._poisoned
         await self._wait_for_version(req.prev_version)
-        # Split-phase resolve: the submit updates conflict history (on
-        # device for the tpu backend, via async dispatch) before returning,
-        # so the version chain can advance and batch N+1 can submit while
-        # batch N's verdicts are still syncing back to the host.  This is
-        # what keeps the device busy instead of blocking the event loop
-        # per batch (SURVEY §7 hard part 3: the latency budget).
-        finish = resolve_begin(self.backend, req.txns, req.version)
-        # slide the history window: writes older than the txn-life window
-        # can no longer conflict with any admissible snapshot
-        floor = req.version - self.knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
-        if floor > 0:
-            self.backend.set_oldest_version(floor)
-        self._advance_to(req.version)
+        if self._poisoned is not None:
+            # poisoned while this batch was parked in the version queue
+            raise ResolverFailed() from self._poisoned
+        finish = None
         try:
+            # Split-phase resolve: the submit updates conflict history (on
+            # device for the tpu backend, via async dispatch) before
+            # returning, so the version chain can advance and batch N+1 can
+            # submit while batch N's verdicts are still syncing back to the
+            # host.  This is what keeps the device busy instead of blocking
+            # the event loop per batch (SURVEY §7 hard part 3).
+            finish = resolve_begin(self.backend, req.txns, req.version)
+            # slide the history window: writes older than the txn-life
+            # window can no longer conflict with any admissible snapshot
+            floor = req.version - self.knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+            if floor > 0:
+                self.backend.set_oldest_version(floor)
+            self._advance_to(req.version)
             verdicts = await finish
+            finish = None
         except asyncio.CancelledError:
             raise
         except BaseException as e:
-            # The chain already advanced and history may hold this batch's
-            # writes, so this resolver's state can no longer be trusted:
-            # fail-stop (every later resolve raises too) rather than keep
-            # serving verdicts from poisoned history.  Recovery replaces
-            # the resolver, exactly as the reference kills the role process.
-            self._poisoned = e
+            # Anywhere past resolve_begin's first chunk submit, history may
+            # hold some of this batch's writes — fail-stop.
+            self._poison(e)
+            if finish is not None and asyncio.iscoroutine(finish):
+                finish.close()
             raise
         self.total_batches += 1
         self.total_txns += len(req.txns)

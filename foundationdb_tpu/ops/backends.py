@@ -25,7 +25,8 @@ Shape discipline for the encoded backends:
 from __future__ import annotations
 
 import asyncio
-import concurrent.futures
+import queue
+import threading
 
 import numpy as np
 
@@ -36,6 +37,58 @@ from .batch import EncodedBatch, TxnRequest
 
 async def _completed(value):
     return value
+
+
+class _DeviceSyncWorker:
+    """One daemon thread that performs blocking device→host syncs so the
+    event loop never waits on the device.  A *daemon* thread rather than a
+    ThreadPoolExecutor: executor threads are non-daemon and joined at
+    interpreter exit, so one sync wedged on a dead device tunnel would hang
+    process shutdown forever.  A single shared worker also serializes all
+    device syncs, which the fragile TPU tunnel prefers."""
+
+    _instance: "_DeviceSyncWorker | None" = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._t = threading.Thread(target=self._run, daemon=True,
+                                   name="resolver-device-sync")
+        self._t.start()
+
+    @classmethod
+    def shared(cls) -> "_DeviceSyncWorker":
+        with cls._instance_lock:
+            if cls._instance is None or not cls._instance._t.is_alive():
+                cls._instance = cls()
+            return cls._instance
+
+    def _run(self) -> None:
+        while True:
+            loop, fut, fn, arg = self._q.get()
+            try:
+                result, err = fn(arg), None
+            except BaseException as e:  # noqa: BLE001 — relayed to the future
+                result, err = None, e
+            try:
+                loop.call_soon_threadsafe(self._finish, fut, result, err)
+            except RuntimeError:
+                pass    # loop already closed; nothing to deliver to
+
+    @staticmethod
+    def _finish(fut: asyncio.Future, result, err) -> None:
+        if fut.cancelled():
+            return
+        if err is None:
+            fut.set_result(result)
+        else:
+            fut.set_exception(err)
+
+    async def run(self, fn, arg):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._q.put((loop, fut, fn, arg))
+        return await fut
 
 
 def resolve_begin(backend, txns: list[TxnRequest], commit_version: int):
@@ -82,7 +135,6 @@ class EncodedConflictBackend:
         self.B = batch_txns
         self.R = ranges_per_txn
         self.width = width
-        self._sync_pool: concurrent.futures.ThreadPoolExecutor | None = None
 
     def _submit_chunks(self, txns: list[TxnRequest], commit_version: int):
         """Encode + dispatch every chunk; returns [(n_txns, verdicts)] where
@@ -121,14 +173,11 @@ class EncodedConflictBackend:
             for n, v in pending:
                 if isinstance(v, np.ndarray) or isinstance(loop, SimEventLoop):
                     # Already host data (numpy backend), or under the
-                    # virtual-time simulator where executors are forbidden
+                    # virtual-time simulator where threads are forbidden
                     # and the device is host CPU anyway: sync inline.
                     host = np.asarray(v)
                 else:
-                    if self._sync_pool is None:
-                        self._sync_pool = concurrent.futures.ThreadPoolExecutor(
-                            max_workers=1, thread_name_prefix="resolver-sync")
-                    host = await loop.run_in_executor(self._sync_pool, np.asarray, v)
+                    host = await _DeviceSyncWorker.shared().run(np.asarray, v)
                 out.extend(int(x) for x in host[:n])
             return out
 
