@@ -22,6 +22,7 @@ from .commit_proxy import CommitProxy
 from .data import KeyRange, Version
 from .grv_proxy import GrvProxy
 from .load_balance import ReplicaGroup
+from .log_system import LogSystem
 from .ratekeeper import Ratekeeper
 from .resolver import Resolver
 from .sequencer import Sequencer
@@ -62,21 +63,23 @@ class Cluster:
         self.shard_map = ShardMap.even(c.storage_servers, team_tags)
         self.tlogs = tlogs if tlogs is not None else [
             TLog(k, v0) for _ in range(c.logs)]
+        # one shared log system: each tag replicated onto LOG_REPLICATION
+        # logs, single generation until a recovery appends more
+        self.log_system = LogSystem.single(self.tlogs, k.LOG_REPLICATION, v0)
 
         # resolver key partitions: even split of the whole keyspace
         res_map = ShardMap.even(c.resolvers)
         self.resolvers = [Resolver(k, res_map.shard_range(i), v0)
                           for i in range(c.resolvers)]
 
-        # storage: tag i lives on tlog i % logs
         self.storage_servers = []
         self._replica_groups: list[ReplicaGroup] = []
         for rng, tags in self.shard_map.ranges():
             team = []
             for tag in tags:
-                tlog = self.tlogs[tag % c.logs]
                 engine = (engines or {}).get(tag)
-                ss = StorageServer(k, tag, rng, tlog, v0, engine=engine)
+                ss = StorageServer(k, tag, rng, self.log_system, v0,
+                                   engine=engine)
                 self.storage_servers.append(ss)
                 team.append(ss)
             self._replica_groups.append(ReplicaGroup(rng, team))
@@ -85,7 +88,7 @@ class Cluster:
         self.grv_proxies = [GrvProxy(k, self.sequencer, self.ratekeeper)
                             for _ in range(c.grv_proxies)]
         self.commit_proxies = [CommitProxy(k, self.sequencer, self.resolvers,
-                                           self.tlogs, self.shard_map)
+                                           self.log_system, self.shard_map)
                                for _ in range(c.commit_proxies)]
         self._started = False
 

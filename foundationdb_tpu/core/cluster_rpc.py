@@ -81,14 +81,22 @@ class NetworkedCluster:
                                for i in range(c.resolvers)]
         res_addrs = [spawn("resolver", r)[0] for r in self._resolver_objs]
 
-        # storage servers: each owns a client transport to peek its tlog
+        # storage servers: each owns a client transport with a full
+        # log-system view (stubs for every TLog) so cursor failover and
+        # pops reach all replicas of its tag
+        from .log_system import LogSystem
+
+        def log_system_view(t: Transport) -> LogSystem:
+            return LogSystem.single(
+                [TLogClient(t, a, BASE) for a in tlog_addrs],
+                k.LOG_REPLICATION, v0)
+
         self._storage_objs = []
         storage_meta = []
         for rng, tags in self.shard_map.ranges():
             for tag in tags:
-                tl = TLogClient(client_transport(),
-                                tlog_addrs[tag % c.logs], BASE)
-                ss = StorageServer(k, tag, rng, tl, v0)
+                ss = StorageServer(k, tag, rng,
+                                   log_system_view(client_transport()), v0)
                 self._storage_objs.append(ss)
                 addr, _ = spawn("storage", ss)
                 storage_meta.append((addr, tag, rng))
@@ -101,8 +109,8 @@ class NetworkedCluster:
             seq = SequencerClient(t, seq_addr, BASE)
             resolvers = [ResolverClient(t, a, BASE, r.key_range)
                          for a, r in zip(res_addrs, self._resolver_objs)]
-            tlogs = [TLogClient(t, a, BASE) for a in tlog_addrs]
-            cp = CommitProxy(k, seq, resolvers, tlogs, self.shard_map)
+            cp = CommitProxy(k, seq, resolvers, log_system_view(t),
+                             self.shard_map)
             self._proxy_objs.append(cp)
             proxy_addrs.append(spawn("commit_proxy", cp)[0])
 

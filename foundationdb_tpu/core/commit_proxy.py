@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import asyncio
 import struct
-from typing import Callable
 
 from ..ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnRequest
 from ..runtime.errors import (ClientInvalidOperation, ClusterVersionChanged,
@@ -29,22 +28,17 @@ from .data import (CommitResult, CommitTransactionRequest, Mutation,
 from .resolver import ResolveBatchRequest, Resolver, clip_txn_to_range
 from .sequencer import Sequencer
 from .shard_map import ShardMap
-from .tlog import TLog, TLogPushRequest
 
 
 class CommitProxy:
     def __init__(self, knobs: Knobs, sequencer: Sequencer,
-                 resolvers: list[Resolver], tlogs: list[TLog],
-                 shard_map: ShardMap,
-                 tag_to_tlog: Callable[[int], int] | None = None) -> None:
+                 resolvers: list[Resolver], log_system,
+                 shard_map: ShardMap) -> None:
         self.knobs = knobs
         self.sequencer = sequencer
         self.resolvers = resolvers
-        self.tlogs = tlogs
+        self.log_system = log_system
         self.shard_map = shard_map
-        # which TLog owns a tag; must match the storage servers' peek
-        # routing or non-owning logs retain unpopped messages forever
-        self.tag_to_tlog = tag_to_tlog or (lambda tag: tag % len(tlogs))
         self._queue: asyncio.Queue = asyncio.Queue()
         self._batcher_task: asyncio.Task | None = None
         self._inflight: set[asyncio.Task] = set()
@@ -123,9 +117,7 @@ class CommitProxy:
             await asyncio.gather(*(r.resolve(
                 ResolveBatchRequest(prev_version, version, []))
                 for r in self.resolvers))
-            await asyncio.gather(*(t.push(
-                TLogPushRequest(prev_version, version, {}))
-                for t in self.tlogs))
+            await self.log_system.push(prev_version, version, {})
             self.sequencer.report_committed(version)
         except Exception:
             # an assigned version must never be abandoned (re-resolving or
@@ -175,9 +167,9 @@ class CommitProxy:
                 for i, v in enumerate(verdicts):
                     final[i] = max(final[i], v)
 
-            # tag mutations of committed txns, in batch order
-            per_tlog: list[dict[int, list[Mutation]]] = [
-                {} for _ in self.tlogs]
+            # tag mutations of committed txns, in batch order; the log
+            # system replicates each tag onto its hosting logs
+            tagged: dict[int, list[Mutation]] = {}
             order = 0
             orders: list[int] = [0] * len(reqs)
             for i, (req, verdict) in enumerate(zip(reqs, final)):
@@ -191,15 +183,11 @@ class CommitProxy:
                     else:
                         tags = self.shard_map.tags_for_key(m.param1)
                     for t in tags:
-                        per_tlog[self.tag_to_tlog(t)].setdefault(t, []).append(m)
+                        tagged.setdefault(t, []).append(m)
                 order += 1
 
-            # each TLog gets only the tags it owns; empty pushes still go
-            # to every TLog so all version chains stay gap-free
             push_started = True
-            await asyncio.gather(*(
-                t.push(TLogPushRequest(prev_version, version, msgs))
-                for t, msgs in zip(self.tlogs, per_tlog)))
+            await self.log_system.push(prev_version, version, tagged)
             pushed = True
             self.sequencer.report_committed(version)
 
@@ -245,9 +233,7 @@ class CommitProxy:
                     ResolveBatchRequest(prev_version, version, []))
                     for r in self.resolvers))
             if not pushed:
-                await asyncio.gather(*(t.push(
-                    TLogPushRequest(prev_version, version, {}))
-                    for t in self.tlogs))
+                await self.log_system.push(prev_version, version, {})
             self.sequencer.report_committed(version)
         except Exception:
             pass  # a failed repair means the epoch is dead; recovery's job

@@ -23,12 +23,17 @@ from .tlog import TLog, Tag
 
 class StorageServer:
     def __init__(self, knobs: Knobs, tag: Tag, shard: KeyRange,
-                 tlog: TLog, epoch_begin_version: Version = 0,
+                 log_system, epoch_begin_version: Version = 0,
                  engine=None) -> None:
+        from .log_system import LogSystem
         self.knobs = knobs
         self.tag = tag
         self.shard = shard
-        self.tlog = tlog
+        if not isinstance(log_system, LogSystem):
+            # a bare TLog (or TLogClient stub) — unit-test convenience
+            log_system = LogSystem.single([log_system], 1,
+                                          epoch_begin_version)
+        self.log_system = log_system
         self.engine = engine            # IKeyValueStore when durable
         self.vmap = VersionedMap()
         if engine is not None:
@@ -76,16 +81,24 @@ class StorageServer:
 
     async def _pull_loop(self) -> None:
         from ..runtime.errors import FdbError
+        cursor = self.log_system.cursor(self.tag, self.version + 1)
         while True:
             try:
-                reply = await self.tlog.peek(self.tag, self.version + 1)
+                reply = await cursor.next()
             except FdbError as e:
-                # remote TLog unreachable (partition/clog/kill): back off
-                # and retry — the reference's peek cursor does the same
+                # every live replica unreachable (partition/clog/kill):
+                # back off and retry — the reference's peek cursor does
+                # the same
                 if e.retryable:
                     await asyncio.sleep(0.1)
                     continue
                 raise
+            if not reply.entries and reply.end_version - 1 <= self.version:
+                # no progress (e.g. the generation is locked but not yet
+                # ended): poll gently instead of spinning
+                await asyncio.sleep(self.knobs.TLOG_PEEK_RETRY)
+                cursor.version = self.version + 1
+                continue
             for version, mutations in reply.entries:
                 self._apply(version, mutations)
             if reply.end_version - 1 > self.version:
@@ -93,7 +106,7 @@ class StorageServer:
             if self.engine is None:
                 # memory-only mode: nothing to persist, pop eagerly and
                 # slide the MVCC window by forgetting (folding) history
-                self.tlog.pop(self.tag, self.version + 1)
+                self.log_system.pop(self.tag, self.version + 1)
                 floor = self.version - self.knobs.STORAGE_VERSION_WINDOW
                 if floor > self.oldest_version:
                     self.oldest_version = floor
@@ -130,7 +143,7 @@ class StorageServer:
             self.durable_version = floor
             self.oldest_version = floor
             self.vmap.drop_before(floor)     # engine is authoritative <= floor
-            self.tlog.pop(self.tag, floor + 1)
+            self.log_system.pop(self.tag, floor + 1)
 
     def _get_latest(self, key: bytes) -> bytes | None:
         found, v = self.vmap.get2(key, self.vmap.latest_version)

@@ -50,6 +50,7 @@ class TLog:
         self._peek_waiters: list[asyncio.Future] = []
         self._pop_task: asyncio.Task | None = None
         self._pop_target = 0
+        self.locked = False          # generation locked by recovery
         self.total_pushes = 0
         self.total_bytes = 0
 
@@ -81,13 +82,43 @@ class TLog:
         self._push_waiters.setdefault(prev_version, []).append(fut)
         await fut
 
+    async def lock(self) -> Version:
+        """Stop accepting pushes and report the tip — TLogLockResult in the
+        reference's recovery (REF:fdbserver/TLogServer.actor.cpp
+        tLogLock): the old generation is frozen so the recovery version
+        can be computed from stable tips.  Peeks and pops still work;
+        blocked peek long-polls are woken so cursors can roll over."""
+        from ..runtime.trace import TraceEvent
+        if not self.locked:
+            self.locked = True
+            TraceEvent("TLogLocked").detail("Tip", self.version).log()
+            for fut in self._peek_waiters:
+                if not fut.done():
+                    fut.set_result(None)
+            self._peek_waiters.clear()
+            # pushes already parked on the version chain will never be
+            # satisfied by a locked log; fail them out
+            for futs in self._push_waiters.values():
+                for fut in futs:
+                    if not fut.done():
+                        from ..runtime.errors import TLogStopped
+                        fut.set_exception(TLogStopped())
+            self._push_waiters.clear()
+        return self.version
+
     async def push(self, req: TLogPushRequest) -> Version:
         """Append and make durable; returns the version once fsync'd.
 
         In-memory engine: durability is immediate.  The version-ordering
         wait still applies so peeks never observe gaps.
         """
+        if self.locked:
+            from ..runtime.errors import TLogStopped
+            raise TLogStopped()
         await self._wait_for_version(req.prev_version)
+        if self.locked:
+            from ..runtime.errors import TLogStopped
+            raise TLogStopped()
         for tag, msgs in req.messages.items():
             if msgs:
                 self._log.setdefault(tag, []).append((req.version, msgs))
@@ -99,6 +130,14 @@ class TLog:
                                                 "m": req.messages}))
             self._frame_ends.append((req.version, end))
             await self.queue.commit()   # the fsync that makes commits durable
+            if self.locked:
+                # lock() captured the tip while we were waiting on disk: the
+                # recovery version excludes this push, so acking it would
+                # lose an acked commit to the generation clamp.  The frame
+                # is on disk but never acked — the client sees an ambiguous
+                # result, which discarding satisfies.
+                from ..runtime.errors import TLogStopped
+                raise TLogStopped()
         self.version = req.version
         self.total_pushes += 1
         ready = [v for v in self._push_waiters if v <= req.version]
@@ -114,8 +153,10 @@ class TLog:
 
     async def peek(self, tag: Tag, begin_version: Version) -> TLogPeekReply:
         """Long-poll: block until the log tip passes begin_version, then
-        return all of tag's messages in [begin_version, tip]."""
-        while self.version < begin_version:
+        return all of tag's messages in [begin_version, tip].  A locked
+        log never advances, so it answers immediately — the cursor uses
+        the (possibly short) end_version to roll to the next generation."""
+        while self.version < begin_version and not self.locked:
             fut = asyncio.get_running_loop().create_future()
             self._peek_waiters.append(fut)
             await fut

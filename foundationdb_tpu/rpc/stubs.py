@@ -23,7 +23,8 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
                   ("get_live_committed_version", False),
                   ("report_committed", True)],
     "resolver": [("resolve", False)],
-    "tlog": [("push", False), ("peek", False), ("pop", True)],
+    "tlog": [("push", False), ("peek", False), ("pop", True),
+             ("lock", False)],
     "storage": [("get_value", False), ("get_key_values", False),
                 ("watch_value", False)],
     "commit_proxy": [("commit", False)],

@@ -84,6 +84,12 @@ class Transport:
         self.address = address
         self.dispatcher = RequestDispatcher()
 
+        # Every process answers pings at the well-known token — the probe
+        # surface FailureMonitor uses (REF: FlowTransport's ping endpoint).
+        async def _ping(payload: Any) -> Any:
+            return payload
+        self.dispatcher.register(_ping, token=WLTOKEN_PING)
+
     async def request(self, endpoint: Endpoint, payload: Any,
                       timeout: float | None = None) -> Any:
         raise NotImplementedError

@@ -56,6 +56,7 @@ CommitUnknownResult = _err(1021, "commit_unknown_result", "Commit result unknown
 TransactionCancelled = _err(1025, "transaction_cancelled", "Transaction was cancelled")
 ConnectionFailed = _err(1026, "connection_failed", "Network connection failed")
 TransactionTimedOut = _err(1031, "transaction_timed_out", "Transaction timed out")
+TLogStopped = _err(1011, "tlog_stopped", "TLog stopped (generation locked by recovery)")
 ProcessBehind = _err(1037, "process_behind", "Storage process does not have recent mutations")
 DatabaseLocked = _err(1038, "database_locked", "Database is locked")
 ClusterVersionChanged = _err(1039, "cluster_version_changed", "Cluster has been upgraded to a new protocol version")
@@ -83,6 +84,8 @@ ResolverCapacityExceeded = _err(2900, "resolver_capacity_exceeded",
 ResolverFailed = _err(2901, "resolver_failed",
                       "Resolver backend failed after history mutation; "
                       "role is fail-stopped pending recovery")
+LogDataLoss = _err(2902, "log_data_loss",
+                   "Every replica of a log tag is gone; recovery impossible")
 
 # 1213 is retryable for idempotent operations (reads, GRV); the commit
 # path converts it to commit_unknown_result (1021) before the client's

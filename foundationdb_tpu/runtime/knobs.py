@@ -60,6 +60,8 @@ class Knobs:
     # --- tlog ---
     TLOG_SPILL_THRESHOLD: int = 1 << 30
     DISK_QUEUE_PAGE_SIZE: int = 4096
+    LOG_REPLICATION: int = 2                  # TLogs hosting each tag (min'd with log count)
+    TLOG_PEEK_RETRY: float = 0.05             # cursor poll while a generation is being ended
 
     # --- ratekeeper ---
     RATEKEEPER_UPDATE_INTERVAL: float = 0.25

@@ -1,0 +1,74 @@
+"""FailureMonitor: detection and recovery over the simulated network."""
+
+import asyncio
+
+from foundationdb_tpu.rpc.failure_monitor import FailureMonitor
+from foundationdb_tpu.rpc.sim_transport import SimNetwork, SimTransport
+from foundationdb_tpu.rpc.transport import NetworkAddress
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+A = NetworkAddress("10.0.0.1", 4000)
+B = NetworkAddress("10.0.0.2", 4000)
+
+
+def _setup(knobs):
+    net = SimNetwork(knobs)
+    ta = SimTransport(net, A)
+    tb = SimTransport(net, B)
+    return net, ta, tb
+
+
+def test_detects_dead_process_and_recovery():
+    async def main():
+        k = Knobs().override(FAILURE_TIMEOUT=1.0, PING_INTERVAL=0.25)
+        net, ta, tb = _setup(k)
+        fm = FailureMonitor(ta, k)
+        loop = asyncio.get_running_loop()
+
+        assert fm.is_available(B)
+        await asyncio.sleep(1.0)
+        assert fm.is_available(B)          # healthy peer stays available
+
+        net.kill(B)
+        t0 = loop.time()
+        await fm.wait_for_failure(B)
+        detect = loop.time() - t0
+        assert detect <= 3 * k.FAILURE_TIMEOUT + 1.0, detect
+
+        net.reboot(B)
+        await fm.wait_for_recovery(B)
+        assert fm.is_available(B)
+        await fm.close()
+    run_simulation(main(), seed=1)
+
+
+def test_partition_is_failure_from_one_side():
+    async def main():
+        k = Knobs().override(FAILURE_TIMEOUT=1.0, PING_INTERVAL=0.25)
+        net, ta, tb = _setup(k)
+        fm_a = FailureMonitor(ta, k)
+        net.partition(A, B)
+        await fm_a.wait_for_failure(B)
+        assert not fm_a.is_available(B)
+        net.heal(A, B)
+        await fm_a.wait_for_recovery(B)
+        await fm_a.close()
+    run_simulation(main(), seed=2)
+
+
+def test_deterministic_detection_time():
+    async def main():
+        k = Knobs().override(FAILURE_TIMEOUT=1.0, PING_INTERVAL=0.25)
+        net, ta, tb = _setup(k)
+        fm = FailureMonitor(ta, k)
+        loop = asyncio.get_running_loop()
+        await asyncio.sleep(0.6)
+        net.kill(B)
+        t0 = loop.time()
+        await fm.wait_for_failure(B)
+        dt = loop.time() - t0
+        await fm.close()
+        return dt
+
+    assert run_simulation(main(), seed=3) == run_simulation(main(), seed=3)

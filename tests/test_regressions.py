@@ -55,18 +55,19 @@ def test_watch_fails_on_reset_instead_of_hanging():
     sim(body)
 
 
-def test_tlogs_only_retain_owned_tags():
+def test_tlogs_only_retain_hosted_tags():
     async def body(db):
         for i in range(30):
             await db.set(b"k%02d" % i, b"v" * 50)
         cluster = db.cluster
-        # storage pops from its owning tlog; non-owning tlogs must hold
-        # nothing for foreign tags (push routing sends them only empties)
+        # push routing sends a tag's data only to its hosting replicas
+        # (LOG_REPLICATION of them); other tlogs get empty frames
+        gen = cluster.log_system.current
         for ti, tlog in enumerate(cluster.tlogs):
             for tag, entries in tlog._log.items():
-                assert tag % len(cluster.tlogs) == ti, \
+                assert ti in gen.logs_for_tag(tag), \
                     f"tlog {ti} retains foreign tag {tag}"
-    sim(body, config=ClusterConfig(logs=2, storage_servers=4))
+    sim(body, config=ClusterConfig(logs=3, storage_servers=4))
 
 
 def test_shard_map_boundary_range():
