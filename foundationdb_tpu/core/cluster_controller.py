@@ -1,0 +1,288 @@
+"""ClusterController — elected singleton running epoch-based recovery.
+
+Reference: REF:fdbserver/ClusterController.actor.cpp +
+REF:fdbserver/masterserver.actor.cpp (the recovery state machine,
+ClusterRecovery.actor.cpp in 7.x) — the elected controller owns the
+transaction subsystem: on election (or on any transaction-role failure)
+it runs one *recovery epoch*:
+
+  READING_CSTATE    read the coordinated state (previous epoch's config)
+  LOCKING_CSTATE    lock the previous TLog generation (tips freeze);
+                    recovery_version = min(tip) over locked logs — safe
+                    because pushes ack only when every log acked
+  RECRUITING        pick live workers; recruit sequencer, TLogs,
+                    resolvers, commit/GRV proxies for the new generation
+  REJOINING         roll storage servers back to the recovery version and
+                    point them at the new log system
+  WRITING_CSTATE    publish the new epoch's config to the coordinators —
+                    the commit point of the recovery
+  ACCEPTING_COMMITS monitor role health; any failure starts a new epoch
+
+Storage servers are durable roles: they survive epochs and rejoin each
+new one.  The transaction subsystem (sequencer/logs/resolvers/proxies) is
+rebuilt from scratch every epoch, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any
+
+from ..rpc.failure_monitor import FailureMonitor
+from ..rpc.stubs import TLogClient, WorkerClient
+from ..rpc.transport import NetworkAddress, Transport
+from ..runtime.errors import FdbError
+from ..runtime.knobs import Knobs
+from ..runtime.trace import TraceEvent
+from .coordination import CoordinatedState
+from .shard_map import ShardMap
+
+
+@dataclasses.dataclass
+class ClusterConfigSpec:
+    """Role counts for recruitment (DatabaseConfiguration analog)."""
+    commit_proxies: int = 1
+    grv_proxies: int = 1
+    resolvers: int = 1
+    logs: int = 2
+    storage_servers: int = 2
+    replication: int = 1          # storage replicas per shard
+    log_replication: int = 2
+
+
+class ClusterController:
+    """Runs on the elected worker.  ``workers`` maps address → WorkerClient
+    for every known worker process (including dead ones; liveness comes
+    from the failure monitor)."""
+
+    def __init__(self, knobs: Knobs, transport: Transport,
+                 cstate: CoordinatedState, workers: dict[NetworkAddress,
+                                                         WorkerClient],
+                 spec: ClusterConfigSpec, base_token: int) -> None:
+        self.knobs = knobs
+        self.transport = transport
+        self.cstate = cstate
+        self.workers = workers
+        self.spec = spec
+        self.base = base_token
+        self.fm = FailureMonitor(transport, knobs)
+        self.epoch = 0
+        self.recovery_state = "READING_CSTATE"
+        self._stopped = False
+
+    # --- helpers ---
+
+    def _live_workers(self) -> list[tuple[NetworkAddress, WorkerClient]]:
+        return [(a, w) for a, w in sorted(self.workers.items())
+                if self.fm.is_available(a)]
+
+    async def _recruit(self, wa: NetworkAddress, role: str,
+                       params: dict) -> tuple[list, int]:
+        token = await self.workers[wa].recruit(role, params)
+        return [wa.ip, wa.port], token
+
+    # --- the recovery state machine ---
+
+    async def recover_once(self, prev_state: dict | None) -> dict:
+        """Run one full recovery; returns the new cluster state dict."""
+        k, spec = self.knobs, self.spec
+        new_epoch = (prev_state["epoch"] + 1) if prev_state else 1
+        self.recovery_state = "LOCKING_CSTATE"
+        TraceEvent("RecoveryStarted").detail("Epoch", new_epoch).log()
+
+        # ---- lock the previous generation, compute recovery version ----
+        recovery_version = 0
+        old_log_cfg: list[dict] = []
+        if prev_state:
+            old_log_cfg = [dict(g) for g in prev_state["log_cfg"]]
+            cur = old_log_cfg[-1]
+            tips: list[int] = []
+            dead: list[int] = list(cur.get("dead", []))
+            ct = self.transport
+            for i, (ip, port) in enumerate(cur["tlogs"]):
+                stub = TLogClient(ct, NetworkAddress(ip, port), cur["token"][i]
+                                  if "token" in cur else self.base)
+                try:
+                    tips.append(await asyncio.wait_for(
+                        stub.lock(), timeout=k.FAILURE_TIMEOUT * 2))
+                except (FdbError, asyncio.TimeoutError):
+                    if i not in dead:
+                        dead.append(i)
+            n = len(cur["tlogs"])
+            # every tag needs a live replica: check coverage
+            covered = set()
+            repl = cur["replication"]
+            for tag in range(64):                    # tags are small ints
+                hosts = [(tag + j) % n for j in range(max(1, min(repl, n)))]
+                if all(h in dead for h in hosts):
+                    TraceEvent("RecoveryWaitingForLogs", severity=30) \
+                        .detail("Tag", tag).log()
+            if not tips:
+                raise FdbError("no lockable logs")
+            recovery_version = min(tips)
+            cur["end"] = recovery_version
+            cur["dead"] = sorted(dead)
+        self.epoch = new_epoch
+
+        # ---- recruit the new transaction subsystem ----
+        self.recovery_state = "RECRUITING"
+        live = self._live_workers()
+        if not live:
+            raise FdbError("no live workers")
+
+        def pick(i: int) -> NetworkAddress:
+            return live[i % len(live)][0]
+
+        rv = recovery_version
+        seq_addr, seq_tok = await self._recruit(
+            pick(0), "sequencer", {"v0": rv})
+
+        tlog_addrs, tlog_toks = [], []
+        for i in range(spec.logs):
+            a, t = await self._recruit(pick(1 + i), "tlog", {"v0": rv})
+            tlog_addrs.append(a)
+            tlog_toks.append(t)
+
+        new_gen = {
+            "epoch": new_epoch,
+            "begin": rv,
+            "end": None,
+            "tlogs": [tuple(a) for a in tlog_addrs],
+            "replication": min(spec.log_replication, spec.logs),
+            "dead": [],
+            "token": tlog_toks,
+        }
+        log_cfg = old_log_cfg + [new_gen]
+
+        res_map = ShardMap.even(spec.resolvers)
+        resolver_info = []
+        for i in range(spec.resolvers):
+            r = res_map.shard_range(i)
+            a, t = await self._recruit(pick(1 + spec.logs + i), "resolver",
+                                       {"begin": r.begin, "end": r.end,
+                                        "v0": rv})
+            resolver_info.append((tuple(a), r.begin, r.end, t))
+
+        # ---- storage: recruit once (epoch 1), rejoin afterwards ----
+        self.recovery_state = "REJOINING"
+        rf = max(1, spec.replication)
+        team_tags = [[s * rf + r for r in range(rf)]
+                     for s in range(spec.storage_servers)]
+        shard_map = ShardMap.even(spec.storage_servers, team_tags)
+        wire_log_cfg = [self._wire_gen(g) for g in log_cfg]
+        storage_meta: list[dict] = []
+        if prev_state:
+            storage_meta = [dict(s) for s in prev_state["storage"]]
+            for s in storage_meta:
+                wa = NetworkAddress(s["worker"][0], s["worker"][1])
+                if not self.fm.is_available(wa):
+                    continue       # dead replica: reads fail over
+                try:
+                    await self.workers[wa].rejoin_storage(
+                        s["token"], wire_log_cfg, rv)
+                except FdbError:
+                    TraceEvent("StorageRejoinFailed", severity=30) \
+                        .detail("Tag", s["tag"]).log()
+        else:
+            i = 0
+            for rng, tags in shard_map.ranges():
+                for tag in tags:
+                    wa = pick(i)
+                    i += 1
+                    a, t = await self._recruit(wa, "storage", {
+                        "tag": tag, "shard_begin": rng.begin,
+                        "shard_end": rng.end, "v0": 0,
+                        "log_cfg": wire_log_cfg})
+                    storage_meta.append({
+                        "worker": [wa.ip, wa.port], "addr": a,
+                        "token": t, "tag": tag,
+                        "begin": rng.begin, "end": rng.end})
+
+        # ---- proxies (they need everything above) ----
+        boundaries = shard_map.boundaries
+        teams = shard_map.shard_tags
+        proxy_params = {
+            "sequencer": seq_addr, "sequencer_token": seq_tok,
+            "resolvers": [(list(a), b, e) for a, b, e, _ in resolver_info],
+            "log_cfg": wire_log_cfg,
+            "shard_boundaries": boundaries, "shard_teams": teams,
+        }
+        commit_info, grv_info = [], []
+        for i in range(spec.commit_proxies):
+            a, t = await self._recruit(pick(10 + i), "commit_proxy",
+                                       dict(proxy_params))
+            commit_info.append((a, t))
+        for i in range(spec.grv_proxies):
+            a, t = await self._recruit(pick(20 + i), "grv_proxy",
+                                       dict(proxy_params))
+            grv_info.append((a, t))
+
+        # ---- commit the new epoch ----
+        self.recovery_state = "WRITING_CSTATE"
+        state = {
+            "epoch": new_epoch,
+            "recovery_version": rv,
+            "log_cfg": log_cfg,
+            "sequencer": {"addr": seq_addr, "token": seq_tok},
+            "resolvers": [{"addr": list(a), "begin": b, "end": e, "token": t}
+                          for a, b, e, t in resolver_info],
+            "storage": storage_meta,
+            "commit_proxies": [{"addr": a, "token": t} for a, t in commit_info],
+            "grv_proxies": [{"addr": a, "token": t} for a, t in grv_info],
+            "shard_boundaries": boundaries,
+            "shard_teams": teams,
+        }
+        await self.cstate.write(state)
+        self.recovery_state = "ACCEPTING_COMMITS"
+        TraceEvent("RecoveryComplete").detail("Epoch", new_epoch) \
+            .detail("RecoveryVersion", rv).log()
+        return state
+
+    @staticmethod
+    def _wire_gen(g: dict) -> dict:
+        """Strip controller-only fields from a generation for role use."""
+        return {"epoch": g["epoch"], "begin": g["begin"], "end": g["end"],
+                "tlogs": [tuple(a) for a in g["tlogs"]],
+                "replication": g["replication"],
+                "dead": list(g.get("dead", []))}
+
+    # --- the controller main loop ---
+
+    async def run(self) -> None:
+        """Recover, then watch the txn subsystem; any role failure (or a
+        fail-stopped resolver) triggers the next epoch.  Runs until
+        cancelled (deposed or machine death)."""
+        state: dict | None = None
+        while not self._stopped:
+            try:
+                _, state = await self.cstate.read()
+                state = await self.recover_once(state)
+            except asyncio.CancelledError:
+                raise
+            except FdbError as e:
+                TraceEvent("RecoveryFailed", severity=30) \
+                    .detail("Error", e.name).log()
+                await asyncio.sleep(self.knobs.RECOVERY_RETRY_DELAY)
+                continue
+            # watch every txn-subsystem address
+            watch = [NetworkAddress(*state["sequencer"]["addr"])]
+            watch += [NetworkAddress(*g)
+                      for g in state["log_cfg"][-1]["tlogs"]]
+            watch += [NetworkAddress(*r["addr"]) for r in state["resolvers"]]
+            watch += [NetworkAddress(*p["addr"])
+                      for p in state["commit_proxies"] + state["grv_proxies"]]
+            waiters = [asyncio.ensure_future(self.fm.wait_for_failure(a))
+                       for a in set(watch)]
+            try:
+                done, pending = await asyncio.wait(
+                    waiters, return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                for w in waiters:
+                    w.cancel()
+                await asyncio.gather(*waiters, return_exceptions=True)
+            TraceEvent("TxnRoleFailed").detail("Epoch", self.epoch).log()
+
+    async def stop(self) -> None:
+        self._stopped = True
+        await self.fm.close()

@@ -1,0 +1,233 @@
+"""Coordination — the generation register and leader election.
+
+Reference: REF:fdbserver/Coordination.actor.cpp (GenerationReg /
+coordinationServer) + REF:fdbserver/LeaderElection.actor.cpp — a small set
+of coordinator processes store the cluster's most important few hundred
+bytes (who leads, which TLog generation is live) behind a Paxos-flavored
+generation register:
+
+- ``read(gen)``: a reader first *registers* its read generation; the
+  coordinator promises never to accept a write from any older generation,
+  and returns the freshest (write_gen, value) it has accepted.
+- ``write(gen, value)``: accepted iff ``gen`` is newer than both the
+  largest read generation registered and the largest write generation
+  accepted.
+
+A client that completes both phases against a **majority** of
+coordinators knows its value is the unique latest — the single-decree
+Paxos core FDB uses for cluster state (CoordinatedState).  Leader
+election rides the same machinery plus per-coordinator candidacy
+tracking with virtual-time leases.
+
+State is durable when a filesystem is provided (OnDemandStore analog):
+a coordinator that reboots remembers its promises.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any
+
+from ..runtime.errors import FdbError, _err
+from ..runtime.knobs import Knobs
+from ..runtime.trace import TraceEvent
+
+NotLatestGeneration = _err(2903, "not_latest_generation",
+                           "A newer generation has been seen by this coordinator")
+CoordinatorsUnreachable = _err(
+    2904, "coordinators_unreachable",
+    "No majority of coordinators reachable")
+
+
+# generations order lexicographically: (counter, candidate_id)
+Generation = tuple[int, int]
+GEN_ZERO: Generation = (0, 0)
+
+
+@dataclasses.dataclass
+class LeaderInfo:
+    leader_id: int
+    address: Any            # NetworkAddress of the cluster controller
+    lease_end: float        # virtual-time lease expiry (coordinator clock)
+
+
+class Coordinator:
+    """One coordinator process (role "coordinator")."""
+
+    def __init__(self, knobs: Knobs, fs=None, path: str | None = None) -> None:
+        self.knobs = knobs
+        self._fs = fs
+        self._path = path
+        self.max_read_gen: Generation = GEN_ZERO
+        self.write_gen: Generation = GEN_ZERO
+        self.value: Any = None
+        self._leader: LeaderInfo | None = None
+
+    # --- durability (OnDemandStore) ---
+
+    @classmethod
+    async def open(cls, knobs: Knobs, fs, path: str) -> "Coordinator":
+        from ..rpc.wire import decode
+        co = cls(knobs, fs, path)
+        f = fs.open(path)
+        data = await f.read(0, f.size())
+        if data:
+            try:
+                st = decode(data)
+                co.max_read_gen = tuple(st["r"])
+                co.write_gen = tuple(st["w"])
+                co.value = st["v"]
+            except Exception:
+                TraceEvent("CoordStateCorrupt", severity=30).detail(
+                    "Path", path).log()
+        return co
+
+    async def _persist(self) -> None:
+        if self._fs is None:
+            return
+        from ..rpc.wire import encode
+        f = self._fs.open(self._path)
+        await f.truncate(0)
+        await f.write(0, encode({"r": list(self.max_read_gen),
+                                 "w": list(self.write_gen),
+                                 "v": self.value}))
+        await f.sync()
+
+    # --- generation register (GenerationRegInterface) ---
+
+    async def read(self, gen: list | Generation) -> tuple[Generation, Generation, Any]:
+        """Register a read at ``gen``; promise excludes older writers.
+        Returns (max_read_gen, write_gen, value)."""
+        gen = tuple(gen)
+        if gen > self.max_read_gen:
+            self.max_read_gen = gen
+            await self._persist()
+        return self.max_read_gen, self.write_gen, self.value
+
+    async def write(self, gen: list | Generation, value: Any) -> Generation:
+        """Accept iff gen is at least as new as every promise; returns the
+        coordinator's max read generation (so a rejected writer learns
+        what to beat)."""
+        gen = tuple(gen)
+        if gen < self.max_read_gen or gen <= self.write_gen:
+            raise NotLatestGeneration()
+        self.write_gen = gen
+        self.value = value
+        await self._persist()
+        return self.max_read_gen
+
+    async def open_database(self) -> Any:
+        """Read-only client entry (OpenDatabaseCoordRequest analog): hand
+        back the latest accepted cluster state WITHOUT registering a read
+        generation — clients must never invalidate writers."""
+        return self.value
+
+    # --- leader election (LeaderElectionRegInterface) ---
+
+    async def candidacy(self, candidate_id: int, address: Any) -> tuple[int, Any]:
+        """Offer to lead; returns the current leader (possibly the caller).
+        First viable candidate wins until its lease lapses."""
+        now = asyncio.get_running_loop().time()
+        if self._leader is None or now >= self._leader.lease_end:
+            self._leader = LeaderInfo(
+                candidate_id, address,
+                now + self.knobs.LEADER_LEASE_DURATION)
+            TraceEvent("CoordLeaderChange").detail("Leader", candidate_id).log()
+        return self._leader.leader_id, self._leader.address
+
+    async def leader_heartbeat(self, candidate_id: int) -> bool:
+        """Renew the lease; False tells a deposed leader to stand down."""
+        now = asyncio.get_running_loop().time()
+        if self._leader is not None and self._leader.leader_id == candidate_id \
+                and now < self._leader.lease_end:
+            self._leader.lease_end = now + self.knobs.LEADER_LEASE_DURATION
+            return True
+        return False
+
+
+class CoordinatedState:
+    """Client view over a quorum of coordinators — CoordinatedState /
+    MovableCoordinatedState in the reference: read-modify-write of the
+    cluster state blob with single-decree safety."""
+
+    def __init__(self, coordinators: list, my_id: int) -> None:
+        self.coordinators = coordinators      # Coordinator objects or stubs
+        self.my_id = my_id
+        self._gen_counter = 0
+        self._read_gen: Generation | None = None
+
+    @property
+    def _majority(self) -> int:
+        return len(self.coordinators) // 2 + 1
+
+    async def _quorum(self, calls) -> list:
+        """Run calls; return successful results, raising unless a
+        majority succeeded."""
+        results = await asyncio.gather(*calls, return_exceptions=True)
+        ok = [r for r in results if not isinstance(r, BaseException)]
+        if len(ok) < self._majority:
+            real = [r for r in results if isinstance(r, FdbError)]
+            if real and all(isinstance(r, NotLatestGeneration) for r in real):
+                raise NotLatestGeneration()
+            raise CoordinatorsUnreachable()
+        return ok
+
+    async def read(self) -> tuple[Generation, Any]:
+        """Phase-1 read from a majority: registers a fresh read generation
+        and returns (read_gen, freshest accepted value).  After this, no
+        writer at an older generation can commit at any majority (the two
+        majorities intersect at a coordinator holding our promise)."""
+        self._gen_counter += 1
+        gen = (self._gen_counter, self.my_id)
+        replies = await self._quorum(
+            [c.read(list(gen)) for c in self.coordinators])
+        # learn the newest generation around so the next read beats it
+        max_seen = max(r[0] for r in replies)
+        self._gen_counter = max(self._gen_counter, max_seen[0])
+        self._read_gen = gen
+        best = max(replies, key=lambda r: r[1])    # freshest accepted write
+        return gen, best[2]
+
+    async def write(self, value: Any) -> None:
+        """Phase-2 write at the generation of OUR read phase — never a
+        fresher one, or a value committed after our read could be silently
+        overwritten (the single-decree Paxos ballot discipline).  Raises
+        NotLatestGeneration if a newer reader/writer got in; the caller
+        must re-read (adopting the newer value) before retrying."""
+        if self._read_gen is None:
+            raise RuntimeError("write() before read()")
+        gen, self._read_gen = self._read_gen, None
+        await self._quorum([c.write(list(gen), value)
+                            for c in self.coordinators])
+
+    async def read_modify_write(self, update) -> Any:
+        """Retry loop: read, apply ``update(old) -> new``, write."""
+        while True:
+            _, old = await self.read()
+            new = update(old)
+            try:
+                await self.write(new)
+                return new
+            except NotLatestGeneration:
+                await asyncio.sleep(0.05)
+
+
+async def elect_leader(coordinators: list, candidate_id: int, address: Any,
+                       knobs: Knobs) -> tuple[int, Any]:
+    """One candidacy round against a majority; returns the winning
+    (leader_id, address) the quorum agrees on (ties broken by count,
+    then lowest id — deterministic)."""
+    results = await asyncio.gather(
+        *(c.candidacy(candidate_id, address) for c in coordinators),
+        return_exceptions=True)
+    ok = [r for r in results if not isinstance(r, BaseException)]
+    if len(ok) < len(coordinators) // 2 + 1:
+        raise CoordinatorsUnreachable()
+    tally: dict[tuple[int, Any], int] = {}
+    for r in ok:
+        key = (r[0], r[1])
+        tally[key] = tally.get(key, 0) + 1
+    (leader_id, addr), _ = min(tally.items(),
+                               key=lambda kv: (-kv[1], kv[0][0]))
+    return leader_id, addr

@@ -77,6 +77,37 @@ class StorageServer:
                     pass
                 setattr(self, attr, None)
 
+    # --- recovery (REF: storageserver.actor.cpp rollback + rejoin) ---
+
+    async def rejoin(self, generations: list, recovery_version: Version) -> None:
+        """Adopt a recovered log system: roll back in-memory state above
+        the recovery version (those mutations came from a generation's
+        clamped, unacked suffix), swap in the new generation list, and
+        restart the pull loop from the consistent cut."""
+        if self.durable_version > recovery_version:
+            # durable state is ahead of the recovered history — this
+            # replica cannot be rolled back and must be discarded/refetched
+            # (the reference kills the storage server here)
+            raise TransactionTooOld()
+        running = self._pull_task is not None
+        if running:
+            self._pull_task.cancel()
+            try:
+                await self._pull_task
+            except asyncio.CancelledError:
+                pass
+            self._pull_task = None
+        if self.version > recovery_version:
+            self.vmap.rollback_after(recovery_version)
+            self._durability_buffer = [
+                (v, op) for v, op in self._durability_buffer
+                if v <= recovery_version]
+            self.version = recovery_version
+        self.log_system.generations[:] = generations
+        if running:
+            self._pull_task = asyncio.get_running_loop().create_task(
+                self._pull_loop(), name=f"storage-{self.tag}-pull")
+
     # --- the update path (REF: storageserver.actor.cpp::update) ---
 
     async def _pull_loop(self) -> None:

@@ -1,0 +1,176 @@
+"""Worker — the per-process role host the cluster controller recruits on.
+
+Reference: REF:fdbserver/worker.actor.cpp (workerServer) — every fdbserver
+process runs a worker that registers with the cluster controller and
+spawns/destroys role actors on request.  Here the worker serves a
+``recruit`` RPC taking a role name + a *serializable* parameter dict; it
+builds the role object (constructing client stubs for the role's
+dependencies from addresses in the params) and registers it at a fresh
+token block on its own transport.
+
+Serializable log-system config (the piece of cluster state that names TLog
+generations) travels as plain dicts — see ``log_system_from_config``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable
+
+from ..rpc.stubs import (ResolverClient, SequencerClient, TLogClient,
+                         serve_role)
+from ..rpc.transport import Transport
+from ..runtime.knobs import Knobs
+from ..runtime.trace import TraceEvent
+from .commit_proxy import CommitProxy
+from .data import KeyRange
+from .grv_proxy import GrvProxy
+from .log_system import LogGeneration, LogSystem
+from .resolver import Resolver
+from .sequencer import Sequencer
+from .shard_map import ShardMap
+from .storage_server import StorageServer
+from .tlog import TLog
+
+TOKEN_BLOCK = 16
+
+
+def log_system_config(ls: LogSystem) -> list[dict]:
+    """LogSystem → wire-friendly generation list (addresses, not stubs)."""
+    out = []
+    for g in ls.generations:
+        out.append({
+            "epoch": g.epoch,
+            "begin": g.begin_version,
+            "end": g.end_version,
+            "tlogs": [(t.address.ip, t.address.port) if hasattr(t, "address")
+                      else t for t in g.tlogs],
+            "replication": g.replication,
+            "dead": sorted(g.dead),
+        })
+    return out
+
+
+def generations_from_config(cfg: list[dict], transport: Transport,
+                            base_token: int) -> list[LogGeneration]:
+    from ..rpc.transport import NetworkAddress
+    gens = []
+    for g in cfg:
+        stubs = [TLogClient(transport, NetworkAddress(ip, port), base_token)
+                 for ip, port in g["tlogs"]]
+        gens.append(LogGeneration(
+            epoch=g["epoch"], begin_version=g["begin"], tlogs=stubs,
+            replication=g["replication"], end_version=g["end"],
+            dead=set(g["dead"])))
+    return gens
+
+
+class Worker:
+    """Hosts role objects on one transport; recruited over RPC.
+
+    ``client_transport_factory`` supplies fresh outbound transports for
+    roles that consume other roles (each role gets its own, mirroring the
+    reference's per-process FlowTransport with distinct endpoints).
+    """
+
+    ROLE_NAMES = ("sequencer", "tlog", "resolver", "storage",
+                  "commit_proxy", "grv_proxy")
+
+    def __init__(self, worker_id: int, knobs: Knobs, transport: Transport,
+                 client_transport_factory: Callable[[], Transport],
+                 base_token: int) -> None:
+        self.id = worker_id
+        self.knobs = knobs
+        self.transport = transport
+        self.make_client_transport = client_transport_factory
+        self.base = base_token
+        self._next_block = base_token + TOKEN_BLOCK   # block 0: the worker itself
+        self.roles: dict[int, tuple[str, Any]] = {}   # token -> (role, obj)
+        serve_role(transport, "worker", self, base_token)
+
+    @property
+    def address(self):
+        return self.transport.address
+
+    # --- recruitment RPC surface ---
+
+    async def recruit(self, role: str, params: dict) -> int:
+        """Create a role object and serve it; returns its base token."""
+        k = self.knobs
+        token = self._next_block
+        self._next_block += TOKEN_BLOCK
+        obj = self._build_role(role, params or {}, k)
+        serve_role(self.transport, role, obj, token)
+        self.roles[token] = (role, obj)
+        if hasattr(obj, "start"):
+            obj.start()
+        TraceEvent("WorkerRecruited").detail("Worker", self.id) \
+            .detail("Role", role).detail("Token", token).log()
+        return token
+
+    async def stop_role(self, token: int) -> bool:
+        entry = self.roles.pop(token, None)
+        if entry is None:
+            return False
+        role, obj = entry
+        for i in range(TOKEN_BLOCK):
+            self.transport.dispatcher.unregister(token + i)
+        if hasattr(obj, "stop"):
+            await obj.stop()
+        return True
+
+    async def rejoin_storage(self, token: int, log_cfg: list,
+                             recovery_version: int) -> bool:
+        """Point a hosted storage server at a recovered log system."""
+        entry = self.roles.get(token)
+        if entry is None or entry[0] != "storage":
+            return False
+        ss: StorageServer = entry[1]
+        gens = generations_from_config(log_cfg, self.make_client_transport(),
+                                       self.base)
+        await ss.rejoin(gens, recovery_version)
+        return True
+
+    async def list_roles(self) -> list[tuple[int, str]]:
+        return sorted((tok, role) for tok, (role, _) in self.roles.items())
+
+    # --- shutdown (machine kill) ---
+
+    async def shutdown(self) -> None:
+        for token in list(self.roles):
+            await self.stop_role(token)
+
+    # --- role construction ---
+
+    def _build_role(self, role: str, p: dict, k: Knobs):
+        from ..rpc.transport import NetworkAddress
+
+        def addr(a):
+            return NetworkAddress(a[0], a[1])
+
+        if role == "sequencer":
+            return Sequencer(k, p.get("v0", 0))
+        if role == "tlog":
+            return TLog(k, p.get("v0", 0))
+        if role == "resolver":
+            return Resolver(k, KeyRange(p["begin"], p["end"]), p.get("v0", 0))
+        if role == "storage":
+            t = self.make_client_transport()
+            ls = LogSystem(generations_from_config(p["log_cfg"], t, self.base))
+            return StorageServer(k, p["tag"],
+                                 KeyRange(p["shard_begin"], p["shard_end"]),
+                                 ls, p.get("v0", 0))
+        if role == "commit_proxy":
+            t = self.make_client_transport()
+            seq = SequencerClient(t, addr(p["sequencer"]), self.base)
+            resolvers = [
+                ResolverClient(t, addr(a), self.base, KeyRange(b, e))
+                for a, b, e in p["resolvers"]]
+            ls = LogSystem(generations_from_config(p["log_cfg"], t, self.base))
+            shard_map = ShardMap(p["shard_boundaries"], p["shard_teams"])
+            return CommitProxy(k, seq, resolvers, ls, shard_map)
+        if role == "grv_proxy":
+            t = self.make_client_transport()
+            seq = SequencerClient(t, addr(p["sequencer"]), self.base)
+            return GrvProxy(k, seq)
+        raise ValueError(f"unknown role {role!r}")

@@ -29,6 +29,11 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
                 ("watch_value", False)],
     "commit_proxy": [("commit", False)],
     "grv_proxy": [("get_read_version", False)],
+    "coordinator": [("read", False), ("write", False),
+                    ("candidacy", False), ("leader_heartbeat", False),
+                    ("open_database", False)],
+    "worker": [("recruit", False), ("stop_role", False),
+               ("rejoin_storage", False), ("list_roles", False)],
 }
 
 TOKEN_BLOCK = 16  # tokens reserved per role instance
@@ -109,3 +114,11 @@ class CommitProxyClient(RoleClient):
 
 class GrvProxyClient(RoleClient):
     role = "grv_proxy"
+
+
+class CoordinatorClient(RoleClient):
+    role = "coordinator"
+
+
+class WorkerClient(RoleClient):
+    role = "worker"

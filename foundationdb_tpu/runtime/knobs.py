@@ -57,6 +57,11 @@ class Knobs:
     PING_INTERVAL: float = 0.25
     CONNECT_TIMEOUT: float = 2.0
 
+    # --- coordination / recovery ---
+    LEADER_LEASE_DURATION: float = 2.0
+    LEADER_HEARTBEAT_INTERVAL: float = 0.5
+    RECOVERY_RETRY_DELAY: float = 0.5
+
     # --- tlog ---
     TLOG_SPILL_THRESHOLD: int = 1 << 30
     DISK_QUEUE_PAGE_SIZE: int = 4096

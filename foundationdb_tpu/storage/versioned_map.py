@@ -155,6 +155,29 @@ class VersionedMap:
             i = bisect.bisect_left(self._index, key)
             del self._index[i]
 
+    def rollback_after(self, version: Version) -> None:
+        """Discard every entry newer than ``version`` — the storage-server
+        rollback at recovery (REF:fdbserver/storageserver.actor.cpp
+        rollback): mutations the server applied from a log generation's
+        unacked suffix were clamped out of the recovered history and must
+        be un-applied before pulling from the new generation."""
+        if version >= self.latest_version:
+            return
+        self.latest_version = version
+        dead: list[bytes] = []
+        for key, chain in self._chains.items():
+            i = len(chain)
+            while i > 0 and chain[i - 1][0] > version:
+                i -= 1
+            if i < len(chain):
+                del chain[i:]
+            if not chain:
+                dead.append(key)
+        for key in dead:
+            del self._chains[key]
+            i = bisect.bisect_left(self._index, key)
+            del self._index[i]
+
     def drop_before(self, version: Version) -> None:
         """Remove entries at or below ``version`` entirely (they are now
         durable in the engine); reads at those versions must fall through.
