@@ -1,0 +1,77 @@
+"""Bisect the pathological ~63ms dispatch seen in profile_resolver exp 6.
+
+A 2-op kernel (compare [64]x[65536] + sum) costs 63ms while a trivial
+scalar add costs 0.02ms.  Vary: array size, dtype, reduction, output
+shape/location, donation — to find which property triggers the cliff.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def timeit(fn, n=10, warmup=2):
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts) * 1e3)
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}")
+
+    def bench(name, fn, *arrs):
+        arrs = [jax.device_put(a, dev) for a in arrs]
+        j = jax.jit(fn)
+        j(*arrs).block_until_ready()
+        print(f"{name:48s} {timeit(lambda: j(*arrs).block_until_ready()):9.3f}ms")
+
+    for C in (1024, 8192, 65536):
+        h = jnp.arange(C, dtype=jnp.int32)
+        s = jnp.arange(64, dtype=jnp.int32)
+        bench(f"cmp+sum int32 [64]x[{C}]",
+              lambda h, s: (h[None, :] > s[:, None]).sum(), h, s)
+
+    C = 65536
+    h32 = jnp.arange(C, dtype=jnp.int32)
+    hf = jnp.arange(C, dtype=jnp.float32)
+    s32 = jnp.arange(64, dtype=jnp.int32)
+    sf = jnp.arange(64, dtype=jnp.float32)
+
+    bench("cmp+sum float32 [64]x[65536]",
+          lambda h, s: (h[None, :] > s[:, None]).sum(), hf, sf)
+    bench("cmp+any int32 [64]x[65536]",
+          lambda h, s: (h[None, :] > s[:, None]).any(), h32, s32)
+    bench("cmp only -> [64,65536] bool out",
+          lambda h, s: h[None, :] > s[:, None], h32, s32)
+    bench("cmp+reduce axis1 -> [64] out",
+          lambda h, s: (h[None, :] > s[:, None]).any(axis=1), h32, s32)
+    bench("sum [65536] alone", lambda h: h.sum(), h32)
+    bench("sum [65536] f32 alone", lambda h: h.sum(), hf)
+    bench("add [65536] -> [65536]", lambda h: h + 1, h32)
+    bench("add [64,65536] -> same", lambda h: h + 1,
+          jnp.zeros((64, 65536), jnp.int32))
+    bench("matmul 1024x1024 f32", lambda a: a @ a,
+          jnp.ones((1024, 1024), jnp.float32))
+    bench("matmul 1024 bf16", lambda a: a @ a,
+          jnp.ones((1024, 1024), jnp.bfloat16))
+    # scalar output vs array output
+    bench("scalar out: sum [64] f32", lambda s: s.sum(), sf)
+    # x64-affected: int64 arrays
+    h64 = jnp.arange(C, dtype=jnp.int64)
+    bench("sum [65536] int64 alone", lambda h: h.sum(), h64)
+
+
+if __name__ == "__main__":
+    main()

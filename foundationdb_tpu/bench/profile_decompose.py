@@ -1,0 +1,155 @@
+"""Decompose resolve_core: which stage costs ~67ms on the axon TPU?
+
+Stages timed as separate jits with real encoded-batch inputs:
+  A. _hist_check on window slice [B,R,W,L]
+  B. window gather hb[idx] (dynamic gather mod ptr)
+  C. intra-batch overlap matrix [B,R,B,R]
+  D. inner lax.scan commit resolution (64 steps)
+  E. ring scatter insert
+  F. full resolve_core, no donation
+  G. full resolve_core, donated
+  H. resolve_core without the lax.cond (window=0 full ring)
+  I. interaction: does running G slow down a subsequent trivial op?
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def timeit(fn, n=10, warmup=2):
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts) * 1e3)
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}")
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH, CAP, WIN = 64, 4, 32, 1 << 16, 4096
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(8, B)
+    txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                       coalesce_ranges(t.write_ranges, R), t.read_snapshot)
+            for t in batches[0]]
+    eb = encode_batch(txns, B, R, WIDTH)
+
+    state = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    rb = jax.device_put(jnp.asarray(eb.read_begin), dev)
+    re_ = jax.device_put(jnp.asarray(eb.read_end), dev)
+    wb = jax.device_put(jnp.asarray(eb.write_begin), dev)
+    we = jax.device_put(jnp.asarray(eb.write_end), dev)
+    sn = jax.device_put(jnp.asarray(eb.read_snapshot), dev)
+    cv = jnp.int64(versions[0])
+
+    def bench(name, j, *a, **kw):
+        out = j(*a, **kw)
+        jax.block_until_ready(out)
+        t = timeit(lambda: jax.block_until_ready(j(*a, **kw)))
+        print(f"{name:44s} {t:9.3f}ms")
+        return out
+
+    L = rb.shape[-1]
+
+    # A. hist check on a static window slice
+    hbw = state.hb[:WIN]
+    hew = state.he[:WIN]
+    hvw = state.hver[:WIN]
+    jA = jax.jit(lambda rb, re_, hb, he, hv, sn:
+                 cj._hist_check(rb, re_, hb, he, hv, sn, WIDTH))
+    bench("A hist_check [B,R,4096,L] static", jA, rb, re_, hbw, hew, hvw, sn)
+
+    # B. dynamic window gather
+    def gather(state):
+        idx = (state.ptr - WIN + jnp.arange(WIN)) % CAP
+        return state.hb[idx], state.he[idx], state.hver[idx]
+    jB = jax.jit(gather)
+    bench("B window gather hb[idx]", jB, state)
+
+    # B2. gather + hist check fused
+    def gh(state, rb, re_, sn):
+        hb, he, hv = gather(state)
+        return cj._hist_check(rb, re_, hb, he, hv, sn, WIDTH)
+    bench("B2 gather+hist_check fused", jax.jit(gh), state, rb, re_, sn)
+
+    # C. intra-batch matrix
+    def intra(rb, re_, wb, we):
+        m = cj._overlap(rb[:, :, None, None, :], re_[:, :, None, None, :],
+                        wb[None, None, :, :, :], we[None, None, :, :, :], WIDTH)
+        return m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
+    M = bench("C intra-batch [B,R,B,R] matrix", jax.jit(intra), rb, re_, wb, we)
+
+    # D. inner scan
+    hist = jnp.zeros(B, bool)
+    valid = jnp.ones(B, bool)
+    too_old = jnp.zeros(B, bool)
+    def inner(hist, M, valid, too_old):
+        def body(committed, i):
+            conf = hist[i] | (committed & M[i]).any()
+            commit_i = valid[i] & ~too_old[i] & ~conf
+            return committed.at[i].set(commit_i), conf
+        return lax.scan(body, jnp.zeros(B, bool), jnp.arange(B))
+    bench("D inner scan 64 steps", jax.jit(inner), hist, M, valid, too_old)
+
+    # E. ring scatter
+    committed = jnp.ones(B, bool)
+    def scat(state, wb, we, committed, cv):
+        valid_w = wb[..., -1] != jnp.uint32(0xFFFFFFFF)
+        ins = (committed[:, None] & valid_w).reshape(-1)
+        k = jnp.cumsum(ins) - ins
+        pos = jnp.where(ins, (state.ptr + k) % CAP, CAP).astype(jnp.int32)
+        wbf = jnp.where(ins[:, None], wb.reshape(B * R, L), jnp.uint32(0xFFFFFFFF))
+        hb2 = state.hb.at[pos].set(wbf)
+        hver2 = state.hver.at[pos].set(jnp.where(ins, cv, jnp.int64(-1)))
+        return hb2, hver2
+    bench("E ring scatter", jax.jit(scat), state, wb, we, committed, cv)
+
+    # F. full resolve_core, NOT donated
+    jF = jax.jit(cj.resolve_core, static_argnames=("width", "window"))
+    bench("F resolve_core no-donate window", jF, state, rb, re_, wb, we, sn, cv,
+          width=WIDTH, window=WIN)
+    bench("H resolve_core no-donate window=0", jF, state, rb, re_, wb, we, sn, cv,
+          width=WIDTH, window=0)
+
+    # G. donated (fresh state each call so donation is legal)
+    states = [jax.device_put(cj.init_state(CAP, WIDTH, 0), dev) for _ in range(14)]
+    jG = jax.jit(cj.resolve_core, static_argnames=("width", "window"),
+                 donate_argnums=(0,))
+    jax.block_until_ready(jG(states.pop(), rb, re_, wb, we, sn, cv,
+                             width=WIDTH, window=WIN))
+    ts = []
+    for _ in range(12):
+        st = states.pop()
+        t0 = time.perf_counter()
+        jax.block_until_ready(jG(st, rb, re_, wb, we, sn, cv,
+                                 width=WIDTH, window=WIN))
+        ts.append(time.perf_counter() - t0)
+    print(f"{'G resolve_core donated':44s} {np.median(ts)*1e3:9.3f}ms")
+
+    # I. trivial op after the heavy kernel
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    print(f"{'I trivial after heavy':44s} "
+          f"{timeit(lambda: jt(one).block_until_ready()):9.3f}ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Measure whether the axon tunnel overlaps work in degraded (post-readback)
+mode — the real RTT is ~64ms; throughput depends on pipelining.
+
+One fresh process.  First poison the session with a readback, then:
+  1. 16 independent dispatches, one block_until_ready at end  -> dispatch pipelining
+  2. compute 16 arrays, then 16 sequential np.asarray         -> serialized readbacks?
+  3. same but copy_to_host_async all 16 first                 -> async readback overlap
+  4. 16 np.asarray from 8 threads                             -> threaded overlap
+  5. one kernel returning a CONCAT of the 16 results, 1 readback -> fusion amortization
+  6. chained dependent dispatches (state threading) x16, 1 readback at end
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    xs = [jax.device_put(jnp.ones((256, 256), jnp.float32) * i, dev)
+          for i in range(16)]
+    f = jax.jit(lambda x: (x @ x).sum(axis=0))
+    f(xs[0]).block_until_ready()
+
+    # poison: one readback
+    t0 = time.perf_counter()
+    _ = np.asarray(f(xs[0]))
+    print(f"poison readback: {(time.perf_counter()-t0)*1e3:.1f}ms")
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    jt(one).block_until_ready()
+    t0 = time.perf_counter()
+    jt(one).block_until_ready()
+    print(f"trivial sync (degraded): {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # 1. independent dispatches, one sync
+    t0 = time.perf_counter()
+    outs = [f(x) for x in xs]
+    outs[-1].block_until_ready()
+    t1 = time.perf_counter()
+    jax.block_until_ready(outs)
+    print(f"1. 16 dispatch + 1 block: {(t1-t0)*1e3:.1f}ms; all block: "
+          f"{(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # 2. sequential readbacks
+    outs = [f(x) for x in xs]
+    jax.block_until_ready(outs)
+    t0 = time.perf_counter()
+    _ = [np.asarray(o) for o in outs]
+    print(f"2. 16 sequential np.asarray: {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # 3. async copy then fetch
+    outs = [f(x) for x in xs]
+    jax.block_until_ready(outs)
+    t0 = time.perf_counter()
+    for o in outs:
+        try:
+            o.copy_to_host_async()
+        except Exception as e:
+            print("copy_to_host_async failed:", e)
+            break
+    _ = [np.asarray(o) for o in outs]
+    print(f"3. async-copy + fetch 16:   {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # 4. threaded readbacks
+    outs = [f(x) for x in xs]
+    jax.block_until_ready(outs)
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        list(ex.map(np.asarray, outs))
+    print(f"4. threaded(8) 16 asarray:  {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # 5. fused output, one readback
+    g = jax.jit(lambda *xs: jnp.stack([(x @ x).sum(axis=0) for x in xs]))
+    g(*xs).block_until_ready()
+    t0 = time.perf_counter()
+    _ = np.asarray(g(*xs))
+    print(f"5. fused 16->1 readback:    {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # 6. dependent chain, single sync
+    h = jax.jit(lambda s, x: s + (x @ x).sum(axis=0))
+    s = jax.device_put(jnp.zeros(256, jnp.float32), dev)
+    h(s, xs[0]).block_until_ready()
+    t0 = time.perf_counter()
+    for x in xs:
+        s = h(s, x)
+    _ = np.asarray(s)
+    print(f"6. 16-chain + 1 readback:   {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # 6b. repeat to see steady-state
+    t0 = time.perf_counter()
+    for x in xs:
+        s = h(s, x)
+    _ = np.asarray(s)
+    print(f"6b. again:                  {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

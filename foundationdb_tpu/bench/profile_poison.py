@@ -1,0 +1,171 @@
+"""Find which op combination in resolve_core poisons the axon dispatch path.
+
+After resolve_core runs once, EVERY subsequent dispatch (even x+1) takes
+~70ms for the rest of the process (profile_decompose exp I).  Each mode
+here runs in a FRESH process: build a candidate kernel, run it 3x, then
+time a trivial op.  If the trivial op is slow, that mode contains the
+poison.
+
+Usage: python -m foundationdb_tpu.bench.profile_poison MODE
+       python -m foundationdb_tpu.bench.profile_poison --all   (spawns children)
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+MODES = [
+    "nostate",      # hist+intra+innerscan only, no state outputs
+    "noscatter",    # + floor/ptr math, no ring scatter
+    "noint64",      # full kernel but hver/versions as int32
+    "nocond",       # full kernel, window=0 (no lax.cond)
+    "nofloor",      # full kernel minus the floor=max(old) reduction
+    "full",         # resolve_core as shipped
+    "smallcap",     # full kernel, CAP=1024
+    "donate",       # full kernel + donation
+]
+
+
+def run_mode(mode: str) -> None:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH = 64, 4, 32
+    CAP = 1024 if mode == "smallcap" else 1 << 16
+    WIN = 0 if mode in ("nocond",) else 4096
+    if WIN >= CAP:
+        WIN = 0
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(4, B)
+    txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                       coalesce_ranges(t.write_ranges, R), t.read_snapshot)
+            for t in batches[0]]
+    eb = encode_batch(txns, B, R, WIDTH)
+
+    state = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    if mode == "noint64":
+        state = state._replace(hver=state.hver.astype(jnp.int32),
+                               floor=state.floor.astype(jnp.int32))
+    rb = jax.device_put(jnp.asarray(eb.read_begin), dev)
+    re_ = jax.device_put(jnp.asarray(eb.read_end), dev)
+    wb = jax.device_put(jnp.asarray(eb.write_begin), dev)
+    we = jax.device_put(jnp.asarray(eb.write_end), dev)
+    sn0 = jnp.asarray(eb.read_snapshot)
+    sn = jax.device_put(sn0.astype(jnp.int32) if mode == "noint64" else sn0, dev)
+    cv = (jnp.int32 if mode == "noint64" else jnp.int64)(versions[0])
+
+    L = rb.shape[-1]
+
+    def kernel(state, rb, re_, wb, we, sn, cv):
+        C = state.hver.shape[0] - 1
+        hb, he, hver = state.hb[:C], state.he[:C], state.hver[:C]
+        too_old = sn < state.floor
+        valid = sn >= 0
+        if WIN:
+            idx = (state.ptr - WIN + jnp.arange(WIN)) % C
+            v_edge = state.hver[(state.ptr - WIN - 1) % C]
+            fast_ok = jnp.all(~valid | too_old | (sn >= v_edge))
+            hist = lax.cond(
+                fast_ok,
+                lambda _: cj._hist_check(rb, re_, hb[idx], he[idx], hver[idx], sn, WIDTH),
+                lambda _: cj._hist_check(rb, re_, hb, he, hver, sn, WIDTH),
+                None)
+        else:
+            hist = cj._hist_check(rb, re_, hb, he, hver, sn, WIDTH)
+        m = cj._overlap(rb[:, :, None, None, :], re_[:, :, None, None, :],
+                        wb[None, None, :, :, :], we[None, None, :, :, :], WIDTH)
+        M = m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
+
+        def body(committed, i):
+            conf = hist[i] | (committed & M[i]).any()
+            commit_i = valid[i] & ~too_old[i] & ~conf
+            verdict = jnp.where(~valid[i], cj.COMMITTED,
+                                jnp.where(too_old[i], cj.TOO_OLD,
+                                          jnp.where(conf, cj.CONFLICT, cj.COMMITTED)))
+            return committed.at[i].set(commit_i), verdict
+
+        committed, verdicts = lax.scan(body, jnp.zeros(B, bool), jnp.arange(B))
+        if mode == "nostate":
+            return verdicts
+
+        valid_w = wb[..., -1] != jnp.uint32(0xFFFFFFFF)
+        ins = (committed[:, None] & valid_w).reshape(-1)
+        k = jnp.cumsum(ins) - ins
+        pos = jnp.where(ins, (state.ptr + k) % C, C).astype(jnp.int32)
+        if mode == "noscatter":
+            ptr2 = ((state.ptr + jnp.sum(ins)) % C).astype(jnp.int32)
+            return state._replace(ptr=ptr2), verdicts
+        old = jnp.where(ins, state.hver[pos], jnp.asarray(-1, state.hver.dtype))
+        if mode == "nofloor":
+            floor2 = state.floor
+        else:
+            floor2 = jnp.maximum(state.floor, jnp.max(old))
+        wbf = jnp.where(ins[:, None], wb.reshape(B * R, L), jnp.uint32(0xFFFFFFFF))
+        wef = jnp.where(ins[:, None], we.reshape(B * R, L), jnp.uint32(0xFFFFFFFF))
+        hb2 = state.hb.at[pos].set(wbf)
+        he2 = state.he.at[pos].set(wef)
+        hver2 = state.hver.at[pos].set(
+            jnp.where(ins, cv, jnp.asarray(-1, state.hver.dtype)))
+        ptr2 = ((state.ptr + jnp.sum(ins)) % C).astype(jnp.int32)
+        return cj.ConflictState(hb2, he2, hver2, ptr2, floor2), verdicts
+
+    donate = (0,) if mode == "donate" else ()
+    j = jax.jit(kernel, donate_argnums=donate)
+
+    t0 = time.perf_counter()
+    out = j(state, rb, re_, wb, we, sn, cv)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+
+    ts = []
+    for _ in range(3):
+        if mode == "donate":
+            state = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+        t0 = time.perf_counter()
+        out = j(state, rb, re_, wb, we, sn, cv)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+
+    # the tell: trivial op afterwards
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    jt(one).block_until_ready()
+    tt = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        tt.append(time.perf_counter() - t0)
+
+    print(f"MODE {mode:10s} kernel_med={np.median(ts)*1e3:8.3f}ms "
+          f"trivial_after={np.median(tt)*1e3:8.3f}ms compile={compile_s:.1f}s",
+          flush=True)
+
+
+def main():
+    if sys.argv[1] == "--all":
+        for m in MODES:
+            r = subprocess.run([sys.executable, "-m",
+                                "foundationdb_tpu.bench.profile_poison", m],
+                               capture_output=True, text=True, timeout=300)
+            out = [l for l in r.stdout.splitlines() if l.startswith("MODE")]
+            print(out[0] if out else f"MODE {m}: FAILED\n{r.stderr[-500:]}",
+                  flush=True)
+    else:
+        run_mode(sys.argv[1])
+
+
+if __name__ == "__main__":
+    main()

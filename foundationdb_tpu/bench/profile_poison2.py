@@ -1,0 +1,157 @@
+"""Round 2 of poison bisection (fresh process per mode).
+
+profile_poison showed the ~70ms session poison occurs even with
+no scatter / no int64 / no cond / CAP=1024.  Candidates left: the
+combination hist+intra+scan, or simply *compiling anything slow*.
+
+Modes (all CAP=1024, window=0 unless said):
+  compileonly — lower+compile the full kernel, NEVER execute; then trivial
+  bigcompile  — compile+run an unrelated 5s-compile fn (chain of matmuls)
+  p1 hist     — _hist_check only
+  p2 intra    — overlap matrix only
+  p3 histintra— both, no scan
+  p4 scan     — hist+intra+lax.scan(committed)
+  p5 verdict  — p4 + int8 verdict chain (== nostate@smallcap)
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+MODES = ["compileonly", "bigcompile", "p1", "p2", "p3", "p4", "p5"]
+
+
+def run_mode(mode: str) -> None:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH, CAP = 64, 4, 32, 1024
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(4, B)
+    txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                       coalesce_ranges(t.write_ranges, R), t.read_snapshot)
+            for t in batches[0]]
+    eb = encode_batch(txns, B, R, WIDTH)
+
+    state = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    rb = jax.device_put(jnp.asarray(eb.read_begin), dev)
+    re_ = jax.device_put(jnp.asarray(eb.read_end), dev)
+    wb = jax.device_put(jnp.asarray(eb.write_begin), dev)
+    we = jax.device_put(jnp.asarray(eb.write_end), dev)
+    sn = jax.device_put(jnp.asarray(eb.read_snapshot), dev)
+
+    hb, he, hver = state.hb[:CAP], state.he[:CAP], state.hver[:CAP]
+    too_old = sn < state.floor
+    valid = sn >= 0
+
+    def khist(rb, re_, hb, he, hver, sn):
+        return cj._hist_check(rb, re_, hb, he, hver, sn, WIDTH)
+
+    def kintra(rb, re_, wb, we):
+        m = cj._overlap(rb[:, :, None, None, :], re_[:, :, None, None, :],
+                        wb[None, None, :, :, :], we[None, None, :, :, :], WIDTH)
+        return m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
+
+    def kboth(rb, re_, wb, we, hb, he, hver, sn):
+        return khist(rb, re_, hb, he, hver, sn), kintra(rb, re_, wb, we)
+
+    def kscan(rb, re_, wb, we, hb, he, hver, sn, valid, too_old):
+        hist, M = kboth(rb, re_, wb, we, hb, he, hver, sn)
+        def body(committed, i):
+            conf = hist[i] | (committed & M[i]).any()
+            return committed.at[i].set(valid[i] & ~too_old[i] & ~conf), conf
+        return lax.scan(body, jnp.zeros(B, bool), jnp.arange(B))
+
+    def kverd(rb, re_, wb, we, hb, he, hver, sn, valid, too_old):
+        hist, M = kboth(rb, re_, wb, we, hb, he, hver, sn)
+        def body(committed, i):
+            conf = hist[i] | (committed & M[i]).any()
+            commit_i = valid[i] & ~too_old[i] & ~conf
+            verdict = jnp.where(~valid[i], cj.COMMITTED,
+                                jnp.where(too_old[i], cj.TOO_OLD,
+                                          jnp.where(conf, cj.CONFLICT, cj.COMMITTED)))
+            return committed.at[i].set(commit_i), verdict
+        return lax.scan(body, jnp.zeros(B, bool), jnp.arange(B))
+
+    compile_s = 0.0
+    if mode == "compileonly":
+        t0 = time.perf_counter()
+        jax.jit(kverd).lower(rb, re_, wb, we, hb, he, hver, sn,
+                             valid, too_old).compile()
+        compile_s = time.perf_counter() - t0
+        ts = [0.0]
+    elif mode == "bigcompile":
+        def chain(a):
+            for _ in range(200):
+                a = jnp.tanh(a @ a) + a
+            return a
+        a = jnp.ones((256, 256), jnp.float32)
+        jc = jax.jit(chain)
+        t0 = time.perf_counter()
+        jc(a).block_until_ready()
+        compile_s = time.perf_counter() - t0
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jc(a).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+    else:
+        fn, arga = {
+            "p1": (khist, (rb, re_, hb, he, hver, sn)),
+            "p2": (kintra, (rb, re_, wb, we)),
+            "p3": (kboth, (rb, re_, wb, we, hb, he, hver, sn)),
+            "p4": (kscan, (rb, re_, wb, we, hb, he, hver, sn, valid, too_old)),
+            "p5": (kverd, (rb, re_, wb, we, hb, he, hver, sn, valid, too_old)),
+        }[mode]
+        j = jax.jit(fn)
+        t0 = time.perf_counter()
+        jax.block_until_ready(j(*arga))
+        compile_s = time.perf_counter() - t0
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(j(*arga))
+            ts.append(time.perf_counter() - t0)
+
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    jt(one).block_until_ready()
+    tt = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        tt.append(time.perf_counter() - t0)
+
+    print(f"MODE {mode:12s} kernel_med={np.median(ts)*1e3:8.3f}ms "
+          f"trivial_after={np.median(tt)*1e3:8.3f}ms compile={compile_s:.1f}s",
+          flush=True)
+
+
+def main():
+    if sys.argv[1] == "--all":
+        for m in MODES:
+            r = subprocess.run([sys.executable, "-m",
+                                "foundationdb_tpu.bench.profile_poison2", m],
+                               capture_output=True, text=True, timeout=300)
+            out = [l for l in r.stdout.splitlines() if l.startswith("MODE")]
+            print(out[0] if out else f"MODE {m}: FAILED\n{r.stderr[-500:]}",
+                  flush=True)
+    else:
+        run_mode(sys.argv[1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Round 3: confirm which verdict construction avoids the int8-in-scan poison.
+
+Modes (fresh process each, CAP=65536, window=4096, donation — i.e. the real
+resolve_step shape):
+  v1 int32 verdict chain inside scan
+  v2 scan returns conf bool; int8 where-chain vectorized OUTSIDE scan
+  v3 like v2 but int32 outside
+  v4 real resolve_core as shipped (control — expect poisoned)
+  v5 v2-style patched resolve_core at full config incl. scatter+donate
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+MODES = ["v1", "v2", "v3", "v4", "v5"]
+
+
+def run_mode(mode: str) -> None:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH, CAP, WIN = 64, 4, 32, 1 << 16, 4096
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(4, B)
+    txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                       coalesce_ranges(t.write_ranges, R), t.read_snapshot)
+            for t in batches[0]]
+    eb = encode_batch(txns, B, R, WIDTH)
+
+    state = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    rb = jax.device_put(jnp.asarray(eb.read_begin), dev)
+    re_ = jax.device_put(jnp.asarray(eb.read_end), dev)
+    wb = jax.device_put(jnp.asarray(eb.write_begin), dev)
+    we = jax.device_put(jnp.asarray(eb.write_end), dev)
+    sn = jax.device_put(jnp.asarray(eb.read_snapshot), dev)
+    cv = jnp.int64(versions[0])
+    L = rb.shape[-1]
+
+    def core_patched(state, rb, re_, wb, we, sn, cv, verdict_mode):
+        C = state.hver.shape[0] - 1
+        hb, he, hver = state.hb[:C], state.he[:C], state.hver[:C]
+        too_old = sn < state.floor
+        valid = sn >= 0
+        idx = (state.ptr - WIN + jnp.arange(WIN)) % C
+        v_edge = state.hver[(state.ptr - WIN - 1) % C]
+        fast_ok = jnp.all(~valid | too_old | (sn >= v_edge))
+        hist = lax.cond(
+            fast_ok,
+            lambda _: cj._hist_check(rb, re_, hb[idx], he[idx], hver[idx], sn, WIDTH),
+            lambda _: cj._hist_check(rb, re_, hb, he, hver, sn, WIDTH), None)
+        m = cj._overlap(rb[:, :, None, None, :], re_[:, :, None, None, :],
+                        wb[None, None, :, :, :], we[None, None, :, :, :], WIDTH)
+        M = m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
+
+        if verdict_mode == "v1":
+            def body(committed, i):
+                conf = hist[i] | (committed & M[i]).any()
+                commit_i = valid[i] & ~too_old[i] & ~conf
+                verdict = jnp.where(~valid[i], jnp.int32(0),
+                                    jnp.where(too_old[i], jnp.int32(2),
+                                              jnp.where(conf, jnp.int32(1),
+                                                        jnp.int32(0))))
+                return committed.at[i].set(commit_i), verdict
+            committed, verdicts = lax.scan(body, jnp.zeros(B, bool), jnp.arange(B))
+        else:
+            def body(committed, i):
+                conf = hist[i] | (committed & M[i]).any()
+                return committed.at[i].set(valid[i] & ~too_old[i] & ~conf), conf
+            committed, conf = lax.scan(body, jnp.zeros(B, bool), jnp.arange(B))
+            dt = jnp.int8 if verdict_mode == "v2" else jnp.int32
+            verdicts = jnp.where(~valid, dt(0),
+                                 jnp.where(too_old, dt(2),
+                                           jnp.where(conf, dt(1), dt(0))))
+
+        valid_w = wb[..., -1] != jnp.uint32(0xFFFFFFFF)
+        ins = (committed[:, None] & valid_w).reshape(-1)
+        k = jnp.cumsum(ins) - ins
+        pos = jnp.where(ins, (state.ptr + k) % C, C).astype(jnp.int32)
+        old = jnp.where(ins, state.hver[pos], jnp.int64(-1))
+        floor2 = jnp.maximum(state.floor, jnp.max(old))
+        wbf = jnp.where(ins[:, None], wb.reshape(B * R, L), jnp.uint32(0xFFFFFFFF))
+        wef = jnp.where(ins[:, None], we.reshape(B * R, L), jnp.uint32(0xFFFFFFFF))
+        hb2 = state.hb.at[pos].set(wbf)
+        he2 = state.he.at[pos].set(wef)
+        hver2 = state.hver.at[pos].set(jnp.where(ins, cv, jnp.int64(-1)))
+        ptr2 = ((state.ptr + jnp.sum(ins)) % C).astype(jnp.int32)
+        return cj.ConflictState(hb2, he2, hver2, ptr2, floor2), verdicts
+
+    if mode == "v4":
+        j = jax.jit(cj.resolve_core, static_argnames=("width", "window"))
+        arga = (state, rb, re_, wb, we, sn, cv)
+        kw = {"width": WIDTH, "window": WIN}
+    else:
+        vm = {"v1": "v1", "v2": "v2", "v3": "v3", "v5": "v2"}[mode]
+        donate = (0,) if mode == "v5" else ()
+        j = jax.jit(lambda s, a, b, c, d, e, f: core_patched(s, a, b, c, d, e, f, vm),
+                    donate_argnums=donate)
+        arga = (state, rb, re_, wb, we, sn, cv)
+        kw = {}
+
+    t0 = time.perf_counter()
+    out = j(*arga, **kw)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    ts = []
+    st = out[0]
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = j(st, *arga[1:], **kw)
+        jax.block_until_ready(out)
+        st = out[0]
+        ts.append(time.perf_counter() - t0)
+
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    jt(one).block_until_ready()
+    tt = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        tt.append(time.perf_counter() - t0)
+
+    print(f"MODE {mode:4s} kernel_med={np.median(ts)*1e3:8.3f}ms "
+          f"trivial_after={np.median(tt)*1e3:8.3f}ms compile={compile_s:.1f}s",
+          flush=True)
+
+
+def main():
+    if sys.argv[1] == "--all":
+        for m in MODES:
+            r = subprocess.run([sys.executable, "-m",
+                                "foundationdb_tpu.bench.profile_poison3", m],
+                               capture_output=True, text=True, timeout=300)
+            out = [l for l in r.stdout.splitlines() if l.startswith("MODE")]
+            print(out[0] if out else f"MODE {m}: FAILED\n{r.stderr[-600:]}",
+                  flush=True)
+    else:
+        run_mode(sys.argv[1])
+
+
+if __name__ == "__main__":
+    main()

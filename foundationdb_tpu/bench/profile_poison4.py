@@ -1,0 +1,155 @@
+"""Round 4: actual cj.resolve_step is still ~67ms while an inline copy of
+the same math is 0.18ms.  Fresh process per mode:
+
+  r1  cj.resolve_step, inputs pre-device, cv created once
+  r2  cj.resolve_step, jnp.asarray + jnp.int64 per call (backend style)
+  r3  jax.jit(cj.resolve_core) no donate, pre-device inputs
+  r4  inline copy of resolve_core body (control, expect fast)
+  r5  r3 but module int8 constants replaced by inline ones via monkeypatch
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+MODES = ["r1", "r2", "r3", "r4", "r5"]
+
+
+def run_mode(mode: str) -> None:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH, CAP, WIN = 64, 4, 32, 1 << 16, 4096
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(4, B)
+    txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                       coalesce_ranges(t.write_ranges, R), t.read_snapshot)
+            for t in batches[0]]
+    eb = encode_batch(txns, B, R, WIDTH)
+
+    if mode == "r5":
+        cj.COMMITTED, cj.CONFLICT, cj.TOO_OLD = (
+            jnp.int8(0), jnp.int8(1), jnp.int8(2))
+
+    state = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    rb = jax.device_put(jnp.asarray(eb.read_begin), dev)
+    re_ = jax.device_put(jnp.asarray(eb.read_end), dev)
+    wb = jax.device_put(jnp.asarray(eb.write_begin), dev)
+    we = jax.device_put(jnp.asarray(eb.write_end), dev)
+    sn = jax.device_put(jnp.asarray(eb.read_snapshot), dev)
+    cv = jnp.int64(versions[0])
+
+    ts = []
+    if mode in ("r1", "r2"):
+        st = state
+        for i in range(6):
+            t0 = time.perf_counter()
+            if mode == "r1":
+                st, v = cj.resolve_step(st, rb, re_, wb, we, sn, cv,
+                                        width=WIDTH, window=WIN)
+            else:
+                e = eb
+                st, v = cj.resolve_step(
+                    st, jnp.asarray(e.read_begin), jnp.asarray(e.read_end),
+                    jnp.asarray(e.write_begin), jnp.asarray(e.write_end),
+                    jnp.asarray(e.read_snapshot), jnp.int64(versions[i % 4]),
+                    width=WIDTH, window=WIN)
+            v.block_until_ready()
+            ts.append(time.perf_counter() - t0)
+    elif mode in ("r3", "r5"):
+        j = jax.jit(cj.resolve_core, static_argnames=("width", "window"))
+        st = state
+        for i in range(6):
+            t0 = time.perf_counter()
+            st, v = j(st, rb, re_, wb, we, sn, cv, width=WIDTH, window=WIN)
+            v.block_until_ready()
+            ts.append(time.perf_counter() - t0)
+    else:  # r4 inline control
+        from jax import lax
+
+        def core(state, rb, re_, wb, we, sn, cv):
+            C = state.hver.shape[0] - 1
+            Bl, Rl, L = rb.shape
+            hb, he, hver = state.hb[:C], state.he[:C], state.hver[:C]
+            too_old = sn < state.floor
+            valid = sn >= 0
+            idx = (state.ptr - WIN + jnp.arange(WIN)) % C
+            v_edge = state.hver[(state.ptr - WIN - 1) % C]
+            fast_ok = jnp.all(~valid | too_old | (sn >= v_edge))
+            hist = lax.cond(
+                fast_ok,
+                lambda _: cj._hist_check(rb, re_, hb[idx], he[idx], hver[idx], sn, WIDTH),
+                lambda _: cj._hist_check(rb, re_, hb, he, hver, sn, WIDTH), None)
+            m = cj._overlap(rb[:, :, None, None, :], re_[:, :, None, None, :],
+                            wb[None, None, :, :, :], we[None, None, :, :, :], WIDTH)
+            M = m.any(axis=(1, 3)) & ~jnp.eye(Bl, dtype=bool)
+
+            def body(committed, i):
+                conf = hist[i] | (committed & M[i]).any()
+                return committed.at[i].set(valid[i] & ~too_old[i] & ~conf), conf
+            committed, conf = lax.scan(body, jnp.zeros(Bl, bool), jnp.arange(Bl))
+            verdicts = jnp.where(~valid, cj.COMMITTED,
+                                 jnp.where(too_old, cj.TOO_OLD,
+                                           jnp.where(conf, cj.CONFLICT, cj.COMMITTED)))
+            valid_w = wb[..., -1] != jnp.uint32(0xFFFFFFFF)
+            ins = (committed[:, None] & valid_w).reshape(-1)
+            k = jnp.cumsum(ins) - ins
+            pos = jnp.where(ins, (state.ptr + k) % C, C).astype(jnp.int32)
+            old = jnp.where(ins, state.hver[pos], jnp.int64(-1))
+            floor2 = jnp.maximum(state.floor, jnp.max(old))
+            wbf = jnp.where(ins[:, None], wb.reshape(Bl * Rl, L), jnp.uint32(0xFFFFFFFF))
+            wef = jnp.where(ins[:, None], we.reshape(Bl * Rl, L), jnp.uint32(0xFFFFFFFF))
+            hb2 = state.hb.at[pos].set(wbf)
+            he2 = state.he.at[pos].set(wef)
+            hver2 = state.hver.at[pos].set(jnp.where(ins, cv, jnp.int64(-1)))
+            ptr2 = ((state.ptr + jnp.sum(ins)) % C).astype(jnp.int32)
+            return cj.ConflictState(hb2, he2, hver2, ptr2, floor2), verdicts
+
+        j = jax.jit(core)
+        st = state
+        for i in range(6):
+            t0 = time.perf_counter()
+            st, v = j(st, rb, re_, wb, we, sn, cv)
+            v.block_until_ready()
+            ts.append(time.perf_counter() - t0)
+
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    jt(one).block_until_ready()
+    tt = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        tt.append(time.perf_counter() - t0)
+
+    print(f"MODE {mode:4s} first={ts[0]*1e3:9.1f}ms med_rest={np.median(ts[1:])*1e3:8.3f}ms "
+          f"trivial_after={np.median(tt)*1e3:8.3f}ms", flush=True)
+
+
+def main():
+    if sys.argv[1] == "--all":
+        for m in MODES:
+            r = subprocess.run([sys.executable, "-m",
+                                "foundationdb_tpu.bench.profile_poison4", m],
+                               capture_output=True, text=True, timeout=300)
+            out = [l for l in r.stdout.splitlines() if l.startswith("MODE")]
+            print(out[0] if out else f"MODE {m}: FAILED\n{r.stderr[-600:]}",
+                  flush=True)
+    else:
+        run_mode(sys.argv[1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,152 @@
+"""Round 5: pin down why the PATCHED resolve_core is still slow.
+
+Suspect: the module-level concrete int8 device arrays (COMMITTED/CONFLICT/
+TOO_OLD) captured as jit constants.  In-trace-created jnp.int8(0) was fast
+(poison3 v2/v5), module constants slow (poison4 r4/r5).
+
+Fresh process per mode, run fast-expected first:
+  d  inline patched kernel, in-trace jnp.int8 constants (v5 replica control)
+  b  cj.resolve_step but constants monkeypatched to np.int8 HOST scalars
+  c  cj.resolve_step but constants monkeypatched to np.int32 host scalars
+  a  cj.resolve_step as-is (module jnp.int8 device constants) — expect slow
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+MODES = ["d", "b", "c", "a"]
+
+
+def run_mode(mode: str) -> None:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+
+    # trivial-op baseline BEFORE anything heavy
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    jt(one).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        jt(one).block_until_ready()
+    pre_trivial = (time.perf_counter() - t0) / 5 * 1e3
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH, CAP, WIN = 64, 4, 32, 1 << 16, 4096
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(4, B)
+    txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                       coalesce_ranges(t.write_ranges, R), t.read_snapshot)
+            for t in batches[0]]
+    eb = encode_batch(txns, B, R, WIDTH)
+
+    if mode == "b":
+        cj.COMMITTED, cj.CONFLICT, cj.TOO_OLD = (
+            np.int8(0), np.int8(1), np.int8(2))
+    elif mode == "c":
+        cj.COMMITTED, cj.CONFLICT, cj.TOO_OLD = (
+            np.int32(0), np.int32(1), np.int32(2))
+
+    state = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    rb = jax.device_put(jnp.asarray(eb.read_begin), dev)
+    re_ = jax.device_put(jnp.asarray(eb.read_end), dev)
+    wb = jax.device_put(jnp.asarray(eb.write_begin), dev)
+    we = jax.device_put(jnp.asarray(eb.write_end), dev)
+    sn = jax.device_put(jnp.asarray(eb.read_snapshot), dev)
+    cv = jnp.int64(versions[0])
+
+    ts = []
+    if mode == "d":
+        def core(state, rb, re_, wb, we, sn, cv):
+            C = state.hver.shape[0] - 1
+            Bl, Rl, L = rb.shape
+            hb, he, hver = state.hb[:C], state.he[:C], state.hver[:C]
+            too_old = sn < state.floor
+            valid = sn >= 0
+            idx = (state.ptr - WIN + jnp.arange(WIN)) % C
+            v_edge = state.hver[(state.ptr - WIN - 1) % C]
+            fast_ok = jnp.all(~valid | too_old | (sn >= v_edge))
+            hist = lax.cond(
+                fast_ok,
+                lambda _: cj._hist_check(rb, re_, hb[idx], he[idx], hver[idx], sn, WIDTH),
+                lambda _: cj._hist_check(rb, re_, hb, he, hver, sn, WIDTH), None)
+            m = cj._overlap(rb[:, :, None, None, :], re_[:, :, None, None, :],
+                            wb[None, None, :, :, :], we[None, None, :, :, :], WIDTH)
+            M = m.any(axis=(1, 3)) & ~jnp.eye(Bl, dtype=bool)
+
+            def body(committed, i):
+                conf = hist[i] | (committed & M[i]).any()
+                return committed.at[i].set(valid[i] & ~too_old[i] & ~conf), conf
+            committed, conf = lax.scan(body, jnp.zeros(Bl, bool), jnp.arange(Bl))
+            dt = jnp.int8
+            verdicts = jnp.where(~valid, dt(0),
+                                 jnp.where(too_old, dt(2),
+                                           jnp.where(conf, dt(1), dt(0))))
+            valid_w = wb[..., -1] != jnp.uint32(0xFFFFFFFF)
+            ins = (committed[:, None] & valid_w).reshape(-1)
+            k = jnp.cumsum(ins) - ins
+            pos = jnp.where(ins, (state.ptr + k) % C, C).astype(jnp.int32)
+            old = jnp.where(ins, state.hver[pos], jnp.int64(-1))
+            floor2 = jnp.maximum(state.floor, jnp.max(old))
+            wbf = jnp.where(ins[:, None], wb.reshape(Bl * Rl, L), jnp.uint32(0xFFFFFFFF))
+            wef = jnp.where(ins[:, None], we.reshape(Bl * Rl, L), jnp.uint32(0xFFFFFFFF))
+            hb2 = state.hb.at[pos].set(wbf)
+            he2 = state.he.at[pos].set(wef)
+            hver2 = state.hver.at[pos].set(jnp.where(ins, cv, jnp.int64(-1)))
+            ptr2 = ((state.ptr + jnp.sum(ins)) % C).astype(jnp.int32)
+            return cj.ConflictState(hb2, he2, hver2, ptr2, floor2), verdicts
+
+        j = jax.jit(core)
+        st = state
+        for i in range(6):
+            t0 = time.perf_counter()
+            st, v = j(st, rb, re_, wb, we, sn, cv)
+            v.block_until_ready()
+            ts.append(time.perf_counter() - t0)
+    else:
+        st = state
+        for i in range(6):
+            t0 = time.perf_counter()
+            st, v = cj.resolve_step(st, rb, re_, wb, we, sn, cv,
+                                    width=WIDTH, window=WIN)
+            v.block_until_ready()
+            ts.append(time.perf_counter() - t0)
+
+    tt = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        tt.append(time.perf_counter() - t0)
+
+    print(f"MODE {mode:2s} pre_trivial={pre_trivial:7.3f}ms first={ts[0]*1e3:9.1f}ms "
+          f"med_rest={np.median(ts[1:])*1e3:8.3f}ms "
+          f"trivial_after={np.median(tt)*1e3:8.3f}ms", flush=True)
+
+
+def main():
+    if sys.argv[1] == "--all":
+        for m in MODES:
+            r = subprocess.run([sys.executable, "-m",
+                                "foundationdb_tpu.bench.profile_poison5", m],
+                               capture_output=True, text=True, timeout=300)
+            out = [l for l in r.stdout.splitlines() if l.startswith("MODE")]
+            print(out[0] if out else f"MODE {m}: FAILED\n{r.stderr[-600:]}",
+                  flush=True)
+    else:
+        run_mode(sys.argv[1])
+
+
+if __name__ == "__main__":
+    main()

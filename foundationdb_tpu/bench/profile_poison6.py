@@ -1,0 +1,106 @@
+"""Round 6: the fixed kernel is fast standalone (poison5 b) but bench.py
+still sees ~80ms/batch.  Bisect the bench's own path, fresh process per mode:
+
+  m1  make_conflict_backend("tpu", device) -> backend.resolve serial x10
+  m2  m1 but run the cpp backend phase first (bench order)
+  m3  m1 but with bench's warmup-then-fresh-backend dance
+  m4  raw JaxConflictSet.resolve_encoded (no EncodedConflictBackend wrap)
+  m5  m1 but cv passed as python int each call (no jnp.int64 wrapper)
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+MODES = ["m4", "m5", "m1", "m3", "m2"]
+
+
+def run_mode(mode: str) -> None:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    jt(one).block_until_ready()
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+    from foundationdb_tpu.runtime import Knobs
+
+    wl = MakoWorkload(n_keys=100_000, seed=42)
+    batches, versions = wl.make_batches(12, 64)
+    warm_batches, warm_versions = wl.make_batches(
+        8, 64, start_version=versions[-1] + 10_000_000)
+
+    knobs = Knobs().override(
+        RESOLVER_BATCH_TXNS=64, RESOLVER_RANGES_PER_TXN=4,
+        CONFLICT_RING_CAPACITY=1 << 16, KEY_ENCODE_BYTES=32,
+        RESOLVER_CONFLICT_BACKEND="tpu")
+
+    if mode == "m2":
+        cppb = make_conflict_backend(knobs.override(RESOLVER_CONFLICT_BACKEND="cpp"))
+        for txns, v in zip(warm_batches, warm_versions):
+            cppb.resolve(txns, v)
+
+    if mode == "m4":
+        from foundationdb_tpu.ops.conflict_jax import JaxConflictSet
+        from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+        from foundationdb_tpu.ops.backends import coalesce_ranges
+        cs = JaxConflictSet(1 << 16, 32, device=dev, window=4096)
+        ebs = []
+        for txns in batches:
+            txns = [TxnRequest(coalesce_ranges(t.read_ranges, 4),
+                               coalesce_ranges(t.write_ranges, 4),
+                               t.read_snapshot) for t in txns]
+            ebs.append(encode_batch(txns, 64, 4, 32))
+        # warm
+        cs.resolve_encoded(ebs[0], versions[0] - 20_000_000)
+        ts = []
+        for eb, v in zip(ebs[1:], versions[1:]):
+            t0 = time.perf_counter()
+            cs.resolve_encoded(eb, v)
+            ts.append(time.perf_counter() - t0)
+    else:
+        backend = make_conflict_backend(knobs, device=dev)
+        for txns, v in zip(warm_batches, warm_versions):
+            backend.resolve(txns, v)
+        if mode == "m3":
+            backend = make_conflict_backend(knobs, device=dev)
+        ts = []
+        for txns, v in zip(batches, versions):
+            t0 = time.perf_counter()
+            backend.resolve(txns, int(v) if mode == "m5" else v)
+            ts.append(time.perf_counter() - t0)
+
+    tt = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        tt.append(time.perf_counter() - t0)
+
+    print(f"MODE {mode:2s} first={ts[0]*1e3:9.1f}ms med_rest={np.median(ts[1:])*1e3:8.3f}ms "
+          f"trivial_after={np.median(tt)*1e3:8.3f}ms", flush=True)
+
+
+def main():
+    if sys.argv[1] == "--all":
+        for m in MODES:
+            r = subprocess.run([sys.executable, "-m",
+                                "foundationdb_tpu.bench.profile_poison6", m],
+                               capture_output=True, text=True, timeout=300)
+            out = [l for l in r.stdout.splitlines() if l.startswith("MODE")]
+            print(out[0] if out else f"MODE {m}: FAILED\n{r.stderr[-600:]}",
+                  flush=True)
+    else:
+        run_mode(sys.argv[1])
+
+
+if __name__ == "__main__":
+    main()

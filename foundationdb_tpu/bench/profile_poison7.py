@@ -1,0 +1,108 @@
+"""Round 7: only input prep differs between fast (poison5-b) and slow (m4).
+
+All modes loop cj.resolve_step 10x, fresh process each, varying input prep:
+  s1  all inputs device_put once; cv jnp.int64 once          (expect fast)
+  s2  arrays once; cv = jnp.int64(v) fresh per call
+  s3  arrays jnp.asarray per call; cv once
+  s4  arrays jax.device_put(.., dev) per call; cv once
+  s5  arrays jnp.asarray + cv jnp.int64 per call             (backend path)
+  s6  like s5 but int(v) -> np.int64 host scalar passed directly (no wrap)
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+MODES = ["s1", "s2", "s3", "s4", "s5", "s6"]
+
+
+def run_mode(mode: str) -> None:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    jt(one).block_until_ready()
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH, CAP, WIN = 64, 4, 32, 1 << 16, 4096
+    wl = MakoWorkload(n_keys=100_000, seed=42)
+    batches, versions = wl.make_batches(12, B)
+    txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                       coalesce_ranges(t.write_ranges, R), t.read_snapshot)
+            for t in batches[0]]
+    eb = encode_batch(txns, B, R, WIDTH)
+
+    st = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    rb0 = jax.device_put(jnp.asarray(eb.read_begin), dev)
+    re0 = jax.device_put(jnp.asarray(eb.read_end), dev)
+    wb0 = jax.device_put(jnp.asarray(eb.write_begin), dev)
+    we0 = jax.device_put(jnp.asarray(eb.write_end), dev)
+    sn0 = jax.device_put(jnp.asarray(eb.read_snapshot), dev)
+    cv0 = jnp.int64(versions[0])
+
+    # warm compile
+    st, v = cj.resolve_step(st, rb0, re0, wb0, we0, sn0, cv0,
+                            width=WIDTH, window=WIN)
+    v.block_until_ready()
+
+    ts = []
+    for i in range(1, 11):
+        t0 = time.perf_counter()
+        if mode == "s1":
+            a = (rb0, re0, wb0, we0, sn0, cv0)
+        elif mode == "s2":
+            a = (rb0, re0, wb0, we0, sn0, jnp.int64(versions[i]))
+        elif mode == "s3":
+            a = (jnp.asarray(eb.read_begin), jnp.asarray(eb.read_end),
+                 jnp.asarray(eb.write_begin), jnp.asarray(eb.write_end),
+                 jnp.asarray(eb.read_snapshot), cv0)
+        elif mode == "s4":
+            a = (jax.device_put(eb.read_begin, dev), jax.device_put(eb.read_end, dev),
+                 jax.device_put(eb.write_begin, dev), jax.device_put(eb.write_end, dev),
+                 jax.device_put(eb.read_snapshot, dev), cv0)
+        elif mode == "s5":
+            a = (jnp.asarray(eb.read_begin), jnp.asarray(eb.read_end),
+                 jnp.asarray(eb.write_begin), jnp.asarray(eb.write_end),
+                 jnp.asarray(eb.read_snapshot), jnp.int64(versions[i]))
+        else:  # s6
+            a = (rb0, re0, wb0, we0, sn0, np.int64(versions[i]))
+        st, v = cj.resolve_step(st, *a, width=WIDTH, window=WIN)
+        v.block_until_ready()
+        ts.append(time.perf_counter() - t0)
+
+    tt = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        tt.append(time.perf_counter() - t0)
+
+    print(f"MODE {mode:2s} med={np.median(ts)*1e3:8.3f}ms "
+          f"trivial_after={np.median(tt)*1e3:8.3f}ms", flush=True)
+
+
+def main():
+    if sys.argv[1] == "--all":
+        for m in MODES:
+            r = subprocess.run([sys.executable, "-m",
+                                "foundationdb_tpu.bench.profile_poison7", m],
+                               capture_output=True, text=True, timeout=300)
+            out = [l for l in r.stdout.splitlines() if l.startswith("MODE")]
+            print(out[0] if out else f"MODE {m}: FAILED\n{r.stderr[-600:]}",
+                  flush=True)
+    else:
+        run_mode(sys.argv[1])
+
+
+if __name__ == "__main__":
+    main()

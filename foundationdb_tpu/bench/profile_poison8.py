@@ -1,0 +1,71 @@
+"""Round 8: is device->host readback of small int8 arrays the poison?
+
+Fresh process per mode; each does 10 timed d2h readbacks of a [64] array
+of the given dtype (produced by a tiny jit), then times trivial dispatches.
+
+  t_i32, t_bool, t_u8, t_i16, t_i8, t_i8big (4096), t_i8once (1 readback)
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+MODES = ["t_i32", "t_bool", "t_u8", "t_i16", "t_i8", "t_i8big", "t_i8once"]
+
+
+def run_mode(mode: str) -> None:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    jt(one).block_until_ready()
+
+    dt = {"t_i32": jnp.int32, "t_bool": jnp.bool_, "t_u8": jnp.uint8,
+          "t_i16": jnp.int16, "t_i8": jnp.int8, "t_i8big": jnp.int8,
+          "t_i8once": jnp.int8}[mode]
+    n = 4096 if mode == "t_i8big" else 64
+    src = jax.device_put(jnp.zeros(n, jnp.int32), dev)
+    f = jax.jit(lambda x: (x + 1).astype(dt))
+    out = f(src)
+    out.block_until_ready()
+
+    reps = 1 if mode == "t_i8once" else 10
+    ts = []
+    for _ in range(reps):
+        out = f(src)
+        t0 = time.perf_counter()
+        _ = np.asarray(out)
+        ts.append(time.perf_counter() - t0)
+
+    tt = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        tt.append(time.perf_counter() - t0)
+
+    print(f"MODE {mode:8s} d2h_med={np.median(ts)*1e3:8.3f}ms "
+          f"trivial_after={np.median(tt)*1e3:8.3f}ms", flush=True)
+
+
+def main():
+    if sys.argv[1] == "--all":
+        for m in MODES:
+            r = subprocess.run([sys.executable, "-m",
+                                "foundationdb_tpu.bench.profile_poison8", m],
+                               capture_output=True, text=True, timeout=300)
+            out = [l for l in r.stdout.splitlines() if l.startswith("MODE")]
+            print(out[0] if out else f"MODE {m}: FAILED\n{r.stderr[-600:]}",
+                  flush=True)
+    else:
+        run_mode(sys.argv[1])
+
+
+if __name__ == "__main__":
+    main()

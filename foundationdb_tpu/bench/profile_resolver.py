@@ -1,0 +1,171 @@
+"""Profile the resolver kernel's dispatch pipeline on the live device.
+
+Isolates the round-2 mystery (~70ms per resolve_step on TPU, pipelining
+gains nothing) into its parts:
+
+  1. bare dispatch+sync RTT of a trivial op        -> tunnel per-call floor
+  2. host->device transfer of one encoded batch    -> transfer cost
+  3. resolve_step execute (fast window path)       -> kernel compute
+  4. resolve_step execute (full-ring path)         -> slow-path compute
+  5. K-fused scan prototype                        -> amortization headroom
+  6. int32-version variant of the hist check       -> int64 emulation tax
+
+Run: python -m foundationdb_tpu.bench.profile_resolver [--cpu]
+Prints one timing line per experiment; safe to run over the axon tunnel
+(single process, never killed mid-op by itself).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def timeit(fn, n=20, warmup=3):
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    ts = np.array(ts) * 1e3
+    return float(np.median(ts)), float(np.min(ts)), float(np.max(ts))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--n", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} platform={dev.platform}")
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH, CAP, WIN = 64, 4, 32, 1 << 16, 4096
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(64, B)
+
+    def enc(txns):
+        txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                           coalesce_ranges(t.write_ranges, R),
+                           t.read_snapshot) for t in txns]
+        return encode_batch(txns, B, R, WIDTH)
+
+    ebs = [enc(t) for t in batches]
+
+    # --- 1. bare dispatch RTT
+    one = jax.device_put(jnp.float32(1.0), dev)
+    f_triv = jax.jit(lambda x: x + 1, device=dev)
+    f_triv(one).block_until_ready()
+    med, mn, mx = timeit(lambda: f_triv(one).block_until_ready(), args.n)
+    print(f"1. trivial dispatch+sync:        med={med:8.3f}ms min={mn:8.3f} max={mx:8.3f}")
+
+    # 1b. dispatch without sync
+    med, mn, mx = timeit(lambda: f_triv(one), args.n)
+    print(f"1b. trivial dispatch (async):    med={med:8.3f}ms min={mn:8.3f} max={mx:8.3f}")
+
+    # --- 2. transfer one encoded batch
+    eb = ebs[0]
+    def xfer():
+        a = jax.device_put(eb.read_begin, dev)
+        b = jax.device_put(eb.read_end, dev)
+        c = jax.device_put(eb.write_begin, dev)
+        d = jax.device_put(eb.write_end, dev)
+        e = jax.device_put(eb.read_snapshot, dev)
+        jax.block_until_ready((a, b, c, d, e))
+    med, mn, mx = timeit(xfer, args.n)
+    print(f"2. h2d transfer 1 batch:         med={med:8.3f}ms min={mn:8.3f} max={mx:8.3f}")
+
+    # --- 3/4. resolve_step fast vs full
+    for name, win in (("fast window", WIN), ("full ring  ", 0)):
+        state = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+        # warm compile
+        st = state
+        st, v = cj.resolve_step(st, jnp.asarray(ebs[0].read_begin),
+                                jnp.asarray(ebs[0].read_end),
+                                jnp.asarray(ebs[0].write_begin),
+                                jnp.asarray(ebs[0].write_end),
+                                jnp.asarray(ebs[0].read_snapshot),
+                                jnp.int64(versions[0]), width=WIDTH, window=win)
+        v.block_until_ready()
+        holder = {"st": st}
+        idx = {"i": 1}
+        def step():
+            i = idx["i"] % len(ebs)
+            idx["i"] += 1
+            e = ebs[i]
+            holder["st"], vv = cj.resolve_step(
+                holder["st"], jnp.asarray(e.read_begin), jnp.asarray(e.read_end),
+                jnp.asarray(e.write_begin), jnp.asarray(e.write_end),
+                jnp.asarray(e.read_snapshot), jnp.int64(versions[i]),
+                width=WIDTH, window=win)
+            vv.block_until_ready()
+        med, mn, mx = timeit(step, args.n)
+        print(f"3. resolve_step {name}:     med={med:8.3f}ms min={mn:8.3f} max={mx:8.3f}")
+
+    # --- 5. K-fused scan prototype: stack K batches, scan on device
+    for K in (8, 64):
+        ks = (ebs * ((K // len(ebs)) + 1))[:K]
+        rb = jnp.asarray(np.stack([e.read_begin for e in ks]))
+        re_ = jnp.asarray(np.stack([e.read_end for e in ks]))
+        wb = jnp.asarray(np.stack([e.write_begin for e in ks]))
+        we = jnp.asarray(np.stack([e.write_end for e in ks]))
+        sn = jnp.asarray(np.stack([e.read_snapshot for e in ks]))
+        cv = jnp.asarray(np.array(versions[:1] * K, dtype=np.int64))
+
+        def many(state, rb, re_, wb, we, sn, cv):
+            def body(st, x):
+                st2, v = cj.resolve_core(st, *x[:5], x[5], width=WIDTH, window=WIN)
+                return st2, v
+            return lax.scan(body, state, (rb, re_, wb, we, sn, cv))
+
+        manyj = jax.jit(many, donate_argnums=(0,), device=dev)
+        state = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+        t0 = time.perf_counter()
+        st, v = manyj(state, rb, re_, wb, we, sn, cv)
+        v.block_until_ready()
+        compile_s = time.perf_counter() - t0
+        holder = {"st": st}
+        def stepk():
+            holder["st"], vv = manyj(holder["st"], rb, re_, wb, we, sn, cv)
+            vv.block_until_ready()
+        med, mn, mx = timeit(stepk, max(5, args.n // 2))
+        print(f"5. K={K:3d} fused scan:           med={med:8.3f}ms min={mn:8.3f} max={mx:8.3f}"
+              f"  ({med/K:7.3f} ms/batch, compile {compile_s:.1f}s)")
+
+    # --- 6. int64 vs int32 hist-version compare tax
+    hver64 = jax.device_put(jnp.arange(CAP, dtype=jnp.int64), dev)
+    hver32 = jax.device_put(jnp.arange(CAP, dtype=jnp.int32), dev)
+    snap64 = jax.device_put(jnp.arange(B, dtype=jnp.int64), dev)
+    snap32 = jax.device_put(jnp.arange(B, dtype=jnp.int32), dev)
+    f64 = jax.jit(lambda h, s: (h[None, None, :] > s[:, None, None]).sum(), device=dev)
+    f32 = jax.jit(lambda h, s: (h[None, None, :] > s[:, None, None]).sum(), device=dev)
+    f64(hver64, snap64).block_until_ready()
+    f32(hver32, snap32).block_until_ready()
+    med, _, _ = timeit(lambda: f64(hver64, snap64).block_until_ready(), args.n)
+    print(f"6. int64 compare [B,1,C]:        med={med:8.3f}ms")
+    med, _, _ = timeit(lambda: f32(hver32, snap32).block_until_ready(), args.n)
+    print(f"6. int32 compare [B,1,C]:        med={med:8.3f}ms")
+
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

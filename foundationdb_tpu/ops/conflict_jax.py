@@ -34,9 +34,14 @@ from . import keycode
 from .batch import EncodedBatch
 from .keycode import DEFAULT_WIDTH
 
-COMMITTED = jnp.int8(0)
-CONFLICT = jnp.int8(1)
-TOO_OLD = jnp.int8(2)
+# Host-side numpy scalars, NOT jnp arrays.  A pre-created concrete int8
+# jax.Array captured as a jit constant flips the axon TPU runtime into a
+# ~66ms-per-dispatch slow mode for the rest of the process (the executable
+# gains int8 scalar buffer parameters); np.int8 lowers to an inline literal
+# and dispatches in ~0.04ms.  Measured A/B in bench/profile_poison5.py.
+COMMITTED = np.int8(0)
+CONFLICT = np.int8(1)
+TOO_OLD = np.int8(2)
 
 
 class ConflictState(NamedTuple):
@@ -147,16 +152,17 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
                  width)
     M = m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
 
-    # 3. commit resolution in batch order
+    # 3. commit resolution in batch order.  The scan carries only booleans;
+    # int8 verdicts are built vectorized after the scan (cheaper ys and the
+    # verdict chain fuses into one vector select).
     def body(committed, i):
         conf = hist_conflict[i] | (committed & M[i]).any()
-        commit_i = valid[i] & ~too_old[i] & ~conf
-        verdict = jnp.where(~valid[i], COMMITTED,
-                            jnp.where(too_old[i], TOO_OLD,
-                                      jnp.where(conf, CONFLICT, COMMITTED)))
-        return committed.at[i].set(commit_i), verdict
+        return committed.at[i].set(valid[i] & ~too_old[i] & ~conf), conf
 
-    committed, verdicts = lax.scan(body, jnp.zeros(B, bool), jnp.arange(B))
+    committed, conf = lax.scan(body, jnp.zeros(B, bool), jnp.arange(B))
+    verdicts = jnp.where(~valid, COMMITTED,
+                         jnp.where(too_old, TOO_OLD,
+                                   jnp.where(conf, CONFLICT, COMMITTED)))
 
     # 4. scatter committed writes into the ring; raise floor over overwrites
     valid_w = write_begin[..., -1] != jnp.uint32(0xFFFFFFFF)          # [B,R]
