@@ -1,0 +1,149 @@
+"""True device exec time per resolve batch, measured in degraded mode.
+
+Poison the session first (one readback) so every block_until_ready is a
+real round trip; exec = measured - trivial RTT.  Times:
+  - resolve_step single batch (window fast path)
+  - fused scan over K batches, K = 16/64/256
+  - transposed-layout hist-check prototype [L,C] lane-major, K=64
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH, CAP, WIN = 64, 4, 32, 1 << 16, 4096
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(256, B)
+
+    def enc(txns):
+        txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                           coalesce_ranges(t.write_ranges, R),
+                           t.read_snapshot) for t in txns]
+        return encode_batch(txns, B, R, WIDTH)
+
+    ebs = [enc(t) for t in batches]
+    L = ebs[0].read_begin.shape[-1]
+
+    # poison -> degraded mode
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    _ = np.asarray(jt(one))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
+    print(f"RTT (trivial sync): {rtt*1e3:.1f}ms")
+
+    def timed(fn, n=5):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    # single batch
+    state = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    args0 = [jax.device_put(jnp.asarray(a), dev) for a in
+             (ebs[0].read_begin, ebs[0].read_end, ebs[0].write_begin,
+              ebs[0].write_end, ebs[0].read_snapshot)]
+    cv = jnp.int64(versions[0])
+    holder = {"st": state}
+    def step():
+        holder["st"], v = cj.resolve_step(holder["st"], *args0, cv,
+                                          width=WIDTH, window=WIN)
+        v.block_until_ready()
+    step()
+    t = timed(step)
+    print(f"resolve_step 1 batch: {t*1e3:7.2f}ms -> exec ~{(t-rtt)*1e3:6.2f}ms")
+
+    # fused scan
+    for K in (16, 64, 256):
+        ks = ebs[:K]
+        rb = jax.device_put(jnp.asarray(np.stack([e.read_begin for e in ks])), dev)
+        re_ = jax.device_put(jnp.asarray(np.stack([e.read_end for e in ks])), dev)
+        wb = jax.device_put(jnp.asarray(np.stack([e.write_begin for e in ks])), dev)
+        we = jax.device_put(jnp.asarray(np.stack([e.write_end for e in ks])), dev)
+        sn = jax.device_put(jnp.asarray(np.stack([e.read_snapshot for e in ks])), dev)
+        cvs = jax.device_put(jnp.asarray(np.array(versions[:K], dtype=np.int64)), dev)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def many(state, rb, re_, wb, we, sn, cvs):
+            def body(st, x):
+                st2, v = cj.resolve_core(st, *x[:5], x[5], width=WIDTH, window=WIN)
+                return st2, v
+            return lax.scan(body, state, (rb, re_, wb, we, sn, cvs))
+
+        st = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+        st, v = many(st, rb, re_, wb, we, sn, cvs)
+        v.block_until_ready()
+        holder = {"st": st}
+        def stepk():
+            holder["st"], vv = many(holder["st"], rb, re_, wb, we, sn, cvs)
+            vv.block_until_ready()
+        t = timed(stepk, 3)
+        ex = (t - rtt) / K * 1e3
+        print(f"fused K={K:3d}: {t*1e3:8.1f}ms -> exec ~{ex:6.3f}ms/batch "
+              f"-> ceiling ~{64_000/ex/1000 if ex>0 else 0:8.1f}k txns/s")
+
+    # transposed-layout hist prototype: hb/he as [L, C], reads [B,R,L]
+    K = 64
+    hbT = jax.device_put(jnp.full((L, WIN), 0x7FFFFFFF, jnp.int32), dev)
+    heT = jax.device_put(jnp.full((L, WIN), 0x7FFFFFFF, jnp.int32), dev)
+    hverT = jax.device_put(jnp.zeros((WIN,), jnp.int32), dev)
+    rbK = jax.device_put(jnp.asarray(
+        np.stack([e.read_begin for e in ebs[:K]]).astype(np.int32)), dev)
+    reK = jax.device_put(jnp.asarray(
+        np.stack([e.read_end for e in ebs[:K]]).astype(np.int32)), dev)
+    snK = jax.device_put(jnp.asarray(
+        np.stack([e.read_snapshot for e in ebs[:K]]).astype(np.int32)), dev)
+
+    def lex_lt_T(a, b):  # a [B,R,L] vs b [L,W] -> [B,R,W]
+        lt = jnp.zeros(a.shape[:2] + (b.shape[-1],), bool)
+        eq = jnp.ones_like(lt)
+        for l in range(a.shape[-1]):
+            al = a[:, :, l:l+1]
+            bl = b[l][None, None, :]
+            lt = lt | (eq & (al < bl))
+            eq = eq & (al == bl)
+        return lt
+
+    @jax.jit
+    def histT(rb, re_, sn, hbT, heT, hverT):
+        def body(_, x):
+            rbi, rei, sni = x
+            hit = lex_lt_T(rbi, heT) & ~lex_lt_T(rei, hbT)  # approx overlap
+            newer = hverT[None, None, :] > sni[:, None, None]
+            return _, (hit & newer).any(axis=(1, 2))
+        return lax.scan(body, None, (rb, re_, sn))
+
+    _, v = histT(rbK, reK, snK, hbT, heT, hverT)
+    v.block_until_ready()
+    def stepT():
+        _, vv = histT(rbK, reK, snK, hbT, heT, hverT)
+        vv.block_until_ready()
+    t = timed(stepT, 3)
+    print(f"histT K=64 [L,C] layout: {t*1e3:8.1f}ms -> ~{(t-rtt)/K*1e3:6.3f}ms/batch (hist only)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,184 @@
+"""Prototype the layout-optimized fused resolver kernel and measure exec.
+
+Design under test (vs current conflict_jax.resolve_core):
+  - ring stored lane-major [L, 2C] (doubled so any window is contiguous);
+    scatter writes each committed range twice (pos, pos+C)
+  - window read = lax.dynamic_slice (no gather)
+  - hist compare loops L in Python (8 unrolled [B,R,W]-shaped ops, W minor)
+  - fused scan over K batches, per-batch commit versions
+  - inner commit-resolution scan with unroll
+Reports exec/batch in degraded mode for K in {16, 64}, unroll in {1, 8, 64}.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH, CAP, WIN = 64, 4, 32, 1 << 16, 4096
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(64, B)
+
+    def enc(txns):
+        txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                           coalesce_ranges(t.write_ranges, R),
+                           t.read_snapshot) for t in txns]
+        return encode_batch(txns, B, R, WIDTH)
+
+    ebs = [enc(t) for t in batches]
+    L = ebs[0].read_begin.shape[-1]
+
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    _ = np.asarray(jt(one))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
+    print(f"RTT: {rtt*1e3:.1f}ms  (L={L})")
+
+    # --- state: hbT/heT [L, 2C] uint32, hver [2C] int64, ptr, floor
+    def init():
+        return (jnp.full((L, 2 * CAP), 0xFFFFFFFF, jnp.uint32),
+                jnp.full((L, 2 * CAP), 0xFFFFFFFF, jnp.uint32),
+                jnp.full((2 * CAP,), -1, jnp.int64),
+                jnp.int32(0), jnp.int64(0))
+
+    def lex_lt_T(a, bT, W):
+        # a [B,R,L] vs bT [L,W] -> strict lex <  [B,R,W]
+        lt = jnp.zeros((a.shape[0], a.shape[1], W), bool)
+        eq = jnp.ones_like(lt)
+        for l in range(L):
+            al = a[:, :, l:l + 1]
+            bl = bT[l][None, None, :]
+            lt = lt | (eq & (al < bl))
+            eq = eq & (al == bl)
+        return lt, eq
+
+    def possibly_lt_T(a, bT, W, width):
+        lt, eq = lex_lt_T(a, bT, W)
+        both_trunc = (a[:, :, -1:] == width + 1) & (bT[-1][None, None, :] == width + 1)
+        return lt | (eq & both_trunc)
+
+    def overlap_T(ab, ae, bbT, beT, W, width):
+        # interval overlap of read [ab,ae] vs history [bbT,beT]
+        return possibly_lt_T(ab, beT, W, width) & possibly_lt_T_rev(bbT, ae, W, width)
+
+    def possibly_lt_T_rev(aT, b, W, width):
+        # aT [L,W] < b [B,R,L] -> [B,R,W]
+        lt = jnp.zeros((b.shape[0], b.shape[1], W), bool)
+        eq = jnp.ones_like(lt)
+        for l in range(L):
+            al = aT[l][None, None, :]
+            bl = b[:, :, l:l + 1]
+            lt = lt | (eq & (al < bl))
+            eq = eq & (al == bl)
+        both_trunc = (aT[-1][None, None, :] == width + 1) & (b[:, :, -1:] == width + 1)
+        return lt | (eq & both_trunc)
+
+    def make_many(K, unroll):
+        def body(st, x):
+            hbT, heT, hver, ptr, floor = st
+            rb, re_, wb, we, sn, cv = x
+            too_old = sn < floor
+            valid = sn >= 0
+            start = ((ptr - WIN) % CAP).astype(jnp.int32)
+            hbW = lax.dynamic_slice(hbT, (jnp.int32(0), start), (L, WIN))
+            heW = lax.dynamic_slice(heT, (jnp.int32(0), start), (L, WIN))
+            hvW = lax.dynamic_slice(hver, (start,), (WIN,))
+            v_edge = hver[(ptr - WIN - 1) % CAP]
+            fast_ok = jnp.all(~valid | too_old | (sn >= v_edge))
+
+            def hist_of(hbT_, heT_, hv_, W):
+                hit = overlap_T(rb, re_, hbT_, heT_, W, WIDTH)
+                newer = hv_[None, None, :] > sn[:, None, None]
+                return (hit & newer).any(axis=(1, 2))
+
+            hist = lax.cond(
+                fast_ok,
+                lambda _: hist_of(hbW, heW, hvW, WIN),
+                lambda _: hist_of(hbT[:, :CAP], heT[:, :CAP], hver[:CAP], CAP),
+                None)
+
+            # intra-batch matrix via transposed writes [L, B*R]
+            wbT = wb.reshape(B * R, L).T
+            weT = we.reshape(B * R, L).T
+            hitM = overlap_T(rb, re_, wbT, weT, B * R, WIDTH)  # [B,R,B*R]
+            M = hitM.reshape(B, R, B, R).any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
+
+            def ibody(committed, i):
+                conf = hist[i] | (committed & M[i]).any()
+                return committed.at[i].set(valid[i] & ~too_old[i] & ~conf), conf
+            committed, conf = lax.scan(ibody, jnp.zeros(B, bool), jnp.arange(B),
+                                       unroll=unroll)
+            verdicts = jnp.where(~valid, np.int8(0),
+                                 jnp.where(too_old, np.int8(2),
+                                           jnp.where(conf, np.int8(1), np.int8(0))))
+
+            valid_w = wb[..., -1] != jnp.uint32(0xFFFFFFFF)
+            ins = (committed[:, None] & valid_w).reshape(-1)
+            k = jnp.cumsum(ins) - ins
+            pos = jnp.where(ins, (ptr + k) % CAP, 2 * CAP - 1).astype(jnp.int32)
+            old = jnp.where(ins, hver[pos], jnp.int64(-1))
+            floor2 = jnp.maximum(floor, jnp.max(old))
+            wbf = jnp.where(ins[:, None], wb.reshape(B * R, L), jnp.uint32(0xFFFFFFFF)).T
+            wef = jnp.where(ins[:, None], we.reshape(B * R, L), jnp.uint32(0xFFFFFFFF)).T
+            pos2 = jnp.where(ins, pos + CAP, 2 * CAP - 1).astype(jnp.int32)
+            cvv = jnp.where(ins, cv, jnp.int64(-1))
+            hbT2 = hbT.at[:, pos].set(wbf).at[:, pos2].set(wbf)
+            heT2 = heT.at[:, pos].set(wef).at[:, pos2].set(wef)
+            hver2 = hver.at[pos].set(cvv).at[pos2].set(cvv)
+            ptr2 = ((ptr + jnp.sum(ins)) % CAP).astype(jnp.int32)
+            return (hbT2, heT2, hver2, ptr2, floor2), verdicts
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def many(st, rb, re_, wb, we, sn, cvs):
+            return lax.scan(body, st, (rb, re_, wb, we, sn, cvs))
+        return many
+
+    for K in (16, 64):
+        ks = ebs[:K]
+        rb = jax.device_put(jnp.asarray(np.stack([e.read_begin for e in ks])), dev)
+        re_ = jax.device_put(jnp.asarray(np.stack([e.read_end for e in ks])), dev)
+        wb = jax.device_put(jnp.asarray(np.stack([e.write_begin for e in ks])), dev)
+        we = jax.device_put(jnp.asarray(np.stack([e.write_end for e in ks])), dev)
+        sn = jax.device_put(jnp.asarray(np.stack([e.read_snapshot for e in ks])), dev)
+        cvs = jax.device_put(jnp.asarray(np.array(versions[:K], dtype=np.int64)), dev)
+        for unroll in (1, 8, 64):
+            many = make_many(K, unroll)
+            st = jax.device_put(init(), dev)
+            t0 = time.perf_counter()
+            st, v = many(st, rb, re_, wb, we, sn, cvs)
+            v.block_until_ready()
+            comp = time.perf_counter() - t0
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                st, v = many(st, rb, re_, wb, we, sn, cvs)
+                v.block_until_ready()
+                ts.append(time.perf_counter() - t0)
+            t = float(np.median(ts))
+            ex = (t - rtt) / K * 1e3
+            print(f"K={K:3d} unroll={unroll:2d}: {t*1e3:8.1f}ms exec~{ex:6.3f}ms/batch "
+                  f"ceiling~{64/ex:7.1f}k txns/s (compile {comp:.0f}s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,282 @@
+"""Component-level profile of the fused lane-major kernel (degraded mode).
+
+All components run inside a K=64 lax.scan to mirror the real kernel.
+  c1  window dynamic_slice only
+  c2  + hist compare, int64 hver
+  c3  + hist compare, int32 hver (version deltas)
+  c4  intra matrix, transposed [B,R,BR]
+  c4b intra matrix, original [B,R,B,R]
+  c5  inner scan alone (unroll 8)
+  c6  append-insert (2 dynamic_update_slice) + floor max
+  c7  FULL kernel: append-insert, always-window (no cond), int32 hver
+  c8  c7 + lax.cond fallback
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops.batch import encode_batch, TxnRequest
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+
+    B, R, WIDTH, WIN = 64, 4, 32, 4096
+    SLAB = B * R                      # slots consumed per batch
+    CAP = 1 << 16                     # ring slots
+    K = 64
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(K, B)
+
+    def enc(txns):
+        txns = [TxnRequest(coalesce_ranges(t.read_ranges, R),
+                           coalesce_ranges(t.write_ranges, R),
+                           t.read_snapshot) for t in txns]
+        return encode_batch(txns, B, R, WIDTH)
+
+    ebs = [enc(t) for t in batches]
+    L = ebs[0].read_begin.shape[-1]
+
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    _ = np.asarray(jt(one))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
+    print(f"RTT: {rtt*1e3:.1f}ms  L={L}")
+
+    rb = jax.device_put(jnp.asarray(np.stack([e.read_begin for e in ebs])), dev)
+    re_ = jax.device_put(jnp.asarray(np.stack([e.read_end for e in ebs])), dev)
+    wb = jax.device_put(jnp.asarray(np.stack([e.write_begin for e in ebs])), dev)
+    we = jax.device_put(jnp.asarray(np.stack([e.write_end for e in ebs])), dev)
+    sn64 = jax.device_put(jnp.asarray(np.stack([e.read_snapshot for e in ebs])), dev)
+    sn32 = jax.device_put(jnp.asarray(
+        np.stack([e.read_snapshot for e in ebs]).astype(np.int32)), dev)
+    cvs = jax.device_put(jnp.asarray(np.array(versions, dtype=np.int64)), dev)
+    cvs32 = jax.device_put(jnp.asarray(np.array(versions, dtype=np.int32)), dev)
+
+    hbT = jax.device_put(jnp.full((L, 2 * CAP), 0xFFFFFFFF, jnp.uint32), dev)
+    heT = jax.device_put(jnp.full((L, 2 * CAP), 0xFFFFFFFF, jnp.uint32), dev)
+    hv64 = jax.device_put(jnp.full((2 * CAP,), -1, jnp.int64), dev)
+    hv32 = jax.device_put(jnp.full((2 * CAP,), -1, jnp.int32), dev)
+
+    def cmp_T(a, bT, W, width):
+        lt = jnp.zeros((a.shape[0], a.shape[1], W), bool)
+        eq = jnp.ones_like(lt)
+        for l in range(L):
+            al = a[:, :, l:l + 1]
+            bl = bT[l][None, None, :]
+            lt = lt | (eq & (al < bl))
+            eq = eq & (al == bl)
+        both = (a[:, :, -1:] == width + 1) & (bT[-1][None, None, :] == width + 1)
+        return lt | (eq & both)
+
+    def cmp_T_rev(aT, b, W, width):
+        lt = jnp.zeros((b.shape[0], b.shape[1], W), bool)
+        eq = jnp.ones_like(lt)
+        for l in range(L):
+            al = aT[l][None, None, :]
+            bl = b[:, :, l:l + 1]
+            lt = lt | (eq & (al < bl))
+            eq = eq & (al == bl)
+        both = (aT[-1][None, None, :] == width + 1) & (b[:, :, -1:] == width + 1)
+        return lt | (eq & both)
+
+    def run(name, body, carry_fn, xs):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def f(carry, xs):
+            return lax.scan(body, carry, xs)
+        c = jax.device_put(carry_fn(), dev)
+        t0 = time.perf_counter()
+        c, y = f(c, xs)
+        jax.block_until_ready(y)
+        comp = time.perf_counter() - t0
+        ts = []
+        for _ in range(3):
+            c2, y = f(c, xs)
+            jax.block_until_ready(y)
+            c = c2
+            t0b = time.perf_counter()
+            c2, y = f(c, xs)
+            jax.block_until_ready(y)
+            c = c2
+            ts.append(time.perf_counter() - t0b)
+        t = float(np.median(ts))
+        print(f"{name:36s} {t*1e3:8.1f}ms exec~{(t-rtt)/K*1e3:6.3f}ms/batch "
+              f"(compile {comp:.0f}s)")
+
+    i32 = jnp.int32
+
+    # c1: window slice only
+    def c1(carry, x):
+        ptr = carry
+        start = ((ptr - WIN) % CAP).astype(i32)
+        hbW = lax.dynamic_slice(hbT, (i32(0), start), (L, WIN))
+        return ptr + SLAB, hbW[0, 0]
+    run("c1 window slice", c1, lambda: jnp.int32(0), jnp.arange(K))
+
+    # c2/c3: slice + hist compare
+    def mk_hist(hv, sn):
+        def c2(carry, x):
+            ptr = carry
+            rbi, rei, sni = x
+            start = ((ptr - WIN) % CAP).astype(i32)
+            hbW = lax.dynamic_slice(hbT, (i32(0), start), (L, WIN))
+            heW = lax.dynamic_slice(heT, (i32(0), start), (L, WIN))
+            hvW = lax.dynamic_slice(hv, (start,), (WIN,))
+            hit = cmp_T(rbi, heW, WIN, WIDTH) & cmp_T_rev(hbW, rei, WIN, WIDTH)
+            newer = hvW[None, None, :] > sni[:, None, None]
+            return ptr + SLAB, (hit & newer).any(axis=(1, 2))
+        return c2
+    run("c2 slice+hist int64", mk_hist(hv64, sn64), lambda: jnp.int32(0), (rb, re_, sn64))
+    run("c3 slice+hist int32", mk_hist(hv32, sn32), lambda: jnp.int32(0), (rb, re_, sn32))
+
+    # c4: intra transposed
+    def c4(carry, x):
+        rbi, rei, wbi, wei = x
+        wbT = wbi.reshape(SLAB, L).T
+        weT = wei.reshape(SLAB, L).T
+        hitM = cmp_T(rbi, weT, SLAB, WIDTH) & cmp_T_rev(wbT, rei, SLAB, WIDTH)
+        M = hitM.reshape(B, R, B, R).any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
+        return carry, M[0]
+    run("c4 intra transposed", c4, lambda: jnp.int32(0), (rb, re_, wb, we))
+
+    # c4b: intra original layout
+    def lex_lt(a, b):
+        lt = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), bool)
+        eq = jnp.ones_like(lt)
+        for l in range(L):
+            al, bl = a[..., l], b[..., l]
+            lt = lt | (eq & (al < bl))
+            eq = eq & (al == bl)
+        both = (a[..., -1] == WIDTH + 1) & (b[..., -1] == WIDTH + 1)
+        return lt | (eq & both)
+
+    def c4b(carry, x):
+        rbi, rei, wbi, wei = x
+        m = (lex_lt(rbi[:, :, None, None, :], wei[None, None, :, :, :])
+             & lex_lt(wbi[None, None, :, :, :], rei[:, :, None, None, :]))
+        M = m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
+        return carry, M[0]
+    run("c4b intra original", c4b, lambda: jnp.int32(0), (rb, re_, wb, we))
+
+    # c5: inner scan
+    Ms = jax.device_put(jnp.zeros((K, B, B), bool), dev)
+    hists = jax.device_put(jnp.zeros((K, B), bool), dev)
+    def c5(carry, x):
+        M, hist = x
+        def ib(committed, i):
+            conf = hist[i] | (committed & M[i]).any()
+            return committed.at[i].set(~conf), conf
+        committed, conf = lax.scan(ib, jnp.zeros(B, bool), jnp.arange(B), unroll=8)
+        return carry, conf
+    run("c5 inner scan u8", c5, lambda: jnp.int32(0), (Ms, hists))
+
+    # c6: append insert
+    def c6(carry, x):
+        hbT_, hv_, ptr, floor = carry
+        wbi, cv = x
+        wslab = wbi.reshape(SLAB, L).T
+        vslab = jnp.full((SLAB,), 0, jnp.int64) + cv
+        old = lax.dynamic_slice(hv_, ((ptr % CAP).astype(i32),), (SLAB,))
+        floor2 = jnp.maximum(floor, jnp.max(old))
+        p = (ptr % CAP).astype(i32)
+        hbT2 = lax.dynamic_update_slice(hbT_, wslab, (i32(0), p))
+        hbT2 = lax.dynamic_update_slice(hbT2, wslab, (i32(0), p + CAP))
+        hv2 = lax.dynamic_update_slice(hv_, vslab, (p,))
+        hv2 = lax.dynamic_update_slice(hv2, vslab, (p + CAP,))
+        return (hbT2, hv2, ptr + SLAB, floor2), floor2
+    def mk_ring64():
+        return (jnp.full((L, 2 * CAP), 0xFFFFFFFF, jnp.uint32),
+                jnp.full((2 * CAP,), -1, jnp.int64),
+                jnp.int32(0), jnp.int64(0))
+    run("c6 append insert", c6, mk_ring64, (wb, cvs))
+
+    # c7: full kernel, always-window, int32 hver
+    def full_body(use_cond):
+        def body(carry, x):
+            hbT_, heT_, hv_, ptr, floor = carry
+            rbi, rei, wbi, wei, sni, cv = x
+            too_old = sni < floor
+            valid = sni >= 0
+            start = ((ptr - WIN) % CAP).astype(i32)
+            hbW = lax.dynamic_slice(hbT_, (i32(0), start), (L, WIN))
+            heW = lax.dynamic_slice(heT_, (i32(0), start), (L, WIN))
+            hvW = lax.dynamic_slice(hv_, (start,), (WIN,))
+
+            def hist_of(hb_, he_, hv__, W):
+                hit = cmp_T(rbi, he_, W, WIDTH) & cmp_T_rev(hb_, rei, W, WIDTH)
+                newer = hv__[None, None, :] > sni[:, None, None]
+                return (hit & newer).any(axis=(1, 2))
+
+            if use_cond:
+                v_edge = hv_[((ptr - WIN - 1) % CAP).astype(i32)]
+                fast_ok = jnp.all(~valid | too_old | (sni >= v_edge))
+                hist = lax.cond(
+                    fast_ok,
+                    lambda _: hist_of(hbW, heW, hvW, WIN),
+                    lambda _: hist_of(hbT_[:, :CAP], heT_[:, :CAP], hv_[:CAP], CAP),
+                    None)
+            else:
+                hist = hist_of(hbW, heW, hvW, WIN)
+
+            wbT = wbi.reshape(SLAB, L).T
+            weT = wei.reshape(SLAB, L).T
+            hitM = cmp_T(rbi, weT, SLAB, WIDTH) & cmp_T_rev(wbT, rei, SLAB, WIDTH)
+            M = hitM.reshape(B, R, B, R).any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
+
+            def ib(committed, i):
+                conf = hist[i] | (committed & M[i]).any()
+                return committed.at[i].set(valid[i] & ~too_old[i] & ~conf), conf
+            committed, conf = lax.scan(ib, jnp.zeros(B, bool), jnp.arange(B),
+                                       unroll=8)
+            verdicts = jnp.where(~valid, np.int8(0),
+                                 jnp.where(too_old, np.int8(2),
+                                           jnp.where(conf, np.int8(1), np.int8(0))))
+
+            insm = (committed[:, None] & (wbi[..., -1] != jnp.uint32(0xFFFFFFFF))).reshape(-1)
+            wslab_b = jnp.where(insm[:, None], wbi.reshape(SLAB, L),
+                                jnp.uint32(0xFFFFFFFF)).T
+            wslab_e = jnp.where(insm[:, None], wei.reshape(SLAB, L),
+                                jnp.uint32(0xFFFFFFFF)).T
+            vslab = jnp.where(insm, cv, jnp.asarray(-1, hv_.dtype))
+            p = (ptr % CAP).astype(i32)
+            old = lax.dynamic_slice(hv_, (p,), (SLAB,))
+            floor2 = jnp.maximum(floor, jnp.max(old))
+            hbT2 = lax.dynamic_update_slice(hbT_, wslab_b, (i32(0), p))
+            hbT2 = lax.dynamic_update_slice(hbT2, wslab_b, (i32(0), p + CAP))
+            heT2 = lax.dynamic_update_slice(heT_, wslab_e, (i32(0), p))
+            heT2 = lax.dynamic_update_slice(heT2, wslab_e, (i32(0), p + CAP))
+            hv2 = lax.dynamic_update_slice(hv_, vslab, (p,))
+            hv2 = lax.dynamic_update_slice(hv2, vslab, (p + CAP,))
+            ptr2 = ((ptr + SLAB) % CAP).astype(i32)
+            return (hbT2, heT2, hv2, ptr2, floor2), verdicts
+        return body
+
+    def mk_full32():
+        return (jnp.full((L, 2 * CAP), 0xFFFFFFFF, jnp.uint32),
+                jnp.full((L, 2 * CAP), 0xFFFFFFFF, jnp.uint32),
+                jnp.full((2 * CAP,), -1, jnp.int32),
+                jnp.int32(0), jnp.int32(0))
+    run("c7 FULL window-only int32", full_body(False), mk_full32,
+        (rb, re_, wb, we, sn32, cvs32))
+    run("c8 FULL + cond int32", full_body(True), mk_full32,
+        (rb, re_, wb, we, sn32, cvs32))
+
+
+if __name__ == "__main__":
+    main()

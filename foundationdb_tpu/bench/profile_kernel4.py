@@ -1,0 +1,132 @@
+"""Can the inner greedy commit-resolution go faster than 0.137ms/batch?
+
+Variants (inside K=64 scan, degraded mode):
+  v_vec   current: lax.scan over [64]-bool vector carry (baseline)
+  v_bits  fully-unrolled scalar bitmask chain: committed packed in 2 uint32
+          scalars, M rows packed [64] uint32 lo/hi, 64 static steps
+  v_fori  same bitmask but lax.fori_loop with dynamic row index
+  + FULL kernel with v_bits inner
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+    B, K = 64, 64
+
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    _ = np.asarray(jt(one))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jt(one).block_until_ready()
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
+    print(f"RTT: {rtt*1e3:.1f}ms")
+
+    rng = np.random.default_rng(0)
+    Ms = jax.device_put(jnp.asarray(rng.random((K, B, B)) < 0.05), dev)
+    hists = jax.device_put(jnp.asarray(rng.random((K, B)) < 0.2), dev)
+    valids = jax.device_put(jnp.ones((K, B), bool), dev)
+    too_olds = jax.device_put(jnp.zeros((K, B), bool), dev)
+
+    def run(name, body, xs):
+        @jax.jit
+        def f(xs):
+            return lax.scan(body, jnp.int32(0), xs)
+        _, y = f(xs)
+        jax.block_until_ready(y)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _, y = f(xs)
+            jax.block_until_ready(y)
+            ts.append(time.perf_counter() - t0)
+        t = float(np.median(ts))
+        print(f"{name:22s} {t*1e3:8.1f}ms exec~{(t-rtt)/K*1e3:7.4f}ms/batch")
+        return np.asarray(y)
+
+    # baseline vector scan
+    def v_vec(carry, x):
+        M, hist, valid, too_old = x
+        def ib(committed, i):
+            conf = hist[i] | (committed & M[i]).any()
+            return committed.at[i].set(valid[i] & ~too_old[i] & ~conf), conf
+        committed, conf = lax.scan(ib, jnp.zeros(B, bool), jnp.arange(B), unroll=8)
+        return carry, conf
+    ref = run("v_vec (scan u8)", v_vec, (Ms, hists, valids, too_olds))
+
+    # packed scalar bitmask, fully unrolled
+    pw_lo = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+
+    def pack64(bits):  # [.., 64] bool -> (lo, hi) uint32
+        lo = jnp.sum(bits[..., :32].astype(jnp.uint32) * pw_lo, axis=-1)
+        hi = jnp.sum(bits[..., 32:].astype(jnp.uint32) * pw_lo, axis=-1)
+        return lo, hi
+
+    def v_bits(carry, x):
+        M, hist, valid, too_old = x
+        Mlo, Mhi = pack64(M)                     # [64] uint32 each
+        ok = valid & ~too_old
+        c_lo = jnp.uint32(0)
+        c_hi = jnp.uint32(0)
+        confs = []
+        for i in range(B):
+            hit = (c_lo & Mlo[i]) | (c_hi & Mhi[i])
+            conf = hist[i] | (hit != 0)
+            commit = ok[i] & ~conf
+            if i < 32:
+                c_lo = c_lo | jnp.where(commit, jnp.uint32(1 << i), jnp.uint32(0))
+            else:
+                c_hi = c_hi | jnp.where(commit, jnp.uint32(1 << (i - 32)), jnp.uint32(0))
+            confs.append(conf)
+        return carry, jnp.stack(confs)
+    out = run("v_bits (unrolled)", v_bits, (Ms, hists, valids, too_olds))
+    print("  parity v_bits:", bool((out == ref).all()))
+
+    # fori_loop bitmask
+    def v_fori(carry, x):
+        M, hist, valid, too_old = x
+        Mlo, Mhi = pack64(M)
+        ok = valid & ~too_old
+
+        def ib(i, st):
+            c_lo, c_hi, confbits_lo, confbits_hi = st
+            hit = (c_lo & Mlo[i]) | (c_hi & Mhi[i])
+            conf = hist[i] | (hit != 0)
+            commit = ok[i] & ~conf
+            ilt = (i < 32)
+            sh_lo = jnp.where(ilt, i, 0).astype(jnp.uint32)
+            sh_hi = jnp.where(ilt, 0, i - 32).astype(jnp.uint32)
+            bit_lo = jnp.where(ilt, jnp.uint32(1) << sh_lo, jnp.uint32(0))
+            bit_hi = jnp.where(ilt, jnp.uint32(0), jnp.uint32(1) << sh_hi)
+            c_lo = c_lo | jnp.where(commit, bit_lo, jnp.uint32(0))
+            c_hi = c_hi | jnp.where(commit, bit_hi, jnp.uint32(0))
+            confbits_lo = confbits_lo | jnp.where(conf, bit_lo, jnp.uint32(0))
+            confbits_hi = confbits_hi | jnp.where(conf, bit_hi, jnp.uint32(0))
+            return c_lo, c_hi, confbits_lo, confbits_hi
+
+        z = jnp.uint32(0)
+        _, _, cb_lo, cb_hi = lax.fori_loop(0, B, ib, (z, z, z, z))
+        conf = jnp.concatenate([
+            (cb_lo >> jnp.arange(32, dtype=jnp.uint32)) & 1,
+            (cb_hi >> jnp.arange(32, dtype=jnp.uint32)) & 1]).astype(bool)
+        return carry, conf
+    out = run("v_fori (bitmask)", v_fori, (Ms, hists, valids, too_olds))
+    print("  parity v_fori:", bool((out == ref).all()))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 TARGETS = {
     "conflictset": ["conflictset.cpp"],
+    "keycodec": ["keycodec.cpp"],
 }
 
 CXXFLAGS = ["-std=c++20", "-O3", "-march=native", "-fPIC", "-shared",

@@ -1,0 +1,31 @@
+// Bulk order-preserving key encoder — native twin of ops/keycode.encode_keys.
+//
+// Encodes n variable-length byte-string keys into fixed-width uint32 lane
+// rows: width/4 big-endian data lanes + one length lane (min(len, width+1)).
+// The Python/numpy version costs ~0.1ms per resolver batch of ~500 keys;
+// this is ~5us.  Loaded via ctypes (no pybind11 in this image); see
+// foundationdb_tpu/native/build.py.
+
+#include <cstdint>
+
+extern "C" {
+
+// flat: concatenated key bytes; offs[n+1]: byte offsets into flat;
+// out: n * (width/4 + 1) uint32, row-major.
+void kc_encode(const uint8_t* flat, const int64_t* offs, int64_t n,
+               int64_t width, uint32_t* out) {
+    const int64_t nd = width / 4;       // data lanes
+    const int64_t L = nd + 1;
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t* k = flat + offs[i];
+        const int64_t len = offs[i + 1] - offs[i];
+        const int64_t plen = len < width ? len : width;
+        uint32_t* row = out + i * L;
+        for (int64_t l = 0; l < nd; ++l) row[l] = 0;
+        for (int64_t b = 0; b < plen; ++b)
+            row[b >> 2] |= static_cast<uint32_t>(k[b]) << (8 * (3 - (b & 3)));
+        row[nd] = static_cast<uint32_t>(len < width + 1 ? len : width + 1);
+    }
+}
+
+}  // extern "C"

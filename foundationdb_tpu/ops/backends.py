@@ -105,6 +105,17 @@ def resolve_begin(backend, txns: list[TxnRequest], commit_version: int):
     return _completed(backend.resolve(txns, commit_version))
 
 
+def resolve_group_begin(backend, batches: list[list[TxnRequest]],
+                        versions: list[int]):
+    """Group-resolve over any backend: fused dispatches when supported,
+    sequential sync resolves otherwise.  Awaitable of per-batch verdicts."""
+    fn = getattr(backend, "resolve_group_begin", None)
+    if fn is not None:
+        return fn(batches, versions)
+    return _completed([backend.resolve(t, v)
+                       for t, v in zip(batches, versions)])
+
+
 def coalesce_ranges(ranges: list[tuple[bytes, bytes]], max_n: int) -> list[tuple[bytes, bytes]]:
     """Merge sorted-adjacent ranges until len <= max_n (conservative)."""
     if len(ranges) <= max_n:
@@ -136,25 +147,43 @@ class EncodedConflictBackend:
         self.R = ranges_per_txn
         self.width = width
 
+    def _encode_chunks(self, txns: list[TxnRequest]):
+        """Split an oversized batch into kernel-shaped encoded chunks."""
+        from .batch import encode_batch
+        out = []
+        for start in range(0, len(txns), self.B):
+            chunk = [t if len(t.read_ranges) <= self.R and len(t.write_ranges) <= self.R
+                     else TxnRequest(coalesce_ranges(t.read_ranges, self.R),
+                                     coalesce_ranges(t.write_ranges, self.R),
+                                     t.read_snapshot)
+                     for t in txns[start:start + self.B]]
+            out.append(encode_batch(chunk, self.B, self.R, self.width))
+        return out
+
     def _submit_chunks(self, txns: list[TxnRequest], commit_version: int):
         """Encode + dispatch every chunk; returns [(n_txns, verdicts)] where
-        verdicts is a device array (jax cs) or host ndarray (numpy cs)."""
-        from .batch import encode_batch
+        verdicts is a device array (jax cs) or host ndarray (numpy cs).
+        Multi-chunk batches go through the fused group dispatch when the
+        conflict set supports it (one device round trip instead of K)."""
+        ebs = self._encode_chunks(txns)
+        group = getattr(self.cs, "resolve_group_submit", None)
+        if group is not None and len(ebs) > 1:
+            # counts as a list marks a grouped [K,B] verdict array
+            return [([e.count for e in ebs],
+                     group(ebs, [commit_version] * len(ebs)))]
         submit = getattr(self.cs, "resolve_encoded_submit", self.cs.resolve_encoded)
-        pending = []
-        for start in range(0, len(txns), self.B):
-            chunk = txns[start:start + self.B]
-            chunk = [TxnRequest(coalesce_ranges(t.read_ranges, self.R),
-                                coalesce_ranges(t.write_ranges, self.R),
-                                t.read_snapshot) for t in chunk]
-            eb = encode_batch(chunk, self.B, self.R, self.width)
-            pending.append((len(chunk), submit(eb, commit_version)))
-        return pending
+        return [(eb.count, submit(eb, commit_version)) for eb in ebs]
+
+    @staticmethod
+    def _extract(n, host: np.ndarray) -> list[int]:
+        if isinstance(n, list):            # grouped [K,B] rows
+            return [int(x) for k, cnt in enumerate(n) for x in host[k][:cnt]]
+        return [int(x) for x in host[:n]]
 
     def resolve(self, txns: list[TxnRequest], commit_version: int) -> list[int]:
         out: list[int] = []
         for n, v in self._submit_chunks(txns, commit_version):
-            out.extend(int(x) for x in np.asarray(v)[:n])
+            out.extend(self._extract(n, np.asarray(v)))
         return out
 
     def resolve_begin(self, txns: list[TxnRequest], commit_version: int):
@@ -178,7 +207,60 @@ class EncodedConflictBackend:
                     host = np.asarray(v)
                 else:
                     host = await _DeviceSyncWorker.shared().run(np.asarray, v)
-                out.extend(int(x) for x in host[:n])
+                out.extend(self._extract(n, host))
+            return out
+
+        return finish()
+
+    def resolve_group_begin(self, batches: list[list[TxnRequest]],
+                            versions: list[int]):
+        """Fuse several distinct proxy batches (each with its own commit
+        version) into as few device dispatches as possible; returns an
+        awaitable yielding one verdict list per input batch.  Bit-identical
+        to sequential resolve_begin calls — the fused kernel threads the
+        ring through the group in order."""
+        group = getattr(self.cs, "resolve_group_submit", None)
+        if group is None:
+            results = [self.resolve(txns, v)
+                       for txns, v in zip(batches, versions)]
+
+            async def done():
+                return results
+            return done()
+
+        flat_ebs: list = []
+        flat_cvs: list[int] = []
+        spans: list[tuple[int, int]] = []    # (start, n_chunks) per batch
+        for txns, v in zip(batches, versions):
+            ebs = self._encode_chunks(txns)
+            spans.append((len(flat_ebs), len(ebs)))
+            flat_ebs.extend(ebs)
+            flat_cvs.extend([v] * len(ebs))
+        from .conflict_jax import GROUP_BUCKETS
+        max_k = GROUP_BUCKETS[-1]
+        pending = []
+        for start in range(0, len(flat_ebs), max_k):
+            pending.append(group(flat_ebs[start:start + max_k],
+                                 flat_cvs[start:start + max_k]))
+
+        async def finish() -> list[list[int]]:
+            from ..runtime.simloop import SimEventLoop
+            loop = asyncio.get_running_loop()
+            hosts = []
+            for v in pending:
+                if isinstance(loop, SimEventLoop):
+                    hosts.append(np.asarray(v))
+                else:
+                    hosts.append(await _DeviceSyncWorker.shared().run(np.asarray, v))
+            rows = [hosts[i // max_k][i % max_k]
+                    for i in range(len(flat_ebs))]
+            out = []
+            for start, n_chunks in spans:
+                verdicts: list[int] = []
+                for c in range(n_chunks):
+                    eb = flat_ebs[start + c]
+                    verdicts.extend(int(x) for x in rows[start + c][:eb.count])
+                out.append(verdicts)
             return out
 
         return finish()

@@ -70,15 +70,36 @@ def encode_batch(txns: list[TxnRequest], batch_size: int, ranges_per_txn: int,
     wb = np.tile(S, (B, R, 1))
     we = np.tile(S, (B, R, 1))
     snap = np.full(B, -1, dtype=np.int64)
+    # gather every key of the batch, then bulk-encode in one vectorized
+    # pass (keycode.encode_keys) — per-key encode_key calls measured
+    # ~2.3ms/batch of host time, 7x the entire resolve
+    keys: list[bytes] = []
+    ri, rj, wi, wj = [], [], [], []
     for i, t in enumerate(txns):
         if len(t.read_ranges) > R or len(t.write_ranges) > R:
             raise ValueError(
                 f"txn {i} has {len(t.read_ranges)}r/{len(t.write_ranges)}w ranges; bucket is {R}")
         for j, (b, e) in enumerate(t.read_ranges):
-            rb[i, j] = keycode.encode_key(b, width)
-            re[i, j] = keycode.encode_key(e, width)
-        for j, (b, e) in enumerate(t.write_ranges):
-            wb[i, j] = keycode.encode_key(b, width)
-            we[i, j] = keycode.encode_key(e, width)
+            keys.append(b)
+            keys.append(e)
+            ri.append(i)
+            rj.append(j)
         snap[i] = t.read_snapshot
+    n_read_keys = len(keys)
+    for i, t in enumerate(txns):
+        for j, (b, e) in enumerate(t.write_ranges):
+            keys.append(b)
+            keys.append(e)
+            wi.append(i)
+            wj.append(j)
+    if keys:
+        enc = keycode.encode_keys(keys, width)
+        renc = enc[:n_read_keys].reshape(-1, 2, L)
+        wenc = enc[n_read_keys:].reshape(-1, 2, L)
+        if ri:
+            rb[ri, rj] = renc[:, 0]
+            re[ri, rj] = renc[:, 1]
+        if wi:
+            wb[wi, wj] = wenc[:, 0]
+            we[wi, wj] = wenc[:, 1]
     return EncodedBatch(rb, re, wb, we, snap, len(txns))

@@ -1,23 +1,27 @@
 """NumPy twin of the TPU conflict kernel — the deterministic CPU reference.
 
-Same state layout and arithmetic as ops/conflict_jax.py, so TPU and CPU
-produce bit-identical verdicts; simulation always runs this twin
-(SURVEY.md §4: determinism with a TPU in the loop is hard part #1, solved
-by never putting the TPU in the sim loop).
+Same semantics, slab for slab, as ops/conflict_jax.py, so TPU and CPU
+produce bit-identical verdicts AND ring state; simulation always runs this
+twin (SURVEY.md §4: determinism with a TPU in the loop is hard part #1,
+solved by never putting the TPU in the sim loop).
 
 Replaces the reference's ConflictSet (REF:fdbserver/SkipList.cpp): where
 the reference walks a probabilistic skip list per range with SSE prefetch,
 we brute-force compare every read range in the batch against a
 fixed-capacity ring of (interval, version) write records — embarrassingly
-parallel, exactly what a TPU's VPU wants, and O(B·R·C) instead of
-O(B·R·log C), a trade that wins because the comparisons are 8-bit-wide
-vector lanes, not pointer chases.
+parallel, exactly what a TPU's VPU wants.
 
-Ring-overflow semantics: inserting over a still-live entry raises the
-``floor`` version to the overwritten entry's version, so any transaction
-whose snapshot predates it gets TOO_OLD — the same safe fallback the
-reference applies when history is compacted (setOldestVersion /
-MAX_WRITE_TRANSACTION_LIFE_VERSIONS, REF:fdbserver/Resolver.actor.cpp).
+Ring semantics (append-only slabs, mirroring the device kernel):
+
+- every resolved batch consumes a contiguous slab of B*R slots; lanes
+  that insert nothing store the sentinel interval [S, S) (overlaps
+  nothing) but still carry the batch's commit version, keeping the ring
+  version-dense so the device's window fast-path edge test is sound;
+- overwriting a slab raises the too-old ``floor`` to the overwritten
+  versions' max: history older than the evicted batch is gone, so any
+  snapshot preceding it gets TOO_OLD — the same safe fallback the
+  reference applies when history is compacted (setOldestVersion /
+  MAX_WRITE_TRANSACTION_LIFE_VERSIONS, REF:fdbserver/Resolver.actor.cpp).
 """
 
 from __future__ import annotations
@@ -40,20 +44,39 @@ def _overlap(ab, ae, bb, be, width):
 
 
 class NumpyConflictSet:
-    """Fixed-capacity conflict history ring + batch resolve."""
+    """Fixed-capacity conflict history ring + batch resolve.
+
+    The ring is allocated lazily on the first batch (slab size = B*R);
+    ``capacity`` is rounded up to a whole number of slabs, exactly as
+    JaxConflictSet does.
+    """
 
     def __init__(self, capacity: int, width: int = DEFAULT_WIDTH,
                  oldest_version: int = 0):
         self.capacity = capacity
         self.width = width
-        L = keycode.nlanes(width)
-        S = keycode.sentinel(width)
-        self.hb = np.tile(S, (capacity, 1))          # history begins [C, L]
-        self.he = np.tile(S, (capacity, 1))          # history ends   [C, L]
-        self.hver = np.full(capacity, -1, np.int64)  # history versions (-1 = empty)
-        self.ptr = 0
-        self.used = 0                                # occupied slots (== capacity once wrapped)
         self.floor = np.int64(oldest_version)
+        self.hb = None    # [C, L] uint32 (row-major on host; device twin is [L, 2C])
+        self.he = None
+        self.hver = None  # [C] int64, -1 = never written
+        self.ptr = 0
+        self.used = 0     # slots ever written (bounds the history scan)
+        self._slab = None
+
+    def _ensure_state(self, B: int, R: int) -> None:
+        if self.hb is not None:
+            if self._slab != B * R:
+                raise ValueError(
+                    f"batch shape changed: slab {B * R} != {self._slab}")
+            return
+        self._slab = B * R
+        cap = ((self.capacity + self._slab - 1) // self._slab) * self._slab
+        self.capacity = cap
+        L = keycode.nlanes(self.width)
+        S = keycode.sentinel(self.width)
+        self.hb = np.tile(S, (cap, 1))
+        self.he = np.tile(S, (cap, 1))
+        self.hver = np.full(cap, -1, np.int64)
 
     # --- ConflictSet API (mirrors newConflictSet/setOldestVersion/resolve) ---
 
@@ -65,22 +88,22 @@ class NumpyConflictSet:
         return int(self.floor)
 
     def resolve_encoded(self, eb: EncodedBatch, commit_version: int) -> np.ndarray:
-        """Returns verdicts [B] int8; updates the ring with committed writes."""
+        """Returns verdicts [B] int8; appends the batch's slab to the ring."""
         B, R, L = eb.shape
-        if B * R > self.capacity:
-            raise ValueError("batch write slots exceed ring capacity")
+        self._ensure_state(B, R)
+        S_ = B * R
         w = self.width
         snap = eb.read_snapshot  # [B]
 
         too_old = snap < self.floor
 
-        # 1. reads vs history ring, sliced to occupied slots (the TPU twin
-        #    scans the full fixed-shape ring; sentinel/empty rows compare
+        # 1. reads vs history ring, sliced to ever-written slots (the TPU
+        #    twin scans its full fixed-shape ring; sentinel rows compare
         #    identically to absent ones, so verdicts match exactly)
         U = self.used
         hit = _overlap(eb.read_begin[:, :, None, :], eb.read_end[:, :, None, :],
                        self.hb[None, None, :U, :], self.he[None, None, :U, :], w)
-        newer = self.hver[None, None, :U] > snap[:, None, None]  # [B,1,U] (hver=-1 never passes)
+        newer = self.hver[None, None, :U] > snap[:, None, None]
         hist_conflict = (hit & newer).any(axis=(1, 2))           # [B]
 
         # 2. intra-batch: reads of i vs writes of j: [B,R,1,1,L] x [1,1,B,R,L] -> [B,B]
@@ -103,20 +126,21 @@ class NumpyConflictSet:
             else:
                 committed[i] = True
 
-        # 4. insert committed writes at commit_version; raise floor over
-        #    any live entry we overwrite
-        valid_w = eb.write_begin[..., -1] != 0xFFFFFFFF          # [B,R] non-sentinel
-        ins = committed[:, None] & valid_w
-        idx_b, idx_r = np.nonzero(ins)
+        # 4. append the slab: committed writes keep their ranges, every
+        #    other lane stores the sentinel interval; the whole slab takes
+        #    commit_version.  Overwriting raises the floor to the evicted
+        #    versions' max.
+        SEN = keycode.sentinel(w)
+        valid_w = eb.write_begin[..., -1] != 0xFFFFFFFF          # [B,R]
+        ins = (committed[:, None] & valid_w).reshape(S_)
         p = self.ptr
-        for bi, ri in zip(idx_b, idx_r):
-            old = self.hver[p]
-            if old >= 0:
-                self.floor = max(self.floor, old)
-            self.hb[p] = eb.write_begin[bi, ri]
-            self.he[p] = eb.write_end[bi, ri]
-            self.hver[p] = commit_version
-            p = (p + 1) % self.capacity
-            self.used = max(self.used, p if p else self.capacity)
-        self.ptr = p
+        old = self.hver[p:p + S_]
+        self.floor = max(self.floor, np.int64(old.max(initial=np.int64(-1))))
+        slab_b = np.where(ins[:, None], eb.write_begin.reshape(S_, L), SEN)
+        slab_e = np.where(ins[:, None], eb.write_end.reshape(S_, L), SEN)
+        self.hb[p:p + S_] = slab_b
+        self.he[p:p + S_] = slab_e
+        self.hver[p:p + S_] = commit_version
+        self.ptr = (p + S_) % self.capacity
+        self.used = max(self.used, p + S_)
         return verdict

@@ -52,21 +52,67 @@ def encode_key(key: bytes, width: int = DEFAULT_WIDTH) -> np.ndarray:
     return out
 
 
+_kc_lib = None
+
+
+def _keycodec():
+    """Lazy-load the native bulk encoder; None if the toolchain is absent."""
+    global _kc_lib
+    if _kc_lib is None:
+        try:
+            import ctypes
+
+            from ..native import load_library
+
+            lib = load_library("keycodec")
+            lib.kc_encode.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
+            ]
+            _kc_lib = lib
+        except Exception:           # noqa: BLE001 — numpy fallback below
+            _kc_lib = False
+    return _kc_lib or None
+
+
 def encode_keys(keys: list[bytes], width: int = DEFAULT_WIDTH) -> np.ndarray:
-    """Vectorized batch encode → [N, nlanes] uint32."""
+    """Vectorized batch encode → [N, nlanes] uint32.
+
+    Native C path (native/keycodec.cpp) when available — one join + one
+    call, ~5µs per resolver batch; numpy gather fallback otherwise.  The
+    original per-key Python loop cost ~2µs/key, which dominated the whole
+    resolve pipeline at mako scale."""
     n = len(keys)
     L = nlanes(width)
     if n == 0:
         return np.zeros((0, L), dtype=np.uint32)
+    lens = np.fromiter((len(k) for k in keys), dtype=np.int64, count=n)
+    flat_b = b"".join(keys)
+    offs = np.empty(n + 1, dtype=np.int64)
+    offs[0] = 0
+    np.cumsum(lens, out=offs[1:])
+    lib = _keycodec()
+    if lib is not None:
+        out = np.empty((n, L), dtype=np.uint32)
+        lib.kc_encode(flat_b, offs, n, width, out)
+        return out
+    flat = np.frombuffer(flat_b, dtype=np.uint8)
+    starts = offs[:-1]
+    plens = np.minimum(lens, width)
     buf = np.zeros((n, width), dtype=np.uint8)
-    lens = np.empty(n, dtype=np.uint32)
-    for i, k in enumerate(keys):
-        p = k[:width]
-        buf[i, :len(p)] = np.frombuffer(p, dtype=np.uint8)
-        lens[i] = min(len(k), width + 1)
+    cols = np.arange(width)[None, :]
+    mask = cols < plens[:, None]
+    # clip keeps the flat index in range for masked-out (padding) cells
+    src = np.minimum(starts[:, None] + cols, len(flat) - 1)
+    buf[mask] = flat[src[mask]]
     lanes = buf.reshape(n, width // 4, 4).astype(np.uint32)
     packed = (lanes[:, :, 0] << 24) | (lanes[:, :, 1] << 16) | (lanes[:, :, 2] << 8) | lanes[:, :, 3]
-    return np.concatenate([packed, lens[:, None]], axis=1)
+    out = np.empty((n, L), dtype=np.uint32)
+    out[:, :-1] = packed
+    out[:, -1] = np.minimum(lens, width + 1).astype(np.uint32)
+    return out
 
 
 def decode_trunc_flag(enc: np.ndarray, width: int = DEFAULT_WIDTH):

@@ -42,10 +42,11 @@ from ..ops.keycode import DEFAULT_WIDTH
 
 class ShardedConflictState(NamedTuple):
     """ConflictState arrays with a leading resolver-shard axis, plus the
-    partition boundary table (replicated)."""
-    hb: jax.Array     # [S, C+1, L]
-    he: jax.Array     # [S, C+1, L]
-    hver: jax.Array   # [S, C+1]
+    partition boundary table (replicated).  Per-shard layout matches the
+    single-chip kernel: lane-major doubled ring (ops/conflict_jax.py)."""
+    hb: jax.Array     # [S, L, 2C]
+    he: jax.Array     # [S, L, 2C]
+    hver: jax.Array   # [S, 2C]
     ptr: jax.Array    # [S]
     floor: jax.Array  # [S]
     part_lo: jax.Array  # [S, L] partition begin keys (encoded)
@@ -83,9 +84,9 @@ def init_sharded_state(mesh: Mesh, capacity_per_shard: int,
     C = capacity_per_shard
     bounds = make_partition_boundaries(S, width, split_keys)
     state = ShardedConflictState(
-        hb=jnp.full((S, C + 1, L), 0xFFFFFFFF, jnp.uint32),
-        he=jnp.full((S, C + 1, L), 0xFFFFFFFF, jnp.uint32),
-        hver=jnp.full((S, C + 1), -1, jnp.int64),
+        hb=jnp.full((S, L, 2 * C), 0xFFFFFFFF, jnp.uint32),
+        he=jnp.full((S, L, 2 * C), 0xFFFFFFFF, jnp.uint32),
+        hver=jnp.full((S, 2 * C), -1, jnp.int64),
         ptr=jnp.zeros(S, jnp.int32),
         floor=jnp.full(S, oldest_version, jnp.int64),
         part_lo=jnp.asarray(bounds[:-1]),

@@ -52,9 +52,12 @@ def test_jax_numpy_bit_parity(seed, maxlen):
         np.testing.assert_array_equal(tv, jv, err_msg=f"verdicts diverge at step {step}")
         # state parity over the live ring (slot C is write-only trash)
         C = capacity
-        np.testing.assert_array_equal(twin.hb, np.asarray(kern.state.hb)[:C])
-        np.testing.assert_array_equal(twin.he, np.asarray(kern.state.he)[:C])
+        np.testing.assert_array_equal(twin.hb, np.asarray(kern.state.hb)[:, :C].T)
+        np.testing.assert_array_equal(twin.he, np.asarray(kern.state.he)[:, :C].T)
         np.testing.assert_array_equal(twin.hver, np.asarray(kern.state.hver)[:C])
+        # the doubled half must mirror the live half exactly
+        np.testing.assert_array_equal(np.asarray(kern.state.hb)[:, C:],
+                                      np.asarray(kern.state.hb)[:, :C])
         assert twin.ptr == int(kern.state.ptr)
         assert twin.oldest_version == kern.oldest_version
         if rng.coinflip(0.2):
@@ -111,3 +114,33 @@ def test_windowed_fast_path_parity(seed, window):
         jv = kern.resolve_encoded(eb, version)
         np.testing.assert_array_equal(tv, jv, err_msg=f"step {step}")
         np.testing.assert_array_equal(twin.hver, np.asarray(kern.state.hver)[:capacity])
+
+
+def test_group_submit_matches_serial():
+    """resolve_group_submit (fused scan + bucket padding) must be
+    bit-identical to one-batch-at-a-time submission, including ring state."""
+    rng = DeterministicRandom(21)
+    capacity = B * R * 8
+    serial = JaxConflictSet(capacity, W)
+    grouped = JaxConflictSet(capacity, W)
+    version = 100
+    for round_ in range(6):
+        k = rng.random_int(1, 7)        # hits buckets 1,2,4,8 incl. padding
+        ebs, cvs = [], []
+        for _ in range(k):
+            nt = rng.random_int(1, B + 1)
+            txns = [rand_txn(rng, max(0, version - 50), version + 1, W)
+                    for _ in range(nt)]
+            version += rng.random_int(1, 20)
+            ebs.append(encode_batch(txns, B, R, W))
+            cvs.append(version)
+        sv = [serial.resolve_encoded(eb, cv) for eb, cv in zip(ebs, cvs)]
+        gv = np.asarray(grouped.resolve_group_submit(ebs, cvs))
+        for i in range(k):
+            np.testing.assert_array_equal(sv[i], gv[i], err_msg=f"round {round_} batch {i}")
+        np.testing.assert_array_equal(np.asarray(serial.state.hver),
+                                      np.asarray(grouped.state.hver))
+        np.testing.assert_array_equal(np.asarray(serial.state.hb),
+                                      np.asarray(grouped.state.hb))
+        assert int(serial.state.ptr) == int(grouped.state.ptr)
+        assert int(serial.state.floor) == int(grouped.state.floor)

@@ -61,7 +61,10 @@ def test_sharded_cross_partition_conservative():
     any divergence from the single-resolver twin is COMMITTED->CONFLICT."""
     mesh = mesh8()
     step = make_sharded_resolve_step(mesh, W)
-    state = init_sharded_state(mesh, capacity_per_shard=B * R * 4, width=W)
+    # append-slab rings consume B*R slots per batch regardless of commit
+    # count, so capacity must cover the whole trace (15 batches) or the
+    # floor rises and adds TOO_OLD divergence on top of the phantom kind
+    state = init_sharded_state(mesh, capacity_per_shard=B * R * 64, width=W)
     twin = NumpyConflictSet(4096, W)
 
     rng = DeterministicRandom(10)
