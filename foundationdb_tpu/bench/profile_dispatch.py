@@ -1,0 +1,117 @@
+"""Degraded-mode dispatch anatomy: what blocks inside resolve_group_submit?
+
+After poisoning the session, time separately:
+  1. np.stack host-side of a 64-batch group
+  2. jnp.asarray (h2d) of the stacked arrays (~2.4MB)
+  3. pure dispatch of resolve_many on pre-device inputs (no block)
+  4. dispatch + block
+  5. back-to-back dispatches (state chained) without sync
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch
+    from foundationdb_tpu.ops.backends import coalesce_ranges
+    from foundationdb_tpu.ops.batch import TxnRequest
+
+    B, R, WIDTH, K = 64, 4, 32, 64
+    CAP = 1 << 19
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(K, B)
+
+    def enc(txns):
+        txns = [t if len(t.read_ranges) <= R and len(t.write_ranges) <= R
+                else TxnRequest(coalesce_ranges(t.read_ranges, R),
+                                coalesce_ranges(t.write_ranges, R),
+                                t.read_snapshot) for t in txns]
+        return encode_batch(txns, B, R, WIDTH)
+
+    ebs = [enc(b) for b in batches]
+
+    # poison
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    _ = np.asarray(jt(one))
+    t0 = time.perf_counter()
+    jt(one).block_until_ready()
+    print(f"RTT: {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+    # 1. host stack
+    t0 = time.perf_counter()
+    rb = np.stack([e.read_begin for e in ebs])
+    re_ = np.stack([e.read_end for e in ebs])
+    wb = np.stack([e.write_begin for e in ebs])
+    we = np.stack([e.write_end for e in ebs])
+    sn = np.stack([e.read_snapshot for e in ebs])
+    cvs = np.array(versions, dtype=np.int64)
+    print(f"1. np.stack group:        {(time.perf_counter()-t0)*1e3:8.1f}ms "
+          f"({(rb.nbytes*4+sn.nbytes)/1e6:.1f}MB)")
+
+    # 2. h2d
+    t0 = time.perf_counter()
+    drb = jax.device_put(rb, dev); dre = jax.device_put(re_, dev)
+    dwb = jax.device_put(wb, dev); dwe = jax.device_put(we, dev)
+    dsn = jax.device_put(sn, dev); dcv = jax.device_put(cvs, dev)
+    jax.block_until_ready((drb, dre, dwb, dwe, dsn, dcv))
+    print(f"2. h2d group (+sync):     {(time.perf_counter()-t0)*1e3:8.1f}ms")
+
+    t0 = time.perf_counter()
+    drb2 = jax.device_put(rb, dev)
+    print(f"2b. h2d one array async:  {(time.perf_counter()-t0)*1e3:8.1f}ms")
+    jax.block_until_ready(drb2)
+
+    # 3. pure dispatch no block
+    st = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    st, v = cj.resolve_many(st, drb, dre, dwb, dwe, dsn, dcv,
+                            width=WIDTH, window=4096)
+    v.block_until_ready()   # compile done
+    t0 = time.perf_counter()
+    st, v = cj.resolve_many(st, drb, dre, dwb, dwe, dsn, dcv,
+                            width=WIDTH, window=4096)
+    print(f"3. dispatch (no block):   {(time.perf_counter()-t0)*1e3:8.1f}ms")
+    t0 = time.perf_counter()
+    v.block_until_ready()
+    print(f"4. then block:            {(time.perf_counter()-t0)*1e3:8.1f}ms")
+
+    # 5. chained dispatches without sync
+    t0 = time.perf_counter()
+    vs = []
+    for _ in range(4):
+        st, v = cj.resolve_many(st, drb, dre, dwb, dwe, dsn, dcv,
+                                width=WIDTH, window=4096)
+        vs.append(v)
+    t_disp = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.block_until_ready(vs)
+    print(f"5. 4 chained dispatches:  {t_disp*1e3:8.1f}ms, block all: "
+          f"{(time.perf_counter()-t0)*1e3:8.1f}ms")
+
+    # 6. jnp.asarray-from-numpy inside the dispatch (backend style)
+    t0 = time.perf_counter()
+    st, v = cj.resolve_many(st, jnp.asarray(rb), jnp.asarray(re_),
+                            jnp.asarray(wb), jnp.asarray(we),
+                            jnp.asarray(sn), jnp.asarray(cvs),
+                            width=WIDTH, window=4096)
+    t_disp = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    v.block_until_ready()
+    print(f"6. asarray+dispatch:      {t_disp*1e3:8.1f}ms, block: "
+          f"{(time.perf_counter()-t0)*1e3:8.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

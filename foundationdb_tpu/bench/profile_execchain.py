@@ -1,0 +1,107 @@
+"""Split the grouped bench's ~1ms/batch into exec vs transfer.
+
+Bench-identical config (R=2, K=64, CAP=2^18, window=4096, 16 groups).
+  A. all inputs PRE-TRANSFERRED: chain 16 resolve_many_packed, block once
+     -> pure exec chain
+  B. transfer-only: device_put all 16 packed groups, block
+  C. full interleaved (transfer k+1 while exec k) like the real backend
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import conflict_jax as cj
+    from foundationdb_tpu.ops.batch import encode_batch
+
+    B, R, WIDTH, K, NG = 64, 2, 32, 64, 16
+    CAP = int(__import__('os').environ.get('CAP', 1 << 18))
+    WIN = 4096
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(NG * K, B)
+    L = 9
+
+    # pack groups host-side
+    n = K * B * R * L
+    packs = []
+    for g in range(NG):
+        ebs = [encode_batch(b, B, R, WIDTH) for b in batches[g * K:(g + 1) * K]]
+        pu32 = np.empty(4 * n, dtype=np.uint32)
+        for f, field in enumerate(("read_begin", "read_end", "write_begin", "write_end")):
+            dst = pu32[f * n:(f + 1) * n].reshape(K, B, R, L)
+            for i, e in enumerate(ebs):
+                dst[i] = getattr(e, field)
+        pi64 = np.empty(K * B + K, dtype=np.int64)
+        for i, e in enumerate(ebs):
+            pi64[i * B:(i + 1) * B] = e.read_snapshot
+        pi64[K * B:] = versions[g * K:(g + 1) * K]
+        packs.append((pu32, pi64))
+    print(f"group payload: {(packs[0][0].nbytes + packs[0][1].nbytes)/1e6:.2f}MB")
+
+    # degrade session
+    one = jax.device_put(jnp.float32(1.0), dev)
+    jt = jax.jit(lambda x: x + 1)
+    _ = np.asarray(jt(one))
+
+    shape = (K, B, R, L)
+    st = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    # compile
+    d0 = (jax.device_put(packs[0][0], dev), jax.device_put(packs[0][1], dev))
+    st, v = cj.resolve_many_packed(st, *d0, shape=shape, width=WIDTH, window=WIN)
+    v.block_until_ready()
+
+    # B. transfer only
+    t0 = time.perf_counter()
+    dev_packs = [(jax.device_put(a, dev), jax.device_put(b, dev))
+                 for a, b in packs]
+    jax.block_until_ready(dev_packs)
+    t_xfer = time.perf_counter() - t0
+    print(f"B. transfer 16 groups:   {t_xfer*1e3:7.0f}ms "
+          f"({NG*(packs[0][0].nbytes+packs[0][1].nbytes)/t_xfer/1e6:.0f} MB/s)")
+
+    # A. pure exec chain on pre-device inputs
+    t0 = time.perf_counter()
+    vs = []
+    for dp in dev_packs:
+        st, v = cj.resolve_many_packed(st, *dp, shape=shape, width=WIDTH,
+                                       window=WIN)
+        vs.append(v)
+    jax.block_until_ready(vs)
+    t_exec = time.perf_counter() - t0
+    print(f"A. exec chain 16 groups: {t_exec*1e3:7.0f}ms "
+          f"({t_exec/NG/K*1e3:.3f} ms/batch)")
+
+    # C. interleaved like the backend
+    st = jax.device_put(cj.init_state(CAP, WIDTH, 0), dev)
+    t0 = time.perf_counter()
+    vs = []
+    for a, b in packs:
+        da, db = jax.device_put(a, dev), jax.device_put(b, dev)
+        st, v = cj.resolve_many_packed(st, da, db, shape=shape, width=WIDTH,
+                                       window=WIN)
+        try:
+            v.copy_to_host_async()
+        except Exception:
+            pass
+        vs.append(v)
+    hosts = [np.asarray(v) for v in vs]
+    t_full = time.perf_counter() - t0
+    txns = NG * K * B
+    print(f"C. interleaved full:     {t_full*1e3:7.0f}ms "
+          f"-> {txns/t_full/1000:.0f}k txns/s")
+
+
+if __name__ == "__main__":
+    main()

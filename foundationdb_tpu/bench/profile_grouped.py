@@ -1,0 +1,88 @@
+"""Phase-level timing of the grouped resolver path on the live device.
+
+Where does the grouped bench's time go?  Encode, submit (dispatch), and
+sync phases measured separately, plus overlap behavior of K=64 groups.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+    dev = jax.devices()[0]
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+    from foundationdb_tpu.runtime import Knobs
+
+    B, GROUP = 64, 64
+    NB = 1024
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(NB, B)
+
+    knobs = Knobs().override(
+        RESOLVER_BATCH_TXNS=B, RESOLVER_RANGES_PER_TXN=4,
+        CONFLICT_RING_CAPACITY=1 << 19, KEY_ENCODE_BYTES=32,
+        RESOLVER_CONFLICT_BACKEND="tpu")
+    backend = make_conflict_backend(knobs, device=dev)
+
+    # warm: compile K=1 + K=64
+    backend.resolve(batches[0], versions[0] - 20_000_000)
+    ebs0 = backend._encode_chunks([t for b in batches[:GROUP] for t in b])
+    backend.cs.resolve_group_submit(ebs0, [versions[0] - 19_000_000] * len(ebs0))
+
+    # fresh cs state
+    backend = make_conflict_backend(knobs, device=dev)
+    backend.resolve(batches[0], versions[0] - 20_000_000)  # K=1 compile for new cs... cached
+
+    # phase 1: encode everything
+    t0 = time.perf_counter()
+    groups = []
+    for start in range(0, NB, GROUP):
+        ebs = []
+        for b in batches[start:start + GROUP]:
+            ebs.extend(backend._encode_chunks(b))
+        groups.append((ebs, list(versions[start:start + GROUP])))
+    t_enc = time.perf_counter() - t0
+    print(f"encode {NB} batches: {t_enc*1e3:8.1f}ms ({t_enc/NB*1e3:.3f} ms/batch)")
+
+    # phase 2: submit all groups (async dispatch)
+    t0 = time.perf_counter()
+    pend = [backend.cs.resolve_group_submit(ebs, cvs) for ebs, cvs in groups]
+    t_sub = time.perf_counter() - t0
+    print(f"submit {len(groups)} groups:  {t_sub*1e3:8.1f}ms")
+
+    # phase 3: sync all verdicts
+    t0 = time.perf_counter()
+    hosts = [np.asarray(v) for v in pend]
+    t_sync = time.perf_counter() - t0
+    print(f"sync  {len(groups)} groups:  {t_sync*1e3:8.1f}ms")
+    total = t_enc + t_sub + t_sync
+    txns = NB * B
+    print(f"total: {total*1e3:.1f}ms -> {txns/total/1000:.1f}k txns/s")
+
+    # again (steady state, no compile effects)
+    t0 = time.perf_counter()
+    pend = [backend.cs.resolve_group_submit(ebs, cvs) for ebs, cvs in groups]
+    hosts = [np.asarray(v) for v in pend]
+    total = time.perf_counter() - t0
+    print(f"round 2 submit+sync: {total*1e3:.1f}ms -> {txns/total/1000:.1f}k txns/s "
+          f"(encode excluded)")
+
+    # sync one group at a time right after its submit (serialized style)
+    t0 = time.perf_counter()
+    for ebs, cvs in groups[:4]:
+        v = backend.cs.resolve_group_submit(ebs, cvs)
+        np.asarray(v)
+    t = time.perf_counter() - t0
+    print(f"serialized 4 groups: {t*1e3:.1f}ms ({t/4*1e3:.1f} ms/group)")
+
+
+if __name__ == "__main__":
+    main()

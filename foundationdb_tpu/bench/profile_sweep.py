@@ -1,0 +1,55 @@
+"""Sweep grouped-resolver parameters on the live device: GROUP x INFLIGHT x R.
+
+Uses the exact bench driver (measure_grouped) over 1024 mako batches.
+mako txns carry 2 reads + 2 writes, so R=2 halves transfer volume and
+kernel rows vs the default R=4.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    dev = jax.devices()[0]
+
+    sys.path.insert(0, "/root/repo")
+    from bench import measure_grouped
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+    from foundationdb_tpu.runtime import Knobs
+
+    B, NB = 64, 1024
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(NB, B)
+
+    for R in (2, 4):
+        knobs = Knobs().override(
+            RESOLVER_BATCH_TXNS=B, RESOLVER_RANGES_PER_TXN=R,
+            CONFLICT_RING_CAPACITY=NB * B * R * 2, KEY_ENCODE_BYTES=32,
+            CONFLICT_WINDOW_SLOTS=B * R * 16,
+            RESOLVER_CONFLICT_BACKEND="tpu")
+        for GROUP in (64, 128, 256):
+            for INFLIGHT in (8, 32):
+                backend = make_conflict_backend(knobs, device=dev)
+                # warm compile on a throwaway run
+                wb, wv = wl.make_batches(GROUP, B,
+                                         start_version=versions[-1] + 10**7)
+                measure_grouped(backend, wb, wv, group=GROUP, inflight=INFLIGHT)
+                backend = make_conflict_backend(knobs, device=dev)
+                el, verd = measure_grouped(backend, batches, versions,
+                                           group=GROUP, inflight=INFLIGHT)
+                flat = np.array([x for vs in verd for x in vs])
+                commits = int((flat == 0).sum())
+                print(f"R={R} GROUP={GROUP:3d} INFLIGHT={INFLIGHT:2d}: "
+                      f"{el*1e3:7.0f}ms -> {len(flat)/el/1000:7.1f}k txns/s, "
+                      f"{commits/el/1000:7.1f}k commits/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

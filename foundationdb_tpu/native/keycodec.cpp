@@ -28,4 +28,54 @@ void kc_encode(const uint8_t* flat, const int64_t* offs, int64_t n,
     }
 }
 
+static inline void encode_one(const uint8_t* k, int64_t len, int64_t width,
+                              uint32_t* row) {
+    const int64_t nd = width / 4;
+    const int64_t plen = len < width ? len : width;
+    for (int64_t l = 0; l < nd; ++l) row[l] = 0;
+    for (int64_t b = 0; b < plen; ++b)
+        row[b >> 2] |= static_cast<uint32_t>(k[b]) << (8 * (3 - (b & 3)));
+    row[nd] = static_cast<uint32_t>(len < width + 1 ? len : width + 1);
+}
+
+// Whole-batch encoder: fills the four padded [B, R, L] uint32 lane arrays
+// (sentinel rows where no range) straight from the batch's key blob.
+//
+// flat/offs: concatenated key bytes + offsets, in txn order:
+//   txn0: r0.begin r0.end r1.begin r1.end ... w0.begin w0.end ...
+// nr/nw: per-txn read/write range counts (n_txns entries).
+// rb/re/wb/we: B*R*L uint32 outputs, L = width/4 + 1.
+void kc_encode_batch(const uint8_t* flat, const int64_t* offs,
+                     const int32_t* nr, const int32_t* nw, int64_t n_txns,
+                     int64_t B, int64_t R, int64_t width,
+                     uint32_t* rb, uint32_t* re, uint32_t* wb, uint32_t* we) {
+    const int64_t L = width / 4 + 1;
+    const int64_t row_words = R * L;
+    for (int64_t i = 0; i < B * row_words; ++i)
+        rb[i] = re[i] = wb[i] = we[i] = 0xFFFFFFFFu;
+    int64_t key = 0;
+    for (int64_t i = 0; i < n_txns; ++i) {
+        uint32_t* rrb = rb + i * row_words;
+        uint32_t* rre = re + i * row_words;
+        for (int32_t j = 0; j < nr[i]; ++j) {
+            encode_one(flat + offs[key], offs[key + 1] - offs[key], width,
+                       rrb + j * L);
+            ++key;
+            encode_one(flat + offs[key], offs[key + 1] - offs[key], width,
+                       rre + j * L);
+            ++key;
+        }
+        uint32_t* rwb = wb + i * row_words;
+        uint32_t* rwe = we + i * row_words;
+        for (int32_t j = 0; j < nw[i]; ++j) {
+            encode_one(flat + offs[key], offs[key + 1] - offs[key], width,
+                       rwb + j * L);
+            ++key;
+            encode_one(flat + offs[key], offs[key + 1] - offs[key], width,
+                       rwe + j * L);
+            ++key;
+        }
+    }
+}
+
 }  // extern "C"

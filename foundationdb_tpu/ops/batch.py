@@ -62,17 +62,50 @@ def encode_batch(txns: list[TxnRequest], batch_size: int, ranges_per_txn: int,
     resolver role picks a bucket by knob.
     """
     B, R, L = batch_size, ranges_per_txn, keycode.nlanes(width)
-    if len(txns) > B:
-        raise ValueError(f"batch of {len(txns)} exceeds batch_size {B}")
+    n = len(txns)
+    if n > B:
+        raise ValueError(f"batch of {n} exceeds batch_size {B}")
+    lib = keycode._keycodec()
+    if lib is not None:
+        # single-pass native path: one key blob + offsets in, the four
+        # padded lane arrays out (native/keycodec.cpp kc_encode_batch);
+        # the Python side only walks the txn list once
+        parts: list[bytes] = []
+        nr = np.empty(n, dtype=np.int32)
+        nw = np.empty(n, dtype=np.int32)
+        snap = np.full(B, -1, dtype=np.int64)
+        for i, t in enumerate(txns):
+            if len(t.read_ranges) > R or len(t.write_ranges) > R:
+                raise ValueError(
+                    f"txn {i} has {len(t.read_ranges)}r/{len(t.write_ranges)}w ranges; bucket is {R}")
+            nr[i] = len(t.read_ranges)
+            nw[i] = len(t.write_ranges)
+            for b, e in t.read_ranges:
+                parts.append(b)
+                parts.append(e)
+            for b, e in t.write_ranges:
+                parts.append(b)
+                parts.append(e)
+            snap[i] = t.read_snapshot
+        lens = np.fromiter(map(len, parts), dtype=np.int64, count=len(parts))
+        offs = np.empty(len(parts) + 1, dtype=np.int64)
+        offs[0] = 0
+        np.cumsum(lens, out=offs[1:])
+        rb = np.empty((B, R, L), dtype=np.uint32)
+        re = np.empty((B, R, L), dtype=np.uint32)
+        wb = np.empty((B, R, L), dtype=np.uint32)
+        we = np.empty((B, R, L), dtype=np.uint32)
+        lib.kc_encode_batch(b"".join(parts), offs, nr, nw, n, B, R, width,
+                            rb, re, wb, we)
+        return EncodedBatch(rb, re, wb, we, snap, n)
+    # numpy fallback: gather every key, bulk-encode, scatter into the
+    # padded arrays (per-key encode_key calls measured ~2.3ms/batch)
     S = keycode.sentinel(width)
     rb = np.tile(S, (B, R, 1))
     re = np.tile(S, (B, R, 1))
     wb = np.tile(S, (B, R, 1))
     we = np.tile(S, (B, R, 1))
     snap = np.full(B, -1, dtype=np.int64)
-    # gather every key of the batch, then bulk-encode in one vectorized
-    # pass (keycode.encode_keys) — per-key encode_key calls measured
-    # ~2.3ms/batch of host time, 7x the entire resolve
     keys: list[bytes] = []
     ri, rj, wi, wj = [], [], [], []
     for i, t in enumerate(txns):

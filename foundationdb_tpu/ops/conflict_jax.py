@@ -296,6 +296,32 @@ resolve_many = functools.partial(jax.jit, static_argnames=("width", "window"),
                                  donate_argnums=(0,))(resolve_many_core)
 
 
+@functools.partial(jax.jit, static_argnames=("shape", "width", "window"),
+                   donate_argnums=(0,))
+def resolve_many_packed(state: ConflictState, pu32, pi64, *, shape,
+                        width: int = DEFAULT_WIDTH, window: int = 0):
+    """resolve_many on single-buffer inputs.
+
+    The axon tunnel moves one big transfer at ~150MB/s but many small ones
+    at ~20MB/s (per-transfer overhead), so the group's four lane arrays
+    ride in one uint32 buffer and the snapshots+versions in one int64
+    buffer; unpacking is free slicing inside the jit.
+
+    pu32: [4*K*B*R*L] = rb | re | wb | we, raveled.
+    pi64: [K*B + K]   = snapshots | commit_versions.
+    """
+    K, B, R, L = shape
+    n = K * B * R * L
+    rb = pu32[0:n].reshape(K, B, R, L)
+    re = pu32[n:2 * n].reshape(K, B, R, L)
+    wb = pu32[2 * n:3 * n].reshape(K, B, R, L)
+    we = pu32[3 * n:4 * n].reshape(K, B, R, L)
+    sn = pi64[:K * B].reshape(K, B)
+    cvs = pi64[K * B:]
+    return resolve_many_core(state, rb, re, wb, we, sn, cvs,
+                             width=width, window=window)
+
+
 @jax.jit
 def set_oldest_step(state: ConflictState, v) -> ConflictState:
     """setOldestVersion analog (REF:fdbserver/SkipList.cpp setOldestVersion):
@@ -380,10 +406,13 @@ class JaxConflictSet:
         copies share it)."""
         B, R, L = eb.read_begin.shape
         self._ensure_state(B, R)
+        # jax.device_put stays asynchronous on the axon tunnel where
+        # jnp.asarray blocks ~RTT per array once the session is degraded
+        put = functools.partial(jax.device_put, device=self.device)
         self.state, verdicts = resolve_step(
-            self.state, jnp.asarray(eb.read_begin), jnp.asarray(eb.read_end),
-            jnp.asarray(eb.write_begin), jnp.asarray(eb.write_end),
-            jnp.asarray(eb.read_snapshot), jnp.int64(commit_version),
+            self.state, put(eb.read_begin), put(eb.read_end),
+            put(eb.write_begin), put(eb.write_end),
+            put(eb.read_snapshot), jnp.int64(commit_version),
             width=self.width, window=self.window)
         self._start_d2h(verdicts)
         return verdicts
@@ -402,20 +431,21 @@ class JaxConflictSet:
         k = len(ebs)
         K = next(b for b in GROUP_BUCKETS if b >= k) if k <= GROUP_BUCKETS[-1] \
             else ((k + GROUP_BUCKETS[-1] - 1) // GROUP_BUCKETS[-1]) * GROUP_BUCKETS[-1]
-        S = keycode.sentinel(self.width)
-        pad_rb = np.tile(S, (B, R, 1))
-        pad_sn = np.full(B, -1, dtype=np.int64)
-
-        def stack(field, pad):
-            return jnp.asarray(np.stack(
-                [getattr(e, field) for e in ebs] + [pad] * (K - k)))
-
-        cvs = jnp.asarray(np.array(list(commit_versions) + [-1] * (K - k),
-                                   dtype=np.int64))
-        self.state, verdicts = resolve_many(
-            self.state, stack("read_begin", pad_rb), stack("read_end", pad_rb),
-            stack("write_begin", pad_rb), stack("write_end", pad_rb),
-            stack("read_snapshot", pad_sn), cvs,
+        n = K * B * R * L
+        pu32 = np.full(4 * n, 0xFFFFFFFF, dtype=np.uint32)
+        kn = k * B * R * L
+        for f, field in enumerate(("read_begin", "read_end",
+                                   "write_begin", "write_end")):
+            dst = pu32[f * n:f * n + kn].reshape(k, B, R, L)
+            for i, e in enumerate(ebs):
+                dst[i] = getattr(e, field)
+        pi64 = np.full(K * B + K, -1, dtype=np.int64)
+        for i, e in enumerate(ebs):
+            pi64[i * B:(i + 1) * B] = e.read_snapshot
+        pi64[K * B:K * B + k] = commit_versions
+        put = functools.partial(jax.device_put, device=self.device)
+        self.state, verdicts = resolve_many_packed(
+            self.state, put(pu32), put(pi64), shape=(K, B, R, L),
             width=self.width, window=self.window)
         self._start_d2h(verdicts)
         return verdicts

@@ -71,6 +71,15 @@ def _keycodec():
                 ctypes.c_int64, ctypes.c_int64,
                 np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
             ]
+            u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+            lib.kc_encode_batch.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                u32p, u32p, u32p, u32p,
+            ]
             _kc_lib = lib
         except Exception:           # noqa: BLE001 — numpy fallback below
             _kc_lib = False
