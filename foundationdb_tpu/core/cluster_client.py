@@ -1,0 +1,113 @@
+"""Client view of a recovered cluster — stubs built from coordinated state.
+
+Reference: REF:fdbclient/MonitorLeader.actor.cpp +
+REF:fdbclient/NativeAPI.actor.cpp (DatabaseContext) — a client connects to
+the coordinators named in its cluster file, fetches the latest published
+cluster state (OpenDatabaseCoordRequest), and builds proxy/storage stubs
+from it; when the state's epoch advances (a recovery happened) the client
+re-points its stubs at the new transaction subsystem.
+
+``RecoveredClusterView`` exposes exactly the surface
+client/transaction.Transaction consumes (grv_proxies, commit_proxies,
+storage_for_key, storages_for_range, knobs), so a Transaction cannot tell
+this view from an in-process cluster.py assembly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..rpc.stubs import (CommitProxyClient, GrvProxyClient, StorageClient)
+from ..rpc.transport import NetworkAddress, Transport
+from ..runtime.errors import FdbError
+from ..runtime.knobs import Knobs
+from .data import KeyRange
+from .load_balance import ReplicaGroup
+from .shard_map import ShardMap
+
+
+class RecoveredClusterView:
+    """Stub bundle over the cluster state dict recover_once publishes."""
+
+    def __init__(self, knobs: Knobs, transport: Transport, state: dict) -> None:
+        self.knobs = knobs
+        self.transport = transport
+        self.epoch = -1
+        self.update(state)
+
+    def update(self, state: dict) -> None:
+        """(Re)build stubs from a (possibly newer) cluster state."""
+        if state["epoch"] <= self.epoch:
+            return
+        t = self.transport
+
+        def addr(a):
+            return NetworkAddress(a[0], a[1])
+
+        self.epoch = state["epoch"]
+        self.commit_proxies = [
+            CommitProxyClient(t, addr(p["addr"]), p["token"])
+            for p in state["commit_proxies"]]
+        self.grv_proxies = [
+            GrvProxyClient(t, addr(p["addr"]), p["token"])
+            for p in state["grv_proxies"]]
+        self.storage_clients = [
+            StorageClient(t, addr(s["addr"]), s["token"], s["tag"],
+                          KeyRange(s["begin"], s["end"]))
+            for s in state["storage"]]
+        self.shard_map = ShardMap(state["shard_boundaries"],
+                                  state["shard_teams"])
+        by_tag = {sc.tag: sc for sc in self.storage_clients}
+        # reads load-balance over the replication team and fail over past
+        # dead replicas (REF:fdbrpc/LoadBalance.actor.h)
+        self._groups = []
+        for rng, tags in self.shard_map.ranges():
+            replicas = [by_tag[tg] for tg in tags if tg in by_tag]
+            self._groups.append(ReplicaGroup(rng, replicas) if replicas
+                                else None)
+
+    # --- location lookup (getKeyLocation analog) ---
+
+    def storage_for_key(self, key: bytes):
+        g = self._groups[self.shard_map.shard_index(key)]
+        if g is None:
+            raise KeyError(f"no storage team for key {key!r}")
+        return g
+
+    def storages_for_range(self, begin: bytes, end: bytes):
+        import bisect
+        if begin >= end:
+            return []
+        lo = self.shard_map.shard_index(begin)
+        # bisect_left keeps a range ending exactly on a boundary out of the
+        # following shard (same rule as ShardMap.tags_for_range)
+        hi = bisect.bisect_left(self.shard_map.boundaries, end)
+        out = []
+        for i in range(lo, min(hi, len(self._groups) - 1) + 1):
+            g = self._groups[i]
+            if g is not None:
+                out.append(g)
+        return out
+
+
+async def open_cluster(knobs: Knobs, transport: Transport,
+                       coordinators: list) -> RecoveredClusterView:
+    """Fetch the freshest published cluster state from the coordinators
+    (read-only open_database — never registers a read generation, so
+    clients can't invalidate a recovering controller) and build a view."""
+    state = await fetch_cluster_state(coordinators)
+    return RecoveredClusterView(knobs, transport, state)
+
+
+async def fetch_cluster_state(coordinators: list) -> dict:
+    replies = await asyncio.gather(
+        *(c.open_database() for c in coordinators), return_exceptions=True)
+    best: dict | None = None
+    for r in replies:
+        if isinstance(r, BaseException) or not r:
+            continue
+        if best is None or r.get("epoch", 0) > best.get("epoch", 0):
+            best = r
+    if best is None:
+        raise FdbError("no coordinator returned a cluster state")
+    return best

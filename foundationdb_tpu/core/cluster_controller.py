@@ -32,7 +32,7 @@ from typing import Any
 from ..rpc.failure_monitor import FailureMonitor
 from ..rpc.stubs import TLogClient, WorkerClient
 from ..rpc.transport import NetworkAddress, Transport
-from ..runtime.errors import FdbError
+from ..runtime.errors import FdbError, LogDataLoss
 from ..runtime.knobs import Knobs
 from ..runtime.trace import TraceEvent
 from .coordination import CoordinatedState
@@ -49,6 +49,7 @@ class ClusterConfigSpec:
     storage_servers: int = 2
     replication: int = 1          # storage replicas per shard
     log_replication: int = 2
+    min_workers: int = 1          # recovery waits until this many registered
 
 
 class ClusterController:
@@ -110,14 +111,19 @@ class ClusterController:
                     if i not in dead:
                         dead.append(i)
             n = len(cur["tlogs"])
-            # every tag needs a live replica: check coverage
-            covered = set()
-            repl = cur["replication"]
-            for tag in range(64):                    # tags are small ints
-                hosts = [(tag + j) % n for j in range(max(1, min(repl, n)))]
+            # every storage tag needs a live replica in the locked
+            # generation; a tag whose every hosting log is dead means real
+            # data loss and recovery MUST refuse rather than serve a gap
+            # (log_system.py's cursor-level LogDataLoss, enforced here
+            # before the cluster ever accepts a commit)
+            repl = max(1, min(cur["replication"], n))
+            needed_tags = {s["tag"] for s in prev_state.get("storage", [])}
+            for tag in sorted(needed_tags):
+                hosts = [(tag + j) % n for j in range(repl)]
                 if all(h in dead for h in hosts):
-                    TraceEvent("RecoveryWaitingForLogs", severity=30) \
-                        .detail("Tag", tag).log()
+                    TraceEvent("RecoveryDataLoss", severity=40) \
+                        .detail("Tag", tag).detail("Hosts", hosts).log()
+                    raise LogDataLoss()
             if not tips:
                 raise FdbError("no lockable logs")
             recovery_version = min(tips)
@@ -128,8 +134,12 @@ class ClusterController:
         # ---- recruit the new transaction subsystem ----
         self.recovery_state = "RECRUITING"
         live = self._live_workers()
-        if not live:
-            raise FdbError("no live workers")
+        # min_workers gates only the INITIAL cluster creation (so recruits
+        # spread over the fleet instead of piling onto the first
+        # registrant); later epochs recover with whoever survives
+        needed = max(1, spec.min_workers) if prev_state is None else 1
+        if len(live) < needed:
+            raise FdbError("waiting for workers")
 
         def pick(i: int) -> NetworkAddress:
             return live[i % len(live)][0]
@@ -176,12 +186,16 @@ class ClusterController:
             storage_meta = [dict(s) for s in prev_state["storage"]]
             for s in storage_meta:
                 wa = NetworkAddress(s["worker"][0], s["worker"][1])
-                if not self.fm.is_available(wa):
-                    continue       # dead replica: reads fail over
+                w = self.workers.get(wa)
+                # a dead machine's worker is unregistered and/or failed:
+                # skip the replica, reads fail over to its team
+                if w is None or not self.fm.is_available(wa):
+                    continue
                 try:
-                    await self.workers[wa].rejoin_storage(
-                        s["token"], wire_log_cfg, rv)
-                except FdbError:
+                    await asyncio.wait_for(
+                        w.rejoin_storage(s["token"], wire_log_cfg, rv),
+                        timeout=k.FAILURE_TIMEOUT * 4)
+                except (FdbError, asyncio.TimeoutError):
                     TraceEvent("StorageRejoinFailed", severity=30) \
                         .detail("Tag", s["tag"]).log()
         else:
@@ -199,14 +213,19 @@ class ClusterController:
                         "token": t, "tag": tag,
                         "begin": rng.begin, "end": rng.end})
 
+        # ---- ratekeeper (admission control over the new storage set) ----
+        rk_addr, rk_tok = await self._recruit(pick(7), "ratekeeper", {
+            "storage": storage_meta, "log_cfg": wire_log_cfg})
+
         # ---- proxies (they need everything above) ----
         boundaries = shard_map.boundaries
         teams = shard_map.shard_tags
         proxy_params = {
             "sequencer": seq_addr, "sequencer_token": seq_tok,
-            "resolvers": [(list(a), b, e) for a, b, e, _ in resolver_info],
+            "resolvers": [(list(a), b, e, t) for a, b, e, t in resolver_info],
             "log_cfg": wire_log_cfg,
             "shard_boundaries": boundaries, "shard_teams": teams,
+            "ratekeeper": rk_addr, "ratekeeper_token": rk_tok,
         }
         commit_info, grv_info = [], []
         for i in range(spec.commit_proxies):
@@ -228,6 +247,7 @@ class ClusterController:
             "resolvers": [{"addr": list(a), "begin": b, "end": e, "token": t}
                           for a, b, e, t in resolver_info],
             "storage": storage_meta,
+            "ratekeeper": {"addr": rk_addr, "token": rk_tok},
             "commit_proxies": [{"addr": a, "token": t} for a, t in commit_info],
             "grv_proxies": [{"addr": a, "token": t} for a, t in grv_info],
             "shard_boundaries": boundaries,
@@ -241,9 +261,13 @@ class ClusterController:
 
     @staticmethod
     def _wire_gen(g: dict) -> dict:
-        """Strip controller-only fields from a generation for role use."""
+        """Generation config as roles consume it.  The per-TLog token list
+        MUST ride along: recruited TLogs live at recruited token blocks on
+        shared worker transports, so a role rebuilding the log-system view
+        dials each one at its recorded token (worker.generations_from_config)."""
         return {"epoch": g["epoch"], "begin": g["begin"], "end": g["end"],
                 "tlogs": [tuple(a) for a in g["tlogs"]],
+                "token": list(g.get("token", [])) or None,
                 "replication": g["replication"],
                 "dead": list(g.get("dead", []))}
 
@@ -265,6 +289,11 @@ class ClusterController:
                     .detail("Error", e.name).log()
                 await asyncio.sleep(self.knobs.RECOVERY_RETRY_DELAY)
                 continue
+            except Exception as e:  # noqa: BLE001 — a wedged CC is worse
+                TraceEvent("RecoveryFailed", severity=40) \
+                    .detail("Error", repr(e)[:200]).log()
+                await asyncio.sleep(self.knobs.RECOVERY_RETRY_DELAY)
+                continue
             # watch every txn-subsystem address
             watch = [NetworkAddress(*state["sequencer"]["addr"])]
             watch += [NetworkAddress(*g)
@@ -272,6 +301,8 @@ class ClusterController:
             watch += [NetworkAddress(*r["addr"]) for r in state["resolvers"]]
             watch += [NetworkAddress(*p["addr"])
                       for p in state["commit_proxies"] + state["grv_proxies"]]
+            if state.get("ratekeeper"):
+                watch.append(NetworkAddress(*state["ratekeeper"]["addr"]))
             waiters = [asyncio.ensure_future(self.fm.wait_for_failure(a))
                        for a in set(watch)]
             try:

@@ -1,0 +1,184 @@
+"""ClusterHost — one process: worker + election candidate + (maybe) the CC.
+
+Reference: REF:fdbserver/worker.actor.cpp — every fdbserver process runs
+``workerServer`` plus ``clusterController`` behind ``tryBecomeLeader``:
+the process that wins the coordinator election runs the ClusterController
+actor and everyone else registers their worker with it
+(RegisterWorkerRequest); losing the lease stands the controller down and
+the survivors re-elect.
+
+Token-space convention: every host serves its Worker at the shared BASE
+token block of its own transport, and the cluster-controller RPC surface
+at ``BASE + CC_TOKEN_OFFSET`` — so a follower can dial any leader knowing
+only its network address (exactly like the reference's well-known
+endpoint tokens).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from ..rpc.stubs import (ClusterControllerClient, WorkerClient, serve_role)
+from ..rpc.transport import NetworkAddress, Transport
+from ..runtime.knobs import Knobs
+from ..runtime.trace import TraceEvent
+from .cluster_controller import ClusterConfigSpec, ClusterController
+from .coordination import (CoordinatedState, CoordinatorsUnreachable,
+                           elect_leader)
+from .worker import Worker
+
+CC_TOKEN_OFFSET = 8     # CC RPC surface inside the worker's token block
+
+
+class ClusterHost:
+    """Worker + election loop; runs the ClusterController while leading."""
+
+    def __init__(self, host_id: int, knobs: Knobs, transport: Transport,
+                 client_transport_factory: Callable[[], Transport],
+                 base_token: int, coordinators: list,
+                 spec: ClusterConfigSpec | None = None) -> None:
+        self.id = host_id
+        self.knobs = knobs
+        self.transport = transport
+        self.make_client_transport = client_transport_factory
+        self.base = base_token
+        self.coordinators = coordinators
+        self.spec = spec or ClusterConfigSpec()
+        self.worker = Worker(host_id, knobs, transport,
+                             client_transport_factory, base_token)
+        self._client_t = client_transport_factory()
+        self._registry: dict[NetworkAddress, WorkerClient] = {}
+        self._leading = False
+        self.cc: ClusterController | None = None
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+        serve_role(transport, "cluster_controller", self,
+                   base_token + CC_TOKEN_OFFSET)
+
+    @property
+    def address(self) -> NetworkAddress:
+        return self.transport.address
+
+    # --- CC RPC surface (live on every host; meaningful when leading) ---
+
+    async def register_worker(self, addr: list, worker_token: int) -> bool:
+        """RegisterWorkerRequest analog; False tells the caller this host
+        is not (or no longer) the cluster controller."""
+        if not self._leading:
+            return False
+        wa = NetworkAddress(addr[0], addr[1])
+        if wa not in self._registry:
+            self._registry[wa] = WorkerClient(self._client_t, wa, worker_token)
+            TraceEvent("CCRegisteredWorker").detail("Worker", str(wa)).log()
+        return True
+
+    async def get_cluster_state(self) -> dict | None:
+        if self.cc is not None and getattr(self.cc, "last_state", None):
+            return self.cc.last_state
+        return None
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self.run(), name=f"cluster-host-{self.id}")
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self.cc is not None:
+            await self.cc.stop()
+        await self.worker.shutdown()
+
+    # --- the main loop: elect, lead or follow, repeat ---
+
+    async def run(self) -> None:
+        k = self.knobs
+        me = [self.address.ip, self.address.port]
+        while not self._stopped:
+            try:
+                leader_id, leader_addr = await elect_leader(
+                    self.coordinators, self.id, me, k)
+            except CoordinatorsUnreachable:
+                await asyncio.sleep(k.RECOVERY_RETRY_DELAY)
+                continue
+            if leader_id == self.id:
+                await self._lead()
+            else:
+                await self._follow(leader_addr)
+
+    async def _lead(self) -> None:
+        """Run the ClusterController until the coordinator lease is lost."""
+        k = self.knobs
+        TraceEvent("BecameClusterController").detail("Host", self.id).log()
+        self._registry.clear()
+        self._registry[self.address] = WorkerClient(
+            self._client_t, self.address, self.worker.base)
+        cstate = CoordinatedState(self.coordinators, self.id)
+        self.cc = ClusterController(k, self.make_client_transport(), cstate,
+                                    self._registry, self.spec, self.base)
+        self._leading = True
+        cc_task = asyncio.get_running_loop().create_task(
+            self._run_cc(), name=f"cc-{self.id}")
+        try:
+            while True:
+                await asyncio.sleep(k.LEADER_HEARTBEAT_INTERVAL)
+                if cc_task.done():
+                    TraceEvent("CCActorDied", severity=40) \
+                        .detail("Host", self.id) \
+                        .detail("Error", repr(cc_task.exception())[:200]).log()
+                    return
+                replies = await asyncio.gather(
+                    *(c.leader_heartbeat(self.id) for c in self.coordinators),
+                    return_exceptions=True)
+                good = sum(1 for r in replies if r is True)
+                if good < len(self.coordinators) // 2 + 1:
+                    TraceEvent("CCLeaseLost", severity=30) \
+                        .detail("Host", self.id).log()
+                    return
+        finally:
+            self._leading = False
+            cc_task.cancel()
+            await asyncio.gather(cc_task, return_exceptions=True)
+            await self.cc.stop()
+            self.cc = None
+
+    async def _run_cc(self) -> None:
+        """cc.run() with state capture for get_cluster_state."""
+        assert self.cc is not None
+        cc = self.cc
+        orig = cc.recover_once
+
+        async def capturing(prev):
+            state = await orig(prev)
+            cc.last_state = state
+            return state
+
+        cc.recover_once = capturing     # type: ignore[method-assign]
+        await cc.run()
+
+    async def _follow(self, leader_addr) -> None:
+        """Register with the leader; return (to re-elect) when it dies or
+        stops leading."""
+        k = self.knobs
+        stub = ClusterControllerClient(
+            self._client_t, NetworkAddress(leader_addr[0], leader_addr[1]),
+            self.base + CC_TOKEN_OFFSET)
+        me = [self.address.ip, self.address.port]
+        while not self._stopped:
+            try:
+                ok = await asyncio.wait_for(
+                    stub.register_worker(me, self.worker.base),
+                    timeout=k.FAILURE_TIMEOUT * 2)
+            except (Exception, asyncio.TimeoutError):
+                ok = False
+            if not ok:
+                return
+            await asyncio.sleep(k.LEADER_HEARTBEAT_INTERVAL * 2)

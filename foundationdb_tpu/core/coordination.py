@@ -226,7 +226,9 @@ async def elect_leader(coordinators: list, candidate_id: int, address: Any,
         raise CoordinatorsUnreachable()
     tally: dict[tuple[int, Any], int] = {}
     for r in ok:
-        key = (r[0], r[1])
+        # addresses decode from the wire as lists; normalize for hashing
+        a = r[1]
+        key = (r[0], tuple(a) if isinstance(a, list) else a)
         tally[key] = tally.get(key, 0) + 1
     (leader_id, addr), _ = min(tally.items(),
                                key=lambda kv: (-kv[1], kv[0][0]))

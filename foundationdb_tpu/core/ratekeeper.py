@@ -49,26 +49,51 @@ class Ratekeeper:
     async def _update_loop(self) -> None:
         while True:
             await asyncio.sleep(self.knobs.RATEKEEPER_UPDATE_INTERVAL)
-            self._recompute()
+            await self._recompute()
 
-    def _recompute(self) -> None:
+    @staticmethod
+    async def _sample_storage(ss) -> dict:
+        """Metrics via RPC-able metrics() when present (recruited stubs),
+        direct attributes otherwise (in-process objects and test fakes)."""
+        m = getattr(ss, "metrics", None)
+        if m is not None:
+            return await m()
+        return {"tag": ss.tag, "durable_engine": ss.engine is not None,
+                "queue_bytes": ss.bytes_input - ss.bytes_durable,
+                "version": ss.version, "durable_version": ss.durable_version}
+
+    @staticmethod
+    async def _sample_tlog(tl) -> dict:
+        m = getattr(tl, "metrics", None)
+        if m is not None:
+            return await m()
+        return {"queue_bytes": tl.queue.bytes_used if tl.queue is not None else 0}
+
+    async def _recompute(self) -> None:
         k = self.knobs
         worst = 0.0
         reason = "unlimited"
-        for ss in self.storage_servers:
-            if ss.engine is None:
-                continue    # memory-only: applied == effectively durable
-            queue = ss.bytes_input - ss.bytes_durable
-            frac = queue / k.TARGET_STORAGE_QUEUE_BYTES
+        samples = await asyncio.gather(
+            *(self._sample_storage(ss) for ss in self.storage_servers),
+            *(self._sample_tlog(tl) for tl in self.tlogs),
+            return_exceptions=True)
+        n_ss = len(self.storage_servers)
+        for m in samples[:n_ss]:
+            if isinstance(m, BaseException):
+                continue       # unreachable replica: the CC handles failure
+            if not m["durable_engine"]:
+                continue       # memory-only: applied == effectively durable
+            frac = m["queue_bytes"] / k.TARGET_STORAGE_QUEUE_BYTES
             if frac > worst:
-                worst, reason = frac, f"storage_queue_tag_{ss.tag}"
-            lag = ss.version - ss.durable_version
+                worst, reason = frac, f"storage_queue_tag_{m['tag']}"
+            lag = m["version"] - m["durable_version"]
             lag_frac = lag / max(1, k.TARGET_DURABILITY_LAG_VERSIONS)
             if lag_frac > worst:
-                worst, reason = lag_frac, f"durability_lag_tag_{ss.tag}"
-        for i, tl in enumerate(self.tlogs):
-            frac = tl.queue.bytes_used / k.TARGET_TLOG_QUEUE_BYTES \
-                if tl.queue is not None else 0.0
+                worst, reason = lag_frac, f"durability_lag_tag_{m['tag']}"
+        for i, m in enumerate(samples[n_ss:]):
+            if isinstance(m, BaseException):
+                continue
+            frac = m["queue_bytes"] / k.TARGET_TLOG_QUEUE_BYTES
             if frac > worst:
                 worst, reason = frac, f"tlog_queue_{i}"
         if worst <= 0.5:
@@ -81,6 +106,10 @@ class Ratekeeper:
                 .detail("TPSLimit", round(rate, 1)).log()
         self.rate_tps = rate
         self.limiting_reason = reason if rate < k.RATEKEEPER_MAX_TPS else "unlimited"
+
+    async def get_rate(self) -> float:
+        """Current budget (RPC surface for status/monitoring)."""
+        return self.rate_tps
 
     # --- admission (spent by GRV proxies) ---
 

@@ -56,6 +56,18 @@ class StorageServer:
         self.bytes_durable = 0    # ratekeeper queue metric
         self.total_reads = 0
 
+    async def metrics(self) -> dict:
+        """Queue/lag sample for the Ratekeeper (StorageQueuingMetrics
+        analog, REF:fdbserver/storageserver.actor.cpp)."""
+        return {
+            "tag": self.tag,
+            "durable_engine": self.engine is not None,
+            "queue_bytes": self.bytes_input - self.bytes_durable,
+            "version": self.version,
+            "durable_version": self.durable_version,
+            "bytes_input": self.bytes_input,
+        }
+
     # --- lifecycle ---
 
     def start(self) -> None:

@@ -75,6 +75,14 @@ class TLog:
             tlog._frame_ends.append((version, end))
         return tlog
 
+    async def metrics(self) -> dict:
+        """Queue sample for the Ratekeeper (TLogQueuingMetrics analog)."""
+        return {
+            "queue_bytes": self.queue.bytes_used if self.queue is not None else 0,
+            "version": self.version,
+            "locked": self.locked,
+        }
+
     async def _wait_for_version(self, prev_version: Version) -> None:
         if self.version >= prev_version:
             return

@@ -26,6 +26,7 @@ from .commit_proxy import CommitProxy
 from .data import KeyRange
 from .grv_proxy import GrvProxy
 from .log_system import LogGeneration, LogSystem
+from .ratekeeper import Ratekeeper
 from .resolver import Resolver
 from .sequencer import Sequencer
 from .shard_map import ShardMap
@@ -36,7 +37,10 @@ TOKEN_BLOCK = 16
 
 
 def log_system_config(ls: LogSystem) -> list[dict]:
-    """LogSystem → wire-friendly generation list (addresses, not stubs)."""
+    """LogSystem → wire-friendly generation list (addresses+tokens, not
+    stubs).  Stub token blocks ride along so a worker reconstructing the
+    view dials each TLog at the token it was recruited at, not at its own
+    base block."""
     out = []
     for g in ls.generations:
         out.append({
@@ -45,6 +49,7 @@ def log_system_config(ls: LogSystem) -> list[dict]:
             "end": g.end_version,
             "tlogs": [(t.address.ip, t.address.port) if hasattr(t, "address")
                       else t for t in g.tlogs],
+            "token": [getattr(t, "_base", None) for t in g.tlogs],
             "replication": g.replication,
             "dead": sorted(g.dead),
         })
@@ -53,11 +58,16 @@ def log_system_config(ls: LogSystem) -> list[dict]:
 
 def generations_from_config(cfg: list[dict], transport: Transport,
                             base_token: int) -> list[LogGeneration]:
+    """Wire generation list → stub-backed LogGenerations.  Each TLog is
+    dialed at its recruited token (cfg "token" list); ``base_token`` is
+    only the legacy fallback for configs predating token plumbing."""
     from ..rpc.transport import NetworkAddress
     gens = []
     for g in cfg:
-        stubs = [TLogClient(transport, NetworkAddress(ip, port), base_token)
-                 for ip, port in g["tlogs"]]
+        tokens = g.get("token") or [base_token] * len(g["tlogs"])
+        stubs = [TLogClient(transport, NetworkAddress(ip, port),
+                            tok if tok is not None else base_token)
+                 for (ip, port), tok in zip(g["tlogs"], tokens)]
         gens.append(LogGeneration(
             epoch=g["epoch"], begin_version=g["begin"], tlogs=stubs,
             replication=g["replication"], end_version=g["end"],
@@ -74,7 +84,7 @@ class Worker:
     """
 
     ROLE_NAMES = ("sequencer", "tlog", "resolver", "storage",
-                  "commit_proxy", "grv_proxy")
+                  "commit_proxy", "grv_proxy", "ratekeeper")
 
     def __init__(self, worker_id: int, knobs: Knobs, transport: Transport,
                  client_transport_factory: Callable[[], Transport],
@@ -143,6 +153,13 @@ class Worker:
     # --- role construction ---
 
     def _build_role(self, role: str, p: dict, k: Knobs):
+        """Construct a role object, dialing every dependency at the token
+        the cluster controller recorded when it recruited that dependency
+        — NEVER at this worker's own base block (a worker hosts many roles
+        on one transport, so base-token dialing reaches whatever role
+        happens to live in block 0: the round-2 recovery-dead-on-arrival
+        bug)."""
+        from ..rpc.stubs import RatekeeperClient, StorageClient
         from ..rpc.transport import NetworkAddress
 
         def addr(a):
@@ -160,17 +177,32 @@ class Worker:
             return StorageServer(k, p["tag"],
                                  KeyRange(p["shard_begin"], p["shard_end"]),
                                  ls, p.get("v0", 0))
+        if role == "ratekeeper":
+            t = self.make_client_transport()
+            storages = [StorageClient(t, addr(s["addr"]), s["token"],
+                                      s["tag"], KeyRange(s["begin"], s["end"]))
+                        for s in p["storage"]]
+            gen = p["log_cfg"][-1]
+            tlogs = [TLogClient(t, addr(a), tok)
+                     for a, tok in zip(gen["tlogs"], gen["token"])]
+            return Ratekeeper(k, storages, tlogs)
         if role == "commit_proxy":
             t = self.make_client_transport()
-            seq = SequencerClient(t, addr(p["sequencer"]), self.base)
+            seq = SequencerClient(t, addr(p["sequencer"]),
+                                  p["sequencer_token"])
             resolvers = [
-                ResolverClient(t, addr(a), self.base, KeyRange(b, e))
-                for a, b, e in p["resolvers"]]
+                ResolverClient(t, addr(a), tok, KeyRange(b, e))
+                for a, b, e, tok in p["resolvers"]]
             ls = LogSystem(generations_from_config(p["log_cfg"], t, self.base))
             shard_map = ShardMap(p["shard_boundaries"], p["shard_teams"])
             return CommitProxy(k, seq, resolvers, ls, shard_map)
         if role == "grv_proxy":
             t = self.make_client_transport()
-            seq = SequencerClient(t, addr(p["sequencer"]), self.base)
-            return GrvProxy(k, seq)
+            seq = SequencerClient(t, addr(p["sequencer"]),
+                                  p["sequencer_token"])
+            rk = None
+            if p.get("ratekeeper") is not None:
+                rk = RatekeeperClient(t, addr(p["ratekeeper"]),
+                                      p["ratekeeper_token"])
+            return GrvProxy(k, seq, rk)
         raise ValueError(f"unknown role {role!r}")

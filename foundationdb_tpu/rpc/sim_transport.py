@@ -28,6 +28,7 @@ class SimNetwork:
         self._clogged: dict[tuple[NetworkAddress, NetworkAddress], float] = {}
         self._partitioned: set[tuple[NetworkAddress, NetworkAddress]] = set()
         self._dead: set[NetworkAddress] = set()
+        self._dead_ips: set[str] = set()
 
     # --- fault injection (RandomClogging / partition workloads use these) ---
 
@@ -51,11 +52,24 @@ class SimNetwork:
     def reboot(self, addr: NetworkAddress) -> None:
         self._dead.discard(addr)
 
+    def kill_ip(self, ip: str) -> None:
+        """Machine kill: every endpoint on this IP goes dark — a process's
+        server transport AND its outbound client transports (the machine
+        model of REF:fdbrpc/sim2.actor.cpp killProcess)."""
+        self._dead_ips.add(ip)
+
+    def reboot_ip(self, ip: str) -> None:
+        self._dead_ips.discard(ip)
+
+    def is_dead(self, addr: NetworkAddress) -> bool:
+        return addr in self._dead or addr.ip in self._dead_ips
+
     # --- delivery ---
 
     def _delay(self, src: NetworkAddress, dst: NetworkAddress) -> float | None:
         """Seconds until delivery, or None if the packet is dropped."""
-        if (src, dst) in self._partitioned or dst in self._dead or src in self._dead:
+        if ((src, dst) in self._partitioned or self.is_dead(dst)
+                or self.is_dead(src)):
             return None
         rng = deterministic_random()
         d = (self.knobs.SIM_NETWORK_MIN_DELAY +
@@ -86,7 +100,7 @@ class SimTransport(Transport):
             raise ConnectionFailed()
         await asyncio.sleep(d1)
         peer = self.network.listeners.get(endpoint.address)
-        if peer is None or endpoint.address in self.network._dead:
+        if peer is None or self.network.is_dead(endpoint.address):
             raise ConnectionFailed()
         ok, reply = await peer.dispatcher.dispatch(endpoint.token, payload)
         d2 = self.network._delay(endpoint.address, self.address)
@@ -106,7 +120,7 @@ class SimTransport(Transport):
                 return
             await asyncio.sleep(d)
             peer = self.network.listeners.get(endpoint.address)
-            if peer is not None and endpoint.address not in self.network._dead:
+            if peer is not None and not self.network.is_dead(endpoint.address):
                 await peer.dispatcher.dispatch(endpoint.token, payload)
         t = asyncio.get_running_loop().create_task(deliver(), name="sim-oneway")
         self._tasks.add(t)

@@ -24,16 +24,19 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
                   ("report_committed", True)],
     "resolver": [("resolve", False)],
     "tlog": [("push", False), ("peek", False), ("pop", True),
-             ("lock", False)],
+             ("lock", False), ("metrics", False)],
     "storage": [("get_value", False), ("get_key_values", False),
-                ("watch_value", False)],
+                ("watch_value", False), ("metrics", False)],
     "commit_proxy": [("commit", False)],
     "grv_proxy": [("get_read_version", False)],
+    "ratekeeper": [("admit", False), ("get_rate", False)],
     "coordinator": [("read", False), ("write", False),
                     ("candidacy", False), ("leader_heartbeat", False),
                     ("open_database", False)],
     "worker": [("recruit", False), ("stop_role", False),
                ("rejoin_storage", False), ("list_roles", False)],
+    "cluster_controller": [("register_worker", False),
+                           ("get_cluster_state", False)],
 }
 
 TOKEN_BLOCK = 16  # tokens reserved per role instance
@@ -110,6 +113,14 @@ class StorageClient(RoleClient):
 
 class CommitProxyClient(RoleClient):
     role = "commit_proxy"
+
+
+class RatekeeperClient(RoleClient):
+    role = "ratekeeper"
+
+
+class ClusterControllerClient(RoleClient):
+    role = "cluster_controller"
 
 
 class GrvProxyClient(RoleClient):

@@ -105,7 +105,7 @@ def test_ratekeeper_throttles_on_queue():
                              RATEKEEPER_MAX_TPS=1000.0,
                              RATEKEEPER_MIN_TPS=5.0)
         rk = Ratekeeper(k, [FakeSS()], [])
-        rk._recompute()
+        await rk._recompute()
         # queue at 100% of target: rate pinned to the floor
         assert rk.rate_tps == 5.0
         assert "storage_queue" in rk.limiting_reason
@@ -125,7 +125,7 @@ def test_ratekeeper_full_rate_when_healthy():
     async def main():
         k = Knobs()
         rk = Ratekeeper(k, [HealthySS()], [])
-        rk._recompute()
+        await rk._recompute()
         assert rk.rate_tps == k.RATEKEEPER_MAX_TPS
         assert rk.limiting_reason == "unlimited"
     run_simulation(main())
