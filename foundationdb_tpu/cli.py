@@ -1,0 +1,148 @@
+"""fdbcli analog — interactive/one-shot cluster shell.
+
+Reference: REF:fdbcli/fdbcli.actor.cpp — get/set/clear/getrange/status
+against a live cluster found through the cluster file.
+
+    python -m foundationdb_tpu.cli -C fdb.cluster --exec "set k v; get k"
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import shlex
+import sys
+
+from .client.transaction import Transaction
+from .core.cluster_client import RecoveredClusterView, fetch_cluster_state
+from .core.cluster_file import ClusterFile
+from .rpc.stubs import CoordinatorClient
+from .rpc.tcp_transport import TcpTransport
+from .rpc.transport import (NetworkAddress, WLTOKEN_COORDINATOR,
+                            WLTOKEN_FIRST_AVAILABLE)
+from .runtime.errors import FdbError
+from .runtime.knobs import Knobs
+
+BASE = WLTOKEN_FIRST_AVAILABLE
+
+
+class Cli:
+    def __init__(self, knobs: Knobs, view: RecoveredClusterView,
+                 coordinators: list) -> None:
+        self.knobs = knobs
+        self.view = view
+        self.coordinators = coordinators
+
+    async def refresh(self) -> None:
+        self.view.update(await fetch_cluster_state(self.coordinators))
+
+    async def run_txn(self, fn):
+        tr = Transaction(self.view)
+        while True:
+            try:
+                out = await fn(tr)
+                await tr.commit()
+                return out
+            except FdbError as e:
+                try:
+                    await tr.on_error(e)
+                except FdbError:
+                    await self.refresh()
+                    tr = Transaction(self.view)
+
+    async def execute(self, line: str) -> str:
+        parts = shlex.split(line)
+        if not parts:
+            return ""
+        cmd, *args = parts
+        if cmd == "get":
+            v = await self.run_txn(lambda tr: tr.get(args[0].encode()))
+            return f"`{args[0]}' is `{v.decode(errors='replace')}'" if v is not None \
+                else f"`{args[0]}': not found"
+        if cmd == "set":
+            async def do(tr):
+                tr.set(args[0].encode(), args[1].encode())
+            await self.run_txn(do)
+            return "Committed"
+        if cmd == "clear":
+            async def do(tr):
+                tr.clear(args[0].encode())
+            await self.run_txn(do)
+            return "Committed"
+        if cmd == "getrange":
+            begin = args[0].encode()
+            end = args[1].encode() if len(args) > 1 else b"\xff"
+            limit = int(args[2]) if len(args) > 2 else 25
+
+            async def do(tr):
+                return await tr.get_range(begin, end, limit=limit)
+            rows = await self.run_txn(do)
+            return "\n".join(f"`{k.decode(errors='replace')}' is "
+                             f"`{v.decode(errors='replace')}'" for k, v in rows) \
+                or "<empty>"
+        if cmd == "status":
+            await self.refresh()
+            st = await fetch_cluster_state(self.coordinators)
+            lines = [f"epoch: {st['epoch']}",
+                     f"recovery_version: {st['recovery_version']}",
+                     f"sequencer: {st['sequencer']['addr']}",
+                     f"tlogs: {st['log_cfg'][-1]['tlogs']}",
+                     f"resolvers: {[r['addr'] for r in st['resolvers']]}",
+                     f"storage: {[s['addr'] for s in st['storage']]}",
+                     f"commit_proxies: {[p['addr'] for p in st['commit_proxies']]}",
+                     f"grv_proxies: {[p['addr'] for p in st['grv_proxies']]}"]
+            return "\n".join(lines)
+        return f"ERROR: unknown command `{cmd}'"
+
+
+async def open_cli(cluster_file: str, knobs: Knobs,
+                   timeout: float = 30.0) -> Cli:
+    cf = ClusterFile.load(cluster_file)
+    t = TcpTransport(NetworkAddress("127.0.0.1", 0))
+    coords = [CoordinatorClient(t, a, WLTOKEN_COORDINATOR)
+              for a in cf.coordinators]
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        try:
+            state = await fetch_cluster_state(coords)
+            break
+        except (FdbError, OSError):
+            if asyncio.get_running_loop().time() > deadline:
+                raise
+            await asyncio.sleep(0.5)
+    return Cli(knobs, RecoveredClusterView(knobs, t, state), coords)
+
+
+async def amain(args) -> int:
+    knobs = Knobs()
+    cli = await open_cli(args.cluster_file, knobs)
+    if args.exec:
+        for line in args.exec.split(";"):
+            out = await cli.execute(line.strip())
+            if out:
+                print(out)
+        return 0
+    print("fdbtpu cli — commands: get set clear getrange status exit")
+    loop = asyncio.get_running_loop()
+    while True:
+        line = await loop.run_in_executor(None, lambda: input("fdbtpu> "))
+        if line.strip() in ("exit", "quit"):
+            return 0
+        try:
+            out = await cli.execute(line)
+        except Exception as e:      # noqa: BLE001 — shell keeps going
+            out = f"ERROR: {e!r}"
+        if out:
+            print(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="foundationdb_tpu.cli")
+    ap.add_argument("-C", "--cluster-file", required=True)
+    ap.add_argument("--exec", default="", help="semicolon-separated commands")
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+    return asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,50 @@
+"""Cluster file — how clients and servers find the coordinators.
+
+Reference: REF:fdbclient/CoordinationInterface.h (ClusterConnectionString)
++ fdb.cluster format: ``description:id@ip:port[,ip:port]*``.  The
+description and id are opaque; the address list names the coordinator
+quorum.  Same format here so operational muscle memory transfers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from ..rpc.transport import NetworkAddress
+
+_RX = re.compile(r"^(?P<desc>[A-Za-z0-9_]+):(?P<id>[A-Za-z0-9_]+)@(?P<addrs>.+)$")
+
+
+@dataclasses.dataclass
+class ClusterFile:
+    description: str
+    cluster_id: str
+    coordinators: list[NetworkAddress]
+
+    @classmethod
+    def parse(cls, text: str) -> "ClusterFile":
+        text = text.strip()
+        m = _RX.match(text)
+        if not m:
+            raise ValueError(f"bad cluster file line: {text!r}")
+        addrs = []
+        for part in m.group("addrs").split(","):
+            ip, _, port = part.strip().rpartition(":")
+            addrs.append(NetworkAddress(ip, int(port)))
+        if not addrs:
+            raise ValueError("cluster file names no coordinators")
+        return cls(m.group("desc"), m.group("id"), addrs)
+
+    @classmethod
+    def load(cls, path: str) -> "ClusterFile":
+        with open(path) as f:
+            return cls.parse(f.read())
+
+    def dump(self) -> str:
+        addrs = ",".join(f"{a.ip}:{a.port}" for a in self.coordinators)
+        return f"{self.description}:{self.cluster_id}@{addrs}\n"
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.dump())

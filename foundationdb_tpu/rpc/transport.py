@@ -19,6 +19,7 @@ from ..runtime.errors import FdbError, error_from_code
 # well-known tokens (REF: WLTOKEN_* in FlowTransport.actor.cpp)
 WLTOKEN_PING = 1
 WLTOKEN_ENDPOINT_NOT_FOUND = 2
+WLTOKEN_COORDINATOR = 40     # coordinator role block on shared-process transports
 WLTOKEN_FIRST_AVAILABLE = 100
 
 

@@ -1,0 +1,127 @@
+"""fdbserver analog — the per-process entry point.
+
+Reference: REF:fdbserver/fdbserver.actor.cpp — one process, one listen
+address: serves a coordinator role when its address is named in the
+cluster file, and always runs a ClusterHost (worker + election candidate
++ cluster controller when elected).  Three of these on localhost make a
+working cluster:
+
+    python -m foundationdb_tpu.server -C fdb.cluster -l 127.0.0.1:4500
+    python -m foundationdb_tpu.server -C fdb.cluster -l 127.0.0.1:4501
+    python -m foundationdb_tpu.server -C fdb.cluster -l 127.0.0.1:4502
+
+Knobs are settable ``--knob_name=value`` exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import signal
+import sys
+
+from .core.cluster_controller import ClusterConfigSpec
+from .core.cluster_file import ClusterFile
+from .core.cluster_host import ClusterHost
+from .core.coordination import Coordinator
+from .rpc.stubs import CoordinatorClient, serve_role
+from .rpc.tcp_transport import TcpTransport
+from .rpc.transport import (NetworkAddress, WLTOKEN_COORDINATOR,
+                            WLTOKEN_FIRST_AVAILABLE)
+from .runtime.knobs import Knobs
+from .runtime.trace import TraceEvent
+
+BASE = WLTOKEN_FIRST_AVAILABLE
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="foundationdb_tpu.server",
+        description="Run one cluster process (worker + coordinator when "
+                    "named in the cluster file).")
+    ap.add_argument("-C", "--cluster-file", required=True)
+    ap.add_argument("-l", "--listen", required=True, metavar="IP:PORT")
+    ap.add_argument("--spec", default="", help="role counts, e.g. "
+                    "logs=2,resolvers=1,storage_servers=2,min_workers=3")
+    args, extra = ap.parse_known_args(argv)
+    knob_overrides = {}
+    for e in extra:
+        if e.startswith("--knob_") and "=" in e:
+            name, val = e[len("--knob_"):].split("=", 1)
+            knob_overrides[name] = val
+        else:
+            ap.error(f"unknown argument {e!r}")
+    return args, knob_overrides
+
+
+def parse_spec(text: str) -> ClusterConfigSpec:
+    spec = ClusterConfigSpec()
+    if text:
+        for part in text.split(","):
+            name, _, val = part.partition("=")
+            if not hasattr(spec, name):
+                raise SystemExit(f"unknown spec field {name!r}")
+            setattr(spec, name, int(val))
+    return spec
+
+
+async def run_server(cluster_file: str, listen: str, spec: ClusterConfigSpec,
+                     knobs: Knobs, ready_event: asyncio.Event | None = None):
+    cf = ClusterFile.load(cluster_file)
+    ip, _, port = listen.rpartition(":")
+    addr = NetworkAddress(ip, int(port))
+
+    transport = TcpTransport(addr)
+    await transport.listen()
+
+    # outbound-only client transports: a unique address identity each, no
+    # listener (mirrors the reference's ephemeral outbound connections)
+    counter = itertools.count(1)
+
+    def client_transport() -> TcpTransport:
+        return TcpTransport(NetworkAddress(ip, int(port) * 1000 + next(counter)))
+
+    if addr in cf.coordinators:
+        # the coordinator shares the process transport with the worker, so
+        # it lives at its own well-known token block
+        coordinator = Coordinator(knobs)
+        serve_role(transport, "coordinator", coordinator, WLTOKEN_COORDINATOR)
+        TraceEvent("CoordinatorStarted").detail("Address", str(addr)).log()
+
+    coord_stubs = [CoordinatorClient(client_transport(), a, WLTOKEN_COORDINATOR)
+                   for a in cf.coordinators]
+    host_id = int(port)           # unique per process on one box
+    host = ClusterHost(host_id, knobs, transport, client_transport, BASE,
+                       coord_stubs, spec)
+    host.start()
+    TraceEvent("ServerStarted").detail("Address", str(addr)) \
+        .detail("Cluster", cf.cluster_id).log()
+    if ready_event is not None:
+        ready_event.set()
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+    await host.stop()
+    await transport.close()
+
+
+def main(argv=None) -> int:
+    args, knob_overrides = parse_args(argv if argv is not None else sys.argv[1:])
+    knobs = Knobs().set_from_strings(knob_overrides)
+    spec = parse_spec(args.spec)
+    try:
+        asyncio.run(run_server(args.cluster_file, args.listen, spec, knobs))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
