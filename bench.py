@@ -248,6 +248,32 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
     }
 
 
+def run_e2e_phase(tpu_device, quiet: bool) -> dict:
+    """Client-boundary mako TPS through GRV->commit (BASELINE configs 1-2)
+    for both backends; each gets its tuned server batching knobs (the
+    tunnel's ~64ms RTT wants deep commit batches on the tpu path)."""
+    import asyncio
+
+    from foundationdb_tpu.bench.e2e import run_e2e
+    from foundationdb_tpu.runtime import Knobs
+
+    out = {}
+    cpp_knobs = Knobs().override(RESOLVER_CONFLICT_BACKEND="cpp")
+    out["cpp"] = asyncio.run(run_e2e(cpp_knobs, duration_s=3.0,
+                                     n_clients=64, warmup_s=1.0))
+    tpu_knobs = Knobs().override(
+        RESOLVER_CONFLICT_BACKEND="tpu",
+        COMMIT_BATCH_INTERVAL=0.05, GRV_BATCH_INTERVAL=0.01,
+        RESOLVER_BATCH_TXNS=256)
+    out["tpu"] = asyncio.run(run_e2e(tpu_knobs, duration_s=5.0,
+                                     n_clients=256, device=tpu_device,
+                                     warmup_s=12.0))
+    if not quiet:
+        print(f"[e2e cpp] {out['cpp']}", file=sys.stderr)
+        print(f"[e2e tpu] {out['tpu']}", file=sys.stderr)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, default=1024)
@@ -337,6 +363,21 @@ def main() -> int:
             print("FATAL: fused group verdicts diverge from serial",
                   file=sys.stderr)
             rc = 1
+        if not args.quick:
+            try:
+                e2e = run_e2e_phase(tpu_device, args.quiet)
+                out.update({
+                    "e2e_tps_tpu": round(e2e["tpu"]["tps"], 1),
+                    "e2e_tps_cpp": round(e2e["cpp"]["tps"], 1),
+                    "e2e_p50_ms_tpu": round(e2e["tpu"]["p50_ms"], 1),
+                    "e2e_p50_ms_cpp": round(e2e["cpp"]["p50_ms"], 1),
+                    "e2e_p99_ms_tpu": round(e2e["tpu"]["p99_ms"], 1),
+                    "e2e_p99_ms_cpp": round(e2e["cpp"]["p99_ms"], 1),
+                    "e2e_abort_rate_tpu": round(e2e["tpu"]["abort_rate"], 3),
+                    "e2e_abort_rate_cpp": round(e2e["cpp"]["abort_rate"], 3),
+                })
+            except Exception as e:  # noqa: BLE001 — e2e must not kill the bench
+                out["e2e_error"] = repr(e)[:300]
     except Exception as e:  # noqa: BLE001 — the JSON line must still appear
         out["error"] = repr(e)[:800]
         import traceback

@@ -1,0 +1,127 @@
+"""End-to-end mako driver: client-boundary TPS through GRV → commit.
+
+Reference: REF:bindings/c/test/mako/mako.c — concurrent client loops run
+read-write transactions (zipfian hot keys) against a live cluster and
+report committed TPS plus commit-latency percentiles measured at the
+client boundary, i.e. including GRV batching, proxy batching, resolution
+(the RESOLVER_CONFLICT_BACKEND under test) and log pushes.
+
+BASELINE.md configs 1–2 are instances of this driver; bench.py runs it
+for the cpp and tpu backends alongside the kernel-stage measurement.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import numpy as np
+
+from ..client.transaction import Transaction
+from ..core.cluster import Cluster, ClusterConfig
+from ..runtime.errors import FdbError
+from ..runtime.knobs import Knobs
+from .workload import ZipfianGenerator
+
+
+async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
+                  n_keys: int = 100_000, reads: int = 2, writes: int = 2,
+                  theta: float = 0.99, device=None, seed: int = 7,
+                  warmup_s: float = 2.0) -> dict:
+    """Run the mako loop against a fresh in-process cluster; returns
+    client-boundary stats.  ``knobs.RESOLVER_CONFLICT_BACKEND`` selects
+    the conflict backend; ``device`` pins the tpu backend's chip.  A
+    warmup phase (uncounted) absorbs kernel compiles and cache warming."""
+    cluster = Cluster(ClusterConfig(), knobs, device=device)
+    cluster.start()
+    zipf = ZipfianGenerator(n_keys, theta, seed)
+    prefix = b"mako"
+    width = 32 - len(prefix)
+
+    def key(i: int) -> bytes:
+        return prefix + str(int(i)).zfill(width).encode()
+
+    committed = 0
+    conflicts = 0
+    measuring = False
+    latencies: list[float] = []
+    stop_at = time.perf_counter() + warmup_s + duration_s
+
+    async def client(cid: int) -> None:
+        nonlocal committed, conflicts
+        tr = Transaction(cluster)
+        while time.perf_counter() < stop_at:
+            ks = zipf.sample(reads + writes)
+            t0 = time.perf_counter()
+            try:
+                for i in range(reads):
+                    await tr.get(key(ks[i]))
+                for i in range(writes):
+                    tr.set(key(ks[reads + i]), b"v%016d" % cid)
+                await tr.commit()
+                if measuring:
+                    committed += 1
+                    latencies.append(time.perf_counter() - t0)
+            except FdbError as e:
+                if measuring:
+                    conflicts += 1
+                try:
+                    await tr.on_error(e)
+                    continue
+                except FdbError:
+                    pass
+            tr.reset()
+
+    async def phase_timer() -> float:
+        nonlocal measuring
+        await asyncio.sleep(warmup_s)
+        measuring = True
+        return time.perf_counter()
+
+    timer = asyncio.ensure_future(phase_timer())
+    await asyncio.gather(*(client(i) for i in range(n_clients)))
+    t0 = await timer
+    elapsed = time.perf_counter() - t0
+    await cluster.stop()
+
+    lat = np.array(latencies) if latencies else np.array([0.0])
+    return {
+        "tps": committed / elapsed,
+        "committed": committed,
+        "aborts": conflicts,
+        "abort_rate": conflicts / max(1, committed + conflicts),
+        "p50_ms": float(np.percentile(lat, 50) * 1e3),
+        "p95_ms": float(np.percentile(lat, 95) * 1e3),
+        "p99_ms": float(np.percentile(lat, 99) * 1e3),
+        "elapsed_s": elapsed,
+    }
+
+
+def main() -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default="cpp",
+                    choices=("cpp", "numpy", "tpu"))
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--clients", type=int, default=64)
+    ap.add_argument("--keys", type=int, default=100_000)
+    args = ap.parse_args()
+
+    knobs = Knobs().override(RESOLVER_CONFLICT_BACKEND=args.backend)
+    device = None
+    warmup = 1.0
+    if args.backend == "tpu":
+        import jax
+        jax.config.update("jax_enable_x64", True)
+        device = jax.devices()[0]
+        warmup = 10.0       # kernel compiles land in the warmup window
+    out = asyncio.run(run_e2e(knobs, args.seconds, args.clients, args.keys,
+                              device=device, warmup_s=warmup))
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

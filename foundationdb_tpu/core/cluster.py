@@ -49,7 +49,8 @@ class Cluster:
                  knobs: Knobs | None = None,
                  epoch_begin_version: Version = 0,
                  tlogs: list[TLog] | None = None,
-                 engines: dict[int, object] | None = None) -> None:
+                 engines: dict[int, object] | None = None,
+                 device=None) -> None:
         self.config = config or ClusterConfig()
         self.knobs = knobs or KNOBS
         c, k, v0 = self.config, self.knobs, epoch_begin_version
@@ -69,7 +70,8 @@ class Cluster:
 
         # resolver key partitions: even split of the whole keyspace
         res_map = ShardMap.even(c.resolvers)
-        self.resolvers = [Resolver(k, res_map.shard_range(i), v0)
+        self.resolvers = [Resolver(k, res_map.shard_range(i), v0,
+                                   device=device)
                           for i in range(c.resolvers)]
 
         self.storage_servers = []
