@@ -80,6 +80,13 @@ class Cli:
             return "\n".join(f"`{k.decode(errors='replace')}' is "
                              f"`{v.decode(errors='replace')}'" for k, v in rows) \
                 or "<empty>"
+        if cmd == "status" and args and args[0] == "json":
+            import json as _json
+
+            from .core.status import cluster_status
+            doc = await cluster_status(self.knobs, self.view.transport,
+                                       self.coordinators)
+            return _json.dumps(doc, indent=2, default=str)
         if cmd == "status":
             await self.refresh()
             st = await fetch_cluster_state(self.coordinators)

@@ -96,6 +96,20 @@ class ClusterController:
         recovery_version = 0
         old_log_cfg: list[dict] = []
         if prev_state:
+            # fence the deposed sequencer first: its commits can no longer
+            # ack (we are about to lock its generation's logs) and locking
+            # it stops its GRV path from serving stale read versions
+            seq_info = prev_state.get("sequencer")
+            if seq_info:
+                from ..rpc.stubs import SequencerClient
+                stub = SequencerClient(
+                    self.transport, NetworkAddress(*seq_info["addr"]),
+                    seq_info["token"])
+                try:
+                    await asyncio.wait_for(
+                        stub.lock(), timeout=k.FAILURE_TIMEOUT * 2)
+                except (FdbError, asyncio.TimeoutError):
+                    pass    # dead/unreachable: its commits can't ack anyway
             old_log_cfg = [dict(g) for g in prev_state["log_cfg"]]
             cur = old_log_cfg[-1]
             tips: list[int] = []

@@ -45,16 +45,30 @@ class CommitProxy:
         self.total_batches = 0
         self.total_committed = 0
         self.total_conflicts = 0
+        from ..runtime.trace import CounterCollection
+        self.counters = CounterCollection("ProxyCommit")
+        self._metrics_task = None
 
     def start(self) -> None:
-        self._batcher_task = asyncio.get_running_loop().create_task(
+        loop = asyncio.get_running_loop()
+        self._batcher_task = loop.create_task(
             self._batcher_loop(), name="commit-proxy-batcher")
+        self._metrics_task = loop.create_task(
+            self._metrics_loop(), name="commit-proxy-metrics")
+
+    async def _metrics_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.knobs.METRICS_INTERVAL)
+            self.counters.log_metrics()
 
     async def stop(self) -> None:
         tasks = list(self._inflight)
         if self._batcher_task is not None:
             tasks.append(self._batcher_task)
             self._batcher_task = None
+        if self._metrics_task is not None:
+            tasks.append(self._metrics_task)
+            self._metrics_task = None
         for t in tasks:
             t.cancel()
         await asyncio.gather(*tasks, return_exceptions=True)
@@ -192,18 +206,22 @@ class CommitProxy:
             self.sequencer.report_committed(version)
 
             self.total_batches += 1
+            self.counters.counter("CommitBatchIn").add(1)
             for i, fut in enumerate(futs):
                 if fut.done():
                     continue
                 if final[i] == COMMITTED:
                     self.total_committed += 1
+                    self.counters.counter("TxnCommitOut").add(1)
                     fut.set_result(CommitResult(
                         version, pack_versionstamp(version, orders[i])))
                 elif final[i] == TOO_OLD:
                     self.total_conflicts += 1
+                    self.counters.counter("TxnConflicts").add(1)
                     fut.set_exception(TransactionTooOld())
                 else:
                     self.total_conflicts += 1
+                    self.counters.counter("TxnConflicts").add(1)
                     fut.set_exception(NotCommitted())
         except asyncio.CancelledError:
             for fut in futs:

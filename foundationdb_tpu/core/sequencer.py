@@ -12,8 +12,12 @@ from __future__ import annotations
 
 import asyncio
 
+from ..runtime.errors import _err
 from ..runtime.knobs import Knobs
 from .data import Version
+
+SequencerDeposed = _err(1191, "sequencer_deposed",
+                        "Sequencer was locked by a newer epoch's recovery")
 
 
 class Sequencer:
@@ -24,11 +28,33 @@ class Sequencer:
         self._base_version = epoch_begin_version
         self._base_time: float | None = None
         self._committed_waiters: list[tuple[Version, asyncio.Future]] = []
+        self.locked = False
+
+    # --- epoch fencing ---
+
+    async def lock(self) -> Version:
+        """Fence a deposed sequencer (recovery calls this while locking the
+        old TLog generation): no further commit versions are assigned and
+        no further read versions are served — a GRV from a stale sequencer
+        after a newer epoch committed elsewhere would be a stale-read hole.
+        Commits in flight can't ack anyway (their generation's logs are
+        locked); this closes the read side too."""
+        self.locked = True
+        for _, fut in self._committed_waiters:
+            if not fut.done():
+                fut.set_exception(SequencerDeposed())
+        self._committed_waiters.clear()
+        return self._last_assigned
+
+    def _check_locked(self) -> None:
+        if self.locked:
+            raise SequencerDeposed()
 
     # --- commit version assignment (GetCommitVersionRequest) ---
 
     async def get_commit_version(self) -> tuple[Version, Version]:
         """Returns (prev_version, version) for one commit batch."""
+        self._check_locked()
         loop = asyncio.get_running_loop()
         if self._base_time is None:
             self._base_time = loop.time()
@@ -54,7 +80,9 @@ class Sequencer:
 
     async def get_live_committed_version(self) -> Version:
         """The version a GRV proxy may serve as a read version
-        (getLiveCommittedVersion in the reference)."""
+        (getLiveCommittedVersion in the reference).  Raises once the
+        sequencer is deposed (locked by a newer epoch's recovery)."""
+        self._check_locked()
         return self._committed
 
     async def wait_committed(self, version: Version) -> Version:

@@ -1,0 +1,105 @@
+"""Status aggregation — the ``status json`` document.
+
+Reference: REF:fdbserver/Status.actor.cpp — the cluster controller
+aggregates role health and metrics into one JSON document fdbcli and
+monitoring consume.  Here the aggregator runs client-side: it reads the
+published cluster state from the coordinators, probes every role address
+(well-known PING token) and pulls role metrics over their RPC surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..rpc.stubs import RatekeeperClient, StorageClient, TLogClient
+from ..rpc.transport import Endpoint, NetworkAddress, Transport, WLTOKEN_PING
+from ..runtime.knobs import Knobs
+from .cluster_client import fetch_cluster_state
+from .data import KeyRange
+
+
+async def _probe(transport: Transport, addr: NetworkAddress,
+                 timeout: float) -> bool:
+    try:
+        await asyncio.wait_for(
+            transport.request(Endpoint(addr, WLTOKEN_PING), b"ping"),
+            timeout=timeout)
+        return True
+    except Exception:       # noqa: BLE001 — any failure means unreachable
+        return False
+
+
+async def cluster_status(knobs: Knobs, transport: Transport,
+                         coordinators: list) -> dict:
+    """Build the status document from the latest published cluster state."""
+    state = await fetch_cluster_state(coordinators)
+    t = knobs.FAILURE_TIMEOUT
+
+    def addr(a) -> NetworkAddress:
+        return NetworkAddress(a[0], a[1])
+
+    roles: list[dict] = []
+    roles.append({"role": "sequencer", "addr": list(state["sequencer"]["addr"])})
+    gen = state["log_cfg"][-1]
+    for i, a in enumerate(gen["tlogs"]):
+        roles.append({"role": "log", "addr": list(a),
+                      "token": gen["token"][i], "index": i})
+    for r in state["resolvers"]:
+        roles.append({"role": "resolver", "addr": list(r["addr"])})
+    for s in state["storage"]:
+        roles.append({"role": "storage", "addr": list(s["addr"]),
+                      "token": s["token"], "tag": s["tag"],
+                      "begin": s["begin"], "end": s["end"]})
+    for p in state["commit_proxies"]:
+        roles.append({"role": "commit_proxy", "addr": list(p["addr"])})
+    for p in state["grv_proxies"]:
+        roles.append({"role": "grv_proxy", "addr": list(p["addr"])})
+    if state.get("ratekeeper"):
+        roles.append({"role": "ratekeeper",
+                      "addr": list(state["ratekeeper"]["addr"]),
+                      "token": state["ratekeeper"]["token"]})
+
+    # probe reachability concurrently
+    alive = await asyncio.gather(
+        *(_probe(transport, addr(r["addr"]), t) for r in roles))
+    for r, ok in zip(roles, alive):
+        r["reachable"] = ok
+
+    # pull metrics from reachable metric-bearing roles
+    async def enrich(r: dict) -> None:
+        try:
+            if r["role"] == "storage":
+                sc = StorageClient(transport, addr(r["addr"]), r["token"],
+                                   r["tag"], KeyRange(r["begin"], r["end"]))
+                r["metrics"] = await asyncio.wait_for(sc.metrics(), timeout=t)
+            elif r["role"] == "log":
+                tc = TLogClient(transport, addr(r["addr"]), r["token"])
+                r["metrics"] = await asyncio.wait_for(tc.metrics(), timeout=t)
+            elif r["role"] == "ratekeeper":
+                rc = RatekeeperClient(transport, addr(r["addr"]), r["token"])
+                r["tps_limit"] = await asyncio.wait_for(rc.get_rate(),
+                                                        timeout=t)
+        except Exception:   # noqa: BLE001 — partial status beats none
+            r["metrics_error"] = True
+
+    await asyncio.gather(*(enrich(r) for r in roles if r["reachable"]))
+    for r in roles:
+        r.pop("begin", None)
+        r.pop("end", None)
+
+    healthy = all(r["reachable"] for r in roles)
+    return {
+        "cluster": {
+            "epoch": state["epoch"],
+            "recovery_version": state["recovery_version"],
+            "database_available": healthy,
+            "degraded_roles": [
+                {"role": r["role"], "addr": r["addr"]}
+                for r in roles if not r["reachable"]],
+        },
+        "roles": roles,
+        "shards": {
+            "boundaries": state["shard_boundaries"],
+            "teams": state["shard_teams"],
+        },
+    }

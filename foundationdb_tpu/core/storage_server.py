@@ -55,6 +55,9 @@ class StorageServer:
         self.bytes_input = 0
         self.bytes_durable = 0    # ratekeeper queue metric
         self.total_reads = 0
+        from ..runtime.trace import CounterCollection
+        self.counters = CounterCollection("StorageMetrics", str(tag))
+        self._metrics_task = None
 
     async def metrics(self) -> dict:
         """Queue/lag sample for the Ratekeeper (StorageQueuingMetrics
@@ -77,9 +80,21 @@ class StorageServer:
         if self.engine is not None:
             self._durability_task = loop.create_task(
                 self._durability_loop(), name=f"storage-{self.tag}-durability")
+        self._metrics_task = loop.create_task(
+            self._metrics_loop(), name=f"storage-{self.tag}-metrics")
+
+    async def _metrics_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.knobs.METRICS_INTERVAL)
+            c = self.counters
+            c.counter("BytesInput").value = self.bytes_input
+            c.counter("BytesDurable").value = self.bytes_durable
+            c.counter("FinishedQueries").value = self.total_reads
+            c.counter("Version").value = self.version
+            c.log_metrics()
 
     async def stop(self) -> None:
-        for attr in ("_pull_task", "_durability_task"):
+        for attr in ("_pull_task", "_durability_task", "_metrics_task"):
             t = getattr(self, attr)
             if t is not None:
                 t.cancel()
@@ -247,7 +262,8 @@ class StorageServer:
         fut = asyncio.get_running_loop().create_future()
         self._version_waiters.setdefault(version, []).append(fut)
         try:
-            await asyncio.wait_for(fut, timeout=1.0)
+            await asyncio.wait_for(
+                fut, timeout=self.knobs.STORAGE_FUTURE_VERSION_WAIT)
         except asyncio.TimeoutError:
             raise FutureVersion() from None
 

@@ -21,7 +21,7 @@ from .transport import Endpoint, NetworkAddress, Transport
 ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
     "sequencer": [("get_commit_version", False),
                   ("get_live_committed_version", False),
-                  ("report_committed", True)],
+                  ("report_committed", True), ("lock", False)],
     "resolver": [("resolve", False)],
     "tlog": [("push", False), ("peek", False), ("pop", True),
              ("lock", False), ("metrics", False)],

@@ -42,6 +42,7 @@ class Knobs:
     # --- storage ---
     STORAGE_VERSION_WINDOW: int = 5_000_000   # in-memory MVCC window, versions
     STORAGE_DURABILITY_LAG: float = 0.25      # seconds between making versions durable
+    STORAGE_FUTURE_VERSION_WAIT: float = 1.0  # read wait before future_version
     FETCH_KEYS_BYTES_PER_BATCH: int = 1 << 20
 
     # --- transaction limits (REF:fdbclient/ClientKnobs, Limits in docs) ---
@@ -67,6 +68,9 @@ class Knobs:
     DISK_QUEUE_PAGE_SIZE: int = 4096
     LOG_REPLICATION: int = 2                  # TLogs hosting each tag (min'd with log count)
     TLOG_PEEK_RETRY: float = 0.05             # cursor poll while a generation is being ended
+
+    # --- observability ---
+    METRICS_INTERVAL: float = 5.0             # role *Metrics emit period
 
     # --- ratekeeper ---
     RATEKEEPER_UPDATE_INTERVAL: float = 0.25

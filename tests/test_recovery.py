@@ -295,3 +295,70 @@ def test_election_cc_and_worker_registration():
             leader._task.cancel()
             await asyncio.gather(leader._task, return_exceptions=True)
     run_simulation(main())
+
+
+def test_status_json_reflects_role_health():
+    """The status aggregator reports every recruited role, pulls metrics,
+    and flags dead roles after a kill (REF:fdbserver/Status.actor.cpp)."""
+    async def main():
+        from foundationdb_tpu.core.status import cluster_status
+        k = Knobs()
+        sim = SimCluster(k)
+        cc = sim.make_cc(ClusterConfigSpec())
+        _, prev = await cc.cstate.read()
+        state = await cc.recover_once(prev)
+        view = await sim.client_view()
+        await commit_kv(view, {b"s1": b"x"})
+
+        ct = sim.client_transport()
+        stubs = sim.coordinator_stubs(ct)
+        doc = await cluster_status(k, ct, stubs)
+        assert doc["cluster"]["epoch"] == 1
+        assert doc["cluster"]["database_available"] is True
+        by_role = {}
+        for r in doc["roles"]:
+            by_role.setdefault(r["role"], []).append(r)
+        assert set(by_role) == {"sequencer", "log", "resolver", "storage",
+                                "commit_proxy", "grv_proxy", "ratekeeper"}
+        assert all(r["reachable"] for r in doc["roles"])
+        # storage metrics came over RPC
+        assert all("metrics" in r for r in by_role["storage"])
+        assert by_role["ratekeeper"][0]["tps_limit"] > 0
+        # kill a resolver: status must degrade
+        victim = NetworkAddress(*state["resolvers"][0]["addr"])
+        sim.net.kill(victim)
+        doc2 = await cluster_status(k, ct, stubs)
+        assert doc2["cluster"]["database_available"] is False
+        assert any(d["role"] == "resolver"
+                   for d in doc2["cluster"]["degraded_roles"])
+        await cc.stop()
+    run_simulation(main())
+
+
+def test_deposed_sequencer_refuses_grv():
+    """Epoch fencing: after recovery locks the old sequencer, a stale GRV
+    proxy pointing at it can no longer hand out read versions."""
+    async def main():
+        from foundationdb_tpu.rpc.stubs import GrvProxyClient
+        k = Knobs()
+        sim = SimCluster(k)
+        cc = sim.make_cc(ClusterConfigSpec())
+        _, prev = await cc.cstate.read()
+        state = await cc.recover_once(prev)
+        view = await sim.client_view()
+        await commit_kv(view, {b"g": b"1"})
+        ct = sim.client_transport()
+        old_grv = GrvProxyClient(
+            ct, NetworkAddress(*state["grv_proxies"][0]["addr"]),
+            state["grv_proxies"][0]["token"])
+        assert await old_grv.get_read_version() > 0
+        # next epoch: kill a resolver so recovery has a reason, then recover
+        sim.net.kill(NetworkAddress(*state["resolvers"][0]["addr"]))
+        await asyncio.sleep(k.FAILURE_TIMEOUT * 3)
+        _, prev2 = await cc.cstate.read()
+        await cc.recover_once(prev2)
+        # the old grv proxy's sequencer is now locked: stale reads refused
+        with pytest.raises(FdbError):
+            await old_grv.get_read_version()
+        await cc.stop()
+    run_simulation(main())
