@@ -14,12 +14,73 @@ push/peek/pop surface (see storage/disk_queue.py once durability lands).
 from __future__ import annotations
 
 import asyncio
+import bisect
 import dataclasses
 
 from ..runtime.knobs import Knobs
 from .data import Mutation, Version
 
 Tag = int
+
+
+class _TagStore:
+    """One tag's retained messages, version-indexed.
+
+    ``versions`` is ascending, aligned with ``entries``; pops advance
+    ``start`` (amortized trim) so peek is O(log n + result) instead of the
+    old linear rescan of the whole retained list.  ``spilled_below`` marks
+    the in-memory floor: older entries were evicted to the DiskQueue and
+    are re-read on demand (spill-by-reference,
+    REF:fdbserver/TLogServer.actor.cpp).
+    """
+
+    __slots__ = ("versions", "entries", "sizes", "start", "mem_bytes",
+                 "spilled_below")
+
+    def __init__(self) -> None:
+        self.versions: list[Version] = []
+        self.entries: list[list[Mutation]] = []
+        self.sizes: list[int] = []
+        self.start = 0
+        self.mem_bytes = 0
+        self.spilled_below: Version = 0
+
+    def append(self, version: Version, msgs: list[Mutation], nbytes: int) -> None:
+        self.versions.append(version)
+        self.entries.append(msgs)
+        self.sizes.append(nbytes)
+        self.mem_bytes += nbytes
+
+    def slice_from(self, begin: Version) -> list[tuple[Version, list[Mutation]]]:
+        i = max(self.start, bisect.bisect_left(self.versions, begin))
+        return list(zip(self.versions[i:], self.entries[i:]))
+
+    def pop_below(self, version: Version) -> None:
+        i = bisect.bisect_left(self.versions, version)
+        if i > self.start:
+            self.mem_bytes -= sum(self.sizes[self.start:i])
+            self.start = i
+        if self.start > 64 and self.start * 2 > len(self.versions):
+            del self.versions[:self.start]
+            del self.entries[:self.start]
+            del self.sizes[:self.start]
+            self.start = 0
+
+    def evict_below(self, version: Version) -> int:
+        """Spill: drop in-memory entries < version (they stay in the disk
+        queue); returns bytes freed."""
+        i = bisect.bisect_left(self.versions, version)
+        if i <= self.start:
+            self.spilled_below = max(self.spilled_below, version)
+            return 0
+        freed = sum(self.sizes[self.start:i])
+        del self.versions[:i]
+        del self.entries[:i]
+        del self.sizes[:i]
+        self.start = 0
+        self.mem_bytes -= freed
+        self.spilled_below = max(self.spilled_below, version)
+        return freed
 
 
 @dataclasses.dataclass
@@ -42,9 +103,9 @@ class TLog:
         self.knobs = knobs
         self.version: Version = epoch_begin_version
         self.queue = queue                      # DiskQueue when durable
-        self._frame_ends: list[tuple[Version, int]] = []  # for pop_to
+        self._frame_ends: list[tuple[Version, int]] = []  # for pop_to + spill reads
         self._hosted: set[Tag] = set()          # tags ever pushed here
-        self._log: dict[Tag, list[tuple[Version, list[Mutation]]]] = {}
+        self._log: dict[Tag, _TagStore] = {}
         self._poppable: dict[Tag, Version] = {}
         self._push_waiters: dict[Version, list[asyncio.Future]] = {}
         self._peek_waiters: list[asyncio.Future] = []
@@ -69,16 +130,29 @@ class TLog:
             rec = decode(frame)
             version = rec["v"]
             for tag, msgs in rec["m"].items():
-                tlog._log.setdefault(tag, []).append((version, msgs))
+                nbytes = sum(len(m.param1) + len(m.param2) for m in msgs)
+                tlog._store(tag).append(version, msgs, nbytes)
                 tlog._hosted.add(tag)
+                tlog.total_bytes += nbytes
             tlog.version = max(tlog.version, version)
             tlog._frame_ends.append((version, end))
         return tlog
+
+    def _store(self, tag: Tag) -> _TagStore:
+        st = self._log.get(tag)
+        if st is None:
+            st = self._log[tag] = _TagStore()
+        return st
+
+    @property
+    def mem_bytes(self) -> int:
+        return sum(st.mem_bytes for st in self._log.values())
 
     async def metrics(self) -> dict:
         """Queue sample for the Ratekeeper (TLogQueuingMetrics analog)."""
         return {
             "queue_bytes": self.queue.bytes_used if self.queue is not None else 0,
+            "mem_bytes": self.mem_bytes,
             "version": self.version,
             "locked": self.locked,
         }
@@ -129,9 +203,10 @@ class TLog:
             raise TLogStopped()
         for tag, msgs in req.messages.items():
             if msgs:
-                self._log.setdefault(tag, []).append((req.version, msgs))
+                nbytes = sum(len(m.param1) + len(m.param2) for m in msgs)
+                self._store(tag).append(req.version, msgs, nbytes)
                 self._hosted.add(tag)
-                self.total_bytes += sum(len(m.param1) + len(m.param2) for m in msgs)
+                self.total_bytes += nbytes
         if self.queue is not None and req.messages:
             from ..rpc.wire import encode
             end = await self.queue.push(encode({"v": req.version,
@@ -148,6 +223,7 @@ class TLog:
                 raise TLogStopped()
         self.version = req.version
         self.total_pushes += 1
+        self._maybe_spill()
         ready = [v for v in self._push_waiters if v <= req.version]
         for v in sorted(ready):
             for fut in self._push_waiters.pop(v):
@@ -163,21 +239,79 @@ class TLog:
         """Long-poll: block until the log tip passes begin_version, then
         return all of tag's messages in [begin_version, tip].  A locked
         log never advances, so it answers immediately — the cursor uses
-        the (possibly short) end_version to roll to the next generation."""
+        the (possibly short) end_version to roll to the next generation.
+
+        In-memory entries are found by binary search (O(log n + result));
+        a peek below a spilled tag's in-memory floor re-reads the disk
+        queue's frames for the missing prefix."""
         while self.version < begin_version and not self.locked:
             fut = asyncio.get_running_loop().create_future()
             self._peek_waiters.append(fut)
             await fut
-        entries = [(v, m) for v, m in self._log.get(tag, ())
-                   if v >= begin_version]
+        st = self._log.get(tag)
+        if st is None:
+            return TLogPeekReply([], self.version + 1)
+        entries: list[tuple[Version, list[Mutation]]] = []
+        if begin_version < st.spilled_below and self.queue is not None:
+            entries.extend(await self._peek_spilled(
+                tag, begin_version, st.spilled_below))
+        entries.extend(st.slice_from(max(begin_version, st.spilled_below)))
         return TLogPeekReply(entries, self.version + 1)
+
+    async def _peek_spilled(self, tag: Tag, begin: Version,
+                            below: Version) -> list:
+        """Re-read frames covering versions [begin, below) from the disk
+        queue and filter this tag's messages."""
+        from ..rpc.wire import decode
+        i = bisect.bisect_left(self._frame_ends, (begin, -1))
+        if i >= len(self._frame_ends):
+            return []
+        off = self._frame_ends[i - 1][1] if i > 0 else 0
+        j = bisect.bisect_left(self._frame_ends, (below, -1))
+        stop = self._frame_ends[j - 1][1] if j > 0 else 0
+        out = []
+        for payload, _end in await self.queue.read_frames(off, stop):
+            rec = decode(payload)
+            v = rec["v"]
+            if begin <= v < below and tag in rec["m"] and rec["m"][tag]:
+                out.append((v, rec["m"][tag]))
+        return out
+
+    def _maybe_spill(self) -> None:
+        """Keep retained memory under TLOG_SPILL_THRESHOLD by evicting the
+        laggiest tags' oldest entries (they stay in the disk queue, keyed
+        by the frame index, and are re-read on peek).  Memory-only logs
+        cannot spill — their threshold is advisory."""
+        if self.queue is None:
+            return
+        limit = self.knobs.TLOG_SPILL_THRESHOLD
+        total = self.mem_bytes
+        if total <= limit:
+            return
+        target = limit // 2
+        from ..runtime.trace import TraceEvent
+        for tag, st in sorted(self._log.items(),
+                              key=lambda kv: -kv[1].mem_bytes):
+            if total <= target:
+                break
+            # evict this tag's older half (bounded below by what's on disk:
+            # everything < self.version is fsync'd before ack)
+            mid_i = st.start + (len(st.versions) - st.start) // 2
+            if mid_i >= len(st.versions):
+                continue
+            mid_v = min(st.versions[mid_i], self.version)
+            freed = st.evict_below(mid_v)
+            total -= freed
+            if freed:
+                TraceEvent("TLogSpilled").detail("Tag", tag) \
+                    .detail("Below", mid_v).detail("FreedBytes", freed).log()
 
     def pop(self, tag: Tag, version: Version) -> None:
         """Storage server declares everything < version durable; discard."""
         self._poppable[tag] = max(self._poppable.get(tag, 0), version)
-        log = self._log.get(tag)
-        if log:
-            self._log[tag] = [(v, m) for v, m in log if v >= version]
+        st = self._log.get(tag)
+        if st is not None:
+            st.pop_below(version)
         if self.queue is not None and self._hosted:
             # the disk queue can advance only past versions every hosted
             # tag has popped; a tag that never popped pins the queue

@@ -98,6 +98,24 @@ class DiskQueue:
             await self.file.truncate(_HEADER_SIZE + live)
             await self.file.sync()
 
+    async def read_frames(self, from_logical: int,
+                          to_logical: int | None = None) -> list[tuple[bytes, int]]:
+        """Re-read live frames in [from_logical, to_logical) — the TLog's
+        spilled-by-reference peek path (REF:fdbserver/TLogServer.actor.cpp
+        spilled data stays in the DiskQueue and is read back on demand)."""
+        pos = max(from_logical, self._front)
+        stop = self._end if to_logical is None else min(to_logical, self._end)
+        out: list[tuple[bytes, int]] = []
+        while pos + _FRAME.size <= stop:
+            ln, crc = _FRAME.unpack(await self.file.read(self._phys(pos),
+                                                         _FRAME.size))
+            data = await self.file.read(self._phys(pos) + _FRAME.size, ln)
+            if len(data) < ln or zlib.crc32(data) != crc:
+                break
+            pos += _FRAME.size + ln
+            out.append((data, pos))
+        return out
+
     @property
     def end_offset(self) -> int:
         return self._end

@@ -167,3 +167,48 @@ def test_restart_determinism():
                 return await Database(cluster2).get_range(b"", b"\xff")
         return run_simulation(main(), seed=seed)
     assert go(11) == go(11)
+
+
+def test_tlog_spill_and_indexed_peek():
+    """A lagging tag's retained memory is spilled to the disk queue once
+    TLOG_SPILL_THRESHOLD is crossed; peeks below the in-memory floor
+    re-read the queue's frames and return bit-identical history
+    (REF:fdbserver/TLogServer.actor.cpp spill-by-reference)."""
+    from foundationdb_tpu.core.data import Mutation, MutationType
+    from foundationdb_tpu.core.tlog import TLog, TLogPushRequest
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    async def main():
+        k = Knobs().override(TLOG_SPILL_THRESHOLD=20_000)
+        fs = SimFileSystem()
+        tlog = await TLog.open(k, fs, "spill.dq")
+        N = 200
+        val = b"x" * 100
+        for i in range(1, N + 1):
+            m0 = [Mutation(MutationType.SET_VALUE, b"fast%04d" % i, val)]
+            m1 = [Mutation(MutationType.SET_VALUE, b"slow%04d" % i, val)]
+            await tlog.push(TLogPushRequest(i - 1, i, {0: m0, 1: m1}))
+            # tag 0 is consumed promptly; tag 1 lags forever
+            tlog.pop(0, i)
+        # the laggard forced spills: memory stays bounded under the knob
+        assert tlog.mem_bytes <= 20_000, tlog.mem_bytes
+        st = tlog._log[1]
+        assert st.spilled_below > 1, "nothing was spilled"
+        # full-history peek of the laggard: disk prefix + memory suffix
+        reply = await tlog.peek(1, 1)
+        assert [v for v, _ in reply.entries] == list(range(1, N + 1))
+        assert all(ms[0].param1 == b"slow%04d" % v
+                   for v, ms in reply.entries)
+        # mid-range peek starting inside the spilled region
+        mid = st.spilled_below // 2
+        reply2 = await tlog.peek(1, mid)
+        assert [v for v, _ in reply2.entries] == list(range(mid, N + 1))
+        # the fast tag was popped below N: only the tip remains
+        reply3 = await tlog.peek(0, N - 5)
+        assert [v for v, _ in reply3.entries] == [N]
+        # restart from disk: spilled data was durable all along
+        tlog2 = await TLog.open(k, fs, "spill.dq")
+        reply4 = await tlog2.peek(1, 1)
+        assert [v for v, _ in reply4.entries] == list(range(1, N + 1))
+    run_simulation(main())
