@@ -201,9 +201,16 @@ class ClusterController:
             for s in storage_meta:
                 wa = NetworkAddress(s["worker"][0], s["worker"][1])
                 w = self.workers.get(wa)
-                # a dead machine's worker is unregistered and/or failed:
-                # skip the replica, reads fail over to its team
-                if w is None or not self.fm.is_available(wa):
+                if w is None:
+                    if self.fm.is_available(wa):
+                        # alive but not yet registered with this (new) CC —
+                        # completing recovery now would strand the replica
+                        # on the ended generation forever (its cursor would
+                        # spin at the old logs); fail the attempt and let
+                        # run() retry after registration
+                        raise FdbError("waiting for storage workers")
+                    continue   # dead machine: reads fail over to its team
+                if not self.fm.is_available(wa):
                     continue
                 try:
                     await asyncio.wait_for(

@@ -73,6 +73,13 @@ class CommitProxy:
             t.cancel()
         await asyncio.gather(*tasks, return_exceptions=True)
         self._inflight.clear()
+        # requests still queued or parked in a cancelled batch would await
+        # forever; their outcome is genuinely unknown (broken promise)
+        from ..runtime.errors import RequestMaybeDelivered
+        while not self._queue.empty():
+            _, fut = self._queue.get_nowait()
+            if not fut.done():
+                fut.set_exception(RequestMaybeDelivered())
 
     # --- client-facing ---
 
@@ -84,6 +91,15 @@ class CommitProxy:
     # --- batching (REF: commitBatcher) ---
 
     async def _batcher_loop(self) -> None:
+        from ..runtime.buggify import buggify
+        from ..runtime.rng import deterministic_random
+        if buggify("proxy_tiny_batches", fire_p=1.0):
+            # pathological batching knob (BUGGIFY knob randomization):
+            # near-zero window makes every txn its own batch
+            self.knobs = self.knobs.override(COMMIT_BATCH_INTERVAL=1e-5)
+        elif buggify("proxy_fat_batches", fire_p=1.0):
+            self.knobs = self.knobs.override(
+                COMMIT_BATCH_INTERVAL=self.knobs.COMMIT_BATCH_INTERVAL * 20)
         loop = asyncio.get_running_loop()
         last_real_commit = loop.time()
         while True:

@@ -35,7 +35,12 @@ class GrvProxy:
         return await fut
 
     async def _serve_batch(self) -> None:
+        from ..runtime.buggify import buggify
         await asyncio.sleep(self.knobs.GRV_BATCH_INTERVAL)
+        if buggify("grv_slow_batch"):
+            from ..runtime.rng import deterministic_random
+            # a stalled GRV batch: read versions arrive late and stale-er
+            await asyncio.sleep(deterministic_random().random() * 0.05)
         # Drain in a loop: requests arriving while we await the (possibly
         # remote) sequencer join the next round instead of being lost.
         # The final empty check and the task becoming done() are atomic in

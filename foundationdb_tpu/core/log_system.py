@@ -95,9 +95,18 @@ class LogSystem:
                 continue
             for i in gen.logs_for_tag(tag):
                 per_log[i][tag] = msgs
-        await asyncio.gather(*(
-            t.push(TLogPushRequest(prev_version, version, msgs))
-            for t, msgs in zip(gen.tlogs, per_log)))
+        from ..runtime.buggify import buggify
+
+        async def one(t, msgs):
+            if buggify("log_push_skew"):
+                from ..runtime.rng import deterministic_random
+                # replicas receive the push at very different times —
+                # stresses recovery's min(tip) reasoning
+                await asyncio.sleep(deterministic_random().random() * 0.03)
+            return await t.push(TLogPushRequest(prev_version, version, msgs))
+
+        await asyncio.gather(*(one(t, msgs)
+                               for t, msgs in zip(gen.tlogs, per_log)))
 
     # --- peek (REF: ILogSystem::peek / ServerPeekCursor) ---
 

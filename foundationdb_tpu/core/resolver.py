@@ -83,6 +83,10 @@ class Resolver:
     async def resolve(self, req: ResolveBatchRequest) -> ResolveBatchReply:
         if self._poisoned is not None:
             raise ResolverFailed() from self._poisoned
+        from ..runtime.buggify import buggify
+        if buggify("resolver_slow_batch"):
+            from ..runtime.rng import deterministic_random
+            await asyncio.sleep(deterministic_random().random() * 0.02)
         await self._wait_for_version(req.prev_version)
         if self._poisoned is not None:
             # poisoned while this batch was parked in the version queue

@@ -157,6 +157,12 @@ class StorageServer:
                 await asyncio.sleep(self.knobs.TLOG_PEEK_RETRY)
                 cursor.version = self.version + 1
                 continue
+            from ..runtime.buggify import buggify
+            if buggify("storage_slow_pull"):
+                # lagging storage: versions pile up, ratekeeper reacts,
+                # peeks span generations after recoveries
+                from ..runtime.rng import deterministic_random
+                await asyncio.sleep(deterministic_random().random() * 0.1)
             for version, mutations in reply.entries:
                 self._apply(version, mutations)
             if reply.end_version - 1 > self.version:

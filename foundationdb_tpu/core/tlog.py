@@ -221,8 +221,19 @@ class TLog:
                 # result, which discarding satisfies.
                 from ..runtime.errors import TLogStopped
                 raise TLogStopped()
+        from ..runtime.buggify import buggify
+        from ..runtime.rng import deterministic_random
+        if buggify("tlog_slow_commit"):
+            # rare fsync stall: pushes ack late, version chains back up
+            await asyncio.sleep(deterministic_random().random() * 0.05)
         self.version = req.version
         self.total_pushes += 1
+        if buggify("tlog_early_spill") and self.queue is not None:
+            # force the spill path long before the threshold would
+            for st_ in self._log.values():
+                if len(st_.versions) - st_.start > 4:
+                    st_.evict_below(min(st_.versions[st_.start + 2],
+                                        self.version))
         self._maybe_spill()
         ready = [v for v in self._push_waiters if v <= req.version]
         for v in sorted(ready):

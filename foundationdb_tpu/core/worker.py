@@ -94,7 +94,6 @@ class Worker:
         self.transport = transport
         self.make_client_transport = client_transport_factory
         self.base = base_token
-        self._next_block = base_token + TOKEN_BLOCK   # block 0: the worker itself
         self.roles: dict[int, tuple[str, Any]] = {}   # token -> (role, obj)
         serve_role(transport, "worker", self, base_token)
 
@@ -104,11 +103,23 @@ class Worker:
 
     # --- recruitment RPC surface ---
 
+    def _alloc_block(self) -> int:
+        """A random unused token block, NOT sequential: sequential blocks
+        repeat after a process reboot, and a stale client dialing a reused
+        token would reach a different role's methods (the reference uses
+        random endpoint UIDs for exactly this reason)."""
+        from ..runtime.rng import deterministic_random
+        rng = deterministic_random()
+        while True:
+            token = self.base + TOKEN_BLOCK * rng.random_int(1, 1 << 40)
+            if token not in self.roles and \
+                    token not in self.transport.dispatcher._handlers:
+                return token
+
     async def recruit(self, role: str, params: dict) -> int:
         """Create a role object and serve it; returns its base token."""
         k = self.knobs
-        token = self._next_block
-        self._next_block += TOKEN_BLOCK
+        token = self._alloc_block()
         obj = self._build_role(role, params or {}, k)
         serve_role(self.transport, role, obj, token)
         self.roles[token] = (role, obj)

@@ -29,6 +29,20 @@ class SimNetwork:
         self._partitioned: set[tuple[NetworkAddress, NetworkAddress]] = set()
         self._dead: set[NetworkAddress] = set()
         self._dead_ips: set[str] = set()
+        self._death_event: asyncio.Event | None = None
+
+    def death_event(self) -> asyncio.Event:
+        """Set (and replaced) on every kill — lets an in-flight request
+        notice its peer's machine died mid-dispatch, the way a real TCP
+        connection would reset."""
+        if self._death_event is None:
+            self._death_event = asyncio.Event()
+        return self._death_event
+
+    def _signal_death(self) -> None:
+        if self._death_event is not None:
+            self._death_event.set()
+            self._death_event = None
 
     # --- fault injection (RandomClogging / partition workloads use these) ---
 
@@ -48,6 +62,7 @@ class SimNetwork:
 
     def kill(self, addr: NetworkAddress) -> None:
         self._dead.add(addr)
+        self._signal_death()
 
     def reboot(self, addr: NetworkAddress) -> None:
         self._dead.discard(addr)
@@ -57,6 +72,7 @@ class SimNetwork:
         server transport AND its outbound client transports (the machine
         model of REF:fdbrpc/sim2.actor.cpp killProcess)."""
         self._dead_ips.add(ip)
+        self._signal_death()
 
     def reboot_ip(self, ip: str) -> None:
         self._dead_ips.discard(ip)
@@ -102,7 +118,26 @@ class SimTransport(Transport):
         peer = self.network.listeners.get(endpoint.address)
         if peer is None or self.network.is_dead(endpoint.address):
             raise ConnectionFailed()
-        ok, reply = await peer.dispatcher.dispatch(endpoint.token, payload)
+        # dispatch, but notice if either machine dies mid-call: the real
+        # network would reset the connection; without this, a handler
+        # whose process was killed leaves the caller awaiting forever
+        dispatch = asyncio.ensure_future(
+            peer.dispatcher.dispatch(endpoint.token, payload))
+        while True:
+            death = self.network.death_event()
+            waiter = asyncio.ensure_future(death.wait())
+            done, _ = await asyncio.wait(
+                {dispatch, waiter}, return_when=asyncio.FIRST_COMPLETED)
+            waiter.cancel()
+            if dispatch in done:
+                break
+            if (self.network.is_dead(endpoint.address)
+                    or self.network.is_dead(self.address)):
+                dispatch.cancel()
+                await asyncio.gather(dispatch, return_exceptions=True)
+                await asyncio.sleep(self.network.knobs.CONNECT_TIMEOUT)
+                raise RequestMaybeDelivered()
+        ok, reply = dispatch.result()
         d2 = self.network._delay(endpoint.address, self.address)
         if d2 is None:
             # executed remotely but the reply was lost: ambiguous outcome

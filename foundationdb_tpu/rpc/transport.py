@@ -66,7 +66,10 @@ class RequestDispatcher:
         """Returns (ok, reply_or_error_code)."""
         h = self._handlers.get(token)
         if h is None:
-            return False, 1012  # wrong_connection_file stand-in: unknown endpoint
+            # endpoint_not_found: the role at this token is gone (stopped,
+            # or its process rebooted).  Retryable — clients refresh their
+            # cluster view and re-dial the new generation.
+            return False, 1012
         try:
             return True, await h(payload)
         except FdbError as e:

@@ -91,5 +91,5 @@ LogDataLoss = _err(2902, "log_data_loss",
 # path converts it to commit_unknown_result (1021) before the client's
 # retry loop can see it, because re-running a maybe-delivered commit is
 # not idempotent.
-_RETRYABLE = {1004, 1007, 1009, 1020, 1021, 1026, 1031, 1037, 1039, 1191, 1213, 2900}
+_RETRYABLE = {1004, 1007, 1009, 1012, 1020, 1021, 1026, 1031, 1037, 1039, 1191, 1213, 2900}
 _MAYBE_COMMITTED = {1021}

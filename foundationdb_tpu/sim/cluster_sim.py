@@ -1,0 +1,211 @@
+"""SimulatedCluster — machines, kills, reboots on the deterministic net.
+
+Reference: REF:fdbserver/SimulatedCluster.actor.cpp — a simulated machine
+is an IP (every process transport on it), a lossy filesystem and the
+fdbserver process (here: ClusterHost, plus a durable Coordinator when the
+machine is in the quorum).  Killing a machine drops every packet to/from
+its IP AND its filesystem's unsynced writes — the crash semantics FDB's
+recovery is proved against; rebooting brings up a fresh process over the
+surviving disk state.
+
+Storage machines are excluded from attrition by callers until
+DataDistribution can re-replicate lost replicas (the reference's
+MachineAttrition honors the same constraint via protectedAddresses).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+
+from ..client.database import Database
+from ..client.transaction import Transaction
+from ..core.cluster_controller import ClusterConfigSpec
+from ..core.cluster_client import RecoveredClusterView, fetch_cluster_state
+from ..core.cluster_host import ClusterHost
+from ..core.coordination import Coordinator
+from ..rpc.sim_transport import SimNetwork, SimTransport
+from ..rpc.stubs import CoordinatorClient, serve_role
+from ..rpc.transport import (NetworkAddress, WLTOKEN_COORDINATOR,
+                             WLTOKEN_FIRST_AVAILABLE)
+from ..runtime.errors import FdbError
+from ..runtime.files import SimFileSystem
+from ..runtime.knobs import Knobs
+from ..runtime.trace import TraceEvent
+
+BASE = WLTOKEN_FIRST_AVAILABLE
+SERVER_PORT = 5100
+
+
+class SimMachine:
+    """One machine: IP + lossy filesystem + (coordinator?) + ClusterHost."""
+
+    def __init__(self, sim: "SimulatedCluster", index: int,
+                 coordinator: bool) -> None:
+        self.sim = sim
+        self.index = index
+        self.ip = f"10.1.0.{index + 1}"
+        self.is_coordinator = coordinator
+        self.fs = SimFileSystem()
+        self.addr = NetworkAddress(self.ip, SERVER_PORT)
+        self.host: ClusterHost | None = None
+        self.coordinator: Coordinator | None = None
+        self.alive = False
+        self._ports = itertools.count(5200)
+        self._boots = 0
+
+    def _client_transport(self) -> SimTransport:
+        return SimTransport(self.sim.net,
+                            NetworkAddress(self.ip, next(self._ports)))
+
+    async def start(self) -> None:
+        """Boot (or reboot) the machine's process."""
+        self.sim.net.reboot_ip(self.ip)
+        transport = SimTransport(self.sim.net, self.addr)  # replaces listener
+        if self.is_coordinator:
+            self.coordinator = await Coordinator.open(
+                self.sim.knobs, self.fs, "coordination-0.fdq")
+            serve_role(transport, "coordinator", self.coordinator,
+                       WLTOKEN_COORDINATOR)
+        coord_stubs = [CoordinatorClient(self._client_transport(), a,
+                                         WLTOKEN_COORDINATOR)
+                       for a in self.sim.coord_addrs]
+        # host ids must differ across boots or coordinators could confuse
+        # two incarnations in the same election
+        host_id = self.index + 100 * self._boots
+        self._boots += 1
+        self.host = ClusterHost(host_id, self.sim.knobs, transport,
+                                self._client_transport, BASE, coord_stubs,
+                                self.sim.spec)
+        self.host.start()
+        self.alive = True
+
+    async def kill(self) -> None:
+        """Machine crash: network dark + unsynced writes lost + process
+        coroutines stopped."""
+        TraceEvent("SimMachineKill").detail("IP", self.ip).log()
+        self.sim.net.kill_ip(self.ip)
+        self.fs.kill_unsynced()
+        self.alive = False
+        if self.host is not None:
+            await self.host.stop()
+            self.host = None
+        self.coordinator = None
+
+    async def reboot(self) -> None:
+        TraceEvent("SimMachineReboot").detail("IP", self.ip).log()
+        await self.start()
+
+
+class SimulatedCluster:
+    """The machine fleet + shared network + client helpers."""
+
+    def __init__(self, knobs: Knobs | None = None, n_machines: int = 6,
+                 n_coordinators: int = 3,
+                 spec: ClusterConfigSpec | None = None) -> None:
+        # sim-scale resolver shapes: the numpy conflict twin scans the
+        # whole ever-written ring per batch, and append-slab rings consume
+        # B*R slots per batch — production-sized shapes (64x8 over 2^16
+        # slots) cost ~seconds of real time per resolve in simulation
+        self.knobs = (knobs or Knobs()).override(
+            RESOLVER_BATCH_TXNS=16, RESOLVER_RANGES_PER_TXN=4,
+            CONFLICT_RING_CAPACITY=1 << 12, KEY_ENCODE_BYTES=16)
+        self.net = SimNetwork(self.knobs)
+        self.spec = spec or ClusterConfigSpec(
+            min_workers=n_machines, replication=2)
+        self.machines = [SimMachine(self, i, i < n_coordinators)
+                         for i in range(n_machines)]
+        self.coord_addrs = [m.addr for m in self.machines[:n_coordinators]]
+        self._client_ports = itertools.count(7000)
+
+    async def start(self) -> None:
+        for m in self.machines:
+            await m.start()
+
+    async def stop(self) -> None:
+        for m in self.machines:
+            if m.host is not None:
+                await m.host.stop()
+
+    # --- clients ---
+
+    def client_transport(self) -> SimTransport:
+        p = next(self._client_ports)
+        return SimTransport(self.net, NetworkAddress("10.9.0.1", p))
+
+    def coordinator_stubs(self, transport=None):
+        t = transport or self.client_transport()
+        return [CoordinatorClient(t, a, WLTOKEN_COORDINATOR)
+                for a in self.coord_addrs]
+
+    async def wait_epoch(self, n: int, poll: float = 0.25) -> dict:
+        stubs = self.coordinator_stubs()
+        while True:
+            try:
+                state = await fetch_cluster_state(stubs)
+                if state.get("epoch", 0) >= n:
+                    return state
+            except FdbError:
+                pass
+            await asyncio.sleep(poll)
+
+    async def database(self) -> "RefreshingDatabase":
+        t = self.client_transport()
+        stubs = self.coordinator_stubs(t)
+        state = await fetch_cluster_state(stubs)
+        view = RecoveredClusterView(self.knobs, t, state)
+        return RefreshingDatabase(view, stubs)
+
+    # --- fault targeting ---
+
+    async def txn_only_machines(self) -> list[SimMachine]:
+        """Machines whose kill exercises recovery: hosting at least one
+        txn-subsystem role, but no storage replica (re-replication needs
+        DataDistribution) and not a coordinator.  The elected controller's
+        machine may be included — CC failover is part of what attrition
+        tests."""
+        state = await self.wait_epoch(1)
+        storage_ips = {s["worker"][0] for s in state["storage"]}
+        role_ips = {state["sequencer"]["addr"][0]}
+        role_ips |= {a[0] for a in state["log_cfg"][-1]["tlogs"]}
+        role_ips |= {r["addr"][0] for r in state["resolvers"]}
+        role_ips |= {p["addr"][0]
+                     for p in state["commit_proxies"] + state["grv_proxies"]}
+        if state.get("ratekeeper"):
+            role_ips.add(state["ratekeeper"]["addr"][0])
+        return [m for m in self.machines
+                if not m.is_coordinator and m.ip not in storage_ips
+                and m.ip in role_ips]
+
+
+class _RefreshingTransaction(Transaction):
+    """Transaction whose retry path re-reads the coordinated state, so
+    every caller of the standard tr.on_error() contract — workloads
+    included — transparently follows recoveries to the new proxy
+    generation (the client-side MonitorLeader analog)."""
+
+    def __init__(self, db: "RefreshingDatabase") -> None:
+        super().__init__(db.view)
+        self._rdb = db
+
+    async def on_error(self, e: BaseException) -> None:
+        await self._rdb.refresh()
+        await super().on_error(e)
+
+
+class RefreshingDatabase(Database):
+    """Database over a RecoveredClusterView + the coordinators backing it."""
+
+    def __init__(self, view: RecoveredClusterView, coordinators: list) -> None:
+        super().__init__(view)
+        self.view = view
+        self.coordinators = coordinators
+
+    def create_transaction(self) -> Transaction:
+        return _RefreshingTransaction(self)
+
+    async def refresh(self) -> None:
+        try:
+            self.view.update(await fetch_cluster_state(self.coordinators))
+        except FdbError:
+            pass

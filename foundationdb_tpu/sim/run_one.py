@@ -1,0 +1,62 @@
+"""One simulation run: the analog of ``fdbserver -r simulation -s <seed>``.
+
+Boots a SimulatedCluster, runs Cycle + Serializability concurrently with
+MachineAttrition + RandomClogging under BUGGIFY, checks invariants, exits
+0 on success.  The seed farm (tools/seed_farm.py) fans these out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ..core.cluster_controller import ClusterConfigSpec
+from ..runtime.buggify import enable_buggify
+from ..runtime.knobs import Knobs
+from ..runtime.simloop import run_simulation
+from ..workloads.workload import run_workloads_on
+from .cluster_sim import SimulatedCluster
+
+
+async def simulate(seed: int, kills: int, buggify: bool) -> dict:
+    knobs = Knobs().override(BUGGIFY_ENABLED=buggify)
+    enable_buggify(buggify)
+    sim = SimulatedCluster(knobs, n_machines=7,
+                           spec=ClusterConfigSpec(min_workers=7,
+                                                  replication=2))
+    await sim.start()
+    await sim.wait_epoch(1)
+    db = await sim.database()
+    specs = [
+        {"testName": "Cycle", "nodeCount": 12, "transactionsPerClient": 30},
+        {"testName": "Serializability", "numOps": 40},
+        {"testName": "MachineAttrition", "sim": sim, "machinesToKill": kills},
+        {"testName": "RandomClogging", "sim": sim, "testDuration": 8.0},
+    ]
+    results = await run_workloads_on(db, specs, client_count=2)
+    await sim.stop()
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kills", type=int, default=2)
+    ap.add_argument("--no-buggify", action="store_true")
+    args = ap.parse_args(argv)
+    try:
+        results = run_simulation(
+            simulate(args.seed, args.kills, not args.no_buggify),
+            seed=args.seed)
+    except BaseException as e:  # noqa: BLE001 — the signature IS the output
+        print(json.dumps({"seed": args.seed, "ok": False,
+                          "error": f"{type(e).__name__}: {e}"[:300]}))
+        return 1
+    print(json.dumps({"seed": args.seed, "ok": True, "results": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,36 @@
+"""Machine-level simulation: attrition + clogging + BUGGIFY under invariants.
+
+The pytest face of the seed farm (tools/seed_farm.py runs the wide
+version; ``python -m foundationdb_tpu.sim.run_one --seed N`` replays one).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.runtime.buggify import enable_buggify
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.sim.run_one import simulate
+
+
+@pytest.fixture(autouse=True)
+def _buggify_off_after():
+    yield
+    enable_buggify(False)
+
+
+@pytest.mark.parametrize("seed", [0, 3, 17])
+def test_attrition_clogging_buggify_invariants(seed):
+    """A full chaos run: machine kills (including the CC's machine),
+    random clogging/partitions and BUGGIFY rare paths, concurrent with
+    Cycle + Serializability invariant workloads.  Any lost/phantom/
+    reordered write fails the check phase."""
+    results = run_simulation(simulate(seed, kills=2, buggify=True), seed=seed)
+    assert results["MachineAttrition"]["machines_killed"] == 2
+    assert results["Cycle"]["transactions"] == 60
+    assert results["Serializability"]["committed"] > 0
+
+
+def test_sim_runs_without_buggify():
+    results = run_simulation(simulate(101, kills=1, buggify=False), seed=101)
+    assert results["MachineAttrition"]["machines_killed"] == 1

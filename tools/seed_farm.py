@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Seed farm: fan out simulation seeds, bucket the failures.
+
+Reference: the correctness farm / TestHarness
+(REF:contrib/TestHarness2, SURVEY.md §4) — run the simulation at many
+seeds in parallel; any failure prints its seed (replayable with
+``python -m foundationdb_tpu.sim.run_one --seed N``) and failures are
+bucketed by error signature.
+
+    python tools/seed_farm.py --seeds 100 --jobs 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import concurrent.futures
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_seed(seed: int, timeout: float) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", "foundationdb_tpu.sim.run_one",
+             "--seed", str(seed)],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"seed": seed, "ok": False, "error": "TIMEOUT",
+                "elapsed": time.time() - t0}
+    line = p.stdout.strip().splitlines()[-1] if p.stdout.strip() else "{}"
+    try:
+        out = json.loads(line)
+    except ValueError:
+        out = {"seed": seed, "ok": False,
+               "error": f"no-json rc={p.returncode}: {p.stderr[-200:]}"}
+    out["elapsed"] = time.time() - t0
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=50)
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("--jobs", type=int, default=os.cpu_count() or 4)
+    ap.add_argument("--timeout", type=float, default=180.0)
+    args = ap.parse_args()
+
+    buckets: dict[str, list[int]] = collections.defaultdict(list)
+    ok = 0
+    t0 = time.time()
+    with concurrent.futures.ThreadPoolExecutor(args.jobs) as ex:
+        futs = {ex.submit(run_seed, s, args.timeout): s
+                for s in range(args.start, args.start + args.seeds)}
+        for fut in concurrent.futures.as_completed(futs):
+            r = fut.result()
+            if r.get("ok"):
+                ok += 1
+            else:
+                buckets[r.get("error", "?")[:120]].append(r["seed"])
+            done = ok + sum(len(v) for v in buckets.values())
+            print(f"\r[{done}/{args.seeds}] ok={ok} "
+                  f"failed={done - ok}", end="", file=sys.stderr, flush=True)
+    print(file=sys.stderr)
+
+    print(json.dumps({
+        "seeds": args.seeds,
+        "ok": ok,
+        "failed": args.seeds - ok,
+        "elapsed_s": round(time.time() - t0, 1),
+        "failure_buckets": {k: sorted(v) for k, v in buckets.items()},
+    }, indent=2))
+    return 0 if ok == args.seeds else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
