@@ -80,6 +80,19 @@ class Cli:
             return "\n".join(f"`{k.decode(errors='replace')}' is "
                              f"`{v.decode(errors='replace')}'" for k, v in rows) \
                 or "<empty>"
+        if cmd == "configure":
+            from .core.system_data import CONF_FIELDS, conf_key
+
+            async def do(tr):
+                for part in args:
+                    name, _, val = part.partition("=")
+                    if name not in CONF_FIELDS:
+                        raise ValueError(f"unknown configure field {name!r}; "
+                                         f"one of {CONF_FIELDS}")
+                    int(val)        # validate
+                    tr.set(conf_key(name), val.encode())
+            await self.run_txn(do)
+            return "Configuration changed (takes effect at the next recovery)"
         if cmd == "status" and args and args[0] == "json":
             import json as _json
 

@@ -145,6 +145,11 @@ class ClusterController:
             cur["dead"] = sorted(dead)
         self.epoch = new_epoch
 
+        # ---- materialize the database's own configuration (txnStateStore
+        # read): \xff/conf/ keys written by ordinary transactions override
+        # the static spec for THIS recruitment ----
+        spec = await self._read_conf_spec(prev_state, spec)
+
         # ---- recruit the new transaction subsystem ----
         self.recovery_state = "RECRUITING"
         live = self._live_workers()
@@ -279,6 +284,39 @@ class ClusterController:
         TraceEvent("RecoveryComplete").detail("Epoch", new_epoch) \
             .detail("RecoveryVersion", rv).log()
         return state
+
+    async def _read_conf_spec(self, prev_state: dict | None, spec):
+        """Read ``\\xff/conf/`` from the surviving storage replicas and
+        merge into the recruitment spec (REF:fdbclient/SystemData.cpp /
+        DatabaseConfiguration::fromKeyValues).  Epoch 1 has no storage
+        yet; an unreachable config shard falls back to the static spec —
+        recovery must never wedge on configuration reads."""
+        from ..rpc.stubs import StorageClient
+        from .data import KeyRange
+        from .system_data import CONF_PREFIX, decode_conf, spec_with_conf
+        if not prev_state:
+            return spec
+        conf_end = CONF_PREFIX + b"\xff"
+        for s in prev_state.get("storage", []):
+            if not (s["begin"] <= CONF_PREFIX < s["end"]):
+                continue
+            wa = NetworkAddress(s["worker"][0], s["worker"][1])
+            if not self.fm.is_available(wa):
+                continue
+            stub = StorageClient(self.transport, NetworkAddress(*s["addr"]),
+                                 s["token"], s["tag"],
+                                 KeyRange(s["begin"], s["end"]))
+            try:
+                rows, _ = await asyncio.wait_for(
+                    stub.get_latest_range(CONF_PREFIX, conf_end),
+                    timeout=self.knobs.FAILURE_TIMEOUT * 2)
+            except (FdbError, asyncio.TimeoutError):
+                continue
+            conf = decode_conf([(bytes(k), bytes(v)) for k, v in rows])
+            if conf:
+                TraceEvent("RecoveryReadConf").detail("Conf", str(conf)).log()
+            return spec_with_conf(spec, conf)
+        return spec
 
     @staticmethod
     def _wire_gen(g: dict) -> dict:

@@ -288,6 +288,21 @@ class StorageServer:
         # (exactly the window floor's state) is authoritative
         return self.engine.get(key) if self.engine is not None else None
 
+    async def get_latest_range(self, begin: bytes, end: bytes,
+                               limit: int = 1000
+                               ) -> tuple[list[tuple[bytes, bytes]], Version]:
+        """Latest-applied-version scan — the recovery-time metadata read
+        (txnStateStore materialization, REF:fdbserver/ApplyMetadataMutation
+        .cpp): the controller reads ``\\xff`` configuration back through
+        this without holding a read version, because it runs BEFORE the
+        new epoch can hand any out."""
+        b = max(begin, self.shard.begin)
+        e = min(end, self.shard.end)
+        if b >= e:
+            return [], self.version
+        rows, _ = await self.get_key_values(b, e, self.version, limit)
+        return rows, self.version
+
     async def get_key_values(self, begin: bytes, end: bytes, version: Version,
                              limit: int = 0, reverse: bool = False,
                              byte_limit: int = 0

@@ -1,0 +1,57 @@
+"""System keyspace layout + the txnStateStore materialization step.
+
+Reference: REF:fdbclient/SystemData.cpp (``\\xff/conf/...``,
+``\\xff/keyServers/...``) + REF:fdbserver/ApplyMetadataMutation.cpp — the
+database configures ITSELF through its own keyspace: configuration lives
+in ``\\xff`` keys written by ordinary transactions, and recovery
+materializes them into the controller's recruitment plan (the
+txnStateStore read).
+
+Here system keys are stored in the storage servers like any other data
+(the ``\\xff`` range belongs to the last shard), so they inherit
+replication, MVCC and recovery for free; the controller reads them back
+at recovery time through the latest-version read surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+CONF_PREFIX = b"\xff/conf/"
+KEY_SERVERS_PREFIX = b"\xff/keyServers/"
+
+# conf keys the controller honors, mapping to ClusterConfigSpec fields
+CONF_FIELDS = ("commit_proxies", "grv_proxies", "resolvers", "logs",
+               "log_replication")
+
+
+def conf_key(field: str) -> bytes:
+    return CONF_PREFIX + field.encode()
+
+
+def decode_conf(rows: list[tuple[bytes, bytes]]) -> dict[str, int]:
+    """``\\xff/conf/...`` rows → {field: value}; unknown/garbage ignored."""
+    out: dict[str, int] = {}
+    for k, v in rows:
+        if not k.startswith(CONF_PREFIX):
+            continue
+        name = k[len(CONF_PREFIX):].decode(errors="replace")
+        if name not in CONF_FIELDS:
+            continue
+        try:
+            out[name] = int(v)
+        except ValueError:
+            continue
+    return out
+
+
+def spec_with_conf(spec, conf: dict[str, int]):
+    """Recruitment spec = static defaults overridden by the database's own
+    configuration keys (the DatabaseConfiguration::fromKeyValues analog).
+    Values are clamped to sane minimums — a bad conf write must not brick
+    recovery."""
+    kv = {}
+    for field in CONF_FIELDS:
+        if field in conf:
+            kv[field] = max(1, int(conf[field]))
+    return dataclasses.replace(spec, **kv) if kv else spec

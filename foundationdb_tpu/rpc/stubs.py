@@ -26,7 +26,8 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
     "tlog": [("push", False), ("peek", False), ("pop", True),
              ("lock", False), ("metrics", False)],
     "storage": [("get_value", False), ("get_key_values", False),
-                ("watch_value", False), ("metrics", False)],
+                ("watch_value", False), ("metrics", False),
+                ("get_latest_range", False)],
     "commit_proxy": [("commit", False)],
     "grv_proxy": [("get_read_version", False)],
     "ratekeeper": [("admit", False), ("get_rate", False)],

@@ -362,3 +362,35 @@ def test_deposed_sequencer_refuses_grv():
             await old_grv.get_read_version()
         await cc.stop()
     run_simulation(main())
+
+
+def test_conf_keys_take_effect_next_recovery():
+    """\\xff/conf/ writes through an ordinary transaction reconfigure the
+    cluster at the next recovery (system keyspace -> txnStateStore read ->
+    recruitment, REF:fdbclient/SystemData.cpp)."""
+    async def main():
+        k = Knobs()
+        sim = SimCluster(k)
+        cc = sim.make_cc(ClusterConfigSpec())
+        _, prev = await cc.cstate.read()
+        state = await cc.recover_once(prev)
+        assert len(state["resolvers"]) == 1
+        view = await sim.client_view()
+        await commit_kv(view, {b"\xff/conf/resolvers": b"2",
+                               b"\xff/conf/logs": b"3",
+                               b"data": b"x"})
+        # let storage apply the conf mutations
+        await asyncio.sleep(1.0)
+        _, prev2 = await cc.cstate.read()
+        state2 = await cc.recover_once(prev2)
+        assert len(state2["resolvers"]) == 2
+        assert len(state2["log_cfg"][-1]["tlogs"]) == 3
+        # the reconfigured cluster serves transactions, old data intact
+        view2 = await sim.client_view()
+        got = await read_kv(view2, [b"data"])
+        assert got == {b"data": b"x"}
+        items = {b"after%d" % i: b"y%d" % i for i in range(6)}
+        await commit_kv(view2, items)
+        assert await read_kv(view2, items) == items
+        await cc.stop()
+    run_simulation(main())
