@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import asyncio
 
+from ..client.database import Database
+from ..client.transaction import Transaction
 from ..rpc.stubs import (CommitProxyClient, GrvProxyClient, StorageClient)
 from ..rpc.transport import NetworkAddress, Transport
 from ..runtime.errors import FdbError
@@ -111,3 +113,37 @@ async def fetch_cluster_state(coordinators: list) -> dict:
     if best is None:
         raise FdbError("no coordinator returned a cluster state")
     return best
+
+
+class _RefreshingTransaction(Transaction):
+    """Transaction whose retry path re-reads the coordinated state, so
+    every caller of the standard tr.on_error() contract — workloads
+    included — transparently follows recoveries to the new proxy
+    generation (the client-side MonitorLeader analog)."""
+
+    def __init__(self, db: "RefreshingDatabase") -> None:
+        super().__init__(db.view)
+        self._rdb = db
+
+    async def on_error(self, e: BaseException) -> None:
+        await self._rdb.refresh()
+        await super().on_error(e)
+
+
+class RefreshingDatabase(Database):
+    """Database over a RecoveredClusterView + the coordinators backing it."""
+
+    def __init__(self, view: RecoveredClusterView, coordinators: list) -> None:
+        super().__init__(view)
+        self.view = view
+        self.coordinators = coordinators
+
+    def create_transaction(self) -> Transaction:
+        return _RefreshingTransaction(self)
+
+    async def refresh(self) -> None:
+        try:
+            self.view.update(await fetch_cluster_state(self.coordinators))
+        except FdbError:
+            pass
+

@@ -70,7 +70,15 @@ class ClusterController:
         self.fm = FailureMonitor(transport, knobs)
         self.epoch = 0
         self.recovery_state = "READING_CSTATE"
+        self.last_state: dict | None = None
+        self._recovery_requested: asyncio.Event = asyncio.Event()
         self._stopped = False
+
+    def request_recovery(self, reason: str = "") -> None:
+        """Ask the run() loop for a new epoch without a role failure —
+        how DataDistribution applies a new shard layout."""
+        TraceEvent("RecoveryRequested").detail("Reason", reason).log()
+        self._recovery_requested.set()
 
     # --- helpers ---
 
@@ -145,10 +153,11 @@ class ClusterController:
             cur["dead"] = sorted(dead)
         self.epoch = new_epoch
 
-        # ---- materialize the database's own configuration (txnStateStore
-        # read): \xff/conf/ keys written by ordinary transactions override
-        # the static spec for THIS recruitment ----
-        spec = await self._read_conf_spec(prev_state, spec)
+        # ---- materialize the database's own metadata (txnStateStore
+        # read): \xff/conf/ overrides the recruitment spec and
+        # \xff/keyServers/layout carries DataDistribution's desired shard
+        # layout, both written by ordinary transactions ----
+        spec, layout = await self._read_system_state(prev_state, spec)
 
         # ---- recruit the new transaction subsystem ----
         self.recovery_state = "RECRUITING"
@@ -193,38 +202,87 @@ class ClusterController:
                                         "v0": rv})
             resolver_info.append((tuple(a), r.begin, r.end, t))
 
-        # ---- storage: recruit once (epoch 1), rejoin afterwards ----
+        # ---- storage: recruit (epoch 1) / rejoin / move per the desired
+        # layout.  A range whose tag assignment changed (a DataDistribution
+        # split or move written to \xff/keyServers/layout) gets a freshly
+        # recruited server that fetchKeys-streams the snapshot at the
+        # recovery version from a surviving source replica; mutations above
+        # it arrive via its new tag.  REF:fdbserver/MoveKeys.actor.cpp. ----
         self.recovery_state = "REJOINING"
-        rf = max(1, spec.replication)
-        team_tags = [[s * rf + r for r in range(rf)]
-                     for s in range(spec.storage_servers)]
-        shard_map = ShardMap.even(spec.storage_servers, team_tags)
         wire_log_cfg = [self._wire_gen(g) for g in log_cfg]
         storage_meta: list[dict] = []
         if prev_state:
-            storage_meta = [dict(s) for s in prev_state["storage"]]
-            for s in storage_meta:
-                wa = NetworkAddress(s["worker"][0], s["worker"][1])
-                w = self.workers.get(wa)
-                if w is None:
-                    if self.fm.is_available(wa):
-                        # alive but not yet registered with this (new) CC —
-                        # completing recovery now would strand the replica
-                        # on the ended generation forever (its cursor would
-                        # spin at the old logs); fail the attempt and let
-                        # run() retry after registration
-                        raise FdbError("waiting for storage workers")
-                    continue   # dead machine: reads fail over to its team
-                if not self.fm.is_available(wa):
-                    continue
-                try:
-                    await asyncio.wait_for(
-                        w.rejoin_storage(s["token"], wire_log_cfg, rv),
-                        timeout=k.FAILURE_TIMEOUT * 4)
-                except (FdbError, asyncio.TimeoutError):
-                    TraceEvent("StorageRejoinFailed", severity=30) \
-                        .detail("Tag", s["tag"]).log()
+            boundaries = (layout or {}).get(
+                "boundaries", prev_state["shard_boundaries"])
+            teams = (layout or {}).get("teams", prev_state["shard_teams"])
+            shard_map = ShardMap([bytes(b) for b in boundaries],
+                                 [list(t) for t in teams])
+            prev_by_tag = {s["tag"]: s for s in prev_state["storage"]}
+            rejoined: set[int] = set()
+            si = 0
+            for rng, team in shard_map.ranges():
+                for tag in team:
+                    ps = prev_by_tag.get(tag)
+                    if ps is not None and ps["begin"] <= rng.begin \
+                            and ps["end"] >= rng.end:
+                        if tag in rejoined:
+                            continue
+                        rejoined.add(tag)
+                        s = dict(ps)
+                        storage_meta.append(s)
+                        wa = NetworkAddress(s["worker"][0], s["worker"][1])
+                        w = self.workers.get(wa)
+                        if w is None:
+                            if self.fm.is_available(wa):
+                                # alive but not yet registered with this
+                                # (new) CC — completing recovery would
+                                # strand the replica on the ended
+                                # generation; fail and retry
+                                raise FdbError("waiting for storage workers")
+                            continue   # dead: reads fail over to its team
+                        if not self.fm.is_available(wa):
+                            continue
+                        try:
+                            await asyncio.wait_for(
+                                w.rejoin_storage(s["token"], wire_log_cfg, rv),
+                                timeout=k.FAILURE_TIMEOUT * 4)
+                        except (FdbError, asyncio.TimeoutError):
+                            TraceEvent("StorageRejoinFailed", severity=30) \
+                                .detail("Tag", s["tag"]).log()
+                    else:
+                        # moved/split-in range: fetch from a live replica of
+                        # the covering source shard
+                        src = next(
+                            (p for p in prev_state["storage"]
+                             if p["begin"] <= rng.begin and p["end"] >= rng.end
+                             and self.fm.is_available(
+                                 NetworkAddress(*p["worker"]))),
+                            None)
+                        if src is None:
+                            raise FdbError("no live source for moved shard")
+                        wa = pick(30 + si)
+                        si += 1
+                        a, t = await self._recruit(wa, "storage", {
+                            "tag": tag, "shard_begin": rng.begin,
+                            "shard_end": rng.end, "v0": rv,
+                            "log_cfg": wire_log_cfg,
+                            "fetch_from": {"addr": src["addr"],
+                                           "token": src["token"],
+                                           "tag": src["tag"],
+                                           "begin": src["begin"],
+                                           "end": src["end"]},
+                            "fetch_version": rv})
+                        storage_meta.append({
+                            "worker": [wa.ip, wa.port], "addr": a,
+                            "token": t, "tag": tag,
+                            "begin": rng.begin, "end": rng.end})
+                        TraceEvent("StorageMoveRecruited").detail("Tag", tag) \
+                            .detail("Begin", rng.begin).detail("End", rng.end).log()
         else:
+            rf = max(1, spec.replication)
+            team_tags = [[s * rf + r for r in range(rf)]
+                         for s in range(spec.storage_servers)]
+            shard_map = ShardMap.even(spec.storage_servers, team_tags)
             i = 0
             for rng, tags in shard_map.ranges():
                 for tag in tags:
@@ -280,25 +338,30 @@ class ClusterController:
             "shard_teams": teams,
         }
         await self.cstate.write(state)
+        self.last_state = state
         self.recovery_state = "ACCEPTING_COMMITS"
         TraceEvent("RecoveryComplete").detail("Epoch", new_epoch) \
             .detail("RecoveryVersion", rv).log()
         return state
 
-    async def _read_conf_spec(self, prev_state: dict | None, spec):
-        """Read ``\\xff/conf/`` from the surviving storage replicas and
-        merge into the recruitment spec (REF:fdbclient/SystemData.cpp /
-        DatabaseConfiguration::fromKeyValues).  Epoch 1 has no storage
-        yet; an unreachable config shard falls back to the static spec —
-        recovery must never wedge on configuration reads."""
+    async def _read_system_state(self, prev_state: dict | None, spec):
+        """Read the ``\\xff`` metadata range from a surviving storage
+        replica: conf keys merge into the recruitment spec
+        (REF:fdbclient/SystemData.cpp / DatabaseConfiguration::
+        fromKeyValues) and the keyServers layout (if any) becomes the
+        desired shard map.  Epoch 1 has no storage yet; an unreachable
+        metadata shard falls back to the static spec — recovery must
+        never wedge on configuration reads."""
         from ..rpc.stubs import StorageClient
-        from .data import KeyRange
-        from .system_data import CONF_PREFIX, decode_conf, spec_with_conf
+        from ..rpc.wire import decode
+        from .data import KeyRange, SYSTEM_PREFIX
+        from .system_data import (KEY_SERVERS_PREFIX, decode_conf,
+                                  spec_with_conf)
         if not prev_state:
-            return spec
-        conf_end = CONF_PREFIX + b"\xff"
+            return spec, None
+        sys_end = SYSTEM_PREFIX + b"\xfe"
         for s in prev_state.get("storage", []):
-            if not (s["begin"] <= CONF_PREFIX < s["end"]):
+            if not (s["begin"] <= SYSTEM_PREFIX < s["end"]):
                 continue
             wa = NetworkAddress(s["worker"][0], s["worker"][1])
             if not self.fm.is_available(wa):
@@ -308,15 +371,25 @@ class ClusterController:
                                  KeyRange(s["begin"], s["end"]))
             try:
                 rows, _ = await asyncio.wait_for(
-                    stub.get_latest_range(CONF_PREFIX, conf_end),
+                    stub.get_latest_range(SYSTEM_PREFIX, sys_end),
                     timeout=self.knobs.FAILURE_TIMEOUT * 2)
             except (FdbError, asyncio.TimeoutError):
                 continue
-            conf = decode_conf([(bytes(k), bytes(v)) for k, v in rows])
-            if conf:
-                TraceEvent("RecoveryReadConf").detail("Conf", str(conf)).log()
-            return spec_with_conf(spec, conf)
-        return spec
+            rows = [(bytes(k), bytes(v)) for k, v in rows]
+            conf = decode_conf(rows)
+            layout = None
+            for key, v in rows:
+                if key == KEY_SERVERS_PREFIX + b"layout":
+                    try:
+                        layout = decode(v)
+                    except Exception:  # noqa: BLE001 — bad layout ignored
+                        layout = None
+            if conf or layout:
+                TraceEvent("RecoveryReadSystemState") \
+                    .detail("Conf", str(conf)) \
+                    .detail("HasLayout", layout is not None).log()
+            return spec_with_conf(spec, conf), layout
+        return spec, None
 
     @staticmethod
     def _wire_gen(g: dict) -> dict:
@@ -364,6 +437,9 @@ class ClusterController:
                 watch.append(NetworkAddress(*state["ratekeeper"]["addr"]))
             waiters = [asyncio.ensure_future(self.fm.wait_for_failure(a))
                        for a in set(watch)]
+            self._recovery_requested.clear()
+            waiters.append(asyncio.ensure_future(
+                self._recovery_requested.wait()))
             try:
                 done, pending = await asyncio.wait(
                     waiters, return_when=asyncio.FIRST_COMPLETED)

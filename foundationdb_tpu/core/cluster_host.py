@@ -127,6 +127,25 @@ class ClusterHost:
         self._leading = True
         cc_task = asyncio.get_running_loop().create_task(
             self._run_cc(), name=f"cc-{self.id}")
+        dd = None
+        if k.DD_ENABLED:
+            from .cluster_client import RecoveredClusterView, RefreshingDatabase
+            from .data_distribution import DataDistributor
+
+            async def start_dd():
+                while self.cc is not None and self.cc.last_state is None:
+                    await asyncio.sleep(0.25)
+                if self.cc is None:
+                    return None
+                t = self.make_client_transport()
+                view = RecoveredClusterView(k, t, self.cc.last_state)
+                db = RefreshingDatabase(view, self.coordinators)
+                d = DataDistributor(k, t, self.cc, db)
+                d.start()
+                return d
+
+            dd_task = asyncio.get_running_loop().create_task(
+                start_dd(), name=f"dd-start-{self.id}")
         try:
             while True:
                 await asyncio.sleep(k.LEADER_HEARTBEAT_INTERVAL)
@@ -145,24 +164,22 @@ class ClusterHost:
                     return
         finally:
             self._leading = False
+            if k.DD_ENABLED:
+                dd_task.cancel()
+                try:
+                    dd = dd_task.result() if dd_task.done() else None
+                except BaseException:
+                    dd = None
+                if dd is not None:
+                    await dd.stop()
             cc_task.cancel()
             await asyncio.gather(cc_task, return_exceptions=True)
             await self.cc.stop()
             self.cc = None
 
     async def _run_cc(self) -> None:
-        """cc.run() with state capture for get_cluster_state."""
         assert self.cc is not None
-        cc = self.cc
-        orig = cc.recover_once
-
-        async def capturing(prev):
-            state = await orig(prev)
-            cc.last_state = state
-            return state
-
-        cc.recover_once = capturing     # type: ignore[method-assign]
-        await cc.run()
+        await self.cc.run()
 
     async def _follow(self, leader_addr) -> None:
         """Register with the leader; return (to re-elect) when it dies or

@@ -1,0 +1,154 @@
+"""DataDistribution v1 — shard statistics, splits and moves.
+
+Reference: REF:fdbserver/DataDistribution.actor.cpp +
+DataDistributionTracker (shard stats / split decisions) +
+MoveKeys.actor.cpp (the relocation protocol).  The distributor runs
+beside the elected cluster controller:
+
+1. it samples every storage replica's ``logical_bytes``;
+2. a shard over ``DD_SHARD_SPLIT_BYTES`` gets a split key from its
+   server (``sample_split_key`` — splitMetrics analog), producing a new
+   desired layout with fresh tags for the right half;
+3. the layout is committed to ``\\xff/keyServers/layout`` through an
+   ordinary transaction (the metadata-mutation path), and a recovery is
+   requested: the next epoch recruits servers for the new assignments,
+   which fetchKeys-stream their snapshot at the recovery version from
+   the old replicas while new mutations arrive via their fresh tags.
+
+The flip is therefore recovery-mediated in v1 — writes retry through the
+(short) recovery window instead of dual-tagging during a live move; the
+data path is still exact: snapshot at rv + stream above rv.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..rpc.stubs import StorageClient
+from ..rpc.transport import Transport
+from ..runtime.knobs import Knobs
+from ..runtime.trace import TraceEvent
+from .cluster_client import RecoveredClusterView
+from .data import KeyRange
+from .shard_map import ShardMap
+from .system_data import KEY_SERVERS_PREFIX
+
+
+def layout_of(state: dict) -> dict:
+    return {"boundaries": [bytes(b) for b in state["shard_boundaries"]],
+            "teams": [list(t) for t in state["shard_teams"]]}
+
+
+def split_layout(layout: dict, shard_idx: int, split_key: bytes,
+                 next_tag: int) -> tuple[dict, int]:
+    """Split shard ``shard_idx`` at ``split_key``: left half keeps its
+    team, right half gets ``len(team)`` fresh tags."""
+    boundaries = list(layout["boundaries"])
+    teams = [list(t) for t in layout["teams"]]
+    team = teams[shard_idx]
+    new_team = [next_tag + i for i in range(len(team))]
+    boundaries.insert(shard_idx, split_key)
+    teams.insert(shard_idx + 1, new_team)
+    return ({"boundaries": boundaries, "teams": teams},
+            next_tag + len(team))
+
+
+def move_layout(layout: dict, shard_idx: int, next_tag: int) -> tuple[dict, int]:
+    """Reassign shard ``shard_idx`` to an entirely fresh team (the manual
+    ``move`` / excluded-server relocation case)."""
+    teams = [list(t) for t in layout["teams"]]
+    n = len(teams[shard_idx])
+    teams[shard_idx] = [next_tag + i for i in range(n)]
+    return ({"boundaries": list(layout["boundaries"]), "teams": teams},
+            next_tag + n)
+
+
+class DataDistributor:
+    """Runs with the elected controller; watches shard sizes and writes
+    new layouts + requests recoveries to apply them."""
+
+    def __init__(self, knobs: Knobs, transport: Transport, cc,
+                 database) -> None:
+        self.knobs = knobs
+        self.transport = transport
+        self.cc = cc                 # ClusterController (for last_state + trigger)
+        self.db = database           # Database-like with .run + .view
+        self._task: asyncio.Task | None = None
+        self.splits_done = 0
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self._loop(), name="data-distributor")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.knobs.DD_INTERVAL)
+            try:
+                await self._round()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — DD must not die quietly
+                TraceEvent("DDRoundFailed", severity=30) \
+                    .detail("Error", repr(e)[:200]).log()
+
+    async def _round(self) -> None:
+        state = getattr(self.cc, "last_state", None)
+        if not state or self.cc.recovery_state != "ACCEPTING_COMMITS":
+            return
+        layout = layout_of(state)
+        by_tag = {s["tag"]: s for s in state["storage"]}
+        shard_map = ShardMap(layout["boundaries"], layout["teams"])
+        next_tag = max(by_tag) + 1 if by_tag else 0
+
+        for idx, (rng, team) in enumerate(shard_map.ranges()):
+            sizes = []
+            for tag in team:
+                s = by_tag.get(tag)
+                if s is None:
+                    continue
+                stub = self._stub(s)
+                try:
+                    m = await asyncio.wait_for(
+                        stub.metrics(), timeout=self.knobs.FAILURE_TIMEOUT)
+                    sizes.append((m.get("logical_bytes", 0), s))
+                except Exception:   # noqa: BLE001 — dead replica: skip
+                    continue
+            if not sizes:
+                continue
+            size, src = max(sizes, key=lambda x: x[0])
+            if size < self.knobs.DD_SHARD_SPLIT_BYTES:
+                continue
+            split_key = await self._stub(src).sample_split_key(
+                rng.begin, rng.end)
+            if not split_key:
+                continue
+            split_key = bytes(split_key)
+            new_layout, _ = split_layout(layout, idx, split_key, next_tag)
+            await self._commit_layout(new_layout)
+            self.splits_done += 1
+            TraceEvent("DDShardSplit").detail("Shard", idx) \
+                .detail("At", split_key).detail("Bytes", size).log()
+            self.cc.request_recovery("dd_split")
+            return                  # one relocation per round
+
+    def _stub(self, s: dict) -> StorageClient:
+        from ..rpc.transport import NetworkAddress
+        return StorageClient(self.transport, NetworkAddress(*s["addr"]),
+                             s["token"], s["tag"],
+                             KeyRange(s["begin"], s["end"]))
+
+    async def _commit_layout(self, layout: dict) -> None:
+        from ..rpc.wire import encode
+
+        async def do(tr):
+            tr.set(KEY_SERVERS_PREFIX + b"layout", encode(layout))
+        await self.db.run(do)

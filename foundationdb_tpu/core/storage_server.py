@@ -24,7 +24,8 @@ from .tlog import TLog, Tag
 class StorageServer:
     def __init__(self, knobs: Knobs, tag: Tag, shard: KeyRange,
                  log_system, epoch_begin_version: Version = 0,
-                 engine=None) -> None:
+                 engine=None, fetch_src=None,
+                 fetch_version: Version = 0) -> None:
         from .log_system import LogSystem
         self.knobs = knobs
         self.tag = tag
@@ -55,6 +56,15 @@ class StorageServer:
         self.bytes_input = 0
         self.bytes_durable = 0    # ratekeeper queue metric
         self.total_reads = 0
+        self.logical_bytes = 0    # approx live kv size (DD shard stats)
+        # fetchKeys: a moved/split-in shard is not readable until the
+        # snapshot from the source replica has landed
+        self._fetch_src = fetch_src
+        self._fetch_version = fetch_version
+        self._fetch_done = asyncio.Event()
+        if fetch_src is None:
+            self._fetch_done.set()
+        self._fetch_task: asyncio.Task | None = None
         from ..runtime.trace import CounterCollection
         self.counters = CounterCollection("StorageMetrics", str(tag))
         self._metrics_task = None
@@ -69,12 +79,18 @@ class StorageServer:
             "version": self.version,
             "durable_version": self.durable_version,
             "bytes_input": self.bytes_input,
+            "logical_bytes": self.logical_bytes,
+            "shard_begin": self.shard.begin,
+            "shard_end": self.shard.end,
         }
 
     # --- lifecycle ---
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
+        if self._fetch_src is not None and not self._fetch_done.is_set():
+            self._fetch_task = loop.create_task(
+                self._fetch_loop(), name=f"storage-{self.tag}-fetch")
         self._pull_task = loop.create_task(
             self._pull_loop(), name=f"storage-{self.tag}-pull")
         if self.engine is not None:
@@ -94,7 +110,8 @@ class StorageServer:
             c.log_metrics()
 
     async def stop(self) -> None:
-        for attr in ("_pull_task", "_durability_task", "_metrics_task"):
+        for attr in ("_pull_task", "_durability_task", "_metrics_task",
+                     "_fetch_task"):
             t = getattr(self, attr)
             if t is not None:
                 t.cancel()
@@ -134,6 +151,68 @@ class StorageServer:
         if running:
             self._pull_task = asyncio.get_running_loop().create_task(
                 self._pull_loop(), name=f"storage-{self.tag}-pull")
+
+    # --- fetchKeys (REF: storageserver.actor.cpp fetchKeys) ---
+
+    async def _fetch_loop(self) -> None:
+        """Stream the shard's snapshot at the fetch version from a source
+        replica; mutations above it arrive via the normal tag pull, so
+        snapshot + stream compose into an exact copy.  Reads are gated
+        until the snapshot has fully landed (no partial-range phantoms)."""
+        from ..runtime.errors import FdbError
+        from ..runtime.trace import TraceEvent
+        b, e, v = self.shard.begin, self.shard.end, self._fetch_version
+        rows_total = 0
+        while True:
+            try:
+                kvs, more = await self._fetch_src.get_key_values(
+                    b, e, v, 1000)
+            except FdbError as err:
+                if err.retryable:
+                    await asyncio.sleep(0.1)
+                    continue
+                raise
+            for k, val in kvs:
+                k, val = bytes(k), bytes(val)
+                self.vmap.set(v, k, val)
+                self.logical_bytes += len(k) + len(val)
+                if self.engine is not None:
+                    self._durability_buffer.append((v, (OP_SET, k, val)))
+            rows_total += len(kvs)
+            if not more or not kvs:
+                break
+            b = bytes(kvs[-1][0]) + b"\x00"
+        self._fetch_done.set()
+        TraceEvent("FetchKeysComplete").detail("Tag", self.tag) \
+            .detail("Rows", rows_total).detail("Version", v).log()
+
+    async def _wait_fetched(self) -> None:
+        if self._fetch_done.is_set():
+            return
+        from ..runtime.errors import FutureVersion
+        try:
+            await asyncio.wait_for(
+                self._fetch_done.wait(),
+                timeout=self.knobs.STORAGE_FUTURE_VERSION_WAIT)
+        except asyncio.TimeoutError:
+            raise FutureVersion() from None
+
+    async def sample_split_key(self, begin: bytes, end: bytes) -> bytes | None:
+        """Key splitting [begin, end) into halves by bytes — what the
+        data distributor asks for (REF:fdbserver/StorageMetrics.actor.cpp
+        splitMetrics).  None when the range has too few rows to split."""
+        rows, _ = await self.get_latest_range(begin, end, limit=10_000)
+        if len(rows) < 4:
+            return None
+        total = sum(len(k) + len(v) for k, v in rows)
+        acc = 0
+        for k, v in rows:
+            acc += len(k) + len(v)
+            if acc * 2 >= total:
+                key = bytes(k)
+                # never split at the boundaries themselves
+                return key if begin < key < end else None
+        return None
 
     # --- the update path (REF: storageserver.actor.cpp::update) ---
 
@@ -220,6 +299,7 @@ class StorageServer:
         for m in mutations:
             self.bytes_input += len(m.param1) + len(m.param2)
             if m.type == MutationType.SET_VALUE:
+                self.logical_bytes += len(m.param1) + len(m.param2)
                 self.vmap.set(version, m.param1, m.param2)
                 if durable:
                     self._durability_buffer.append(
@@ -278,6 +358,7 @@ class StorageServer:
             raise TransactionTooOld()
 
     async def get_value(self, key: bytes, version: Version) -> bytes | None:
+        await self._wait_fetched()
         await self._wait_for_version(version)
         self._check_too_old(version)
         self.total_reads += 1
@@ -307,6 +388,7 @@ class StorageServer:
                              limit: int = 0, reverse: bool = False,
                              byte_limit: int = 0
                              ) -> tuple[list[tuple[bytes, bytes]], bool]:
+        await self._wait_fetched()
         await self._wait_for_version(version)
         self._check_too_old(version)
         self.total_reads += 1

@@ -185,9 +185,18 @@ class Worker:
         if role == "storage":
             t = self.make_client_transport()
             ls = LogSystem(generations_from_config(p["log_cfg"], t, self.base))
+            fetch_src = None
+            src = p.get("fetch_from")
+            if src is not None:
+                from ..rpc.stubs import StorageClient
+                fetch_src = StorageClient(
+                    self.make_client_transport(), addr(src["addr"]),
+                    src["token"], src["tag"],
+                    KeyRange(src["begin"], src["end"]))
             return StorageServer(k, p["tag"],
                                  KeyRange(p["shard_begin"], p["shard_end"]),
-                                 ls, p.get("v0", 0))
+                                 ls, p.get("v0", 0), fetch_src=fetch_src,
+                                 fetch_version=p.get("fetch_version", 0))
         if role == "ratekeeper":
             t = self.make_client_transport()
             storages = [StorageClient(t, addr(s["addr"]), s["token"],

@@ -27,7 +27,7 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
              ("lock", False), ("metrics", False)],
     "storage": [("get_value", False), ("get_key_values", False),
                 ("watch_value", False), ("metrics", False),
-                ("get_latest_range", False)],
+                ("get_latest_range", False), ("sample_split_key", False)],
     "commit_proxy": [("commit", False)],
     "grv_proxy": [("get_read_version", False)],
     "ratekeeper": [("admit", False), ("get_rate", False)],

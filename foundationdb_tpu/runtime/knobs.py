@@ -69,6 +69,11 @@ class Knobs:
     LOG_REPLICATION: int = 2                  # TLogs hosting each tag (min'd with log count)
     TLOG_PEEK_RETRY: float = 0.05             # cursor poll while a generation is being ended
 
+    # --- data distribution ---
+    DD_ENABLED: bool = False                  # auto split/move loop on the CC
+    DD_INTERVAL: float = 2.0                  # stats sampling period
+    DD_SHARD_SPLIT_BYTES: int = 1 << 24       # split threshold (logical bytes)
+
     # --- observability ---
     METRICS_INTERVAL: float = 5.0             # role *Metrics emit period
 

@@ -18,10 +18,9 @@ from __future__ import annotations
 import asyncio
 import itertools
 
-from ..client.database import Database
-from ..client.transaction import Transaction
 from ..core.cluster_controller import ClusterConfigSpec
-from ..core.cluster_client import RecoveredClusterView, fetch_cluster_state
+from ..core.cluster_client import (RecoveredClusterView,
+                                   RefreshingDatabase, fetch_cluster_state)
 from ..core.cluster_host import ClusterHost
 from ..core.coordination import Coordinator
 from ..rpc.sim_transport import SimNetwork, SimTransport
@@ -176,36 +175,3 @@ class SimulatedCluster:
         return [m for m in self.machines
                 if not m.is_coordinator and m.ip not in storage_ips
                 and m.ip in role_ips]
-
-
-class _RefreshingTransaction(Transaction):
-    """Transaction whose retry path re-reads the coordinated state, so
-    every caller of the standard tr.on_error() contract — workloads
-    included — transparently follows recoveries to the new proxy
-    generation (the client-side MonitorLeader analog)."""
-
-    def __init__(self, db: "RefreshingDatabase") -> None:
-        super().__init__(db.view)
-        self._rdb = db
-
-    async def on_error(self, e: BaseException) -> None:
-        await self._rdb.refresh()
-        await super().on_error(e)
-
-
-class RefreshingDatabase(Database):
-    """Database over a RecoveredClusterView + the coordinators backing it."""
-
-    def __init__(self, view: RecoveredClusterView, coordinators: list) -> None:
-        super().__init__(view)
-        self.view = view
-        self.coordinators = coordinators
-
-    def create_transaction(self) -> Transaction:
-        return _RefreshingTransaction(self)
-
-    async def refresh(self) -> None:
-        try:
-            self.view.update(await fetch_cluster_state(self.coordinators))
-        except FdbError:
-            pass
