@@ -225,7 +225,7 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
         << jnp.arange(32, dtype=jnp.uint32)[None, None, :], axis=-1)  # [B, nw]
     ok = valid & ~too_old
     cw = [jnp.uint32(0)] * nw
-    confs = []
+    confw = [jnp.uint32(0)] * nw
     for i in range(B):
         hit = cw[0] & packed[i, 0]
         for w in range(1, nw):
@@ -233,9 +233,13 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
         conf = hist_conflict[i] | (hit != jnp.uint32(0))
         commit = ok[i] & ~conf
         wi, bi = divmod(i, 32)
-        cw[wi] = cw[wi] | jnp.where(commit, jnp.uint32(1 << bi), jnp.uint32(0))
-        confs.append(conf)
-    conf_vec = jnp.stack(confs)
+        bit = jnp.uint32(1 << bi)
+        cw[wi] = cw[wi] | jnp.where(commit, bit, jnp.uint32(0))
+        confw[wi] = confw[wi] | jnp.where(conf, bit, jnp.uint32(0))
+    # unpack the conf bit words vectorized (cheaper than stacking B scalars)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    conf_vec = jnp.concatenate(
+        [(w >> shifts) & jnp.uint32(1) for w in confw])[:B].astype(bool)
     committed = ok & ~conf_vec
     verdicts = jnp.where(~valid, COMMITTED,
                          jnp.where(too_old, TOO_OLD,
