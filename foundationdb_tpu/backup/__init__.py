@@ -1,0 +1,5 @@
+"""Backup and restore agents."""
+
+from .agent import BackupAgent, RestoreError
+
+__all__ = ["BackupAgent", "RestoreError"]

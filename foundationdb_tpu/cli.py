@@ -26,6 +26,25 @@ from .runtime.knobs import Knobs
 BASE = WLTOKEN_FIRST_AVAILABLE
 
 
+class _CliDatabase:
+    """Database facade over the CLI's retry loop (refresh-aware)."""
+
+    def __init__(self, cli: "Cli") -> None:
+        self._cli = cli
+
+    def create_transaction(self):
+        from .client.transaction import Transaction
+        return Transaction(self._cli.view)
+
+    async def run(self, fn, max_retries=None):
+        return await self._cli.run_txn(fn)
+
+    async def set(self, key, value):
+        async def go(tr):
+            tr.set(key, value)
+        await self.run(go)
+
+
 class Cli:
     def __init__(self, knobs: Knobs, view: RecoveredClusterView,
                  coordinators: list) -> None:
@@ -80,6 +99,16 @@ class Cli:
             return "\n".join(f"`{k.decode(errors='replace')}' is "
                              f"`{v.decode(errors='replace')}'" for k, v in rows) \
                 or "<empty>"
+        if cmd == "backup" or cmd == "restore":
+            from .backup import BackupAgent
+            from .runtime.files import RealFileSystem
+            agent = BackupAgent(_CliDatabase(self), RealFileSystem(),
+                                args[0] if args else "fdb-backup")
+            if cmd == "backup":
+                m = await agent.backup()
+                return f"Backup complete: {m.rows} rows at version {m.version}"
+            m = await agent.restore()
+            return f"Restore complete: {m.rows} rows (snapshot version {m.version})"
         if cmd == "configure":
             from .core.system_data import CONF_FIELDS, conf_key
 

@@ -144,3 +144,26 @@ class RealFileSystem:
             os.remove(path)
         except FileNotFoundError:
             pass
+
+
+class RealFileSystem:
+    """Real-disk twin of SimFileSystem (RealFile-backed, rooted)."""
+
+    def __init__(self, root: str = ".") -> None:
+        self.root = root
+
+    def open(self, path: str) -> RealFile:
+        return RealFile(os.path.join(self.root, path))
+
+    def listdir(self, prefix: str) -> list[str]:
+        base = os.path.join(self.root, prefix)
+        d = base if os.path.isdir(base) else os.path.dirname(base)
+        if not os.path.isdir(d):
+            return []
+        rel = os.path.relpath(d, self.root)
+        out = []
+        for name in os.listdir(d):
+            p = name if rel == "." else os.path.join(rel, name)
+            if p.startswith(prefix):
+                out.append(p)
+        return sorted(out)
