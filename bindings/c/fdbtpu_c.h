@@ -1,0 +1,73 @@
+/* fdbtpu_c.h — the C ABI of the tpu-kv client.
+ *
+ * Reference: REF:bindings/c/foundationdb/fdb_c.h — every language binding
+ * goes through this surface.  v1 is the synchronous core of that API
+ * (get/set/clear/commit/on_error with the standard retry-loop contract);
+ * futures/callbacks and range reads are additive later.
+ *
+ * Thread model: fdbtpu_init() starts the network (an embedded client
+ * runtime on its own thread, the run_network analog); every call below is
+ * thread-safe and blocking.  Returned buffers are owned by the caller and
+ * released with fdbtpu_free().
+ */
+
+#ifndef FDBTPU_C_H
+#define FDBTPU_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int fdbtpu_error_t;            /* 0 = success; FDB error codes */
+
+typedef struct FDBTPUTransaction FDBTPUTransaction;
+
+/* Start the client network against the given cluster file.  Returns 0 or
+ * an error code.  Call once per process. */
+fdbtpu_error_t fdbtpu_init(const char* cluster_file_path);
+
+/* Stop the network and release the runtime. */
+fdbtpu_error_t fdbtpu_stop(void);
+
+/* Create / destroy a transaction. */
+fdbtpu_error_t fdbtpu_create_transaction(FDBTPUTransaction** out);
+void fdbtpu_transaction_destroy(FDBTPUTransaction* tr);
+
+/* Reads.  On success *out_present tells whether the key exists; when it
+ * does, *out_value/*out_length hold a malloc'd copy (fdbtpu_free it). */
+fdbtpu_error_t fdbtpu_transaction_get(FDBTPUTransaction* tr,
+                                      const uint8_t* key, int key_length,
+                                      int* out_present,
+                                      uint8_t** out_value, int* out_length);
+
+/* Buffered writes (visible to this transaction's reads, RYW). */
+fdbtpu_error_t fdbtpu_transaction_set(FDBTPUTransaction* tr,
+                                      const uint8_t* key, int key_length,
+                                      const uint8_t* value, int value_length);
+fdbtpu_error_t fdbtpu_transaction_clear(FDBTPUTransaction* tr,
+                                        const uint8_t* key, int key_length);
+
+/* Commit; on success *out_committed_version holds the commit version. */
+fdbtpu_error_t fdbtpu_transaction_commit(FDBTPUTransaction* tr,
+                                         int64_t* out_committed_version);
+
+/* The retry-loop contract: feed a failed call's error code back; returns
+ * 0 when the transaction was reset and should be retried, else the
+ * (non-retryable) error to surface. */
+fdbtpu_error_t fdbtpu_transaction_on_error(FDBTPUTransaction* tr,
+                                           fdbtpu_error_t code);
+
+/* Reset a transaction for reuse. */
+fdbtpu_error_t fdbtpu_transaction_reset(FDBTPUTransaction* tr);
+
+void fdbtpu_free(uint8_t* ptr);
+
+/* Static description of an error code (never NULL). */
+const char* fdbtpu_get_error(fdbtpu_error_t code);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* FDBTPU_C_H */

@@ -1,0 +1,100 @@
+/* C-ABI smoke test: set/get/clear/commit with the retry loop, in plain C.
+ *
+ * Compiled and run by tests/test_bindings.py against a live 3-process
+ * cluster; exercises exactly the contract every language binding uses
+ * (REF:bindings/c/test/unit/unit_tests.cpp).
+ */
+
+#include <stdio.h>
+#include <string.h>
+
+#include "fdbtpu_c.h"
+
+#define CHECK(expr)                                                       \
+    do {                                                                  \
+        fdbtpu_error_t _e = (expr);                                       \
+        if (_e != 0) {                                                    \
+            fprintf(stderr, "FAIL %s -> %d (%s)\n", #expr, _e,            \
+                    fdbtpu_get_error(_e));                                \
+            return 1;                                                     \
+        }                                                                 \
+    } while (0)
+
+static fdbtpu_error_t retry(FDBTPUTransaction* tr, fdbtpu_error_t e) {
+    return fdbtpu_transaction_on_error(tr, e);
+}
+
+int main(int argc, char** argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s <cluster-file>\n", argv[0]);
+        return 2;
+    }
+    CHECK(fdbtpu_init(argv[1]));
+
+    FDBTPUTransaction* tr;
+    CHECK(fdbtpu_create_transaction(&tr));
+
+    /* write with the standard retry loop */
+    for (;;) {
+        fdbtpu_error_t e = 0;
+        e = fdbtpu_transaction_set(tr, (const uint8_t*)"c-key", 5,
+                                   (const uint8_t*)"c-value", 7);
+        if (e == 0) {
+            int64_t ver = -1;
+            e = fdbtpu_transaction_commit(tr, &ver);
+            if (e == 0) {
+                if (ver <= 0) {
+                    fprintf(stderr, "FAIL bad commit version %lld\n",
+                            (long long)ver);
+                    return 1;
+                }
+                break;
+            }
+        }
+        CHECK(retry(tr, e));
+    }
+    CHECK(fdbtpu_transaction_reset(tr));
+
+    /* read it back (new transaction semantics after reset) */
+    int present = 0, len = 0;
+    uint8_t* val = NULL;
+    for (;;) {
+        fdbtpu_error_t e = fdbtpu_transaction_get(
+            tr, (const uint8_t*)"c-key", 5, &present, &val, &len);
+        if (e == 0) break;
+        CHECK(retry(tr, e));
+    }
+    if (!present || len != 7 || memcmp(val, "c-value", 7) != 0) {
+        fprintf(stderr, "FAIL read-back mismatch (present=%d len=%d)\n",
+                present, len);
+        return 1;
+    }
+    fdbtpu_free(val);
+
+    /* clear + verify absent */
+    for (;;) {
+        fdbtpu_error_t e = 0;
+        e = fdbtpu_transaction_clear(tr, (const uint8_t*)"c-key", 5);
+        if (e == 0) {
+            e = fdbtpu_transaction_commit(tr, NULL);
+            if (e == 0) break;
+        }
+        CHECK(retry(tr, e));
+    }
+    CHECK(fdbtpu_transaction_reset(tr));
+    for (;;) {
+        fdbtpu_error_t e = fdbtpu_transaction_get(
+            tr, (const uint8_t*)"c-key", 5, &present, &val, &len);
+        if (e == 0) break;
+        CHECK(retry(tr, e));
+    }
+    if (present) {
+        fprintf(stderr, "FAIL key still present after clear\n");
+        return 1;
+    }
+
+    fdbtpu_transaction_destroy(tr);
+    CHECK(fdbtpu_stop());
+    printf("C ABI SMOKE OK\n");
+    return 0;
+}

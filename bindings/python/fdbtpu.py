@@ -1,0 +1,124 @@
+"""Python binding over the C ABI (NOT the native client).
+
+Reference: REF:bindings/python/fdb/impl.py — the real Python binding
+dlopens fdb_c and goes through the C surface; this does the same against
+libfdbtpu_c.so via ctypes, so the ABI itself is exercised end to end.
+(The in-repo native client — foundationdb_tpu.client — stays the fast
+path; this module exists to prove the binding surface.)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.normpath(os.path.join(
+    _HERE, "..", "..", "foundationdb_tpu", "native", "libfdbtpu_c.so"))
+
+_lib: ctypes.CDLL | None = None
+
+
+class FdbtpuError(Exception):
+    def __init__(self, code: int, message: str = ""):
+        super().__init__(f"fdbtpu error {code}: {message}")
+        self.code = code
+
+
+def _check(code: int) -> None:
+    if code != 0:
+        msg = _lib.fdbtpu_get_error(code).decode()
+        raise FdbtpuError(code, msg)
+
+
+def open(cluster_file: str) -> "Database":
+    """Start the network against the cluster file; returns the database."""
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(_LIB_PATH, mode=ctypes.RTLD_GLOBAL)
+        lib.fdbtpu_init.argtypes = [ctypes.c_char_p]
+        lib.fdbtpu_create_transaction.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.fdbtpu_transaction_destroy.argtypes = [ctypes.c_void_p]
+        lib.fdbtpu_transaction_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.fdbtpu_transaction_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int]
+        lib.fdbtpu_transaction_clear.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.fdbtpu_transaction_commit.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+        lib.fdbtpu_transaction_on_error.argtypes = [ctypes.c_void_p,
+                                                    ctypes.c_int]
+        lib.fdbtpu_transaction_reset.argtypes = [ctypes.c_void_p]
+        lib.fdbtpu_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.fdbtpu_get_error.restype = ctypes.c_char_p
+        lib.fdbtpu_get_error.argtypes = [ctypes.c_int]
+        _lib = lib
+        _check(_lib.fdbtpu_init(cluster_file.encode()))
+    return Database()
+
+
+class Database:
+    def create_transaction(self) -> "CTransaction":
+        h = ctypes.c_void_p()
+        _check(_lib.fdbtpu_create_transaction(ctypes.byref(h)))
+        return CTransaction(h)
+
+    def run(self, fn):
+        """The @transactional retry loop over the C surface."""
+        tr = self.create_transaction()
+        try:
+            while True:
+                try:
+                    out = fn(tr)
+                    tr.commit()
+                    return out
+                except FdbtpuError as e:
+                    rc = _lib.fdbtpu_transaction_on_error(tr._h, e.code)
+                    if rc != 0:
+                        raise
+        finally:
+            tr.destroy()
+
+
+class CTransaction:
+    def __init__(self, handle):
+        self._h = handle
+
+    def get(self, key: bytes) -> bytes | None:
+        present = ctypes.c_int()
+        val = ctypes.POINTER(ctypes.c_uint8)()
+        vlen = ctypes.c_int()
+        _check(_lib.fdbtpu_transaction_get(
+            self._h, key, len(key), ctypes.byref(present),
+            ctypes.byref(val), ctypes.byref(vlen)))
+        if not present.value:
+            return None
+        out = ctypes.string_at(val, vlen.value)
+        _lib.fdbtpu_free(val)
+        return out
+
+    def set(self, key: bytes, value: bytes) -> None:
+        _check(_lib.fdbtpu_transaction_set(self._h, key, len(key),
+                                           value, len(value)))
+
+    def clear(self, key: bytes) -> None:
+        _check(_lib.fdbtpu_transaction_clear(self._h, key, len(key)))
+
+    def commit(self) -> int:
+        ver = ctypes.c_int64()
+        _check(_lib.fdbtpu_transaction_commit(self._h, ctypes.byref(ver)))
+        return ver.value
+
+    def reset(self) -> None:
+        _check(_lib.fdbtpu_transaction_reset(self._h))
+
+    def destroy(self) -> None:
+        if self._h:
+            _lib.fdbtpu_transaction_destroy(self._h)
+            self._h = None

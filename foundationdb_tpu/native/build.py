@@ -18,6 +18,18 @@ TARGETS = {
     "keycodec": ["keycodec.cpp"],
 }
 
+# targets living outside native/ with extra flags: name -> (srcs, extra)
+def _py_flags():
+    import sysconfig
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    return ([f"-I{inc}"], [f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+                           "-lpython" + sysconfig.get_config_var("LDVERSION")])
+
+SPECIAL_TARGETS = {
+    "fdbtpu_c": (["../../bindings/c/fdbtpu_c.cpp"], _py_flags),
+}
+
 CXXFLAGS = ["-std=c++20", "-O3", "-march=native", "-fPIC", "-shared",
             "-Wall", "-Wextra", "-fno-exceptions", "-fno-rtti"]
 
@@ -27,18 +39,27 @@ def lib_path(name: str) -> str:
 
 
 def build(name: str, force: bool = False) -> str:
-    srcs = [os.path.join(HERE, s) for s in TARGETS[name]]
+    extra_cc: list[str] = []
+    extra_ld: list[str] = []
+    if name in SPECIAL_TARGETS:
+        rel_srcs, flags_fn = SPECIAL_TARGETS[name]
+        extra_cc, extra_ld = flags_fn()
+        srcs = [os.path.normpath(os.path.join(HERE, s)) for s in rel_srcs]
+    else:
+        srcs = [os.path.join(HERE, s) for s in TARGETS[name]]
     out = lib_path(name)
     if not force and os.path.exists(out) and all(
             os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
         return out
-    cmd = ["g++", *CXXFLAGS, "-o", out, *srcs]
+    flags = [f for f in CXXFLAGS
+             if name not in SPECIAL_TARGETS or f != "-fno-exceptions"]
+    cmd = ["g++", *flags, *extra_cc, "-o", out, *srcs, *extra_ld]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
     return out
 
 
 def build_all(force: bool = False) -> None:
-    for name in TARGETS:
+    for name in list(TARGETS) + list(SPECIAL_TARGETS):
         print(f"building lib{name}.so ...", file=sys.stderr)
         build(name, force=force)
 
