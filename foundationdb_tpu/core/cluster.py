@@ -105,8 +105,11 @@ class Cluster:
         if fs is None or data_dir is None:
             return cls(config, knobs)
         from ..storage.kv_store import MemoryKVStore
+        from ..storage.lsm import LSMKVStore
         config = config or ClusterConfig()
         knobs = knobs or KNOBS
+        engine_cls = {"memory": MemoryKVStore,
+                      "lsm": LSMKVStore}[knobs.STORAGE_ENGINE]
         tlogs = [await TLog.open(knobs, fs, f"{data_dir}/tlog-{i}.dq")
                  for i in range(config.logs)]
         engines = {}
@@ -114,7 +117,7 @@ class Cluster:
         for s in range(config.storage_servers):
             for r in range(rf):
                 tag = s * rf + r
-                engines[tag] = await MemoryKVStore.open(
+                engines[tag] = await engine_cls.open(
                     fs, f"{data_dir}/storage-{tag}")
         epoch = max([t.version for t in tlogs]
                     + [e.meta.get("durable_version", 0)

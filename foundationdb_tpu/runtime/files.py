@@ -36,6 +36,11 @@ class RealFile:
     async def read(self, offset: int, length: int) -> bytes:
         return os.pread(self._fd, length, offset)
 
+    def read_sync(self, offset: int, length: int) -> bytes:
+        """Synchronous block read — the LSM engine's page-cache path
+        (bounded block-sized stalls, same caveat as the class docstring)."""
+        return os.pread(self._fd, length, offset)
+
     async def write(self, offset: int, data: bytes) -> None:
         os.pwrite(self._fd, data, offset)
 
@@ -80,6 +85,10 @@ class SimFile:
         buf = bytearray(self.fs.disks[self.path])
         self._replay(buf, self._pending)
         return bytes(buf)
+
+    def read_sync(self, offset: int, length: int) -> bytes:
+        v = self._view()
+        return bytes(v[offset:offset + length])
 
     async def read(self, offset: int, length: int) -> bytes:
         v = self._view()
@@ -129,24 +138,6 @@ class SimFileSystem:
 
 
 class RealFileSystem:
-    def open(self, path: str) -> RealFile:
-        return RealFile(path)
-
-    def listdir(self, prefix: str) -> list[str]:
-        d = os.path.dirname(prefix) or "."
-        if not os.path.isdir(d):
-            return []
-        return sorted(os.path.join(d, n) for n in os.listdir(d)
-                      if os.path.join(d, n).startswith(prefix))
-
-    def remove(self, path: str) -> None:
-        try:
-            os.remove(path)
-        except FileNotFoundError:
-            pass
-
-
-class RealFileSystem:
     """Real-disk twin of SimFileSystem (RealFile-backed, rooted)."""
 
     def __init__(self, root: str = ".") -> None:
@@ -167,3 +158,9 @@ class RealFileSystem:
             if p.startswith(prefix):
                 out.append(p)
         return sorted(out)
+
+    def remove(self, path: str) -> None:
+        try:
+            os.remove(os.path.join(self.root, path))
+        except FileNotFoundError:
+            pass

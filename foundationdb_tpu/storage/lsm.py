@@ -1,0 +1,346 @@
+"""LSM key-value engine: memtable + WAL + sorted-run files + compaction.
+
+Reference: the disk-backed IKeyValueStore engines —
+REF:fdbserver/VersionedBTree.actor.cpp (Redwood) and
+REF:fdbserver/KeyValueStoreRocksDB.actor.cpp — behind the same
+IKeyValueStore surface as kv_store.MemoryKVStore.  Where the memory
+engine caps the database at RAM and rewrites O(db) snapshots, this engine
+keeps only the memtable in RAM:
+
+- writes land in the WAL (DiskQueue, fsync per commit) + memtable;
+- a full memtable flushes to an immutable sorted-run file (data blocks +
+  a sparse index block + footer), newest-first;
+- reads consult memtable then runs newest→oldest through a small LRU
+  block cache (sync block reads — the page-cache path);
+- too many runs trigger a merge compaction into one run (tombstones
+  elided at the bottom level);
+- the MANIFEST names the live runs + engine metadata; every state change
+  (flush/compact) writes MANIFEST atomically after the new files are
+  durable, so a crash at any point recovers to a consistent run set.
+"""
+
+from __future__ import annotations
+
+import bisect
+import heapq
+from collections import OrderedDict
+from typing import Iterator
+
+from ..rpc.wire import decode, encode
+from .disk_queue import DiskQueue
+from .kv_store import OP_CLEAR, OP_SET
+
+_TOMBSTONE = None          # value None in runs marks a deletion
+_BLOCK_BYTES = 1 << 16
+_MEMTABLE_BYTES = 1 << 22  # flush threshold (4MB)
+_MAX_RUNS = 6              # compact when exceeded
+_CACHE_BLOCKS = 256        # LRU block cache entries (~16MB)
+_FOOTER = b"LSM1"
+
+
+class _Run:
+    """One immutable sorted-run file: block-sparse index in RAM, data
+    blocks read on demand through the shared cache."""
+
+    def __init__(self, fs, path: str, cache: "_BlockCache") -> None:
+        self.path = path
+        self._f = fs.open(path)
+        self._cache = cache
+        size = self._f.size()
+        foot = self._f.read_sync(size - 12, 12)
+        assert foot[8:] == _FOOTER, f"bad run footer in {path}"
+        idx_off = int.from_bytes(foot[:8], "little")
+        self.index = decode(self._f.read_sync(idx_off, size - 12 - idx_off))
+        # index: list of [first_key, offset, length]
+        self.first_keys = [bytes(e[0]) for e in self.index]
+
+    def _block(self, i: int) -> list:
+        key = (self.path, i)
+        blk = self._cache.get(key)
+        if blk is None:
+            _, off, ln = self.index[i]
+            blk = decode(self._f.read_sync(off, ln))
+            self._cache.put(key, blk)
+        return blk
+
+    def get(self, key: bytes) -> tuple[bool, bytes | None]:
+        """(found, value-or-tombstone)."""
+        i = bisect.bisect_right(self.first_keys, key) - 1
+        if i < 0:
+            return False, None
+        blk = self._block(i)
+        keys = [bytes(e[0]) for e in blk]
+        j = bisect.bisect_left(keys, key)
+        if j < len(keys) and keys[j] == key:
+            v = blk[j][1]
+            return True, (bytes(v) if v is not None else None)
+        return False, None
+
+    def iter_range(self, begin: bytes, end: bytes,
+                   reverse: bool = False) -> Iterator[tuple[bytes, bytes | None]]:
+        lo = max(0, bisect.bisect_right(self.first_keys, begin) - 1)
+        hi = bisect.bisect_left(self.first_keys, end)
+        blocks = range(lo, min(hi + 1, len(self.index)))
+        if reverse:
+            blocks = reversed(blocks)
+        for i in blocks:
+            blk = self._block(i)
+            entries = reversed(blk) if reverse else blk
+            for k, v in entries:
+                k = bytes(k)
+                if k < begin or k >= end:
+                    continue
+                yield k, (bytes(v) if v is not None else None)
+
+
+class _BlockCache:
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._d: OrderedDict = OrderedDict()
+
+    def get(self, key):
+        blk = self._d.get(key)
+        if blk is not None:
+            self._d.move_to_end(key)
+        return blk
+
+    def put(self, key, blk) -> None:
+        self._d[key] = blk
+        self._d.move_to_end(key)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+    def drop_file(self, path: str) -> None:
+        for k in [k for k in self._d if k[0] == path]:
+            del self._d[k]
+
+
+class LSMKVStore:
+    """IKeyValueStore-compatible LSM engine (see kv_store.MemoryKVStore
+    for the surface contract)."""
+
+    def __init__(self, fs, prefix: str) -> None:
+        self.fs = fs
+        self.prefix = prefix
+        self.meta: dict = {}
+        self._mem: dict[bytes, bytes | None] = {}   # None = tombstone
+        self._mem_index: list[bytes] = []
+        self._mem_bytes = 0
+        self._runs: list[_Run] = []                 # newest first
+        self._cache = _BlockCache(_CACHE_BLOCKS)
+        self._wal: DiskQueue | None = None
+        self._wal_file = None
+        self._gen = 0
+        self._wal_gen = 0
+
+    # --- lifecycle ---
+
+    @classmethod
+    async def open(cls, fs, prefix: str) -> "LSMKVStore":
+        kv = cls(fs, prefix)
+        mf = fs.open(prefix + ".MANIFEST")
+        blob = await mf.read(0, mf.size())
+        await mf.close()
+        if blob:
+            man = decode(blob)
+            kv.meta = man["meta"]
+            kv._gen = man["gen"]
+            kv._wal_gen = man.get("wal_gen", 0)
+            for path in man["runs"]:
+                kv._runs.append(_Run(fs, str(path), kv._cache))
+        kv._wal_file = fs.open(prefix + ".wal")
+        kv._wal, frames = await DiskQueue.open(kv._wal_file)
+        for frame, _end in frames:
+            rec = decode(frame)
+            if rec["gen"] < kv._wal_gen:
+                continue        # folded into a flushed run already
+            kv._apply_mem(rec["ops"])
+            kv.meta = rec["meta"]
+        kv._mem_index = sorted(kv._mem)
+        return kv
+
+    async def close(self) -> None:
+        if self._wal_file is not None:
+            await self._wal_file.close()
+
+    def __len__(self) -> int:
+        n = 0
+        for _ in self.range(b"", b"\xff\xff\xff\xff"):
+            n += 1
+        return n
+
+    # --- reads ---
+
+    def get(self, key: bytes) -> bytes | None:
+        if key in self._mem:
+            return self._mem[key]
+        for run in self._runs:
+            found, v = run.get(key)
+            if found:
+                return v
+        return None
+
+    def range(self, begin: bytes, end: bytes,
+              reverse: bool = False) -> Iterator[tuple[bytes, bytes]]:
+        """Newest-wins k-way merge of memtable + runs, tombstones elided."""
+        sources: list[Iterator[tuple[bytes, bytes | None]]] = []
+
+        def mem_iter():
+            lo = bisect.bisect_left(self._mem_index, begin)
+            hi = bisect.bisect_left(self._mem_index, end)
+            keys = self._mem_index[lo:hi]
+            if reverse:
+                keys = list(reversed(keys))
+            for k in keys:
+                yield k, self._mem[k]
+
+        sources.append(mem_iter())
+        sources.extend(r.iter_range(begin, end, reverse) for r in self._runs)
+        yield from _merge(sources, reverse)
+
+    # --- writes ---
+
+    def _apply_mem(self, ops: list[tuple[int, bytes, bytes]]) -> None:
+        for op, p1, p2 in ops:
+            if op == OP_SET:
+                old = self._mem.get(p1)
+                self._mem[p1] = p2
+                self._mem_bytes += len(p1) + len(p2) - (len(old) if old else 0)
+            else:
+                # a clear becomes per-key tombstones over every key known
+                # ANYWHERE (memtable or runs) in [p1, p2): point lookups
+                # must see the deletion without a range check
+                for k, _ in list(self.range(p1, p2)):
+                    self._mem[k] = _TOMBSTONE
+                for k in [k for k in self._mem if p1 <= k < p2]:
+                    self._mem[k] = _TOMBSTONE
+
+    async def commit(self, ops: list[tuple[int, bytes, bytes]],
+                     meta: dict) -> None:
+        rec = encode({"gen": self._gen, "ops": ops, "meta": meta})
+        await self._wal.push(rec)
+        await self._wal.commit()
+        self._apply_mem(ops)
+        self.meta = meta
+        self._mem_index = sorted(self._mem)
+        if self._mem_bytes > _MEMTABLE_BYTES:
+            await self._flush()
+        if len(self._runs) > _MAX_RUNS:
+            await self._compact()
+
+    # --- flush / compaction ---
+
+    async def _write_run(self, items: Iterator[tuple[bytes, bytes | None]],
+                         drop_tombstones: bool) -> str | None:
+        self._gen += 1
+        path = f"{self.prefix}.run.{self._gen:08d}"
+        f = self.fs.open(path)
+        await f.truncate(0)
+        off = 0
+        index = []
+        block: list = []
+        bbytes = 0
+
+        async def emit():
+            nonlocal off, block, bbytes
+            if not block:
+                return
+            blob = encode(block)
+            index.append([block[0][0], off, len(blob)])
+            await f.write(off, blob)
+            off += len(blob)
+            block = []
+            bbytes = 0
+
+        wrote = False
+        for k, v in items:
+            if v is None and drop_tombstones:
+                continue
+            wrote = True
+            block.append([k, v])
+            bbytes += len(k) + (len(v) if v else 0)
+            if bbytes >= _BLOCK_BYTES:
+                await emit()
+        await emit()
+        if not wrote:
+            await f.close()
+            self.fs.remove(path)
+            return None
+        idx = encode(index)
+        await f.write(off, idx)
+        await f.write(off + len(idx), off.to_bytes(8, "little") + _FOOTER)
+        await f.sync()
+        await f.close()
+        return path
+
+    async def _write_manifest(self) -> None:
+        mf = self.fs.open(self.prefix + ".MANIFEST")
+        blob = encode({"gen": self._gen, "wal_gen": self._wal_gen,
+                       "meta": self.meta,
+                       "runs": [r.path for r in self._runs]})
+        await mf.write(0, blob)
+        await mf.truncate(len(blob))
+        await mf.sync()
+        await mf.close()
+
+    async def _flush(self) -> None:
+        def items():
+            for k in self._mem_index:
+                yield k, self._mem[k]
+
+        path = await self._write_run(items(), drop_tombstones=not self._runs)
+        if path is not None:
+            self._runs.insert(0, _Run(self.fs, path, self._cache))
+        # WAL records below the new gen are folded into the run
+        self._wal_gen = self._gen
+        await self._write_manifest()
+        await self._wal.pop_to(self._wal.end_offset)
+        self._mem.clear()
+        self._mem_index = []
+        self._mem_bytes = 0
+
+    async def _compact(self) -> None:
+        """Merge every run into one (tombstones drop at the bottom)."""
+        old = list(self._runs)
+        merged = _merge([r.iter_range(b"", b"\xff\xff\xff\xff")
+                         for r in old], reverse=False, keep_tombstones=False)
+        path = await self._write_run(merged, drop_tombstones=True)
+        self._runs = [_Run(self.fs, path, self._cache)] if path else []
+        await self._write_manifest()
+        for r in old:
+            self._cache.drop_file(r.path)
+            self.fs.remove(r.path)
+
+
+def _merge(sources, reverse: bool, keep_tombstones: bool = False):
+    """K-way merge, earlier sources win on equal keys; tombstones elided
+    from the output unless kept (compaction intermediate)."""
+    heap = []
+    for si, it in enumerate(sources):
+        it = iter(it)
+        first = next(it, None)
+        if first is not None:
+            k = first[0]
+            heap.append(((_rk(k) if reverse else k), si, first, it))
+    heapq.heapify(heap)
+    last_key = None
+    while heap:
+        _, si, (k, v), it = heapq.heappop(heap)
+        nxt = next(it, None)
+        if nxt is not None:
+            heapq.heappush(heap, ((_rk(nxt[0]) if reverse else nxt[0]),
+                                  si, nxt, it))
+        if k == last_key:
+            continue            # an older source's version of the same key
+        last_key = k
+        if v is None and not keep_tombstones:
+            continue
+        yield k, v
+
+
+class _rk(bytes):
+    """Reversed byte ordering for descending merges."""
+    __slots__ = ()
+
+    def __lt__(self, other):    # type: ignore[override]
+        return bytes.__gt__(self, other)
