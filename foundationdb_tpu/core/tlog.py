@@ -201,6 +201,15 @@ class TLog:
         if self.locked:
             from ..runtime.errors import TLogStopped
             raise TLogStopped()
+        if req.version <= self.version:
+            # duplicate push: a proxy retrying after an ambiguous result
+            # (RequestMaybeDelivered / chain repair) re-sends a version this
+            # log already holds.  Re-appending would make peeks serve the
+            # version twice and atomic ops apply twice on this replica's
+            # consumers — ack idempotently instead (a version's content is
+            # deterministic for its batch, so the stored copy is identical).
+            self.total_pushes += 1
+            return self.version
         for tag, msgs in req.messages.items():
             if msgs:
                 nbytes = sum(len(m.param1) + len(m.param2) for m in msgs)
@@ -259,15 +268,24 @@ class TLog:
             fut = asyncio.get_running_loop().create_future()
             self._peek_waiters.append(fut)
             await fut
+        # snapshot the tip FIRST and clamp entries to it: a push appends
+        # its slab before bumping the version (with awaits in between when
+        # durability or BUGGIFY stalls land there), and serving an entry
+        # beyond the reported end would make the cursor apply that version
+        # twice on the next peek (replica divergence found by
+        # ConsistencyCheck at sim seed 10)
+        tip = self.version
         st = self._log.get(tag)
         if st is None:
-            return TLogPeekReply([], self.version + 1)
+            return TLogPeekReply([], tip + 1)
         entries: list[tuple[Version, list[Mutation]]] = []
         if begin_version < st.spilled_below and self.queue is not None:
-            entries.extend(await self._peek_spilled(
-                tag, begin_version, st.spilled_below))
-        entries.extend(st.slice_from(max(begin_version, st.spilled_below)))
-        return TLogPeekReply(entries, self.version + 1)
+            entries.extend(e for e in await self._peek_spilled(
+                tag, begin_version, st.spilled_below) if e[0] <= tip)
+        entries.extend(
+            e for e in st.slice_from(max(begin_version, st.spilled_below))
+            if e[0] <= tip)
+        return TLogPeekReply(entries, tip + 1)
 
     async def _peek_spilled(self, tag: Tag, begin: Version,
                             below: Version) -> list:

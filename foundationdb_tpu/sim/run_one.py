@@ -32,8 +32,10 @@ async def simulate(seed: int, kills: int, buggify: bool) -> dict:
     specs = [
         {"testName": "Cycle", "nodeCount": 12, "transactionsPerClient": 30},
         {"testName": "Serializability", "numOps": 40},
+        {"testName": "AtomicOps", "addsPerClient": 15},
         {"testName": "MachineAttrition", "sim": sim, "machinesToKill": kills},
         {"testName": "RandomClogging", "sim": sim, "testDuration": 8.0},
+        {"testName": "ConsistencyCheck"},
     ]
     results = await run_workloads_on(db, specs, client_count=2)
     await sim.stop()

@@ -212,3 +212,24 @@ def test_tlog_spill_and_indexed_peek():
         reply4 = await tlog2.peek(1, 1)
         assert [v for v, _ in reply4.entries] == list(range(1, N + 1))
     run_simulation(main())
+
+
+def test_tlog_duplicate_push_is_idempotent():
+    """A retried push (ambiguous result / chain repair) must not duplicate
+    a version's messages — peeks would serve it twice and downstream
+    atomic ops would double-apply (found by ConsistencyCheck at seed 10)."""
+    from foundationdb_tpu.core.data import Mutation, MutationType
+    from foundationdb_tpu.core.tlog import TLog, TLogPushRequest
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    async def main():
+        tlog = TLog(Knobs())
+        m = [Mutation(MutationType.ADD, b"ctr", b"\x05\x00\x00\x00\x00\x00\x00\x00")]
+        await tlog.push(TLogPushRequest(0, 10, {0: m}))
+        await tlog.push(TLogPushRequest(10, 20, {0: m}))
+        # the retry of version 10 (same content) must be an idempotent ack
+        tip = await tlog.push(TLogPushRequest(0, 10, {0: m}))
+        assert tip == 20
+        reply = await tlog.peek(0, 1)
+        assert [v for v, _ in reply.entries] == [10, 20]
+    run_simulation(main())
