@@ -1,0 +1,108 @@
+"""fdbmonitor analog — supervise server processes from a conf file.
+
+Reference: REF:fdbmonitor/fdbmonitor.cpp + the foundationdb.conf format —
+one lightweight supervisor per machine starts every configured fdbserver
+process, restarts crashed ones with backoff, and tears the family down on
+SIGTERM.
+
+Conf format (ini, a subset of foundationdb.conf):
+
+    [general]
+    cluster-file = /etc/fdb.cluster
+    restart-delay = 2
+
+    [fdbserver.4500]
+    listen = 127.0.0.1:4500
+    spec = min_workers=3
+
+Run: ``python -m foundationdb_tpu.monitor -C fdbmonitor.conf``
+"""
+
+from __future__ import annotations
+
+import argparse
+import configparser
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+class Monitor:
+    def __init__(self, conf_path: str) -> None:
+        cp = configparser.ConfigParser()
+        if not cp.read(conf_path):
+            raise SystemExit(f"cannot read conf file {conf_path}")
+        g = cp["general"] if "general" in cp else {}
+        self.cluster_file = g.get("cluster-file", "fdb.cluster")
+        self.restart_delay = float(g.get("restart-delay", 2.0))
+        self.servers: list[dict] = []
+        for section in cp.sections():
+            if not section.startswith("fdbserver."):
+                continue
+            s = cp[section]
+            self.servers.append({
+                "id": section.split(".", 1)[1],
+                "listen": s["listen"],
+                "spec": s.get("spec", ""),
+            })
+        if not self.servers:
+            raise SystemExit("conf names no [fdbserver.*] sections")
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.restarts: dict[str, int] = {}
+        self._stopping = False
+
+    def _spawn(self, srv: dict) -> None:
+        cmd = [sys.executable, "-m", "foundationdb_tpu.server",
+               "-C", self.cluster_file, "-l", srv["listen"]]
+        if srv["spec"]:
+            cmd += ["--spec", srv["spec"]]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.procs[srv["id"]] = subprocess.Popen(cmd, env=env)
+        print(f"[fdbmonitor] started fdbserver.{srv['id']} "
+              f"pid={self.procs[srv['id']].pid}", file=sys.stderr, flush=True)
+
+    def run(self) -> int:
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, self._on_signal)
+        for srv in self.servers:
+            self._spawn(srv)
+        while not self._stopping:
+            time.sleep(0.5)
+            for srv in self.servers:
+                p = self.procs.get(srv["id"])
+                if p is not None and p.poll() is not None and not self._stopping:
+                    self.restarts[srv["id"]] = \
+                        self.restarts.get(srv["id"], 0) + 1
+                    print(f"[fdbmonitor] fdbserver.{srv['id']} exited "
+                          f"rc={p.returncode}; restarting in "
+                          f"{self.restart_delay}s", file=sys.stderr, flush=True)
+                    time.sleep(self.restart_delay)
+                    if not self._stopping:
+                        self._spawn(srv)
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        return 0
+
+    def _on_signal(self, _sig, _frame) -> None:
+        self._stopping = True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="foundationdb_tpu.monitor")
+    ap.add_argument("-C", "--conffile", default="fdbmonitor.conf")
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+    return Monitor(args.conffile).run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

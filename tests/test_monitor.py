@@ -1,0 +1,86 @@
+"""fdbmonitor: supervise, restart crashed servers, clean teardown."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from foundationdb_tpu.core.cluster_file import ClusterFile
+from foundationdb_tpu.rpc.transport import NetworkAddress
+
+from test_server import free_ports
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_monitor_restarts_crashed_server(tmp_path):
+    ports = free_ports(3)
+    cf = ClusterFile("mon", "t1",
+                     [NetworkAddress("127.0.0.1", p) for p in ports])
+    cf_path = tmp_path / "fdb.cluster"
+    cf.save(str(cf_path))
+    conf = tmp_path / "fdbmonitor.conf"
+    conf.write_text(
+        "[general]\n"
+        f"cluster-file = {cf_path}\n"
+        "restart-delay = 0.5\n"
+        + "".join(f"[fdbserver.{p}]\nlisten = 127.0.0.1:{p}\n"
+                  "spec = min_workers=3\n" for p in ports))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    mon = subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.monitor", "-C", str(conf)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        # wait until the cluster serves (smoke through the CLI path)
+        import asyncio
+
+        from foundationdb_tpu.cli import open_cli
+        from foundationdb_tpu.runtime.knobs import Knobs
+
+        async def smoke():
+            cli = await open_cli(str(cf_path), Knobs(), timeout=60)
+            assert await cli.execute("set mk mv") == "Committed"
+
+        asyncio.run(smoke())
+
+        # find and SIGKILL one child fdbserver; the monitor must respawn it
+        out = subprocess.run(
+            ["pgrep", "-f", f"foundationdb_tpu.server.*{ports[2]}"],
+            capture_output=True, text=True)
+        pids = [int(x) for x in out.stdout.split()]
+        assert pids, "child server not found"
+        os.kill(pids[0], signal.SIGKILL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            out = subprocess.run(
+                ["pgrep", "-f", f"foundationdb_tpu.server.*{ports[2]}"],
+                capture_output=True, text=True)
+            new = [int(x) for x in out.stdout.split()]
+            if new and new[0] != pids[0]:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("monitor never restarted the killed server")
+
+        # cluster still serves after the restart
+        async def smoke2():
+            cli = await open_cli(str(cf_path), Knobs(), timeout=60)
+            out = await cli.execute("get mk")
+            assert out == "`mk' is `mv'", out
+
+        asyncio.run(smoke2())
+    finally:
+        mon.send_signal(signal.SIGTERM)
+        try:
+            mon.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            mon.kill()
+            mon.communicate()
+        # no orphan servers
+        time.sleep(1)
+        out = subprocess.run(["pgrep", "-f", f"cluster-file.*{cf_path}"],
+                             capture_output=True, text=True)
