@@ -109,6 +109,17 @@ class Cli:
                 return f"Backup complete: {m.rows} rows at version {m.version}"
             m = await agent.restore()
             return f"Restore complete: {m.rows} rows (snapshot version {m.version})"
+        if cmd in ("exclude", "include"):
+            from .core import management
+
+            async def do(tr):
+                for a in args:
+                    if cmd == "exclude":
+                        tr.set(management.excluded_key(a), b"1")
+                    else:
+                        tr.clear(management.excluded_key(a))
+            await self.run_txn(do)
+            return f"Servers {cmd}d (takes effect at the next recovery)"
         if cmd == "configure":
             from .core.system_data import CONF_FIELDS, conf_key
 

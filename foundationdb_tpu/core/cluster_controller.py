@@ -157,11 +157,13 @@ class ClusterController:
         # read): \xff/conf/ overrides the recruitment spec and
         # \xff/keyServers/layout carries DataDistribution's desired shard
         # layout, both written by ordinary transactions ----
-        spec, layout = await self._read_system_state(prev_state, spec)
+        spec, layout, excluded = await self._read_system_state(
+            prev_state, spec)
 
         # ---- recruit the new transaction subsystem ----
         self.recovery_state = "RECRUITING"
-        live = self._live_workers()
+        live = [(a, w) for a, w in self._live_workers()
+                if f"{a.ip}:{a.port}" not in excluded]
         # min_workers gates only the INITIAL cluster creation (so recruits
         # spread over the fleet instead of piling onto the first
         # registrant); later epochs recover with whoever survives
@@ -358,7 +360,7 @@ class ClusterController:
         from .system_data import (KEY_SERVERS_PREFIX, decode_conf,
                                   spec_with_conf)
         if not prev_state:
-            return spec, None
+            return spec, None, set()
         sys_end = SYSTEM_PREFIX + b"\xfe"
         for s in prev_state.get("storage", []):
             if not (s["begin"] <= SYSTEM_PREFIX < s["end"]):
@@ -377,6 +379,8 @@ class ClusterController:
                 continue
             rows = [(bytes(k), bytes(v)) for k, v in rows]
             conf = decode_conf(rows)
+            from .management import decode_excluded
+            excluded = decode_excluded(rows)
             layout = None
             for key, v in rows:
                 if key == KEY_SERVERS_PREFIX + b"layout":
@@ -384,12 +388,13 @@ class ClusterController:
                         layout = decode(v)
                     except Exception:  # noqa: BLE001 — bad layout ignored
                         layout = None
-            if conf or layout:
+            if conf or layout or excluded:
                 TraceEvent("RecoveryReadSystemState") \
                     .detail("Conf", str(conf)) \
+                    .detail("Excluded", sorted(excluded)) \
                     .detail("HasLayout", layout is not None).log()
-            return spec_with_conf(spec, conf), layout
-        return spec, None
+            return spec_with_conf(spec, conf), layout, excluded
+        return spec, None, set()
 
     @staticmethod
     def _wire_gen(g: dict) -> dict:

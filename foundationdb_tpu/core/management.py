@@ -1,0 +1,58 @@
+"""ManagementAPI — operational mutations through the system keyspace.
+
+Reference: REF:fdbclient/ManagementAPI.actor.cpp — configuration changes,
+server exclusion/inclusion and status all ride ordinary transactions over
+``\\xff`` keys; the controller materializes them at recovery.
+
+Exclusion semantics v1 (matching the reference's intent): an excluded
+address stops being a recruitment target for transaction-subsystem roles
+at the next recovery.  Storage replicas already resident there keep
+serving until DataDistribution (or an operator move) relocates them —
+exclusion never silently drops data.
+"""
+
+from __future__ import annotations
+
+from .system_data import CONF_PREFIX, conf_key
+
+EXCLUDED_PREFIX = CONF_PREFIX + b"excluded/"
+
+
+def excluded_key(addr: str) -> bytes:
+    """addr: "ip:port" (a worker's listen address)."""
+    return EXCLUDED_PREFIX + addr.encode()
+
+
+def decode_excluded(rows: list[tuple[bytes, bytes]]) -> set[str]:
+    out = set()
+    for k, v in rows:
+        if k.startswith(EXCLUDED_PREFIX) and v:
+            out.add(k[len(EXCLUDED_PREFIX):].decode(errors="replace"))
+    return out
+
+
+async def exclude_servers(db, addrs: list[str]) -> None:
+    """Mark addresses excluded (takes effect at the next recovery)."""
+    async def do(tr):
+        for a in addrs:
+            tr.set(excluded_key(a), b"1")
+    await db.run(do)
+
+
+async def include_servers(db, addrs: list[str]) -> None:
+    async def do(tr):
+        for a in addrs:
+            tr.clear(excluded_key(a))
+    await db.run(do)
+
+
+async def configure(db, **fields: int) -> None:
+    """configure(resolvers=2, logs=3, ...) — the fdbcli configure analog."""
+    from .system_data import CONF_FIELDS
+
+    async def do(tr):
+        for name, val in fields.items():
+            if name not in CONF_FIELDS:
+                raise ValueError(f"unknown configure field {name!r}")
+            tr.set(conf_key(name), str(int(val)).encode())
+    await db.run(do)

@@ -394,3 +394,52 @@ def test_conf_keys_take_effect_next_recovery():
         assert await read_kv(view2, items) == items
         await cc.stop()
     run_simulation(main())
+
+
+def test_excluded_worker_gets_no_txn_roles():
+    """ManagementAPI exclusion: an excluded worker must receive no
+    transaction-subsystem recruit at the next recovery
+    (REF:fdbclient/ManagementAPI.actor.cpp excludeServers)."""
+    async def main():
+        from foundationdb_tpu.core.management import exclude_servers
+
+        k = Knobs()
+        sim = SimCluster(k)
+        cc = sim.make_cc(ClusterConfigSpec())
+        _, prev = await cc.cstate.read()
+        state = await cc.recover_once(prev)
+        view = await sim.client_view()
+        await commit_kv(view, {b"x": b"1"})
+
+        victim = sim.worker_addrs[3]        # hosts the resolver in epoch 1
+        assert [victim.ip, victim.port] == state["resolvers"][0]["addr"]
+
+        class _Db:
+            async def run(self, fn):
+                await commit_kv_fn(view, fn)
+        async def commit_kv_fn(view, fn):
+            tr = Transaction(view)
+            while True:
+                try:
+                    await fn(tr)
+                    await tr.commit()
+                    return
+                except FdbError as e:
+                    await tr.on_error(e)
+        await exclude_servers(_Db(), [f"{victim.ip}:{victim.port}"])
+        await asyncio.sleep(1.0)            # let storage apply
+
+        _, prev2 = await cc.cstate.read()
+        state2 = await cc.recover_once(prev2)
+        placed = {tuple(state2["sequencer"]["addr"])}
+        placed |= {tuple(a) for a in state2["log_cfg"][-1]["tlogs"]}
+        placed |= {tuple(r["addr"]) for r in state2["resolvers"]}
+        placed |= {tuple(p["addr"]) for p in
+                   state2["commit_proxies"] + state2["grv_proxies"]}
+        placed.add(tuple(state2["ratekeeper"]["addr"]))
+        assert (victim.ip, victim.port) not in placed, placed
+        # the cluster still serves and old data is intact
+        view2 = await sim.client_view()
+        assert await read_kv(view2, [b"x"]) == {b"x": b"1"}
+        await cc.stop()
+    run_simulation(main())
