@@ -71,6 +71,13 @@ class ClusterController:
         self.epoch = 0
         self.recovery_state = "READING_CSTATE"
         self.last_state: dict | None = None
+        # storage tags resident on registered workers' disks (reboot
+        # adoption; maintained by the cluster host)
+        self.resident: dict[int, tuple[NetworkAddress, int]] = {}
+        # tags successfully rejoined/recruited in the current epoch: a
+        # registration reporting a resident tag OUTSIDE this set asks for
+        # a recovery (the replica is stranded until rejoined)
+        self.active_tags: set[int] = set()
         self._recovery_requested: asyncio.Event = asyncio.Event()
         self._stopped = False
 
@@ -213,6 +220,7 @@ class ClusterController:
         self.recovery_state = "REJOINING"
         wire_log_cfg = [self._wire_gen(g) for g in log_cfg]
         storage_meta: list[dict] = []
+        active_tags: set[int] = set()
         if prev_state:
             boundaries = (layout or {}).get(
                 "boundaries", prev_state["shard_boundaries"])
@@ -231,8 +239,28 @@ class ClusterController:
                             continue
                         rejoined.add(tag)
                         s = dict(ps)
-                        storage_meta.append(s)
                         wa = NetworkAddress(s["worker"][0], s["worker"][1])
+                        # a replica whose machine died or rebooted lives on
+                        # through its disk: when a registered worker
+                        # reports the tag resident at a DIFFERENT location/
+                        # token than the stale meta (a rebooted incarnation
+                        # serves at a fresh random token), adopt the
+                        # resident copy (REF:fdbserver/worker.actor.cpp
+                        # storage rejoin after reboot)
+                        res = self.resident.get(tag)
+                        if res is not None and self.fm.is_available(res[0]) \
+                                and res[0] in self.workers \
+                                and (not self.fm.is_available(wa)
+                                     or (res[0], res[1])
+                                     != (wa, s["token"])):
+                            s["worker"] = [res[0].ip, res[0].port]
+                            s["addr"] = [res[0].ip, res[0].port]
+                            s["token"] = res[1]
+                            wa = res[0]
+                            TraceEvent("StorageAdopted") \
+                                .detail("Tag", tag) \
+                                .detail("Worker", str(res[0])).log()
+                        storage_meta.append(s)
                         w = self.workers.get(wa)
                         if w is None:
                             if self.fm.is_available(wa):
@@ -243,12 +271,22 @@ class ClusterController:
                                 raise FdbError("waiting for storage workers")
                             continue   # dead: reads fail over to its team
                         if not self.fm.is_available(wa):
+                            # skipped now; a registration reporting the tag
+                            # resident re-triggers recovery via active_tags
                             continue
                         try:
-                            await asyncio.wait_for(
+                            ok = await asyncio.wait_for(
                                 w.rejoin_storage(s["token"], wire_log_cfg, rv),
                                 timeout=k.FAILURE_TIMEOUT * 4)
-                        except (FdbError, asyncio.TimeoutError):
+                            if not ok:
+                                # the worker no longer hosts that token (a
+                                # rebooted incarnation): stranding the
+                                # replica silently would hide data loss —
+                                # fail and retry (the resident report will
+                                # enable adoption)
+                                raise FdbError("storage role missing at token")
+                            active_tags.add(tag)
+                        except asyncio.TimeoutError:
                             TraceEvent("StorageRejoinFailed", severity=30) \
                                 .detail("Tag", s["tag"]).log()
                     else:
@@ -278,6 +316,7 @@ class ClusterController:
                             "worker": [wa.ip, wa.port], "addr": a,
                             "token": t, "tag": tag,
                             "begin": rng.begin, "end": rng.end})
+                        active_tags.add(tag)
                         TraceEvent("StorageMoveRecruited").detail("Tag", tag) \
                             .detail("Begin", rng.begin).detail("End", rng.end).log()
         else:
@@ -298,6 +337,7 @@ class ClusterController:
                         "worker": [wa.ip, wa.port], "addr": a,
                         "token": t, "tag": tag,
                         "begin": rng.begin, "end": rng.end})
+                    active_tags.add(tag)
 
         # ---- ratekeeper (admission control over the new storage set) ----
         rk_addr, rk_tok = await self._recruit(pick(7), "ratekeeper", {
@@ -341,6 +381,7 @@ class ClusterController:
         }
         await self.cstate.write(state)
         self.last_state = state
+        self.active_tags = active_tags
         self.recovery_state = "ACCEPTING_COMMITS"
         TraceEvent("RecoveryComplete").detail("Epoch", new_epoch) \
             .detail("RecoveryVersion", rv).log()

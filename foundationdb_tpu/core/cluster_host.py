@@ -37,7 +37,8 @@ class ClusterHost:
     def __init__(self, host_id: int, knobs: Knobs, transport: Transport,
                  client_transport_factory: Callable[[], Transport],
                  base_token: int, coordinators: list,
-                 spec: ClusterConfigSpec | None = None) -> None:
+                 spec: ClusterConfigSpec | None = None,
+                 fs=None, data_dir: str = "data") -> None:
         self.id = host_id
         self.knobs = knobs
         self.transport = transport
@@ -46,7 +47,9 @@ class ClusterHost:
         self.coordinators = coordinators
         self.spec = spec or ClusterConfigSpec()
         self.worker = Worker(host_id, knobs, transport,
-                             client_transport_factory, base_token)
+                             client_transport_factory, base_token,
+                             fs=fs, data_dir=data_dir)
+        self._resident_map: dict[int, tuple[NetworkAddress, int]] = {}
         self._client_t = client_transport_factory()
         self._registry: dict[NetworkAddress, WorkerClient] = {}
         self._leading = False
@@ -62,15 +65,35 @@ class ClusterHost:
 
     # --- CC RPC surface (live on every host; meaningful when leading) ---
 
-    async def register_worker(self, addr: list, worker_token: int) -> bool:
+    async def register_worker(self, addr: list, worker_token: int,
+                              resident: dict | None = None) -> bool:
         """RegisterWorkerRequest analog; False tells the caller this host
-        is not (or no longer) the cluster controller."""
+        is not (or no longer) the cluster controller.  ``resident`` maps
+        storage tags this worker holds on disk to their serving tokens, so
+        a rebooted machine's replicas can be adopted back."""
         if not self._leading:
             return False
         wa = NetworkAddress(addr[0], addr[1])
         if wa not in self._registry:
             self._registry[wa] = WorkerClient(self._client_t, wa, worker_token)
             TraceEvent("CCRegisteredWorker").detail("Worker", str(wa)).log()
+        if resident and self.cc is not None:
+            new_tags = []
+            for tag, token in resident.items():
+                tag = int(tag)
+                self._resident_map[tag] = (wa, int(token))
+                self.cc.resident = self._resident_map
+                state = self.cc.last_state
+                if state is not None and tag not in self.cc.active_tags:
+                    # the database needs this tag and no live copy was
+                    # rejoined in the current epoch: recover to adopt it
+                    needed = {s["tag"] for s in state["storage"]}
+                    if tag in needed:
+                        new_tags.append(tag)
+            if new_tags:
+                # a dead replica's data is back on a live machine: recover
+                # so the next epoch adopts + rejoins it
+                self.cc.request_recovery(f"storage_rejoin tags={new_tags}")
         return True
 
     async def get_cluster_state(self) -> dict | None:
@@ -101,6 +124,7 @@ class ClusterHost:
 
     async def run(self) -> None:
         k = self.knobs
+        await self.worker.open_resident()
         me = [self.address.ip, self.address.port]
         while not self._stopped:
             try:
@@ -121,9 +145,12 @@ class ClusterHost:
         self._registry.clear()
         self._registry[self.address] = WorkerClient(
             self._client_t, self.address, self.worker.base)
+        for tag, token in self.worker.resident.items():
+            self._resident_map[tag] = (self.address, token)
         cstate = CoordinatedState(self.coordinators, self.id)
         self.cc = ClusterController(k, self.make_client_transport(), cstate,
                                     self._registry, self.spec, self.base)
+        self.cc.resident = self._resident_map
         self._leading = True
         cc_task = asyncio.get_running_loop().create_task(
             self._run_cc(), name=f"cc-{self.id}")
@@ -192,7 +219,8 @@ class ClusterHost:
         while not self._stopped:
             try:
                 ok = await asyncio.wait_for(
-                    stub.register_worker(me, self.worker.base),
+                    stub.register_worker(me, self.worker.base,
+                                         dict(self.worker.resident)),
                     timeout=k.FAILURE_TIMEOUT * 2)
             except (Exception, asyncio.TimeoutError):
                 ok = False

@@ -88,14 +88,56 @@ class Worker:
 
     def __init__(self, worker_id: int, knobs: Knobs, transport: Transport,
                  client_transport_factory: Callable[[], Transport],
-                 base_token: int) -> None:
+                 base_token: int, fs=None, data_dir: str = "data") -> None:
         self.id = worker_id
         self.knobs = knobs
         self.transport = transport
         self.make_client_transport = client_transport_factory
         self.base = base_token
+        self.fs = fs                   # durable roles when set
+        self.data_dir = data_dir
         self.roles: dict[int, tuple[str, Any]] = {}   # token -> (role, obj)
+        self.resident: dict[int, int] = {}            # storage tag -> token
         serve_role(transport, "worker", self, base_token)
+
+    def _engine_cls(self):
+        from ..storage.kv_store import MemoryKVStore
+        from ..storage.lsm import LSMKVStore
+        return {"memory": MemoryKVStore,
+                "lsm": LSMKVStore}[self.knobs.STORAGE_ENGINE]
+
+    async def open_resident(self) -> dict[int, int]:
+        """Reboot path: reopen every storage engine found on this
+        machine's disk as a DORMANT storage server (no log system yet) and
+        report {tag: token} so the cluster controller can adopt the
+        replicas back at its next recovery
+        (REF:fdbserver/worker.actor.cpp restoring rebooted storage roles)."""
+        if self.fs is None:
+            return {}
+        prefix = f"{self.data_dir}/storage-"
+        tags = set()
+        for path in self.fs.listdir(prefix):
+            rest = path[len(prefix):]
+            tag = rest.split(".", 1)[0]
+            if tag.isdigit():
+                tags.add(int(tag))
+        for tag in sorted(tags):
+            engine = await self._engine_cls().open(
+                self.fs, f"{self.data_dir}/storage-{tag}")
+            meta = engine.meta
+            if "shard" not in meta:
+                continue     # never completed a durability tick: useless
+            shard = KeyRange(bytes(meta["shard"][0]), bytes(meta["shard"][1]))
+            ls = LogSystem([LogGeneration(epoch=0, begin_version=0,
+                                          tlogs=[], replication=1)])
+            ss = StorageServer(self.knobs, tag, shard, ls, engine=engine)
+            token = self._alloc_block()
+            serve_role(self.transport, "storage", ss, token)
+            self.roles[token] = ("storage", ss)
+            self.resident[tag] = token
+            TraceEvent("WorkerResidentStorage").detail("Worker", self.id) \
+                .detail("Tag", tag).detail("Token", token).log()
+        return dict(self.resident)
 
     @property
     def address(self):
@@ -121,6 +163,12 @@ class Worker:
         k = self.knobs
         token = self._alloc_block()
         obj = self._build_role(role, params or {}, k)
+        if role == "storage" and self.fs is not None:
+            # durable storage: attach a disk engine (memory engines stay
+            # for diskless deployments)
+            obj.engine = await self._engine_cls().open(
+                self.fs, f"{self.data_dir}/storage-{params['tag']}")
+            self.resident[params["tag"]] = token
         serve_role(self.transport, role, obj, token)
         self.roles[token] = (role, obj)
         if hasattr(obj, "start"):
@@ -142,7 +190,8 @@ class Worker:
 
     async def rejoin_storage(self, token: int, log_cfg: list,
                              recovery_version: int) -> bool:
-        """Point a hosted storage server at a recovered log system."""
+        """Point a hosted storage server at a recovered log system; a
+        dormant (reboot-resident) server starts pulling here."""
         entry = self.roles.get(token)
         if entry is None or entry[0] != "storage":
             return False
@@ -150,6 +199,8 @@ class Worker:
         gens = generations_from_config(log_cfg, self.make_client_transport(),
                                        self.base)
         await ss.rejoin(gens, recovery_version)
+        if ss._pull_task is None:
+            ss.start()
         return True
 
     async def list_roles(self) -> list[tuple[int, str]]:

@@ -73,9 +73,11 @@ class SimMachine:
         # two incarnations in the same election
         host_id = self.index + 100 * self._boots
         self._boots += 1
-        self.host = ClusterHost(host_id, self.sim.knobs, transport,
-                                self._client_transport, BASE, coord_stubs,
-                                self.sim.spec)
+        self.host = ClusterHost(
+            host_id, self.sim.knobs, transport, self._client_transport,
+            BASE, coord_stubs, self.sim.spec,
+            fs=self.fs if self.sim.durable_storage else None,
+            data_dir="data")
         self.host.start()
         self.alive = True
 
@@ -101,7 +103,9 @@ class SimulatedCluster:
 
     def __init__(self, knobs: Knobs | None = None, n_machines: int = 6,
                  n_coordinators: int = 3,
-                 spec: ClusterConfigSpec | None = None) -> None:
+                 spec: ClusterConfigSpec | None = None,
+                 durable_storage: bool = False) -> None:
+        self.durable_storage = durable_storage
         # sim-scale resolver shapes: the numpy conflict twin scans the
         # whole ever-written ring per batch, and append-slab rings consume
         # B*R slots per batch — production-sized shapes (64x8 over 2^16

@@ -101,7 +101,7 @@ print("PY-OVER-C OK")
 '''
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     r = subprocess.run([sys.executable, "-c", script], env=env,
-                       capture_output=True, text=True, timeout=120)
+                       capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "PY-OVER-C OK" in r.stdout
 
@@ -122,6 +122,6 @@ async def main():
 asyncio.run(main())
 '''
     r2 = subprocess.run([sys.executable, "-c", script2], env=env,
-                        capture_output=True, text=True, timeout=120)
+                        capture_output=True, text=True, timeout=300)
     assert r2.returncode == 0, f"stdout={r2.stdout}\nstderr={r2.stderr}"
     assert "NATIVE-XCHECK OK" in r2.stdout
