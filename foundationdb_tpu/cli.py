@@ -156,9 +156,9 @@ class Cli:
 
 
 async def open_cli(cluster_file: str, knobs: Knobs,
-                   timeout: float = 30.0) -> Cli:
+                   timeout: float = 30.0, tls=None) -> Cli:
     cf = ClusterFile.load(cluster_file)
-    t = TcpTransport(NetworkAddress("127.0.0.1", 0))
+    t = TcpTransport(NetworkAddress("127.0.0.1", 0), tls=tls)
     coords = [CoordinatorClient(t, a, WLTOKEN_COORDINATOR)
               for a in cf.coordinators]
     deadline = asyncio.get_running_loop().time() + timeout
@@ -175,7 +175,11 @@ async def open_cli(cluster_file: str, knobs: Knobs,
 
 async def amain(args) -> int:
     knobs = Knobs()
-    cli = await open_cli(args.cluster_file, knobs)
+    tls = None
+    if args.tls_cert:
+        from .rpc.tcp_transport import TlsConfig
+        tls = TlsConfig(args.tls_cert, args.tls_key, args.tls_ca)
+    cli = await open_cli(args.cluster_file, knobs, tls=tls)
     if args.exec:
         for line in args.exec.split(";"):
             out = await cli.execute(line.strip())
@@ -200,6 +204,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="foundationdb_tpu.cli")
     ap.add_argument("-C", "--cluster-file", required=True)
     ap.add_argument("--exec", default="", help="semicolon-separated commands")
+    ap.add_argument("--tls-cert", default="")
+    ap.add_argument("--tls-key", default="")
+    ap.add_argument("--tls-ca", default="")
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
     return asyncio.run(amain(args))
 

@@ -1,7 +1,10 @@
-"""Real asyncio TCP transport with packet framing.
+"""Real asyncio TCP transport with packet framing (optionally over TLS).
 
-Reference: REF:fdbrpc/FlowTransport.actor.cpp — persistent connections
-per peer, length-prefixed packets with a checksum, automatic reconnect.
+Reference: REF:fdbrpc/FlowTransport.actor.cpp + REF:fdbrpc/TLSConnection —
+persistent connections per peer, length-prefixed packets with a checksum,
+automatic reconnect.  With a ``TlsConfig`` every listener requires client
+certificates and every outbound connection verifies the peer against the
+shared CA (mutual TLS, the reference's fdb_tls_* model).
 Frame: [u32 len][u32 crc32][u64 token][u64 reply_id][u8 kind][payload].
 kind: 0=request, 1=reply-ok, 2=reply-error (payload = varint error code),
 3=one-way.
@@ -10,7 +13,9 @@ kind: 0=request, 1=reply-ok, 2=reply-error (payload = varint error code),
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import itertools
+import ssl as ssl_mod
 import struct
 import zlib
 from typing import Any
@@ -20,6 +25,29 @@ from .transport import Endpoint, NetworkAddress, Transport
 from .wire import decode, encode
 
 _HDR = struct.Struct("<IIQQB")
+
+
+@dataclasses.dataclass
+class TlsConfig:
+    """Mutual-TLS material (fdb_tls_certificate_file/_key_file/_ca_file)."""
+    cert_file: str
+    key_file: str
+    ca_file: str
+    verify_hostname: bool = False    # clusters dial IPs; identity = the CA
+
+    def server_context(self) -> ssl_mod.SSLContext:
+        ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        ctx.load_verify_locations(self.ca_file)
+        ctx.verify_mode = ssl_mod.CERT_REQUIRED
+        return ctx
+
+    def client_context(self) -> ssl_mod.SSLContext:
+        ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        ctx.load_verify_locations(self.ca_file)
+        ctx.check_hostname = self.verify_hostname
+        return ctx
 
 
 class _Peer:
@@ -33,8 +61,10 @@ class _Peer:
 
 
 class TcpTransport(Transport):
-    def __init__(self, address: NetworkAddress) -> None:
+    def __init__(self, address: NetworkAddress,
+                 tls: TlsConfig | None = None) -> None:
         super().__init__(address)
+        self.tls = tls
         self._server: asyncio.AbstractServer | None = None
         self._peers: dict[NetworkAddress, _Peer] = {}
         self._reply_ids = itertools.count(1)
@@ -42,7 +72,8 @@ class TcpTransport(Transport):
 
     async def listen(self) -> None:
         self._server = await asyncio.start_server(
-            self._on_connection, self.address.ip, self.address.port)
+            self._on_connection, self.address.ip, self.address.port,
+            ssl=self.tls.server_context() if self.tls else None)
 
     async def _on_connection(self, reader, writer) -> None:
         await self._read_loop(_Peer(reader, writer), None)
@@ -57,8 +88,12 @@ class TcpTransport(Transport):
         if peer is not None and not peer.writer.is_closing():
             return peer
         try:
-            reader, writer = await asyncio.open_connection(addr.ip, addr.port)
-        except OSError as e:
+            reader, writer = await asyncio.open_connection(
+                addr.ip, addr.port,
+                ssl=self.tls.client_context() if self.tls else None,
+                server_hostname=addr.ip if self.tls
+                and self.tls.verify_hostname else None)
+        except (OSError, ssl_mod.SSLError) as e:
             raise ConnectionFailed(str(e)) from None
         peer = _Peer(reader, writer)
         self._peers[addr] = peer

@@ -44,6 +44,9 @@ def parse_args(argv):
     ap.add_argument("-l", "--listen", required=True, metavar="IP:PORT")
     ap.add_argument("--spec", default="", help="role counts, e.g. "
                     "logs=2,resolvers=1,storage_servers=2,min_workers=3")
+    ap.add_argument("--tls-cert", default="", help="mutual TLS certificate")
+    ap.add_argument("--tls-key", default="")
+    ap.add_argument("--tls-ca", default="")
     args, extra = ap.parse_known_args(argv)
     knob_overrides = {}
     for e in extra:
@@ -67,12 +70,13 @@ def parse_spec(text: str) -> ClusterConfigSpec:
 
 
 async def run_server(cluster_file: str, listen: str, spec: ClusterConfigSpec,
-                     knobs: Knobs, ready_event: asyncio.Event | None = None):
+                     knobs: Knobs, ready_event: asyncio.Event | None = None,
+                     tls=None):
     cf = ClusterFile.load(cluster_file)
     ip, _, port = listen.rpartition(":")
     addr = NetworkAddress(ip, int(port))
 
-    transport = TcpTransport(addr)
+    transport = TcpTransport(addr, tls=tls)
     await transport.listen()
 
     # outbound-only client transports: a unique address identity each, no
@@ -80,7 +84,8 @@ async def run_server(cluster_file: str, listen: str, spec: ClusterConfigSpec,
     counter = itertools.count(1)
 
     def client_transport() -> TcpTransport:
-        return TcpTransport(NetworkAddress(ip, int(port) * 1000 + next(counter)))
+        return TcpTransport(
+            NetworkAddress(ip, int(port) * 1000 + next(counter)), tls=tls)
 
     if addr in cf.coordinators:
         # the coordinator shares the process transport with the worker, so
@@ -116,8 +121,13 @@ def main(argv=None) -> int:
     args, knob_overrides = parse_args(argv if argv is not None else sys.argv[1:])
     knobs = Knobs().set_from_strings(knob_overrides)
     spec = parse_spec(args.spec)
+    tls = None
+    if args.tls_cert:
+        from .rpc.tcp_transport import TlsConfig
+        tls = TlsConfig(args.tls_cert, args.tls_key, args.tls_ca)
     try:
-        asyncio.run(run_server(args.cluster_file, args.listen, spec, knobs))
+        asyncio.run(run_server(args.cluster_file, args.listen, spec, knobs,
+                               tls=tls))
     except KeyboardInterrupt:
         pass
     return 0
