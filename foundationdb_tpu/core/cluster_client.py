@@ -35,11 +35,13 @@ class RecoveredClusterView:
         self.knobs = knobs
         self.transport = transport
         self.epoch = -1
+        self.seq = -1
         self.update(state)
 
     def update(self, state: dict) -> None:
-        """(Re)build stubs from a (possibly newer) cluster state."""
-        if state["epoch"] <= self.epoch:
+        """(Re)build stubs from a (possibly newer) cluster state.  A live
+        shard move publishes the same epoch with a higher ``seq``."""
+        if (state["epoch"], state.get("seq", 0)) <= (self.epoch, self.seq):
             return
         t = self.transport
 
@@ -47,6 +49,7 @@ class RecoveredClusterView:
             return NetworkAddress(a[0], a[1])
 
         self.epoch = state["epoch"]
+        self.seq = state.get("seq", 0)
         self.commit_proxies = [
             CommitProxyClient(t, addr(p["addr"]), p["token"])
             for p in state["commit_proxies"]]
@@ -108,7 +111,8 @@ async def fetch_cluster_state(coordinators: list) -> dict:
     for r in replies:
         if isinstance(r, BaseException) or not r:
             continue
-        if best is None or r.get("epoch", 0) > best.get("epoch", 0):
+        if best is None or (r.get("epoch", 0), r.get("seq", 0)) > \
+                (best.get("epoch", 0), best.get("seq", 0)):
             best = r
     if best is None:
         raise FdbError("no coordinator returned a cluster state")

@@ -222,12 +222,26 @@ class ClusterController:
         storage_meta: list[dict] = []
         active_tags: set[int] = set()
         if prev_state:
+            prev_storage = list(prev_state["storage"])
+            if layout:
+                from .system_data import (flip_move_dest_entries,
+                                          normalize_layout)
+                # a flipped-but-unpublished live move's destinations are
+                # known only to the layout's move journal; merge them so
+                # they rejoin instead of being refetched from sources
+                # that already dropped the range
+                known = {s["tag"] for s in prev_storage}
+                prev_storage += [d for d in flip_move_dest_entries(layout)
+                                 if d["tag"] not in known]
+                # in-flight (dual-tagged) moves roll BACK to their source
+                # team; flipped moves roll forward
+                layout = normalize_layout(layout)
             boundaries = (layout or {}).get(
                 "boundaries", prev_state["shard_boundaries"])
             teams = (layout or {}).get("teams", prev_state["shard_teams"])
             shard_map = ShardMap([bytes(b) for b in boundaries],
                                  [list(t) for t in teams])
-            prev_by_tag = {s["tag"]: s for s in prev_state["storage"]}
+            prev_by_tag = {s["tag"]: s for s in prev_storage}
             rejoined: set[int] = set()
             si = 0
             for rng, team in shard_map.ranges():
@@ -293,7 +307,7 @@ class ClusterController:
                         # moved/split-in range: fetch from a live replica of
                         # the covering source shard
                         src = next(
-                            (p for p in prev_state["storage"]
+                            (p for p in prev_storage
                              if p["begin"] <= rng.begin and p["end"] >= rng.end
                              and self.fm.is_available(
                                  NetworkAddress(*p["worker"]))),
@@ -367,6 +381,7 @@ class ClusterController:
         self.recovery_state = "WRITING_CSTATE"
         state = {
             "epoch": new_epoch,
+            "seq": 0,
             "recovery_version": rv,
             "log_cfg": log_cfg,
             "sequencer": {"addr": seq_addr, "token": seq_tok},
@@ -386,6 +401,24 @@ class ClusterController:
         TraceEvent("RecoveryComplete").detail("Epoch", new_epoch) \
             .detail("RecoveryVersion", rv).log()
         return state
+
+    async def publish_state(self, mutate) -> dict:
+        """Publish a mid-epoch cluster-state update — how a live shard
+        move's flip reaches clients without a recovery.  ``mutate(state)
+        -> state`` transforms a copy of the last state; the sequence
+        number bumps so client views rebuild (epoch ties, seq advances).
+        Refuses when a newer epoch exists (this controller is deposed)."""
+        assert self.last_state is not None, "publish before first recovery"
+        new = mutate(dict(self.last_state))
+        new["seq"] = self.last_state.get("seq", 0) + 1
+        _, cur = await self.cstate.read()
+        if cur is not None and cur.get("epoch", 0) > self.epoch:
+            raise FdbError("deposed: newer epoch published")
+        await self.cstate.write(new)
+        self.last_state = new
+        TraceEvent("StatePublished").detail("Epoch", self.epoch) \
+            .detail("Seq", new["seq"]).log()
+        return new
 
     async def _read_system_state(self, prev_state: dict | None, spec):
         """Read the ``\\xff`` metadata range from a surviving storage
@@ -464,7 +497,7 @@ class ClusterController:
                 raise
             except FdbError as e:
                 TraceEvent("RecoveryFailed", severity=30) \
-                    .detail("Error", e.name).log()
+                    .detail("Error", e.name).detail("Msg", str(e)).log()
                 await asyncio.sleep(self.knobs.RECOVERY_RETRY_DELAY)
                 continue
             except Exception as e:  # noqa: BLE001 — a wedged CC is worse

@@ -23,11 +23,26 @@ from ..runtime.errors import (ClientInvalidOperation, ClusterVersionChanged,
                               CommitUnknownResult, NotCommitted,
                               TransactionTooOld)
 from ..runtime.knobs import Knobs
-from .data import (CommitResult, CommitTransactionRequest, Mutation,
-                   MutationType, Version, pack_versionstamp)
+from .data import (SYSTEM_PREFIX, CommitResult, CommitTransactionRequest,
+                   Mutation, MutationType, Version, pack_versionstamp)
 from .resolver import ResolveBatchRequest, Resolver, clip_txn_to_range
 from .sequencer import Sequencer
-from .shard_map import ShardMap
+from .shard_map import ShardMap, write_team_drops
+
+
+def is_state_txn(req: CommitTransactionRequest) -> bool:
+    """A transaction that mutates the system keyspace is a "state
+    transaction" (REF:fdbserver/CommitProxyServer.actor.cpp
+    txnStateTransactions): its mutations must be applied by EVERY commit
+    proxy in version order, so it is resolved alone in its batch with
+    unclipped conflict ranges on every resolver."""
+    for m in req.mutations:
+        if m.type == MutationType.CLEAR_RANGE:
+            if m.param2 > SYSTEM_PREFIX:
+                return True
+        elif m.param1 >= SYSTEM_PREFIX:
+            return True
+    return False
 
 
 class CommitProxy:
@@ -38,7 +53,20 @@ class CommitProxy:
         self.sequencer = sequencer
         self.resolvers = resolvers
         self.log_system = log_system
-        self.shard_map = shard_map
+        # versioned shard-map history: the map at index i is effective for
+        # commit versions >= its change version.  Layout changes arrive as
+        # state-transaction entries (the txnStateStore of this proxy) and
+        # append snapshots, so pipelined batches always tag with the map
+        # as of their OWN version even when a later batch applied a newer
+        # layout first.
+        self._maps: list[tuple[Version, ShardMap]] = [(-1, shard_map)]
+        self.state_applied_version: Version = -1
+        # drop markers computed per applied layout-change version.  Kept
+        # separately from _apply_state_entries' return value because the
+        # entry for version V may be applied by ANOTHER in-flight batch
+        # whose reply arrived first — the batch that OWNS version V must
+        # still find and push V's markers exactly once.
+        self._pending_drops: dict[Version, list[tuple[int, bytes, bytes]]] = {}
         self._queue: asyncio.Queue = asyncio.Queue()
         self._batcher_task: asyncio.Task | None = None
         self._inflight: set[asyncio.Task] = set()
@@ -48,6 +76,65 @@ class CommitProxy:
         from ..runtime.trace import CounterCollection
         self.counters = CounterCollection("ProxyCommit")
         self._metrics_task = None
+
+    @property
+    def shard_map(self) -> ShardMap:
+        return self._maps[-1][1]
+
+    def map_at(self, version: Version) -> ShardMap:
+        for v, m in reversed(self._maps):
+            if v <= version:
+                return m
+        return self._maps[0][1]
+
+    # --- metadata mutations (REF:fdbserver/ApplyMetadataMutation.cpp) ---
+
+    def _apply_state_entries(self, entries, own_version: Version | None = None
+                             ) -> list[tuple[int, bytes, bytes]]:
+        """Apply committed state entries in version order; returns the
+        drop markers for the entry at ``own_version`` (only the proxy that
+        owns that batch pushes them to the TLogs — exactly once).  The
+        markers are retrieved from _pending_drops rather than the apply
+        call, because a pipelined batch at a higher version may have
+        applied our entry before our own reply arrived."""
+        for v, muts in sorted(entries or []):
+            if v <= self.state_applied_version:
+                continue
+            drops = self._apply_metadata(v, muts)
+            if drops:
+                self._pending_drops[v] = drops
+                if len(self._pending_drops) > 256:
+                    # entries owned by other proxies are never popped;
+                    # old ones can no longer be claimed by any batch
+                    self._pending_drops.pop(min(self._pending_drops))
+            self.state_applied_version = v
+        if own_version is None:
+            return []
+        return self._pending_drops.pop(own_version, [])
+
+    def _apply_metadata(self, version: Version, muts
+                        ) -> list[tuple[int, bytes, bytes]]:
+        from ..rpc.wire import decode
+        from ..runtime.trace import TraceEvent
+        from .system_data import LAYOUT_KEY
+        drops: list[tuple[int, bytes, bytes]] = []
+        for m in muts:
+            if m.type != MutationType.SET_VALUE or m.param1 != LAYOUT_KEY:
+                continue
+            try:
+                layout = decode(m.param2)
+                new = ShardMap([bytes(b) for b in layout["boundaries"]],
+                               [list(t) for t in layout["teams"]])
+            except Exception as e:  # noqa: BLE001 — a bad blob must not
+                TraceEvent("ProxyBadLayout", severity=40) \
+                    .detail("Error", repr(e)[:100]).log()   # kill the proxy
+                continue
+            drops.extend(write_team_drops(self._maps[-1][1], new))
+            self._maps.append((version, new))
+            TraceEvent("ProxyLayoutApplied").detail("Version", version) \
+                .detail("Shards", len(new.shard_tags)) \
+                .detail("Drops", len(drops)).log()
+        return drops
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -118,10 +205,20 @@ class CommitProxy:
             else:
                 first = await self._queue.get()
             last_real_commit = loop.time()
-            batch = [first]
-            nbytes = first[0].expected_size()
+            # state transactions (system-key writers) resolve ALONE in
+            # their batch: every resolver must compute the same verdict
+            # from the same (unclipped) view, which a singleton batch
+            # guarantees without any cross-resolver agreement protocol
+            state_item = None
+            if is_state_txn(first[0]):
+                batch, state_item = [], first
+                nbytes = 0
+            else:
+                batch = [first]
+                nbytes = first[0].expected_size()
             deadline = asyncio.get_running_loop().time() + self.knobs.COMMIT_BATCH_INTERVAL
-            while (len(batch) < self.knobs.COMMIT_BATCH_COUNT_LIMIT
+            while (state_item is None
+                   and len(batch) < self.knobs.COMMIT_BATCH_COUNT_LIMIT
                    and nbytes < self.knobs.COMMIT_BATCH_BYTE_LIMIT):
                 timeout = deadline - asyncio.get_running_loop().time()
                 if timeout <= 0:
@@ -130,26 +227,36 @@ class CommitProxy:
                     item = await asyncio.wait_for(self._queue.get(), timeout)
                 except asyncio.TimeoutError:
                     break
+                if is_state_txn(item[0]):
+                    state_item = item      # flush batch, then this alone
+                    break
                 batch.append(item)
                 nbytes += item[0].expected_size()
             # overlapped pipelining: run the batch as its own task; version
             # ordering downstream comes from prev_version chaining
-            t = asyncio.get_running_loop().create_task(
-                self._commit_batch(batch), name="commit-batch")
-            self._inflight.add(t)
-            t.add_done_callback(self._inflight.discard)
+            for b in ([batch] if batch else []) + \
+                    ([[state_item]] if state_item else []):
+                t = asyncio.get_running_loop().create_task(
+                    self._commit_batch(b), name="commit-batch")
+                self._inflight.add(t)
+                t.add_done_callback(self._inflight.discard)
 
     async def _empty_batch(self) -> None:
         """Advance the version chain with no transactions."""
         prev_version = version = None
         try:
             prev_version, version = await self.sequencer.get_commit_version()
-            await asyncio.gather(*(r.resolve(
-                ResolveBatchRequest(prev_version, version, []))
+            replies = await asyncio.gather(*(r.resolve(
+                ResolveBatchRequest(prev_version, version, [], None,
+                                    self.state_applied_version))
                 for r in self.resolvers))
+            self._apply_state_entries(replies[0].state_entries)
             await self.log_system.push(prev_version, version, {})
             self.sequencer.report_committed(version)
-        except Exception:
+        except Exception as e:
+            from ..runtime.trace import TraceEvent
+            TraceEvent("EmptyBatchFailed", severity=30) \
+                .detail("Error", repr(e)[:200]).detail("Version", version).log()
             # an assigned version must never be abandoned (re-resolving or
             # re-pushing an empty batch is harmless)
             if version is not None:
@@ -177,25 +284,44 @@ class CommitProxy:
         futs = [f for _, f in valid]
         prev_version = version = None
         resolved = pushed = push_started = False
+        repair_tagged: dict[int, list[Mutation]] | None = None
         try:
             prev_version, version = await self.sequencer.get_commit_version()
             txns = [TxnRequest(r.read_conflict_ranges, r.write_conflict_ranges,
                                r.read_snapshot) for r in reqs]
+            is_state = any(is_state_txn(r) for r in reqs)
+            state_txns = None
+            if is_state:
+                # singleton by the batcher's construction; ranges ride
+                # unclipped + mutations piggyback so every resolver logs
+                # the identical committed-state stream
+                assert len(reqs) == 1
+                state_txns = [(0, list(reqs[0].mutations))]
 
             # broadcast to all resolvers, clipped to each partition
             async def ask(res: Resolver):
-                clipped = [clip_txn_to_range(t, res.key_range) for t in txns]
-                reply = await res.resolve(
-                    ResolveBatchRequest(prev_version, version, clipped))
-                return reply.verdicts
-            all_verdicts = await asyncio.gather(*(ask(r) for r in self.resolvers))
+                sent = txns if is_state else \
+                    [clip_txn_to_range(t, res.key_range) for t in txns]
+                return await res.resolve(
+                    ResolveBatchRequest(prev_version, version, sent,
+                                        state_txns,
+                                        self.state_applied_version))
+            replies = await asyncio.gather(*(ask(r) for r in self.resolvers))
             resolved = True
 
             # AND the verdicts: TOO_OLD dominates, then CONFLICT
             final = [COMMITTED] * len(reqs)
-            for verdicts in all_verdicts:
-                for i, v in enumerate(verdicts):
+            for reply in replies:
+                for i, v in enumerate(reply.verdicts):
                     final[i] = max(final[i], v)
+
+            # apply the committed state stream (our own state batch AND
+            # other proxies' — identical on every resolver, take the
+            # first's) BEFORE tagging, then tag with the map as of THIS
+            # batch's version
+            my_drops = self._apply_state_entries(
+                replies[0].state_entries, own_version=version)
+            shard_map = self.map_at(version)
 
             # tag mutations of committed txns, in batch order; the log
             # system replicates each tag onto its hosting logs
@@ -209,12 +335,19 @@ class CommitProxy:
                 for m in req.mutations:
                     m = self._substitute_versionstamp(m, version, order)
                     if m.type == MutationType.CLEAR_RANGE:
-                        tags = self.shard_map.tags_for_range(m.param1, m.param2)
+                        tags = shard_map.tags_for_range(m.param1, m.param2)
                     else:
-                        tags = self.shard_map.tags_for_key(m.param1)
+                        tags = shard_map.tags_for_key(m.param1)
                     for t in tags:
                         tagged.setdefault(t, []).append(m)
                 order += 1
+            # ownership handoff markers for a layout change this batch
+            # committed: each losing tag sees the drop at exactly this
+            # version in its own mutation stream
+            for t, b, e in my_drops:
+                tagged.setdefault(t, []).append(
+                    Mutation(MutationType.PRIVATE_DROP_SHARD, b, e))
+            repair_tagged = tagged
 
             push_started = True
             await self.log_system.push(prev_version, version, tagged)
@@ -245,6 +378,10 @@ class CommitProxy:
                     fut.set_exception(ClusterVersionChanged())
             raise
         except Exception as e:
+            from ..runtime.trace import TraceEvent
+            TraceEvent("CommitBatchFailed", severity=30) \
+                .detail("Version", version).detail("Resolved", resolved) \
+                .detail("Pushed", pushed).detail("Error", repr(e)[:200]).log()
             # once any TLog may hold the batch, the outcome is ambiguous:
             # clients must see commit_unknown_result (maybe-committed), not
             # a freely-retryable transport error that would double-apply
@@ -257,17 +394,30 @@ class CommitProxy:
             # prev_version ordering, and an abandoned version would wedge
             # every later batch cluster-wide
             if version is not None:
-                await self._repair_chain(prev_version, version, resolved, pushed)
+                await self._repair_chain(prev_version, version, resolved,
+                                         pushed, repair_tagged)
 
     async def _repair_chain(self, prev_version: Version, version: Version,
-                            resolved: bool, pushed: bool) -> None:
+                            resolved: bool, pushed: bool,
+                            tagged: dict[int, list[Mutation]] | None = None
+                            ) -> None:
+        """Complete an interrupted batch's version chain.  Once the batch
+        RESOLVED, its verdicts (and any committed state transaction) are
+        in every resolver's history, so the repair must push the batch's
+        REAL payload — an empty substitute would let later batches commit
+        durably on top of a layout change that never reached the logs
+        (TLog pushes ack duplicates idempotently, so re-pushing a
+        partially-delivered version is safe)."""
         try:
             if not resolved:
                 await asyncio.gather(*(r.resolve(
-                    ResolveBatchRequest(prev_version, version, []))
+                    ResolveBatchRequest(prev_version, version, [], None,
+                                        self.state_applied_version))
                     for r in self.resolvers))
             if not pushed:
-                await self.log_system.push(prev_version, version, {})
+                await self.log_system.push(prev_version, version,
+                                           tagged if resolved and tagged
+                                           else {})
             self.sequencer.report_committed(version)
         except Exception:
             pass  # a failed repair means the epoch is dead; recovery's job

@@ -105,11 +105,19 @@ class MutationType(enum.IntEnum):
     BYTE_MIN = 16
     BYTE_MAX = 17
     COMPARE_AND_CLEAR = 20
+    # Private mutations (no upstream opcode equivalent at this number):
+    # control messages the commit proxy injects into a storage tag's
+    # mutation stream so ownership changes land at an exact version
+    # (REF:fdbserver/ApplyMetadataMutation.cpp private mutations with the
+    # \xff\xff systemKeysPrefix).  param1=begin, param2=end of the range
+    # this tag stops owning as of the mutation's version.
+    PRIVATE_DROP_SHARD = 30
 
 
 ATOMIC_TYPES = frozenset(
     t for t in MutationType
-    if t not in (MutationType.SET_VALUE, MutationType.CLEAR_RANGE)
+    if t not in (MutationType.SET_VALUE, MutationType.CLEAR_RANGE,
+                 MutationType.PRIVATE_DROP_SHARD)
 )
 
 

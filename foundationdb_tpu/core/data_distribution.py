@@ -1,37 +1,46 @@
-"""DataDistribution v1 — shard statistics, splits and moves.
+"""DataDistribution v2 — shard statistics, LIVE splits and moves.
 
-Reference: REF:fdbserver/DataDistribution.actor.cpp +
-DataDistributionTracker (shard stats / split decisions) +
-MoveKeys.actor.cpp (the relocation protocol).  The distributor runs
-beside the elected cluster controller:
+Reference: REF:fdbserver/DataDistribution.actor.cpp (tracker/queue) +
+REF:fdbserver/MoveKeys.actor.cpp (the relocation protocol).  The
+distributor runs beside the elected cluster controller and relocates
+shards WITHOUT a recovery, with the same three-phase protocol as the
+reference:
 
-1. it samples every storage replica's ``logical_bytes``;
-2. a shard over ``DD_SHARD_SPLIT_BYTES`` gets a split key from its
-   server (``sample_split_key`` — splitMetrics analog), producing a new
-   desired layout with fresh tags for the right half;
-3. the layout is committed to ``\\xff/keyServers/layout`` through an
-   ordinary transaction (the metadata-mutation path), and a recovery is
-   requested: the next epoch recruits servers for the new assignments,
-   which fetchKeys-stream their snapshot at the recovery version from
-   the old replicas while new mutations arrive via their fresh tags.
+1. **startMove** — a state transaction rewrites ``\\xff/keyServers/
+   layout`` so the moving range's WRITE team is src+dest (dual tagging)
+   and journals the move.  Every commit proxy applies the mutation at its
+   exact commit version Vs (the ApplyMetadataMutation path), so all
+   mutations > Vs reach both teams.  Reads keep routing to src: clients
+   only see published cluster state, which does not change yet.
+2. **fetch + catch-up** — destination storage servers are recruited with
+   ``fetch_version = Vs``: they stream the range's snapshot AT Vs from a
+   source replica while pulling their new tag from Vs+1 — an exact cut,
+   because the startMove transaction is alone in its version.
+3. **finishMove (flip)** — once destinations are caught up, another
+   state transaction sets the write team to dest-only; the committing
+   proxy emits PRIVATE_DROP_SHARD markers to the source tags at the flip
+   version Vf, so sources refuse reads above Vf (wrong_shard_server →
+   clients refresh).  The controller then publishes the updated cluster
+   state (same epoch, seq+1) and a final transaction clears the journal.
 
-The flip is therefore recovery-mediated in v1 — writes retry through the
-(short) recovery window instead of dual-tagging during a live move; the
-data path is still exact: snapshot at rv + stream above rv.
+A crash at any point is safe: recovery normalizes the layout journal —
+moves still in phase 1–2 roll BACK to src (src holds everything, writes
+were dual-tagged); flipped moves roll FORWARD (the journal carries the
+destination server info so they rejoin).  See
+``system_data.normalize_layout``.
 """
 
 from __future__ import annotations
 
 import asyncio
 
-from ..rpc.stubs import StorageClient
-from ..rpc.transport import Transport
+from ..rpc.stubs import StorageClient, TLogClient
+from ..rpc.transport import NetworkAddress, Transport
 from ..runtime.knobs import Knobs
 from ..runtime.trace import TraceEvent
-from .cluster_client import RecoveredClusterView
-from .data import KeyRange
+from .data import MAX_VERSION, KeyRange, Version
 from .shard_map import ShardMap
-from .system_data import KEY_SERVERS_PREFIX
+from .system_data import LAYOUT_KEY, normalize_layout
 
 
 def layout_of(state: dict) -> dict:
@@ -63,18 +72,24 @@ def move_layout(layout: dict, shard_idx: int, next_tag: int) -> tuple[dict, int]
             next_tag + n)
 
 
+class MoveAborted(Exception):
+    pass
+
+
 class DataDistributor:
-    """Runs with the elected controller; watches shard sizes and writes
-    new layouts + requests recoveries to apply them."""
+    """Runs with the elected controller; watches shard sizes and performs
+    live relocations through the layout state-transaction path."""
 
     def __init__(self, knobs: Knobs, transport: Transport, cc,
                  database) -> None:
         self.knobs = knobs
         self.transport = transport
-        self.cc = cc                 # ClusterController (for last_state + trigger)
+        self.cc = cc                 # ClusterController (workers + publish)
         self.db = database           # Database-like with .run + .view
         self._task: asyncio.Task | None = None
         self.splits_done = 0
+        self.live_moves_done = 0
+        self._worker_rr = 0
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(
@@ -100,14 +115,34 @@ class DataDistributor:
                 TraceEvent("DDRoundFailed", severity=30) \
                     .detail("Error", repr(e)[:200]).log()
 
+    # --- one relocation decision per round ---
+
     async def _round(self) -> None:
         state = getattr(self.cc, "last_state", None)
         if not state or self.cc.recovery_state != "ACCEPTING_COMMITS":
             return
-        layout = layout_of(state)
+        layout = await self._current_layout(state)
+        if layout is None:
+            return
+        if layout.get("moves"):
+            # Leftover journal from an interrupted move.  "in" entries
+            # were rolled back (by recovery's normalization, or never
+            # flipped); "flip" entries may have flipped WITHOUT the state
+            # publish reaching the coordinators — re-publish from the
+            # journal's dest_info first, or the destinations (holding
+            # every post-flip write) would be orphaned.  Then write the
+            # normalized layout so the durable blob matches.
+            for mv in layout["moves"]:
+                if mv.get("state") == "flip":
+                    await self._publish_flip(mv, layout["boundaries"],
+                                             layout["teams"])
+            await self._commit_layout(normalize_layout(layout))
+            TraceEvent("DDJournalReconciled").log()
+            return
         by_tag = {s["tag"]: s for s in state["storage"]}
-        shard_map = ShardMap(layout["boundaries"], layout["teams"])
-        next_tag = max(by_tag) + 1 if by_tag else 0
+        shard_map = ShardMap([bytes(b) for b in layout["boundaries"]],
+                             [list(t) for t in layout["teams"]])
+        next_tag = max(by_tag, default=-1) + 1
 
         for idx, (rng, team) in enumerate(shard_map.ranges()):
             sizes = []
@@ -115,7 +150,7 @@ class DataDistributor:
                 s = by_tag.get(tag)
                 if s is None:
                     continue
-                stub = self._stub(s)
+                stub = self._storage_stub(s)
                 try:
                     m = await asyncio.wait_for(
                         stub.metrics(), timeout=self.knobs.FAILURE_TIMEOUT)
@@ -127,28 +162,249 @@ class DataDistributor:
             size, src = max(sizes, key=lambda x: x[0])
             if size < self.knobs.DD_SHARD_SPLIT_BYTES:
                 continue
-            split_key = await self._stub(src).sample_split_key(
+            split_key = await self._storage_stub(src).sample_split_key(
                 rng.begin, rng.end)
             if not split_key:
                 continue
-            split_key = bytes(split_key)
-            new_layout, _ = split_layout(layout, idx, split_key, next_tag)
-            await self._commit_layout(new_layout)
-            self.splits_done += 1
-            TraceEvent("DDShardSplit").detail("Shard", idx) \
-                .detail("At", split_key).detail("Bytes", size).log()
-            self.cc.request_recovery("dd_split")
+            await self._live_split(state, layout, idx, bytes(split_key),
+                                   next_tag)
             return                  # one relocation per round
 
-    def _stub(self, s: dict) -> StorageClient:
-        from ..rpc.transport import NetworkAddress
+    async def _current_layout(self, state: dict) -> dict | None:
+        from ..rpc.wire import decode
+        try:
+            raw = await self.db.get(LAYOUT_KEY)
+        except Exception:  # noqa: BLE001 — unreadable metadata: skip round
+            return None
+        if raw:
+            try:
+                return decode(raw)
+            except Exception:  # noqa: BLE001 — corrupt blob: fall through
+                pass
+        return layout_of(state)
+
+    # --- the live relocation protocol ---
+
+    async def _live_split(self, state: dict, layout: dict, idx: int,
+                          split_key: bytes, next_tag: int) -> None:
+        rng = ShardMap([bytes(b) for b in layout["boundaries"]],
+                       [list(t) for t in layout["teams"]]).shard_range(idx)
+        if not rng.begin < split_key < rng.end:
+            return
+        src_team = list(layout["teams"][idx])
+        dest_tags = [next_tag + i for i in range(len(src_team))]
+        epoch0 = self.cc.epoch
+        move_rng = KeyRange(split_key, rng.end)
+
+        # --- phase 1: startMove (dual-tagged write team) ---
+        start_layout = {
+            "boundaries": [*layout["boundaries"][:idx], split_key,
+                           *layout["boundaries"][idx:]],
+            "teams": [*(list(t) for t in layout["teams"][:idx]),
+                      src_team, src_team + dest_tags,
+                      *(list(t) for t in layout["teams"][idx + 1:])],
+            "moves": [{"begin": split_key, "end": rng.end, "src": src_team,
+                       "dest": dest_tags, "state": "in"}],
+        }
+        vs = await self._commit_layout(start_layout)
+        TraceEvent("DDMoveStarted").detail("Begin", split_key) \
+            .detail("End", rng.end).detail("Vs", vs) \
+            .detail("DestTags", dest_tags).log()
+
+        dest_info: list[dict] = []
+        try:
+            # --- phase 2: recruit destinations, fetch at Vs ---
+            src_entry = self._live_src_entry(state, move_rng)
+            wire_log_cfg = [self.cc._wire_gen(g) for g in state["log_cfg"]]
+            chosen: set[str] = {src_entry["worker"][0]}
+            for tag in dest_tags:
+                wa = self._pick_worker(avoid=chosen)
+                chosen.add(wa.ip)
+                a, t = await self.cc._recruit(wa, "storage", {
+                    "tag": tag, "shard_begin": split_key,
+                    "shard_end": rng.end, "v0": vs,
+                    "log_cfg": wire_log_cfg,
+                    "fetch_from": {"addr": src_entry["addr"],
+                                   "token": src_entry["token"],
+                                   "tag": src_entry["tag"],
+                                   "begin": src_entry["begin"],
+                                   "end": src_entry["end"]},
+                    "fetch_version": vs})
+                dest_info.append({"worker": [wa.ip, wa.port], "addr": a,
+                                  "token": t, "tag": tag,
+                                  "begin": split_key, "end": rng.end})
+            await self._wait_caught_up(dest_info, vs, epoch0)
+        except asyncio.CancelledError:
+            # the distributor is being stopped (CC deposed / shutdown):
+            # do NOT try to run the abort protocol against a cluster that
+            # may already be dying — the "in" journal entry makes the
+            # rollback safe at the next recovery or DD round
+            raise
+        except Exception as e:
+            await self._abort_move(start_layout, idx, src_team, dest_info,
+                                   epoch0)
+            TraceEvent("DDMoveAborted", severity=30) \
+                .detail("Error", repr(e)[:200]).log()
+            return
+
+        # --- phase 3: flip to dest + journal the dest info ---
+        flip_layout = {
+            "boundaries": list(start_layout["boundaries"]),
+            "teams": [list(t) for t in start_layout["teams"]],
+            "moves": [{"begin": split_key, "end": rng.end, "src": src_team,
+                       "dest": dest_tags, "state": "flip",
+                       "dest_info": dest_info}],
+        }
+        flip_layout["teams"][idx + 1] = list(dest_tags)
+        vf = await self._commit_layout(flip_layout)
+
+        # --- publish so clients re-route reads, then clear the journal.
+        # If anything here fails, the flip journal entry survives and the
+        # next round's reconciliation re-publishes from it. ---
+        await self._publish_flip(flip_layout["moves"][0],
+                                 flip_layout["boundaries"],
+                                 flip_layout["teams"])
+        await self._commit_layout({
+            "boundaries": list(flip_layout["boundaries"]),
+            "teams": [list(t) for t in flip_layout["teams"]]})
+        self.splits_done += 1
+        self.live_moves_done += 1
+        TraceEvent("DDMoveComplete").detail("Begin", split_key) \
+            .detail("End", rng.end).detail("Vf", vf).log()
+
+    async def _publish_flip(self, mv: dict, boundaries, teams) -> None:
+        """Publish a flipped move's cluster state: the layout's boundaries
+        and (dest) teams, source entries narrowed out of the moved range,
+        and the journal's dest_info entries added.  Idempotent — re-run
+        by journal reconciliation when a crash interrupted the original
+        publish."""
+        dest_info = [dict(d) for d in mv.get("dest_info", [])]
+        src_team = list(mv["src"])
+        b, e = bytes(mv["begin"]), bytes(mv["end"])
+        dest_tags = {d["tag"] for d in dest_info}
+
+        def mutate(s: dict) -> dict:
+            s = dict(s)
+            s["shard_boundaries"] = [bytes(x) for x in boundaries]
+            s["shard_teams"] = [list(t) for t in teams]
+            storage = []
+            for entry in s["storage"]:
+                if entry["tag"] in dest_tags:
+                    continue                 # re-added fresh below
+                if entry["tag"] in src_team and entry["begin"] <= b \
+                        and entry["end"] >= e:
+                    entry = dict(entry)
+                    if entry["begin"] == b:  # whole-entry move
+                        entry["begin"] = entry["end"] = e
+                    else:                    # suffix move (split)
+                        entry["end"] = b
+                storage.append(entry)
+            s["storage"] = [x for x in storage
+                            if x["begin"] < x["end"]] + dest_info
+            return s
+        await self.cc.publish_state(mutate)
+        self.cc.active_tags.update(dest_tags)
+
+    async def _wait_caught_up(self, dest_info: list[dict], vs: Version,
+                              epoch0: int) -> None:
+        deadline = asyncio.get_running_loop().time() + \
+            self.knobs.DD_MOVE_TIMEOUT
+        while True:
+            if self.cc.epoch != epoch0 \
+                    or self.cc.recovery_state != "ACCEPTING_COMMITS":
+                raise MoveAborted("epoch changed mid-move")
+            if asyncio.get_running_loop().time() > deadline:
+                raise MoveAborted("destination catch-up timeout")
+            ok = True
+            for d in dest_info:
+                m = await asyncio.wait_for(
+                    self._storage_stub(d).metrics(),
+                    timeout=self.knobs.FAILURE_TIMEOUT)
+                if m.get("fetch_failed"):
+                    raise MoveAborted("destination fetch failed (too old)")
+                if not m.get("fetch_done") or m.get("version", 0) < vs:
+                    ok = False
+            if ok:
+                return
+            await asyncio.sleep(self.knobs.DD_INTERVAL / 4)
+
+    async def _abort_move(self, start_layout: dict, idx: int,
+                          src_team: list[int], dest_info: list[dict],
+                          epoch0: int) -> None:
+        """Roll a failed move back: write team reverts to src (the abort
+        layout's team diff sends drop markers to the destinations), the
+        destination roles stop, and their tags pop at infinity so they
+        never pin a TLog queue."""
+        if self.cc.epoch != epoch0:
+            return      # a recovery already normalized the journal
+        abort_layout = {
+            "boundaries": list(start_layout["boundaries"]),
+            "teams": [list(t) for t in start_layout["teams"]]}
+        abort_layout["teams"][idx + 1] = list(src_team)
+        try:
+            # bounded: if the abort can't commit (pipeline already dead),
+            # give up — the journal entry rolls the move back at recovery
+            await asyncio.wait_for(self._commit_layout(abort_layout),
+                                   timeout=self.knobs.DD_MOVE_TIMEOUT)
+        except (Exception, asyncio.TimeoutError):  # noqa: BLE001
+            return
+        for d in dest_info:
+            try:
+                wa = NetworkAddress(*d["worker"])
+                w = self.cc.workers.get(wa)
+                if w is not None:
+                    await asyncio.wait_for(
+                        w.stop_role(d["token"]),
+                        timeout=self.knobs.FAILURE_TIMEOUT)
+            except Exception:  # noqa: BLE001 — dead worker: nothing to stop
+                pass
+        self._pop_tags_forever([d["tag"] for d in dest_info])
+
+    def _pop_tags_forever(self, tags: list[int]) -> None:
+        state = self.cc.last_state or {}
+        gen = (state.get("log_cfg") or [{}])[-1]
+        for (ip, port), tok in zip(gen.get("tlogs", []),
+                                   gen.get("token", [])):
+            stub = TLogClient(self.transport, NetworkAddress(ip, port), tok)
+            for tag in tags:
+                try:
+                    stub.pop(tag, MAX_VERSION)
+                except Exception:  # noqa: BLE001 — oneway best-effort
+                    pass
+
+    # --- helpers ---
+
+    def _live_src_entry(self, state: dict, rng: KeyRange) -> dict:
+        for s in state["storage"]:
+            if s["begin"] <= rng.begin and s["end"] >= rng.end \
+                    and self.cc.fm.is_available(NetworkAddress(*s["worker"])):
+                return s
+        raise MoveAborted("no live source replica for move range")
+
+    def _pick_worker(self, avoid: set[str] | None = None) -> NetworkAddress:
+        """Round-robin over live workers, preferring machines not in
+        ``avoid`` (the source and already-chosen team members) so one
+        machine death cannot take out a whole replication team.  Falls
+        back to any live worker when the fleet is too small to avoid."""
+        live = [a for a, _ in self.cc._live_workers()]
+        preferred = [a for a in live if not avoid or a.ip not in avoid]
+        pool = preferred or live
+        if not pool:
+            raise MoveAborted("no live workers for destination")
+        self._worker_rr += 1
+        return pool[self._worker_rr % len(pool)]
+
+    def _storage_stub(self, s: dict) -> StorageClient:
         return StorageClient(self.transport, NetworkAddress(*s["addr"]),
                              s["token"], s["tag"],
-                             KeyRange(s["begin"], s["end"]))
+                             KeyRange(bytes(s["begin"]), bytes(s["end"])))
 
-    async def _commit_layout(self, layout: dict) -> None:
+    async def _commit_layout(self, layout: dict) -> Version:
         from ..rpc.wire import encode
-
-        async def do(tr):
-            tr.set(KEY_SERVERS_PREFIX + b"layout", encode(layout))
-        await self.db.run(do)
+        tr = self.db.create_transaction()
+        while True:
+            try:
+                tr.set(LAYOUT_KEY, encode(layout))
+                return await tr.commit()
+            except Exception as e:  # noqa: BLE001 — retry via on_error
+                await tr.on_error(e)

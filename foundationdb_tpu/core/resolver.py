@@ -27,15 +27,31 @@ from .data import KeyRange, Version
 
 @dataclasses.dataclass
 class ResolveBatchRequest:
-    """ResolveTransactionBatchRequest (REF:fdbserver/ResolverInterface.h)."""
+    """ResolveTransactionBatchRequest (REF:fdbserver/ResolverInterface.h).
+
+    ``state_txns`` carries the mutations of system-keyspace ("state")
+    transactions in this batch as (txn_index, mutations) pairs — the
+    txnStateTransactions piggyback of the reference.  The proxy sends
+    state transactions' conflict ranges UNCLIPPED to every resolver and
+    alone in their batch, so all resolvers compute the identical verdict
+    and log the identical committed-state stream.
+
+    ``state_known_version`` is the highest version through which the
+    asking proxy has applied state mutations; the reply returns every
+    newer committed state entry so all proxies converge on one metadata
+    history (REF:fdbserver/Resolver.actor.cpp recentStateTransactions).
+    """
     prev_version: Version
     version: Version
     txns: list[TxnRequest]
+    state_txns: list | None = None          # [(txn_index, [Mutation])]
+    state_known_version: Version = -1
 
 
 @dataclasses.dataclass
 class ResolveBatchReply:
     verdicts: list[int]   # per-txn COMMITTED/CONFLICT/TOO_OLD
+    state_entries: list | None = None       # [(version, [Mutation])]
 
 
 class Resolver:
@@ -50,6 +66,11 @@ class Resolver:
         self.total_txns = 0
         self.total_conflicts = 0
         self._poisoned: BaseException | None = None
+        # committed state transactions this epoch, in version order.  Kept
+        # whole: state txns are rare (shard moves, config changes) and the
+        # log resets every epoch with the role, so proxies can never fall
+        # off its tail mid-epoch.
+        self._state_log: list[tuple[Version, list]] = []
 
     async def _wait_for_version(self, prev_version: Version) -> None:
         if self.version >= prev_version:
@@ -105,9 +126,22 @@ class Resolver:
             floor = req.version - self.knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
             if floor > 0:
                 self.backend.set_oldest_version(floor)
-            self._advance_to(req.version)
-            verdicts = await finish
-            finish = None
+            if req.state_txns:
+                # State batches are a pipeline barrier: their committed
+                # mutations must be in the state log BEFORE any later
+                # batch's reply is built, or a pipelined batch at a higher
+                # version could tag with a stale shard map.  Rare, so the
+                # lost overlap is negligible.
+                verdicts = await finish
+                finish = None
+                for idx, muts in req.state_txns:
+                    if verdicts[idx] == COMMITTED:
+                        self._state_log.append((req.version, muts))
+                self._advance_to(req.version)
+            else:
+                self._advance_to(req.version)
+                verdicts = await finish
+                finish = None
         except asyncio.CancelledError:
             raise
         except BaseException as e:
@@ -120,7 +154,9 @@ class Resolver:
         self.total_batches += 1
         self.total_txns += len(req.txns)
         self.total_conflicts += sum(1 for v in verdicts if v != COMMITTED)
-        return ResolveBatchReply(verdicts)
+        entries = [(v, m) for v, m in self._state_log
+                   if req.state_known_version < v <= req.version]
+        return ResolveBatchReply(verdicts, entries or None)
 
 
 def clip_txn_to_range(t: TxnRequest, r: KeyRange) -> TxnRequest:

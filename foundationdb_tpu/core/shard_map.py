@@ -19,7 +19,15 @@ class ShardMap:
     def __init__(self, boundaries: list[bytes], shard_tags: list[list[Tag]],
                  keyspace_end: bytes = b"\xff\xff\xff"):
         """boundaries: interior split points (sorted); len(shard_tags) ==
-        len(boundaries) + 1.  Shard i covers [b[i-1], b[i])."""
+        len(boundaries) + 1.  Shard i covers [b[i-1], b[i]).
+
+        ``shard_tags`` are the WRITE teams (the keyServers mapping: every
+        listed tag receives the shard's mutations — during a live move
+        that is src+dest, REF:fdbserver/MoveKeys.actor.cpp startMoveKeys).
+        Read routing (the serverKeys view) is what the published cluster
+        state carries: clients keep reading the sources until the move's
+        flip is published, so no separate read-team list is needed here.
+        """
         assert len(shard_tags) == len(boundaries) + 1
         self.boundaries = boundaries
         self.shard_tags = shard_tags
@@ -70,3 +78,34 @@ class ShardMap:
                 if t not in out:
                     out.append(t)
         return out
+
+
+def write_team_drops(old: ShardMap, new: ShardMap
+                     ) -> list[tuple[Tag, bytes, bytes]]:
+    """Ranges each tag stops receiving writes for under the new map.
+
+    Elementary-interval diff over the union of both maps' boundaries: for
+    every interval, any tag in the old write team but not the new one gets
+    a (tag, begin, end) drop; adjacent intervals per tag are merged.  The
+    commit proxy turns these into PRIVATE_DROP_SHARD mutations riding the
+    same version as the layout change, so storage servers relinquish
+    ownership at an exact point in the version order
+    (REF:fdbserver/ApplyMetadataMutation.cpp krmSetPreviouslyEmptyRange /
+    private mutation emission)."""
+    points = sorted({b"", *old.boundaries, *new.boundaries})
+    end_key = min(old.keyspace_end, new.keyspace_end)
+    drops: dict[Tag, list[tuple[bytes, bytes]]] = {}
+    for i, b in enumerate(points):
+        e = points[i + 1] if i + 1 < len(points) else end_key
+        if b >= e:
+            continue
+        old_t = set(old.shard_tags[old.shard_index(b)])
+        new_t = set(new.shard_tags[new.shard_index(b)])
+        for t in old_t - new_t:
+            spans = drops.setdefault(t, [])
+            if spans and spans[-1][1] == b:
+                spans[-1] = (spans[-1][0], e)
+            else:
+                spans.append((b, e))
+    return [(t, b, e) for t, spans in sorted(drops.items())
+            for b, e in spans]

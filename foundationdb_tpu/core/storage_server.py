@@ -30,6 +30,7 @@ class StorageServer:
         self.knobs = knobs
         self.tag = tag
         self.shard = shard
+        self._meta_shard = shard     # narrows on live-move drops; persisted
         if not isinstance(log_system, LogSystem):
             # a bare TLog (or TLogClient stub) — unit-test convenience
             log_system = LogSystem.single([log_system], 1,
@@ -64,7 +65,15 @@ class StorageServer:
         self._fetch_done = asyncio.Event()
         if fetch_src is None:
             self._fetch_done.set()
+        self._fetch_failed = False
         self._fetch_task: asyncio.Task | None = None
+        # ranges this server relinquished (live shard moves): a
+        # PRIVATE_DROP_SHARD marker in the tag stream records (version,
+        # begin, end); reads ABOVE the drop version are refused with
+        # wrong_shard_server so a stale-routed client refreshes its map,
+        # while reads at or below it still serve from history
+        # (REF:fdbserver/storageserver.actor.cpp changeServerKeys)
+        self._dropped: list[tuple[Version, bytes, bytes]] = []
         from ..runtime.trace import CounterCollection
         self.counters = CounterCollection("StorageMetrics", str(tag))
         self._metrics_task = None
@@ -82,6 +91,8 @@ class StorageServer:
             "logical_bytes": self.logical_bytes,
             "shard_begin": self.shard.begin,
             "shard_end": self.shard.end,
+            "fetch_done": self._fetch_done.is_set(),
+            "fetch_failed": self._fetch_failed,
         }
 
     # --- lifecycle ---
@@ -168,6 +179,19 @@ class StorageServer:
                 kvs, more = await self._fetch_src.get_key_values(
                     b, e, v, 1000)
             except FdbError as err:
+                from ..runtime.errors import TransactionTooOld as _TooOld
+                if isinstance(err, _TooOld):
+                    # the snapshot version aged out of the source's MVCC
+                    # window before the fetch finished: this destination
+                    # cannot be completed exactly — fail the fetch and let
+                    # the data distributor abort the move and retry with a
+                    # fresh destination (the reference instead restarts
+                    # fetchKeys at a newer version; our moves are
+                    # all-or-nothing per attempt)
+                    self._fetch_failed = True
+                    TraceEvent("FetchKeysTooOld", severity=30) \
+                        .detail("Tag", self.tag).detail("Version", v).log()
+                    return
                 if err.retryable:
                     await asyncio.sleep(0.1)
                     continue
@@ -271,7 +295,7 @@ class StorageServer:
                 await self.engine.commit(ops, {
                     "durable_version": floor,
                     "tag": self.tag,
-                    "shard": (self.shard.begin, self.shard.end),
+                    "shard": (self._meta_shard.begin, self._meta_shard.end),
                 })
             except Exception as e:
                 # disk trouble (ENOSPC, IO error): keep the buffer intact
@@ -294,9 +318,61 @@ class StorageServer:
             return v
         return self.engine.get(key) if self.engine is not None else None
 
+    def _drop_shard(self, version: Version, begin: bytes, end: bytes) -> None:
+        """Relinquish [begin, end) as of ``version`` (live move handoff).
+
+        ``self.shard`` (the boot-time range) keeps serving clips and
+        history reads at or below the drop version; only ``_meta_shard``
+        — what the durable meta records and the next boot declares —
+        narrows, so a rebooted source refuses the moved range outright."""
+        from ..runtime.errors import WrongShardServer
+        from ..runtime.trace import TraceEvent
+        self._dropped.append((version, begin, end))
+        ms = self._meta_shard
+        if begin <= ms.begin and end >= ms.end:
+            self._meta_shard = KeyRange(ms.begin, ms.begin)
+        elif begin <= ms.begin < end < ms.end:
+            self._meta_shard = KeyRange(end, ms.end)
+        elif ms.begin < begin < ms.end <= end:
+            self._meta_shard = KeyRange(ms.begin, begin)
+        # approximate the stats handoff: the rows leave this server's
+        # logical size (DD reads these for split decisions)
+        dropped_bytes = 0
+        for k, val in self.vmap.range_read(begin, end, version)[0]:
+            dropped_bytes += len(k) + len(val)
+        self.logical_bytes = max(0, self.logical_bytes - dropped_bytes)
+        # watches anchored in the range can no longer fire here
+        for key in [k for k in self._watches if begin <= k < end]:
+            for _, fut in self._watches.pop(key):
+                if not fut.done():
+                    fut.set_exception(WrongShardServer())
+        TraceEvent("StorageShardDropped").detail("Tag", self.tag) \
+            .detail("Begin", begin).detail("End", end) \
+            .detail("Version", version).log()
+
+    def _check_dropped(self, version: Version, begin: bytes,
+                       end: bytes) -> None:
+        """Refuse reads touching relinquished key space.
+
+        Two fences compose: the in-memory drop list (exact handoff
+        version, so reads at-or-below it still serve), and the boot-time
+        shard bounds — narrowed drops persist via the engine meta, so a
+        rebooted source with an empty drop list cannot silently serve a
+        range it relinquished before the reboot (its engine may still
+        hold the stale rows until cleanup)."""
+        from ..runtime.errors import WrongShardServer
+        if begin < self.shard.begin or end > self.shard.end:
+            raise WrongShardServer()
+        for dv, b, e in self._dropped:
+            if version > dv and begin < e and b < end:
+                raise WrongShardServer()
+
     def _apply(self, version: Version, mutations: list[Mutation]) -> None:
         durable = self.engine is not None
         for m in mutations:
+            if m.type == MutationType.PRIVATE_DROP_SHARD:
+                self._drop_shard(version, m.param1, m.param2)
+                continue
             self.bytes_input += len(m.param1) + len(m.param2)
             if m.type == MutationType.SET_VALUE:
                 self.logical_bytes += len(m.param1) + len(m.param2)
@@ -361,6 +437,7 @@ class StorageServer:
         await self._wait_fetched()
         await self._wait_for_version(version)
         self._check_too_old(version)
+        self._check_dropped(version, key, key + b"\x00")
         self.total_reads += 1
         found, v = self.vmap.get2(key, version)
         if found:
@@ -391,6 +468,7 @@ class StorageServer:
         await self._wait_fetched()
         await self._wait_for_version(version)
         self._check_too_old(version)
+        self._check_dropped(version, begin, end)
         self.total_reads += 1
         b = max(begin, self.shard.begin)
         e = min(end, self.shard.end)

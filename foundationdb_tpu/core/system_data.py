@@ -19,6 +19,8 @@ import dataclasses
 
 CONF_PREFIX = b"\xff/conf/"
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
+LAYOUT_KEY = KEY_SERVERS_PREFIX + b"layout"
+BACKUP_PREFIX = b"\xff/backup/"
 
 # conf keys the controller honors, mapping to ClusterConfigSpec fields
 CONF_FIELDS = ("commit_proxies", "grv_proxies", "resolvers", "logs",
@@ -42,6 +44,48 @@ def decode_conf(rows: list[tuple[bytes, bytes]]) -> dict[str, int]:
             out[name] = int(v)
         except ValueError:
             continue
+    return out
+
+
+def normalize_layout(layout: dict) -> dict:
+    """Resolve a layout's in-flight moves for recovery (the MoveKeys
+    cleanup recovery performs, REF:fdbserver/MoveKeys.actor.cpp):
+
+    - a move still in its dual-tagged phase (``state == "in"``) is rolled
+      BACK: the write team reverts to the source team (the sources hold
+      every mutation, because writes were replicated to both teams);
+    - a flipped move (``state == "flip"``) is rolled FORWARD: the layout's
+      teams already name the destination; only the journal entry drops.
+
+    Returns a plain {boundaries, teams} layout with read == write teams
+    and no move journal.  Idempotent."""
+    boundaries = [bytes(b) for b in layout["boundaries"]]
+    teams = [list(t) for t in layout["teams"]]
+    for mv in layout.get("moves") or []:
+        if mv.get("state") != "in":
+            continue
+        b, e = bytes(mv["begin"]), bytes(mv["end"])
+        import bisect as _b
+        idx = _b.bisect_right(boundaries, b)
+        lo = boundaries[idx - 1] if idx > 0 else b""
+        hi = boundaries[idx] if idx < len(boundaries) else b"\xff\xff\xff"
+        if lo == b and hi == e:
+            teams[idx] = list(mv["src"])
+    return {"boundaries": boundaries, "teams": teams}
+
+
+def flip_move_dest_entries(layout: dict) -> list[dict]:
+    """Storage entries for destinations of flipped-but-unpublished moves.
+
+    A crash between the flip transaction and the controller's state
+    publish leaves the destination replicas known only to the layout's
+    move journal; recovery merges these entries into the previous state's
+    storage list so the destinations rejoin instead of being refetched
+    from sources that already dropped the range."""
+    out: list[dict] = []
+    for mv in layout.get("moves") or []:
+        if mv.get("state") == "flip":
+            out.extend(dict(d) for d in mv.get("dest_info", []))
     return out
 
 

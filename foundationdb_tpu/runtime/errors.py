@@ -75,6 +75,11 @@ KeyTooLarge = _err(2102, "key_too_large", "Key length exceeds limit")
 ValueTooLarge = _err(2103, "value_too_large", "Value length exceeds limit")
 TransactionTooLarge = _err(2101, "transaction_too_large", "Transaction exceeds byte limit")
 
+WrongShardServer = _err(1001, "wrong_shard_server",
+                        "Shard is no longer served by this storage server "
+                        "(client must refresh its location map and retry); "
+                        "upstream's exact code was unverifiable this session "
+                        "— 1001 is reserved here for it")
 RequestMaybeDelivered = _err(1213, "request_maybe_delivered",
                              "Request may or may not have been delivered")
 
@@ -91,5 +96,5 @@ LogDataLoss = _err(2902, "log_data_loss",
 # path converts it to commit_unknown_result (1021) before the client's
 # retry loop can see it, because re-running a maybe-delivered commit is
 # not idempotent.
-_RETRYABLE = {1004, 1007, 1009, 1012, 1020, 1021, 1026, 1031, 1037, 1039, 1191, 1213, 2900}
+_RETRYABLE = {1001, 1004, 1007, 1009, 1012, 1020, 1021, 1026, 1031, 1037, 1039, 1191, 1213, 2900}
 _MAYBE_COMMITTED = {1021}

@@ -74,6 +74,7 @@ class Knobs:
     DD_ENABLED: bool = False                  # auto split/move loop on the CC
     DD_INTERVAL: float = 2.0                  # stats sampling period
     DD_SHARD_SPLIT_BYTES: int = 1 << 24       # split threshold (logical bytes)
+    DD_MOVE_TIMEOUT: float = 30.0             # live-move catch-up deadline
 
     # --- observability ---
     METRICS_INTERVAL: float = 5.0             # role *Metrics emit period

@@ -142,11 +142,16 @@ class SimulatedCluster:
                 for a in self.coord_addrs]
 
     async def wait_epoch(self, n: int, poll: float = 0.25) -> dict:
+        return await self.wait_state(lambda s: s.get("epoch", 0) >= n, poll)
+
+    async def wait_state(self, pred, poll: float = 0.25) -> dict:
+        """Poll the coordinators until the published cluster state
+        satisfies ``pred`` (e.g. a live move's seq bump)."""
         stubs = self.coordinator_stubs()
         while True:
             try:
                 state = await fetch_cluster_state(stubs)
-                if state.get("epoch", 0) >= n:
+                if pred(state):
                     return state
             except FdbError:
                 pass

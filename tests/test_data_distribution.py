@@ -1,4 +1,4 @@
-"""DataDistribution v1: shard stats → split → fetchKeys move, under load.
+"""DataDistribution layout helpers.
 
 Reference test model: REF:fdbserver/workloads/ (move/split under live
 writes must lose no rows and invent none).
@@ -6,14 +6,7 @@ writes must lose no rows and invent none).
 
 from __future__ import annotations
 
-import asyncio
-
-from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
-from foundationdb_tpu.core.data_distribution import (layout_of, move_layout,
-                                                     split_layout)
-from foundationdb_tpu.runtime.knobs import Knobs
-from foundationdb_tpu.runtime.simloop import run_simulation
-from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+from foundationdb_tpu.core.data_distribution import move_layout, split_layout
 
 
 def test_split_and_move_layout_helpers():
@@ -26,61 +19,6 @@ def test_split_and_move_layout_helpers():
     assert nxt == 4
 
 
-def test_hot_shard_splits_under_live_writes():
-    """Fill one shard past the split threshold while writes keep flowing;
-    the distributor must split it (new layout + recovery + fetchKeys) with
-    zero lost and zero phantom rows."""
-    async def main():
-        k = Knobs().override(DD_ENABLED=True, DD_INTERVAL=1.0,
-                             DD_SHARD_SPLIT_BYTES=6_000)
-        sim = SimulatedCluster(k, n_machines=6,
-                               spec=ClusterConfigSpec(min_workers=6))
-        await sim.start()
-        state1 = await sim.wait_epoch(1)
-        n_shards_before = len(state1["shard_teams"])
-        db = await sim.database()
-
-        written: dict[bytes, bytes] = {}
-        stop = asyncio.Event()
-
-        async def writer(wid: int) -> None:
-            i = 0
-            while not stop.is_set():
-                items = {b"hot%02d%05d" % (wid, i + j): b"v" * 40
-                         for j in range(5)}
-                i += 5
-
-                async def do(tr, items=items):
-                    for key, v in items.items():
-                        tr.set(key, v)
-                await db.run(do)
-                written.update(items)
-                await asyncio.sleep(0.05)
-
-        writers = [asyncio.ensure_future(writer(w)) for w in range(2)]
-        # wait for the split-driven recovery (epoch 2+) with writes live
-        state2 = await sim.wait_epoch(2)
-        # let a few more writes land after the flip
-        await asyncio.sleep(2.0)
-        stop.set()
-        await asyncio.gather(*writers)
-
-        assert len(state2["shard_teams"]) > n_shards_before
-        # every acknowledged row is present with the right value (no loss),
-        # and a full scan returns exactly the written hot keys (no phantoms)
-        tr = db.create_transaction()
-        while True:
-            try:
-                rows = await tr.get_range(b"hot", b"hou", limit=0)
-                break
-            except Exception as e:   # noqa: BLE001 — retry through recovery
-                await tr.on_error(e)
-        got = dict(rows)
-        missing = [key for key in written if key not in got]
-        assert not missing, f"{len(missing)} rows lost, e.g. {missing[:3]}"
-        wrong = [key for key, v in written.items() if got.get(key) != v]
-        assert not wrong, f"{len(wrong)} rows corrupted"
-        phantom = [key for key in got if key not in written]
-        assert not phantom, f"{len(phantom)} phantom rows, e.g. {phantom[:3]}"
-        await sim.stop()
-    run_simulation(main())
+# The split-under-live-writes scenario moved to
+# tests/test_live_move.py::test_live_split_without_recovery when
+# DataDistribution v2 made relocations live (no recovery involved).

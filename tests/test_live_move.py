@@ -1,0 +1,284 @@
+"""Live shard moves (MoveKeys v2): dual tagging, flip, no recovery.
+
+Reference test model: REF:fdbserver/MoveKeys.actor.cpp semantics — a
+shard relocation under live writes must lose no rows, invent none, and
+leave readers able to follow the handoff; a crash mid-move must roll
+back (dual phase) or forward (flipped) safely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+from foundationdb_tpu.core.data import Mutation, MutationType
+from foundationdb_tpu.core.shard_map import ShardMap, write_team_drops
+from foundationdb_tpu.core.system_data import (LAYOUT_KEY,
+                                               flip_move_dest_entries,
+                                               normalize_layout)
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+
+# --- unit: the layout diff that drives ownership handoff ---
+
+def test_write_team_drops_on_flip():
+    old = ShardMap([b"\x80", b"\xc0"], [[0], [1, 9], [2]])
+    new = ShardMap([b"\x80", b"\xc0"], [[0], [9], [2]])
+    assert write_team_drops(old, new) == [(1, b"\x80", b"\xc0")]
+
+
+def test_write_team_drops_none_on_start():
+    old = ShardMap([b"\x80"], [[0], [1]])
+    new = ShardMap([b"\x40", b"\x80"], [[0], [0, 5], [1]])  # split+dual
+    assert write_team_drops(old, new) == []
+
+
+def test_write_team_drops_merges_adjacent():
+    old = ShardMap([b"\x40", b"\x80"], [[3], [3], [1]])
+    new = ShardMap([b"\x40", b"\x80"], [[7], [7], [1]])
+    assert write_team_drops(old, new) == [(3, b"", b"\x80")]
+
+
+def test_normalize_layout_rolls_back_in_flight():
+    layout = {"boundaries": [b"\x40", b"\x80"],
+              "teams": [[0], [0, 5], [1]],
+              "moves": [{"begin": b"\x40", "end": b"\x80", "src": [0],
+                         "dest": [5], "state": "in"}]}
+    n = normalize_layout(layout)
+    assert n == {"boundaries": [b"\x40", b"\x80"], "teams": [[0], [0], [1]]}
+
+
+def test_normalize_layout_rolls_forward_flip():
+    layout = {"boundaries": [b"\x40", b"\x80"],
+              "teams": [[0], [5], [1]],
+              "moves": [{"begin": b"\x40", "end": b"\x80", "src": [0],
+                         "dest": [5], "state": "flip",
+                         "dest_info": [{"tag": 5, "worker": ["10.1.0.2", 1],
+                                        "addr": ["10.1.0.2", 1], "token": 77,
+                                        "begin": b"\x40", "end": b"\x80"}]}]}
+    n = normalize_layout(layout)
+    assert n["teams"] == [[0], [5], [1]]
+    assert [d["tag"] for d in flip_move_dest_entries(layout)] == [5]
+
+
+# --- unit: storage server ownership drop fencing ---
+
+def test_storage_drop_fences_reads():
+    from foundationdb_tpu.core.data import KeyRange
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+    from foundationdb_tpu.runtime.errors import WrongShardServer
+
+    async def main():
+        k = Knobs()
+        tlog = TLog(k)
+        ss = StorageServer(k, 0, KeyRange(b"", b"\xff"), tlog)
+        ss._apply(5, [Mutation.set(b"a", b"1"), Mutation.set(b"m", b"2")])
+        ss._apply(10, [Mutation(MutationType.PRIVATE_DROP_SHARD,
+                                b"m", b"\xff")])
+        ss._bump_version(11)
+        # below/at the drop version: still served from history
+        assert await ss.get_value(b"m", 10) == b"2"
+        # above it: refused so a stale-routed client refreshes
+        try:
+            await ss.get_value(b"m", 11)
+            raise AssertionError("expected wrong_shard_server")
+        except WrongShardServer:
+            pass
+        try:
+            await ss.get_key_values(b"a", b"z", 11)
+            raise AssertionError("expected wrong_shard_server")
+        except WrongShardServer:
+            pass
+        # the kept half is unaffected; the DURABLE shard narrowed (what
+        # the next boot declares) while the boot-time range keeps serving
+        # old-version history
+        assert await ss.get_value(b"a", 11) == b"1"
+        assert ss._meta_shard.end == b"m"
+        assert ss.shard.end == b"\xff"
+    run_simulation(main())
+
+
+# --- sim: the full live protocol under load ---
+
+def test_live_split_without_recovery():
+    """Fill one shard past the split threshold while writes keep flowing:
+    the distributor must relocate the hot half LIVE — epoch unchanged —
+    with zero lost and zero phantom rows, and both old and fresh client
+    views must read correctly afterwards."""
+    async def main():
+        k = Knobs().override(DD_ENABLED=True, DD_INTERVAL=1.0,
+                             DD_SHARD_SPLIT_BYTES=6_000)
+        sim = SimulatedCluster(k, n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        n_shards_before = len(state1["shard_teams"])
+        db = await sim.database()
+        stale_db = await sim.database()   # view frozen pre-move
+        stale_db.view.update(state1)
+
+        written: dict[bytes, bytes] = {}
+        stop = asyncio.Event()
+
+        async def writer(wid: int) -> None:
+            i = 0
+            while not stop.is_set():
+                items = {b"hot%02d%05d" % (wid, i + j): b"v" * 40
+                         for j in range(5)}
+                i += 5
+
+                async def do(tr, items=items):
+                    for key, v in items.items():
+                        tr.set(key, v)
+                await db.run(do)
+                written.update(items)
+                await asyncio.sleep(0.05)
+
+        writers = [asyncio.ensure_future(writer(w)) for w in range(2)]
+        # wait for the flip's publish: seq advances, epoch must NOT
+        state2 = await sim.wait_state(
+            lambda s: s.get("seq", 0) > 0
+            and len(s["shard_teams"]) > n_shards_before)
+        await asyncio.sleep(2.0)          # let writes land post-flip
+        stop.set()
+        await asyncio.gather(*writers)
+
+        assert state2["epoch"] == state1["epoch"], \
+            "live move must not trigger a recovery"
+        for fresh in (db, stale_db):
+            tr = fresh.create_transaction()
+            while True:
+                try:
+                    rows = await tr.get_range(b"hot", b"hou", limit=0)
+                    break
+                except Exception as e:   # noqa: BLE001 — follow the move
+                    await tr.on_error(e)
+            got = dict(rows)
+            missing = [key for key in written if key not in got]
+            assert not missing, f"{len(missing)} rows lost, e.g. {missing[:3]}"
+            wrong = [key for key, v in written.items() if got.get(key) != v]
+            assert not wrong, f"{len(wrong)} rows corrupted"
+            phantom = [key for key in got if key not in written]
+            assert not phantom, f"{len(phantom)} phantoms, e.g. {phantom[:3]}"
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_live_split_multi_proxy_multi_resolver():
+    """With TWO commit proxies and TWO resolvers, a live move's layout
+    change committed through one proxy must reach the other through the
+    resolver state stream before it tags any later batch — otherwise the
+    second proxy keeps writing to the dropped source and rows vanish."""
+    async def main():
+        k = Knobs().override(DD_ENABLED=True, DD_INTERVAL=1.0,
+                             DD_SHARD_SPLIT_BYTES=6_000)
+        sim = SimulatedCluster(
+            k, n_machines=6,
+            spec=ClusterConfigSpec(min_workers=6, commit_proxies=2,
+                                   grv_proxies=2, resolvers=2))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        db = await sim.database()
+
+        written: dict[bytes, bytes] = {}
+        stop = asyncio.Event()
+
+        async def writer(wid: int) -> None:
+            i = 0
+            while not stop.is_set():
+                # fresh transactions pick proxies at random, so both
+                # proxies carry writes through the move window
+                items = {b"hot%02d%05d" % (wid, i + j): b"w" * 40
+                         for j in range(5)}
+                i += 5
+
+                async def do(tr, items=items):
+                    for key, v in items.items():
+                        tr.set(key, v)
+                await db.run(do)
+                written.update(items)
+                await asyncio.sleep(0.04)
+
+        writers = [asyncio.ensure_future(writer(w)) for w in range(3)]
+        state2 = await sim.wait_state(lambda s: s.get("seq", 0) > 0)
+        await asyncio.sleep(2.0)
+        stop.set()
+        await asyncio.gather(*writers)
+        assert state2["epoch"] == state1["epoch"]
+
+        tr = db.create_transaction()
+        while True:
+            try:
+                rows = await tr.get_range(b"hot", b"hou", limit=0)
+                break
+            except Exception as e:   # noqa: BLE001 — follow the move
+                await tr.on_error(e)
+        got = dict(rows)
+        missing = [key for key in written if key not in got]
+        assert not missing, f"{len(missing)} rows lost, e.g. {missing[:3]}"
+        phantom = [key for key in got if key not in written]
+        assert not phantom, f"{len(phantom)} phantoms, e.g. {phantom[:3]}"
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_recovery_mid_move_rolls_back():
+    """A dual-tagged (phase-1) move interrupted by a recovery must roll
+    back to the source team with every row intact."""
+    async def main():
+        from foundationdb_tpu.rpc.wire import decode, encode
+        k = Knobs()
+        sim = SimulatedCluster(k, n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        db = await sim.database()
+        rows = {b"mv%04d" % i: b"x" * 20 for i in range(50)}
+
+        async def put(tr):
+            for key, v in rows.items():
+                tr.set(key, v)
+        await db.run(put)
+
+        # hand-commit a startMove layout: dual team + "in" journal, with a
+        # destination tag that will never exist
+        boundaries = [bytes(b) for b in state1["shard_boundaries"]]
+        teams = [list(t) for t in state1["shard_teams"]]
+        idx = 0
+        src = list(teams[idx])
+        dest = [max(s["tag"] for s in state1["storage"]) + 1]
+        begin = b""
+        end = boundaries[0] if boundaries else b"\xff\xff\xff"
+        teams[idx] = src + dest
+        layout = {"boundaries": boundaries, "teams": teams,
+                  "moves": [{"begin": begin, "end": end, "src": src,
+                             "dest": dest, "state": "in"}]}
+
+        async def start_move(tr):
+            tr.set(LAYOUT_KEY, encode(layout))
+        await db.run(start_move)
+
+        # writes in the dual window reach the (phantom) dest tag AND src
+        async def dual(tr):
+            for i in range(50, 70):
+                tr.set(b"mv%04d" % i, b"y" * 20)
+        await db.run(dual)
+        rows.update({b"mv%04d" % i: b"y" * 20 for i in range(50, 70)})
+
+        # force a recovery: kill a txn-role machine (not storage/coord)
+        victims = await sim.txn_only_machines()
+        assert victims, "need a pure txn machine to kill"
+        await victims[0].kill()
+        state2 = await sim.wait_epoch(state1["epoch"] + 1)
+        assert state2["shard_teams"][idx] == src, \
+            "recovery must roll the in-flight move back to src"
+
+        got = dict(await db.get_range(b"mv", b"mw", limit=0))
+        assert got == rows, (
+            f"{len(set(rows) - set(got))} lost / "
+            f"{len(set(got) - set(rows))} phantom after rollback")
+        await sim.stop()
+    run_simulation(main())
