@@ -1,27 +1,40 @@
-"""Backup/restore v1 — consistent snapshot backups to files.
+"""Backup/restore — snapshot backups + continuous mutation log (PITR).
 
 Reference: REF:fdbclient/FileBackupAgent.actor.cpp +
 REF:fdbbackup/backup.actor.cpp — the file-based backup writes range files
-(a consistent key-value cut) plus a manifest; restore streams them back
-through ordinary transactions.
+(a consistent key-value cut) plus mutation-log files; restore streams the
+snapshot back and replays the logs to a target version.
 
-v1 scope: full snapshot backup at one read version (every range page is
-read at the same version, so the backup is a strictly consistent cut of
-the database) and full restore, over the IAsyncFile abstraction (lossy
-sim files in simulation, real files in deployment).  The reference's
-continuous mutation-log backup (point-in-time restore between snapshots)
-is future work and noted in the manifest format.
+Two layers:
+
+1. **Snapshot** (`backup()`): every range page read at ONE pinned version
+   — a strictly consistent cut.
+2. **Continuous mutation log** (`start_continuous()`): a state
+   transaction sets ``\\xff/backup/tag``, after which every commit proxy
+   pushes the full ordered mutation stream under the backup tag too (the
+   reference's backup mutation tags); this agent pulls that tag from the
+   TLogs like a storage server would, writes versioned ``.mlog`` files,
+   and pops what it has made durable.  ``restore(to_version=...)`` then
+   replays logs in ``(snapshot_version, to_version]`` over the restored
+   snapshot — point-in-time restore to any covered version.
 """
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 
 from ..client.database import Database
-from ..core.data import SYSTEM_PREFIX
+from ..core.data import MAX_VERSION, MutationType, SYSTEM_PREFIX, Version
+from ..core.system_data import BACKUP_PREFIX
 from ..rpc.wire import decode, encode
 from ..runtime.errors import FdbError
 from ..runtime.trace import TraceEvent
+
+# well-known mutation-log tag, far above any storage tag DataDistribution
+# will ever allocate (DD uses max(existing storage tag)+1)
+BACKUP_TAG = 1 << 20
+RESTORE_PROGRESS_KEY = BACKUP_PREFIX + b"restore_progress"
 
 
 class RestoreError(FdbError):
@@ -57,6 +70,156 @@ class BackupAgent:
         self.fs = fs
         self.dir = directory.rstrip("/")
         self.rows_per_file = rows_per_file
+        self._pull_task: asyncio.Task | None = None
+        self._log_files: list[tuple[Version, Version, str]] = []
+        self._log_begin: Version | None = None
+        self._pulled_through: Version = 0
+        self._ls = None                 # cached TLog view for pops
+
+    # --- continuous mutation log (REF: backup mutation tags) ---
+
+    async def start_continuous(self) -> Version:
+        """Activate the backup tag on every commit proxy (via the
+        ``\\xff/backup/tag`` state transaction) and start pulling the
+        mutation stream.  Returns the activation version: every mutation
+        strictly after it is captured."""
+        if self._pull_task is not None and not self._pull_task.done():
+            raise RestoreError("continuous backup already running")
+        vb = await self._commit_tag(encode(BACKUP_TAG))
+        self._log_begin = vb
+        self._log_files = []        # a fresh activation: fresh file set
+        self._pulled_through = vb
+        await self._save_log_manifest()
+        self._pull_task = asyncio.get_running_loop().create_task(
+            self._pull_loop(vb + 1), name="backup-pull")
+        TraceEvent("BackupContinuousStarted").detail("Version", vb).log()
+        return vb
+
+    async def stop_continuous(self, drain_timeout: float = 10.0) -> None:
+        """Deactivate the tag, drain the stream through the deactivation
+        version, and release the TLogs."""
+        ve = await self._commit_tag(None)
+        try:
+            await asyncio.wait_for(self._drained(ve), timeout=drain_timeout)
+        except asyncio.TimeoutError:
+            TraceEvent("BackupDrainTimeout", severity=30) \
+                .detail("Through", self._pulled_through).log()
+        if self._pull_task is not None:
+            self._pull_task.cancel()
+            try:
+                await self._pull_task
+            except asyncio.CancelledError:
+                pass
+            self._pull_task = None
+        if self._ls is not None:
+            # release only what was drained — NOT MAX_VERSION, which would
+            # permanently un-pin the tag for this generation and let a
+            # later re-activation's unpulled frames be discarded before
+            # the agent reads them.  The tag stops constraining the disk
+            # queue once popped past its last pushed version (TLog.pop's
+            # tag-tip retirement), so this does not pin the queue either.
+            self._ls.pop(BACKUP_TAG, self._pulled_through + 1)
+        # persist the drained frontier: restore's coverage check reads it
+        await self._save_log_manifest()
+        TraceEvent("BackupContinuousStopped").detail("Version", ve) \
+            .detail("PulledThrough", self._pulled_through).log()
+
+    async def _drained(self, version: Version) -> None:
+        while self._pulled_through < version:
+            await asyncio.sleep(0.1)
+
+    async def _commit_tag(self, value: bytes | None) -> Version:
+        tr = self.db.create_transaction()
+        while True:
+            try:
+                if value is None:
+                    tr.clear(BACKUP_PREFIX + b"tag")
+                else:
+                    tr.set(BACKUP_PREFIX + b"tag", value)
+                return await tr.commit()
+            except Exception as e:  # noqa: BLE001 — retry via on_error
+                await tr.on_error(e)
+
+    async def _log_view(self):
+        """A TLog view built from the freshest published cluster state —
+        rebuilt whenever a recovery invalidates the old generation."""
+        from ..core.cluster_client import fetch_cluster_state
+        from ..core.log_system import LogSystem
+        from ..core.worker import generations_from_config
+        state = await fetch_cluster_state(self.db.coordinators)
+        gens = generations_from_config(state["log_cfg"],
+                                       self.db.view.transport, 0)
+        self._ls = LogSystem(gens)
+        return self._ls
+
+    async def _pull_loop(self, begin: Version) -> None:
+        idx = 0
+        cursor = None
+        while True:
+            try:
+                if cursor is None:
+                    cursor = (await self._log_view()).cursor(
+                        BACKUP_TAG, self._pulled_through + 1)
+                reply = await cursor.next()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — recovery/partition: re-view
+                TraceEvent("BackupPullError", severity=20).detail("Error", repr(e)[:200]).detail("Through", self._pulled_through).log()
+                cursor = None
+                await asyncio.sleep(0.25)
+                continue
+            if not reply.entries \
+                    and reply.end_version - 1 <= self._pulled_through:
+                # no progress: either idle, or a recovery locked this
+                # generation and our view predates it (a locked log
+                # answers peeks immediately with an unmoving tip).
+                # Re-fetch the published state so the cursor rolls into
+                # the new generation when there is one.
+                await asyncio.sleep(0.25)
+                cursor = None
+                continue
+            if reply.entries:
+                first = reply.entries[0][0]
+                last = reply.entries[-1][0]
+                # the activation version in the name keeps re-activated
+                # backups from truncating a previous run's files out from
+                # under their manifest entries
+                name = f"{self.dir}/log-{self._log_begin}-{idx:06d}.mlog"
+                idx += 1
+                try:
+                    f = self.fs.open(name)
+                    await f.truncate(0)
+                    await f.write(0, encode([[v, list(muts)]
+                                             for v, muts in reply.entries]))
+                    await f.sync()
+                    self._log_files.append((first, last, name))
+                    await self._save_log_manifest()
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — fs error: retry pull
+                    TraceEvent("BackupWriteError", severity=30) \
+                        .detail("Error", repr(e)[:200]).detail("File", name) \
+                        .log()
+                    # roll back bookkeeping; the frontier has not advanced,
+                    # so the next pull regenerates this span (replay dedupes
+                    # by version if the half-written file survived)
+                    if self._log_files and self._log_files[-1][2] == name:
+                        self._log_files.pop()
+                    await asyncio.sleep(0.25)
+                    continue
+            # durable (or empty): the TLogs may discard what we hold
+            self._pulled_through = max(self._pulled_through,
+                                       reply.end_version - 1)
+            self._ls.pop(BACKUP_TAG, reply.end_version)
+
+    async def _save_log_manifest(self) -> None:
+        mf = self.fs.open(f"{self.dir}/logs.manifest")
+        await mf.truncate(0)
+        await mf.write(0, encode({
+            "begin": self._log_begin,
+            "through": self._pulled_through,
+            "files": [[b, e, n] for b, e, n in self._log_files]}))
+        await mf.sync()
 
     # --- backup ---
 
@@ -116,9 +279,12 @@ class BackupAgent:
 
     async def restore(self, clear_first: bool = True,
                       begin: bytes = b"",
-                      end: bytes = SYSTEM_PREFIX) -> BackupManifest:
+                      end: bytes = SYSTEM_PREFIX,
+                      to_version: Version | None = None) -> BackupManifest:
         """Load the manifest and stream every range file back in through
-        transactions (idempotent sets — safe to retry)."""
+        transactions (idempotent sets — safe to retry).  With a mutation
+        log present, the stream in ``(snapshot_version, to_version]`` is
+        replayed on top — point-in-time restore."""
         mf = self.fs.open(f"{self.dir}/manifest")
         raw = await mf.read(0, mf.size())
         if not raw:
@@ -147,5 +313,103 @@ class BackupAgent:
         if restored != manifest.rows:
             raise RestoreError(
                 f"manifest promises {manifest.rows} rows, restored {restored}")
-        TraceEvent("RestoreComplete").detail("Rows", restored).log()
+        replayed = await self._replay_logs(manifest.version, to_version)
+        TraceEvent("RestoreComplete").detail("Rows", restored) \
+            .detail("Replayed", replayed).detail("ToVersion", to_version).log()
         return manifest
+
+    # --- mutation-log replay (the PITR half of restore) ---
+
+    async def _replay_logs(self, snapshot_version: Version,
+                           to_version: Version | None) -> int:
+        """Replay logged mutations in (snapshot_version, to_version] in
+        version order.  Atomic ops re-evaluate against the restored base
+        state — the same inputs in the same order as the original
+        cluster, so the results are identical.  Each chunk's transaction
+        is guarded by a progress key: a retry after an ambiguous commit
+        sees the progress and skips, so non-idempotent atomics never
+        double-apply."""
+        mf = self.fs.open(f"{self.dir}/logs.manifest")
+        raw = await mf.read(0, mf.size())
+        if not raw:
+            if to_version is not None:
+                raise RestoreError("to_version given but no mutation log")
+            return 0
+        meta = decode(raw)
+        vt = to_version if to_version is not None else MAX_VERSION
+        if to_version is not None and meta.get("through", 0) < to_version:
+            raise RestoreError(
+                f"log covers through {meta.get('through')}, "
+                f"wanted {to_version}")
+        # lower-bound coverage: the log stream starts strictly after its
+        # activation version; if the tag was armed AFTER the snapshot was
+        # cut (or re-armed, resetting the file set), mutations in
+        # (snapshot, begin] are simply not in any file — replaying would
+        # silently produce a wrong database
+        log_begin = meta.get("begin")
+        if log_begin is None or log_begin > snapshot_version:
+            if to_version is not None:
+                raise RestoreError(
+                    f"log begins at {log_begin}, after snapshot "
+                    f"{snapshot_version}: coverage hole "
+                    f"({snapshot_version}, {log_begin}]")
+            TraceEvent("RestoreLogsSkipped", severity=30) \
+                .detail("LogBegin", log_begin) \
+                .detail("SnapshotVersion", snapshot_version).log()
+            return 0
+        # a progress key left by a CRASHED earlier restore must not make
+        # this one skip chunks — clear it before replay starts
+        async def pre(tr):
+            tr.clear(RESTORE_PROGRESS_KEY)
+        await self.db.run(pre)
+        # keyed by version so a file re-written after a mid-write pull
+        # retry can overlap a predecessor without double-applying atomics
+        # (a version's mutation list is deterministic, so last-wins is
+        # also first-wins)
+        by_version: dict[int, list] = {}
+        for first, last, name in meta["files"]:
+            if last <= snapshot_version or first > vt:
+                continue
+            f = self.fs.open(name)
+            entries = decode(await f.read(0, f.size()))
+            for v, muts in entries:
+                if v <= snapshot_version or v > vt:
+                    continue
+                by_version[v] = muts
+        chunks: list[list] = [[]]
+        for v in sorted(by_version):
+            chunks[-1].extend(by_version[v])
+            if len(chunks[-1]) >= 500:
+                chunks.append([])
+        replayed = 0
+        for idx, chunk in enumerate(c for c in chunks if c):
+            async def apply(tr, idx=idx, chunk=chunk):
+                cur = await tr.get(RESTORE_PROGRESS_KEY)
+                if cur is not None and int(cur) >= idx:
+                    return
+                for m in chunk:
+                    self._replay_one(tr, m)
+                tr.set(RESTORE_PROGRESS_KEY, b"%d" % idx)
+            await self.db.run(apply)
+            replayed += len(chunk)
+        async def done(tr):
+            tr.clear(RESTORE_PROGRESS_KEY)
+        await self.db.run(done)
+        return replayed
+
+    @staticmethod
+    def _replay_one(tr, m) -> None:
+        t = MutationType(m.type)
+        if t == MutationType.PRIVATE_DROP_SHARD:
+            return
+        if t == MutationType.CLEAR_RANGE:
+            e = min(m.param2, SYSTEM_PREFIX)
+            if m.param1 < e:
+                tr.clear_range(m.param1, e)
+            return
+        if m.param1 >= SYSTEM_PREFIX:
+            return          # the old cluster's metadata must not replay
+        if t == MutationType.SET_VALUE:
+            tr.set(m.param1, m.param2)
+        else:
+            tr.atomic_op(t, m.param1, m.param2)

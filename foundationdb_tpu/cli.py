@@ -107,7 +107,8 @@ class Cli:
             if cmd == "backup":
                 m = await agent.backup()
                 return f"Backup complete: {m.rows} rows at version {m.version}"
-            m = await agent.restore()
+            to_version = int(args[1]) if len(args) > 1 else None
+            m = await agent.restore(to_version=to_version)
             return f"Restore complete: {m.rows} rows (snapshot version {m.version})"
         if cmd in ("exclude", "include"):
             from .core import management

@@ -74,11 +74,16 @@ class ClusterController:
         # storage tags resident on registered workers' disks (reboot
         # adoption; maintained by the cluster host)
         self.resident: dict[int, tuple[NetworkAddress, int]] = {}
+        # durable TLog copies resident on rebooted machines, keyed by the
+        # (epoch, index, recruitment-nonce) identity in their filenames
+        self.resident_tlogs: dict[tuple[int, int, int | None],
+                                  tuple[NetworkAddress, int]] = {}
         # tags successfully rejoined/recruited in the current epoch: a
         # registration reporting a resident tag OUTSIDE this set asks for
         # a recovery (the replica is stranded until rejoined)
         self.active_tags: set[int] = set()
         self._recovery_requested: asyncio.Event = asyncio.Event()
+        self._attempt_recruits: list[tuple[NetworkAddress, int]] = []
         self._stopped = False
 
     def request_recovery(self, reason: str = "") -> None:
@@ -96,7 +101,30 @@ class ClusterController:
     async def _recruit(self, wa: NetworkAddress, role: str,
                        params: dict) -> tuple[list, int]:
         token = await self.workers[wa].recruit(role, params)
+        self._attempt_recruits.append((wa, token))
         return [wa.ip, wa.port], token
+
+    async def _stop_attempt_recruits(self) -> None:
+        """Tear down a FAILED recovery attempt's recruits.  Orphaned
+        pipelines are not just waste: an orphan sequencer+proxy pair keeps
+        minting versions into TLogs no coordinated state knows about, and
+        anything that consumed them (a rejoined storage server) ends up
+        durably AHEAD of every recoverable generation — wedging all
+        future recoveries with transaction_too_old."""
+        recruits, self._attempt_recruits = self._attempt_recruits, []
+        for wa, token in recruits:
+            w = self.workers.get(wa)
+            if w is None:
+                continue
+            try:
+                # destroy=True: a failed attempt's durable files (TLog
+                # queues, storage engines) must be GC'd, not just stopped
+                # — left on disk they'd be reported resident after a
+                # reboot and could shadow the committed epoch's real data
+                await asyncio.wait_for(w.stop_role(token, True),
+                                       timeout=self.knobs.FAILURE_TIMEOUT)
+            except (Exception, asyncio.TimeoutError):  # noqa: BLE001
+                pass        # dead worker: its roles died with it
 
     # --- the recovery state machine ---
 
@@ -105,6 +133,7 @@ class ClusterController:
         k, spec = self.knobs, self.spec
         new_epoch = (prev_state["epoch"] + 1) if prev_state else 1
         self.recovery_state = "LOCKING_CSTATE"
+        self._attempt_recruits = []
         TraceEvent("RecoveryStarted").detail("Epoch", new_epoch).log()
 
         # ---- lock the previous generation, compute recovery version ----
@@ -131,14 +160,39 @@ class ClusterController:
             dead: list[int] = list(cur.get("dead", []))
             ct = self.transport
             for i, (ip, port) in enumerate(cur["tlogs"]):
-                stub = TLogClient(ct, NetworkAddress(ip, port), cur["token"][i]
-                                  if "token" in cur else self.base)
-                try:
-                    tips.append(await asyncio.wait_for(
-                        stub.lock(), timeout=k.FAILURE_TIMEOUT * 2))
-                except (FdbError, asyncio.TimeoutError):
-                    if i not in dead:
-                        dead.append(i)
+                # lock the recorded copy; failing that, a rebooted
+                # machine's reopened durable copy (same DiskQueue frames,
+                # fresh address/token) — whole-cluster power loss
+                # recovers through these
+                candidates = [(NetworkAddress(ip, port),
+                               cur["token"][i] if "token" in cur
+                               else self.base)]
+                nonces = cur.get("nonce") or [None] * len(cur["tlogs"])
+                res = self.resident_tlogs.get(
+                    (cur.get("epoch"), i, nonces[i]))
+                if res is not None and res[0] in self.workers:
+                    candidates.append(res)
+                locked = False
+                for addr_c, tok_c in candidates:
+                    stub = TLogClient(ct, addr_c, tok_c)
+                    try:
+                        tips.append(await asyncio.wait_for(
+                            stub.lock(), timeout=k.FAILURE_TIMEOUT * 2))
+                    except (FdbError, asyncio.TimeoutError):
+                        continue
+                    if (addr_c, tok_c) != candidates[0]:
+                        cur["tlogs"][i] = (addr_c.ip, addr_c.port)
+                        cur.setdefault("token",
+                                       [self.base] * len(cur["tlogs"]))
+                        cur["token"][i] = tok_c
+                        TraceEvent("TLogAdopted") \
+                            .detail("Epoch", cur.get("epoch")) \
+                            .detail("Index", i) \
+                            .detail("Addr", str(addr_c)).log()
+                    locked = True
+                    break
+                if not locked and i not in dead:
+                    dead.append(i)
             n = len(cur["tlogs"])
             # every storage tag needs a live replica in the locked
             # generation; a tag whose every hosting log is dead means real
@@ -164,7 +218,7 @@ class ClusterController:
         # read): \xff/conf/ overrides the recruitment spec and
         # \xff/keyServers/layout carries DataDistribution's desired shard
         # layout, both written by ordinary transactions ----
-        spec, layout, excluded = await self._read_system_state(
+        spec, layout, excluded, backup_tag = await self._read_system_state(
             prev_state, spec)
 
         # ---- recruit the new transaction subsystem ----
@@ -185,11 +239,20 @@ class ClusterController:
         seq_addr, seq_tok = await self._recruit(
             pick(0), "sequencer", {"v0": rv})
 
-        tlog_addrs, tlog_toks = [], []
+        from ..runtime.rng import deterministic_random
+        rng = deterministic_random()
+        tlog_addrs, tlog_toks, tlog_nonces = [], [], []
         for i in range(spec.logs):
-            a, t = await self._recruit(pick(1 + i), "tlog", {"v0": rv})
+            # the nonce disambiguates THIS recruitment's durable file from
+            # any failed earlier attempt's leftover for the same
+            # (epoch, index) — reboot adoption matches on the full triple
+            nonce = rng.random_int(1, 1 << 40)
+            a, t = await self._recruit(pick(1 + i), "tlog",
+                                       {"v0": rv, "epoch": new_epoch,
+                                        "index": i, "nonce": nonce})
             tlog_addrs.append(a)
             tlog_toks.append(t)
+            tlog_nonces.append(nonce)
 
         new_gen = {
             "epoch": new_epoch,
@@ -199,6 +262,7 @@ class ClusterController:
             "replication": min(spec.log_replication, spec.logs),
             "dead": [],
             "token": tlog_toks,
+            "nonce": tlog_nonces,
         }
         log_cfg = old_log_cfg + [new_gen]
 
@@ -221,6 +285,11 @@ class ClusterController:
         wire_log_cfg = [self._wire_gen(g) for g in log_cfg]
         storage_meta: list[dict] = []
         active_tags: set[int] = set()
+        # rejoin RPCs run AFTER the coordinated state commits (pass 2):
+        # a storage server must never consume versions from a generation
+        # no cstate records — a failed attempt's orphan pipeline would
+        # push it durably ahead of every recoverable generation
+        rejoin_plan: list[tuple[NetworkAddress, dict]] = []
         if prev_state:
             prev_storage = list(prev_state["storage"])
             if layout:
@@ -288,21 +357,7 @@ class ClusterController:
                             # skipped now; a registration reporting the tag
                             # resident re-triggers recovery via active_tags
                             continue
-                        try:
-                            ok = await asyncio.wait_for(
-                                w.rejoin_storage(s["token"], wire_log_cfg, rv),
-                                timeout=k.FAILURE_TIMEOUT * 4)
-                            if not ok:
-                                # the worker no longer hosts that token (a
-                                # rebooted incarnation): stranding the
-                                # replica silently would hide data loss —
-                                # fail and retry (the resident report will
-                                # enable adoption)
-                                raise FdbError("storage role missing at token")
-                            active_tags.add(tag)
-                        except asyncio.TimeoutError:
-                            TraceEvent("StorageRejoinFailed", severity=30) \
-                                .detail("Tag", s["tag"]).log()
+                        rejoin_plan.append((wa, s))
                     else:
                         # moved/split-in range: fetch from a live replica of
                         # the covering source shard
@@ -366,6 +421,7 @@ class ClusterController:
             "log_cfg": wire_log_cfg,
             "shard_boundaries": boundaries, "shard_teams": teams,
             "ratekeeper": rk_addr, "ratekeeper_token": rk_tok,
+            "backup_tag": backup_tag,
         }
         commit_info, grv_info = [], []
         for i in range(spec.commit_proxies):
@@ -396,6 +452,31 @@ class ClusterController:
         }
         await self.cstate.write(state)
         self.last_state = state
+        self._attempt_recruits = []      # committed: these roles ARE the epoch
+
+        # ---- pass 2: rejoin storage onto the now-COMMITTED generation.
+        # A failure here cannot orphan anything (the epoch is in cstate;
+        # the next recovery locks this generation, whose tips are >= all
+        # versions any rejoined server will ever apply) — so failures log
+        # and request another recovery instead of raising. ----
+        for wa, s in rejoin_plan:
+            w = self.workers.get(wa)
+            try:
+                ok = await asyncio.wait_for(
+                    w.rejoin_storage(s["token"], wire_log_cfg, rv),
+                    timeout=k.FAILURE_TIMEOUT * 4)
+                if not ok:
+                    # the worker no longer hosts that token (a rebooted
+                    # incarnation): the resident report enables adoption
+                    # at the next epoch
+                    raise FdbError("storage role missing at token")
+                active_tags.add(s["tag"])
+            except (FdbError, asyncio.TimeoutError) as e:
+                TraceEvent("StorageRejoinFailed", severity=30) \
+                    .detail("Tag", s["tag"]).detail("Error", repr(e)[:100]) \
+                    .log()
+                self.request_recovery(f"storage_rejoin_failed tag={s['tag']}")
+
         self.active_tags = active_tags
         self.recovery_state = "ACCEPTING_COMMITS"
         TraceEvent("RecoveryComplete").detail("Epoch", new_epoch) \
@@ -431,10 +512,10 @@ class ClusterController:
         from ..rpc.stubs import StorageClient
         from ..rpc.wire import decode
         from .data import KeyRange, SYSTEM_PREFIX
-        from .system_data import (KEY_SERVERS_PREFIX, decode_conf,
-                                  spec_with_conf)
+        from .system_data import (BACKUP_PREFIX, KEY_SERVERS_PREFIX,
+                                  decode_conf, spec_with_conf)
         if not prev_state:
-            return spec, None, set()
+            return spec, None, set(), None
         sys_end = SYSTEM_PREFIX + b"\xfe"
         for s in prev_state.get("storage", []):
             if not (s["begin"] <= SYSTEM_PREFIX < s["end"]):
@@ -456,19 +537,26 @@ class ClusterController:
             from .management import decode_excluded
             excluded = decode_excluded(rows)
             layout = None
+            backup_tag = None
             for key, v in rows:
                 if key == KEY_SERVERS_PREFIX + b"layout":
                     try:
                         layout = decode(v)
                     except Exception:  # noqa: BLE001 — bad layout ignored
                         layout = None
-            if conf or layout or excluded:
+                elif key == BACKUP_PREFIX + b"tag":
+                    try:
+                        backup_tag = int(decode(v))
+                    except Exception:  # noqa: BLE001 — bad tag ignored
+                        backup_tag = None
+            if conf or layout or excluded or backup_tag is not None:
                 TraceEvent("RecoveryReadSystemState") \
                     .detail("Conf", str(conf)) \
                     .detail("Excluded", sorted(excluded)) \
+                    .detail("BackupTag", backup_tag) \
                     .detail("HasLayout", layout is not None).log()
-            return spec_with_conf(spec, conf), layout, excluded
-        return spec, None, set()
+            return spec_with_conf(spec, conf), layout, excluded, backup_tag
+        return spec, None, set(), None
 
     @staticmethod
     def _wire_gen(g: dict) -> dict:
@@ -498,11 +586,13 @@ class ClusterController:
             except FdbError as e:
                 TraceEvent("RecoveryFailed", severity=30) \
                     .detail("Error", e.name).detail("Msg", str(e)).log()
+                await self._stop_attempt_recruits()
                 await asyncio.sleep(self.knobs.RECOVERY_RETRY_DELAY)
                 continue
             except Exception as e:  # noqa: BLE001 — a wedged CC is worse
                 TraceEvent("RecoveryFailed", severity=40) \
                     .detail("Error", repr(e)[:200]).log()
+                await self._stop_attempt_recruits()
                 await asyncio.sleep(self.knobs.RECOVERY_RETRY_DELAY)
                 continue
             # watch every txn-subsystem address

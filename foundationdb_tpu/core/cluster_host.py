@@ -50,6 +50,8 @@ class ClusterHost:
                              client_transport_factory, base_token,
                              fs=fs, data_dir=data_dir)
         self._resident_map: dict[int, tuple[NetworkAddress, int]] = {}
+        self._resident_tlog_map: dict[tuple[int, int, int | None],
+                                      tuple[NetworkAddress, int]] = {}
         self._client_t = client_transport_factory()
         self._registry: dict[NetworkAddress, WorkerClient] = {}
         self._leading = False
@@ -66,17 +68,24 @@ class ClusterHost:
     # --- CC RPC surface (live on every host; meaningful when leading) ---
 
     async def register_worker(self, addr: list, worker_token: int,
-                              resident: dict | None = None) -> bool:
+                              resident: dict | None = None,
+                              resident_tlogs: dict | None = None) -> bool:
         """RegisterWorkerRequest analog; False tells the caller this host
         is not (or no longer) the cluster controller.  ``resident`` maps
-        storage tags this worker holds on disk to their serving tokens, so
-        a rebooted machine's replicas can be adopted back."""
+        storage tags this worker holds on disk to their serving tokens;
+        ``resident_tlogs`` maps (epoch, index, nonce) TLog copy
+        identities to tokens — so a rebooted machine's replicas and log
+        copies can be adopted back."""
         if not self._leading:
             return False
         wa = NetworkAddress(addr[0], addr[1])
         if wa not in self._registry:
             self._registry[wa] = WorkerClient(self._client_t, wa, worker_token)
             TraceEvent("CCRegisteredWorker").detail("Worker", str(wa)).log()
+        if resident_tlogs and self.cc is not None:
+            for key, token in resident_tlogs.items():
+                self._resident_tlog_map[tuple(key)] = (wa, int(token))
+            self.cc.resident_tlogs = self._resident_tlog_map
         if resident and self.cc is not None:
             new_tags = []
             for tag, token in resident.items():
@@ -147,10 +156,13 @@ class ClusterHost:
             self._client_t, self.address, self.worker.base)
         for tag, token in self.worker.resident.items():
             self._resident_map[tag] = (self.address, token)
+        for key, token in self.worker.resident_tlogs.items():
+            self._resident_tlog_map[key] = (self.address, token)
         cstate = CoordinatedState(self.coordinators, self.id)
         self.cc = ClusterController(k, self.make_client_transport(), cstate,
                                     self._registry, self.spec, self.base)
         self.cc.resident = self._resident_map
+        self.cc.resident_tlogs = self._resident_tlog_map
         self._leading = True
         cc_task = asyncio.get_running_loop().create_task(
             self._run_cc(), name=f"cc-{self.id}")
@@ -220,7 +232,8 @@ class ClusterHost:
             try:
                 ok = await asyncio.wait_for(
                     stub.register_worker(me, self.worker.base,
-                                         dict(self.worker.resident)),
+                                         dict(self.worker.resident),
+                                         dict(self.worker.resident_tlogs)),
                     timeout=k.FAILURE_TIMEOUT * 2)
             except (Exception, asyncio.TimeoutError):
                 ok = False

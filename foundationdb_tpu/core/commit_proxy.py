@@ -35,7 +35,15 @@ def is_state_txn(req: CommitTransactionRequest) -> bool:
     transaction" (REF:fdbserver/CommitProxyServer.actor.cpp
     txnStateTransactions): its mutations must be applied by EVERY commit
     proxy in version order, so it is resolved alone in its batch with
-    unclipped conflict ranges on every resolver."""
+    unclipped conflict ranges on every resolver.
+
+    Verdict-agreement invariant: every resolver must compute the SAME
+    verdict for a state transaction, or the proxies' metadata histories
+    diverge.  Unclipped ranges alone don't give that — resolvers' write
+    HISTORIES are per-partition — so state transactions may take read
+    conflicts only within the system keyspace, whose full write history
+    every resolver holds (all ``\\xff`` writes arrive via broadcast
+    state transactions).  The proxy rejects violators up front."""
     for m in req.mutations:
         if m.type == MutationType.CLEAR_RANGE:
             if m.param2 > SYSTEM_PREFIX:
@@ -45,14 +53,30 @@ def is_state_txn(req: CommitTransactionRequest) -> bool:
     return False
 
 
+def check_state_txn_reads(req: CommitTransactionRequest) -> None:
+    """Enforce the verdict-agreement invariant (see is_state_txn)."""
+    for rb, _re in req.read_conflict_ranges:
+        if rb < SYSTEM_PREFIX:
+            raise ClientInvalidOperation(
+                "system-key transactions may not take read conflicts on "
+                "user keys (cross-resolver verdict agreement)")
+
+
 class CommitProxy:
     def __init__(self, knobs: Knobs, sequencer: Sequencer,
                  resolvers: list[Resolver], log_system,
-                 shard_map: ShardMap) -> None:
+                 shard_map: ShardMap, backup_tag: int | None = None) -> None:
         self.knobs = knobs
         self.sequencer = sequencer
         self.resolvers = resolvers
         self.log_system = log_system
+        # continuous-backup mutation tagging (REF:fdbserver/
+        # BackupWorker/backup tags): while a backup tag is active, every
+        # committed mutation is ALSO pushed under it, so backup agents can
+        # pull the full ordered mutation stream.  Versioned like the shard
+        # maps — the \xff/backup/tag state transaction flips it at an
+        # exact commit version on every proxy.
+        self._backup_tags: list[tuple[Version, int | None]] = [(-1, backup_tag)]
         # versioned shard-map history: the map at index i is effective for
         # commit versions >= its change version.  Layout changes arrive as
         # state-transaction entries (the txnStateStore of this proxy) and
@@ -87,6 +111,12 @@ class CommitProxy:
                 return m
         return self._maps[0][1]
 
+    def backup_tag_at(self, version: Version) -> int | None:
+        for v, tag in reversed(self._backup_tags):
+            if v <= version:
+                return tag
+        return None
+
     # --- metadata mutations (REF:fdbserver/ApplyMetadataMutation.cpp) ---
 
     def _apply_state_entries(self, entries, own_version: Version | None = None
@@ -116,9 +146,25 @@ class CommitProxy:
                         ) -> list[tuple[int, bytes, bytes]]:
         from ..rpc.wire import decode
         from ..runtime.trace import TraceEvent
-        from .system_data import LAYOUT_KEY
+        from .system_data import BACKUP_PREFIX, LAYOUT_KEY
+        backup_key = BACKUP_PREFIX + b"tag"
         drops: list[tuple[int, bytes, bytes]] = []
         for m in muts:
+            if m.type == MutationType.SET_VALUE and m.param1 == backup_key:
+                try:
+                    tag = int(decode(m.param2))
+                except Exception:  # noqa: BLE001 — bad blob: disable
+                    tag = None
+                self._backup_tags.append((version, tag))
+                TraceEvent("ProxyBackupTag").detail("Version", version) \
+                    .detail("Tag", tag).log()
+                continue
+            if m.type == MutationType.CLEAR_RANGE \
+                    and m.param1 <= backup_key < m.param2:
+                self._backup_tags.append((version, None))
+                TraceEvent("ProxyBackupTag").detail("Version", version) \
+                    .detail("Tag", None).log()
+                continue
             if m.type != MutationType.SET_VALUE or m.param1 != LAYOUT_KEY:
                 continue
             try:
@@ -272,6 +318,8 @@ class CommitProxy:
         valid: list[tuple[CommitTransactionRequest, asyncio.Future]] = []
         for req, fut in batch:
             try:
+                if is_state_txn(req):
+                    check_state_txn_reads(req)
                 for m in req.mutations:
                     self._substitute_versionstamp(m, 0, 0)
                 valid.append((req, fut))
@@ -322,9 +370,12 @@ class CommitProxy:
             my_drops = self._apply_state_entries(
                 replies[0].state_entries, own_version=version)
             shard_map = self.map_at(version)
+            backup_tag = self.backup_tag_at(version)
 
             # tag mutations of committed txns, in batch order; the log
-            # system replicates each tag onto its hosting logs
+            # system replicates each tag onto its hosting logs.  With a
+            # backup tag active, the whole ordered stream rides under it
+            # too (the continuous mutation-log backup feed).
             tagged: dict[int, list[Mutation]] = {}
             order = 0
             orders: list[int] = [0] * len(reqs)
@@ -340,6 +391,8 @@ class CommitProxy:
                         tags = shard_map.tags_for_key(m.param1)
                     for t in tags:
                         tagged.setdefault(t, []).append(m)
+                    if backup_tag is not None:
+                        tagged.setdefault(backup_tag, []).append(m)
                 order += 1
             # ownership handoff markers for a layout change this batch
             # committed: each losing tag sees the drop at exactly this

@@ -353,8 +353,10 @@ class DataDistributor:
                 wa = NetworkAddress(*d["worker"])
                 w = self.cc.workers.get(wa)
                 if w is not None:
+                    # destroy: an aborted destination's partial fetch must
+                    # not be reported resident after a reboot
                     await asyncio.wait_for(
-                        w.stop_role(d["token"]),
+                        w.stop_role(d["token"], True),
                         timeout=self.knobs.FAILURE_TIMEOUT)
             except Exception:  # noqa: BLE001 — dead worker: nothing to stop
                 pass

@@ -143,6 +143,11 @@ class StorageServer:
             # durable state is ahead of the recovered history — this
             # replica cannot be rolled back and must be discarded/refetched
             # (the reference kills the storage server here)
+            from ..runtime.trace import TraceEvent
+            TraceEvent("StorageRejoinAhead", severity=30) \
+                .detail("Tag", self.tag) \
+                .detail("DurableVersion", self.durable_version) \
+                .detail("RecoveryVersion", recovery_version).log()
             raise TransactionTooOld()
         running = self._pull_task is not None
         if running:

@@ -103,8 +103,10 @@ class TLog:
         self.knobs = knobs
         self.version: Version = epoch_begin_version
         self.queue = queue                      # DiskQueue when durable
+        self.path: str | None = None            # backing file when durable
         self._frame_ends: list[tuple[Version, int]] = []  # for pop_to + spill reads
         self._hosted: set[Tag] = set()          # tags ever pushed here
+        self._tag_tip: dict[Tag, Version] = {}  # highest version pushed per tag
         self._log: dict[Tag, _TagStore] = {}
         self._poppable: dict[Tag, Version] = {}
         self._push_waiters: dict[Version, list[asyncio.Future]] = {}
@@ -126,6 +128,7 @@ class TLog:
         f = fs.open(path)
         queue, frames = await DiskQueue.open(f)
         tlog = cls(knobs, epoch_begin_version, queue)
+        tlog.path = path            # for worker-side file GC on destroy
         for frame, end in frames:
             rec = decode(frame)
             version = rec["v"]
@@ -133,9 +136,15 @@ class TLog:
                 nbytes = sum(len(m.param1) + len(m.param2) for m in msgs)
                 tlog._store(tag).append(version, msgs, nbytes)
                 tlog._hosted.add(tag)
+                tlog._tag_tip[tag] = max(tlog._tag_tip.get(tag, 0), version)
                 tlog.total_bytes += nbytes
             tlog.version = max(tlog.version, version)
             tlog._frame_ends.append((version, end))
+        # the durable tip may exceed the surviving frames' versions:
+        # popped frames are gone but their pushes WERE acked (the header
+        # meta carries the tip so recovery versions never regress below
+        # storage durability)
+        tlog.version = max(tlog.version, queue.meta)
         return tlog
 
     def _store(self, tag: Tag) -> _TagStore:
@@ -215,19 +224,31 @@ class TLog:
                 nbytes = sum(len(m.param1) + len(m.param2) for m in msgs)
                 self._store(tag).append(req.version, msgs, nbytes)
                 self._hosted.add(tag)
+                self._tag_tip[tag] = max(self._tag_tip.get(tag, 0),
+                                         req.version)
                 self.total_bytes += nbytes
-        if self.queue is not None and req.messages:
-            from ..rpc.wire import encode
-            end = await self.queue.push(encode({"v": req.version,
-                                                "m": req.messages}))
-            self._frame_ends.append((req.version, end))
-            await self.queue.commit()   # the fsync that makes commits durable
+        if self.queue is not None:
+            if req.messages:
+                from ..rpc.wire import encode
+                end = await self.queue.push(encode({"v": req.version,
+                                                    "m": req.messages}))
+                self._frame_ends.append((req.version, end))
+            # the fsync that makes commits durable; the tip rides the
+            # header so a reopened log still reports it after pops AND
+            # after idle periods of frameless (empty-batch) versions —
+            # either way a reboot must never report a tip below what
+            # storage has durably applied
+            await self.queue.commit(meta=req.version)
             if self.locked:
                 # lock() captured the tip while we were waiting on disk: the
                 # recovery version excludes this push, so acking it would
                 # lose an acked commit to the generation clamp.  The frame
                 # is on disk but never acked — the client sees an ambiguous
-                # result, which discarding satisfies.
+                # result, which discarding satisfies.  This applies to
+                # frameless (empty-message) pushes too: the commit's data
+                # may live on OTHER logs, and acking here lets the proxy
+                # ack a client while this log's lock-reported tip already
+                # clamps the generation below the version.
                 from ..runtime.errors import TLogStopped
                 raise TLogStopped()
         from ..runtime.buggify import buggify
@@ -343,8 +364,13 @@ class TLog:
             st.pop_below(version)
         if self.queue is not None and self._hosted:
             # the disk queue can advance only past versions every hosted
-            # tag has popped; a tag that never popped pins the queue
-            frontier = min(self._poppable.get(t, 0) for t in self._hosted)
+            # tag has popped; a tag that never popped pins the queue.  A
+            # tag popped past its last pushed version is retired — it no
+            # longer constrains (a deactivated backup tag must not pin
+            # the queue forever); it re-constrains if data arrives again.
+            active = [self._poppable.get(t, 0) for t in self._hosted
+                      if self._poppable.get(t, 0) <= self._tag_tip.get(t, 0)]
+            frontier = min(active) if active else self.version + 1
             keep = 0
             pop_off = None
             for v, end in self._frame_ends:
@@ -356,6 +382,17 @@ class TLog:
             if pop_off is not None:
                 del self._frame_ends[:keep]
                 self._schedule_pop(pop_off)
+
+    async def stop(self) -> None:
+        """Host teardown: quiesce the disk-queue pop worker so a stopped
+        role can't keep writing the queue header (or race a destroy)."""
+        if self._pop_task is not None and not self._pop_task.done():
+            self._pop_task.cancel()
+            try:
+                await self._pop_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._pop_task = None
 
     def _schedule_pop(self, offset: int) -> None:
         """Serialize disk-queue pops through one strongly-held worker task

@@ -98,6 +98,13 @@ class Worker:
         self.data_dir = data_dir
         self.roles: dict[int, tuple[str, Any]] = {}   # token -> (role, obj)
         self.resident: dict[int, int] = {}            # storage tag -> token
+        # durable TLog copies found on disk after a reboot, keyed by the
+        # identity baked into the filename: (epoch, index, nonce).  The
+        # nonce is minted per RECRUITMENT by the controller, so a failed
+        # recovery attempt's leftover file can never impersonate the
+        # committed generation's copy of the same (epoch, index) — same
+        # versions, different content ⇒ replica divergence if adopted.
+        self.resident_tlogs: dict[tuple[int, int, int | None], int] = {}
         serve_role(transport, "worker", self, base_token)
 
     def _engine_cls(self):
@@ -137,6 +144,33 @@ class Worker:
             self.resident[tag] = token
             TraceEvent("WorkerResidentStorage").detail("Worker", self.id) \
                 .detail("Tag", tag).detail("Token", token).log()
+        # durable TLogs: reopen each generation copy LOCKED (old
+        # generations never accept pushes again); recovery adopts them so
+        # acked commits survive a whole-cluster power loss
+        # (REF:fdbserver/TLogServer.actor.cpp tLogStart recovery of
+        # persistent state from the DiskQueue)
+        tprefix = f"{self.data_dir}/tlog-"
+        for path in self.fs.listdir(tprefix):
+            stem = path[len(tprefix):].split(".", 1)[0]
+            try:
+                parts = [int(x) for x in stem.split("-")]
+            except ValueError:
+                continue
+            if len(parts) == 3:
+                key = (parts[0], parts[1], parts[2])
+            elif len(parts) == 2:       # pre-nonce naming
+                key = (parts[0], parts[1], None)
+            else:
+                continue
+            tlog = await TLog.open(self.knobs, self.fs, path)
+            tlog.locked = True
+            token = self._alloc_block()
+            serve_role(self.transport, "tlog", tlog, token)
+            self.roles[token] = ("tlog", tlog)
+            self.resident_tlogs[key] = token
+            TraceEvent("WorkerResidentTLog").detail("Worker", self.id) \
+                .detail("Epoch", key[0]).detail("Index", key[1]) \
+                .detail("Tip", tlog.version).detail("Token", token).log()
         return dict(self.resident)
 
     @property
@@ -162,12 +196,47 @@ class Worker:
         """Create a role object and serve it; returns its base token."""
         k = self.knobs
         token = self._alloc_block()
-        obj = self._build_role(role, params or {}, k)
+        if role == "tlog" and self.fs is not None \
+                and "epoch" in (params or {}):
+            # durable TLog: DiskQueue-backed, named by generation identity
+            # + the controller's per-recruitment nonce so a rebooted
+            # machine can reopen and report it, and a failed attempt's
+            # leftover can never be adopted as the committed copy.
+            # Truncated first — a retried recovery re-recruiting the same
+            # identity must NOT resurrect a failed attempt's frames (same
+            # version numbers, different content ⇒ replica divergence).
+            stem = f"tlog-{params['epoch']}-{params['index']}"
+            if params.get("nonce") is not None:
+                stem += f"-{params['nonce']}"
+            path = f"{self.data_dir}/{stem}.fdq"
+            f = self.fs.open(path)
+            await f.truncate(0)
+            await f.sync()
+            obj = await TLog.open(k, self.fs, path, params.get("v0", 0))
+        else:
+            obj = self._build_role(role, params or {}, k)
         if role == "storage" and self.fs is not None:
             # durable storage: attach a disk engine (memory engines stay
-            # for diskless deployments)
+            # for diskless deployments).  A recruit is always a FRESH
+            # replica (rejoins and reboot adoption never come through
+            # here), so any on-disk leftovers under this tag — an aborted
+            # live move's partial fetch, a failed recovery's recruit —
+            # are garbage that must not resurface as stale rows.
+            base = f"{self.data_dir}/storage-{params['tag']}"
+            for p in self.fs.listdir(base):
+                if p == base or p[len(base):len(base) + 1] == ".":
+                    self.fs.remove(p)
             obj.engine = await self._engine_cls().open(
                 self.fs, f"{self.data_dir}/storage-{params['tag']}")
+            if "shard" not in obj.engine.meta:
+                # persist the assignment IMMEDIATELY (the reference writes
+                # storage metadata at creation): a replica that crashes
+                # before its first durability tick must still be adoptable
+                # after reboot — its data replays from the TLogs
+                v0 = params.get("v0", 0)
+                await obj.engine.commit([], {
+                    "durable_version": v0, "tag": params["tag"],
+                    "shard": (params["shard_begin"], params["shard_end"])})
             self.resident[params["tag"]] = token
         serve_role(self.transport, role, obj, token)
         self.roles[token] = (role, obj)
@@ -177,15 +246,41 @@ class Worker:
             .detail("Role", role).detail("Token", token).log()
         return token
 
-    async def stop_role(self, token: int) -> bool:
+    async def stop_role(self, token: int, destroy: bool = False) -> bool:
+        """Stop a hosted role.  ``destroy=True`` additionally deletes the
+        role's durable files — used when tearing down a FAILED recovery
+        attempt's recruits or an aborted move's destinations, whose
+        on-disk state must never resurface as an adoptable resident copy
+        after a reboot (it shares identity/tag with the committed epoch's
+        real data but diverges in content)."""
         entry = self.roles.pop(token, None)
         if entry is None:
             return False
         role, obj = entry
         for i in range(TOKEN_BLOCK):
             self.transport.dispatcher.unregister(token + i)
+        if role == "storage":
+            # a stopped replica must not keep being reported resident, or
+            # the controller would try to adopt a corpse
+            self.resident = {t: tok for t, tok in self.resident.items()
+                             if tok != token}
+        if role == "tlog":
+            self.resident_tlogs = {k: tok for k, tok
+                                   in self.resident_tlogs.items()
+                                   if tok != token}
         if hasattr(obj, "stop"):
             await obj.stop()
+        if destroy and self.fs is not None:
+            try:
+                if role == "tlog" and getattr(obj, "path", None):
+                    self.fs.remove(obj.path)
+                elif role == "storage":
+                    base = f"{self.data_dir}/storage-{obj.tag}"
+                    for p in self.fs.listdir(base):
+                        if p == base or p[len(base):len(base) + 1] == ".":
+                            self.fs.remove(p)
+            except Exception:  # noqa: BLE001 — GC is best-effort
+                pass
         return True
 
     async def rejoin_storage(self, token: int, log_cfg: list,
@@ -266,7 +361,8 @@ class Worker:
                 for a, b, e, tok in p["resolvers"]]
             ls = LogSystem(generations_from_config(p["log_cfg"], t, self.base))
             shard_map = ShardMap(p["shard_boundaries"], p["shard_teams"])
-            return CommitProxy(k, seq, resolvers, ls, shard_map)
+            return CommitProxy(k, seq, resolvers, ls, shard_map,
+                               backup_tag=p.get("backup_tag"))
         if role == "grv_proxy":
             t = self.make_client_transport()
             seq = SequencerClient(t, addr(p["sequencer"]),

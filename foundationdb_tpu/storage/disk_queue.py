@@ -23,7 +23,11 @@ import struct
 import zlib
 
 _FRAME = struct.Struct("<II")
-_HEADER = struct.Struct("<QQ")      # magic, physical front offset
+# magic, physical front offset, caller meta (the TLog stores its durable
+# tip version here: popped frames vanish, so the tip of the surviving
+# frames UNDERSTATES how far the log durably acked — recovery computed
+# from that would precede storage durability and wedge every rejoin)
+_HEADER = struct.Struct("<QQQ")
 _MAGIC = 0xFDB7D15C  # arbitrary magic for our queue files
 _HEADER_SIZE = 4096
 _COMPACT_SLACK = 1 << 22            # compact when popped prefix > 4MB
@@ -35,6 +39,7 @@ class DiskQueue:
         self._front = _HEADER_SIZE   # logical offset of first live frame
         self._end = _HEADER_SIZE     # logical append position
         self._shift = 0              # logical - physical
+        self.meta = 0                # caller-owned u64, durable w/ commits
 
     def _phys(self, logical: int) -> int:
         return logical - self._shift
@@ -47,9 +52,10 @@ class DiskQueue:
         size = file.size()
         if size >= _HEADER_SIZE:
             hdr = await file.read(0, _HEADER.size)
-            magic, front = _HEADER.unpack(hdr)
+            magic, front, meta = _HEADER.unpack(hdr)
             if magic == _MAGIC and _HEADER_SIZE <= front:
                 q._front = front     # logical == physical on a fresh open
+                q.meta = meta
         payloads: list[tuple[bytes, int]] = []
         pos = q._front
         while pos + _FRAME.size <= size:
@@ -66,7 +72,8 @@ class DiskQueue:
         return q, payloads
 
     async def _write_header(self) -> None:
-        await self.file.write(0, _HEADER.pack(_MAGIC, self._phys(self._front)))
+        await self.file.write(0, _HEADER.pack(_MAGIC, self._phys(self._front),
+                                              self.meta))
 
     async def push(self, payload: bytes) -> int:
         """Append one frame; returns its logical end offset (record this
@@ -76,8 +83,12 @@ class DiskQueue:
         self._end += len(frame)
         return self._end
 
-    async def commit(self) -> None:
-        """Make all pushed frames durable (the TLog's fsync point)."""
+    async def commit(self, meta: int | None = None) -> None:
+        """Make all pushed frames durable (the TLog's fsync point).
+        ``meta`` rides the header under the same sync."""
+        if meta is not None and meta != self.meta:
+            self.meta = meta
+            await self._write_header()
         await self.file.sync()
 
     async def pop_to(self, offset: int) -> None:

@@ -20,3 +20,21 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")   # never touch the real TPU from tests
 jax.config.update("jax_enable_x64", True)   # conflict versions are int64
+
+# Per-test hang watchdog: a wedged test dumps every thread's stack and
+# kills the run instead of stalling CI silently (pytest-timeout is not in
+# this image; faulthandler is stdlib).
+import faulthandler
+
+import pytest
+
+_TEST_TIMEOUT_S = 600.0
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    faulthandler.dump_traceback_later(_TEST_TIMEOUT_S, exit=True)
+    try:
+        yield
+    finally:
+        faulthandler.cancel_dump_traceback_later()

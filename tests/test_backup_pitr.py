@@ -1,0 +1,294 @@
+"""Continuous mutation-log backup + point-in-time restore.
+
+Reference test model: REF:fdbclient/FileBackupAgent.actor.cpp semantics —
+snapshot + mutation log compose into restore-to-any-covered-version, with
+atomic ops re-evaluated identically and transaction atomicity preserved
+at every restore point.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from foundationdb_tpu.backup.agent import BackupAgent
+from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+from foundationdb_tpu.core.data import SYSTEM_PREFIX
+from foundationdb_tpu.runtime.files import SimFileSystem
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+
+async def _read_all(db, at_version=None):
+    tr = db.create_transaction()
+    while True:
+        try:
+            if at_version is not None:
+                tr.set_read_version(at_version)
+            rows = await tr.get_range(b"", SYSTEM_PREFIX, limit=0,
+                                      snapshot=True)
+            return dict(rows)
+        except Exception as e:   # noqa: BLE001 — retry loop
+            await tr.on_error(e)
+
+
+def test_pitr_restore_to_exact_version():
+    """Snapshot mid-stream, keep writing (sets, clears, atomic adds),
+    then restore to a version BETWEEN snapshot and the end: the result
+    must equal the database's exact historical state at that version."""
+    async def main():
+        k = Knobs()
+        sim = SimulatedCluster(k, n_machines=4,
+                               spec=ClusterConfigSpec(min_workers=4))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        agent = BackupAgent(db, SimFileSystem(), "bk")
+
+        await agent.start_continuous()
+
+        # phase A: before the snapshot
+        async def phase_a(tr):
+            for i in range(25):
+                tr.set(b"pa%03d" % i, b"A%d" % i)
+            tr.add(b"counter", (5).to_bytes(8, "little"))
+        await db.run(phase_a)
+
+        await agent.backup()
+
+        # phase B: after the snapshot, before the restore point
+        for j in range(5):
+            async def phase_b(tr, j=j):
+                tr.set(b"pb%03d" % j, b"B%d" % j)
+                tr.clear(b"pa%03d" % (j * 2))
+                tr.add(b"counter", (3).to_bytes(8, "little"))
+            await db.run(phase_b)
+        tr = db.create_transaction()
+        while True:
+            try:
+                tr.set(b"marker", b"at-vt")
+                vt = await tr.commit()
+                break
+            except Exception as e:   # noqa: BLE001
+                await tr.on_error(e)
+        expected = await _read_all(db, at_version=vt)
+        assert expected[b"marker"] == b"at-vt"
+        assert expected[b"counter"] == (20).to_bytes(8, "little")
+
+        # phase C: after the restore point — must NOT appear
+        async def phase_c(tr):
+            for j in range(5):
+                tr.set(b"pb%03d" % j, b"C!")
+                tr.set(b"pc%03d" % j, b"C")
+            tr.clear_range(b"pa", b"pa\xff")
+            tr.add(b"counter", (100).to_bytes(8, "little"))
+            tr.set(b"marker", b"after-vt")
+        await db.run(phase_c)
+
+        await agent.stop_continuous()
+
+        # wipe and point-in-time restore
+        async def wipe(tr):
+            tr.clear_range(b"", SYSTEM_PREFIX)
+        await db.run(wipe)
+        await agent.restore(to_version=vt)
+
+        got = await _read_all(db)
+        assert got == expected, (
+            f"PITR mismatch: {len(expected)} expected vs {len(got)} got; "
+            f"missing={sorted(set(expected) - set(got))[:4]} "
+            f"extra={sorted(set(got) - set(expected))[:4]}")
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_pitr_torn_transaction_consistency():
+    """Pairs written atomically must be consistent at ANY restore point:
+    restore to a version captured mid-stream and check pair equality."""
+    async def main():
+        k = Knobs()
+        sim = SimulatedCluster(k, n_machines=4,
+                               spec=ClusterConfigSpec(min_workers=4))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        agent = BackupAgent(db, SimFileSystem(), "bk2")
+        await agent.start_continuous()
+        await agent.backup()
+
+        vt = None
+        for i in range(20):
+            async def pair(tr, i=i):
+                tr.set(b"left", b"%04d" % i)
+                tr.set(b"right", b"%04d" % i)
+            await db.run(pair)
+            if i == 11:
+                tr = db.create_transaction()
+                while True:
+                    try:
+                        tr.add_write_conflict_range(b"zz", b"zz\x00")
+                        vt = await tr.commit()
+                        break
+                    except Exception as e:   # noqa: BLE001
+                        await tr.on_error(e)
+        await agent.stop_continuous()
+
+        async def wipe(tr):
+            tr.clear_range(b"", SYSTEM_PREFIX)
+        await db.run(wipe)
+        await agent.restore(to_version=vt)
+        got = await _read_all(db)
+        assert got[b"left"] == got[b"right"] == b"0011", got
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_continuous_backup_survives_recovery():
+    """A recovery mid-stream must not lose acked mutations from the log:
+    the backup tag re-arms on the new epoch's proxies (seeded from the
+    \\xff read) and the agent's cursor rolls across generations."""
+    async def main():
+        k = Knobs()
+        sim = SimulatedCluster(k, n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        db = await sim.database()
+        agent = BackupAgent(db, SimFileSystem(), "bk3")
+        await agent.start_continuous()
+        await agent.backup()
+
+        async def put(tr, tag, n):
+            for i in range(n):
+                tr.set(b"rk%s%03d" % (tag, i), b"v-" + tag)
+        await db.run(lambda tr: put(tr, b"pre", 20))
+
+        victims = await sim.txn_only_machines()
+        assert victims
+        await victims[0].kill()
+        await sim.wait_epoch(state1["epoch"] + 1)
+
+        async def post(tr):
+            await put(tr, b"post", 20)
+            tr.set(b"marker", b"end")
+        while True:
+            tr = db.create_transaction()
+            try:
+                await post(tr)
+                vt = await tr.commit()
+                break
+            except Exception as e:   # noqa: BLE001 — retry through recovery
+                await tr.on_error(e)
+        expected = await _read_all(db, at_version=vt)
+        await agent.stop_continuous()
+
+        async def wipe(tr):
+            tr.clear_range(b"", SYSTEM_PREFIX)
+        await db.run(wipe)
+        await agent.restore(to_version=vt)
+        got = await _read_all(db)
+        assert got == expected, (
+            f"missing={sorted(set(expected) - set(got))[:4]} "
+            f"extra={sorted(set(got) - set(expected))[:4]}")
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_restore_refuses_coverage_hole_below_log():
+    """A log armed AFTER the snapshot cannot cover (snapshot, begin]:
+    restore must refuse (RestoreError), never silently produce a database
+    missing that window's mutations."""
+    from foundationdb_tpu.backup.agent import RestoreError
+
+    async def main():
+        k = Knobs()
+        sim = SimulatedCluster(k, n_machines=4,
+                               spec=ClusterConfigSpec(min_workers=4))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        agent = BackupAgent(db, SimFileSystem(), "bk4")
+
+        async def seed(tr):
+            tr.set(b"hole0", b"in-snapshot")
+        await db.run(seed)
+        await agent.backup()                      # snapshot FIRST
+
+        async def in_hole(tr):
+            tr.set(b"hole1", b"lost-if-replayed")
+        await db.run(in_hole)                     # before the tag arms
+
+        await agent.start_continuous()            # log begins after snapshot
+
+        tr = db.create_transaction()
+        while True:
+            try:
+                tr.set(b"hole2", b"in-log")
+                vt = await tr.commit()
+                break
+            except Exception as e:   # noqa: BLE001
+                await tr.on_error(e)
+        await agent.stop_continuous()
+
+        try:
+            await agent.restore(to_version=vt)
+            raise AssertionError("restore served a coverage hole")
+        except RestoreError:
+            pass
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_backup_reactivation_captures_new_stream():
+    """stop_continuous must not un-pin the tag forever: a second
+    activation in the same generation still captures every mutation (the
+    first stop used to pop the tag to MAX_VERSION, letting the TLogs
+    discard re-armed frames before the agent pulled them)."""
+    async def main():
+        k = Knobs()
+        sim = SimulatedCluster(k, n_machines=4,
+                               spec=ClusterConfigSpec(min_workers=4))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        fs = SimFileSystem()
+        agent = BackupAgent(db, fs, "bk5")
+
+        # first activation: arm, write, stop (drained + released)
+        await agent.start_continuous()
+
+        async def w1(tr):
+            tr.set(b"gen1", b"one")
+        await db.run(w1)
+        await agent.stop_continuous()
+
+        # second activation in the SAME generation
+        agent2 = BackupAgent(db, fs, "bk5")
+        await agent2.start_continuous()
+        await agent2.backup()                     # snapshot under the log
+
+        async def w2(tr):
+            for i in range(10):
+                tr.set(b"re%03d" % i, b"second")
+        await db.run(w2)
+        tr = db.create_transaction()
+        while True:
+            try:
+                tr.set(b"marker", b"re-end")
+                vt = await tr.commit()
+                break
+            except Exception as e:   # noqa: BLE001
+                await tr.on_error(e)
+        expected = await _read_all(db, at_version=vt)
+        await agent2.stop_continuous()
+
+        async def wipe(tr):
+            tr.clear_range(b"", SYSTEM_PREFIX)
+        await db.run(wipe)
+        await agent2.restore(to_version=vt)
+        got = await _read_all(db)
+        assert got == expected, (
+            f"missing={sorted(set(expected) - set(got))[:4]} "
+            f"extra={sorted(set(got) - set(got))[:4]}")
+        await sim.stop()
+    run_simulation(main())

@@ -1,0 +1,202 @@
+"""Tuple layer + bindingtester stack machine + multi-version client.
+
+Reference test models: REF:bindings/bindingtester (same instruction
+stream through two implementations, byte-identical results) and the
+tuple layer's defining property (byte order of pack == semantic order).
+"""
+
+from __future__ import annotations
+
+import random
+import uuid
+
+import pytest
+
+from foundationdb_tpu.client import tuple as fdbtuple
+from foundationdb_tpu.client.tuple import Versionstamp
+
+
+# --- tuple layer ---
+
+def _rand_item(rng: random.Random):
+    kind = rng.randrange(7)
+    if kind == 0:
+        return None
+    if kind == 1:
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(6)))
+    if kind == 2:
+        return "".join(rng.choice("abé中") for _ in range(rng.randrange(5)))
+    if kind == 3:
+        return rng.randrange(-(1 << 60), 1 << 60)
+    if kind == 4:
+        return rng.uniform(-1e9, 1e9)
+    if kind == 5:
+        return rng.random() < 0.5
+    return tuple(_rand_item(rng) for _ in range(rng.randrange(3)))
+
+
+def test_tuple_roundtrip_random():
+    rng = random.Random(7)
+    for _ in range(500):
+        t = tuple(_rand_item(rng) for _ in range(rng.randrange(5)))
+        packed = fdbtuple.pack(t)
+        assert fdbtuple.unpack(packed) == t, t
+
+
+def test_tuple_roundtrip_specials():
+    t = (None, b"", b"a\x00b", "", "é\x00x", 0, 1, -1, 255, 256,
+         -255, -256, (1 << 60), -(1 << 60), 0.0, -1.5, 2.5,
+         True, False, (None, (b"n",), 3), uuid.UUID(int=0x1234),
+         Versionstamp(b"\x01" * 10, 7))
+    assert fdbtuple.unpack(fdbtuple.pack(t)) == t
+
+
+def _order_key(item):
+    """Semantic sort key mirroring the spec's cross-type order."""
+    if item is None:
+        return (0,)
+    if isinstance(item, bytes):
+        return (1, item)
+    if isinstance(item, str):
+        return (2, item.encode())
+    if isinstance(item, tuple):
+        return (5, tuple(_order_key(x) for x in item))
+    if isinstance(item, bool):
+        return (38, item)
+    if isinstance(item, int):
+        return (20, item)
+    if isinstance(item, float):
+        return (33, item)
+    raise TypeError(item)
+
+
+def test_tuple_pack_preserves_order():
+    """The defining property: byte comparison of packs == semantic
+    comparison of tuples (REF:bindings tuple spec)."""
+    rng = random.Random(11)
+    tuples = [tuple(_rand_item(rng) for _ in range(rng.randrange(1, 4)))
+              for _ in range(400)]
+    packed = sorted(tuples, key=lambda t: fdbtuple.pack(t))
+    semantic = sorted(tuples, key=lambda t: tuple(_order_key(x) for x in t))
+    for a, b in zip(packed, semantic):
+        assert tuple(_order_key(x) for x in a) == \
+            tuple(_order_key(x) for x in b), (a, b)
+
+
+def test_tuple_int_boundaries():
+    for v in (0, 1, -1, 0xFF, 0x100, -0xFF, -0x100, (1 << 64) - 1,
+              -((1 << 64) - 1)):
+        assert fdbtuple.unpack(fdbtuple.pack((v,))) == (v,)
+    with pytest.raises(ValueError):
+        fdbtuple.pack((1 << 64,))
+
+
+def test_tuple_range():
+    b, e = fdbtuple.range_of((b"app",))
+    inside = fdbtuple.pack((b"app", 3))
+    assert b <= inside < e
+    assert not b <= fdbtuple.pack((b"apq",)) < e
+
+
+# --- bindingtester stack machine: native client vs model ---
+
+def test_stack_machine_native_vs_model():
+    """The bindingtester property: the same seeded instruction stream
+    through the native client (on a sim cluster) and the brute-force
+    model must leave byte-identical stacks and databases."""
+    import asyncio
+
+    from bindings.bindingtester.stack_tester import (ModelDatabase,
+                                                     StackMachine,
+                                                     generate_program)
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.core.data import SYSTEM_PREFIX
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.runtime.simloop import run_simulation
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        sim = SimulatedCluster(Knobs(), n_machines=4,
+                               spec=ClusterConfigSpec(min_workers=4))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        for seed in (1, 2):
+            program = generate_program(seed, n_ops=250)
+            native = StackMachine(db)
+            model = StackMachine(ModelDatabase())
+            await native.run(program)
+            await model.run(program)
+            assert native.stack == model.stack, (
+                f"seed {seed}: stack diverged at "
+                f"{next(i for i, (a, b) in enumerate(zip(native.stack, model.stack)) if a != b)}"
+            )
+            tr = db.create_transaction()
+            while True:
+                try:
+                    rows = await tr.get_range(b"", SYSTEM_PREFIX, limit=0)
+                    break
+                except Exception as e:  # noqa: BLE001
+                    await tr.on_error(e)
+            assert dict(rows) == model.db.data, f"seed {seed}: db diverged"
+            # wipe between seeds
+
+            async def wipe(t):
+                t.clear_range(b"", SYSTEM_PREFIX)
+            await db.run(wipe)
+        await sim.stop()
+    run_simulation(main())
+
+
+# --- multi-version client ---
+
+def test_multiversion_api_gating():
+    from foundationdb_tpu.client import multiversion as mv
+    mv._reset_api_version_for_tests()
+    with pytest.raises(mv.ApiVersionUnset):
+        mv.MultiVersionDatabase("native", object())
+    with pytest.raises(mv.ApiVersionInvalid):
+        mv.api_version(100)
+    mv.api_version(710)
+    mv.api_version(710)            # idempotent re-select of the same
+    with pytest.raises(mv.ApiVersionAlreadySet):
+        mv.api_version(520)
+    assert mv.selected_api_version() == 710
+    mv._reset_api_version_for_tests()
+
+
+def test_multiversion_versionstamp_gate():
+    import asyncio
+
+    from foundationdb_tpu.client import multiversion as mv
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.runtime.simloop import run_simulation
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        mv._reset_api_version_for_tests()
+        mv.api_version(300)        # pre-versionstamp era
+        sim = SimulatedCluster(Knobs(), n_machines=4,
+                               spec=ClusterConfigSpec(min_workers=4))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = mv.MultiVersionDatabase("native", await sim.database())
+        tr = db.create_transaction()
+        tr.set(b"plain", b"ok")    # ordinary surface unaffected
+        with pytest.raises(mv.ApiVersionInvalid):
+            tr.set_versionstamped_key(b"k\x00\x00\x00\x00", b"v")
+        await tr.commit()
+        assert await db.get(b"plain") == b"ok"
+        mv._reset_api_version_for_tests()
+        mv.api_version(710)
+        db2 = mv.MultiVersionDatabase("native", await sim.database())
+        tr = db2.create_transaction()
+        tr.set_versionstamped_key(b"vs-0123456789" + b"\x00" * 2 +
+                                  b"\x03\x00\x00\x00", b"v")
+        await tr.commit()
+        rows = await db2.get_range(b"vs-", b"vs-\xff")
+        assert len(rows) == 1 and rows[0][1] == b"v"
+        mv._reset_api_version_for_tests()
+        await sim.stop()
+    run_simulation(main())
