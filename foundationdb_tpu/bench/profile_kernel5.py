@@ -1,0 +1,168 @@
+"""Decompose resolve_many device time: which kernel stage dominates?
+
+Variants of the fused K-batch scan with stages knocked out, each timed on
+the live device.  Stages: (1) window history check, (2) intra-batch
+overlap matrix, (3) scalar bitmask commit chain, (4) slab append.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    jax.config.update("jax_enable_x64", True)
+    dev = jax.devices()[0]
+    print("device:", dev)
+
+    from foundationdb_tpu.ops import conflict_jax as cj
+
+    K, B, R, L = 64, 64, 2, 9
+    CAP = 1 << 14
+    WINDOW = 4096
+    width = 32
+    rng = np.random.default_rng(0)
+
+    state = jax.device_put(cj.init_state(CAP, width), dev)
+    rb = rng.integers(0, 2**32, (K, B, R, L), dtype=np.uint32)
+    re = rb.copy()
+    wb = rb.copy()
+    we = rb.copy()
+    sn = np.arange(K * B, dtype=np.int64).reshape(K, B)
+    cv = np.arange(1, K + 1, dtype=np.int64) * 100
+
+    def run_many(core_fn, st, tag):
+        fn = jax.jit(functools.partial(core_fn, width=width, window=WINDOW))
+
+        def scan_fn(s, x):
+            rb_, re_, wb_, we_, sn_, cv_ = x
+            return core_fn(s, rb_, re_, wb_, we_, sn_, cv_,
+                           width=width, window=WINDOW)
+
+        many = jax.jit(lambda s, *xs: lax.scan(scan_fn, s, xs))
+        args = [jax.device_put(a, dev) for a in (rb, re, wb, we, sn, cv)]
+        out = many(st, *args)
+        jax.block_until_ready(out)
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = many(st, *args)
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
+        print(f"{tag:28s} {min(ts)*1e3:7.1f} ms/group  "
+              f"({min(ts)/K*1e3:5.2f} ms/batch)")
+        return min(ts)
+
+    full = run_many(cj.resolve_core, state, "full")
+
+    # knockout variants
+    def make_variant(no_hist=False, no_intra=False, no_chain=False,
+                     no_slab=False):
+        def core(st, read_begin, read_end, write_begin, write_end, snap,
+                 commit_version, *, width, window):
+            C = st.hver.shape[0] // 2
+            B_, R_, L_ = read_begin.shape
+            S_ = B_ * R_
+            i32 = jnp.int32
+            too_old = snap < st.floor
+            valid = snap >= 0
+            if no_hist:
+                hist_conflict = jnp.zeros(B_, bool)
+            else:
+                start = ((st.ptr - window) % C).astype(i32)
+                hbW = lax.dynamic_slice(st.hb, (i32(0), start), (L_, window))
+                heW = lax.dynamic_slice(st.he, (i32(0), start), (L_, window))
+                hvW = lax.dynamic_slice(st.hver, (start,), (window,))
+                hist_conflict = cj._hist_check_T(read_begin, read_end, hbW,
+                                                 heW, hvW, snap, width)
+            if no_intra:
+                M = jnp.zeros((B_, B_), bool)
+            else:
+                m = cj._overlap(read_begin[:, :, None, None, :],
+                                read_end[:, :, None, None, :],
+                                write_begin[None, None, :, :, :],
+                                write_end[None, None, :, :, :], width)
+                M = m.any(axis=(1, 3)) & ~jnp.eye(B_, dtype=bool)
+            ok = valid & ~too_old
+            if no_chain:
+                conf_vec = hist_conflict | M.any(axis=1)
+                committed = ok & ~conf_vec
+            else:
+                nw = (B_ + 31) // 32
+                Bpad = nw * 32
+                Mp = jnp.pad(M, ((0, 0), (0, Bpad - B_)))
+                packed = jnp.sum(
+                    Mp.reshape(B_, nw, 32).astype(jnp.uint32)
+                    << jnp.arange(32, dtype=jnp.uint32)[None, None, :],
+                    axis=-1)
+                cw = [jnp.uint32(0)] * nw
+                confw = [jnp.uint32(0)] * nw
+                for i in range(B_):
+                    hit = cw[0] & packed[i, 0]
+                    for w in range(1, nw):
+                        hit = hit | (cw[w] & packed[i, w])
+                    conf = hist_conflict[i] | (hit != jnp.uint32(0))
+                    commit = ok[i] & ~conf
+                    wi, bi = divmod(i, 32)
+                    bit = jnp.uint32(1 << bi)
+                    cw[wi] = cw[wi] | jnp.where(commit, bit, jnp.uint32(0))
+                    confw[wi] = confw[wi] | jnp.where(conf, bit,
+                                                      jnp.uint32(0))
+                shifts = jnp.arange(32, dtype=jnp.uint32)
+                conf_vec = jnp.concatenate(
+                    [(w >> shifts) & jnp.uint32(1)
+                     for w in confw])[:B_].astype(bool)
+                committed = ok & ~conf_vec
+            verdicts = jnp.where(~valid, cj.COMMITTED,
+                                 jnp.where(too_old, cj.TOO_OLD,
+                                           jnp.where(conf_vec, cj.CONFLICT,
+                                                     cj.COMMITTED)))
+            if no_slab:
+                return st, verdicts
+            is_pad = commit_version < 0
+            p = st.ptr
+            old_b = lax.dynamic_slice(st.hb, (i32(0), p), (L_, S_))
+            old_e = lax.dynamic_slice(st.he, (i32(0), p), (L_, S_))
+            old_v = lax.dynamic_slice(st.hver, (p,), (S_,))
+            valid_w = write_begin[..., -1] != jnp.uint32(cj.SENTINEL_LANE)
+            ins = (committed[:, None] & valid_w).reshape(S_)
+            new_b = jnp.where(ins[:, None], write_begin.reshape(S_, L_),
+                              jnp.uint32(cj.SENTINEL_LANE)).T
+            new_e = jnp.where(ins[:, None], write_end.reshape(S_, L_),
+                              jnp.uint32(cj.SENTINEL_LANE)).T
+            new_v = jnp.broadcast_to(
+                jnp.asarray(commit_version, st.hver.dtype), (S_,))
+            slab_b = jnp.where(is_pad, old_b, new_b)
+            slab_e = jnp.where(is_pad, old_e, new_e)
+            slab_v = jnp.where(is_pad, old_v, new_v)
+            floor2 = jnp.where(is_pad, st.floor,
+                               jnp.maximum(st.floor, jnp.max(old_v)))
+            hb2 = lax.dynamic_update_slice(st.hb, slab_b, (i32(0), p))
+            hb2 = lax.dynamic_update_slice(hb2, slab_b, (i32(0), p + C))
+            he2 = lax.dynamic_update_slice(st.he, slab_e, (i32(0), p))
+            he2 = lax.dynamic_update_slice(he2, slab_e, (i32(0), p + C))
+            hv2 = lax.dynamic_update_slice(st.hver, slab_v, (p,))
+            hv2 = lax.dynamic_update_slice(hv2, slab_v, (p + C,))
+            ptr2 = ((p + jnp.where(is_pad, 0, S_)) % C).astype(i32)
+            return cj.ConflictState(hb2, he2, hv2, ptr2, floor2), verdicts
+        return core
+
+    run_many(make_variant(no_hist=True), state, "no window check")
+    run_many(make_variant(no_intra=True), state, "no intra-batch matrix")
+    run_many(make_variant(no_chain=True), state, "no scalar chain")
+    run_many(make_variant(no_slab=True), state, "no slab append")
+    run_many(make_variant(no_hist=True, no_intra=True, no_chain=True),
+             state, "slab only")
+    run_many(make_variant(no_hist=True, no_intra=True, no_chain=True,
+                          no_slab=True), state, "empty (scan overhead)")
+
+
+if __name__ == "__main__":
+    main()

@@ -136,3 +136,52 @@ void cs_resolve(void* p, int32_t ntxns, const int64_t* snapshots,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Resolve a batch in the resolver WIRE layout — the serialized form a
+// commit proxy ships (one blob; per txn: nr read ranges' begin/end keys
+// then nw write ranges', interleaved in txn order).  Identical verdict
+// semantics to cs_resolve; offs[nkeys+1] are byte offsets into blob.
+void cs_resolve_wire(void* p, int32_t ntxns, const int64_t* snapshots,
+                     const int32_t* nr, const int32_t* nw,
+                     const int64_t* offs, const uint8_t* blob,
+                     int64_t commit_version, int8_t* verdicts_out) {
+    auto* cs = static_cast<ConflictSet*>(p);
+    int64_t key = 0;
+    for (int32_t i = 0; i < ntxns; ++i) {
+        if (snapshots[i] < cs->oldest) {
+            verdicts_out[i] = 2;
+            key += 2 * (static_cast<int64_t>(nr[i]) + nw[i]);
+            continue;
+        }
+        bool conflict = false;
+        for (int32_t j = 0; j < nr[i]; ++j, key += 2) {
+            if (conflict) continue;
+            auto b = std::string_view(
+                reinterpret_cast<const char*>(blob) + offs[key],
+                static_cast<size_t>(offs[key + 1] - offs[key]));
+            auto e = std::string_view(
+                reinterpret_cast<const char*>(blob) + offs[key + 1],
+                static_cast<size_t>(offs[key + 2] - offs[key + 1]));
+            conflict = cs->check_read(b, e, snapshots[i]);
+        }
+        if (conflict) {
+            verdicts_out[i] = 1;
+            key += 2 * static_cast<int64_t>(nw[i]);
+        } else {
+            verdicts_out[i] = 0;
+            for (int32_t j = 0; j < nw[i]; ++j, key += 2) {
+                auto b = std::string_view(
+                    reinterpret_cast<const char*>(blob) + offs[key],
+                    static_cast<size_t>(offs[key + 1] - offs[key]));
+                auto e = std::string_view(
+                    reinterpret_cast<const char*>(blob) + offs[key + 1],
+                    static_cast<size_t>(offs[key + 2] - offs[key + 1]));
+                cs->add_write(b, e, commit_version);
+            }
+        }
+    }
+}
+
+}  // extern "C"

@@ -79,3 +79,306 @@ void kc_encode_batch(const uint8_t* flat, const int64_t* offs,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Endpoint-id dictionary encoder (transfer compression for the TPU kernel).
+//
+// The axon tunnel moves ~65MB/s effective, so shipping every range
+// endpoint's lane vector (36B) each batch caps resolver throughput.  The
+// device keeps a lane dictionary [L, D] resident; the host keeps this
+// mirror: an open-addressing hash table mapping endpoint bytes -> slot id.
+// A batch ships u32 slot ids (4B per endpoint) plus lane updates for
+// endpoints not yet on the device.  Slots are reused round-robin (the
+// ring history stores materialized lanes, so reassigning a slot never
+// corrupts old history); a slot referenced by the current group is never
+// evicted (group stamps), so in-flight ids always gather the right lanes.
+
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct KcDict {
+    int64_t slots;          // device capacity D; ids 1..slots-1 (0 = sentinel)
+    int64_t table_cap;      // power of two
+    uint64_t* table_h;      // 0 = empty, 1 = tombstone
+    uint32_t* table_id;
+    uint8_t** slot_key;     // owned copy of each slot's endpoint bytes
+    int32_t* slot_len;
+    uint64_t* slot_stamp;   // group counter at last reference
+    int64_t next_slot;
+    uint64_t group;
+    int64_t tombstones;
+    int64_t live;
+};
+
+inline uint64_t kd_hash(const uint8_t* k, int64_t len) {
+    uint64_t h = 1469598103934665603ull;            // FNV-1a 64
+    for (int64_t i = 0; i < len; ++i) { h ^= k[i]; h *= 1099511628211ull; }
+    if (h < 2) h += 2;                              // 0/1 reserved
+    return h;
+}
+
+// find the entry for key; returns table index or -1
+inline int64_t kd_find(KcDict* d, const uint8_t* k, int64_t len, uint64_t h) {
+    const uint64_t mask = d->table_cap - 1;
+    for (uint64_t i = h & mask;; i = (i + 1) & mask) {
+        const uint64_t th = d->table_h[i];
+        if (th == 0) return -1;
+        if (th == h) {
+            const uint32_t id = d->table_id[i];
+            if (d->slot_len[id] == len &&
+                memcmp(d->slot_key[id], k, len) == 0)
+                return static_cast<int64_t>(i);
+        }
+    }
+}
+
+inline int64_t kd_find_insert_pos(KcDict* d, uint64_t h) {
+    const uint64_t mask = d->table_cap - 1;
+    for (uint64_t i = h & mask;; i = (i + 1) & mask) {
+        const uint64_t th = d->table_h[i];
+        if (th == 0 || th == 1) {
+            if (th == 1) --d->tombstones;
+            return static_cast<int64_t>(i);
+        }
+    }
+}
+
+void kd_rebuild(KcDict* d) {
+    uint64_t* oh = d->table_h;
+    uint32_t* oid = d->table_id;
+    const int64_t ocap = d->table_cap;
+    d->table_h = static_cast<uint64_t*>(calloc(d->table_cap, 8));
+    d->table_id = static_cast<uint32_t*>(calloc(d->table_cap, 4));
+    d->tombstones = 0;
+    for (int64_t i = 0; i < ocap; ++i) {
+        if (oh[i] > 1) {
+            const int64_t j = kd_find_insert_pos(d, oh[i]);
+            d->table_h[j] = oh[i];
+            d->table_id[j] = oid[i];
+        }
+    }
+    free(oh);
+    free(oid);
+}
+
+void kd_remove(KcDict* d, uint32_t id) {
+    const uint8_t* k = d->slot_key[id];
+    if (!k) return;
+    const uint64_t h = kd_hash(k, d->slot_len[id]);
+    const int64_t i = kd_find(d, k, d->slot_len[id], h);
+    if (i >= 0) {
+        d->table_h[i] = 1;                          // tombstone
+        ++d->tombstones;
+        --d->live;
+    }
+    free(d->slot_key[id]);
+    d->slot_key[id] = nullptr;
+    d->slot_len[id] = 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kc_dict_new(int64_t slots) {
+    KcDict* d = static_cast<KcDict*>(calloc(1, sizeof(KcDict)));
+    d->slots = slots;
+    int64_t cap = 64;
+    while (cap < slots * 4) cap <<= 1;
+    d->table_cap = cap;
+    d->table_h = static_cast<uint64_t*>(calloc(cap, 8));
+    d->table_id = static_cast<uint32_t*>(calloc(cap, 4));
+    d->slot_key = static_cast<uint8_t**>(calloc(slots, sizeof(uint8_t*)));
+    d->slot_len = static_cast<int32_t*>(calloc(slots, 4));
+    d->slot_stamp = static_cast<uint64_t*>(calloc(slots, 8));
+    d->next_slot = 1;
+    d->group = 1;
+    return d;
+}
+
+void kc_dict_free(void* p) {
+    KcDict* d = static_cast<KcDict*>(p);
+    for (int64_t i = 0; i < d->slots; ++i) free(d->slot_key[i]);
+    free(d->slot_key);
+    free(d->slot_len);
+    free(d->slot_stamp);
+    free(d->table_h);
+    free(d->table_id);
+    free(d);
+}
+
+// New group boundary: ids handed out after this call may not evict slots
+// referenced since this call (they share a device dispatch).
+void kc_dict_group(void* p) {
+    ++static_cast<KcDict*>(p)->group;
+}
+
+int64_t kc_dict_live(void* p) { return static_cast<KcDict*>(p)->live; }
+
+}  // extern "C"
+
+namespace {
+
+// id for one endpoint; appends (slot, lanes) to the update buffers when
+// the endpoint is not yet device-resident.  Returns the id, or 0 with
+// *overflow set when the update buffers are full (caller falls back).
+inline uint32_t kd_id(KcDict* d, const uint8_t* k, int64_t len,
+                      int64_t width, uint32_t* upd_slots,
+                      uint32_t* upd_lanes, int64_t max_upd,
+                      int64_t* n_upd, int* overflow) {
+    const uint64_t h = kd_hash(k, len);
+    const int64_t found = kd_find(d, k, len, h);
+    if (found >= 0) {
+        const uint32_t id = d->table_id[found];
+        d->slot_stamp[id] = d->group;
+        return id;
+    }
+    if (*n_upd >= max_upd) { *overflow = 1; return 0; }
+    // allocate a slot round-robin, skipping slots referenced this group
+    uint32_t id;
+    for (;;) {
+        if (d->next_slot >= d->slots) d->next_slot = 1;
+        id = static_cast<uint32_t>(d->next_slot++);
+        if (d->slot_stamp[id] != d->group) break;
+    }
+    kd_remove(d, id);
+    if ((d->live + d->tombstones) * 2 > d->table_cap) kd_rebuild(d);
+    const int64_t pos = kd_find_insert_pos(d, h);
+    d->table_h[pos] = h;
+    d->table_id[pos] = id;
+    d->slot_key[id] = static_cast<uint8_t*>(malloc(len ? len : 1));
+    memcpy(d->slot_key[id], k, len);
+    d->slot_len[id] = static_cast<int32_t>(len);
+    d->slot_stamp[id] = d->group;
+    ++d->live;
+    const int64_t L = width / 4 + 1;
+    const int64_t u = (*n_upd)++;
+    upd_slots[u] = id;
+    uint32_t row[257];                  // supports width <= 1024 (checked
+                                        // host-side in DictEncoder)
+    encode_one(k, len, width, row);
+    for (int64_t l = 0; l < L; ++l)
+        upd_lanes[l * max_upd + u] = row[l];        // lane-major [L, max_upd]
+    return id;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Whole-batch id encoder: same input layout as kc_encode_batch, but emits
+// u32 id arrays [B*R] (0 = sentinel padding) + dictionary updates.
+// Returns the new n_upd on success, or -(n_upd_partial + 1) if the update
+// buffers overflowed — the partial updates are REAL table insertions and
+// must still reach the device; the caller re-encodes this batch via the
+// lanes path (callers sizing max_upd to the group's endpoint count never
+// overflow).
+int64_t kc_encode_batch_ids(void* dict, const uint8_t* flat,
+                            const int64_t* offs, const int32_t* nr,
+                            const int32_t* nw, int64_t n_txns, int64_t B,
+                            int64_t R, int64_t width,
+                            uint32_t* rbi, uint32_t* rei,
+                            uint32_t* wbi, uint32_t* wei,
+                            uint32_t* upd_slots, uint32_t* upd_lanes,
+                            int64_t max_upd, int64_t n_upd0) {
+    KcDict* d = static_cast<KcDict*>(dict);
+    for (int64_t i = 0; i < B * R; ++i) rbi[i] = rei[i] = wbi[i] = wei[i] = 0;
+    int64_t n_upd = n_upd0;
+    int overflow = 0;
+    int64_t key = 0;
+    for (int64_t i = 0; i < n_txns; ++i) {
+        for (int32_t j = 0; j < nr[i]; ++j) {
+            rbi[i * R + j] = kd_id(d, flat + offs[key],
+                                   offs[key + 1] - offs[key], width,
+                                   upd_slots, upd_lanes, max_upd, &n_upd,
+                                   &overflow);
+            ++key;
+            rei[i * R + j] = kd_id(d, flat + offs[key],
+                                   offs[key + 1] - offs[key], width,
+                                   upd_slots, upd_lanes, max_upd, &n_upd,
+                                   &overflow);
+            ++key;
+        }
+        for (int32_t j = 0; j < nw[i]; ++j) {
+            wbi[i * R + j] = kd_id(d, flat + offs[key],
+                                   offs[key + 1] - offs[key], width,
+                                   upd_slots, upd_lanes, max_upd, &n_upd,
+                                   &overflow);
+            ++key;
+            wei[i * R + j] = kd_id(d, flat + offs[key],
+                                   offs[key + 1] - offs[key], width,
+                                   upd_slots, upd_lanes, max_upd, &n_upd,
+                                   &overflow);
+            ++key;
+        }
+        if (overflow) return -(n_upd + 1);
+    }
+    return n_upd;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Whole-GROUP id encoder: K_real batches' txns concatenated in one blob,
+// one ctypes crossing per device dispatch instead of per batch (the
+// per-batch Python walk + 9-arg ctypes conversion dominated encode).
+//
+// counts[K_real]: real txn count per batch.  nr/nw/offs cover the
+// concatenated real txns in order.  ids_out: [4 * K_pad * B * R] u32,
+// pre-zeroed by the caller (0 = sentinel slot), segment f of size
+// K_pad*B*R holds field f (rb|re|wb|we) with batch k at offset k*B*R.
+// Returns new n_upd or -(partial+1) on update-buffer overflow.
+int64_t kc_encode_group_ids(void* dict, const uint8_t* flat,
+                            const int64_t* offs, const int32_t* nr,
+                            const int32_t* nw, const int32_t* counts,
+                            int64_t K_real, int64_t K_pad, int64_t B,
+                            int64_t R, int64_t width,
+                            uint32_t* ids_out,
+                            uint32_t* upd_slots, uint32_t* upd_lanes,
+                            int64_t max_upd) {
+    KcDict* d = static_cast<KcDict*>(dict);
+    const int64_t seg = K_pad * B * R;
+    uint32_t* rbi = ids_out;
+    uint32_t* rei = ids_out + seg;
+    uint32_t* wbi = ids_out + 2 * seg;
+    uint32_t* wei = ids_out + 3 * seg;
+    int64_t n_upd = 0;
+    int overflow = 0;
+    int64_t key = 0, t = 0;
+    for (int64_t k = 0; k < K_real; ++k) {
+        const int64_t base = k * B * R;
+        for (int32_t i = 0; i < counts[k]; ++i, ++t) {
+            for (int32_t j = 0; j < nr[t]; ++j) {
+                rbi[base + i * R + j] = kd_id(d, flat + offs[key],
+                                              offs[key + 1] - offs[key],
+                                              width, upd_slots, upd_lanes,
+                                              max_upd, &n_upd, &overflow);
+                ++key;
+                rei[base + i * R + j] = kd_id(d, flat + offs[key],
+                                              offs[key + 1] - offs[key],
+                                              width, upd_slots, upd_lanes,
+                                              max_upd, &n_upd, &overflow);
+                ++key;
+            }
+            for (int32_t j = 0; j < nw[t]; ++j) {
+                wbi[base + i * R + j] = kd_id(d, flat + offs[key],
+                                              offs[key + 1] - offs[key],
+                                              width, upd_slots, upd_lanes,
+                                              max_upd, &n_upd, &overflow);
+                ++key;
+                wei[base + i * R + j] = kd_id(d, flat + offs[key],
+                                              offs[key + 1] - offs[key],
+                                              width, upd_slots, upd_lanes,
+                                              max_upd, &n_upd, &overflow);
+                ++key;
+            }
+            if (overflow) return -(n_upd + 1);
+        }
+    }
+    return n_upd;
+}
+
+}  // extern "C"

@@ -116,6 +116,22 @@ def resolve_group_begin(backend, batches: list[list[TxnRequest]],
                        for t, v in zip(batches, versions)])
 
 
+def resolve_group_wire_begin(backend, wires: list, versions: list[int]):
+    """Group-resolve serialized WireBatches over any backend.  The
+    encoded/TPU backend takes its zero-walk dictionary path; a backend
+    with resolve_wire (cpp) consumes the wire form directly; anything
+    else deserializes and falls back to the TxnRequest group path."""
+    fn = getattr(backend, "resolve_group_wire_begin", None)
+    if fn is not None and getattr(backend, "_dict", None) is not None:
+        return fn(wires, versions)
+    rw = getattr(backend, "resolve_wire", None)
+    if rw is not None:
+        return _completed([rw(w, v) for w, v in zip(wires, versions)])
+    from .batch import txns_from_wire
+    return resolve_group_begin(backend, [txns_from_wire(w) for w in wires],
+                               versions)
+
+
 def coalesce_ranges(ranges: list[tuple[bytes, bytes]], max_n: int) -> list[tuple[bytes, bytes]]:
     """Merge sorted-adjacent ranges until len <= max_n (conservative)."""
     if len(ranges) <= max_n:
@@ -141,28 +157,31 @@ class EncodedConflictBackend:
     byte-string TxnRequest interface."""
 
     def __init__(self, conflict_set, batch_txns: int, ranges_per_txn: int,
-                 width: int):
+                 width: int, dict_encoder=None):
         self.cs = conflict_set
         self.B = batch_txns
         self.R = ranges_per_txn
         self.width = width
-        # group-submission ordering (see resolve_group_begin)
-        self._turn_next = 0
-        self._turn_serving = 0
-        self._turn_waiters: dict[int, asyncio.Future] = {}
+        self._dict = dict_encoder       # DictEncoder when transfer-compressed
+
+    def _chunk_txns(self, txns: list[TxnRequest]) -> list[list[TxnRequest]]:
+        """Split an oversized batch into kernel-shaped txn chunks, with
+        over-bucket txns' ranges coalesced (conservative)."""
+        out = []
+        for start in range(0, len(txns), self.B):
+            out.append(
+                [t if len(t.read_ranges) <= self.R and len(t.write_ranges) <= self.R
+                 else TxnRequest(coalesce_ranges(t.read_ranges, self.R),
+                                 coalesce_ranges(t.write_ranges, self.R),
+                                 t.read_snapshot)
+                 for t in txns[start:start + self.B]])
+        return out
 
     def _encode_chunks(self, txns: list[TxnRequest]):
         """Split an oversized batch into kernel-shaped encoded chunks."""
         from .batch import encode_batch
-        out = []
-        for start in range(0, len(txns), self.B):
-            chunk = [t if len(t.read_ranges) <= self.R and len(t.write_ranges) <= self.R
-                     else TxnRequest(coalesce_ranges(t.read_ranges, self.R),
-                                     coalesce_ranges(t.write_ranges, self.R),
-                                     t.read_snapshot)
-                     for t in txns[start:start + self.B]]
-            out.append(encode_batch(chunk, self.B, self.R, self.width))
-        return out
+        return [encode_batch(c, self.B, self.R, self.width)
+                for c in self._chunk_txns(txns)]
 
     def _submit_chunks(self, txns: list[TxnRequest], commit_version: int):
         """Encode + dispatch every chunk; returns [(n_txns, verdicts)] where
@@ -216,23 +235,6 @@ class EncodedConflictBackend:
 
         return finish()
 
-    async def _wait_turn(self, ticket: int) -> None:
-        """FIFO turnstile: group submissions must hit the device in call
-        order (the ring state threads through them), even when their host
-        encodes finish out of order on executor threads."""
-        if self._turn_serving == ticket:
-            return
-        loop = asyncio.get_running_loop()
-        fut = loop.create_future()
-        self._turn_waiters[ticket] = fut
-        await fut
-
-    def _advance_turn(self) -> None:
-        self._turn_serving += 1
-        fut = self._turn_waiters.pop(self._turn_serving, None)
-        if fut is not None and not fut.done():
-            fut.set_result(None)
-
     def resolve_group_begin(self, batches: list[list[TxnRequest]],
                             versions: list[int]):
         """Fuse several distinct proxy batches (each with its own commit
@@ -241,10 +243,13 @@ class EncodedConflictBackend:
         to sequential resolve_begin calls — the fused kernel threads the
         ring through the group in order.
 
-        Encoding stays on the calling task (moving it to executor
-        threads measured SLOWER: concurrent encodes contend on the GIL
-        against each other and the dispatch path); the ticket turnstile
-        still guarantees device submission in call order."""
+        Encode + dispatch happen EAGERLY on the calling task, exactly like
+        ``resolve_begin`` (submit now, sync later): a returned-but-unawaited
+        coroutine never runs, so deferring the dispatch into the awaitable
+        silently serialized every caller that queued groups before awaiting
+        them — the device sat idle while groups waited their turn to even
+        be submitted.  Eager dispatch also makes device order = call order
+        by construction (no turnstile needed)."""
         group = getattr(self.cs, "resolve_group_submit", None)
         if group is None:
             results = [self.resolve(txns, v)
@@ -254,53 +259,120 @@ class EncodedConflictBackend:
                 return results
             return done()
 
+        from .batch import encode_batch
         from .conflict_jax import GROUP_BUCKETS
         max_k = GROUP_BUCKETS[-1]
-        ticket = self._turn_next
-        self._turn_next += 1
+        chunks: list[list[TxnRequest]] = []
+        flat_cvs: list[int] = []
+        spans: list[tuple[int, int]] = []   # (start, n_chunks) per batch
+        for txns, v in zip(batches, versions):
+            cs_ = self._chunk_txns(txns)
+            spans.append((len(chunks), len(cs_)))
+            chunks.extend(cs_)
+            flat_cvs.extend([v] * len(cs_))
+        counts = [len(c) for c in chunks]
+        use_dict = self._dict is not None \
+            and hasattr(self.cs, "resolve_group_submit_dict")
+        pending = []                        # (n_chunks, verdict array)
+        for start in range(0, len(chunks), max_k):
+            sub = chunks[start:start + max_k]
+            subv = flat_cvs[start:start + max_k]
+            if use_dict:
+                d = self._dict
+                from .conflict_jax import UPD_BUCKETS
+                K = next(b for b in GROUP_BUCKETS if b >= len(sub))
+                enc = d.encode_group(sub, self.B, self.R, K)
+                if enc is not None and d.n_upd <= UPD_BUCKETS[-1]:
+                    ids, snaps, _counts = enc
+                    pending.append((len(sub), self.cs.resolve_group_submit_ids(
+                        ids, snaps, (K, self.B, self.R), subv,
+                        d.upd_slots, d.upd_lanes, d.n_upd)))
+                    continue
+                # update-buffer (or bucket) overflow: the inserted
+                # endpoints are real table state — ship them, then
+                # lanes-path this sub-group
+                self.cs.apply_dict_updates(d.upd_slots, d.upd_lanes, d.n_upd)
+            ebs = [encode_batch(c, self.B, self.R, self.width) for c in sub]
+            pending.append((len(sub), group(ebs, subv)))
 
-        def encode_all():
-            flat_ebs: list = []
-            flat_cvs: list[int] = []
-            spans: list[tuple[int, int]] = []   # (start, n_chunks) per batch
-            for txns, v in zip(batches, versions):
-                ebs = self._encode_chunks(txns)
-                spans.append((len(flat_ebs), len(ebs)))
-                flat_ebs.extend(ebs)
-                flat_cvs.extend([v] * len(ebs))
-            return flat_ebs, flat_cvs, spans
-
-        async def run() -> list[list[int]]:
+        async def finish() -> list[list[int]]:
             from ..runtime.simloop import SimEventLoop
             loop = asyncio.get_running_loop()
             sim = isinstance(loop, SimEventLoop)
-            flat_ebs, flat_cvs, spans = encode_all()
-            await self._wait_turn(ticket)
-            try:
-                pending = []
-                for start in range(0, len(flat_ebs), max_k):
-                    pending.append(group(flat_ebs[start:start + max_k],
-                                         flat_cvs[start:start + max_k]))
-            finally:
-                self._advance_turn()
-            hosts = []
-            for v in pending:
+            rows = []
+            for dn, v in pending:
                 if sim:
-                    hosts.append(np.asarray(v))
+                    host = np.asarray(v)
                 else:
-                    hosts.append(await _DeviceSyncWorker.shared().run(np.asarray, v))
-            rows = [hosts[i // max_k][i % max_k]
-                    for i in range(len(flat_ebs))]
+                    host = await _DeviceSyncWorker.shared().run(np.asarray, v)
+                rows.extend(host[i] for i in range(dn))
             out = []
             for start, n_chunks in spans:
                 verdicts: list[int] = []
                 for c in range(n_chunks):
-                    eb = flat_ebs[start + c]
-                    verdicts.extend(int(x) for x in rows[start + c][:eb.count])
+                    verdicts.extend(int(x)
+                                    for x in rows[start + c][:counts[start + c]])
                 out.append(verdicts)
             return out
 
-        return run()
+        return finish()
+
+    def resolve_group_wire_begin(self, wires: list, versions: list[int]):
+        """Group resolve over serialized WireBatches (dictionary path):
+        no Python txn walk — concat + one native encode + one dispatch
+        per sub-group.  Requires the dict encoder; callers fall back to
+        resolve_group_begin on TxnRequests otherwise."""
+        assert self._dict is not None \
+            and hasattr(self.cs, "resolve_group_submit_ids")
+        from .conflict_jax import GROUP_BUCKETS, UPD_BUCKETS
+        max_k = GROUP_BUCKETS[-1]
+        d = self._dict
+        pending = []                        # (counts, verdict array)
+        for start in range(0, len(wires), max_k):
+            sub = wires[start:start + max_k]
+            subv = versions[start:start + max_k]
+            K = next(b for b in GROUP_BUCKETS if b >= len(sub))
+            enc = d.encode_group_wire(sub, self.B, self.R, K)
+            if enc is None:
+                # buffer overflow can't happen with a worst-case-sized
+                # buffer; the partial insertions are real regardless
+                self.cs.apply_dict_updates(d.upd_slots, d.upd_lanes, d.n_upd)
+                raise ValueError("update buffer overflow on wire path")
+            ids, snaps, counts = enc
+            n_upd = d.n_upd
+            if n_upd > UPD_BUCKETS[-1]:
+                # cold-start burst past the largest transfer bucket: ship
+                # the updates chunked, then dispatch with none attached
+                self.cs.apply_dict_updates(d.upd_slots, d.upd_lanes, n_upd)
+                n_upd = 0
+            pending.append((counts, self.cs.resolve_group_submit_ids(
+                ids, snaps, (K, self.B, self.R), subv,
+                d.upd_slots, d.upd_lanes, n_upd)))
+
+        async def finish() -> list[list[int]]:
+            from ..runtime.simloop import SimEventLoop
+            loop = asyncio.get_running_loop()
+            sim = isinstance(loop, SimEventLoop)
+            out = []
+            for counts, v in pending:
+                if sim:
+                    host = np.asarray(v)
+                else:
+                    host = await _DeviceSyncWorker.shared().run(np.asarray, v)
+                for k, cnt in enumerate(counts):
+                    out.append([int(x) for x in host[k][:cnt]])
+            return out
+
+        return finish()
+
+    def reset_ring(self, oldest_version: int = 0) -> bool:
+        """Clear conflict history (fresh-backend verdict semantics) while
+        keeping the transfer dictionary warm; False if unsupported."""
+        fn = getattr(self.cs, "reset_ring", None)
+        if fn is None:
+            return False
+        fn(oldest_version)
+        return True
 
     def set_oldest_version(self, v: int) -> None:
         self.cs.set_oldest_version(v)
@@ -316,15 +388,36 @@ def make_conflict_backend(knobs: Knobs, device=None):
     if kind == "cpp":
         from .conflict_cpp import CppConflictSet
         return CppConflictSet()
+    dict_encoder = None
     if kind == "numpy":
         from .conflict_np import NumpyConflictSet
         cs = NumpyConflictSet(knobs.CONFLICT_RING_CAPACITY, knobs.KEY_ENCODE_BYTES)
     elif kind == "tpu":
-        from .conflict_jax import JaxConflictSet
+        from .conflict_jax import GROUP_BUCKETS, JaxConflictSet
+        dict_slots = knobs.CONFLICT_DICT_SLOTS
+        # the allocator must always find an unstamped slot: require room
+        # for two full worst-case dispatch groups, else ship lanes
+        if dict_slots and dict_slots < 8 * knobs.RESOLVER_RANGES_PER_TXN \
+                * knobs.RESOLVER_BATCH_TXNS * 64:
+            dict_slots = 0
+        if dict_slots:
+            from .batch import DictEncoder
+            try:
+                # update buffer sized to one dispatch's worst case (every
+                # endpoint of every range new): overflow is impossible and
+                # the lanes fallback exists anyway
+                dict_encoder = DictEncoder(
+                    dict_slots, knobs.KEY_ENCODE_BYTES,
+                    max_upd=4 * knobs.RESOLVER_RANGES_PER_TXN
+                    * knobs.RESOLVER_BATCH_TXNS * GROUP_BUCKETS[-1])
+            except RuntimeError:
+                dict_slots = 0          # no native codec: ship lanes
         cs = JaxConflictSet(knobs.CONFLICT_RING_CAPACITY, knobs.KEY_ENCODE_BYTES,
-                            device=device, window=knobs.CONFLICT_WINDOW_SLOTS)
+                            device=device, window=knobs.CONFLICT_WINDOW_SLOTS,
+                            dict_slots=dict_slots)
     else:
         raise ValueError(f"unknown RESOLVER_CONFLICT_BACKEND {kind!r}")
     return EncodedConflictBackend(cs, knobs.RESOLVER_BATCH_TXNS,
                                   knobs.RESOLVER_RANGES_PER_TXN,
-                                  knobs.KEY_ENCODE_BYTES)
+                                  knobs.KEY_ENCODE_BYTES,
+                                  dict_encoder=dict_encoder)

@@ -40,6 +40,202 @@ TOO_OLD = 2
 
 
 @dataclasses.dataclass
+class WireBatch:
+    """A resolve batch in serialized proxy→resolver form — the payload a
+    commit proxy ships over the wire (REF:fdbserver/ResolverInterface.h
+    ResolveTransactionBatchRequest is likewise a flat serialized arena,
+    not an object graph).  One blob holds every range endpoint in txn
+    order (per txn: nr read ranges' begin,end then nw write ranges');
+    offs are cumulative byte offsets (len nkeys+1).  Both resolver
+    backends consume this layout natively, so the measured resolver
+    stage starts where the reference's does: at the received bytes."""
+    blob: bytes
+    offs: np.ndarray        # [nkeys+1] int64
+    nr: np.ndarray          # [n] int32 read-range counts
+    nw: np.ndarray          # [n] int32 write-range counts
+    snapshots: np.ndarray   # [n] int64
+    count: int
+
+
+def wire_from_txns(txns: list["TxnRequest"]) -> WireBatch:
+    """Serialize TxnRequests into the wire layout (what a proxy does as
+    it builds the batch)."""
+    n = len(txns)
+    nr = np.fromiter((len(t.read_ranges) for t in txns), np.int32, n)
+    nw = np.fromiter((len(t.write_ranges) for t in txns), np.int32, n)
+    snaps = np.fromiter((t.read_snapshot for t in txns), np.int64, n)
+    parts = [x for t in txns
+             for rng in (t.read_ranges, t.write_ranges)
+             for pair in rng for x in pair]
+    lens = np.fromiter(map(len, parts), dtype=np.int64, count=len(parts))
+    offs = np.empty(len(parts) + 1, dtype=np.int64)
+    offs[0] = 0
+    np.cumsum(lens, out=offs[1:])
+    return WireBatch(b"".join(parts), offs, nr, nw, snaps, n)
+
+
+def txns_from_wire(w: WireBatch) -> list["TxnRequest"]:
+    """Deserialize a WireBatch back into TxnRequests (the fallback when a
+    backend lacks a native wire path)."""
+    out = []
+    blob, offs = w.blob, w.offs
+    key = 0
+    for i in range(w.count):
+        rr, wr = [], []
+        for dst, cnt in ((rr, int(w.nr[i])), (wr, int(w.nw[i]))):
+            for _ in range(cnt):
+                dst.append((blob[offs[key]:offs[key + 1]],
+                            blob[offs[key + 1]:offs[key + 2]]))
+                key += 2
+        out.append(TxnRequest(rr, wr, int(w.snapshots[i])))
+    return out
+
+
+@dataclasses.dataclass
+class IdBatch:
+    """A batch in endpoint-id form (dictionary transfer compression):
+    each u32 is a slot in the device-resident lane dictionary; 0 is the
+    sentinel slot (padding).  36B/endpoint lane rows become 4B ids, which
+    is what makes the resolver transfer-bound tunnel path scale."""
+    read_begin: np.ndarray   # [B, R] uint32 slot ids
+    read_end: np.ndarray
+    write_begin: np.ndarray
+    write_end: np.ndarray
+    read_snapshot: np.ndarray  # [B] int64
+    count: int
+
+
+class DictEncoder:
+    """Host mirror of the device lane dictionary (native hash table).
+
+    ``encode(txns)`` returns an IdBatch and appends (slot, lanes) updates
+    for endpoints not yet device-resident into the current group's update
+    buffers; ``begin_group`` starts a fresh update buffer and group stamp
+    (slots referenced since the stamp are never evicted, so every id in a
+    group gathers the right lanes on device).  Returns None when a batch
+    overflows the update buffer — the caller re-encodes it via the lanes
+    path but MUST still ship the partial updates (they are real table
+    insertions).
+    """
+
+    def __init__(self, slots: int, width: int, max_upd: int) -> None:
+        from . import keycode as kc
+        self._lib = kc._keycodec()
+        if self._lib is None:
+            raise RuntimeError("native keycodec unavailable")
+        if width > 1024:
+            # the native lane-row stack buffer is sized for this bound
+            raise ValueError(f"KEY_ENCODE_BYTES {width} > 1024 unsupported")
+        self.slots = slots
+        self.width = width
+        self.L = keycode.nlanes(width)
+        self.max_upd = max_upd
+        self._h = self._lib.kc_dict_new(slots)
+        self.upd_slots = np.zeros(max_upd, dtype=np.uint32)
+        self.upd_lanes = np.full((self.L, max_upd), 0xFFFFFFFF,
+                                 dtype=np.uint32)
+        self.n_upd = 0
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.kc_dict_free(self._h)
+        except Exception:   # noqa: BLE001 — interpreter teardown
+            pass
+
+    def begin_group(self) -> None:
+        self._lib.kc_dict_group(self._h)
+        self.n_upd = 0
+        # pad slots stay 0 (the sentinel slot) and pad lanes stay SENTINEL,
+        # so unused update rows scatter a no-op
+        self.upd_slots[:] = 0
+        self.upd_lanes[:] = 0xFFFFFFFF
+
+    def encode(self, txns: list["TxnRequest"], batch_size: int,
+               ranges_per_txn: int) -> IdBatch | None:
+        B, R = batch_size, ranges_per_txn
+        n = len(txns)
+        if n > B:
+            raise ValueError(f"batch of {n} exceeds batch_size {B}")
+        parts: list[bytes] = []
+        nr = np.empty(n, dtype=np.int32)
+        nw = np.empty(n, dtype=np.int32)
+        snap = np.full(B, -1, dtype=np.int64)
+        for i, t in enumerate(txns):
+            if len(t.read_ranges) > R or len(t.write_ranges) > R:
+                raise ValueError(
+                    f"txn {i} has {len(t.read_ranges)}r/"
+                    f"{len(t.write_ranges)}w ranges; bucket is {R}")
+            nr[i] = len(t.read_ranges)
+            nw[i] = len(t.write_ranges)
+            for b, e in t.read_ranges:
+                parts.append(b)
+                parts.append(e)
+            for b, e in t.write_ranges:
+                parts.append(b)
+                parts.append(e)
+            snap[i] = t.read_snapshot
+        lens = np.fromiter(map(len, parts), dtype=np.int64, count=len(parts))
+        offs = np.empty(len(parts) + 1, dtype=np.int64)
+        offs[0] = 0
+        np.cumsum(lens, out=offs[1:])
+        rbi = np.empty((B, R), dtype=np.uint32)
+        rei = np.empty((B, R), dtype=np.uint32)
+        wbi = np.empty((B, R), dtype=np.uint32)
+        wei = np.empty((B, R), dtype=np.uint32)
+        rc = self._lib.kc_encode_batch_ids(
+            self._h, b"".join(parts), offs, nr, nw, n, B, R, self.width,
+            rbi, rei, wbi, wei, self.upd_slots, self.upd_lanes,
+            self.max_upd, self.n_upd)
+        if rc < 0:
+            self.n_upd = -(rc + 1)      # partial updates are still real
+            return None
+        self.n_upd = int(rc)
+        return IdBatch(rbi, rei, wbi, wei, snap, n)
+
+    def encode_group_wire(self, wires: list[WireBatch], batch_size: int,
+                          ranges_per_txn: int, k_pad: int):
+        """encode_group on already-serialized WireBatches: no Python txn
+        walk at all — blob concatenation + one native call.  This is the
+        production-shaped path (the proxy serialized once; the resolver
+        stage starts here)."""
+        B, R = batch_size, ranges_per_txn
+        self.begin_group()
+        counts = np.fromiter((w.count for w in wires), np.int32, len(wires))
+        nr = np.concatenate([w.nr for w in wires])
+        nw = np.concatenate([w.nw for w in wires])
+        if len(nr) and (int(nr.max()) > R or int(nw.max()) > R):
+            raise ValueError(f"wire range count exceeds bucket {R}")
+        sizes = [len(w.blob) for w in wires]
+        bases = np.zeros(len(wires) + 1, dtype=np.int64)
+        np.cumsum(sizes, out=bases[1:])
+        offs = np.concatenate(
+            [w.offs[:-1] + bases[i] for i, w in enumerate(wires)]
+            + [bases[-1:]])
+        blob = b"".join(w.blob for w in wires)
+        ids = np.zeros(4 * k_pad * B * R, dtype=np.uint32)
+        rc = self._lib.kc_encode_group_ids(
+            self._h, blob, offs, nr, nw, counts, len(wires), k_pad, B, R,
+            self.width, ids, self.upd_slots, self.upd_lanes, self.max_upd)
+        snaps = np.full((k_pad, B), -1, dtype=np.int64)
+        for k, w in enumerate(wires):
+            snaps[k, :w.count] = w.snapshots
+        if rc < 0:
+            self.n_upd = -(rc + 1)
+            return None
+        self.n_upd = int(rc)
+        return ids, snaps, counts
+
+    def encode_group(self, chunks: list[list["TxnRequest"]], batch_size: int,
+                     ranges_per_txn: int, k_pad: int):
+        """encode_group_wire over TxnRequest chunks: serialize each chunk
+        (what a proxy does) and take the wire path.  Same return
+        contract."""
+        return self.encode_group_wire([wire_from_txns(c) for c in chunks],
+                                      batch_size, ranges_per_txn, k_pad)
+
+
+@dataclasses.dataclass
 class EncodedBatch:
     read_begin: np.ndarray   # [B, R, L] uint32
     read_end: np.ndarray

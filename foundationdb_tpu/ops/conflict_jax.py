@@ -326,6 +326,53 @@ def resolve_many_packed(state: ConflictState, pu32, pi64, *, shape,
                              width=width, window=window)
 
 
+@functools.partial(jax.jit, static_argnames=("shape", "width", "window"),
+                   donate_argnums=(0, 1))
+def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
+                     pi64, *, shape, width: int = DEFAULT_WIDTH,
+                     window: int = 0):
+    """resolve_many on dictionary-compressed inputs.
+
+    The device keeps every recently-seen range endpoint's lane row in a
+    resident dictionary ``dct [L, D]`` (slot 0 = the padding sentinel,
+    never reassigned); the host ships u32 slot ids — 4B per endpoint
+    instead of a 36B lane row — plus (slot, lane) updates for endpoints
+    not yet resident.  Updates apply before the gathers, and the host
+    never evicts a slot referenced by the in-flight group, so the
+    materialized lanes are bit-identical to the uncompressed path (same
+    resolve_many_core, so verdicts and ring state match exactly).
+
+    ids:  [4*K*B*R] u32 = rb | re | wb | we slot ids, raveled.
+    upd_slots: [U] u32 (0-padded: writing SENTINEL lanes to slot 0 is a
+    no-op by construction).  upd_lanes: [L, U] u32.  pi64 as
+    resolve_many_packed.
+    """
+    K, B, R, L = shape
+    dct2 = dct.at[:, upd_slots].set(upd_lanes)
+    n = K * B * R
+
+    def gather(seg):
+        return dct2[:, seg].T.reshape(K, B, R, L)
+
+    rb = gather(ids[0:n])
+    re = gather(ids[n:2 * n])
+    wb = gather(ids[2 * n:3 * n])
+    we = gather(ids[3 * n:4 * n])
+    sn = pi64[:K * B].reshape(K, B)
+    cvs = pi64[K * B:]
+    st, verdicts = resolve_many_core(state, rb, re, wb, we, sn, cvs,
+                                     width=width, window=window)
+    return st, dct2, verdicts
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def dict_update_step(dct, upd_slots, upd_lanes):
+    """Apply dictionary updates alone — the fallback when a group reverts
+    to the lanes path after its encoder already inserted endpoints into
+    the host table (the device mirror must not go stale)."""
+    return dct.at[:, upd_slots].set(upd_lanes)
+
+
 @jax.jit
 def set_oldest_step(state: ConflictState, v) -> ConflictState:
     """setOldestVersion analog (REF:fdbserver/SkipList.cpp setOldestVersion):
@@ -338,6 +385,10 @@ def set_oldest_step(state: ConflictState, v) -> ConflictState:
 # to the next bucket with ring-neutral padding batches (commit_version=-1)
 GROUP_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
+# update-count buckets compiled for resolve_many_ids: fine enough that a
+# warm dictionary ships little padding, coarse enough to bound compiles
+UPD_BUCKETS = (1024, 4096, 16384, 32768)
+
 
 class JaxConflictSet:
     """Drop-in peer of NumpyConflictSet backed by the XLA kernel.
@@ -349,7 +400,8 @@ class JaxConflictSet:
     """
 
     def __init__(self, capacity: int, width: int = DEFAULT_WIDTH,
-                 oldest_version: int = 0, device=None, window: int = 4096):
+                 oldest_version: int = 0, device=None, window: int = 4096,
+                 dict_slots: int = 0):
         if not jax.config.jax_enable_x64:
             raise RuntimeError(
                 "JaxConflictSet requires 64-bit versions: set JAX_ENABLE_X64=1 "
@@ -358,7 +410,9 @@ class JaxConflictSet:
         self.width = width
         self.device = device
         self.window = window
+        self.dict_slots = dict_slots
         self.state: ConflictState | None = None
+        self._dct = None                # [L, D] device lane dictionary
         self._init_floor = oldest_version
         self._slab = None
 
@@ -374,6 +428,27 @@ class JaxConflictSet:
         if not (0 < self.window < cap):
             self.window = 0
         state = init_state(cap, self.width, self._init_floor)
+        if self.device is not None:
+            state = jax.device_put(state, self.device)
+        self.state = state
+        if self.dict_slots and self._dct is None:
+            L = keycode.nlanes(self.width)
+            dct = jnp.full((L, self.dict_slots), SENTINEL_LANE, jnp.uint32)
+            if self.device is not None:
+                dct = jax.device_put(dct, self.device)
+            self._dct = dct
+
+    def reset_ring(self, oldest_version: int = 0) -> None:
+        """Clear the conflict history ring but KEEP the lane dictionary.
+        The dictionary is pure transfer-compression — verdicts never
+        depend on it — so a long-lived resolver process restarting its
+        MVCC window (or a bench pass restarting its measured run) need
+        not re-ship every endpoint."""
+        if self.state is None:
+            self._init_floor = oldest_version
+            return
+        cap = self.capacity
+        state = init_state(cap, self.width, oldest_version)
         if self.device is not None:
             state = jax.device_put(state, self.device)
         self.state = state
@@ -453,6 +528,84 @@ class JaxConflictSet:
             width=self.width, window=self.window)
         self._start_d2h(verdicts)
         return verdicts
+
+    def resolve_group_submit_dict(self, ibs: list, commit_versions: list[int],
+                                  upd_slots: np.ndarray,
+                                  upd_lanes: np.ndarray,
+                                  n_upd: int) -> jax.Array:
+        """Dictionary-compressed group dispatch from per-batch IdBatches;
+        see resolve_group_submit_ids for the packed fast path."""
+        assert len(ibs) == len(commit_versions) and ibs
+        B, R = ibs[0].read_begin.shape
+        k = len(ibs)
+        K = next(b for b in GROUP_BUCKETS if b >= k)
+        n = K * B * R
+        ids = np.zeros(4 * n, dtype=np.uint32)      # 0 = sentinel slot
+        for f, field in enumerate(("read_begin", "read_end",
+                                   "write_begin", "write_end")):
+            dst = ids[f * n:f * n + k * B * R].reshape(k, B, R)
+            for i, e in enumerate(ibs):
+                dst[i] = getattr(e, field)
+        snaps = np.full((K, B), -1, dtype=np.int64)
+        for i, e in enumerate(ibs):
+            snaps[i] = e.read_snapshot
+        return self.resolve_group_submit_ids(ids, snaps, (K, B, R),
+                                             commit_versions, upd_slots,
+                                             upd_lanes, n_upd)
+
+    def resolve_group_submit_ids(self, ids: np.ndarray, snaps: np.ndarray,
+                                 shape: tuple, commit_versions: list[int],
+                                 upd_slots: np.ndarray,
+                                 upd_lanes: np.ndarray,
+                                 n_upd: int) -> jax.Array:
+        """Dictionary-compressed group dispatch: u32 ids + lane updates
+        instead of full lane arrays.  Same [K, B] verdict contract as
+        ``resolve_group_submit`` and bit-identical verdicts/ring state
+        (the kernel materializes the very lanes the host would have
+        sent).  ``ids`` is the packed [4*K*B*R] buffer (0 = sentinel),
+        ``snaps`` is [K, B] with -1 padding."""
+        assert self.dict_slots, "dictionary disabled"
+        K, B, R = shape
+        self._ensure_state(B, R)
+        L = keycode.nlanes(self.width)
+        k = len(commit_versions)
+        pi64 = np.full(K * B + K, -1, dtype=np.int64)
+        pi64[:K * B] = snaps.reshape(-1)
+        pi64[K * B:K * B + k] = commit_versions
+        U = next((b for b in UPD_BUCKETS if b >= n_upd), UPD_BUCKETS[-1])
+        if n_upd > U:
+            raise ValueError(f"{n_upd} updates exceed bucket {U}")
+        put = functools.partial(jax.device_put, device=self.device)
+        # COPY the update slices: the encoder reuses its buffers for the
+        # next group (begin_group clears them) while this dispatch's
+        # device_put may still be staging asynchronously — a view would
+        # alias the mutation and ship corrupted updates
+        self.state, self._dct, verdicts = resolve_many_ids(
+            self.state, self._dct, put(ids),
+            put(np.array(upd_slots[:U], copy=True)),
+            put(np.array(upd_lanes[:, :U], copy=True)),
+            put(pi64), shape=(K, B, R, L), width=self.width,
+            window=self.window)
+        self._start_d2h(verdicts)
+        return verdicts
+
+    def apply_dict_updates(self, upd_slots: np.ndarray,
+                           upd_lanes: np.ndarray, n_upd: int) -> None:
+        """Ship updates without a resolve — used when a group falls back
+        to the lanes path after its encoder already inserted endpoints.
+        Chunked, so any update count is accepted."""
+        if self._dct is None or n_upd == 0:
+            return
+        put = functools.partial(jax.device_put, device=self.device)
+        cap = UPD_BUCKETS[-1]
+        for start in range(0, n_upd, cap):
+            m = min(n_upd - start, cap)
+            U = next(b for b in UPD_BUCKETS if b >= m)
+            sl = np.zeros(U, dtype=np.uint32)
+            sl[:m] = upd_slots[start:start + m]
+            ln = np.full((upd_lanes.shape[0], U), 0xFFFFFFFF, dtype=np.uint32)
+            ln[:, :m] = upd_lanes[:, start:start + m]
+            self._dct = dict_update_step(self._dct, put(sl), put(ln))
 
     def resolve_encoded(self, eb: EncodedBatch, commit_version: int) -> np.ndarray:
         return np.asarray(self.resolve_encoded_submit(eb, commit_version))

@@ -80,6 +80,32 @@ def _keycodec():
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                 u32p, u32p, u32p, u32p,
             ]
+            lib.kc_dict_new.argtypes = [ctypes.c_int64]
+            lib.kc_dict_new.restype = ctypes.c_void_p
+            lib.kc_dict_free.argtypes = [ctypes.c_void_p]
+            lib.kc_dict_group.argtypes = [ctypes.c_void_p]
+            lib.kc_dict_live.argtypes = [ctypes.c_void_p]
+            lib.kc_dict_live.restype = ctypes.c_int64
+            lib.kc_encode_batch_ids.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                u32p, u32p, u32p, u32p,
+                u32p, u32p, ctypes.c_int64, ctypes.c_int64,
+            ]
+            lib.kc_encode_batch_ids.restype = ctypes.c_int64
+            i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+            lib.kc_encode_group_ids.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                i32p, i32p, i32p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64,
+                u32p, u32p, u32p, ctypes.c_int64,
+            ]
+            lib.kc_encode_group_ids.restype = ctypes.c_int64
             _kc_lib = lib
         except Exception:           # noqa: BLE001 — numpy fallback below
             _kc_lib = False

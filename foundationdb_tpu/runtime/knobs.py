@@ -21,6 +21,7 @@ class Knobs:
     RESOLVER_CONFLICT_BACKEND: str = "numpy"  # cpp | numpy | tpu (jax)
     CONFLICT_RING_CAPACITY: int = 1 << 16     # history entries on device
     CONFLICT_WINDOW_SLOTS: int = 4096         # exact fast-path scan window (0 = always full ring)
+    CONFLICT_DICT_SLOTS: int = 1 << 21        # device endpoint-lane dictionary (0 = ship lanes)
     KEY_ENCODE_BYTES: int = 32                # fixed-width key prefix lanes (multiple of 8)
     RESOLVER_BATCH_TXNS: int = 64             # txns per resolve launch (static shape)
     RESOLVER_RANGES_PER_TXN: int = 8          # padded read/write ranges per txn

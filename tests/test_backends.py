@@ -77,3 +77,112 @@ def test_oversize_txn_is_conservative_not_error():
         # expected after a flip; stop comparing once they differ)
         if bv != ov:
             break
+
+
+def _run_groups(be, rng, n_groups=12, group=6, start_version=1000):
+    """Drive resolve_group_begin over random txn batches; returns flat
+    verdicts."""
+    import asyncio
+
+    from foundationdb_tpu.ops.backends import resolve_group_begin
+    version = start_version
+    out = []
+
+    async def drive():
+        nonlocal version
+        for _ in range(n_groups):
+            batches, versions = [], []
+            for _ in range(group):
+                batches.append([rand_txn(rng, version, nr=4)
+                                for _ in range(rng.random_int(1, 9))])
+                version += rng.random_int(1, 15)
+                versions.append(version)
+            for vs in await resolve_group_begin(be, batches, versions):
+                out.extend(vs)
+    asyncio.run(drive())
+    return out
+
+
+def test_dict_compressed_group_path_matches_lanes_path():
+    """The endpoint-id dictionary path (device-resident lane dictionary +
+    u32 ids) must produce bit-identical verdicts to the uncompressed lanes
+    path, including across dictionary slot eviction/reuse."""
+    # small dictionary (min viable = 8*R*B*64) forces slot reuse quickly
+    min_slots = 8 * 4 * 8 * 64
+    lanes = make_conflict_backend(
+        K(RESOLVER_CONFLICT_BACKEND="tpu", CONFLICT_DICT_SLOTS=0))
+    dct = make_conflict_backend(
+        K(RESOLVER_CONFLICT_BACKEND="tpu", CONFLICT_DICT_SLOTS=min_slots))
+    assert dct._dict is not None, "dictionary path not active"
+    r1 = _run_groups(lanes, DeterministicRandom(77))
+    r2 = _run_groups(dct, DeterministicRandom(77))
+    assert r1 == r2
+    # and the numpy twin agrees
+    np_be = make_conflict_backend(K(RESOLVER_CONFLICT_BACKEND="numpy"))
+    r3 = _run_groups(np_be, DeterministicRandom(77))
+    assert r1 == r3
+
+
+def test_dict_path_ring_state_matches_lanes_path():
+    import numpy as np
+    min_slots = 8 * 4 * 8 * 64
+    lanes = make_conflict_backend(
+        K(RESOLVER_CONFLICT_BACKEND="tpu", CONFLICT_DICT_SLOTS=0))
+    dct = make_conflict_backend(
+        K(RESOLVER_CONFLICT_BACKEND="tpu", CONFLICT_DICT_SLOTS=min_slots))
+    _run_groups(lanes, DeterministicRandom(5), n_groups=6)
+    _run_groups(dct, DeterministicRandom(5), n_groups=6)
+    for f in ("hb", "he", "hver", "ptr", "floor"):
+        a = np.asarray(getattr(lanes.cs.state, f))
+        b = np.asarray(getattr(dct.cs.state, f))
+        assert (a == b).all(), f"ring field {f} diverged"
+
+
+def test_wire_path_matches_object_path_both_backends():
+    """The serialized WireBatch form must resolve bit-identically to the
+    TxnRequest object form on both the cpp baseline and the jax/dict
+    path (the wire layout is the canonical proxy payload)."""
+    import asyncio
+
+    from foundationdb_tpu.ops.backends import resolve_group_wire_begin
+    from foundationdb_tpu.ops.batch import wire_from_txns
+
+    def gen(seed, n_groups=6, group=5):
+        rng = DeterministicRandom(seed)
+        version = 500
+        out = []
+        for _ in range(n_groups):
+            batches, versions = [], []
+            for _ in range(group):
+                batches.append([rand_txn(rng, version, nr=4)
+                                for _ in range(rng.random_int(1, 8))])
+                version += rng.random_int(1, 15)
+                versions.append(version)
+            out.append((batches, versions))
+        return out
+
+    def run_wire(be):
+        flat = []
+
+        async def drive():
+            for batches, versions in gen(31):
+                wires = [wire_from_txns(b) for b in batches]
+                for vs in await resolve_group_wire_begin(be, wires, versions):
+                    flat.extend(vs)
+        asyncio.run(drive())
+        return flat
+
+    def run_obj(be):
+        flat = []
+        for batches, versions in gen(31):
+            for b, v in zip(batches, versions):
+                flat.extend(be.resolve(b, v))
+        return flat
+
+    min_slots = 8 * 4 * 8 * 64
+    cpp_obj = run_obj(make_conflict_backend(K(RESOLVER_CONFLICT_BACKEND="cpp")))
+    cpp_wire = run_wire(make_conflict_backend(K(RESOLVER_CONFLICT_BACKEND="cpp")))
+    tpu_wire = run_wire(make_conflict_backend(
+        K(RESOLVER_CONFLICT_BACKEND="tpu", CONFLICT_DICT_SLOTS=min_slots)))
+    assert cpp_obj == cpp_wire, "cpp wire layout diverged from object path"
+    assert cpp_obj == tpu_wire, "tpu wire/dict path diverged from cpp"

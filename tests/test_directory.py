@@ -1,0 +1,198 @@
+"""Directory layer + Subspace + high-contention allocator.
+
+Reference test model: REF:bindings/python/fdb/directory_impl.py semantics
+and the bindingtester's directory operations — path→prefix mapping via
+the \\xfe node tree, allocator uniqueness under contention, partitions
+moving as a unit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from foundationdb_tpu.client.directory import (DirectoryError, DirectoryLayer,
+                                               DirectoryPartition,
+                                               HighContentionAllocator)
+from foundationdb_tpu.client.subspace import Subspace
+from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+
+def test_subspace_pack_unpack_range():
+    s = Subspace(("app",))
+    k = s.pack((1, b"x"))
+    assert s.unpack(k) == (1, b"x")
+    assert s.contains(k)
+    b, e = s.range((1,))
+    assert b <= s.pack((1, b"x")) < e
+    assert not (b <= s.pack((2,)) < e)
+    nested = s["users"]
+    assert nested.key().startswith(s.key())
+    assert Subspace(("app",))["users"] == nested
+
+
+async def _with_db(fn):
+    k = Knobs()
+    sim = SimulatedCluster(k, n_machines=3,
+                           spec=ClusterConfigSpec(min_workers=3))
+    await sim.start()
+    await sim.wait_epoch(1)
+    db = await sim.database()
+    try:
+        await fn(db)
+    finally:
+        await sim.stop()
+
+
+def test_directory_create_open_list_remove():
+    async def main(db):
+        dl = DirectoryLayer()
+
+        async def body(tr):
+            d = await dl.create_or_open(tr, ("app", "users"))
+            d2 = await dl.create_or_open(tr, ("app", "orders"))
+            assert d.key() != d2.key()
+            assert len(d.key()) < len(b"app/users")   # short allocated prefix
+            tr.set(d.pack((b"alice",)), b"1")
+            tr.set(d2.pack((7,)), b"o")
+            return d.key(), d2.key()
+        p1, p2 = await db.run(body)
+
+        async def body2(tr):
+            # reopen finds the same prefixes
+            d = await dl.open(tr, ("app", "users"))
+            assert d.key() == p1
+            assert await tr.get(d.pack((b"alice",))) == b"1"
+            names = await dl.list(tr, ("app",))
+            assert names == ["orders", "users"] or names == [b"orders", b"users"]
+            # create refuses an existing path; open refuses a missing one
+            try:
+                await dl.create(tr, ("app", "users"))
+                raise AssertionError("create on existing must fail")
+            except DirectoryError:
+                pass
+            try:
+                await dl.open(tr, ("app", "nope"))
+                raise AssertionError("open on missing must fail")
+            except DirectoryError:
+                pass
+        await db.run(body2)
+
+        async def body3(tr):
+            assert await dl.remove(tr, ("app", "users"))
+            assert not await dl.exists(tr, ("app", "users"))
+            d2 = await dl.open(tr, ("app", "orders"))
+            assert await tr.get(d2.pack((7,))) == b"o"
+            # removed directory's content is gone
+            rows = await tr.get_range(p1, p1 + b"\xff")
+            assert not rows
+        await db.run(body3)
+    run_simulation(_with_db(main))
+
+
+def test_directory_move_and_layer_check():
+    async def main(db):
+        dl = DirectoryLayer()
+
+        async def body(tr):
+            d = await dl.create_or_open(tr, ("a", "b"), layer=b"queue")
+            tr.set(d.pack((1,)), b"v")
+            return d.key()
+        prefix = await db.run(body)
+
+        async def body2(tr):
+            moved = await dl.move(tr, ("a", "b"), ("c",))
+            assert moved.key() == prefix       # same prefix, new path
+            assert not await dl.exists(tr, ("a", "b"))
+            d = await dl.open(tr, ("c",), layer=b"queue")
+            assert await tr.get(d.pack((1,))) == b"v"
+            try:
+                await dl.open(tr, ("c",), layer=b"other")
+                raise AssertionError("layer mismatch must fail")
+            except DirectoryError:
+                pass
+            try:
+                await dl.move(tr, ("c",), ("c", "inside"))
+                raise AssertionError("move into self must fail")
+            except DirectoryError:
+                pass
+        await db.run(body2)
+    run_simulation(_with_db(main))
+
+
+def test_directory_partition_moves_as_unit():
+    async def main(db):
+        dl = DirectoryLayer()
+
+        async def body(tr):
+            p = await dl.create_or_open(tr, ("tenants", "acme"),
+                                        layer=b"partition")
+            assert isinstance(p, DirectoryPartition)
+            inner = await p.create_or_open(tr, ("data",))
+            tr.set(inner.pack((b"k",)), b"v")
+            # raw subspace use of a partition is an error
+            try:
+                p.pack((1,))
+                raise AssertionError("partition raw use must fail")
+            except DirectoryError:
+                pass
+        await db.run(body)
+
+        async def body2(tr):
+            p = await dl.open(tr, ("tenants", "acme"))
+            inner = await p.open(tr, ("data",))
+            assert await tr.get(inner.pack((b"k",))) == b"v"
+            names = await p.list(tr)
+            assert [str(n) if isinstance(n, str) else n.decode()
+                    for n in names] == ["data"]
+        await db.run(body2)
+    run_simulation(_with_db(main))
+
+
+def test_hca_unique_under_contention():
+    """Concurrent allocators must never hand out the same prefix."""
+    async def main(db):
+        hca_space = Subspace((b"hca-test",))
+        got: list[bytes] = []
+
+        async def one(i):
+            async def body(tr):
+                hca = HighContentionAllocator(hca_space)
+                return await hca.allocate(tr)
+            got.append(await db.run(body))
+        await asyncio.gather(*(one(i) for i in range(24)))
+        assert len(set(got)) == len(got), f"duplicate prefixes: {got}"
+    run_simulation(_with_db(main))
+
+
+def test_directory_path_crossing_partition_routes_inside():
+    """A path whose ancestor is a partition must resolve inside the
+    partition's own node tree — dl.open(("t","p","data")) and
+    partition.open(("data",)) are the same directory."""
+    async def main(db):
+        dl = DirectoryLayer()
+
+        async def body(tr):
+            p = await dl.create_or_open(tr, ("t", "p"), layer=b"partition")
+            inner = await p.create_or_open(tr, ("data",))
+            tr.set(inner.pack((b"k",)), b"v")
+            return inner.key()
+        inner_prefix = await db.run(body)
+
+        async def body2(tr):
+            via_dl = await dl.open(tr, ("t", "p", "data"))
+            assert via_dl.key() == inner_prefix
+            assert await dl.exists(tr, ("t", "p", "data"))
+            created = await dl.create_or_open(tr, ("t", "p", "more"))
+            p = await dl.open(tr, ("t", "p"))
+            names = sorted(str(n) if isinstance(n, str) else n.decode()
+                           for n in await p.list(tr))
+            assert names == ["data", "more"], names
+            # listing through the outer layer routes too
+            names2 = sorted(str(n) if isinstance(n, str) else n.decode()
+                            for n in await dl.list(tr, ("t", "p")))
+            assert names2 == ["data", "more"], names2
+        await db.run(body2)
+    run_simulation(_with_db(main))
