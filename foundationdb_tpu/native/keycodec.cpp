@@ -320,6 +320,56 @@ int64_t kc_encode_batch_ids(void* dict, const uint8_t* flat,
 
 }  // extern "C"
 
+namespace {
+
+// Shared group walk for both id-encoder layouts.  with_ends=true emits
+// the 4-segment [rb|re|wb|we] layout; false emits the compact 2-segment
+// [rb|wb] layout (end keys never touch the dictionary).  Returns new
+// n_upd or -(partial+1) on update-buffer overflow.
+int64_t kd_encode_group(KcDict* d, const uint8_t* flat, const int64_t* offs,
+                        const int32_t* nr, const int32_t* nw,
+                        const int32_t* counts, int64_t K_real, int64_t K_pad,
+                        int64_t B, int64_t R, int64_t width,
+                        uint32_t* ids_out, uint32_t* upd_slots,
+                        uint32_t* upd_lanes, int64_t max_upd,
+                        bool with_ends) {
+    const int64_t seg = K_pad * B * R;
+    uint32_t* rbi = ids_out;
+    uint32_t* rei = with_ends ? ids_out + seg : nullptr;
+    uint32_t* wbi = with_ends ? ids_out + 2 * seg : ids_out + seg;
+    uint32_t* wei = with_ends ? ids_out + 3 * seg : nullptr;
+    int64_t n_upd = 0;
+    int overflow = 0;
+    int64_t key = 0, t = 0;
+    for (int64_t k = 0; k < K_real; ++k) {
+        const int64_t base = k * B * R;
+        for (int32_t i = 0; i < counts[k]; ++i, ++t) {
+            for (int32_t pass = 0; pass < 2; ++pass) {
+                const int32_t cnt = pass == 0 ? nr[t] : nw[t];
+                uint32_t* bi = pass == 0 ? rbi : wbi;
+                uint32_t* ei = pass == 0 ? rei : wei;
+                for (int32_t j = 0; j < cnt; ++j) {
+                    bi[base + i * R + j] = kd_id(
+                        d, flat + offs[key], offs[key + 1] - offs[key],
+                        width, upd_slots, upd_lanes, max_upd, &n_upd,
+                        &overflow);
+                    ++key;
+                    if (ei)
+                        ei[base + i * R + j] = kd_id(
+                            d, flat + offs[key], offs[key + 1] - offs[key],
+                            width, upd_slots, upd_lanes, max_upd, &n_upd,
+                            &overflow);
+                    ++key;
+                }
+            }
+            if (overflow) return -(n_upd + 1);
+        }
+    }
+    return n_upd;
+}
+
+}  // namespace
+
 extern "C" {
 
 // Whole-GROUP id encoder: K_real batches' txns concatenated in one blob,
@@ -339,46 +389,67 @@ int64_t kc_encode_group_ids(void* dict, const uint8_t* flat,
                             uint32_t* ids_out,
                             uint32_t* upd_slots, uint32_t* upd_lanes,
                             int64_t max_upd) {
+    return kd_encode_group(static_cast<KcDict*>(dict), flat, offs, nr, nw,
+                           counts, K_real, K_pad, B, R, width, ids_out,
+                           upd_slots, upd_lanes, max_upd,
+                           /*with_ends=*/true);
+}
+}  // extern "C"
+
+namespace {
+
+inline bool kd_is_point(const uint8_t* flat, const int64_t* offs,
+                        int64_t key) {
+    const int64_t blen = offs[key + 1] - offs[key];
+    const int64_t elen = offs[key + 2] - offs[key + 1];
+    return elen == blen + 1 &&
+           flat[offs[key + 1] + blen] == 0 &&
+           memcmp(flat + offs[key], flat + offs[key + 1],
+                  static_cast<size_t>(blen)) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Group id encoder v2 with point-range compression.  A "point" range is
+// [k, k+'\0') — the canonical single-key conflict range; its end key's
+// lane row is derivable on device from the begin's (same data lanes,
+// length lane + 1), so when EVERY range in the group is a point, only
+// begin ids ship: ids_out = [rb | wb], 2 segments, and end endpoints
+// never enter the dictionary at all.  Mixed/range groups fall back to
+// the 4-segment layout.  *compact_out reports which layout was written.
+// Returns new n_upd or -(partial+1) on update-buffer overflow.
+int64_t kc_encode_group_ids2(void* dict, const uint8_t* flat,
+                             const int64_t* offs, const int32_t* nr,
+                             const int32_t* nw, const int32_t* counts,
+                             int64_t K_real, int64_t K_pad, int64_t B,
+                             int64_t R, int64_t width,
+                             uint32_t* ids_out,
+                             uint32_t* upd_slots, uint32_t* upd_lanes,
+                             int64_t max_upd, int64_t* compact_out) {
     KcDict* d = static_cast<KcDict*>(dict);
-    const int64_t seg = K_pad * B * R;
-    uint32_t* rbi = ids_out;
-    uint32_t* rei = ids_out + seg;
-    uint32_t* wbi = ids_out + 2 * seg;
-    uint32_t* wei = ids_out + 3 * seg;
-    int64_t n_upd = 0;
-    int overflow = 0;
-    int64_t key = 0, t = 0;
-    for (int64_t k = 0; k < K_real; ++k) {
-        const int64_t base = k * B * R;
-        for (int32_t i = 0; i < counts[k]; ++i, ++t) {
-            for (int32_t j = 0; j < nr[t]; ++j) {
-                rbi[base + i * R + j] = kd_id(d, flat + offs[key],
-                                              offs[key + 1] - offs[key],
-                                              width, upd_slots, upd_lanes,
-                                              max_upd, &n_upd, &overflow);
-                ++key;
-                rei[base + i * R + j] = kd_id(d, flat + offs[key],
-                                              offs[key + 1] - offs[key],
-                                              width, upd_slots, upd_lanes,
-                                              max_upd, &n_upd, &overflow);
-                ++key;
+    // pass 1: is every range in the group a point?
+    bool compact = true;
+    {
+        int64_t key = 0, t = 0;
+        for (int64_t k = 0; k < K_real && compact; ++k) {
+            for (int32_t i = 0; i < counts[k] && compact; ++i, ++t) {
+                for (int32_t j = 0; j < nr[t] + nw[t]; ++j, key += 2) {
+                    if (!kd_is_point(flat, offs, key)) { compact = false; break; }
+                }
             }
-            for (int32_t j = 0; j < nw[t]; ++j) {
-                wbi[base + i * R + j] = kd_id(d, flat + offs[key],
-                                              offs[key + 1] - offs[key],
-                                              width, upd_slots, upd_lanes,
-                                              max_upd, &n_upd, &overflow);
-                ++key;
-                wei[base + i * R + j] = kd_id(d, flat + offs[key],
-                                              offs[key + 1] - offs[key],
-                                              width, upd_slots, upd_lanes,
-                                              max_upd, &n_upd, &overflow);
-                ++key;
-            }
-            if (overflow) return -(n_upd + 1);
+            if (!compact) break;
         }
     }
-    return n_upd;
+    *compact_out = compact ? 1 : 0;
+    if (!compact)
+        return kc_encode_group_ids(dict, flat, offs, nr, nw, counts, K_real,
+                                   K_pad, B, R, width, ids_out, upd_slots,
+                                   upd_lanes, max_upd);
+    return kd_encode_group(d, flat, offs, nr, nw, counts, K_real, K_pad,
+                           B, R, width, ids_out, upd_slots, upd_lanes,
+                           max_upd, /*with_ends=*/false);
 }
 
 }  // extern "C"

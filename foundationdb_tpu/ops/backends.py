@@ -283,10 +283,10 @@ class EncodedConflictBackend:
                 K = next(b for b in GROUP_BUCKETS if b >= len(sub))
                 enc = d.encode_group(sub, self.B, self.R, K)
                 if enc is not None and d.n_upd <= UPD_BUCKETS[-1]:
-                    ids, snaps, _counts = enc
+                    ids, snaps, _counts, compact = enc
                     pending.append((len(sub), self.cs.resolve_group_submit_ids(
                         ids, snaps, (K, self.B, self.R), subv,
-                        d.upd_slots, d.upd_lanes, d.n_upd)))
+                        d.upd_slots, d.upd_lanes, d.n_upd, compact)))
                     continue
                 # update-buffer (or bucket) overflow: the inserted
                 # endpoints are real table state — ship them, then
@@ -338,7 +338,7 @@ class EncodedConflictBackend:
                 # buffer; the partial insertions are real regardless
                 self.cs.apply_dict_updates(d.upd_slots, d.upd_lanes, d.n_upd)
                 raise ValueError("update buffer overflow on wire path")
-            ids, snaps, counts = enc
+            ids, snaps, counts, compact = enc
             n_upd = d.n_upd
             if n_upd > UPD_BUCKETS[-1]:
                 # cold-start burst past the largest transfer bucket: ship
@@ -347,7 +347,7 @@ class EncodedConflictBackend:
                 n_upd = 0
             pending.append((counts, self.cs.resolve_group_submit_ids(
                 ids, snaps, (K, self.B, self.R), subv,
-                d.upd_slots, d.upd_lanes, n_upd)))
+                d.upd_slots, d.upd_lanes, n_upd, compact)))
 
         async def finish() -> list[list[int]]:
             from ..runtime.simloop import SimEventLoop

@@ -198,7 +198,12 @@ class DictEncoder:
         """encode_group on already-serialized WireBatches: no Python txn
         walk at all — blob concatenation + one native call.  This is the
         production-shaped path (the proxy serialized once; the resolver
-        stage starts here)."""
+        stage starts here).
+
+        Returns (ids, snaps, counts, compact): when every range in the
+        group is a point range [k, k+'\\0'), ``compact`` is True and
+        ``ids`` holds only the 2-segment [rb | wb] begin ids — the end
+        rows are derived on device, halving id transfer."""
         B, R = batch_size, ranges_per_txn
         self.begin_group()
         counts = np.fromiter((w.count for w in wires), np.int32, len(wires))
@@ -214,9 +219,11 @@ class DictEncoder:
             + [bases[-1:]])
         blob = b"".join(w.blob for w in wires)
         ids = np.zeros(4 * k_pad * B * R, dtype=np.uint32)
-        rc = self._lib.kc_encode_group_ids(
+        compact_out = np.zeros(1, dtype=np.int64)
+        rc = self._lib.kc_encode_group_ids2(
             self._h, blob, offs, nr, nw, counts, len(wires), k_pad, B, R,
-            self.width, ids, self.upd_slots, self.upd_lanes, self.max_upd)
+            self.width, ids, self.upd_slots, self.upd_lanes, self.max_upd,
+            compact_out)
         snaps = np.full((k_pad, B), -1, dtype=np.int64)
         for k, w in enumerate(wires):
             snaps[k, :w.count] = w.snapshots
@@ -224,7 +231,10 @@ class DictEncoder:
             self.n_upd = -(rc + 1)
             return None
         self.n_upd = int(rc)
-        return ids, snaps, counts
+        compact = bool(compact_out[0])
+        if compact:
+            ids = ids[:2 * k_pad * B * R]
+        return ids, snaps, counts, compact
 
     def encode_group(self, chunks: list[list["TxnRequest"]], batch_size: int,
                      ranges_per_txn: int, k_pad: int):

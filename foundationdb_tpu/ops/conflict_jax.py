@@ -326,11 +326,12 @@ def resolve_many_packed(state: ConflictState, pu32, pi64, *, shape,
                              width=width, window=window)
 
 
-@functools.partial(jax.jit, static_argnames=("shape", "width", "window"),
+@functools.partial(jax.jit,
+                   static_argnames=("shape", "width", "window", "compact"),
                    donate_argnums=(0, 1))
 def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
                      pi64, *, shape, width: int = DEFAULT_WIDTH,
-                     window: int = 0):
+                     window: int = 0, compact: bool = False):
     """resolve_many on dictionary-compressed inputs.
 
     The device keeps every recently-seen range endpoint's lane row in a
@@ -342,7 +343,10 @@ def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
     materialized lanes are bit-identical to the uncompressed path (same
     resolve_many_core, so verdicts and ring state match exactly).
 
-    ids:  [4*K*B*R] u32 = rb | re | wb | we slot ids, raveled.
+    ids:  [4*K*B*R] u32 = rb | re | wb | we slot ids, raveled — or, with
+    ``compact=True`` (an all-point group: every range is [k, k+'\\0')),
+    [2*K*B*R] = rb | wb begin ids only; the end rows are derived on
+    device by ``_point_end``, halving id transfer.
     upd_slots: [U] u32 (0-padded: writing SENTINEL lanes to slot 0 is a
     no-op by construction).  upd_lanes: [L, U] u32.  pi64 as
     resolve_many_packed.
@@ -354,15 +358,33 @@ def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
     def gather(seg):
         return dct2[:, seg].T.reshape(K, B, R, L)
 
-    rb = gather(ids[0:n])
-    re = gather(ids[n:2 * n])
-    wb = gather(ids[2 * n:3 * n])
-    we = gather(ids[3 * n:4 * n])
+    if compact:
+        rb = gather(ids[0:n])
+        wb = gather(ids[n:2 * n])
+        re = _point_end(rb, width)
+        we = _point_end(wb, width)
+    else:
+        rb = gather(ids[0:n])
+        re = gather(ids[n:2 * n])
+        wb = gather(ids[2 * n:3 * n])
+        we = gather(ids[3 * n:4 * n])
     sn = pi64[:K * B].reshape(K, B)
     cvs = pi64[K * B:]
     st, verdicts = resolve_many_core(state, rb, re, wb, we, sn, cvs,
                                      width=width, window=window)
     return st, dct2, verdicts
+
+
+def _point_end(x, width):
+    """Lane rows of k+'\\0' derived from k's: identical data lanes (the
+    appended NUL is already the zero padding), length lane + 1 clamped to
+    the truncation marker; sentinels stay sentinels.  Bit-identical to
+    host-encoding the end key (tested)."""
+    ll = x[..., -1]
+    sent = ll == jnp.uint32(0xFFFFFFFF)
+    newll = jnp.where(sent, ll,
+                      jnp.minimum(ll + jnp.uint32(1), jnp.uint32(width + 1)))
+    return jnp.concatenate([x[..., :-1], newll[..., None]], axis=-1)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -557,7 +579,7 @@ class JaxConflictSet:
                                  shape: tuple, commit_versions: list[int],
                                  upd_slots: np.ndarray,
                                  upd_lanes: np.ndarray,
-                                 n_upd: int) -> jax.Array:
+                                 n_upd: int, compact: bool = False) -> jax.Array:
         """Dictionary-compressed group dispatch: u32 ids + lane updates
         instead of full lane arrays.  Same [K, B] verdict contract as
         ``resolve_group_submit`` and bit-identical verdicts/ring state
@@ -585,7 +607,7 @@ class JaxConflictSet:
             put(np.array(upd_slots[:U], copy=True)),
             put(np.array(upd_lanes[:, :U], copy=True)),
             put(pi64), shape=(K, B, R, L), width=self.width,
-            window=self.window)
+            window=self.window, compact=compact)
         self._start_d2h(verdicts)
         return verdicts
 

@@ -106,6 +106,16 @@ def _keycodec():
                 u32p, u32p, u32p, ctypes.c_int64,
             ]
             lib.kc_encode_group_ids.restype = ctypes.c_int64
+            lib.kc_encode_group_ids2.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                i32p, i32p, i32p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64,
+                u32p, u32p, u32p, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ]
+            lib.kc_encode_group_ids2.restype = ctypes.c_int64
             _kc_lib = lib
         except Exception:           # noqa: BLE001 — numpy fallback below
             _kc_lib = False
