@@ -39,10 +39,22 @@ ATOMIC_NAMES = {
 
 
 class StackMachine:
-    """One interpreter over a Database-like async client."""
+    """One interpreter over a Database-like async client.
 
-    def __init__(self, db) -> None:
+    Directory ops (DIRECTORY_*) follow the upstream directory tester: a
+    directory list holds opened DirectorySubspaces; DIRECTORY_CHANGE
+    selects the active one.  Both implementations get a DirectoryLayer
+    seeded with the SAME allocator RNG, so prefix allocation — and hence
+    the raw database bytes — must match exactly."""
+
+    def __init__(self, db, dir_seed: int | None = None) -> None:
         self.db = db
+        self.dirs: list = []
+        self.dir_idx = 0
+        if dir_seed is not None:
+            from foundationdb_tpu.client.directory import DirectoryLayer
+            from foundationdb_tpu.runtime.rng import DeterministicRandom
+            self.dirs = [DirectoryLayer(rng=DeterministicRandom(dir_seed))]
         self.stack: list[Any] = []
         self.tr = db.create_transaction()
 
@@ -137,8 +149,75 @@ class StackMachine:
             b, e = fdbtuple.range_of(list(reversed(items)))
             self.push(b)
             self.push(e)
+        elif op.startswith("DIRECTORY_"):
+            await self._dispatch_directory(op)
         else:
             raise ValueError(f"unknown stack op {op!r}")
+
+    def _cur_dir(self):
+        return self.dirs[self.dir_idx]
+
+    async def _dispatch_directory(self, op: str) -> None:
+        from foundationdb_tpu.client.directory import DirectoryError
+        try:
+            if op == "DIRECTORY_CREATE_OR_OPEN":
+                path, layer = self.pop(2)
+                d = await self._cur_dir().create_or_open(
+                    self.tr, fdbtuple.unpack(path), layer)
+                self.dirs.append(d)
+                self.push(len(self.dirs) - 1)
+            elif op == "DIRECTORY_OPEN":
+                path, layer = self.pop(2)
+                d = await self._cur_dir().open(self.tr,
+                                               fdbtuple.unpack(path), layer)
+                self.dirs.append(d)
+                self.push(len(self.dirs) - 1)
+            elif op == "DIRECTORY_CREATE":
+                path, layer = self.pop(2)
+                d = await self._cur_dir().create(self.tr,
+                                                 fdbtuple.unpack(path), layer)
+                self.dirs.append(d)
+                self.push(len(self.dirs) - 1)
+            elif op == "DIRECTORY_CHANGE":
+                i = self.pop()
+                self.dir_idx = i if 0 <= i < len(self.dirs) else 0
+            elif op == "DIRECTORY_EXISTS":
+                path = self.pop()
+                ok = await self._cur_dir().exists(self.tr,
+                                                  fdbtuple.unpack(path))
+                self.push(1 if ok else 0)
+            elif op == "DIRECTORY_LIST":
+                path = self.pop()
+                names = await self._cur_dir().list(self.tr,
+                                                   fdbtuple.unpack(path))
+                self.push(fdbtuple.pack([str(n) for n in names]))
+            elif op == "DIRECTORY_MOVE":
+                old, new = self.pop(2)
+                d = await self._cur_dir().move(self.tr, fdbtuple.unpack(old),
+                                               fdbtuple.unpack(new))
+                self.dirs.append(d)
+                self.push(len(self.dirs) - 1)
+            elif op == "DIRECTORY_REMOVE":
+                path = self.pop()
+                ok = await self._cur_dir().remove(self.tr,
+                                                  fdbtuple.unpack(path))
+                self.push(1 if ok else 0)
+            elif op == "DIRECTORY_PACK_KEY":
+                t = self.pop()
+                d = self._cur_dir()
+                if not hasattr(d, "pack"):
+                    raise DirectoryError("cannot pack through the layer")
+                self.push(d.pack(fdbtuple.unpack(t)))
+            elif op == "DIRECTORY_SET":
+                t, value = self.pop(2)
+                d = self._cur_dir()
+                if not hasattr(d, "pack"):
+                    raise DirectoryError("cannot set through the layer")
+                self.tr.set(d.pack(fdbtuple.unpack(t)), value)
+            else:
+                raise ValueError(f"unknown directory op {op!r}")
+        except DirectoryError:
+            self.push(fdbtuple.pack((b"DIRECTORY_ERROR",)))
 
 
 class ModelTransaction:
@@ -174,10 +253,11 @@ class ModelTransaction:
             else:
                 data[w[2]] = new
 
-    async def get(self, key: bytes):
+    async def get(self, key: bytes, snapshot: bool = False):
         return self._view().get(key)
 
-    async def get_range(self, begin, end, limit=0, reverse=False):
+    async def get_range(self, begin, end, limit=0, reverse=False,
+                        snapshot: bool = False):
         rows = sorted((k, v) for k, v in self._view().items()
                       if begin <= k < end)
         if reverse:
@@ -198,6 +278,9 @@ class ModelTransaction:
 
     def atomic_op(self, op, key, operand) -> None:
         self._writes.append(("atomic", op, key, operand))
+
+    def add(self, key, operand) -> None:
+        self.atomic_op(MutationType.ADD, key, operand)
 
     async def commit(self) -> int:
         for w in self._writes:
@@ -270,5 +353,54 @@ def generate_program(seed: int, n_ops: int = 300,
                 prog.insert(-1, ("PUSH", rng.randrange(min(depth, 3))))
             else:
                 depth -= 1
+    prog.append(("COMMIT",))
+    return prog
+
+
+def generate_directory_program(seed: int, n_ops: int = 60) -> list[tuple]:
+    """A seeded directory-op stream (DIRECTORY_* spec subset).  Tracks
+    the machine's directory-list length so CHANGE indices are always
+    valid, and only packs/sets through real DirectorySubspaces (index
+    > 0)."""
+    rng = random.Random(seed)
+    names = ["a", "b", "c", "d"]
+    prog: list[tuple] = [("NEW_TRANSACTION",)]
+
+    def path() -> bytes:
+        return fdbtuple.pack([rng.choice(names)
+                              for _ in range(rng.randrange(1, 3))])
+
+    for _ in range(n_ops):
+        op = rng.choice(["CREATE_OR_OPEN", "OPEN", "CREATE", "EXISTS",
+                         "LIST", "MOVE", "REMOVE", "CHANGE", "PACK",
+                         "SET", "COMMIT"])
+        if op in ("CREATE_OR_OPEN", "OPEN", "CREATE"):
+            layer = rng.choice([b"", b"", b"queue"])
+            prog += [("PUSH", layer), ("PUSH", path()),
+                     (f"DIRECTORY_{op}",)]
+        elif op == "EXISTS":
+            prog += [("PUSH", path()), ("DIRECTORY_EXISTS",)]
+        elif op == "LIST":
+            prog += [("PUSH", fdbtuple.pack(())), ("DIRECTORY_LIST",)]
+        elif op == "MOVE":
+            prog += [("PUSH", path()), ("PUSH", path()),
+                     ("DIRECTORY_MOVE",)]
+        elif op == "REMOVE":
+            prog += [("PUSH", path()), ("DIRECTORY_REMOVE",)]
+        elif op == "CHANGE":
+            # invalid indices clamp to 0 (the layer) in the machine; the
+            # same clamp happens in both implementations
+            prog += [("PUSH", rng.randrange(0, 6)), ("DIRECTORY_CHANGE",)]
+        elif op == "PACK":
+            # on the layer (index 0) this pushes DIRECTORY_ERROR — in
+            # both implementations identically
+            prog += [("PUSH", fdbtuple.pack((rng.randrange(10),))),
+                     ("DIRECTORY_PACK_KEY",)]
+        elif op == "SET":
+            prog += [("PUSH", b"v%03d" % rng.randrange(1000)),
+                     ("PUSH", fdbtuple.pack((rng.randrange(10),))),
+                     ("DIRECTORY_SET",)]
+        elif op == "COMMIT":
+            prog.append(("COMMIT",))
     prog.append(("COMMIT",))
     return prog

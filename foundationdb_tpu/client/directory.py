@@ -51,9 +51,12 @@ class HighContentionAllocator:
     older state is cleared.
     """
 
-    def __init__(self, subspace: Subspace) -> None:
+    def __init__(self, subspace: Subspace, rng=None) -> None:
         self.counters = subspace[0]
         self.recent = subspace[1]
+        # injectable for the bindingtester (two implementations must draw
+        # identical candidate sequences); defaults to the process RNG
+        self._rng = rng
 
     @staticmethod
     def _window_size(start: int) -> int:
@@ -98,8 +101,9 @@ class HighContentionAllocator:
                 # the process RNG, NOT os.urandom: every source of
                 # randomness must flow through the seeded generator or
                 # simulation replay loses bit-for-bit determinism
-                candidate = start + deterministic_random().random_int(
-                    0, window - 1)
+                rng = self._rng if self._rng is not None \
+                    else deterministic_random()
+                candidate = start + rng.random_int(0, window - 1)
                 latest = await self._current_start(tr)
                 if latest > start:
                     break       # window moved under us: restart outer
@@ -203,14 +207,15 @@ class DirectoryPartition(DirectorySubspace):
 class DirectoryLayer:
     def __init__(self,
                  node_subspace: Subspace | None = None,
-                 content_subspace: Subspace | None = None) -> None:
+                 content_subspace: Subspace | None = None,
+                 rng=None) -> None:
         self._nodes = node_subspace if node_subspace is not None \
             else Subspace.from_raw(b"\xfe")
         self._content = content_subspace if content_subspace is not None \
             else Subspace()
         # the root node's key prefix is the node subspace's own prefix
         self._root = self._nodes[self._nodes.key()]
-        self._allocator = HighContentionAllocator(self._root[b"hca"])
+        self._allocator = HighContentionAllocator(self._root[b"hca"], rng)
         self._path: tuple = ()
 
     # --- node helpers.  A node is nodes[prefix]; children live at

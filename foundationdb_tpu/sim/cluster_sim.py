@@ -166,6 +166,13 @@ class SimulatedCluster:
 
     # --- fault targeting ---
 
+    def leader_cc(self):
+        """The live ClusterController, if any machine currently leads."""
+        for m in self.machines:
+            if m.alive and m.host is not None and m.host.cc is not None:
+                return m.host.cc
+        return None
+
     async def txn_only_machines(self) -> list[SimMachine]:
         """Machines whose kill exercises recovery: hosting at least one
         txn-subsystem role, but no storage replica (re-replication needs

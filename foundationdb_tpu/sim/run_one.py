@@ -33,6 +33,9 @@ async def simulate(seed: int, kills: int, buggify: bool) -> dict:
         {"testName": "Cycle", "nodeCount": 12, "transactionsPerClient": 30},
         {"testName": "Serializability", "numOps": 40},
         {"testName": "AtomicOps", "addsPerClient": 15},
+        {"testName": "Watches", "rounds": 3, "strictFires": False},
+        {"testName": "ConfigureDatabase", "sim": sim, "rounds": 2,
+         "secondsBetweenChanges": 2.5},
         {"testName": "MachineAttrition", "sim": sim, "machinesToKill": kills},
         {"testName": "RandomClogging", "sim": sim, "testDuration": 8.0},
         {"testName": "ConsistencyCheck"},

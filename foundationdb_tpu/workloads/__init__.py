@@ -9,4 +9,5 @@ violated by lost/phantom/reordered writes.
 
 from .workload import (TestWorkload, WorkloadContext, register_workload,
                        make_workload, run_workloads, run_workloads_on)
-from . import attrition, consistency, cycle, serializability, random_rw  # noqa: F401  (register)
+from . import (attrition, consistency, cycle, dynamic, random_rw,  # noqa: F401  (register)
+               serializability)

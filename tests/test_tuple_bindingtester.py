@@ -200,3 +200,48 @@ def test_multiversion_versionstamp_gate():
         mv._reset_api_version_for_tests()
         await sim.stop()
     run_simulation(main())
+
+
+def test_stack_machine_directory_ops_native_vs_model():
+    """Directory-layer bindingtester: the same seeded DIRECTORY_* stream
+    through the native client and the brute-force model must leave
+    byte-identical stacks AND byte-identical databases (both layers draw
+    allocator candidates from identically-seeded RNGs)."""
+    from bindings.bindingtester.stack_tester import (
+        ModelDatabase, StackMachine, generate_directory_program)
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.core.data import SYSTEM_PREFIX
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.runtime.simloop import run_simulation
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        sim = SimulatedCluster(Knobs(), n_machines=4,
+                               spec=ClusterConfigSpec(min_workers=4))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        for seed in (4, 9):
+            program = generate_directory_program(seed, n_ops=50)
+            native = StackMachine(db, dir_seed=1000 + seed)
+            model = StackMachine(ModelDatabase(), dir_seed=1000 + seed)
+            await native.run(program)
+            await model.run(program)
+            assert native.stack == model.stack, (
+                f"seed {seed}: stack diverged at index "
+                f"{next(i for i, (a, b) in enumerate(zip(native.stack, model.stack)) if a != b)}"
+            )
+            tr = db.create_transaction()
+            while True:
+                try:
+                    rows = await tr.get_range(b"", SYSTEM_PREFIX, limit=0)
+                    break
+                except Exception as e:  # noqa: BLE001
+                    await tr.on_error(e)
+            assert dict(rows) == model.db.data, f"seed {seed}: db diverged"
+
+            async def wipe(t):
+                t.clear_range(b"", SYSTEM_PREFIX)
+            await db.run(wipe)
+        await sim.stop()
+    run_simulation(main())

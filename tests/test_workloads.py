@@ -59,3 +59,45 @@ def test_workload_determinism():
                                "transactionsPerClient": 15}],
                              seed=21, config=multi(), client_count=3)
     assert go() == go()
+
+
+def test_watches_workload():
+    """Watch fires reflect real changes; re-arm on storage errors."""
+    from foundationdb_tpu.workloads.workload import run_workloads
+
+    results = run_workloads(
+        [{"testName": "Watches", "rounds": 3, "nodeCount": 3}],
+        seed=5, client_count=2)
+    assert results["Watches"]["watch_fires"] >= 6
+
+
+def test_configure_database_workload_with_cycle():
+    """Random role-count churn forcing recoveries mid-run, while Cycle's
+    permutation invariant holds (REF:fdbserver/workloads/
+    ConfigureDatabase.actor.cpp)."""
+    import asyncio
+
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.runtime.simloop import run_simulation
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+    from foundationdb_tpu.workloads.workload import run_workloads_on
+
+    async def main():
+        sim = SimulatedCluster(Knobs(), n_machines=5,
+                               spec=ClusterConfigSpec(min_workers=5))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        results = await run_workloads_on(db, [
+            {"testName": "Cycle", "nodeCount": 10,
+             "transactionsPerClient": 25},
+            {"testName": "ConfigureDatabase", "sim": sim, "rounds": 2,
+             "secondsBetweenChanges": 1.0},
+        ], client_count=2)
+        await sim.stop()
+        return results
+
+    results = run_simulation(main(), seed=12)
+    assert results["ConfigureDatabase"]["config_changes"] == 2
+    assert results["Cycle"]["transactions"] == 50
