@@ -74,6 +74,9 @@ class StorageServer:
         # while reads at or below it still serve from history
         # (REF:fdbserver/storageserver.actor.cpp changeServerKeys)
         self._dropped: list[tuple[Version, bytes, bytes]] = []
+        # dropped ranges whose rows still occupy the engine; GC'd by the
+        # durability loop once the drop version ages past the MVCC floor
+        self._gc_pending: list[tuple[Version, bytes, bytes]] = []
         from ..runtime.trace import CounterCollection
         self.counters = CounterCollection("StorageMetrics", str(tag))
         self._metrics_task = None
@@ -163,6 +166,27 @@ class StorageServer:
                 (v, op) for v, op in self._durability_buffer
                 if v <= recovery_version]
             self.version = recovery_version
+        if any(v > recovery_version for v, _b, _e in self._dropped):
+            # a PRIVATE_DROP_SHARD applied from a generation's unacked
+            # suffix rolls back with it: the move never committed, this
+            # team still owns the range.  The fence must lift AND the
+            # pending engine GC must be cancelled — clearing a range we
+            # still own would be physical data loss, not over-fencing.
+            self._dropped = [(v, b, e) for v, b, e in self._dropped
+                             if v <= recovery_version]
+            self._gc_pending = [(v, b, e) for v, b, e in self._gc_pending
+                                if v <= recovery_version]
+            ms = KeyRange(self.shard.begin, self.shard.end)
+            self._meta_shard = ms
+            surviving = sorted(self._dropped)
+            for v, b, e in surviving:       # re-narrow from surviving drops
+                if b <= ms.begin and e >= ms.end:
+                    ms = KeyRange(ms.begin, ms.begin)
+                elif b <= ms.begin < e < ms.end:
+                    ms = KeyRange(e, ms.end)
+                elif ms.begin < b < ms.end <= e:
+                    ms = KeyRange(ms.begin, b)
+            self._meta_shard = ms
         self.log_system.generations[:] = generations
         if running:
             self._pull_task = asyncio.get_running_loop().create_task(
@@ -316,6 +340,34 @@ class StorageServer:
             self.oldest_version = floor
             self.vmap.drop_before(floor)     # engine is authoritative <= floor
             self.log_system.pop(self.tag, floor + 1)
+            # GC relinquished ranges (live-move handoffs): once the drop
+            # version is STRICTLY below the now-advanced floor, no legal
+            # read can touch the range (reads at or below the drop
+            # version — the only ones the fence allows — are too old),
+            # and the narrowed meta shard excludes it after any reboot.
+            # A SEPARATE engine commit AFTER oldest_version advances: a
+            # clear riding the main batch would be observable by a
+            # still-legal history read during the engine's internal
+            # awaits, before the floor moved.
+            gc = [(v, b, e) for v, b, e in self._gc_pending if v < floor]
+            if gc:
+                try:
+                    await self.engine.commit(
+                        [(OP_CLEAR, b, e) for _v, b, e in gc], {
+                            "durable_version": floor,
+                            "tag": self.tag,
+                            "shard": (self._meta_shard.begin,
+                                      self._meta_shard.end),
+                        })
+                except Exception as e:   # noqa: BLE001 — retry next tick
+                    TraceEvent("StorageDurabilityError", severity=40).detail(
+                        "Tag", self.tag).error(e).log()
+                    continue
+                self._gc_pending = [(v, b, e) for v, b, e in self._gc_pending
+                                    if v >= floor]
+                for _v, b, e in gc:
+                    TraceEvent("StorageDroppedRangeGC").detail("Tag", self.tag) \
+                        .detail("Begin", b).detail("End", e).log()
 
     def _get_latest(self, key: bytes) -> bytes | None:
         found, v = self.vmap.get2(key, self.vmap.latest_version)
@@ -333,6 +385,7 @@ class StorageServer:
         from ..runtime.errors import WrongShardServer
         from ..runtime.trace import TraceEvent
         self._dropped.append((version, begin, end))
+        self._gc_pending.append((version, begin, end))
         ms = self._meta_shard
         if begin <= ms.begin and end >= ms.end:
             self._meta_shard = KeyRange(ms.begin, ms.begin)

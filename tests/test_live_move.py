@@ -312,3 +312,56 @@ def test_recovery_mid_move_rolls_back():
             f"{len(set(got) - set(rows))} phantom after rollback")
         await sim.stop()
     run_simulation(main())
+
+
+def test_source_engine_gc_after_live_split():
+    """After a live split's flip, the source replica's ENGINE must shed
+    the moved range's rows once the drop version ages past the MVCC
+    floor — dropped key space is fenced garbage, not disk freight.
+    Every durable engine's contents must end up inside its server's
+    narrowed meta shard."""
+    async def main():
+        k = Knobs().override(DD_ENABLED=True, DD_INTERVAL=1.0,
+                             DD_SHARD_SPLIT_BYTES=6_000,
+                             STORAGE_DURABILITY_LAG=0.2,
+                             STORAGE_VERSION_WINDOW=2000)
+        sim = SimulatedCluster(k, n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6),
+                               durable_storage=True)
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        n_shards_before = len(state1["shard_teams"])
+        db = await sim.database()
+
+        async def fill(tr, lo, hi):
+            for i in range(lo, hi):
+                tr.set(b"gc%05d" % i, b"v" * 60)
+        for lo in range(0, 200, 50):
+            await db.run(lambda tr, lo=lo: fill(tr, lo, lo + 50))
+        await sim.wait_state(
+            lambda s: len(s["shard_teams"]) > n_shards_before)
+
+        # keep versions flowing so the MVCC floor passes the drop version
+        for j in range(30):
+            await db.run(lambda tr, j=j: fill(tr, j, j + 1))
+            await asyncio.sleep(0.1)
+
+        checked = 0
+        for m in sim.machines:
+            if not m.alive or m.host is None:
+                continue
+            for _tok, (role, obj) in list(m.host.worker.roles.items()):
+                if role != "storage" or obj.engine is None:
+                    continue
+                ms = obj._meta_shard
+                outside = [key for key, _v
+                           in obj.engine.range(b"", b"\xff\xff")
+                           if not (ms.begin <= key < ms.end)]
+                checked += 1
+                assert not outside, (
+                    f"tag {obj.tag}: {len(outside)} engine rows outside "
+                    f"meta shard [{ms.begin!r}, {ms.end!r}), "
+                    f"e.g. {outside[:3]}")
+        assert checked >= 2, "expected multiple durable storage engines"
+        await sim.stop()
+    run_simulation(main())
