@@ -65,7 +65,7 @@ def test_c_abi_smoke_program(live_cluster, tmp_path):
         check=True, capture_output=True, text=True)
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     r = subprocess.run([exe, live_cluster], env=env, capture_output=True,
-                       text=True, timeout=120)
+                       text=True, timeout=300)
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "C ABI SMOKE OK" in r.stdout
 
