@@ -53,6 +53,7 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
         while time.perf_counter() < stop_at:
             ks = zipf.sample(reads + writes)
             t0 = time.perf_counter()
+            started_measuring = measuring
             try:
                 for i in range(reads):
                     await tr.get(key(ks[i]))
@@ -61,7 +62,10 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
                 await tr.commit()
                 if measuring:
                     committed += 1
-                    latencies.append(time.perf_counter() - t0)
+                    if started_measuring:
+                        # a txn started in warmup may carry a compile
+                        # stall; its latency is not a measured sample
+                        latencies.append(time.perf_counter() - t0)
             except FdbError as e:
                 if measuring:
                     conflicts += 1

@@ -76,6 +76,7 @@ async def run_tpcc_neworder(knobs: Knobs, n_warehouses: int = 2,
             n_lines = lr.random_int(5, 15)
             items = [lr.random_int(1, n_items) for _ in range(n_lines)]
             t0 = time.perf_counter()
+            started_measuring = measuring
             try:
                 await tr.get(_k("wh", w))
                 draw = await tr.get(_k("dist", w, d))
@@ -96,7 +97,11 @@ async def run_tpcc_neworder(knobs: Knobs, n_warehouses: int = 2,
                 await tr.commit()
                 if measuring:
                     done += 1
-                    latencies.append(time.perf_counter() - t0)
+                    if started_measuring:
+                        # warmup-started txns may carry compile stalls;
+                        # their latency is not a measured sample (same
+                        # policy as bench/e2e.py)
+                        latencies.append(time.perf_counter() - t0)
             except FdbError as e:
                 if measuring:
                     aborts += 1

@@ -64,6 +64,7 @@ async def run_ycsb_f(knobs: Knobs, n_rows: int = 100_000,
         while time.perf_counter() < stop_at:
             k = _ycsb_key(int(zipf.sample(1)[0]))
             t0 = time.perf_counter()
+            started_measuring = measuring
             try:
                 row = await tr.get(k)
                 mutated = (row or b"")[:-8] + b"%08d" % (cid % 10**8)
@@ -71,7 +72,11 @@ async def run_ycsb_f(knobs: Knobs, n_rows: int = 100_000,
                 await tr.commit()
                 if measuring:
                     ops += 1
-                    latencies.append(time.perf_counter() - t0)
+                    if started_measuring:
+                        # warmup-started txns may carry compile stalls;
+                        # their latency is not a measured sample (same
+                        # policy as bench/e2e.py)
+                        latencies.append(time.perf_counter() - t0)
             except FdbError as e:
                 if measuring:
                     aborts += 1
