@@ -74,7 +74,7 @@ class BackupAgent:
         self._log_files: list[tuple[Version, Version, str]] = []
         self._log_begin: Version | None = None
         self._pulled_through: Version = 0
-        self._ls = None                 # cached TLog view for pops
+        self._stream = None             # TagStream while pulling
 
     # --- continuous mutation log (REF: backup mutation tags) ---
 
@@ -111,14 +111,13 @@ class BackupAgent:
             except asyncio.CancelledError:
                 pass
             self._pull_task = None
-        if self._ls is not None:
-            # release only what was drained — NOT MAX_VERSION, which would
-            # permanently un-pin the tag for this generation and let a
-            # later re-activation's unpulled frames be discarded before
-            # the agent reads them.  The tag stops constraining the disk
-            # queue once popped past its last pushed version (TLog.pop's
-            # tag-tip retirement), so this does not pin the queue either.
-            self._ls.pop(BACKUP_TAG, self._pulled_through + 1)
+        if self._stream is not None:
+            # release the drained span AND the disarm version — popping
+            # past the tag's last pushed version retires it (TLog.pop's
+            # tag-tip retirement) so nothing pins the disk queue, while
+            # NOT un-pinning to MAX_VERSION, which would let a later
+            # re-activation's unpulled frames be discarded unread.
+            self._stream.pop(max(self._pulled_through, ve))
         # persist the drained frontier: restore's coverage check reads it
         await self._save_log_manifest()
         TraceEvent("BackupContinuousStopped").detail("Version", ve) \
@@ -129,58 +128,21 @@ class BackupAgent:
             await asyncio.sleep(0.1)
 
     async def _commit_tag(self, value: bytes | None) -> Version:
-        tr = self.db.create_transaction()
-        while True:
-            try:
-                if value is None:
-                    tr.clear(BACKUP_PREFIX + b"tag")
-                else:
-                    tr.set(BACKUP_PREFIX + b"tag", value)
-                return await tr.commit()
-            except Exception as e:  # noqa: BLE001 — retry via on_error
-                await tr.on_error(e)
-
-    async def _log_view(self):
-        """A TLog view built from the freshest published cluster state —
-        rebuilt whenever a recovery invalidates the old generation."""
-        from ..core.cluster_client import fetch_cluster_state
-        from ..core.log_system import LogSystem
-        from ..core.worker import generations_from_config
-        state = await fetch_cluster_state(self.db.coordinators)
-        gens = generations_from_config(state["log_cfg"],
-                                       self.db.view.transport, 0)
-        self._ls = LogSystem(gens)
-        return self._ls
+        from .stream import commit_tag
+        return await commit_tag(self.db, "", value)   # "" = legacy slot
 
     async def _pull_loop(self, begin: Version) -> None:
+        """Pull the tag through an ack-safe TagStream (never writes a
+        version a recovery could roll back) and persist it to .mlog
+        files; the stream frontier advances only past durable files
+        (rewind on a write failure)."""
+        from .stream import TagStream
         idx = 0
-        cursor = None
+        self._stream = TagStream(self.db, BACKUP_TAG, begin)
         while True:
-            try:
-                if cursor is None:
-                    cursor = (await self._log_view()).cursor(
-                        BACKUP_TAG, self._pulled_through + 1)
-                reply = await cursor.next()
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:  # noqa: BLE001 — recovery/partition: re-view
-                TraceEvent("BackupPullError", severity=20).detail("Error", repr(e)[:200]).detail("Through", self._pulled_through).log()
-                cursor = None
-                await asyncio.sleep(0.25)
-                continue
-            if not reply.entries \
-                    and reply.end_version - 1 <= self._pulled_through:
-                # no progress: either idle, or a recovery locked this
-                # generation and our view predates it (a locked log
-                # answers peeks immediately with an unmoving tip).
-                # Re-fetch the published state so the cursor rolls into
-                # the new generation when there is one.
-                await asyncio.sleep(0.25)
-                cursor = None
-                continue
-            if reply.entries:
-                first = reply.entries[0][0]
-                last = reply.entries[-1][0]
+            entries, end = await self._stream.next()
+            if entries:
+                first, last = entries[0][0], entries[-1][0]
                 # the activation version in the name keeps re-activated
                 # backups from truncating a previous run's files out from
                 # under their manifest entries
@@ -190,7 +152,7 @@ class BackupAgent:
                     f = self.fs.open(name)
                     await f.truncate(0)
                     await f.write(0, encode([[v, list(muts)]
-                                             for v, muts in reply.entries]))
+                                             for v, muts in entries]))
                     await f.sync()
                     self._log_files.append((first, last, name))
                     await self._save_log_manifest()
@@ -200,17 +162,17 @@ class BackupAgent:
                     TraceEvent("BackupWriteError", severity=30) \
                         .detail("Error", repr(e)[:200]).detail("File", name) \
                         .log()
-                    # roll back bookkeeping; the frontier has not advanced,
-                    # so the next pull regenerates this span (replay dedupes
-                    # by version if the half-written file survived)
+                    # roll back bookkeeping and the stream: the next pull
+                    # regenerates this span (replay dedupes by version if
+                    # the half-written file survived)
                     if self._log_files and self._log_files[-1][2] == name:
                         self._log_files.pop()
+                    self._stream.rewind(self._pulled_through)
                     await asyncio.sleep(0.25)
                     continue
             # durable (or empty): the TLogs may discard what we hold
-            self._pulled_through = max(self._pulled_through,
-                                       reply.end_version - 1)
-            self._ls.pop(BACKUP_TAG, reply.end_version)
+            self._pulled_through = max(self._pulled_through, end - 1)
+            self._stream.pop(self._pulled_through)
 
     async def _save_log_manifest(self) -> None:
         mf = self.fs.open(f"{self.dir}/logs.manifest")
@@ -231,25 +193,13 @@ class BackupAgent:
         transaction and pinned with set_read_version on the rest), so the
         backup is a strict cut — a transaction is either entirely in the
         backup or entirely absent."""
+        from .stream import paged_snapshot
         version: int | None = None
         range_files: list[str] = []
         rows = nbytes = 0
-        cursor = begin
         file_idx = 0
-        while True:
-            tr = self.db.create_transaction()
-            while True:
-                try:
-                    if version is not None:
-                        tr.set_read_version(version)
-                    page = await tr.get_range(cursor, end,
-                                              limit=self.rows_per_file,
-                                              snapshot=True)
-                    if version is None:
-                        version = await tr.get_read_version()
-                    break
-                except FdbError as e:
-                    await tr.on_error(e)
+        async for page, version in paged_snapshot(self.db, begin, end,
+                                                  self.rows_per_file):
             if not page:
                 break
             name = f"{self.dir}/range-{file_idx:06d}.kv"
@@ -261,9 +211,6 @@ class BackupAgent:
             range_files.append(name)
             rows += len(page)
             nbytes += sum(len(k) + len(v) for k, v in page)
-            if len(page) < self.rows_per_file:
-                break
-            cursor = bytes(page[-1][0]) + b"\x00"
         manifest = BackupManifest(version=version or 0,
                                   range_files=range_files, rows=rows,
                                   bytes=nbytes)

@@ -27,6 +27,10 @@ class Transaction:
     def __init__(self, cluster: Cluster) -> None:
         self._cluster = cluster
         self._knobs = cluster.knobs
+        # LOCK_AWARE survives reset/on_error like an upstream persistent
+        # transaction option (REF:fdbclient/NativeAPI.actor.cpp
+        # TransactionOptions held across resets by the retry loop)
+        self.lock_aware = False
         self.reset()
 
     # --- lifecycle ---
@@ -58,7 +62,8 @@ class Transaction:
     async def get_read_version(self) -> Version:
         if self._read_version is None:
             proxy = deterministic_random().choice(self._cluster.grv_proxies)
-            self._read_version = await proxy.get_read_version()
+            self._read_version = await proxy.get_read_version(
+                self.lock_aware)
         return self._read_version
 
     def set_read_version(self, version: Version) -> None:
@@ -319,6 +324,7 @@ class Transaction:
             write_conflict_ranges=_coalesce(self._write_conflicts),
             mutations=list(self._writes.mutations),
             read_snapshot=read_snapshot,
+            lock_aware=self.lock_aware,
         )
         self._committing = True
         try:

@@ -218,8 +218,9 @@ class ClusterController:
         # read): \xff/conf/ overrides the recruitment spec and
         # \xff/keyServers/layout carries DataDistribution's desired shard
         # layout, both written by ordinary transactions ----
-        spec, layout, excluded, backup_tag = await self._read_system_state(
-            prev_state, spec)
+        spec, layout, excluded, backup_tags, locked = \
+            await self._read_system_state(prev_state, spec,
+                                          recovery_version)
 
         # ---- recruit the new transaction subsystem ----
         self.recovery_state = "RECRUITING"
@@ -237,7 +238,7 @@ class ClusterController:
 
         rv = recovery_version
         seq_addr, seq_tok = await self._recruit(
-            pick(0), "sequencer", {"v0": rv})
+            pick(0), "sequencer", {"v0": rv, "db_lock": locked})
 
         from ..runtime.rng import deterministic_random
         rng = deterministic_random()
@@ -421,7 +422,7 @@ class ClusterController:
             "log_cfg": wire_log_cfg,
             "shard_boundaries": boundaries, "shard_teams": teams,
             "ratekeeper": rk_addr, "ratekeeper_token": rk_tok,
-            "backup_tag": backup_tag,
+            "backup_tags": backup_tags, "locked": locked,
         }
         commit_info, grv_info = [], []
         for i in range(spec.commit_proxies):
@@ -501,7 +502,8 @@ class ClusterController:
             .detail("Seq", new["seq"]).log()
         return new
 
-    async def _read_system_state(self, prev_state: dict | None, spec):
+    async def _read_system_state(self, prev_state: dict | None, spec,
+                                 recovery_version: Version | None = None):
         """Read the ``\\xff`` metadata range from a surviving storage
         replica: conf keys merge into the recruitment spec
         (REF:fdbclient/SystemData.cpp / DatabaseConfiguration::
@@ -512,10 +514,11 @@ class ClusterController:
         from ..rpc.stubs import StorageClient
         from ..rpc.wire import decode
         from .data import KeyRange, SYSTEM_PREFIX
-        from .system_data import (BACKUP_PREFIX, KEY_SERVERS_PREFIX,
-                                  decode_conf, spec_with_conf)
+        from .system_data import (KEY_SERVERS_PREFIX, LOCKED_KEY,
+                                  decode_backup_tags, decode_conf,
+                                  spec_with_conf)
         if not prev_state:
-            return spec, None, set(), None
+            return spec, None, set(), {}, None
         sys_end = SYSTEM_PREFIX + b"\xfe"
         for s in prev_state.get("storage", []):
             if not (s["begin"] <= SYSTEM_PREFIX < s["end"]):
@@ -527,8 +530,14 @@ class ClusterController:
                                  s["token"], s["tag"],
                                  KeyRange(s["begin"], s["end"]))
             try:
+                # the replica must have pulled through the recovery
+                # version: a lock/backup-tag/configure txn committed just
+                # before the crash is on the locked TLogs but may not be
+                # applied here yet — a lagging snapshot would silently
+                # recover without it
                 rows, _ = await asyncio.wait_for(
-                    stub.get_latest_range(SYSTEM_PREFIX, sys_end),
+                    stub.get_latest_range(SYSTEM_PREFIX, sys_end, 1000,
+                                          recovery_version),
                     timeout=self.knobs.FAILURE_TIMEOUT * 2)
             except (FdbError, asyncio.TimeoutError):
                 continue
@@ -537,26 +546,26 @@ class ClusterController:
             from .management import decode_excluded
             excluded = decode_excluded(rows)
             layout = None
-            backup_tag = None
+            locked = None
+            backup_tags = decode_backup_tags(rows)
             for key, v in rows:
                 if key == KEY_SERVERS_PREFIX + b"layout":
                     try:
                         layout = decode(v)
                     except Exception:  # noqa: BLE001 — bad layout ignored
                         layout = None
-                elif key == BACKUP_PREFIX + b"tag":
-                    try:
-                        backup_tag = int(decode(v))
-                    except Exception:  # noqa: BLE001 — bad tag ignored
-                        backup_tag = None
-            if conf or layout or excluded or backup_tag is not None:
+                elif key == LOCKED_KEY:
+                    locked = bytes(v)
+            if conf or layout or excluded or backup_tags or locked:
                 TraceEvent("RecoveryReadSystemState") \
                     .detail("Conf", str(conf)) \
                     .detail("Excluded", sorted(excluded)) \
-                    .detail("BackupTag", backup_tag) \
+                    .detail("BackupTags", str(backup_tags)) \
+                    .detail("Locked", locked is not None) \
                     .detail("HasLayout", layout is not None).log()
-            return spec_with_conf(spec, conf), layout, excluded, backup_tag
-        return spec, None, set(), None
+            return (spec_with_conf(spec, conf), layout, excluded,
+                    backup_tags, locked)
+        return spec, None, set(), {}, None
 
     @staticmethod
     def _wire_gen(g: dict) -> dict:

@@ -65,7 +65,8 @@ def check_state_txn_reads(req: CommitTransactionRequest) -> None:
 class CommitProxy:
     def __init__(self, knobs: Knobs, sequencer: Sequencer,
                  resolvers: list[Resolver], log_system,
-                 shard_map: ShardMap, backup_tag: int | None = None) -> None:
+                 shard_map: ShardMap, backup_tags: dict[str, int] | None = None,
+                 locked: bytes | None = None) -> None:
         self.knobs = knobs
         self.sequencer = sequencer
         self.resolvers = resolvers
@@ -74,9 +75,14 @@ class CommitProxy:
         # BackupWorker/backup tags): while a backup tag is active, every
         # committed mutation is ALSO pushed under it, so backup agents can
         # pull the full ordered mutation stream.  Versioned like the shard
-        # maps — the \xff/backup/tag state transaction flips it at an
-        # exact commit version on every proxy.
-        self._backup_tags: list[tuple[Version, int | None]] = [(-1, backup_tag)]
+        # maps — \xff/backup/tag[s/<name>] state transactions flip the
+        # armed set at an exact commit version on every proxy.  Several
+        # named tags (file backup + DR) stream concurrently.
+        self._backup_tags: list[tuple[Version, dict[str, int]]] = \
+            [(-1, dict(backup_tags or {}))]
+        # database lock (REF: lockedKey in ProxyCommitData): while set,
+        # only lock-aware transactions may commit.  Versioned the same way.
+        self._locks: list[tuple[Version, bytes | None]] = [(-1, locked)]
         # versioned shard-map history: the map at index i is effective for
         # commit versions >= its change version.  Layout changes arrive as
         # state-transaction entries (the txnStateStore of this proxy) and
@@ -97,8 +103,9 @@ class CommitProxy:
         self.total_batches = 0
         self.total_committed = 0
         self.total_conflicts = 0
-        from ..runtime.trace import CounterCollection
+        from ..runtime.trace import CounterCollection, Histogram
         self.counters = CounterCollection("ProxyCommit")
+        self.latency_hist = Histogram("ProxyCommit", "BatchLatency")
         self._metrics_task = None
 
     @property
@@ -111,10 +118,16 @@ class CommitProxy:
                 return m
         return self._maps[0][1]
 
-    def backup_tag_at(self, version: Version) -> int | None:
-        for v, tag in reversed(self._backup_tags):
+    def backup_tags_at(self, version: Version) -> tuple[int, ...]:
+        for v, tags in reversed(self._backup_tags):
             if v <= version:
-                return tag
+                return tuple(sorted(set(tags.values())))
+        return ()
+
+    def locked_at(self, version: Version) -> bytes | None:
+        for v, uid in reversed(self._locks):
+            if v <= version:
+                return uid
         return None
 
     # --- metadata mutations (REF:fdbserver/ApplyMetadataMutation.cpp) ---
@@ -146,24 +159,50 @@ class CommitProxy:
                         ) -> list[tuple[int, bytes, bytes]]:
         from ..rpc.wire import decode
         from ..runtime.trace import TraceEvent
-        from .system_data import BACKUP_PREFIX, LAYOUT_KEY
+        from .system_data import (BACKUP_PREFIX, BACKUP_TAGS_PREFIX,
+                                  LAYOUT_KEY, LOCKED_KEY, backup_tag_key)
         backup_key = BACKUP_PREFIX + b"tag"
         drops: list[tuple[int, bytes, bytes]] = []
         for m in muts:
-            if m.type == MutationType.SET_VALUE and m.param1 == backup_key:
+            # -- mutation-log tag arm/disarm (named slots) --
+            name = None
+            if m.param1 == backup_key:
+                name = ""
+            elif m.param1.startswith(BACKUP_TAGS_PREFIX):
+                name = m.param1[len(BACKUP_TAGS_PREFIX):].decode(
+                    errors="replace")
+            if m.type == MutationType.SET_VALUE and name is not None:
                 try:
                     tag = int(decode(m.param2))
                 except Exception:  # noqa: BLE001 — bad blob: disable
                     tag = None
-                self._backup_tags.append((version, tag))
+                cur = dict(self._backup_tags[-1][1])
+                if tag is None:
+                    cur.pop(name, None)
+                else:
+                    cur[name] = tag
+                self._backup_tags.append((version, cur))
                 TraceEvent("ProxyBackupTag").detail("Version", version) \
-                    .detail("Tag", tag).log()
+                    .detail("Name", name).detail("Tag", tag).log()
                 continue
-            if m.type == MutationType.CLEAR_RANGE \
-                    and m.param1 <= backup_key < m.param2:
-                self._backup_tags.append((version, None))
-                TraceEvent("ProxyBackupTag").detail("Version", version) \
-                    .detail("Tag", None).log()
+            if m.type == MutationType.CLEAR_RANGE:
+                cur = {n: t for n, t in self._backup_tags[-1][1].items()
+                       if not (m.param1 <= backup_tag_key(n) < m.param2)}
+                if cur != self._backup_tags[-1][1]:
+                    self._backup_tags.append((version, cur))
+                    TraceEvent("ProxyBackupTag").detail("Version", version) \
+                        .detail("Armed", sorted(cur)).log()
+                if m.param1 <= LOCKED_KEY < m.param2:
+                    self._locks.append((version, None))
+                    self.sequencer.report_lock(version, None)
+                    TraceEvent("ProxyDbLock").detail("Version", version) \
+                        .detail("Locked", False).log()
+            # -- database lock/unlock --
+            if m.type == MutationType.SET_VALUE and m.param1 == LOCKED_KEY:
+                self._locks.append((version, bytes(m.param2)))
+                self.sequencer.report_lock(version, bytes(m.param2))
+                TraceEvent("ProxyDbLock").detail("Version", version) \
+                    .detail("Locked", True).log()
                 continue
             if m.type != MutationType.SET_VALUE or m.param1 != LAYOUT_KEY:
                 continue
@@ -193,6 +232,7 @@ class CommitProxy:
         while True:
             await asyncio.sleep(self.knobs.METRICS_INTERVAL)
             self.counters.log_metrics()
+            self.latency_hist.log_metrics()
 
     async def stop(self) -> None:
         tasks = list(self._inflight)
@@ -320,16 +360,44 @@ class CommitProxy:
             try:
                 if is_state_txn(req):
                     check_state_txn_reads(req)
+                    # the database lock gates state transactions BEFORE
+                    # resolution: once resolved, a state txn's metadata
+                    # mutations ride every resolver's committed-state
+                    # stream to every proxy unconditionally, so rejecting
+                    # it afterwards would leave proxies' metadata applied
+                    # for a commit the client was told failed (REF: the
+                    # lockedKey check gating applyMetadataMutations).
+                    # The local lock view can be STALE-LOCKED on an idle
+                    # cluster (an unlock committed through another proxy
+                    # only reaches us via state entries): resolve an
+                    # empty batch first — it applies every pending state
+                    # entry — and only reject if still locked.  The
+                    # refresh is rate-limited so a tight client retry
+                    # loop against a genuinely locked database costs one
+                    # version-chain round per second, not one per retry.
+                    if self._locks[-1][1] is not None \
+                            and not getattr(req, "lock_aware", False):
+                        now = asyncio.get_running_loop().time()
+                        if now - getattr(self, "_lock_refreshed", -1e9) > 1.0:
+                            self._lock_refreshed = now
+                            await self._empty_batch()
+                        if self._locks[-1][1] is not None:
+                            from ..runtime.errors import DatabaseLocked
+                            raise DatabaseLocked()
                 for m in req.mutations:
                     self._substitute_versionstamp(m, 0, 0)
                 valid.append((req, fut))
-            except Exception:
+            except Exception as pre_err:
                 if not fut.done():
-                    fut.set_exception(ClientInvalidOperation())
+                    from ..runtime.errors import DatabaseLocked
+                    fut.set_exception(
+                        pre_err if isinstance(pre_err, DatabaseLocked)
+                        else ClientInvalidOperation())
         if not valid:
             return
         reqs = [r for r, _ in valid]
         futs = [f for _, f in valid]
+        batch_began = asyncio.get_running_loop().time()
         prev_version = version = None
         resolved = pushed = push_started = False
         repair_tagged: dict[int, list[Mutation]] | None = None
@@ -370,7 +438,19 @@ class CommitProxy:
             my_drops = self._apply_state_entries(
                 replies[0].state_entries, own_version=version)
             shard_map = self.map_at(version)
-            backup_tag = self.backup_tag_at(version)
+            backup_tags = self.backup_tags_at(version)
+            # database lock, authoritative as of THIS version (the state
+            # entries above include any lock/unlock committed before us in
+            # version order).  Applies to USER transactions only: their
+            # exclusion from tagging is side-effect-free (the resolver
+            # write-history entry causes at most spurious conflicts, never
+            # a durable mutation).  A state txn that slipped the
+            # pre-resolution check in the lock's propagation window
+            # commits normally — its metadata is already in every
+            # resolver's stream, and acking it keeps client and cluster
+            # state consistent (the lock fences state txns steady-state,
+            # like the reference).
+            lock_uid = None if is_state else self.locked_at(version)
 
             # tag mutations of committed txns, in batch order; the log
             # system replicates each tag onto its hosting logs.  With a
@@ -379,8 +459,13 @@ class CommitProxy:
             tagged: dict[int, list[Mutation]] = {}
             order = 0
             orders: list[int] = [0] * len(reqs)
+            locked_out: set[int] = set()
             for i, (req, verdict) in enumerate(zip(reqs, final)):
                 if verdict != COMMITTED:
+                    continue
+                if lock_uid is not None and not getattr(req, "lock_aware",
+                                                        False):
+                    locked_out.add(i)
                     continue
                 orders[i] = order
                 for m in req.mutations:
@@ -391,8 +476,8 @@ class CommitProxy:
                         tags = shard_map.tags_for_key(m.param1)
                     for t in tags:
                         tagged.setdefault(t, []).append(m)
-                    if backup_tag is not None:
-                        tagged.setdefault(backup_tag, []).append(m)
+                    for bt in backup_tags:
+                        tagged.setdefault(bt, []).append(m)
                 order += 1
             # ownership handoff markers for a layout change this batch
             # committed: each losing tag sees the drop at exactly this
@@ -409,10 +494,15 @@ class CommitProxy:
 
             self.total_batches += 1
             self.counters.counter("CommitBatchIn").add(1)
+            self.latency_hist.sample_seconds(
+                asyncio.get_running_loop().time() - batch_began)
             for i, fut in enumerate(futs):
                 if fut.done():
                     continue
-                if final[i] == COMMITTED:
+                if i in locked_out:
+                    from ..runtime.errors import DatabaseLocked
+                    fut.set_exception(DatabaseLocked())
+                elif final[i] == COMMITTED:
                     self.total_committed += 1
                     self.counters.counter("TxnCommitOut").add(1)
                     fut.set_result(CommitResult(

@@ -255,6 +255,9 @@ class CommitTransactionRequest:
     mutations: list[Mutation]
     read_snapshot: Version
     report_conflicting_keys: bool = False
+    # FDB's LOCK_AWARE transaction option: permitted to commit while the
+    # database is locked (REF:fdbclient/NativeAPI.actor.cpp lockedKey check)
+    lock_aware: bool = False
 
     def expected_size(self) -> int:
         n = 0

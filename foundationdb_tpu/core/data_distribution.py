@@ -404,6 +404,9 @@ class DataDistributor:
     async def _commit_layout(self, layout: dict) -> Version:
         from ..rpc.wire import encode
         tr = self.db.create_transaction()
+        # layout maintenance continues under a database lock (the
+        # reference's MoveKeys transactions are lock-aware)
+        tr.lock_aware = True
         while True:
             try:
                 tr.set(LAYOUT_KEY, encode(layout))

@@ -21,14 +21,14 @@ class GrvProxy:
         self.knobs = knobs
         self.sequencer = sequencer
         self.ratekeeper = ratekeeper
-        self._waiters: list[asyncio.Future] = []
+        self._waiters: list[tuple[asyncio.Future, bool]] = []
         self._batch_task: asyncio.Task | None = None
         self.total_grvs = 0
 
-    async def get_read_version(self) -> Version:
+    async def get_read_version(self, lock_aware: bool = False) -> Version:
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._waiters.append(fut)
+        self._waiters.append((fut, lock_aware))
         if self._batch_task is None or self._batch_task.done():
             self._batch_task = loop.create_task(self._serve_batch(),
                                                 name="grv-batch")
@@ -50,12 +50,22 @@ class GrvProxy:
             if self.ratekeeper is not None:
                 await self.ratekeeper.admit(len(waiters))
             try:
-                version = await self.sequencer.get_live_committed_version()
+                version, lock_uid = \
+                    await self.sequencer.get_live_committed_version()
                 self.total_grvs += len(waiters)
-                for fut in waiters:
-                    if not fut.done():
+                for fut, lock_aware in waiters:
+                    if fut.done():
+                        continue
+                    if lock_uid is not None and not lock_aware:
+                        # the read side of the database lock (REF:
+                        # GetReadVersionReply.locked → NativeAPI throws):
+                        # an application still pointed at a switched-over
+                        # primary must hear about it, not read stale data
+                        from ..runtime.errors import DatabaseLocked
+                        fut.set_exception(DatabaseLocked())
+                    else:
                         fut.set_result(version)
             except Exception as e:
-                for fut in waiters:
+                for fut, _ in waiters:
                     if not fut.done():
                         fut.set_exception(e)

@@ -56,3 +56,38 @@ async def configure(db, **fields: int) -> None:
                 raise ValueError(f"unknown configure field {name!r}")
             tr.set(conf_key(name), str(int(val)).encode())
     await db.run(do)
+
+
+# --- database lock (REF:fdbclient/ManagementAPI.actor.cpp lockDatabase) ---
+
+class DatabaseLockedByOther(ValueError):
+    """Lock refused: already locked under a different UID."""
+
+
+async def lock_database(db, uid: bytes) -> None:
+    """Lock the database: commit proxies reject every non-lock-aware
+    transaction until unlock.  Idempotent under the same UID; refuses if
+    locked under a different one."""
+    from .system_data import LOCKED_KEY
+
+    async def do(tr):
+        tr.lock_aware = True
+        cur = await tr.get(LOCKED_KEY)
+        if cur is not None and bytes(cur) != uid:
+            raise DatabaseLockedByOther(cur)
+        tr.set(LOCKED_KEY, uid)
+    await db.run(do)
+
+
+async def unlock_database(db, uid: bytes) -> None:
+    """Release the lock.  Refuses under a mismatched UID (someone else's
+    lock must not be stomped by a stale script)."""
+    from .system_data import LOCKED_KEY
+
+    async def do(tr):
+        tr.lock_aware = True
+        cur = await tr.get(LOCKED_KEY)
+        if cur is not None and bytes(cur) != uid:
+            raise DatabaseLockedByOther(cur)
+        tr.clear(LOCKED_KEY)
+    await db.run(do)

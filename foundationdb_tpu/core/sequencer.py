@@ -21,7 +21,8 @@ SequencerDeposed = _err(1191, "sequencer_deposed",
 
 
 class Sequencer:
-    def __init__(self, knobs: Knobs, epoch_begin_version: Version = 0) -> None:
+    def __init__(self, knobs: Knobs, epoch_begin_version: Version = 0,
+                 db_lock_uid: bytes | None = None) -> None:
         self.knobs = knobs
         self._last_assigned: Version = epoch_begin_version
         self._committed: Version = epoch_begin_version
@@ -29,6 +30,13 @@ class Sequencer:
         self._base_time: float | None = None
         self._committed_waiters: list[tuple[Version, asyncio.Future]] = []
         self.locked = False
+        # database-lock register: the sequencer is the hub BOTH proxy
+        # kinds already round-trip, so commit proxies report lock-state
+        # flips here and GRV proxies learn them with every batch — read
+        # fencing without a new gossip path (the reference piggybacks
+        # `locked` on GetReadVersionReply the same way).  Seeded from the
+        # recovery's \xff read; versioned so stale reports can't regress.
+        self._db_lock: tuple[Version, bytes | None] = (-1, db_lock_uid)
 
     # --- epoch fencing ---
 
@@ -78,12 +86,19 @@ class Sequencer:
                     still.append((target, fut))
             self._committed_waiters = still
 
-    async def get_live_committed_version(self) -> Version:
-        """The version a GRV proxy may serve as a read version
-        (getLiveCommittedVersion in the reference).  Raises once the
+    def report_lock(self, version: Version, uid: bytes | None) -> None:
+        """A commit proxy applied a \\xff/dbLocked flip at ``version``."""
+        if version > self._db_lock[0]:
+            self._db_lock = (version, uid)
+
+    async def get_live_committed_version(self) -> tuple[Version,
+                                                        bytes | None]:
+        """(version, db_lock_uid) a GRV proxy may serve as a read version
+        (getLiveCommittedVersion in the reference; the lock rides the
+        reply like GetReadVersionReply.locked).  Raises once the
         sequencer is deposed (locked by a newer epoch's recovery)."""
         self._check_locked()
-        return self._committed
+        return self._committed, self._db_lock[1]
 
     async def wait_committed(self, version: Version) -> Version:
         if self._committed >= version:

@@ -505,13 +505,27 @@ class StorageServer:
         return self.engine.get(key) if self.engine is not None else None
 
     async def get_latest_range(self, begin: bytes, end: bytes,
-                               limit: int = 1000
+                               limit: int = 1000,
+                               min_version: Version | None = None
                                ) -> tuple[list[tuple[bytes, bytes]], Version]:
         """Latest-applied-version scan — the recovery-time metadata read
         (txnStateStore materialization, REF:fdbserver/ApplyMetadataMutation
         .cpp): the controller reads ``\\xff`` configuration back through
         this without holding a read version, because it runs BEFORE the
-        new epoch can hand any out."""
+        new epoch can hand any out.
+
+        ``min_version``: wait until this replica has pulled through it
+        first.  Recovery passes its recovery version — a metadata txn
+        (lock, backup tag, configure) committed just before the crash is
+        on the locked TLogs but maybe not yet applied here; reading a
+        lagging snapshot would silently recover WITHOUT it (an unfenced
+        primary after DR switchover, a disarmed backup stream)."""
+        if min_version is not None:
+            # plain poll (no future_version timeout): the caller bounds
+            # the wait, and the locked generation keeps serving peeks so
+            # the pull loop CAN catch up during recovery
+            while self.version < min_version:
+                await asyncio.sleep(0.05)
         b = max(begin, self.shard.begin)
         e = min(end, self.shard.end)
         if b >= e:

@@ -21,6 +21,38 @@ CONF_PREFIX = b"\xff/conf/"
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 LAYOUT_KEY = KEY_SERVERS_PREFIX + b"layout"
 BACKUP_PREFIX = b"\xff/backup/"
+# named mutation-log tags (\xff/backup/tags/<name> -> encode(tag)), so a
+# file backup and a DR feed can stream concurrently; the bare
+# \xff/backup/tag key is the unnamed legacy slot (name "")
+BACKUP_TAGS_PREFIX = BACKUP_PREFIX + b"tags/"
+# database lock (REF:fdbclient/SystemData.cpp databaseLockedKey): value is
+# the locking UID; commit proxies reject non-lock-aware transactions
+LOCKED_KEY = b"\xff/dbLocked"
+
+
+def backup_tag_key(name: str) -> bytes:
+    """The \\xff key arming mutation-log tag ``name`` ("" = legacy slot)."""
+    return (BACKUP_PREFIX + b"tag") if name == "" \
+        else BACKUP_TAGS_PREFIX + name.encode()
+
+
+def decode_backup_tags(rows: list[tuple[bytes, bytes]]) -> dict[str, int]:
+    """All armed mutation-log tags from a \\xff range read."""
+    from ..rpc.wire import decode
+    out: dict[str, int] = {}
+    for k, v in rows:
+        name = None
+        if k == BACKUP_PREFIX + b"tag":
+            name = ""
+        elif k.startswith(BACKUP_TAGS_PREFIX):
+            name = k[len(BACKUP_TAGS_PREFIX):].decode(errors="replace")
+        if name is None:
+            continue
+        try:
+            out[name] = int(decode(v))
+        except Exception:  # noqa: BLE001 — a bad blob disarms that slot
+            continue
+    return out
 
 # conf keys the controller honors, mapping to ClusterConfigSpec fields
 CONF_FIELDS = ("commit_proxies", "grv_proxies", "resolvers", "logs",

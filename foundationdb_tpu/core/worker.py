@@ -323,7 +323,8 @@ class Worker:
             return NetworkAddress(a[0], a[1])
 
         if role == "sequencer":
-            return Sequencer(k, p.get("v0", 0))
+            return Sequencer(k, p.get("v0", 0),
+                             db_lock_uid=p.get("db_lock"))
         if role == "tlog":
             return TLog(k, p.get("v0", 0))
         if role == "resolver":
@@ -362,7 +363,8 @@ class Worker:
             ls = LogSystem(generations_from_config(p["log_cfg"], t, self.base))
             shard_map = ShardMap(p["shard_boundaries"], p["shard_teams"])
             return CommitProxy(k, seq, resolvers, ls, shard_map,
-                               backup_tag=p.get("backup_tag"))
+                               backup_tags=p.get("backup_tags"),
+                               locked=p.get("locked"))
         if role == "grv_proxy":
             t = self.make_client_transport()
             seq = SequencerClient(t, addr(p["sequencer"]),

@@ -21,7 +21,8 @@ from .transport import Endpoint, NetworkAddress, Transport
 ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
     "sequencer": [("get_commit_version", False),
                   ("get_live_committed_version", False),
-                  ("report_committed", True), ("lock", False)],
+                  ("report_committed", True), ("lock", False),
+                  ("report_lock", True)],
     "resolver": [("resolve", False)],
     "tlog": [("push", False), ("peek", False), ("pop", True),
              ("lock", False), ("metrics", False)],
@@ -38,6 +39,7 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
                ("rejoin_storage", False), ("list_roles", False)],
     "cluster_controller": [("register_worker", False),
                            ("get_cluster_state", False)],
+    "log_router": [("peek", False), ("pop", True), ("metrics", False)],
 }
 
 TOKEN_BLOCK = 16  # tokens reserved per role instance
@@ -130,6 +132,10 @@ class GrvProxyClient(RoleClient):
 
 class CoordinatorClient(RoleClient):
     role = "coordinator"
+
+
+class LogRouterClient(RoleClient):
+    role = "log_router"
 
 
 class WorkerClient(RoleClient):

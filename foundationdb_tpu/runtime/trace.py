@@ -168,6 +168,66 @@ class Counter:
         return self
 
 
+class Histogram:
+    """32-bucket power-of-two histogram (REF:flow/Histogram.h): bucket i
+    counts samples in [2^i, 2^(i+1)) — microseconds for latency use.
+    Emitted as one trace event per interval, like the reference's
+    Histogram::writeToLog."""
+
+    def __init__(self, group: str, op: str, unit: str = "microseconds"):
+        self.group = group
+        self.op = op
+        self.unit = unit
+        self.buckets = [0] * 32
+        self.count = 0
+        self.total = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+
+    def sample(self, x: float) -> None:
+        i = max(0, min(31, int(x).bit_length() - 1)) if x >= 1 else 0
+        self.buckets[i] += 1
+        self.count += 1
+        self.total += x
+        self.min = x if self.min is None else min(self.min, x)
+        self.max = x if self.max is None else max(self.max, x)
+
+    def sample_seconds(self, seconds: float) -> None:
+        self.sample(seconds * 1e6)
+
+    def percentile(self, p: float) -> float:
+        """Upper bound of the bucket where the cumulative count crosses
+        p (0..1); 0 when empty."""
+        if self.count == 0:
+            return 0.0
+        target = p * self.count
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            if seen >= target:
+                return float(1 << (i + 1))
+        return float(1 << 32)
+
+    def clear(self) -> None:
+        self.buckets = [0] * 32
+        self.count = 0
+        self.total = 0.0
+        self.min = self.max = None
+
+    def log_metrics(self, log: Optional[TraceLog] = None) -> None:
+        if self.count == 0:
+            return
+        TraceEvent(f"Histogram{self.group}{self.op}", log=log or _GLOBAL) \
+            .detail("Unit", self.unit).detail("Count", self.count) \
+            .detail("Min", round(self.min or 0, 1)) \
+            .detail("Max", round(self.max or 0, 1)) \
+            .detail("Mean", round(self.total / self.count, 1)) \
+            .detail("P50", self.percentile(0.5)) \
+            .detail("P95", self.percentile(0.95)) \
+            .detail("P99", self.percentile(0.99)).log()
+        self.clear()
+
+
 class CounterCollection:
     def __init__(self, name: str, id_: str = ""):
         self.name = name

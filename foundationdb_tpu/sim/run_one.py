@@ -33,10 +33,13 @@ async def simulate(seed: int, kills: int, buggify: bool) -> dict:
         {"testName": "Cycle", "nodeCount": 12, "transactionsPerClient": 30},
         {"testName": "Serializability", "numOps": 40},
         {"testName": "AtomicOps", "addsPerClient": 15},
+        {"testName": "ConflictRange", "nodeCount": 8, "opsPerClient": 15},
         {"testName": "Watches", "rounds": 3, "strictFires": False},
         {"testName": "ConfigureDatabase", "sim": sim, "rounds": 2,
          "secondsBetweenChanges": 2.5},
         {"testName": "MachineAttrition", "sim": sim, "machinesToKill": kills},
+        {"testName": "Swizzle", "sim": sim, "rounds": 1,
+         "secondsBefore": 6.0},
         {"testName": "RandomClogging", "sim": sim, "testDuration": 8.0},
         {"testName": "ConsistencyCheck"},
     ]

@@ -9,5 +9,5 @@ violated by lost/phantom/reordered writes.
 
 from .workload import (TestWorkload, WorkloadContext, register_workload,
                        make_workload, run_workloads, run_workloads_on)
-from . import (attrition, consistency, cycle, dynamic, random_rw,  # noqa: F401  (register)
-               serializability)
+from . import (attrition, conflict_range, consistency, cycle,  # noqa: F401  (register)
+               dynamic, random_rw, serializability)

@@ -61,6 +61,58 @@ class MachineAttritionWorkload(TestWorkload):
 
 
 @register_workload
+class SwizzleWorkload(TestWorkload):
+    """The simulator's swizzle: kill a random SUBSET of txn-role
+    machines near-simultaneously, then reboot them in a DIFFERENT
+    shuffled order (REF:fdbrpc/sim2.actor.cpp swizzle /
+    RebootProcessAndSwitch) — the worst-case correlated failure the
+    single-kill attrition workload never produces."""
+
+    name = "Swizzle"
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self.sim = self.opt("sim", None)
+        self.rounds = int(self.opt("rounds", 1))
+        self.delay = float(self.opt("secondsBefore", 3.0))
+        self.swizzled = 0
+
+    async def start(self) -> None:
+        if self.ctx.client_id != 0 or self.sim is None:
+            return
+        for _ in range(self.rounds):
+            await asyncio.sleep(self.delay)
+            victims = [m for m in await self.sim.txn_only_machines()
+                       if m.alive]
+            if len(victims) < 2:
+                continue
+            # a random subset of >= 2, killed in one burst
+            k = 2 + int(self.rng.random_int(0, len(victims) - 1))
+            picks = list(victims)
+            self.rng.shuffle(picks)
+            subset = picks[:k]
+            epoch_before = (await self.sim.wait_epoch(1))["epoch"]
+            for m in subset:
+                await m.kill()
+                await asyncio.sleep(self.rng.random() * 0.05)
+            # reboot in a DIFFERENT shuffled order
+            order = list(subset)
+            self.rng.shuffle(order)
+            await asyncio.sleep(0.5)
+            for m in order:
+                await m.reboot()
+                await asyncio.sleep(self.rng.random() * 0.1)
+            # the cluster must recover to a NEW epoch with everyone back
+            await self.sim.wait_epoch(epoch_before + 1)
+            self.swizzled += len(subset)
+            TraceEvent("SwizzleRound").detail("Killed", len(subset)) \
+                .detail("Epoch", epoch_before + 1).log()
+
+    def metrics(self):
+        return {"machines_swizzled": self.swizzled}
+
+
+@register_workload
 class RandomCloggingWorkload(TestWorkload):
     """Randomly clog and partition (then heal) network links."""
 

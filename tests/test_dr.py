@@ -1,0 +1,360 @@
+"""DR (cluster-to-cluster replication) + database lock.
+
+Reference test model: REF:fdbclient/DatabaseBackupAgent.actor.cpp
+(`fdbdr start/status/switch`) — a secondary cluster converges on the
+primary's state, switchover is loss-free, and the database lock fences
+the primary from non-lock-aware commits.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from foundationdb_tpu.backup.dr import DRAgent, DrError
+from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+from foundationdb_tpu.core.data import SYSTEM_PREFIX
+from foundationdb_tpu.core.management import lock_database, unlock_database
+from foundationdb_tpu.runtime.errors import DatabaseLocked
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+
+async def _read_all(db, at_version=None):
+    tr = db.create_transaction()
+    tr.lock_aware = True
+    while True:
+        try:
+            if at_version is not None:
+                tr.set_read_version(at_version)
+            rows = await tr.get_range(b"", SYSTEM_PREFIX, limit=0,
+                                      snapshot=True)
+            return dict(rows)
+        except Exception as e:   # noqa: BLE001 — retry loop
+            await tr.on_error(e)
+
+
+async def _two_clusters(n_src=4, n_dest=4):
+    src_sim = SimulatedCluster(Knobs(), n_machines=n_src,
+                               spec=ClusterConfigSpec(min_workers=n_src))
+    dest_sim = SimulatedCluster(Knobs(), n_machines=n_dest,
+                                spec=ClusterConfigSpec(min_workers=n_dest))
+    await src_sim.start()
+    await dest_sim.start()
+    await src_sim.wait_epoch(1)
+    await dest_sim.wait_epoch(1)
+    return src_sim, dest_sim, await src_sim.database(), \
+        await dest_sim.database()
+
+
+def test_dr_replicates_sets_clears_atomics():
+    """Writes before AND after start() all converge on dest, including
+    pre-snapshot state, clears, and order-sensitive atomic adds."""
+    async def main():
+        src_sim, dest_sim, src, dest = await _two_clusters()
+
+        async def seed(tr):
+            for i in range(30):
+                tr.set(b"pre%03d" % i, b"S%d" % i)
+            tr.add(b"counter", (7).to_bytes(8, "little"))
+        await src.run(seed)
+
+        dr = DRAgent(src, dest)
+        await dr.start()
+
+        for j in range(6):
+            async def live(tr, j=j):
+                tr.set(b"live%03d" % j, b"L%d" % j)
+                tr.clear(b"pre%03d" % (j * 3))
+                tr.add(b"counter", (3).to_bytes(8, "little"))
+            await src.run(live)
+
+        vd = await dr.drain()
+        expected = await _read_all(src, at_version=vd)
+        got = await _read_all(dest)
+        got.pop(b"\xff/dr/applied", None)
+        assert expected[b"counter"] == (25).to_bytes(8, "little")
+        assert got == expected, (
+            f"missing={sorted(set(expected) - set(got))[:4]} "
+            f"extra={sorted(set(got) - set(expected))[:4]}")
+        await dr.abort()
+        await src_sim.stop()
+        await dest_sim.stop()
+    run_simulation(main())
+
+
+def test_dr_switchover_is_loss_free_and_locks_source():
+    """switchover(): every acked source commit is on dest; the source
+    then refuses non-lock-aware commits; dest accepts writes."""
+    async def main():
+        src_sim, dest_sim, src, dest = await _two_clusters()
+        dr = DRAgent(src, dest)
+        await dr.start()
+
+        for j in range(5):
+            async def w(tr, j=j):
+                tr.set(b"k%03d" % j, b"v%d" % j)
+            await src.run(w)
+
+        vd = await dr.switchover()
+        expected = await _read_all(src, at_version=vd)
+        got = await _read_all(dest)
+        got.pop(b"\xff/dr/applied", None)
+        assert got == expected
+
+        # the source is fenced
+        tr = src.create_transaction()
+        tr.set(b"after", b"must-not-land")
+        try:
+            await tr.commit()
+            raise AssertionError("locked source accepted a commit")
+        except DatabaseLocked:
+            pass
+
+        # the destination is live and writable
+        async def wd(tr):
+            tr.set(b"dest-write", b"ok")
+        await dest.run(wd)
+        got2 = await _read_all(dest)
+        assert got2[b"dest-write"] == b"ok"
+        await src_sim.stop()
+        await dest_sim.stop()
+    run_simulation(main())
+
+
+def test_dr_survives_source_recovery():
+    """A source-side recovery mid-stream must not lose or duplicate
+    mutations on dest (the tag re-arms from the \\xff read and the
+    stream's cursor rolls generations)."""
+    async def main():
+        src_sim, dest_sim, src, dest = await _two_clusters(n_src=6)
+        dr = DRAgent(src, dest)
+        await dr.start()
+
+        async def w(tr, tag, n):
+            for i in range(n):
+                tr.set(b"r%s%03d" % (tag, i), b"v-" + tag)
+            tr.add(b"rc", (1).to_bytes(8, "little"))
+        await src.run(lambda tr: w(tr, b"pre", 15))
+
+        state1 = await src_sim.wait_epoch(1)
+        victims = await src_sim.txn_only_machines()
+        assert victims
+        await victims[0].kill()
+        await src_sim.wait_epoch(state1["epoch"] + 1)
+
+        while True:
+            tr = src.create_transaction()
+            try:
+                await w(tr, b"post", 15)
+                await tr.commit()
+                break
+            except Exception as e:   # noqa: BLE001 — retry through recovery
+                await tr.on_error(e)
+
+        vd = await dr.drain(timeout=60.0)
+        expected = await _read_all(src, at_version=vd)
+        got = await _read_all(dest)
+        got.pop(b"\xff/dr/applied", None)
+        assert expected[b"rc"] == (2).to_bytes(8, "little")
+        assert got == expected, (
+            f"missing={sorted(set(expected) - set(got))[:4]} "
+            f"extra={sorted(set(got) - set(expected))[:4]}")
+        await dr.abort()
+        await src_sim.stop()
+        await dest_sim.stop()
+    run_simulation(main())
+
+
+def test_database_lock_semantics():
+    """lock blocks plain commits (database_locked, non-retryable), spares
+    lock-aware ones, refuses a mismatched unlock, and unlock restores
+    service."""
+    from foundationdb_tpu.core.management import DatabaseLockedByOther
+
+    async def main():
+        sim = SimulatedCluster(Knobs(), n_machines=4,
+                               spec=ClusterConfigSpec(min_workers=4))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+
+        await lock_database(db, b"uid-1")
+
+        tr = db.create_transaction()
+        tr.set(b"x", b"1")
+        try:
+            await tr.commit()
+            raise AssertionError("locked db accepted a plain commit")
+        except DatabaseLocked:
+            pass
+
+        tr = db.create_transaction()
+        tr.lock_aware = True
+        tr.set(b"x", b"locked-write")
+        await tr.commit()
+
+        # a non-lock-aware STATE transaction is fenced BEFORE resolution:
+        # its \xff mutations must never reach the proxies' metadata
+        tr = db.create_transaction()
+        tr.set(b"\xff/conf/resolvers", b"7")
+        try:
+            await tr.commit()
+            raise AssertionError("locked db accepted a state txn")
+        except DatabaseLocked:
+            pass
+
+        # relock under the same uid is idempotent; other uid refused
+        await lock_database(db, b"uid-1")
+        try:
+            await lock_database(db, b"uid-2")
+            raise AssertionError("second uid stole the lock")
+        except DatabaseLockedByOther:
+            pass
+        try:
+            await unlock_database(db, b"uid-2")
+            raise AssertionError("mismatched unlock succeeded")
+        except DatabaseLockedByOther:
+            pass
+
+        await unlock_database(db, b"uid-1")
+        # a non-lock-aware STATE txn right after unlock: a proxy whose
+        # local lock view is stale-locked must refresh (empty batch)
+        # instead of spuriously rejecting with the non-retryable 1038
+        from foundationdb_tpu.core.management import configure
+        await configure(db, resolvers=1)
+        async def w(tr):
+            tr.set(b"y", b"after-unlock")
+        await db.run(w)
+        got = await _read_all(db)
+        assert got[b"x"] == b"locked-write" and got[b"y"] == b"after-unlock"
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_lock_survives_recovery():
+    """A lock committed moments before a crash must still fence the
+    recovered cluster: recovery's metadata read waits for the storage
+    replica to catch up to the recovery version (a lagging snapshot
+    would silently recover unlocked — an unfenced primary after DR
+    switchover)."""
+    async def main():
+        sim = SimulatedCluster(Knobs(), n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        db = await sim.database()
+
+        await lock_database(db, b"uid-r")
+        victims = await sim.txn_only_machines()
+        assert victims
+        await victims[0].kill()
+        await sim.wait_epoch(state1["epoch"] + 1)
+
+        # still fenced after recovery — commits AND reads
+        while True:
+            tr = db.create_transaction()
+            tr.set(b"x", b"1")
+            try:
+                await tr.commit()
+                raise AssertionError("recovered cluster dropped the lock")
+            except DatabaseLocked:
+                break
+            except Exception as e:   # noqa: BLE001 — retry through recovery
+                await tr.on_error(e)
+
+        # lock-aware service still works, and unlock restores everything
+        await unlock_database(db, b"uid-r")
+        async def w(tr):
+            tr.set(b"y", b"ok")
+        await db.run(w)
+        assert (await _read_all(db))[b"y"] == b"ok"
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_dest_locked_during_dr():
+    """The destination refuses third-party writes while DR runs (the
+    reference locks the secondary for exactly this), and opens up at
+    switchover."""
+    async def main():
+        src_sim, dest_sim, src, dest = await _two_clusters()
+        dr = DRAgent(src, dest)
+        await dr.start()
+
+        tr = dest.create_transaction()
+        tr.set(b"intruder", b"x")
+        try:
+            await tr.commit()
+            raise AssertionError("dest accepted a third-party write")
+        except DatabaseLocked:
+            pass
+
+        async def w(tr):
+            tr.set(b"k", b"v")
+        await src.run(w)
+        await dr.switchover()
+
+        # dest is primary now: unlocked
+        async def wd(tr):
+            tr.set(b"after", b"ok")
+        await dest.run(wd)
+        got = await _read_all(dest)
+        assert got[b"after"] == b"ok" and got[b"k"] == b"v"
+        assert b"intruder" not in got
+        await src_sim.stop()
+        await dest_sim.stop()
+    run_simulation(main())
+
+
+def test_backup_and_dr_tags_coexist():
+    """A named DR tag and the legacy file-backup tag stream concurrently:
+    disarming one leaves the other armed (the proxy's named-slot map)."""
+    from foundationdb_tpu.backup.agent import BackupAgent
+    from foundationdb_tpu.runtime.files import SimFileSystem
+
+    async def main():
+        src_sim, dest_sim, src, dest = await _two_clusters()
+        bk = BackupAgent(src, SimFileSystem(), "bk-dr")
+        dr = DRAgent(src, dest)
+        await bk.start_continuous()
+        await bk.backup()
+        await dr.start()
+
+        for j in range(4):
+            async def w(tr, j=j):
+                tr.set(b"both%03d" % j, b"B%d" % j)
+            await src.run(w)
+
+        # disarm DR; backup keeps streaming
+        vd = await dr.drain()
+        await dr.abort()
+
+        async def after(tr):
+            tr.set(b"after-dr-abort", b"bk-only")
+        await src.run(after)
+        tr = src.create_transaction()
+        while True:
+            try:
+                tr.set(b"marker", b"end")
+                vt = await tr.commit()
+                break
+            except Exception as e:   # noqa: BLE001
+                await tr.on_error(e)
+        expected_src = await _read_all(src, at_version=vt)
+        await bk.stop_continuous()
+
+        # dest has the DR prefix
+        got = await _read_all(dest)
+        got.pop(b"\xff/dr/applied", None)
+        assert got == await _read_all(src, at_version=vd)
+
+        # the file backup restores the FULL stream incl. post-abort writes
+        async def wipe(tr):
+            tr.clear_range(b"", SYSTEM_PREFIX)
+        await src.run(wipe)
+        await bk.restore(to_version=vt)
+        assert await _read_all(src) == expected_src
+        await src_sim.stop()
+        await dest_sim.stop()
+    run_simulation(main())

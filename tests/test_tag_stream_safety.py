@@ -1,0 +1,129 @@
+"""TagStream ack-safety: never emit a version a recovery can roll back.
+
+Reference model: REF:fdbserver/TLogServer.actor.cpp peeks bound consumers
+by minKnownCommittedVersion — a pushed-but-unacked version must not reach
+an external consumer (DR destination, backup file), because recovery may
+discard it (its client saw commit_unknown_result).  TagStream implements
+the same discipline with a GRV+epoch confirm round; these tests script
+the view/confirm surfaces to force the exact races.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from foundationdb_tpu.backup.stream import TagStream
+from foundationdb_tpu.core.tlog import TLogPeekReply
+
+
+class ScriptedCursor:
+    def __init__(self, replies):
+        self.replies = list(replies)     # list of (entries, end) or callables
+        self.version = 0                 # rewind target (observed)
+
+    async def next(self):
+        if not self.replies:
+            await asyncio.sleep(3600)    # nothing more scripted
+        item = self.replies.pop(0)
+        entries, end = item() if callable(item) else item
+        # honor rewinds like a real LogCursor: serve only >= self.version
+        entries = [(v, m) for v, m in entries if v >= self.version]
+        return TLogPeekReply(entries, end)
+
+
+class ScriptedStream(TagStream):
+    """TagStream with _view/_confirm replaced by scripts."""
+
+    def __init__(self, begin, views, confirms):
+        super().__init__(db=None, tag=99, begin=begin)
+        self._views = list(views)        # (epoch, gen_begin, cursor)
+        self._confirms = list(confirms)  # (grv, epoch)
+        self.confirm_calls = 0
+
+    async def _view(self):
+        epoch, gen_begin, cursor = self._views.pop(0)
+        self.view_epoch = epoch
+        self.current_gen_begin = gen_begin
+        cursor.version = self.frontier + 1
+        self._cursor = cursor
+        self._ls = None
+
+    async def _confirm(self):
+        self.confirm_calls += 1
+        return self._confirms.pop(0)
+
+
+def test_unconfirmed_tail_held_until_grv_passes():
+    """Entries above the confirmed read version are withheld, then
+    emitted once a (same-epoch) GRV covers them."""
+    async def main():
+        cur = ScriptedCursor([([(10, ["a"]), (12, ["b"])], 13),
+                              ([(12, ["b"])], 13)])
+        s = ScriptedStream(begin=10,
+                           views=[(5, 0, cur)],
+                           confirms=[(10, 5), (12, 5)])
+        entries, end = await s.next()
+        assert entries == [(10, ["a"])] and end == 11, (entries, end)
+        entries, end = await s.next()
+        assert entries == [(12, ["b"])] and end == 13
+        assert s.confirm_calls == 2
+    asyncio.run(asyncio.wait_for(main(), 10))
+
+
+def test_phantom_version_discarded_on_epoch_roll():
+    """A pulled-but-unacked version rolled back by a recovery is never
+    emitted: the epoch check discards it and the re-pulled view (whose
+    sealed generation excludes it) supplies the truth."""
+    async def main():
+        cur_old = ScriptedCursor([([(10, ["a"]), (12, ["phantom"])], 13)])
+        # after recovery at version 11: 10 retained, 12 rolled back, a
+        # NEW commit landed at 12 (version reuse across the recovery)
+        cur_new = ScriptedCursor([([(10, ["a"]), (12, ["new"])], 15)])
+        s = ScriptedStream(
+            begin=10,
+            views=[(5, 0, cur_old), (6, 11, cur_new)],
+            confirms=[(12, 6),      # epoch moved: discard the old reply
+                      (14, 6)])     # confirms the new generation's tail
+        got = []
+        while len(got) < 2:
+            entries, _ = await s.next()
+            got.extend(entries)
+        assert got == [(10, ["a"]), (12, ["new"])], got
+        assert all(m != ["phantom"] for _, m in got)
+    asyncio.run(asyncio.wait_for(main(), 10))
+
+
+def test_frontier_never_advances_past_unconfirmed_tip():
+    """An empty reply whose end_version is an unacked peek tip must not
+    advance the emitted frontier past the confirmed cap — a consumer
+    persisting end-1 as 'applied through' would otherwise skip real
+    commits landing numerically below the rolled-back tip."""
+    async def main():
+        cur = ScriptedCursor([([], 50),            # empty, tip way ahead
+                              ([], 50),
+                              ([(21, ["x"])], 50)])
+        s = ScriptedStream(begin=10,
+                           views=[(5, 0, cur)],
+                           confirms=[(20, 5), (20, 5), (21, 5), (21, 5)])
+        entries, end = await s.next()
+        assert end - 1 <= 20, end
+        assert s.frontier <= 20
+        entries, end = await s.next()
+        assert entries == [(21, ["x"])] and end - 1 <= 21
+    asyncio.run(asyncio.wait_for(main(), 10))
+
+
+def test_rewind_replays_span():
+    """rewind() steps the frontier back so a consumer that failed to
+    persist a span pulls it again."""
+    async def main():
+        cur = ScriptedCursor([([(10, ["a"]), (11, ["b"])], 12),
+                              ([(10, ["a"]), (11, ["b"])], 12)])
+        s = ScriptedStream(begin=10, views=[(5, 0, cur)],
+                           confirms=[(11, 5), (11, 5)])
+        e1, _ = await s.next()
+        assert e1 == [(10, ["a"]), (11, ["b"])]
+        s.rewind(9)
+        e2, _ = await s.next()
+        assert e2 == e1
+    asyncio.run(asyncio.wait_for(main(), 10))
