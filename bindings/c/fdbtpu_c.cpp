@@ -57,6 +57,13 @@ fdbtpu_error_t err_from_python() {
     return 4100;  // internal_error: the host returns codes, not raises
 }
 
+// Py_BuildValue "y#" turns a NULL pointer into None; zero-length keys
+// (e.g. a scan from begin="") are legal, so give NULL/0 a real address.
+inline const char* nz(const uint8_t* p) {
+    static const char empty[1] = {0};
+    return p ? (const char*)p : empty;
+}
+
 }  // namespace
 
 extern "C" {
@@ -123,7 +130,7 @@ fdbtpu_error_t fdbtpu_transaction_get(FDBTPUTransaction* tr,
                                       uint8_t** out_value, int* out_length) {
     Gil gil;
     PyObject* args = Py_BuildValue("(Ly#)", tr->tid,
-                                   (const char*)key, (Py_ssize_t)key_length);
+                                   nz(key), (Py_ssize_t)key_length);
     PyObject* r = call_host("txn_get", args);
     Py_XDECREF(args);
     if (!r) return err_from_python();
@@ -153,8 +160,8 @@ fdbtpu_error_t fdbtpu_transaction_set(FDBTPUTransaction* tr,
                                       const uint8_t* value, int value_length) {
     Gil gil;
     PyObject* args = Py_BuildValue("(Ly#y#)", tr->tid,
-                                   (const char*)key, (Py_ssize_t)key_length,
-                                   (const char*)value, (Py_ssize_t)value_length);
+                                   nz(key), (Py_ssize_t)key_length,
+                                   nz(value), (Py_ssize_t)value_length);
     PyObject* r = call_host("txn_set", args);
     Py_XDECREF(args);
     if (!r) return err_from_python();
@@ -167,8 +174,93 @@ fdbtpu_error_t fdbtpu_transaction_clear(FDBTPUTransaction* tr,
                                         const uint8_t* key, int key_length) {
     Gil gil;
     PyObject* args = Py_BuildValue("(Ly#)", tr->tid,
-                                   (const char*)key, (Py_ssize_t)key_length);
+                                   nz(key), (Py_ssize_t)key_length);
     PyObject* r = call_host("txn_clear", args);
+    Py_XDECREF(args);
+    if (!r) return err_from_python();
+    long code = PyLong_AsLong(r);
+    Py_DECREF(r);
+    return (fdbtpu_error_t)code;
+}
+
+fdbtpu_error_t fdbtpu_transaction_get_range(FDBTPUTransaction* tr,
+                                            const uint8_t* begin,
+                                            int begin_length,
+                                            const uint8_t* end,
+                                            int end_length,
+                                            int limit, int reverse,
+                                            uint8_t** out_buf,
+                                            int* out_length,
+                                            int* out_count) {
+    Gil gil;
+    PyObject* args = Py_BuildValue(
+        "(Ly#y#ii)", tr->tid, nz(begin), (Py_ssize_t)begin_length,
+        nz(end), (Py_ssize_t)end_length, limit, reverse);
+    PyObject* r = call_host("txn_get_range", args);
+    Py_XDECREF(args);
+    if (!r) return err_from_python();
+    long code;
+    const char* buf = nullptr;
+    Py_ssize_t blen = 0;
+    int count = 0;
+    if (!PyArg_ParseTuple(r, "ly#i", &code, &buf, &blen, &count)) {
+        Py_DECREF(r);
+        return err_from_python();
+    }
+    if (code == 0) {
+        *out_buf = (uint8_t*)std::malloc(blen ? blen : 1);
+        std::memcpy(*out_buf, buf, blen);
+        *out_length = (int)blen;
+        *out_count = count;
+    } else {
+        *out_buf = nullptr;
+        *out_length = 0;
+        *out_count = 0;
+    }
+    Py_DECREF(r);
+    return (fdbtpu_error_t)code;
+}
+
+fdbtpu_error_t fdbtpu_transaction_atomic_op(FDBTPUTransaction* tr, int op,
+                                            const uint8_t* key,
+                                            int key_length,
+                                            const uint8_t* operand,
+                                            int operand_length) {
+    Gil gil;
+    PyObject* args = Py_BuildValue(
+        "(Liy#y#)", tr->tid, op, nz(key), (Py_ssize_t)key_length,
+        nz(operand), (Py_ssize_t)operand_length);
+    PyObject* r = call_host("txn_atomic_op", args);
+    Py_XDECREF(args);
+    if (!r) return err_from_python();
+    long code = PyLong_AsLong(r);
+    Py_DECREF(r);
+    return (fdbtpu_error_t)code;
+}
+
+fdbtpu_error_t fdbtpu_transaction_get_read_version(FDBTPUTransaction* tr,
+                                                   int64_t* out_version) {
+    Gil gil;
+    PyObject* args = Py_BuildValue("(L)", tr->tid);
+    PyObject* r = call_host("txn_get_read_version", args);
+    Py_XDECREF(args);
+    if (!r) return err_from_python();
+    long code;
+    long long ver;
+    if (!PyArg_ParseTuple(r, "lL", &code, &ver)) {
+        Py_DECREF(r);
+        return err_from_python();
+    }
+    Py_DECREF(r);
+    if (out_version) *out_version = ver;
+    return (fdbtpu_error_t)code;
+}
+
+fdbtpu_error_t fdbtpu_transaction_set_option(FDBTPUTransaction* tr,
+                                             const char* option) {
+    Gil gil;
+    PyObject* args = Py_BuildValue("(Ls)", tr->tid, option);
+    PyObject* r = call_host("txn_set_option", args);
     Py_XDECREF(args);
     if (!r) return err_from_python();
     long code = PyLong_AsLong(r);

@@ -49,6 +49,39 @@ fdbtpu_error_t fdbtpu_transaction_set(FDBTPUTransaction* tr,
 fdbtpu_error_t fdbtpu_transaction_clear(FDBTPUTransaction* tr,
                                         const uint8_t* key, int key_length);
 
+/* Range read.  On success *out_buf holds count records packed as
+ * ([u32 key_length][key][u32 value_length][value]) * count, little
+ * endian, in one malloc'd buffer (fdbtpu_free it).  limit 0 = no limit;
+ * reverse != 0 returns descending order. */
+fdbtpu_error_t fdbtpu_transaction_get_range(FDBTPUTransaction* tr,
+                                            const uint8_t* begin,
+                                            int begin_length,
+                                            const uint8_t* end,
+                                            int end_length,
+                                            int limit, int reverse,
+                                            uint8_t** out_buf,
+                                            int* out_length,
+                                            int* out_count);
+
+/* Atomic read-modify-write (FDBMutationType opcodes: ADD=2, BIT_AND=6,
+ * BIT_OR=7, BIT_XOR=8, APPEND_IF_FITS=9, MAX=12, MIN=13,
+ * SET_VERSIONSTAMPED_KEY=14, SET_VERSIONSTAMPED_VALUE=15, BYTE_MIN=16,
+ * BYTE_MAX=17 — values match fdb_c.h where an equivalent exists). */
+fdbtpu_error_t fdbtpu_transaction_atomic_op(FDBTPUTransaction* tr, int op,
+                                            const uint8_t* key,
+                                            int key_length,
+                                            const uint8_t* operand,
+                                            int operand_length);
+
+/* The transaction's read version (GRV). */
+fdbtpu_error_t fdbtpu_transaction_get_read_version(FDBTPUTransaction* tr,
+                                                   int64_t* out_version);
+
+/* Named transaction option ("lock_aware", ...).  Unknown options return
+ * error 2007 (invalid_option). */
+fdbtpu_error_t fdbtpu_transaction_set_option(FDBTPUTransaction* tr,
+                                             const char* option);
+
 /* Commit; on success *out_committed_version holds the commit version. */
 fdbtpu_error_t fdbtpu_transaction_commit(FDBTPUTransaction* tr,
                                          int64_t* out_committed_version);

@@ -1,0 +1,229 @@
+// Package fdbtpu is the Go binding over the C ABI (libfdbtpu_c.so).
+//
+// Reference: REF:bindings/go/src/fdb — the upstream Go binding is cgo
+// over fdb_c; this is the same shape over bindings/c/fdbtpu_c.h, which
+// is built and integration-tested in-repo (tests/test_bindings.py).
+// No Go toolchain exists in the repo's CI image, so the package ships
+// as source; the C ABI underneath is the tested seam.
+//
+// Build: CGO_CFLAGS="-I${REPO}/bindings/c" \
+//        CGO_LDFLAGS="${REPO}/foundationdb_tpu/native/libfdbtpu_c.so" \
+//        go build ./...
+package fdbtpu
+
+/*
+#include <stdlib.h>
+#include "fdbtpu_c.h"
+*/
+import "C"
+
+import (
+	"encoding/binary"
+	"fmt"
+	"unsafe"
+)
+
+// Error carries an FDB-compatible numeric code.
+type Error struct {
+	Code int
+}
+
+func (e Error) Error() string {
+	return fmt.Sprintf("fdbtpu error %d: %s", e.Code,
+		C.GoString(C.fdbtpu_get_error(C.fdbtpu_error_t(e.Code))))
+}
+
+func check(code C.fdbtpu_error_t) error {
+	if code != 0 {
+		return Error{Code: int(code)}
+	}
+	return nil
+}
+
+// KeyValue is one decoded row of a range read.
+type KeyValue struct {
+	Key   []byte
+	Value []byte
+}
+
+// Mutation opcodes (values shared with fdb_c.h FDBMutationType).
+const (
+	MutationAdd                    = 2
+	MutationBitAnd                 = 6
+	MutationBitOr                  = 7
+	MutationBitXor                 = 8
+	MutationAppendIfFits           = 9
+	MutationMax                    = 12
+	MutationMin                    = 13
+	MutationSetVersionstampedKey   = 14
+	MutationSetVersionstampedValue = 15
+	MutationByteMin                = 16
+	MutationByteMax                = 17
+)
+
+// Open starts the client network against the cluster file (once per
+// process) and returns the database handle.
+func Open(clusterFile string) (*Database, error) {
+	cs := C.CString(clusterFile)
+	defer C.free(unsafe.Pointer(cs))
+	if err := check(C.fdbtpu_init(cs)); err != nil {
+		return nil, err
+	}
+	return &Database{}, nil
+}
+
+// Stop shuts the network down.
+func Stop() error {
+	return check(C.fdbtpu_stop())
+}
+
+// Database hands out transactions and hosts the retry loop.
+type Database struct{}
+
+func (d *Database) CreateTransaction() (*Transaction, error) {
+	var h *C.FDBTPUTransaction
+	if err := check(C.fdbtpu_create_transaction(&h)); err != nil {
+		return nil, err
+	}
+	return &Transaction{h: h}, nil
+}
+
+// Run is the @transactional retry loop: fn then commit; retryable
+// errors reset the transaction and re-run fn (fn must be idempotent).
+func (d *Database) Run(fn func(*Transaction) error) error {
+	tr, err := d.CreateTransaction()
+	if err != nil {
+		return err
+	}
+	defer tr.Destroy()
+	for {
+		err = fn(tr)
+		if err == nil {
+			_, err = tr.Commit()
+			if err == nil {
+				return nil
+			}
+		}
+		fe, ok := err.(Error)
+		if !ok {
+			return err
+		}
+		if rc := C.fdbtpu_transaction_on_error(tr.h,
+			C.fdbtpu_error_t(fe.Code)); rc != 0 {
+			return Error{Code: int(rc)}
+		}
+	}
+}
+
+// Transaction wraps one C-ABI transaction handle.
+type Transaction struct {
+	h *C.FDBTPUTransaction
+}
+
+func bytesPtr(b []byte) *C.uint8_t {
+	if len(b) == 0 {
+		return nil
+	}
+	return (*C.uint8_t)(unsafe.Pointer(&b[0]))
+}
+
+// Get returns (nil, nil) for an absent key.
+func (t *Transaction) Get(key []byte) ([]byte, error) {
+	var present C.int
+	var value *C.uint8_t
+	var length C.int
+	err := check(C.fdbtpu_transaction_get(t.h, bytesPtr(key),
+		C.int(len(key)), &present, &value, &length))
+	if err != nil {
+		return nil, err
+	}
+	if present == 0 {
+		return nil, nil
+	}
+	out := C.GoBytes(unsafe.Pointer(value), length)
+	C.fdbtpu_free(value)
+	return out, nil
+}
+
+func (t *Transaction) Set(key, value []byte) error {
+	return check(C.fdbtpu_transaction_set(t.h, bytesPtr(key),
+		C.int(len(key)), bytesPtr(value), C.int(len(value))))
+}
+
+func (t *Transaction) Clear(key []byte) error {
+	return check(C.fdbtpu_transaction_clear(t.h, bytesPtr(key),
+		C.int(len(key))))
+}
+
+// GetRange decodes the packed ([u32 klen][key][u32 vlen][value])* reply.
+func (t *Transaction) GetRange(begin, end []byte, limit int,
+	reverse bool) ([]KeyValue, error) {
+	var buf *C.uint8_t
+	var length, count C.int
+	rev := C.int(0)
+	if reverse {
+		rev = 1
+	}
+	err := check(C.fdbtpu_transaction_get_range(t.h,
+		bytesPtr(begin), C.int(len(begin)),
+		bytesPtr(end), C.int(len(end)),
+		C.int(limit), rev, &buf, &length, &count))
+	if err != nil {
+		return nil, err
+	}
+	// the C side mallocs even for empty results: free unconditionally
+	raw := C.GoBytes(unsafe.Pointer(buf), length)
+	C.fdbtpu_free(buf)
+	out := make([]KeyValue, 0, int(count))
+	pos := 0
+	for i := 0; i < int(count); i++ {
+		klen := int(binary.LittleEndian.Uint32(raw[pos:]))
+		pos += 4
+		k := raw[pos : pos+klen]
+		pos += klen
+		vlen := int(binary.LittleEndian.Uint32(raw[pos:]))
+		pos += 4
+		v := raw[pos : pos+vlen]
+		pos += vlen
+		out = append(out, KeyValue{Key: k, Value: v})
+	}
+	return out, nil
+}
+
+// AtomicOp applies a Mutation* opcode server-side at commit.
+func (t *Transaction) AtomicOp(op int, key, operand []byte) error {
+	return check(C.fdbtpu_transaction_atomic_op(t.h, C.int(op),
+		bytesPtr(key), C.int(len(key)),
+		bytesPtr(operand), C.int(len(operand))))
+}
+
+func (t *Transaction) GetReadVersion() (int64, error) {
+	var v C.int64_t
+	err := check(C.fdbtpu_transaction_get_read_version(t.h, &v))
+	return int64(v), err
+}
+
+// SetOption sets a named option, e.g. "lock_aware".
+func (t *Transaction) SetOption(option string) error {
+	cs := C.CString(option)
+	defer C.free(unsafe.Pointer(cs))
+	return check(C.fdbtpu_transaction_set_option(t.h, cs))
+}
+
+// Commit returns the committed version.
+func (t *Transaction) Commit() (int64, error) {
+	var v C.int64_t
+	err := check(C.fdbtpu_transaction_commit(t.h, &v))
+	return int64(v), err
+}
+
+func (t *Transaction) Reset() error {
+	return check(C.fdbtpu_transaction_reset(t.h))
+}
+
+func (t *Transaction) Destroy() {
+	if t.h != nil {
+		C.fdbtpu_transaction_destroy(t.h)
+		t.h = nil
+	}
+}

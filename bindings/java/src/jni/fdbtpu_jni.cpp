@@ -1,0 +1,174 @@
+// JNI glue: dev.fdbtpu natives → the C ABI (fdbtpu_c.h).
+//
+// Reference shape: REF:bindings/java/fdbJNI.cpp.  Error handling follows
+// the binding's contract: int-returning natives hand the code straight
+// back; byte[]-returning natives stash the code in a thread-local that
+// FDBTPU.lastError() reads (the JNI layer never throws itself — the
+// Java side turns codes into FDBException so the retry loop sees them).
+//
+// Build: see bindings/java/README.md (needs a JDK's jni.h; the C ABI
+// below it is compiled and tested in-repo).
+
+#include <jni.h>
+
+#include <cstdint>
+#include <cstring>
+
+#include "fdbtpu_c.h"
+
+namespace {
+
+thread_local fdbtpu_error_t g_last_error = 0;
+
+jbyteArray to_jbytes(JNIEnv* env, const uint8_t* buf, int len) {
+    jbyteArray out = env->NewByteArray(len);
+    if (out && len) {
+        env->SetByteArrayRegion(out, 0, len,
+                                reinterpret_cast<const jbyte*>(buf));
+    }
+    return out;
+}
+
+struct Bytes {
+    JNIEnv* env;
+    jbyteArray arr;
+    jbyte* ptr;
+    jsize len;
+    Bytes(JNIEnv* e, jbyteArray a) : env(e), arr(a) {
+        ptr = a ? e->GetByteArrayElements(a, nullptr) : nullptr;
+        len = a ? e->GetArrayLength(a) : 0;
+    }
+    ~Bytes() {
+        if (arr) env->ReleaseByteArrayElements(arr, ptr, JNI_ABORT);
+    }
+    const uint8_t* data() const {
+        return reinterpret_cast<const uint8_t*>(ptr);
+    }
+};
+
+FDBTPUTransaction* tr(jlong handle) {
+    return reinterpret_cast<FDBTPUTransaction*>(handle);
+}
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT jint JNICALL Java_dev_fdbtpu_FDBTPU_init(
+    JNIEnv* env, jclass, jstring path) {
+    const char* p = env->GetStringUTFChars(path, nullptr);
+    fdbtpu_error_t code = fdbtpu_init(p);
+    env->ReleaseStringUTFChars(path, p);
+    return (jint)code;
+}
+
+JNIEXPORT jint JNICALL Java_dev_fdbtpu_FDBTPU_stopNetwork(JNIEnv*, jclass) {
+    return (jint)fdbtpu_stop();
+}
+
+JNIEXPORT jstring JNICALL Java_dev_fdbtpu_FDBTPU_getError(
+    JNIEnv* env, jclass, jint code) {
+    return env->NewStringUTF(fdbtpu_get_error((fdbtpu_error_t)code));
+}
+
+JNIEXPORT jlong JNICALL Java_dev_fdbtpu_FDBTPU_createTransaction(
+    JNIEnv*, jclass) {
+    FDBTPUTransaction* out = nullptr;
+    g_last_error = fdbtpu_create_transaction(&out);
+    return reinterpret_cast<jlong>(out);
+}
+
+JNIEXPORT void JNICALL Java_dev_fdbtpu_FDBTPU_destroyTransaction(
+    JNIEnv*, jclass, jlong handle) {
+    fdbtpu_transaction_destroy(tr(handle));
+}
+
+JNIEXPORT jbyteArray JNICALL Java_dev_fdbtpu_FDBTPU_transactionGet(
+    JNIEnv* env, jclass, jlong handle, jbyteArray key) {
+    Bytes k(env, key);
+    int present = 0;
+    uint8_t* value = nullptr;
+    int vlen = 0;
+    g_last_error = fdbtpu_transaction_get(tr(handle), k.data(), (int)k.len,
+                                          &present, &value, &vlen);
+    if (g_last_error != 0 || !present) return nullptr;
+    jbyteArray out = to_jbytes(env, value, vlen);
+    fdbtpu_free(value);
+    return out;
+}
+
+JNIEXPORT jint JNICALL Java_dev_fdbtpu_FDBTPU_transactionSet(
+    JNIEnv* env, jclass, jlong handle, jbyteArray key, jbyteArray value) {
+    Bytes k(env, key), v(env, value);
+    return (jint)fdbtpu_transaction_set(tr(handle), k.data(), (int)k.len,
+                                        v.data(), (int)v.len);
+}
+
+JNIEXPORT jint JNICALL Java_dev_fdbtpu_FDBTPU_transactionClear(
+    JNIEnv* env, jclass, jlong handle, jbyteArray key) {
+    Bytes k(env, key);
+    return (jint)fdbtpu_transaction_clear(tr(handle), k.data(), (int)k.len);
+}
+
+JNIEXPORT jbyteArray JNICALL Java_dev_fdbtpu_FDBTPU_transactionGetRange(
+    JNIEnv* env, jclass, jlong handle, jbyteArray begin, jbyteArray end,
+    jint limit, jboolean reverse) {
+    Bytes b(env, begin), e(env, end);
+    uint8_t* buf = nullptr;
+    int blen = 0, count = 0;
+    g_last_error = fdbtpu_transaction_get_range(
+        tr(handle), b.data(), (int)b.len, e.data(), (int)e.len,
+        (int)limit, reverse ? 1 : 0, &buf, &blen, &count);
+    if (g_last_error != 0) return env->NewByteArray(0);
+    jbyteArray out = to_jbytes(env, buf, blen);
+    fdbtpu_free(buf);
+    return out;
+}
+
+JNIEXPORT jint JNICALL Java_dev_fdbtpu_FDBTPU_transactionAtomicOp(
+    JNIEnv* env, jclass, jlong handle, jint op, jbyteArray key,
+    jbyteArray operand) {
+    Bytes k(env, key), o(env, operand);
+    return (jint)fdbtpu_transaction_atomic_op(tr(handle), (int)op,
+                                              k.data(), (int)k.len,
+                                              o.data(), (int)o.len);
+}
+
+JNIEXPORT jlong JNICALL Java_dev_fdbtpu_FDBTPU_transactionGetReadVersion(
+    JNIEnv*, jclass, jlong handle) {
+    int64_t v = -1;
+    g_last_error = fdbtpu_transaction_get_read_version(tr(handle), &v);
+    return (jlong)v;
+}
+
+JNIEXPORT jint JNICALL Java_dev_fdbtpu_FDBTPU_transactionSetOption(
+    JNIEnv* env, jclass, jlong handle, jstring option) {
+    const char* o = env->GetStringUTFChars(option, nullptr);
+    fdbtpu_error_t code = fdbtpu_transaction_set_option(tr(handle), o);
+    env->ReleaseStringUTFChars(option, o);
+    return (jint)code;
+}
+
+JNIEXPORT jlong JNICALL Java_dev_fdbtpu_FDBTPU_transactionCommit(
+    JNIEnv*, jclass, jlong handle) {
+    int64_t v = -1;
+    g_last_error = fdbtpu_transaction_commit(tr(handle), &v);
+    return (jlong)v;
+}
+
+JNIEXPORT jint JNICALL Java_dev_fdbtpu_FDBTPU_transactionOnError(
+    JNIEnv*, jclass, jlong handle, jint code) {
+    return (jint)fdbtpu_transaction_on_error(tr(handle),
+                                             (fdbtpu_error_t)code);
+}
+
+JNIEXPORT jint JNICALL Java_dev_fdbtpu_FDBTPU_transactionReset(
+    JNIEnv*, jclass, jlong handle) {
+    return (jint)fdbtpu_transaction_reset(tr(handle));
+}
+
+JNIEXPORT jint JNICALL Java_dev_fdbtpu_FDBTPU_lastError(JNIEnv*, jclass) {
+    return (jint)g_last_error;
+}
+
+}  // extern "C"

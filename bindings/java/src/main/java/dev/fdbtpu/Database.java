@@ -1,0 +1,37 @@
+// REF:bindings/java/src/main/com/apple/foundationdb/Database.java — the
+// run() retry loop is the binding's core contract.
+package dev.fdbtpu;
+
+import java.util.function.Function;
+
+public final class Database {
+    Database() {}
+
+    public Transaction createTransaction() {
+        long handle = FDBTPU.createTransaction();
+        int rc = FDBTPU.lastError();
+        if (rc != 0 || handle == 0) {
+            // surface the failure here rather than letting the next
+            // operation dereference a null native handle
+            throw new FDBException(rc != 0 ? rc : 4100, FDBTPU.getError(rc));
+        }
+        return new Transaction(handle);
+    }
+
+    /** The @transactional retry loop: apply fn, commit; retryable errors
+     *  reset the transaction and re-run fn (fn must be idempotent). */
+    public <T> T run(Function<Transaction, T> fn) {
+        try (Transaction tr = createTransaction()) {
+            while (true) {
+                try {
+                    T out = fn.apply(tr);
+                    tr.commit();
+                    return out;
+                } catch (FDBException e) {
+                    int rc = FDBTPU.transactionOnError(tr.handle, e.getCode());
+                    if (rc != 0) throw new FDBException(rc, FDBTPU.getError(rc));
+                }
+            }
+        }
+    }
+}

@@ -1,0 +1,12 @@
+package dev.fdbtpu;
+
+public final class FDBException extends RuntimeException {
+    private final int code;
+
+    public FDBException(int code, String message) {
+        super(message + " (" + code + ")");
+        this.code = code;
+    }
+
+    public int getCode() { return code; }
+}

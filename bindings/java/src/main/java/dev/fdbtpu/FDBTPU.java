@@ -1,0 +1,56 @@
+// The binding entry point — fdb.jar's FDB class analog
+// (REF:bindings/java/src/main/com/apple/foundationdb/FDB.java): load the
+// JNI glue, start the client network once, hand out Database handles.
+package dev.fdbtpu;
+
+public final class FDBTPU {
+    private static boolean started = false;
+
+    static {
+        System.loadLibrary("fdbtpu_jni");
+    }
+
+    private FDBTPU() {}
+
+    /** Start the client network against the cluster file (once per
+     *  process) and return the database handle. */
+    public static synchronized Database open(String clusterFilePath) {
+        if (!started) {
+            int code = init(clusterFilePath);
+            if (code != 0) throw new FDBException(code, getError(code));
+            started = true;
+        }
+        return new Database();
+    }
+
+    /** Stop the network and release the runtime. */
+    public static synchronized void stop() {
+        if (started) {
+            stopNetwork();
+            started = false;
+        }
+    }
+
+    static native int init(String clusterFilePath);
+    static native int stopNetwork();
+    static native String getError(int code);
+    static native long createTransaction();
+    static native void destroyTransaction(long handle);
+    static native byte[] transactionGet(long handle, byte[] key);
+    static native int transactionSet(long handle, byte[] key, byte[] value);
+    static native int transactionClear(long handle, byte[] key);
+    static native byte[] transactionGetRange(long handle, byte[] begin,
+                                             byte[] end, int limit,
+                                             boolean reverse);
+    static native int transactionAtomicOp(long handle, int op, byte[] key,
+                                          byte[] operand);
+    static native long transactionGetReadVersion(long handle);
+    static native int transactionSetOption(long handle, String option);
+    static native long transactionCommit(long handle);
+    static native int transactionOnError(long handle, int code);
+    static native int transactionReset(long handle);
+
+    // error codes are returned out-of-band for the byte[]-returning
+    // natives; the glue stashes the last code per thread
+    static native int lastError();
+}

@@ -1,0 +1,22 @@
+// Opcodes shared with fdb_c.h FDBMutationType / the C ABI header.
+package dev.fdbtpu;
+
+public enum MutationType {
+    ADD(2),
+    BIT_AND(6),
+    BIT_OR(7),
+    BIT_XOR(8),
+    APPEND_IF_FITS(9),
+    MAX(12),
+    MIN(13),
+    SET_VERSIONSTAMPED_KEY(14),
+    SET_VERSIONSTAMPED_VALUE(15),
+    BYTE_MIN(16),
+    BYTE_MAX(17);
+
+    private final int code;
+
+    MutationType(int code) { this.code = code; }
+
+    public int code() { return code; }
+}

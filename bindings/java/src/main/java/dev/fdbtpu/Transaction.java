@@ -1,0 +1,90 @@
+// REF:bindings/java/src/main/com/apple/foundationdb/Transaction.java —
+// synchronous surface over the C ABI (the upstream binding's async
+// CompletableFuture layer is additive on top of these primitives).
+package dev.fdbtpu;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.util.ArrayList;
+import java.util.List;
+
+public final class Transaction implements AutoCloseable {
+    final long handle;
+    private boolean closed = false;
+
+    Transaction(long handle) {
+        this.handle = handle;
+    }
+
+    private void check(int code) {
+        if (code != 0) throw new FDBException(code, FDBTPU.getError(code));
+    }
+
+    /** null when the key is absent. */
+    public byte[] get(byte[] key) {
+        byte[] out = FDBTPU.transactionGet(handle, key);
+        check(FDBTPU.lastError());
+        return out;
+    }
+
+    public void set(byte[] key, byte[] value) {
+        check(FDBTPU.transactionSet(handle, key, value));
+    }
+
+    public void clear(byte[] key) {
+        check(FDBTPU.transactionClear(handle, key));
+    }
+
+    /** Decoded range read; limit 0 = unlimited. */
+    public List<KeyValue> getRange(byte[] begin, byte[] end, int limit,
+                                   boolean reverse) {
+        byte[] packed = FDBTPU.transactionGetRange(handle, begin, end,
+                                                   limit, reverse);
+        check(FDBTPU.lastError());
+        // packed: ([u32 klen][key][u32 vlen][value]) * n, little-endian
+        List<KeyValue> out = new ArrayList<>();
+        ByteBuffer buf = ByteBuffer.wrap(packed).order(ByteOrder.LITTLE_ENDIAN);
+        while (buf.remaining() > 0) {
+            byte[] k = new byte[buf.getInt()];
+            buf.get(k);
+            byte[] v = new byte[buf.getInt()];
+            buf.get(v);
+            out.add(new KeyValue(k, v));
+        }
+        return out;
+    }
+
+    public void mutate(MutationType op, byte[] key, byte[] operand) {
+        check(FDBTPU.transactionAtomicOp(handle, op.code(), key, operand));
+    }
+
+    public long getReadVersion() {
+        long v = FDBTPU.transactionGetReadVersion(handle);
+        check(FDBTPU.lastError());
+        return v;
+    }
+
+    /** Named option, e.g. "lock_aware". */
+    public void setOption(String option) {
+        check(FDBTPU.transactionSetOption(handle, option));
+    }
+
+    /** Returns the committed version. */
+    public long commit() {
+        long v = FDBTPU.transactionCommit(handle);
+        check(FDBTPU.lastError());
+        return v;
+    }
+
+    public void reset() {
+        check(FDBTPU.transactionReset(handle));
+    }
+
+    @Override
+    public void close() {
+        if (!closed) {
+            FDBTPU.destroyTransaction(handle);
+            closed = true;
+        }
+    }
+}

@@ -50,6 +50,18 @@ def open(cluster_file: str) -> "Database":
             ctypes.c_char_p, ctypes.c_int]
         lib.fdbtpu_transaction_clear.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.fdbtpu_transaction_get_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.fdbtpu_transaction_atomic_op.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int]
+        lib.fdbtpu_transaction_get_read_version.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+        lib.fdbtpu_transaction_set_option.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
         lib.fdbtpu_transaction_commit.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
         lib.fdbtpu_transaction_on_error.argtypes = [ctypes.c_void_p,
@@ -109,6 +121,50 @@ class CTransaction:
 
     def clear(self, key: bytes) -> None:
         _check(_lib.fdbtpu_transaction_clear(self._h, key, len(key)))
+
+    def get_range(self, begin: bytes, end: bytes, limit: int = 0,
+                  reverse: bool = False) -> list[tuple[bytes, bytes]]:
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        blen = ctypes.c_int()
+        count = ctypes.c_int()
+        _check(_lib.fdbtpu_transaction_get_range(
+            self._h, begin, len(begin), end, len(end), limit,
+            1 if reverse else 0, ctypes.byref(buf), ctypes.byref(blen),
+            ctypes.byref(count)))
+        raw = ctypes.string_at(buf, blen.value) if blen.value else b""
+        # the C side mallocs even for empty results: free unconditionally
+        _lib.fdbtpu_free(buf)
+        out: list[tuple[bytes, bytes]] = []
+        pos = 0
+        import struct
+        for _ in range(count.value):
+            (klen,) = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            k = raw[pos:pos + klen]
+            pos += klen
+            (vlen,) = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            v = raw[pos:pos + vlen]
+            pos += vlen
+            out.append((k, v))
+        return out
+
+    def atomic_op(self, op: int, key: bytes, operand: bytes) -> None:
+        _check(_lib.fdbtpu_transaction_atomic_op(
+            self._h, op, key, len(key), operand, len(operand)))
+
+    def add(self, key: bytes, operand: bytes) -> None:
+        self.atomic_op(2, key, operand)            # MutationType.ADD
+
+    def get_read_version(self) -> int:
+        ver = ctypes.c_int64()
+        _check(_lib.fdbtpu_transaction_get_read_version(
+            self._h, ctypes.byref(ver)))
+        return ver.value
+
+    def set_option(self, option: str) -> None:
+        _check(_lib.fdbtpu_transaction_set_option(self._h,
+                                                  option.encode()))
 
     def commit(self) -> int:
         ver = ctypes.c_int64()

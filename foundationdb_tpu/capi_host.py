@@ -109,6 +109,59 @@ class Host:
     async def _sync(fn, *args):
         return fn(*args)
 
+    def txn_get_range(self, tid: int, begin: bytes, end: bytes,
+                      limit: int, reverse: int):
+        """-> (err, packed, count); packed = ([u32 klen][key][u32 vlen]
+        [value]) * count, little-endian — one flat buffer crossing the
+        ABI (the fdb_c FDBKeyValue array analog)."""
+        import struct
+        tr = self._txns[tid]
+        try:
+            rows = self._call(tr.get_range(begin, end, limit=limit,
+                                           reverse=bool(reverse)))
+        except BaseException as e:  # noqa: BLE001 — code crosses the ABI
+            return self._code(e), b"", 0
+        out = bytearray()
+        for k, v in rows:
+            k, v = bytes(k), bytes(v)
+            out += struct.pack("<I", len(k)) + k
+            out += struct.pack("<I", len(v)) + v
+        return 0, bytes(out), len(rows)
+
+    def txn_atomic_op(self, tid: int, op: int, key: bytes,
+                      operand: bytes) -> int:
+        from .core.data import ATOMIC_TYPES, MutationType
+        try:
+            mt = MutationType(op)
+        except ValueError:
+            return 2007  # invalid_option (unknown mutation opcode)
+        if mt not in ATOMIC_TYPES:
+            # SET_VALUE/CLEAR_RANGE ride their own entry points, and
+            # private opcodes (shard drops) must never cross the ABI —
+            # a forged one would be client-triggered data loss
+            return 2007
+        try:
+            self._call(self._sync(self._txns[tid].atomic_op, mt, key,
+                                  operand))
+        except BaseException as e:  # noqa: BLE001
+            return self._code(e)
+        return 0
+
+    def txn_get_read_version(self, tid: int):
+        """-> (err, version)"""
+        try:
+            v = self._call(self._txns[tid].get_read_version())
+        except BaseException as e:  # noqa: BLE001
+            return self._code(e), -1
+        return 0, v
+
+    def txn_set_option(self, tid: int, option: str) -> int:
+        """fdb_transaction_set_option analog (named, no packed ints)."""
+        if option == "lock_aware":
+            self._txns[tid].lock_aware = True
+            return 0
+        return 2007  # invalid_option
+
     def txn_commit(self, tid: int):
         """-> (err, committed_version)"""
         tr = self._txns[tid]

@@ -15,10 +15,10 @@ from ..core.cluster import Cluster
 from ..core.data import (CommitTransactionRequest, KeySelector, MutationType,
                          Version, key_after)
 from ..runtime.errors import (CommitUnknownResult, FdbError, InvalidOption,
-                              KeyTooLarge, RequestMaybeDelivered,
-                              TransactionCancelled, TransactionTooLarge,
-                              TransactionReadOnly, UsedDuringCommit,
-                              ValueTooLarge)
+                              KeyOutsideLegalRange, KeyTooLarge,
+                              RequestMaybeDelivered, TransactionCancelled,
+                              TransactionTooLarge, TransactionReadOnly,
+                              UsedDuringCommit, ValueTooLarge)
 from ..runtime.rng import deterministic_random
 from .writemap import WriteMap
 
@@ -73,6 +73,8 @@ class Transaction:
 
     async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
         self._check_mutable()
+        if key.startswith(b"\xff\xff"):
+            return await self._special_key(key)
         self._check_key(key)
         kind, payload = self._writes.lookup(key)
         if kind == "value" and not snapshot:
@@ -88,6 +90,39 @@ class Transaction:
         if kind == "stack":
             return WriteMap.fold_with_base(payload, base)
         return base
+
+    async def _special_key(self, key: bytes) -> bytes | None:
+        """The ``\\xff\\xff`` special-key space (REF:fdbclient/
+        SpecialKeySpace.actor.cpp): module-backed reads answered by the
+        client, not storage.  No read conflict is taken."""
+        if key == b"\xff\xff/status/json":
+            import json
+
+            from ..core.status import cluster_status
+            rdb = getattr(self, "_rdb", None)
+            if rdb is None:
+                from ..runtime.errors import ClientInvalidOperation
+                raise ClientInvalidOperation(
+                    "status json needs a coordinator-backed database")
+            doc = await cluster_status(self._cluster.knobs,
+                                       self._cluster.transport,
+                                       rdb.coordinators)
+            return json.dumps(
+                doc, sort_keys=True,
+                default=lambda o: (o.hex() if isinstance(o, (bytes,
+                                                             bytearray))
+                                   else str(o))).encode()
+        if key == b"\xff\xff/connection_string":
+            rdb = getattr(self, "_rdb", None)
+            if rdb is None or not getattr(rdb, "connection_string", None):
+                return None
+            return rdb.connection_string.encode()
+        from ..runtime.errors import ClientInvalidOperation
+        raise ClientInvalidOperation(f"unknown special key {key!r}")
+
+    async def get_addresses_for_key(self, key: bytes) -> list[str]:
+        from .locality import get_addresses_for_key
+        return await get_addresses_for_key(self, key)
 
     async def get_range(self, begin, end, limit: int = 0,
                         reverse: bool = False, snapshot: bool = False
@@ -389,6 +424,11 @@ class Transaction:
     def _check_key(self, key: bytes) -> None:
         if len(key) > self._knobs.KEY_SIZE_LIMIT:
             raise KeyTooLarge()
+        if key.startswith(b"\xff\xff"):
+            # the special-key space is module-backed and never stored
+            # (REF: keys at or above \xff\xff are outside the legal
+            # range); writes here would be unreachable through get()
+            raise KeyOutsideLegalRange()
 
 
 def _coalesce(ranges: list[tuple[bytes, bytes]]) -> list[tuple[bytes, bytes]]:

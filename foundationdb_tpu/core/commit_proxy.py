@@ -385,6 +385,12 @@ class CommitProxy:
                             from ..runtime.errors import DatabaseLocked
                             raise DatabaseLocked()
                 for m in req.mutations:
+                    if m.type == MutationType.PRIVATE_DROP_SHARD:
+                        # proxies append drop markers themselves after
+                        # tagging; one arriving IN a client request is
+                        # forged and would discard a shard
+                        raise ClientInvalidOperation(
+                            "private mutation type in client commit")
                     self._substitute_versionstamp(m, 0, 0)
                 valid.append((req, fut))
             except Exception as pre_err:

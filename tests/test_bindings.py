@@ -97,6 +97,33 @@ def check2(tr):
     assert tr.get(b"bt1") is None
     assert tr.get(b"bt2") == b"v2"
 db.run(check2)
+
+def extended(tr):
+    # the v2 ABI surface: range reads, atomics, GRV, options
+    tr.set_option("lock_aware")
+    for i in range(5):
+        tr.set(b"rng%02d" % i, b"x%d" % i)
+    tr.add(b"ctr", (7).to_bytes(8, "little"))
+db.run(extended)
+
+def check3(tr):
+    rows = tr.get_range(b"rng", b"rng\\xff")
+    assert rows == [(b"rng%02d" % i, b"x%d" % i) for i in range(5)], rows
+    rev = tr.get_range(b"rng", b"rng\\xff", limit=2, reverse=True)
+    assert rev == [(b"rng04", b"x4"), (b"rng03", b"x3")], rev
+    assert tr.get(b"ctr") == (7).to_bytes(8, "little")
+    tr.add(b"ctr", (5).to_bytes(8, "little"))
+db.run(check3)
+
+def check4(tr):
+    assert tr.get(b"ctr") == (12).to_bytes(8, "little")
+    assert tr.get_read_version() > 0
+    try:
+        tr.set_option("no_such_option")
+        raise AssertionError("unknown option accepted")
+    except fdbtpu.FdbtpuError as e:
+        assert e.code == 2007, e.code
+db.run(check4)
 print("PY-OVER-C OK")
 '''
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)

@@ -1,0 +1,82 @@
+"""Locality API + special-key space.
+
+Reference test model: REF:bindings/python/fdb/locality.py
+(get_addresses_for_key / get_boundary_keys) and
+REF:fdbclient/SpecialKeySpace.actor.cpp (\\xff\\xff reads answered by
+the client).
+"""
+
+from __future__ import annotations
+
+import json
+
+from foundationdb_tpu.client.locality import (get_addresses_for_key,
+                                              get_boundary_keys)
+from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+from foundationdb_tpu.runtime.errors import ClientInvalidOperation
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+
+def test_addresses_and_boundaries_match_cluster_state():
+    async def main():
+        sim = SimulatedCluster(Knobs(), n_machines=5,
+                               spec=ClusterConfigSpec(min_workers=5,
+                                                      replication=2))
+        await sim.start()
+        state = await sim.wait_epoch(1)
+        db = await sim.database()
+
+        tr = db.create_transaction()
+        addrs = await tr.get_addresses_for_key(b"some-key")
+        # replication=2: the team serving the key has two distinct
+        # replicas, and every address is a real storage address from the
+        # published state
+        assert len(addrs) == 2 and len(set(addrs)) == 2, addrs
+        published = {f"{s['addr'][0]}:{s['addr'][1]}"
+                     for s in state["storage"]}
+        assert set(addrs) <= published, (addrs, published)
+        # the module-level variant agrees
+        assert await get_addresses_for_key(tr, b"some-key") == addrs
+
+        # boundary keys cover the whole space and respect the window
+        bounds = await get_boundary_keys(db, b"", b"\xff")
+        assert bounds and bounds[0] == b""
+        assert bounds == sorted(bounds)
+        sub = await get_boundary_keys(db, b"m", b"\xff")
+        assert all(b"m" <= k < b"\xff" for k in sub)
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_status_json_special_key():
+    async def main():
+        sim = SimulatedCluster(Knobs(), n_machines=4,
+                               spec=ClusterConfigSpec(min_workers=4))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+
+        async def w(tr):
+            tr.set(b"k", b"v")
+        await db.run(w)
+
+        tr = db.create_transaction()
+        raw = await tr.get(b"\xff\xff/status/json")
+        doc = json.loads(raw)
+        roles = {r["role"] for r in doc["roles"]}
+        assert {"sequencer", "log", "resolver", "storage"} <= roles, roles
+        # reading a special key must not poison the transaction: a
+        # normal read-write commit still works on the same txn
+        tr.set(b"after-status", b"1")
+        await tr.commit()
+
+        tr = db.create_transaction()
+        try:
+            await tr.get(b"\xff\xff/no/such/module")
+            raise AssertionError("unknown special key did not raise")
+        except ClientInvalidOperation:
+            pass
+        await sim.stop()
+    run_simulation(main())

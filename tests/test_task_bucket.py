@@ -1,0 +1,146 @@
+"""TaskBucket/FutureBucket — durable task scheduling semantics.
+
+Reference test model: REF:fdbclient/TaskBucket.actor.cpp — concurrent
+agents never double-claim, a crashed agent's lease expires back to
+available (at-least-once), and future-parked tasks run only after the
+future fires, surviving through the keyspace rather than agent memory.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from foundationdb_tpu.backup.task_bucket import (FutureBucket, TaskBucket,
+                                                 task_agent)
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+def _cluster_main(body):
+    async def main():
+        async with Cluster(ClusterConfig(commit_proxies=2, resolvers=2,
+                                         storage_servers=2),
+                           Knobs()) as cluster:
+            await body(Database(cluster))
+    run_simulation(main())
+
+
+def test_concurrent_agents_execute_each_task_once():
+    async def body(db):
+        bucket = TaskBucket(db, b"tb1/", lease_seconds=30.0)
+        done: list[int] = []
+
+        async def handler(params):
+            await asyncio.sleep(0.01)
+            done.append(params["i"])
+        for i in range(12):
+            await bucket.add_task({"type": "t", "i": i})
+        agents = [asyncio.get_running_loop().create_task(
+            task_agent(bucket, {"t": handler})) for _ in range(3)]
+        while not await bucket.is_empty():
+            await asyncio.sleep(0.05)
+        for a in agents:
+            a.cancel()
+        await asyncio.gather(*agents, return_exceptions=True)
+        assert sorted(done) == list(range(12)), sorted(done)
+    _cluster_main(body)
+
+
+def test_expired_lease_requeues_crashed_agents_task():
+    async def body(db):
+        bucket = TaskBucket(db, b"tb2/", lease_seconds=0.05)
+        await bucket.add_task({"type": "t", "i": 1})
+        got = await bucket.get_one()
+        assert got is not None
+        tid, params = got
+        # the "agent" dies here: no extend, no finish.  Let the version
+        # clock pass the lease (commits advance the committed version).
+        for _ in range(3):
+            await asyncio.sleep(0.1)
+
+            async def tick(tr):
+                tr.set(b"tick", b"1")
+            await db.run(tick)
+        n = await bucket.requeue_expired()
+        assert n >= 1
+        got2 = await bucket.get_one()
+        assert got2 is not None and got2[1] == params
+        await bucket.finish(got2[0])
+        assert await bucket.is_empty()
+    _cluster_main(body)
+
+
+def test_future_parks_task_until_set():
+    async def body(db):
+        bucket = TaskBucket(db, b"tb3/", lease_seconds=30.0)
+        done: list[str] = []
+
+        async def handler(params):
+            done.append(params["name"])
+
+        async def setup(tr):
+            bucket.futures.create(tr, b"f1")
+            await bucket.add(tr, {"type": "t", "name": "dependent"},
+                             after=b"f1")
+            await bucket.add(tr, {"type": "t", "name": "free"})
+        await db.run(setup)
+
+        agent = asyncio.get_running_loop().create_task(
+            task_agent(bucket, {"t": handler}))
+        while "free" not in done:
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.3)
+        assert done == ["free"], done          # dependent still parked
+
+        await bucket.futures.set(b"f1")
+        while "dependent" not in done:
+            await asyncio.sleep(0.05)
+        while not await bucket.is_empty():
+            await asyncio.sleep(0.05)
+        agent.cancel()
+        await asyncio.gather(agent, return_exceptions=True)
+        assert sorted(done) == ["dependent", "free"]
+    _cluster_main(body)
+
+
+def test_add_after_already_fired_future_runs_immediately():
+    """A task added AFTER its future fired must not strand in park/
+    forever: add() reads the future in the same transaction and routes
+    straight to available."""
+    async def body(db):
+        bucket = TaskBucket(db, b"tb5/", lease_seconds=30.0)
+
+        async def setup(tr):
+            bucket.futures.create(tr, b"done-fut")
+        await db.run(setup)
+        await bucket.futures.set(b"done-fut")
+
+        await bucket.add_task({"type": "t", "n": 1}, after=b"done-fut")
+        got = await bucket.get_one()
+        assert got is not None and got[1] == {"type": "t", "n": 1}
+        await bucket.finish(got[0])
+        assert await bucket.is_empty()
+    _cluster_main(body)
+
+
+def test_lease_extension_keeps_task_claimed():
+    async def body(db):
+        bucket = TaskBucket(db, b"tb4/", lease_seconds=0.2)
+        await bucket.add_task({"type": "t", "i": 9})
+        got = await bucket.get_one()
+        assert got is not None
+        for _ in range(4):
+            await asyncio.sleep(0.1)
+            assert await bucket.extend(got[0])
+
+            async def tick(tr):
+                tr.set(b"tick4", b"1")
+            await db.run(tick)
+            await bucket.requeue_expired()
+        # never expired: still claimed, nothing available
+        assert await bucket.get_one() is None
+        await bucket.finish(got[0])
+        assert await bucket.is_empty()
+    _cluster_main(body)
