@@ -20,24 +20,34 @@ public final class Transaction implements AutoCloseable {
         if (code != 0) throw new FDBException(code, FDBTPU.getError(code));
     }
 
+    private void ensureOpen() {
+        // the native handle is freed by close(); passing it afterwards
+        // would dereference freed memory in the JNI layer
+        if (closed) throw new IllegalStateException("transaction closed");
+    }
+
     /** null when the key is absent. */
     public byte[] get(byte[] key) {
+        ensureOpen();
         byte[] out = FDBTPU.transactionGet(handle, key);
         check(FDBTPU.lastError());
         return out;
     }
 
     public void set(byte[] key, byte[] value) {
+        ensureOpen();
         check(FDBTPU.transactionSet(handle, key, value));
     }
 
     public void clear(byte[] key) {
+        ensureOpen();
         check(FDBTPU.transactionClear(handle, key));
     }
 
     /** Decoded range read; limit 0 = unlimited. */
     public List<KeyValue> getRange(byte[] begin, byte[] end, int limit,
                                    boolean reverse) {
+        ensureOpen();
         byte[] packed = FDBTPU.transactionGetRange(handle, begin, end,
                                                    limit, reverse);
         check(FDBTPU.lastError());
@@ -55,10 +65,12 @@ public final class Transaction implements AutoCloseable {
     }
 
     public void mutate(MutationType op, byte[] key, byte[] operand) {
+        ensureOpen();
         check(FDBTPU.transactionAtomicOp(handle, op.code(), key, operand));
     }
 
     public long getReadVersion() {
+        ensureOpen();
         long v = FDBTPU.transactionGetReadVersion(handle);
         check(FDBTPU.lastError());
         return v;
@@ -66,17 +78,20 @@ public final class Transaction implements AutoCloseable {
 
     /** Named option, e.g. "lock_aware". */
     public void setOption(String option) {
+        ensureOpen();
         check(FDBTPU.transactionSetOption(handle, option));
     }
 
     /** Returns the committed version. */
     public long commit() {
+        ensureOpen();
         long v = FDBTPU.transactionCommit(handle);
         check(FDBTPU.lastError());
         return v;
     }
 
     public void reset() {
+        ensureOpen();
         check(FDBTPU.transactionReset(handle));
     }
 

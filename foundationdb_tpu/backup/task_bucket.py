@@ -54,20 +54,57 @@ class FutureBucket:
         return await self.db.run(go)
 
     async def set(self, fid: bytes) -> None:
-        """Fire the future: parked tasks move to the available set in
-        the SAME transaction — a crash can never strand or duplicate
-        them."""
+        """Fire the future, then release its parked tasks in bounded
+        chunks (one unbounded move could exceed the transaction size
+        limit and make the future permanently unsettable).  The flag
+        commits FIRST, so concurrent add(after=fid) routes straight to
+        available and never parks into a drained area; a crash
+        mid-drain leaves parked tasks under a set future, which
+        ``sweep_fired`` (run by every agent alongside requeue_expired)
+        self-heals."""
+        async def flag(tr):
+            tr.lock_aware = True
+            tr.set(self._key(fid), b"1")
+        await self.db.run(flag)
+        while await self._drain_parked(fid):
+            pass
+
+    async def _drain_parked(self, fid: bytes, limit: int = 100) -> int:
         park = self.prefix + b"park/" + fid + b"/"
 
         async def go(tr):
             tr.lock_aware = True
-            tr.set(self._key(fid), b"1")
-            parked = await tr.get_range(park, park + b"\xff", limit=0)
+            parked = await tr.get_range(park, park + b"\xff", limit=limit)
             for k, v in parked:
                 suffix = bytes(k)[len(park):]
                 tr.set(self.prefix + b"avail/" + suffix, bytes(v))
                 tr.clear(bytes(k))
-        await self.db.run(go)
+            return len(parked)
+        return await self.db.run(go)
+
+    async def sweep_fired(self, limit: int = 50) -> int:
+        """Release tasks parked under ALREADY-SET futures (a crash
+        between set()'s flag and its drain leaves them).  Any agent may
+        run this; bounded per call."""
+        park_all = self.prefix + b"park/"
+
+        async def find(tr):
+            return await tr.get_range(park_all, park_all + b"\xff",
+                                      limit=limit)
+        rows = await self.db.run(find)
+        moved = 0
+        seen: set[bytes] = set()
+        for k, _v in rows:
+            body = bytes(k)[len(park_all):]
+            # layout: <fid> b"/" <10B stamp + 2B nonce>; the stamp may
+            # contain 0x2f, so strip the fixed-length suffix positionally
+            fid = body[:-13]
+            if fid in seen:
+                continue
+            seen.add(fid)
+            if await self.is_set(fid):
+                moved += await self._drain_parked(fid)
+        return moved
 
 
 class TaskBucket:
@@ -80,6 +117,8 @@ class TaskBucket:
         self.prefix = prefix
         self.lease_versions = int(lease_seconds * versions_per_second)
         self.futures = FutureBucket(db, prefix)
+        import itertools
+        self._nonce = itertools.count()
 
     # --- producers ---
 
@@ -97,11 +136,17 @@ class TaskBucket:
             if fired == b"1":
                 after = None
         if after is None:
-            key = self.prefix + b"avail/" + b"\x00" * 10
+            base = self.prefix + b"avail/"
         else:
-            key = self.prefix + b"park/" + after + b"/" + b"\x00" * 10
+            base = self.prefix + b"park/" + after + b"/"
+        # every mutation in one transaction receives the SAME
+        # (version, order) stamp, so two add()s in one txn would collide
+        # on the bare stamp — a per-bucket nonce after the placeholder
+        # disambiguates while keeping commit order as key order
+        seq = (next(self._nonce) & 0xFFFF).to_bytes(2, "big")
+        key = base + b"\x00" * 10 + seq
         tr.set_versionstamped_key(
-            key + (len(key) - 10).to_bytes(4, "little"), blob)
+            key + len(base).to_bytes(4, "little"), blob)
 
     async def add_task(self, params: dict, after: bytes | None = None) -> None:
         async def go(tr):
@@ -178,6 +223,11 @@ class TaskBucket:
             TraceEvent("TaskBucketRequeued").detail("Count", n).log()
         return n
 
+    async def sweep_fired(self, limit: int = 50) -> int:
+        """Release tasks parked under already-set futures (see
+        FutureBucket.sweep_fired — run by every agent)."""
+        return await self.futures.sweep_fired(limit)
+
     async def is_empty(self) -> bool:
         a, b = self.prefix + b"avail/", self.prefix + b"busy/"
 
@@ -199,10 +249,16 @@ async def task_agent(bucket: TaskBucket, handlers: dict,
     while True:
         try:
             await bucket.requeue_expired()
+            await bucket.sweep_fired()
             got = await bucket.get_one()
         except asyncio.CancelledError:
             raise
-        except FdbError:
+        except Exception as e:  # noqa: BLE001 — an agent must not die silently
+            if not isinstance(e, FdbError):
+                # a programming error would otherwise kill the agent
+                # TASK invisibly (create_task swallows it until gather)
+                TraceEvent("TaskAgentError", severity=40) \
+                    .detail("Error", repr(e)[:200]).log()
             await asyncio.sleep(idle_sleep)
             continue
         if got is None:

@@ -32,12 +32,23 @@ class _CliDatabase:
     def __init__(self, cli: "Cli") -> None:
         self._cli = cli
 
+    @property
+    def view(self):
+        return self._cli.view
+
+    @property
+    def coordinators(self):
+        return self._cli.coordinators
+
     def create_transaction(self):
         from .client.transaction import Transaction
         return Transaction(self._cli.view)
 
     async def run(self, fn, max_retries=None):
         return await self._cli.run_txn(fn)
+
+    async def get(self, key):
+        return await self.run(lambda tr: tr.get(key))
 
     async def set(self, key, value):
         async def go(tr):
@@ -57,6 +68,7 @@ class Cli:
 
     async def run_txn(self, fn):
         tr = Transaction(self.view)
+        refreshed_for: set[int] = set()
         while True:
             try:
                 out = await fn(tr)
@@ -66,10 +78,28 @@ class Cli:
                 try:
                     await tr.on_error(e)
                 except FdbError:
-                    await self.refresh()
-                    tr = Transaction(self.view)
+                    # one refresh per distinct non-retryable code covers
+                    # stale-view errors; a repeat of any already-refreshed
+                    # code is real (e.g. database_locked) and must
+                    # surface, not spin
+                    if e.code in refreshed_for:
+                        raise
+                    refreshed_for.add(e.code)
+                # EVERY retry follows recoveries: a retryable error
+                # (endpoint_not_found, connection_failed) against a
+                # stale view would otherwise loop forever dialing the
+                # previous epoch's dead endpoints (the
+                # _RefreshingTransaction contract)
+                await self.refresh()
+                tr = Transaction(self.view)
 
     async def execute(self, line: str) -> str:
+        try:
+            return await self._execute(line)
+        except FdbError as e:
+            return f"ERROR: {e.name} ({e.code})"
+
+    async def _execute(self, line: str) -> str:
         parts = shlex.split(line)
         if not parts:
             return ""
@@ -110,6 +140,61 @@ class Cli:
             to_version = int(args[1]) if len(args) > 1 else None
             m = await agent.restore(to_version=to_version)
             return f"Restore complete: {m.rows} rows (snapshot version {m.version})"
+        if cmd == "lock":
+            from .core.management import (DatabaseLockedByOther,
+                                          lock_database)
+            import os as _os
+            uid = args[0].encode() if args else _os.urandom(8).hex().encode()
+            try:
+                await lock_database(_CliDatabase(self), uid)
+            except DatabaseLockedByOther:
+                return "ERROR: locked under a different uid"
+            return f"Database locked (uid {uid.decode()})"
+        if cmd == "unlock":
+            if not args:
+                return "ERROR: unlock <uid>"
+            from .core.management import (DatabaseLockedByOther,
+                                          unlock_database)
+            try:
+                await unlock_database(_CliDatabase(self), args[0].encode())
+            except DatabaseLockedByOther:
+                return "ERROR: locked under a different uid"
+            return "Database unlocked"
+        if cmd == "dr":
+            # fdbdr analog: dr start <dest_cluster_file> | dr status |
+            # dr switch | dr abort.  The stream runs for the life of this
+            # CLI session (the reference runs a separate dr_agent daemon;
+            # here the session hosts it).
+            from .backup.dr import DRAgent
+            sub = args[0] if args else "status"
+            if sub == "start":
+                if len(args) < 2:
+                    return "ERROR: dr start <dest_cluster_file>"
+                cur = getattr(self, "_dr", None)
+                if cur is not None and cur._task is not None \
+                        and not cur._task.done():
+                    return ("ERROR: a DR is already running in this "
+                            "session (dr abort/switch first)")
+                dest = await open_cli(args[1], self.knobs)
+                self._dr = DRAgent(_CliDatabase(self), _CliDatabase(dest))
+                v0 = await self._dr.start()
+                return f"DR started (snapshot version {v0})"
+            dr = getattr(self, "_dr", None)
+            if dr is None:
+                return "ERROR: no DR running in this session"
+            if sub == "status":
+                st = await dr.status()
+                return (f"running: {st['running']}  applied: "
+                        f"{st['applied_through']}  lag: "
+                        f"{st['lag_versions']} versions")
+            if sub == "switch":
+                vd = await dr.switchover()
+                return (f"Switchover complete at version {vd}: destination "
+                        f"is primary; source locked")
+            if sub == "abort":
+                await dr.abort()
+                return "DR aborted (destination keeps its prefix)"
+            return f"ERROR: unknown dr subcommand `{sub}'"
         if cmd in ("exclude", "include"):
             from .core import management
 

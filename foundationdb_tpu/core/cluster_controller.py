@@ -68,6 +68,10 @@ class ClusterController:
         self.spec = spec
         self.base = base_token
         self.fm = FailureMonitor(transport, knobs)
+        # replicas proven lost (their registered worker disowned the
+        # token) — dropped from recovery planning; address liveness alone
+        # can never retire them because the respawned process stays alive
+        self.dead_replicas: set[tuple[tuple, int]] = set()
         self.epoch = 0
         self.recovery_state = "READING_CSTATE"
         self.last_state: dict | None = None
@@ -312,6 +316,19 @@ class ClusterController:
             shard_map = ShardMap([bytes(b) for b in boundaries],
                                  [list(t) for t in teams])
             prev_by_tag = {s["tag"]: s for s in prev_storage}
+            # what each REGISTERED worker actually hosts right now: a
+            # respawned incarnation at a live address silently dropped
+            # every pre-crash role; catching that HERE drops the corpse
+            # replica in this attempt instead of failing pass-2 rejoin
+            # and cascading another whole epoch
+            hosted: dict[NetworkAddress, set[int]] = {}
+            for hwa, hw in list(self.workers.items()):
+                try:
+                    roles = await asyncio.wait_for(
+                        hw.list_roles(), timeout=k.FAILURE_TIMEOUT)
+                    hosted[hwa] = {int(t) for t, _ in roles}
+                except (FdbError, asyncio.TimeoutError, OSError):
+                    continue        # unknown: keep legacy behavior
             rejoined: set[int] = set()
             si = 0
             for rng, team in shard_map.ranges():
@@ -344,6 +361,24 @@ class ClusterController:
                             TraceEvent("StorageAdopted") \
                                 .detail("Tag", tag) \
                                 .detail("Worker", str(res[0])).log()
+                        if wa in hosted and s["token"] not in hosted[wa] \
+                                and self.resident.get(tag) is None:
+                            # the registered worker disowns the token and
+                            # no durable copy reported resident: lost
+                            self.dead_replicas.add((tuple(s["addr"]),
+                                                    s["token"]))
+                        if (tuple(s["addr"]), s["token"]) in \
+                                self.dead_replicas:
+                            # a confirmed-lost replica (its live worker
+                            # disowned the token): drop it from the team
+                            # — reads fail over to the survivors, and a
+                            # future resident report at a NEW token can
+                            # still be adopted above
+                            TraceEvent("StorageReplicaDropped",
+                                       severity=30) \
+                                .detail("Tag", tag) \
+                                .detail("Addr", str(s["addr"])).log()
+                            continue
                         storage_meta.append(s)
                         w = self.workers.get(wa)
                         if w is None:
@@ -467,10 +502,16 @@ class ClusterController:
                     w.rejoin_storage(s["token"], wire_log_cfg, rv),
                     timeout=k.FAILURE_TIMEOUT * 4)
                 if not ok:
-                    # the worker no longer hosts that token (a rebooted
-                    # incarnation): the resident report enables adoption
-                    # at the next epoch
-                    raise FdbError("storage role missing at token")
+                    # the registered worker no longer hosts that token:
+                    # a respawned incarnation whose (non-durable) replica
+                    # died with the old process.  The ADDRESS stays alive
+                    # forever, so address-level liveness will never
+                    # retire this entry — without marking the REPLICA
+                    # dead, every epoch re-plans the corpse and recovery
+                    # loops for good (a durable copy instead re-reports
+                    # residency and is adopted, never reaching here).
+                    self.dead_replicas.add((tuple(s["addr"]), s["token"]))
+                    raise FdbError("storage replica lost (token gone)")
                 active_tags.add(s["tag"])
             except (FdbError, asyncio.TimeoutError) as e:
                 TraceEvent("StorageRejoinFailed", severity=30) \
@@ -618,6 +659,11 @@ class ClusterController:
             self._recovery_requested.clear()
             waiters.append(asyncio.ensure_future(
                 self._recovery_requested.wait()))
+            # role-ENDPOINT liveness: a supervisor-respawned process
+            # answers address pings while its recruited endpoints are
+            # gone — the address watch above never fires, yet the epoch
+            # cannot commit (every push gets endpoint_not_found)
+            waiters.append(asyncio.ensure_future(self._probe_roles(state)))
             try:
                 done, pending = await asyncio.wait(
                     waiters, return_when=asyncio.FIRST_COMPLETED)
@@ -626,6 +672,50 @@ class ClusterController:
                     w.cancel()
                 await asyncio.gather(*waiters, return_exceptions=True)
             TraceEvent("TxnRoleFailed").detail("Epoch", self.epoch).log()
+
+    async def _probe_roles(self, state: dict) -> None:
+        """Ping each recruited txn role's block-level liveness slot
+        (serve_role's base + TOKEN_BLOCK - 1); returning completes the
+        run() watch and starts a recovery.  Two consecutive
+        endpoint_not_found answers mean the role instance is gone even
+        though its process is reachable (crash + supervisor respawn
+        between recruitment and now).  Connection-level failures stay
+        the FailureMonitor's job."""
+        from ..rpc.stubs import TOKEN_BLOCK
+        from ..rpc.transport import Endpoint
+        targets: list[tuple[tuple, int | None]] = [
+            (tuple(state["sequencer"]["addr"]), state["sequencer"]["token"])]
+        gen = state["log_cfg"][-1]
+        toks = gen.get("token") or [None] * len(gen["tlogs"])
+        targets += [(tuple(a), t) for a, t in zip(gen["tlogs"], toks)]
+        targets += [(tuple(r["addr"]), r["token"])
+                    for r in state["resolvers"]]
+        targets += [(tuple(p["addr"]), p["token"]) for p in
+                    state["commit_proxies"] + state["grv_proxies"]]
+        strikes: dict[tuple, int] = {}
+        while True:
+            await asyncio.sleep(self.knobs.FAILURE_TIMEOUT)
+            for addr, tok in targets:
+                if tok is None:
+                    continue
+                ep = Endpoint(NetworkAddress(*addr),
+                              tok + TOKEN_BLOCK - 1)
+                try:
+                    await asyncio.wait_for(
+                        self.transport.request(ep, []),
+                        timeout=self.knobs.FAILURE_TIMEOUT)
+                    strikes[(addr, tok)] = 0
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — classify by code
+                    if getattr(e, "code", None) == 1012:
+                        n = strikes.get((addr, tok), 0) + 1
+                        strikes[(addr, tok)] = n
+                        if n >= 2:
+                            TraceEvent("RoleEndpointLost", severity=30) \
+                                .detail("Addr", str(addr)) \
+                                .detail("Token", tok).log()
+                            return
 
     async def stop(self) -> None:
         self._stopped = True

@@ -136,6 +136,17 @@ class Coordinator:
             TraceEvent("CoordLeaderChange").detail("Leader", candidate_id).log()
         return self._leader.leader_id, self._leader.address
 
+    async def read_leader(self) -> tuple[int, Any] | None:
+        """Read-only leader query (the reference's monitorLeader side):
+        returns the CURRENT unexpired leader or None — never grants.
+        Candidacy-on-read is what seeds leader ping-pong: a respawned
+        (empty) coordinator would grant to the first caller while the
+        quorum still honors the incumbent's lease."""
+        now = asyncio.get_running_loop().time()
+        if self._leader is not None and now < self._leader.lease_end:
+            return self._leader.leader_id, self._leader.address
+        return None
+
     async def leader_heartbeat(self, candidate_id: int) -> bool:
         """Renew the lease; False tells a deposed leader to stand down."""
         now = asyncio.get_running_loop().time()
@@ -215,9 +226,30 @@ class CoordinatedState:
 
 async def elect_leader(coordinators: list, candidate_id: int, address: Any,
                        knobs: Knobs) -> tuple[int, Any]:
-    """One candidacy round against a majority; returns the winning
-    (leader_id, address) the quorum agrees on (ties broken by count,
-    then lowest id — deterministic)."""
+    """Find (or become) the leader.
+
+    Phase 0 — read-only: if a MAJORITY already agrees on a live leader,
+    follow it without nominating.  Nominating unconditionally lets a
+    freshly-restarted coordinator (empty register) grant its slot to
+    whichever bystander asks first, seeding split grants and leadership
+    ping-pong while the incumbent is perfectly healthy.
+
+    Phase 1 — candidacy, only when no live-leader majority exists:
+    returns the winning (leader_id, address) the quorum agrees on (ties
+    broken by count, then lowest id — deterministic)."""
+    reads = await asyncio.gather(*(c.read_leader() for c in coordinators),
+                                 return_exceptions=True)
+    tally0: dict[tuple[int, Any], int] = {}
+    for r in reads:
+        if isinstance(r, BaseException) or r is None:
+            continue
+        a = r[1]
+        key = (r[0], tuple(a) if isinstance(a, list) else a)
+        tally0[key] = tally0.get(key, 0) + 1
+    if tally0:
+        (lid, laddr), votes = max(tally0.items(), key=lambda kv: kv[1])
+        if votes >= len(coordinators) // 2 + 1:
+            return lid, laddr
     results = await asyncio.gather(
         *(c.candidacy(candidate_id, address) for c in coordinators),
         return_exceptions=True)

@@ -37,6 +37,12 @@ class Monitor:
         g = cp["general"] if "general" in cp else {}
         self.cluster_file = g.get("cluster-file", "fdb.cluster")
         self.restart_delay = float(g.get("restart-delay", 2.0))
+        # children write to per-server log files (the reference
+        # fdbmonitor's logdir), NEVER to the monitor's own stdout: an
+        # inherited pipe nobody drains blocks the servers at 64KB and
+        # wedges the whole cluster mid-recovery
+        self.logdir = g.get("logdir", "") or os.path.dirname(
+            os.path.abspath(conf_path))
         self.servers: list[dict] = []
         for section in cp.sections():
             if not section.startswith("fdbserver."):
@@ -60,9 +66,16 @@ class Monitor:
             cmd += ["--spec", srv["spec"]]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
-        self.procs[srv["id"]] = subprocess.Popen(cmd, env=env)
+        log_path = os.path.join(self.logdir, f"fdbserver.{srv['id']}.log")
+        log = open(log_path, "ab")
+        try:
+            self.procs[srv["id"]] = subprocess.Popen(
+                cmd, env=env, stdout=log, stderr=subprocess.STDOUT)
+        finally:
+            log.close()      # the child holds its own fd now
         print(f"[fdbmonitor] started fdbserver.{srv['id']} "
-              f"pid={self.procs[srv['id']].pid}", file=sys.stderr, flush=True)
+              f"pid={self.procs[srv['id']].pid} log={log_path}",
+              file=sys.stderr, flush=True)
 
     def run(self) -> int:
         for sig in (signal.SIGINT, signal.SIGTERM):

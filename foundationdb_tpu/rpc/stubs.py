@@ -34,7 +34,7 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
     "ratekeeper": [("admit", False), ("get_rate", False)],
     "coordinator": [("read", False), ("write", False),
                     ("candidacy", False), ("leader_heartbeat", False),
-                    ("open_database", False)],
+                    ("open_database", False), ("read_leader", False)],
     "worker": [("recruit", False), ("stop_role", False),
                ("rejoin_storage", False), ("list_roles", False)],
     "cluster_controller": [("register_worker", False),
@@ -47,7 +47,13 @@ TOKEN_BLOCK = 16  # tokens reserved per role instance
 
 def serve_role(transport: Transport, role: str, obj: Any,
                base_token: int) -> None:
-    """Register obj's role methods at base_token + method index."""
+    """Register obj's role methods at base_token + method index, plus a
+    role-liveness ping at the block's LAST token (base + TOKEN_BLOCK-1).
+    The ping answers only while THIS role instance is registered — a
+    process that crashed and was respawned by its supervisor answers
+    address-level pings fine while its recruited role endpoints are
+    gone; the cluster controller probes this slot to tell the two
+    apart (the reference's waitFailureClient on role interfaces)."""
     for i, (name, _oneway) in enumerate(ROLE_METHODS[role]):
         method = getattr(obj, name)
 
@@ -57,6 +63,15 @@ def serve_role(transport: Transport, role: str, obj: Any,
                 result = await result
             return result
         transport.dispatcher.register(handler, token=base_token + i)
+
+    async def role_ping(_args, role=role):
+        return role
+    ping_token = base_token + TOKEN_BLOCK - 1
+    # static layouts (worker block + CC surface sharing one block) may
+    # overlap; the probe only targets RECRUITED role blocks, which are
+    # always distinct
+    if ping_token not in transport.dispatcher._handlers:
+        transport.dispatcher.register(role_ping, token=ping_token)
 
 
 class RoleClient:

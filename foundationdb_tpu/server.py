@@ -119,7 +119,16 @@ async def run_server(cluster_file: str, listen: str, spec: ClusterConfigSpec,
 
 def main(argv=None) -> int:
     args, knob_overrides = parse_args(argv if argv is not None else sys.argv[1:])
-    knobs = Knobs().set_from_strings(knob_overrides)
+    # Real-TCP deployments run on wall clocks with real scheduling
+    # stalls: a neighbor process's startup burst (JAX import alone costs
+    # seconds of CPU) can starve the controller's heartbeat loop past
+    # the sim-tuned 2s lease, churning leadership exactly when a crashed
+    # server respawns.  Production-grade leases absorb such pauses; the
+    # sim keeps the short ones for fast deterministic failover tests.
+    # Explicit --knob overrides still win.
+    knobs = Knobs().override(LEADER_LEASE_DURATION=8.0,
+                             FAILURE_TIMEOUT=2.0)
+    knobs = knobs.set_from_strings(knob_overrides)
     spec = parse_spec(args.spec)
     tls = None
     if args.tls_cert:

@@ -34,6 +34,8 @@ async def simulate(seed: int, kills: int, buggify: bool) -> dict:
         {"testName": "Serializability", "numOps": 40},
         {"testName": "AtomicOps", "addsPerClient": 15},
         {"testName": "ConflictRange", "nodeCount": 8, "opsPerClient": 15},
+        {"testName": "Increment", "incrementsPerClient": 10},
+        {"testName": "VersionStamp", "stampsPerClient": 8},
         {"testName": "Watches", "rounds": 3, "strictFires": False},
         {"testName": "ConfigureDatabase", "sim": sim, "rounds": 2,
          "secondsBetweenChanges": 2.5},

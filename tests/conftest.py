@@ -23,17 +23,26 @@ jax.config.update("jax_enable_x64", True)   # conflict versions are int64
 
 # Per-test hang watchdog: a wedged test dumps every thread's stack and
 # kills the run instead of stalling CI silently (pytest-timeout is not in
-# this image; faulthandler is stdlib).
+# this image; faulthandler is stdlib).  The dump goes to a REAL file:
+# under pytest capture, sys.stderr is a temp buffer that os._exit throws
+# away — a dump written there vanishes and the kill looks like a silent
+# exit(1) with no summary.
 import faulthandler
 
 import pytest
 
 _TEST_TIMEOUT_S = 600.0
+_WATCHDOG_PATH = os.environ.get("FDBTPU_WATCHDOG_FILE",
+                                "/tmp/fdbtpu_watchdog.txt")
+_WATCHDOG_FILE = open(_WATCHDOG_PATH, "a")
 
 
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_call(item):
-    faulthandler.dump_traceback_later(_TEST_TIMEOUT_S, exit=True)
+    _WATCHDOG_FILE.write(f"=== arming for {item.nodeid}\n")
+    _WATCHDOG_FILE.flush()
+    faulthandler.dump_traceback_later(_TEST_TIMEOUT_S, exit=True,
+                                      file=_WATCHDOG_FILE)
     try:
         yield
     finally:

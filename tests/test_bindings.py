@@ -32,21 +32,22 @@ def live_cluster(tmp_path_factory):
     cf_path = tmp_path_factory.mktemp("bind") / "fdb.cluster"
     cf.save(str(cf_path))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
-    procs = [subprocess.Popen(
+    from test_server import spawn_server
+    logdir = cf_path.parent
+    procs = [spawn_server(
         [sys.executable, "-m", "foundationdb_tpu.server",
          "-C", str(cf_path), "-l", f"127.0.0.1:{p}",
-         "--spec", "min_workers=3"],
-        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+         "--spec", "min_workers=3"], logdir / f"server-{p}.log", env)
         for p in ports]
     yield str(cf_path)
     for pr in procs:
         pr.send_signal(signal.SIGTERM)
     for pr in procs:
         try:
-            pr.communicate(timeout=10)
+            pr.wait(timeout=10)
         except subprocess.TimeoutExpired:
             pr.kill()
-            pr.communicate()
+            pr.wait()
 
 
 def test_c_abi_smoke_program(live_cluster, tmp_path):

@@ -365,3 +365,84 @@ def test_source_engine_gc_after_live_split():
         assert checked >= 2, "expected multiple durable storage engines"
         await sim.stop()
     run_simulation(main())
+
+
+def test_live_move_of_system_keyspace_shard():
+    """The LAST shard holds the \xff metadata.  Overfilling it forces a
+    live split whose right half — including the entire system keyspace —
+    moves to a fresh team.  The cluster must keep serving, metadata
+    writes must keep working, and a subsequent recovery must read its
+    configuration from the NEW team (the recovery-time metadata read
+    follows the moved shard)."""
+    async def main():
+        from foundationdb_tpu.core.management import configure
+
+        k = Knobs().override(DD_ENABLED=True, DD_INTERVAL=1.0,
+                             DD_SHARD_SPLIT_BYTES=6_000)
+        sim = SimulatedCluster(k, n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        n_shards_before = len(state1["shard_teams"])
+        last_team_before = list(state1["shard_teams"][-1])
+        db = await sim.database()
+
+        # a config value that must survive the metadata move + recovery
+        await configure(db, resolvers=1)
+
+        written: dict[bytes, bytes] = {}
+        stop = asyncio.Event()
+
+        async def writer(wid: int) -> None:
+            i = 0
+            while not stop.is_set():
+                items = {b"\xf0hot%02d%05d" % (wid, i + j): b"v" * 40
+                         for j in range(5)}
+                i += 5
+
+                async def do(tr, items=items):
+                    for key, v in items.items():
+                        tr.set(key, v)
+                await db.run(do)
+                written.update(items)
+                await asyncio.sleep(0.05)
+
+        writers = [asyncio.ensure_future(writer(w)) for w in range(2)]
+        state2 = await sim.wait_state(
+            lambda s: s.get("seq", 0) > 0
+            and len(s["shard_teams"]) > n_shards_before)
+        await asyncio.sleep(1.0)
+        stop.set()
+        await asyncio.gather(*writers)
+
+        assert state2["epoch"] == state1["epoch"], \
+            "live move must not trigger a recovery"
+        # the system keyspace (last shard) is on a DIFFERENT team now
+        assert list(state2["shard_teams"][-1]) != last_team_before, \
+            (last_team_before, state2["shard_teams"])
+
+        # metadata writes still work post-move (routed to the new team)
+        await configure(db, logs=1)
+
+        # a recovery right after the metadata moved: the controller's
+        # \xff read must find the NEW team and recover the conf
+        victims = await sim.txn_only_machines()
+        assert victims
+        await victims[0].kill()
+        state3 = await sim.wait_epoch(state2["epoch"] + 1)
+        assert len(state3["resolvers"]) == 1, state3["resolvers"]
+
+        tr = db.create_transaction()
+        while True:
+            try:
+                rows = await tr.get_range(b"\xf0hot", b"\xf0hou", limit=0)
+                break
+            except Exception as e:   # noqa: BLE001 — follow the recovery
+                await tr.on_error(e)
+        got = dict(rows)
+        missing = [key for key in written if key not in got]
+        assert not missing, f"{len(missing)} rows lost, e.g. {missing[:3]}"
+        phantom = [key for key in got if key not in written]
+        assert not phantom, f"{len(phantom)} phantoms"
+        await sim.stop()
+    run_simulation(main())

@@ -17,9 +17,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_monitor_restarts_crashed_server(tmp_path):
-    ports = free_ports(3)
+    """The victim is a WORKER-ONLY server (4th process, not in the
+    coordinator quorum): the monitor's contract under test is
+    supervision — crash detection + respawn + the cluster still serving.
+    Killing a coordinator host instead drags in real-time election
+    failover, which is timing-sensitive on a loaded VM and covered
+    deterministically by the sim suite (attrition/leader-kill tests)."""
+    ports = free_ports(4)
+    coord_ports, victim_port = ports[:3], ports[3]
     cf = ClusterFile("mon", "t1",
-                     [NetworkAddress("127.0.0.1", p) for p in ports])
+                     [NetworkAddress("127.0.0.1", p) for p in coord_ports])
     cf_path = tmp_path / "fdb.cluster"
     cf.save(str(cf_path))
     conf = tmp_path / "fdbmonitor.conf"
@@ -27,8 +34,13 @@ def test_monitor_restarts_crashed_server(tmp_path):
         "[general]\n"
         f"cluster-file = {cf_path}\n"
         "restart-delay = 0.5\n"
+        # replication=2: the kill must not be data loss (single-replica
+        # memory-engine storage dies with its process; reads of a lost
+        # shard retry endpoint_not_found forever — unavailability, not a
+        # supervision failure)
         + "".join(f"[fdbserver.{p}]\nlisten = 127.0.0.1:{p}\n"
-                  "spec = min_workers=3\n" for p in ports))
+                  "spec = min_workers=4,storage_servers=4,replication=2\n"
+                  for p in ports))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     mon = subprocess.Popen(
         [sys.executable, "-m", "foundationdb_tpu.monitor", "-C", str(conf)],
@@ -45,11 +57,12 @@ def test_monitor_restarts_crashed_server(tmp_path):
             cli = await open_cli(str(cf_path), Knobs(), timeout=60)
             assert await cli.execute("set mk mv") == "Committed"
 
-        asyncio.run(smoke())
+        asyncio.run(asyncio.wait_for(smoke(), 120))
 
-        # find and SIGKILL one child fdbserver; the monitor must respawn it
+        # find and SIGKILL the worker-only fdbserver; the monitor must
+        # respawn it
         out = subprocess.run(
-            ["pgrep", "-f", f"foundationdb_tpu.server.*{ports[2]}"],
+            ["pgrep", "-f", f"foundationdb_tpu.server.*{victim_port}"],
             capture_output=True, text=True)
         pids = [int(x) for x in out.stdout.split()]
         assert pids, "child server not found"
@@ -57,7 +70,7 @@ def test_monitor_restarts_crashed_server(tmp_path):
         deadline = time.time() + 30
         while time.time() < deadline:
             out = subprocess.run(
-                ["pgrep", "-f", f"foundationdb_tpu.server.*{ports[2]}"],
+                ["pgrep", "-f", f"foundationdb_tpu.server.*{victim_port}"],
                 capture_output=True, text=True)
             new = [int(x) for x in out.stdout.split()]
             if new and new[0] != pids[0]:
@@ -66,13 +79,14 @@ def test_monitor_restarts_crashed_server(tmp_path):
         else:
             raise AssertionError("monitor never restarted the killed server")
 
-        # cluster still serves after the restart
+        # cluster still serves after the restart (bounded: a wedge must
+        # FAIL the test, not hang the suite)
         async def smoke2():
             cli = await open_cli(str(cf_path), Knobs(), timeout=60)
             out = await cli.execute("get mk")
             assert out == "`mk' is `mv'", out
 
-        asyncio.run(smoke2())
+        asyncio.run(asyncio.wait_for(smoke2(), 150))
     finally:
         mon.send_signal(signal.SIGTERM)
         try:
@@ -80,7 +94,3 @@ def test_monitor_restarts_crashed_server(tmp_path):
         except subprocess.TimeoutExpired:
             mon.kill()
             mon.communicate()
-        # no orphan servers
-        time.sleep(1)
-        out = subprocess.run(["pgrep", "-f", f"cluster-file.*{cf_path}"],
-                             capture_output=True, text=True)

@@ -443,3 +443,63 @@ def test_excluded_worker_gets_no_txn_roles():
         assert await read_kv(view2, [b"x"]) == {b"x": b"1"}
         await cc.stop()
     run_simulation(main())
+
+
+def test_role_endpoint_loss_on_live_process_triggers_recovery():
+    """A role can die while its process stays reachable (crash +
+    supervisor respawn between recruitment and now, or a stopped role):
+    address-level failure detection never fires, every TLog push gets
+    endpoint_not_found, and without role-endpoint probing the cluster
+    wedges forever.  The controller's role probe must notice and
+    recover (REF: waitFailureClient on role interfaces)."""
+    import asyncio
+
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.runtime.simloop import run_simulation
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        sim = SimulatedCluster(Knobs(), n_machines=5,
+                               spec=ClusterConfigSpec(min_workers=5))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        db = await sim.database()
+
+        async def w(tr):
+            tr.set(b"pre-loss", b"1")
+        await db.run(w)
+
+        # surgically stop ONE recruited TLog ROLE on its (live) host:
+        # the machine keeps answering pings, only the endpoints vanish
+        gen = state1["log_cfg"][-1]
+        tlog_addr, tlog_tok = gen["tlogs"][0], gen["token"][0]
+        victim = next(m for m in sim.machines
+                      if m.alive and m.host is not None
+                      and m.ip == tlog_addr[0]
+                      and tlog_tok in m.host.worker.roles)
+        assert await victim.host.worker.stop_role(tlog_tok)
+
+        # the controller must notice the dead ENDPOINT and recover
+        state2 = await sim.wait_epoch(state1["epoch"] + 1)
+        assert state2["epoch"] > state1["epoch"]
+
+        # and the recovered cluster serves: reads AND writes
+        while True:
+            tr = db.create_transaction()
+            try:
+                tr.set(b"post-loss", b"2")
+                await tr.commit()
+                break
+            except Exception as e:   # noqa: BLE001 — retry through recovery
+                await tr.on_error(e)
+        tr = db.create_transaction()
+        while True:
+            try:
+                assert await tr.get(b"pre-loss") == b"1"
+                assert await tr.get(b"post-loss") == b"2"
+                break
+            except Exception as e:   # noqa: BLE001
+                await tr.on_error(e)
+        await sim.stop()
+    run_simulation(main())

