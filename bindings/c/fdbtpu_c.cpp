@@ -145,6 +145,11 @@ fdbtpu_error_t fdbtpu_transaction_get(FDBTPUTransaction* tr,
     *out_present = present;
     if (code == 0 && present) {
         *out_value = (uint8_t*)std::malloc(blen ? blen : 1);
+        if (!*out_value) {
+            *out_length = 0;
+            Py_DECREF(r);
+            return 1500;  /* platform_error: allocation failed */
+        }
         std::memcpy(*out_value, buf, blen);
         *out_length = (int)blen;
     } else {
@@ -209,6 +214,12 @@ fdbtpu_error_t fdbtpu_transaction_get_range(FDBTPUTransaction* tr,
     }
     if (code == 0) {
         *out_buf = (uint8_t*)std::malloc(blen ? blen : 1);
+        if (!*out_buf) {
+            *out_length = 0;
+            *out_count = 0;
+            Py_DECREF(r);
+            return 1500;  /* platform_error: allocation failed */
+        }
         std::memcpy(*out_buf, buf, blen);
         *out_length = (int)blen;
         *out_count = count;

@@ -107,6 +107,9 @@ class CommitProxy:
         self.counters = CounterCollection("ProxyCommit")
         self.latency_hist = Histogram("ProxyCommit", "BatchLatency")
         self._metrics_task = None
+        # fail-stop (see _repair_chain): once set, new commits are refused
+        # and the role-liveness ping probes dead, driving an epoch recovery
+        self._failed: BaseException | None = None
 
     @property
     def shard_map(self) -> ShardMap:
@@ -257,6 +260,8 @@ class CommitProxy:
     # --- client-facing ---
 
     async def commit(self, req: CommitTransactionRequest) -> CommitResult:
+        if self._failed is not None:
+            raise ClusterVersionChanged() from self._failed
         fut = asyncio.get_running_loop().create_future()
         self._queue.put_nowait((req, fut))
         return await fut
@@ -407,11 +412,11 @@ class CommitProxy:
         prev_version = version = None
         resolved = pushed = push_started = False
         repair_tagged: dict[int, list[Mutation]] | None = None
+        is_state = any(is_state_txn(r) for r in reqs)
         try:
             prev_version, version = await self.sequencer.get_commit_version()
             txns = [TxnRequest(r.read_conflict_ranges, r.write_conflict_ranges,
                                r.read_snapshot) for r in reqs]
-            is_state = any(is_state_txn(r) for r in reqs)
             state_txns = None
             if is_state:
                 # singleton by the batcher's construction; ranges ride
@@ -544,19 +549,40 @@ class CommitProxy:
             # every later batch cluster-wide
             if version is not None:
                 await self._repair_chain(prev_version, version, resolved,
-                                         pushed, repair_tagged)
+                                         pushed, repair_tagged,
+                                         carries_state=is_state,
+                                         cause=e)
 
     async def _repair_chain(self, prev_version: Version, version: Version,
                             resolved: bool, pushed: bool,
-                            tagged: dict[int, list[Mutation]] | None = None
-                            ) -> None:
+                            tagged: dict[int, list[Mutation]] | None = None,
+                            carries_state: bool = False,
+                            cause: BaseException | None = None) -> None:
         """Complete an interrupted batch's version chain.  Once the batch
         RESOLVED, its verdicts (and any committed state transaction) are
         in every resolver's history, so the repair must push the batch's
         REAL payload — an empty substitute would let later batches commit
         durably on top of a layout change that never reached the logs
         (TLog pushes ack duplicates idempotently, so re-pushing a
-        partially-delivered version is safe)."""
+        partially-delivered version is safe).  If a STATE-bearing batch
+        resolved but the failure hit BEFORE tagging was computed
+        (``tagged is None``), the payload cannot be reconstructed: the
+        committed state txn is in every resolver's stream with its
+        metadata mutations unrecoverable here.  Pushing an empty
+        substitute would durably erase it, so the proxy FAIL-STOPS —
+        refuses further commits and probes dead on its role-liveness
+        slot — forcing an epoch recovery that rebuilds from the
+        resolvers' state streams.  A pure USER batch in the same spot is
+        safe to repair with an empty push: its clients already hold
+        commit_unknown_result (maybe-committed permits not-committed),
+        and the stray resolver write history costs at most spurious
+        conflicts inside the MVCC window."""
+        if resolved and tagged is None and carries_state:
+            from ..runtime.trace import TraceEvent
+            self._failed = cause or RuntimeError("unrepairable state batch")
+            TraceEvent("CommitBatchUnrepairable", severity=30) \
+                .detail("Version", version).log()
+            return
         try:
             if not resolved:
                 await asyncio.gather(*(r.resolve(

@@ -63,8 +63,29 @@ class LogRouter:
             self._task = None
 
     async def _run(self) -> None:
+        # errors escaping TagStream.next() (e.g. fetch_cluster_state in
+        # the ack-confirm round, outside TagStream's internal retry) must
+        # not kill the router silently — consumers would long-poll an
+        # unmoving frontier forever with no trace of why
+        backoff = 0.25
         while True:
-            entries, end = await self.stream.next()
+            try:
+                entries, end = await self.stream.next()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:   # noqa: BLE001 — retry with backoff
+                TraceEvent("LogRouterPullError", severity=30) \
+                    .detail("Tag", self.tag).detail("End", self._end) \
+                    .error(e).log()
+                # the cursor may have advanced past entries the failed
+                # call never handed us (ack-confirm raised after the
+                # pull): rewind to the emitted frontier or the retry
+                # silently skips those versions
+                self.stream.rewind(self._end - 1)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                continue
+            backoff = 0.25
             for v, m in entries:
                 self._versions.append(v)
                 self._msgs.append(m)

@@ -317,44 +317,50 @@ class StorageServer:
         while True:
             await asyncio.sleep(self.knobs.STORAGE_DURABILITY_LAG)
             floor = self.version - self.knobs.STORAGE_VERSION_WINDOW
-            if floor <= self.durable_version:
-                continue
-            ops = [op for v, op in self._durability_buffer if v <= floor]
-            try:
-                await self.engine.commit(ops, {
-                    "durable_version": floor,
-                    "tag": self.tag,
-                    "shard": (self._meta_shard.begin, self._meta_shard.end),
-                })
-            except Exception as e:
-                # disk trouble (ENOSPC, IO error): keep the buffer intact
-                # and retry next tick — losing the task would silently
-                # freeze durability and grow memory forever
-                TraceEvent("StorageDurabilityError", severity=40).detail(
-                    "Tag", self.tag).error(e).log()
-                continue
-            self._durability_buffer = [(v, op) for v, op in
-                                       self._durability_buffer if v > floor]
-            self.bytes_durable += sum(len(p1) + len(p2) for _, p1, p2 in ops)
-            self.durable_version = floor
-            self.oldest_version = floor
-            self.vmap.drop_before(floor)     # engine is authoritative <= floor
-            self.log_system.pop(self.tag, floor + 1)
+            if floor > self.durable_version:
+                ops = [op for v, op in self._durability_buffer if v <= floor]
+                try:
+                    await self.engine.commit(ops, {
+                        "durable_version": floor,
+                        "tag": self.tag,
+                        "shard": (self._meta_shard.begin,
+                                  self._meta_shard.end),
+                    })
+                except Exception as e:
+                    # disk trouble (ENOSPC, IO error): keep the buffer
+                    # intact and retry next tick — losing the task would
+                    # silently freeze durability and grow memory forever
+                    TraceEvent("StorageDurabilityError", severity=40).detail(
+                        "Tag", self.tag).error(e).log()
+                    continue
+                self._durability_buffer = [(v, op) for v, op in
+                                           self._durability_buffer
+                                           if v > floor]
+                self.bytes_durable += sum(
+                    len(p1) + len(p2) for _, p1, p2 in ops)
+                self.durable_version = floor
+                self.oldest_version = floor
+                self.vmap.drop_before(floor)  # engine authoritative <= floor
+                self.log_system.pop(self.tag, floor + 1)
             # GC relinquished ranges (live-move handoffs): once the drop
-            # version is STRICTLY below the now-advanced floor, no legal
-            # read can touch the range (reads at or below the drop
-            # version — the only ones the fence allows — are too old),
-            # and the narrowed meta shard excludes it after any reboot.
-            # A SEPARATE engine commit AFTER oldest_version advances: a
-            # clear riding the main batch would be observable by a
-            # still-legal history read during the engine's internal
-            # awaits, before the floor moved.
-            gc = [(v, b, e) for v, b, e in self._gc_pending if v < floor]
+            # version is STRICTLY below the durable floor, no legal read
+            # can touch the range (reads at or below the drop version —
+            # the only ones the fence allows — are too old), and the
+            # narrowed meta shard excludes it after any reboot.  This
+            # runs EVERY tick against the achieved floor, not only when
+            # new data needed persisting: a server that just relinquished
+            # its only hot range may never see another mutation, yet must
+            # still shed the dropped rows.  A SEPARATE engine commit
+            # AFTER oldest_version advances: a clear riding the main
+            # batch would be observable by a still-legal history read
+            # during the engine's internal awaits, before the floor moved.
+            gc = [(v, b, e) for v, b, e in self._gc_pending
+                  if v < self.oldest_version]
             if gc:
                 try:
                     await self.engine.commit(
                         [(OP_CLEAR, b, e) for _v, b, e in gc], {
-                            "durable_version": floor,
+                            "durable_version": self.durable_version,
                             "tag": self.tag,
                             "shard": (self._meta_shard.begin,
                                       self._meta_shard.end),
@@ -363,8 +369,9 @@ class StorageServer:
                     TraceEvent("StorageDurabilityError", severity=40).detail(
                         "Tag", self.tag).error(e).log()
                     continue
-                self._gc_pending = [(v, b, e) for v, b, e in self._gc_pending
-                                    if v >= floor]
+                done = {(v, b, e) for v, b, e in gc}
+                self._gc_pending = [t for t in self._gc_pending
+                                    if t not in done]
                 for _v, b, e in gc:
                     TraceEvent("StorageDroppedRangeGC").detail("Tag", self.tag) \
                         .detail("Begin", b).detail("End", e).log()

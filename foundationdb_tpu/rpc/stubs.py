@@ -64,7 +64,15 @@ def serve_role(transport: Transport, role: str, obj: Any,
             return result
         transport.dispatcher.register(handler, token=base_token + i)
 
-    async def role_ping(_args, role=role):
+    async def role_ping(_args, role=role, obj=obj):
+        # a fail-stopped role instance (resolver poison, proxy
+        # unrepairable batch) must probe DEAD even though its process —
+        # and this handler — are alive: the CC's role-liveness probe is
+        # what converts the fail-stop into an epoch recovery
+        if getattr(obj, "_failed", None) is not None \
+                or getattr(obj, "_poisoned", None) is not None:
+            from ..runtime.errors import EndpointNotFound
+            raise EndpointNotFound()
         return role
     ping_token = base_token + TOKEN_BLOCK - 1
     # static layouts (worker block + CC surface sharing one block) may

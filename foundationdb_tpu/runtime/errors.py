@@ -57,6 +57,7 @@ TransactionCancelled = _err(1025, "transaction_cancelled", "Transaction was canc
 ConnectionFailed = _err(1026, "connection_failed", "Network connection failed")
 TransactionTimedOut = _err(1031, "transaction_timed_out", "Transaction timed out")
 TLogStopped = _err(1011, "tlog_stopped", "TLog stopped (generation locked by recovery)")
+EndpointNotFound = _err(1012, "endpoint_not_found", "Endpoint not found (role gone or fail-stopped)")
 ProcessBehind = _err(1037, "process_behind", "Storage process does not have recent mutations")
 DatabaseLocked = _err(1038, "database_locked", "Database is locked")
 ClusterVersionChanged = _err(1039, "cluster_version_changed", "Cluster has been upgraded to a new protocol version")

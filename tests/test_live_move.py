@@ -341,10 +341,31 @@ def test_source_engine_gc_after_live_split():
         await sim.wait_state(
             lambda s: len(s["shard_teams"]) > n_shards_before)
 
-        # keep versions flowing so the MVCC floor passes the drop version
-        for j in range(30):
-            await db.run(lambda tr, j=j: fill(tr, j, j + 1))
+        # keep versions flowing so the MVCC floor passes the drop version,
+        # then hold until every source server's pending GC has drained —
+        # the LAST split can land at the very end of the write traffic,
+        # and its GC legitimately needs the floor (hence versions) to
+        # advance past the drop version plus one durability tick
+        def storage_roles():
+            out = []
+            for m in sim.machines:
+                if not m.alive or m.host is None:
+                    continue
+                for _tok, (role, obj) in list(m.host.worker.roles.items()):
+                    if role == "storage" and obj.engine is not None:
+                        out.append(obj)
+            return out
+
+        for j in range(200):
+            await db.run(lambda tr, j=j: fill(tr, j % 5, j % 5 + 1))
             await asyncio.sleep(0.1)
+            if j >= 30 and not any(s._gc_pending for s in storage_roles()):
+                break
+        else:
+            raise AssertionError(
+                "pending source-engine GC never drained: " +
+                repr([(s.tag, s._gc_pending) for s in storage_roles()
+                      if s._gc_pending]))
 
         checked = 0
         for m in sim.machines:

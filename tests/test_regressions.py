@@ -83,3 +83,66 @@ def test_shard_map_boundary_range():
 def test_shard_map_keyspace_end_threaded():
     sm = ShardMap.even(2, keyspace_end=b"\xff")
     assert sm.ranges()[-1][0].end == b"\xff"
+
+
+def test_unrepairable_state_batch_fail_stops_proxy():
+    """A state-bearing batch that fails AFTER resolution but BEFORE its
+    tagging is computed cannot be repaired (an empty substitute push
+    would durably erase a committed metadata change every resolver
+    already streamed).  The proxy must fail-stop: refuse new commits and
+    probe dead on its role-liveness slot — never push the substitute."""
+    from foundationdb_tpu.runtime.errors import ClusterVersionChanged
+
+    async def body(db):
+        proxy = db.cluster.commit_proxies[0]
+        real = proxy._apply_state_entries
+        fired = {}
+
+        def boom(entries, own_version=None):
+            if entries and not fired:
+                fired["x"] = True
+                raise RuntimeError("injected post-resolve failure")
+            return real(entries, own_version=own_version)
+
+        proxy._apply_state_entries = boom
+        tr = db.create_transaction()
+        tr.set(b"\xff/conf/test", b"1")   # state txn
+        with pytest.raises(Exception):
+            await tr.commit()
+        assert proxy._failed is not None, "proxy must fail-stop"
+        # new commits are refused at the proxy boundary (a real cluster's
+        # CC would see the dead role-liveness probe and recover the epoch;
+        # this bare Cluster has no CC, so assert at the seam)
+        from foundationdb_tpu.core.data import CommitTransactionRequest
+        with pytest.raises(ClusterVersionChanged):
+            await proxy.commit(CommitTransactionRequest([], [], [], 0))
+
+    sim(body)
+
+
+def test_pure_user_batch_repairs_without_fail_stop():
+    """The same post-resolve failure on a batch with NO state txn is
+    safely repaired with an empty substitute: clients hold
+    commit_unknown_result and the cluster keeps serving."""
+    async def body(db):
+        proxy = db.cluster.commit_proxies[0]
+        real = proxy._apply_state_entries
+        fired = {}
+
+        def boom(entries, own_version=None):
+            # only the _commit_batch path passes own_version; an idle
+            # empty batch must not consume the injection
+            if own_version is not None and not fired:
+                fired["x"] = True
+                raise RuntimeError("injected post-resolve failure")
+            return real(entries, own_version=own_version)
+
+        proxy._apply_state_entries = boom
+        with pytest.raises(Exception):
+            await db.set(b"victim", b"v")
+        assert proxy._failed is None, "user batch must not dead-end epoch"
+        proxy._apply_state_entries = real
+        await db.set(b"after", b"ok")
+        assert await db.get(b"after") == b"ok"
+
+    sim(body)
