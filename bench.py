@@ -47,20 +47,7 @@ def read_status(path: str) -> dict | None:
         return None
 
 
-def probe_tpu(wait_s: float, quiet: bool) -> tuple[bool, str]:
-    """Return (tpu_ok, detail).  Spawns a detached probe child writing to a
-    status file unique to this spawn (an older never-killed probe must not
-    overwrite ours) and polls it for up to wait_s.  A fresh ok from any
-    previous probe generation is reused without touching the tunnel again."""
-    import glob
-
-    os.makedirs(PROBE_DIR, exist_ok=True)
-    for path in sorted(glob.glob(os.path.join(PROBE_DIR, "bench_tpu_status.*.json")),
-                       reverse=True):
-        st = read_status(path)
-        if st and st.get("state") == "ok" and time.time() - st.get("ts", 0) < 600:
-            return True, "reused fresh probe result"
-
+def _spawn_probe() -> str:
     status_path = os.path.join(
         PROBE_DIR, f"bench_tpu_status.{os.getpid()}.{int(time.time() * 1e3)}.json")
     with open(os.path.join(PROBE_DIR, "bench_tpu_probe.log"), "ab") as log:
@@ -69,21 +56,63 @@ def probe_tpu(wait_s: float, quiet: bool) -> tuple[bool, str]:
              "--out", status_path],
             cwd=REPO, stdout=log, stderr=log,
             start_new_session=True)      # detached: never killed, may outlive us
+    return status_path
+
+
+def probe_tpu(wait_s: float, quiet: bool,
+              respawn_every: float = 360.0) -> tuple[bool, str]:
+    """Return (tpu_ok, detail).  Spawns detached probe children writing to
+    status files unique to each spawn (an older never-killed probe must not
+    overwrite ours) and polls ALL generations for up to wait_s.  A fresh ok
+    from any previous generation is reused without touching the tunnel.
+
+    The tunnel wedge clears on a many-minute scale (VERDICT r3: a single
+    360s window shipped a CPU fallback as the round's artifact), so this
+    keeps probing across the whole budget: earlier probes are never killed
+    — when the wedge clears, a long-blocked probe completes and writes ok
+    — and a fresh probe is additionally spawned every ``respawn_every``
+    seconds in case an early child died with the wedge (e.g. tunnel reset
+    mid-init)."""
+    import glob
+
+    os.makedirs(PROBE_DIR, exist_ok=True)
+
+    def freshest_ok() -> bool:
+        for path in glob.glob(os.path.join(PROBE_DIR, "bench_tpu_status.*.json")):
+            st = read_status(path)
+            if st and st.get("state") == "ok" \
+                    and time.time() - st.get("ts", 0) < 600:
+                return True
+        return False
+
+    if freshest_ok():
+        return True, "reused fresh probe result"
+
+    spawned = [_spawn_probe()]
     deadline = time.time() + wait_s
+    next_respawn = time.time() + respawn_every
     last_state = "no-status"
     while time.time() < deadline:
-        st = read_status(status_path)
-        if st:
-            last_state = st.get("state", "?")
-            if last_state == "ok":
-                return True, f"probe ok (init {st.get('init_s', 0):.1f}s)"
-            if last_state in ("error", "cpu-only"):
-                return False, f"probe {last_state}: {st.get('error', '')}"
+        states = []
+        for path in spawned:
+            st = read_status(path)
+            states.append(st.get("state", "?") if st else "no-status")
+        if freshest_ok() or "ok" in states:
+            return True, f"probe ok after {time.time() - deadline + wait_s:.0f}s"
+        if "cpu-only" in states:
+            # definitive: this machine has no TPU attached — waiting out
+            # the wedge window or respawning would only burn 25 minutes
+            return False, "probe cpu-only: no TPU device on this host"
+        last_state = states[-1]
+        if time.time() >= next_respawn:
+            spawned.append(_spawn_probe())
+            next_respawn = time.time() + respawn_every
         if not quiet:
-            print(f"[bench] waiting for TPU probe ({last_state}), "
+            print(f"[bench] waiting for TPU probe ({states}), "
                   f"{deadline - time.time():.0f}s left", file=sys.stderr)
         time.sleep(5.0)
-    return False, f"probe timed out after {wait_s:.0f}s in state {last_state!r}"
+    return False, (f"probe timed out after {wait_s:.0f}s; "
+                   f"{len(spawned)} generations, last state {last_state!r}")
 
 
 # --------------------------------------------------------------------------
@@ -345,8 +374,9 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true", help="small fast run (CI)")
     ap.add_argument("--cpu", action="store_true", help="skip the TPU probe")
     ap.add_argument("--tpu-wait", type=float,
-                    default=float(os.environ.get("BENCH_TPU_WAIT", "360")),
-                    help="max seconds to wait for the TPU tunnel probe")
+                    default=float(os.environ.get("BENCH_TPU_WAIT", "1500")),
+                    help="max seconds to wait for the TPU tunnel probe "
+                         "(probes are re-spawned across the whole window)")
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args()
     if args.quick:
@@ -383,20 +413,31 @@ def main() -> int:
     out = {
         "metric": "resolver_commits_per_sec (mako 50/50 zipf0.99 batch=64, "
                   "tpu kernel)",
-        "value": 0.0,
+        "value": None,
         "unit": "commits/s",
-        "vs_baseline": 0.0,
+        "vs_baseline": None,
         "backend_used": backend_used,
         "tpu_detail": tpu_detail,
     }
+    # a CPU-twin fallback must NEVER masquerade as the metric: with no
+    # real TPU, value/vs_baseline stay null and the twin's numbers are
+    # recorded under explicitly-named fallback keys (VERDICT r3 #1a)
+    fallback = backend_used != "tpu"
     rc = 0
     try:
         r = run(args.batches, args.batch_size, args.keys, args.quiet, tpu_device)
         res = r["results"]
         out.update({
-            "value": round(res["tpu"]["commits_per_sec"], 1),
-            "vs_baseline": round(res["tpu"]["commits_per_sec"]
-                                 / res["cpp"]["commits_per_sec"], 3),
+            "value": None if fallback
+            else round(res["tpu"]["commits_per_sec"], 1),
+            "vs_baseline": None if fallback
+            else round(res["tpu"]["commits_per_sec"]
+                       / res["cpp"]["commits_per_sec"], 3),
+            "cpu_twin_commits_per_sec": round(res["tpu"]["commits_per_sec"], 1)
+            if fallback else None,
+            "cpu_twin_vs_baseline": round(res["tpu"]["commits_per_sec"]
+                                          / res["cpp"]["commits_per_sec"], 3)
+            if fallback else None,
             "baseline_cpp_commits_per_sec": round(res["cpp"]["commits_per_sec"], 1),
             "serial_commits_per_sec_tpu": round(res["tpu"]["serial_commits_per_sec"], 1),
             "serial_commits_per_sec_cpp": round(res["cpp"]["serial_commits_per_sec"], 1),
@@ -426,32 +467,43 @@ def main() -> int:
             print("FATAL: fused group verdicts diverge from serial",
                   file=sys.stderr)
             rc = 1
+        def rnd(x, n=1):
+            return None if x is None else round(x, n)
+
         if not args.quick:
             try:
                 e2e = run_e2e_phase(tpu_device, args.quiet)
                 out.update({
-                    "e2e_tps_tpu": round(e2e["tpu"]["tps"], 1),
-                    "e2e_tps_cpp": round(e2e["cpp"]["tps"], 1),
-                    "e2e_p50_ms_tpu": round(e2e["tpu"]["p50_ms"], 1),
-                    "e2e_p50_ms_cpp": round(e2e["cpp"]["p50_ms"], 1),
-                    "e2e_p99_ms_tpu": round(e2e["tpu"]["p99_ms"], 1),
-                    "e2e_p99_ms_cpp": round(e2e["cpp"]["p99_ms"], 1),
-                    "e2e_abort_rate_tpu": round(e2e["tpu"]["abort_rate"], 3),
-                    "e2e_abort_rate_cpp": round(e2e["cpp"]["abort_rate"], 3),
+                    "e2e_tps_tpu": rnd(e2e["tpu"]["tps"]),
+                    "e2e_tps_cpp": rnd(e2e["cpp"]["tps"]),
+                    "e2e_p50_ms_tpu": rnd(e2e["tpu"]["p50_ms"]),
+                    "e2e_p50_ms_cpp": rnd(e2e["cpp"]["p50_ms"]),
+                    "e2e_p99_ms_tpu": rnd(e2e["tpu"]["p99_ms"]),
+                    "e2e_p99_ms_cpp": rnd(e2e["cpp"]["p99_ms"]),
+                    "e2e_n_samples_tpu": e2e["tpu"]["n_samples"],
+                    "e2e_n_samples_cpp": e2e["cpp"]["n_samples"],
+                    "e2e_abort_rate_tpu": rnd(e2e["tpu"]["abort_rate"], 3),
+                    "e2e_abort_rate_cpp": rnd(e2e["cpp"]["abort_rate"], 3),
                 })
             except Exception as e:  # noqa: BLE001 — e2e must not kill the bench
                 out["e2e_error"] = repr(e)[:300]
             try:
                 c34 = run_configs34_phase(tpu_device, args.quiet)
                 out.update({
-                    "ycsb_ops_per_sec_tpu": round(c34["ycsb_tpu"]["ops_per_sec"], 1),
-                    "ycsb_ops_per_sec_cpp": round(c34["ycsb_cpp"]["ops_per_sec"], 1),
-                    "ycsb_p99_ms_tpu": round(c34["ycsb_tpu"]["p99_ms"], 1),
-                    "ycsb_p99_ms_cpp": round(c34["ycsb_cpp"]["p99_ms"], 1),
-                    "tpcc_tpmC_tpu": round(c34["tpcc_tpu"]["tpmC"], 1),
-                    "tpcc_tpmC_cpp": round(c34["tpcc_cpp"]["tpmC"], 1),
-                    "tpcc_abort_rate_tpu": round(c34["tpcc_tpu"]["abort_rate"], 3),
-                    "tpcc_abort_rate_cpp": round(c34["tpcc_cpp"]["abort_rate"], 3),
+                    "ycsb_ops_per_sec_tpu": rnd(c34["ycsb_tpu"]["ops_per_sec"]),
+                    "ycsb_ops_per_sec_cpp": rnd(c34["ycsb_cpp"]["ops_per_sec"]),
+                    "ycsb_p99_ms_tpu": rnd(c34["ycsb_tpu"]["p99_ms"]),
+                    "ycsb_p99_ms_cpp": rnd(c34["ycsb_cpp"]["p99_ms"]),
+                    "ycsb_n_samples_tpu": c34["ycsb_tpu"]["n_samples"],
+                    "ycsb_n_samples_cpp": c34["ycsb_cpp"]["n_samples"],
+                    "tpcc_tpmC_tpu": rnd(c34["tpcc_tpu"]["tpmC"]),
+                    "tpcc_tpmC_cpp": rnd(c34["tpcc_cpp"]["tpmC"]),
+                    "tpcc_livelock_tpu": c34["tpcc_tpu"]["livelock"],
+                    "tpcc_livelock_cpp": c34["tpcc_cpp"]["livelock"],
+                    "tpcc_n_samples_tpu": c34["tpcc_tpu"]["n_samples"],
+                    "tpcc_n_samples_cpp": c34["tpcc_cpp"]["n_samples"],
+                    "tpcc_abort_rate_tpu": rnd(c34["tpcc_tpu"]["abort_rate"], 3),
+                    "tpcc_abort_rate_cpp": rnd(c34["tpcc_cpp"]["abort_rate"], 3),
                 })
             except Exception as e:  # noqa: BLE001 — configs 3-4 are extras
                 out["configs34_error"] = repr(e)[:300]

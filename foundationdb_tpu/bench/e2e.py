@@ -88,15 +88,13 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
     elapsed = time.perf_counter() - t0
     await cluster.stop()
 
-    lat = np.array(latencies) if latencies else np.array([0.0])
+    from .stats import latency_ms
     return {
         "tps": committed / elapsed,
         "committed": committed,
         "aborts": conflicts,
         "abort_rate": conflicts / max(1, committed + conflicts),
-        "p50_ms": float(np.percentile(lat, 50) * 1e3),
-        "p95_ms": float(np.percentile(lat, 95) * 1e3),
-        "p99_ms": float(np.percentile(lat, 99) * 1e3),
+        **latency_ms(latencies, (50, 95, 99)),
         "elapsed_s": elapsed,
     }
 

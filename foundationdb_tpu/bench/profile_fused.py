@@ -1,0 +1,84 @@
+"""Head-to-head: old multi-put wire path vs fused single-put path (r4).
+
+Interleaves passes A/B/A/B in one process so VM neighbor noise hits both.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    dev = jax.devices()[0]
+    print("device:", dev, dev.platform)
+
+    from foundationdb_tpu.bench.workload import MakoWorkload
+    from foundationdb_tpu.ops import backends as bk
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+    from foundationdb_tpu.ops.batch import wire_from_txns
+    from foundationdb_tpu.runtime import Knobs
+
+    B, N = 64, 1024
+    knobs = Knobs().override(
+        RESOLVER_BATCH_TXNS=B, RESOLVER_RANGES_PER_TXN=2,
+        CONFLICT_RING_CAPACITY=1 << 14, KEY_ENCODE_BYTES=32,
+        RESOLVER_CONFLICT_BACKEND="tpu")
+    wl = MakoWorkload(n_keys=1_000_000, seed=42)
+    batches, versions = wl.make_batches(N, B)
+    wires = [wire_from_txns(b) for b in batches]
+
+    backend = make_conflict_backend(knobs, device=dev)
+    d = backend._dict
+
+    class NoFused:
+        """Context: make hasattr(d, 'encode_group_fused') False."""
+        def __enter__(self):
+            self._saved = type(d).encode_group_fused
+            del type(d).encode_group_fused
+        def __exit__(self, *a):
+            type(d).encode_group_fused = self._saved
+
+    async def go():
+        from foundationdb_tpu.ops.backends import resolve_group_wire_begin
+        return await resolve_group_wire_begin(backend, wires, versions)
+
+    def timed():
+        t0 = time.perf_counter()
+        out = asyncio.run(go())
+        dt = time.perf_counter() - t0
+        backend.reset_ring(0)
+        return dt, out
+
+    # warm both paths (compiles + dictionary)
+    timed()
+    with NoFused():
+        timed()
+    timed()
+
+    results = {"fused": [], "old": []}
+    ref = None
+    for rnd in range(4):
+        dt, out = timed()
+        results["fused"].append(dt)
+        if ref is None:
+            ref = out
+        assert out == ref, "fused verdicts diverge between passes"
+        with NoFused():
+            dt, out = timed()
+        results["old"].append(dt)
+        assert out == ref, "old-path verdicts diverge from fused"
+    n_txn = N * B
+    for k, v in results.items():
+        best = min(v)
+        print(f"{k:>5}: best {n_txn/best:,.0f} txns/s "
+              f"({best/n_txn*1e6:.2f} us/txn)  all={[f'{x*1e3:.0f}ms' for x in v]}")
+
+
+if __name__ == "__main__":
+    main()

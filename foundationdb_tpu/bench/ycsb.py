@@ -98,14 +98,13 @@ async def run_ycsb_f(knobs: Knobs, n_rows: int = 100_000,
     t0 = await timer
     elapsed = time.perf_counter() - t0
     await cluster.stop()
-    lat = np.array(latencies) if latencies else np.array([0.0])
+    from .stats import latency_ms
     return {
         "ops_per_sec": ops / elapsed,
         "ops": ops,
         "aborts": aborts,
         "abort_rate": aborts / max(1, ops + aborts),
-        "p50_ms": float(np.percentile(lat, 50) * 1e3),
-        "p99_ms": float(np.percentile(lat, 99) * 1e3),
+        **latency_ms(latencies, (50, 99)),
         "elapsed_s": elapsed,
     }
 

@@ -221,14 +221,17 @@ int64_t kc_dict_live(void* p) { return static_cast<KcDict*>(p)->live; }
 
 namespace {
 
-// id for one endpoint; appends (slot, lanes) to the update buffers when
-// the endpoint is not yet device-resident.  Returns the id, or 0 with
-// *overflow set when the update buffers are full (caller falls back).
-inline uint32_t kd_id(KcDict* d, const uint8_t* k, int64_t len,
-                      int64_t width, uint32_t* upd_slots,
-                      uint32_t* upd_lanes, int64_t max_upd,
-                      int64_t* n_upd, int* overflow) {
-    const uint64_t h = kd_hash(k, len);
+// id for one endpoint with a precomputed hash; appends (slot, lanes) to
+// the update buffers when the endpoint is not yet device-resident.
+// Returns the id, or 0 with *overflow set when the update buffers are
+// full (caller falls back).  The SINGLE home of the dictionary-insert
+// invariants (round-robin slot allocation with group-stamp skip, evict,
+// load-factor rebuild, lane-major update emit) — both the per-batch and
+// the fused group paths go through here.
+inline uint32_t kd_id_h(KcDict* d, const uint8_t* k, int64_t len,
+                        uint64_t h, int64_t width, uint32_t* upd_slots,
+                        uint32_t* upd_lanes, int64_t max_upd,
+                        int64_t* n_upd, int* overflow) {
     const int64_t found = kd_find(d, k, len, h);
     if (found >= 0) {
         const uint32_t id = d->table_id[found];
@@ -262,6 +265,14 @@ inline uint32_t kd_id(KcDict* d, const uint8_t* k, int64_t len,
     for (int64_t l = 0; l < L; ++l)
         upd_lanes[l * max_upd + u] = row[l];        // lane-major [L, max_upd]
     return id;
+}
+
+inline uint32_t kd_id(KcDict* d, const uint8_t* k, int64_t len,
+                      int64_t width, uint32_t* upd_slots,
+                      uint32_t* upd_lanes, int64_t max_upd,
+                      int64_t* n_upd, int* overflow) {
+    return kd_id_h(d, k, len, kd_hash(k, len), width, upd_slots, upd_lanes,
+                   max_upd, n_upd, overflow);
 }
 
 }  // namespace
@@ -450,6 +461,167 @@ int64_t kc_encode_group_ids2(void* dict, const uint8_t* flat,
     return kd_encode_group(d, flat, offs, nr, nw, counts, K_real, K_pad,
                            B, R, width, ids_out, upd_slots, upd_lanes,
                            max_upd, /*with_ends=*/false);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Fused group driver (r4).  One native call per device dispatch does ALL
+// host-side group assembly: walks the K wires' buffers directly (no Python
+// blob concat / offset rebasing), decides point-compactness, encodes
+// endpoint ids with software-prefetched hash probes, and writes ids +
+// snapshots + commit versions into ONE fused u32 buffer that ships as a
+// single device_put.  The measured per-group cost of the Python path this
+// replaces: ~0.4us/txn assembly + 3 extra device_put calls (~1.5ms fixed).
+
+namespace {
+
+struct KeyRef {
+    const uint8_t* p;
+    int64_t len;
+    int64_t dst;            // index into ids_out
+};
+
+// chunked id assignment with table-line prefetch: pass 1 hashes (key bytes
+// are sequential in the wire blob, so this also warms them for the memcmp
+// confirm), pass 2 probes.  The large dictionary table (~10s of MB) makes
+// every cold probe a cache+TLB miss; overlapping 32 of them via prefetch
+// is worth ~2x on the hash-bound path.
+inline int64_t kd_ids_chunked(KcDict* d, const KeyRef* refs, int64_t n,
+                              int64_t width, uint32_t* ids_out,
+                              uint32_t* upd_slots, uint32_t* upd_lanes,
+                              int64_t max_upd, int64_t* n_upd,
+                              int* overflow) {
+    constexpr int64_t CHUNK = 32;
+    uint64_t h[CHUNK];
+    const uint64_t mask = d->table_cap - 1;
+    for (int64_t base = 0; base < n; base += CHUNK) {
+        const int64_t m = n - base < CHUNK ? n - base : CHUNK;
+        for (int64_t j = 0; j < m; ++j) {
+            h[j] = kd_hash(refs[base + j].p, refs[base + j].len);
+            __builtin_prefetch(&d->table_h[h[j] & mask], 0, 1);
+            __builtin_prefetch(&d->table_id[h[j] & mask], 0, 1);
+        }
+        for (int64_t j = 0; j < m; ++j) {
+            const KeyRef& r = refs[base + j];
+            const uint32_t id = kd_id_h(d, r.p, r.len, h[j], width,
+                                        upd_slots, upd_lanes, max_upd,
+                                        n_upd, overflow);
+            if (*overflow) return 0;
+            ids_out[r.dst] = id;
+        }
+    }
+    return 0;
+}
+
+inline bool kd_wire_all_points(const uint8_t* blob, const int64_t* offs,
+                               const int32_t* nr, const int32_t* nw,
+                               const int32_t count) {
+    int64_t key = 0;
+    // offs are wire-local; key counts endpoint pairs
+    for (int64_t t = 0; t < count; ++t) {
+        const int32_t pairs = nr[t] + nw[t];
+        for (int32_t j = 0; j < pairs; ++j, key += 2) {
+            const int64_t blen = offs[key + 1] - offs[key];
+            const int64_t elen = offs[key + 2] - offs[key + 1];
+            if (!(elen == blen + 1 && blob[offs[key + 1] + blen] == 0 &&
+                  memcmp(blob + offs[key], blob + offs[key + 1],
+                         static_cast<size_t>(blen)) == 0))
+                return false;
+        }
+    }
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fused group encoder.  Walks per-wire buffers (no concatenation):
+//   blobs[k], offs_list[k] (wire-local), counts[k]; nr/nw/versions are
+//   group-flat (nr/nw indexed by global txn t, snaps_list[k] per wire).
+// fused layout (u32 words), written here:
+//   [0, nids)            endpoint ids; nids = (compact?2:4)*K_pad*B*R
+//   [off_pi, off_pi+npi) snapshots [K_pad*B] + versions [K_pad] as i64
+//                        (u32 pairs, little-endian); off_pi = nids rounded
+//                        up to even, npi = 2*(K_pad*B + K_pad)
+// The caller appends the update region after off_pi+npi once n_upd is
+// known (bucketed), then ships fused[:total] in ONE device_put.
+// Returns n_upd, or -(partial+1) on update-buffer overflow; *compact_out
+// and *off_pi_out report the layout.
+int64_t kc_encode_group_fused(
+        void* dict, const uint8_t** blobs, const int64_t** offs_list,
+        const int32_t** nr_list, const int32_t** nw_list,
+        const int64_t** snaps_list,
+        const int32_t* counts, const int64_t* versions,
+        int64_t K_real, int64_t K_pad, int64_t B, int64_t R, int64_t width,
+        uint32_t* fused, uint32_t* upd_slots, uint32_t* upd_lanes,
+        int64_t max_upd, int64_t* compact_out, int64_t* off_pi_out) {
+    KcDict* d = static_cast<KcDict*>(dict);
+    // pass 1: compactness (every range in the group a point range)
+    bool compact = true;
+    for (int64_t k = 0; k < K_real && compact; ++k)
+        compact = kd_wire_all_points(blobs[k], offs_list[k], nr_list[k],
+                                     nw_list[k], counts[k]);
+    *compact_out = compact ? 1 : 0;
+    const int64_t seg = K_pad * B * R;
+    const int64_t nids = (compact ? 2 : 4) * seg;
+    const int64_t off_pi = (nids + 1) & ~int64_t(1);
+    *off_pi_out = off_pi;
+    memset(fused, 0, static_cast<size_t>(nids) * 4);        // 0 = sentinel
+
+    // pi64 region: snapshots then versions, -1 padded
+    int64_t* pi = reinterpret_cast<int64_t*>(fused + off_pi);
+    for (int64_t i = 0; i < K_pad * B + K_pad; ++i) pi[i] = -1;
+    for (int64_t k = 0; k < K_real; ++k) {
+        for (int32_t i = 0; i < counts[k]; ++i)
+            pi[k * B + i] = snaps_list[k][i];
+        pi[K_pad * B + k] = versions[k];
+    }
+
+    // pass 2: ids via chunked prefetching lookup (dict keys only:
+    // begins always; ends only in the 4-segment layout); each KeyRef's
+    // dst is the absolute index into the segment layout
+    int64_t n_upd = 0;
+    int overflow = 0;
+    // worst case per wire: B txns x 2 passes x R ranges x 2 endpoints
+    KeyRef* refs = static_cast<KeyRef*>(
+        malloc(static_cast<size_t>(4 * B * R) * sizeof(KeyRef)));
+    for (int64_t k = 0; k < K_real; ++k) {
+        const uint8_t* blob = blobs[k];
+        const int64_t* offs = offs_list[k];
+        const int32_t* nr = nr_list[k];
+        const int32_t* nw = nw_list[k];
+        const int64_t base = k * B * R;
+        int64_t nref = 0;
+        int64_t key = 0;
+        for (int32_t i = 0; i < counts[k]; ++i) {
+            for (int32_t pass = 0; pass < 2; ++pass) {
+                const int32_t cnt = pass == 0 ? nr[i] : nw[i];
+                const int64_t seg_b = pass == 0 ? 0 : (compact ? seg : 2 * seg);
+                const int64_t seg_e = pass == 0 ? seg : 3 * seg;
+                for (int32_t j = 0; j < cnt; ++j) {
+                    refs[nref].p = blob + offs[key];
+                    refs[nref].len = offs[key + 1] - offs[key];
+                    refs[nref].dst = seg_b + base + i * R + j;
+                    ++nref;
+                    ++key;
+                    if (!compact) {
+                        refs[nref].p = blob + offs[key];
+                        refs[nref].len = offs[key + 1] - offs[key];
+                        refs[nref].dst = seg_e + base + i * R + j;
+                        ++nref;
+                    }
+                    ++key;
+                }
+            }
+        }
+        kd_ids_chunked(d, refs, nref, width, fused, upd_slots, upd_lanes,
+                       max_upd, &n_upd, &overflow);
+        if (overflow) { free(refs); return -(n_upd + 1); }
+    }
+    free(refs);
+    return n_upd;
 }
 
 }  // extern "C"

@@ -319,19 +319,40 @@ class EncodedConflictBackend:
 
     def resolve_group_wire_begin(self, wires: list, versions: list[int]):
         """Group resolve over serialized WireBatches (dictionary path):
-        no Python txn walk — concat + one native encode + one dispatch
-        per sub-group.  Requires the dict encoder; callers fall back to
-        resolve_group_begin on TxnRequests otherwise."""
+        no Python txn walk — ONE native group-driver call assembles ids,
+        snapshots and versions into a single fused buffer, shipped in a
+        single device_put per sub-group.  Requires the dict encoder;
+        callers fall back to resolve_group_begin on TxnRequests
+        otherwise."""
         assert self._dict is not None \
             and hasattr(self.cs, "resolve_group_submit_ids")
-        from .conflict_jax import GROUP_BUCKETS, UPD_BUCKETS
+        from .conflict_jax import (FUSED_UPD_BUCKETS, GROUP_BUCKETS,
+                                   UPD_BUCKETS)
         max_k = GROUP_BUCKETS[-1]
         d = self._dict
+        fused_ok = hasattr(d, "encode_group_fused") \
+            and hasattr(self.cs, "resolve_group_submit_fused")
         pending = []                        # (counts, verdict array)
         for start in range(0, len(wires), max_k):
             sub = wires[start:start + max_k]
             subv = versions[start:start + max_k]
             K = next(b for b in GROUP_BUCKETS if b >= len(sub))
+            if fused_ok:
+                enc = d.encode_group_fused(sub, self.B, self.R, K, subv)
+                if enc is None:
+                    self.cs.apply_dict_updates(d.upd_slots, d.upd_lanes,
+                                               d.n_upd)
+                    raise ValueError("update buffer overflow on wire path")
+                fused, counts, compact, off_pi, n_upd = enc
+                if n_upd > FUSED_UPD_BUCKETS[-1]:
+                    self.cs.apply_dict_updates(d.upd_slots, d.upd_lanes,
+                                               n_upd)
+                    n_upd = 0
+                U = next(b for b in FUSED_UPD_BUCKETS if b >= n_upd)
+                total = d.pack_updates_into(fused, off_pi, K, self.B, U)
+                pending.append((counts, self.cs.resolve_group_submit_fused(
+                    fused[:total], (K, self.B, self.R), compact, U)))
+                continue
             enc = d.encode_group_wire(sub, self.B, self.R, K)
             if enc is None:
                 # buffer overflow can't happen with a worst-case-sized

@@ -244,6 +244,86 @@ class DictEncoder:
         return self.encode_group_wire([wire_from_txns(c) for c in chunks],
                                       batch_size, ranges_per_txn, k_pad)
 
+    # --- fused single-buffer path (r4) ---
+
+    _N_FUSED_BUFS = 8   # rotated: device_put stages synchronously, but a
+    # deep in-flight pipeline must never observe a buffer being rewritten
+
+    def _fused_buf(self, words: int) -> np.ndarray:
+        bufs = getattr(self, "_fused_bufs", None)
+        if bufs is None or bufs[0].size < words:
+            bufs = [np.zeros(words, dtype=np.uint32)
+                    for _ in range(self._N_FUSED_BUFS)]
+            self._fused_bufs = bufs
+            self._fused_i = 0
+        self._fused_i = (self._fused_i + 1) % self._N_FUSED_BUFS
+        return bufs[self._fused_i]
+
+    def encode_group_fused(self, wires: list[WireBatch], batch_size: int,
+                           ranges_per_txn: int, k_pad: int,
+                           versions: list[int]):
+        """ONE native call does all group assembly: walks the K wires'
+        buffers in place (no Python concatenation), decides compactness,
+        encodes endpoint ids with prefetched hash probes, and writes
+        ids + snapshots + commit versions into one fused u32 buffer.
+        The caller ships ``fused[:total]`` as a SINGLE device_put.
+
+        Returns (fused_view, counts, compact, off_pi, n_upd) or None on
+        update-buffer overflow (same contract as encode_group_wire: the
+        partial updates are real and must still ship)."""
+        import ctypes
+        K, B, R = len(wires), batch_size, ranges_per_txn
+        self.begin_group()
+        # update region sized to the largest SHIPPABLE bucket, not
+        # max_upd: overflow past the bucket routes through
+        # apply_dict_updates with U=0, so fused never carries more
+        from .conflict_jax import FUSED_UPD_BUCKETS
+        u_cap = min(self.max_upd, FUSED_UPD_BUCKETS[-1])
+        words = 4 * k_pad * B * R + 2 + 2 * (k_pad * B + k_pad) \
+            + u_cap + self.L * u_cap
+        fused = self._fused_buf(words)
+        counts = np.fromiter((w.count for w in wires), np.int32, K)
+        vers = np.asarray(versions, dtype=np.int64)
+        PtrArr = ctypes.c_void_p * K
+        # bytes objects and numpy arrays stay referenced via `wires`/`holds`
+        holds = [np.ascontiguousarray(w.offs, dtype=np.int64) for w in wires]
+        holds_nr = [np.ascontiguousarray(w.nr, dtype=np.int32) for w in wires]
+        holds_nw = [np.ascontiguousarray(w.nw, dtype=np.int32) for w in wires]
+        holds_sn = [np.ascontiguousarray(w.snapshots, dtype=np.int64)
+                    for w in wires]
+        blobs = PtrArr(*(ctypes.cast(ctypes.c_char_p(w.blob), ctypes.c_void_p)
+                         for w in wires))
+        offs_l = PtrArr(*(a.ctypes.data for a in holds))
+        nr_l = PtrArr(*(a.ctypes.data for a in holds_nr))
+        nw_l = PtrArr(*(a.ctypes.data for a in holds_nw))
+        sn_l = PtrArr(*(a.ctypes.data for a in holds_sn))
+        compact_out = np.zeros(1, dtype=np.int64)
+        off_pi_out = np.zeros(1, dtype=np.int64)
+        rc = self._lib.kc_encode_group_fused(
+            self._h, blobs, offs_l, nr_l, nw_l, sn_l, counts, vers,
+            K, k_pad, B, R, self.width, fused,
+            self.upd_slots, self.upd_lanes, self.max_upd,
+            compact_out, off_pi_out)
+        del holds, holds_nr, holds_nw, holds_sn
+        if rc < 0:
+            self.n_upd = -(rc + 1)
+            return None
+        self.n_upd = int(rc)
+        return fused, counts, bool(compact_out[0]), int(off_pi_out[0]), \
+            int(rc)
+
+    def pack_updates_into(self, fused: np.ndarray, off_pi: int, k_pad: int,
+                          batch_size: int, U: int) -> int:
+        """Append the update block after the pi64 region and return the
+        total word count to ship.  Slots past n_upd are 0 (sentinel slot)
+        with sentinel lanes — a no-op scatter by construction."""
+        off_upd = off_pi + 2 * (k_pad * batch_size + k_pad)
+        if U:
+            fused[off_upd:off_upd + U] = self.upd_slots[:U]
+            fused[off_upd + U:off_upd + U + self.L * U].reshape(
+                self.L, U)[:] = self.upd_lanes[:, :U]
+        return off_upd + U + self.L * U
+
 
 @dataclasses.dataclass
 class EncodedBatch:

@@ -375,6 +375,63 @@ def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
     return st, dct2, verdicts
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("shape", "width", "window", "compact",
+                                    "U"),
+                   donate_argnums=(0, 1))
+def resolve_many_fused(state: ConflictState, dct, fused, *, shape,
+                       width: int = DEFAULT_WIDTH, window: int = 0,
+                       compact: bool = False, U: int = 0):
+    """resolve_many_ids on ONE fused input buffer.
+
+    The axon tunnel charges ~0.5ms fixed per device_put call on top of
+    ~2us/KB, so the whole group — endpoint ids, snapshots+versions (i64
+    as u32 pairs, bitcast on device), and the dictionary update block —
+    rides in a single u32 transfer written by the native group driver
+    (native/keycodec.cpp kc_encode_group_fused).  Layout:
+
+        [0, nids)                  ids; nids = (compact?2:4)*K*B*R
+        [off_pi, off_pi+npi)       snapshots [K*B] + versions [K] as
+                                   little-endian u32 pairs
+        [off_upd, ...)             upd_slots [U] | upd_lanes [L, U]
+
+    ``U`` is the bucketed update count (0 = skip the dictionary scatter
+    entirely — the steady-state hot path on a warm dictionary)."""
+    K, B, R, L = shape
+    n = K * B * R
+    nids = (2 if compact else 4) * n
+    off_pi = (nids + 1) // 2 * 2
+    npi = 2 * (K * B + K)
+    off_upd = off_pi + npi
+    if U:
+        upd_slots = fused[off_upd:off_upd + U]
+        upd_lanes = fused[off_upd + U:off_upd + U + L * U].reshape(L, U)
+        dct2 = dct.at[:, upd_slots].set(upd_lanes)
+    else:
+        dct2 = dct
+    pi64 = lax.bitcast_convert_type(
+        fused[off_pi:off_pi + npi].reshape(K * B + K, 2), jnp.int64)
+
+    def gather(a, b):
+        return dct2[:, fused[a:b]].T.reshape(K, B, R, L)
+
+    if compact:
+        rb = gather(0, n)
+        wb = gather(n, 2 * n)
+        re = _point_end(rb, width)
+        we = _point_end(wb, width)
+    else:
+        rb = gather(0, n)
+        re = gather(n, 2 * n)
+        wb = gather(2 * n, 3 * n)
+        we = gather(3 * n, 4 * n)
+    sn = pi64[:K * B].reshape(K, B)
+    cvs = pi64[K * B:]
+    st, verdicts = resolve_many_core(state, rb, re, wb, we, sn, cvs,
+                                     width=width, window=window)
+    return st, dct2, verdicts
+
+
 def _point_end(x, width):
     """Lane rows of k+'\\0' derived from k's: identical data lanes (the
     appended NUL is already the zero padding), length lane + 1 clamped to
@@ -410,6 +467,10 @@ GROUP_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 # update-count buckets compiled for resolve_many_ids: fine enough that a
 # warm dictionary ships little padding, coarse enough to bound compiles
 UPD_BUCKETS = (1024, 4096, 16384, 32768)
+
+# fused-path buckets add 0 (warm dictionary: skip the scatter entirely)
+# and 256 (trickle of new endpoints) — the steady-state hot sizes
+FUSED_UPD_BUCKETS = (0, 256, 1024, 4096, 16384, 32768)
 
 
 class JaxConflictSet:
@@ -608,6 +669,22 @@ class JaxConflictSet:
             put(np.array(upd_lanes[:, :U], copy=True)),
             put(pi64), shape=(K, B, R, L), width=self.width,
             window=self.window, compact=compact)
+        self._start_d2h(verdicts)
+        return verdicts
+
+    def resolve_group_submit_fused(self, fused: np.ndarray, shape: tuple,
+                                   compact: bool, U: int) -> jax.Array:
+        """Single-transfer group dispatch: ``fused`` is the complete
+        layout written by the native group driver + the update block
+        (see resolve_many_fused).  One device_put, one jit call."""
+        assert self.dict_slots, "dictionary disabled"
+        K, B, R = shape
+        self._ensure_state(B, R)
+        L = keycode.nlanes(self.width)
+        dev = jax.device_put(fused, self.device)
+        self.state, self._dct, verdicts = resolve_many_fused(
+            self.state, self._dct, dev, shape=(K, B, R, L),
+            width=self.width, window=self.window, compact=compact, U=U)
         self._start_d2h(verdicts)
         return verdicts
 

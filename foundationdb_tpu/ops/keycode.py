@@ -116,6 +116,22 @@ def _keycodec():
                 np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
             ]
             lib.kc_encode_group_ids2.restype = ctypes.c_int64
+            pvp = ctypes.POINTER(ctypes.c_void_p)
+            lib.kc_encode_group_fused.argtypes = [
+                ctypes.c_void_p,
+                pvp,                         # blobs: array of byte ptrs
+                pvp,                         # offs_list
+                pvp, pvp,                    # nr_list, nw_list
+                pvp,                         # snaps_list
+                i32p,                        # counts
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64,
+                u32p, u32p, u32p, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ]
+            lib.kc_encode_group_fused.restype = ctypes.c_int64
             _kc_lib = lib
         except Exception:           # noqa: BLE001 — numpy fallback below
             _kc_lib = False
