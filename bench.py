@@ -191,7 +191,9 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
 
     from foundationdb_tpu.ops.batch import wire_from_txns
 
-    GROUP, INFLIGHT = 64, 8
+    # K=128 fused groups amortize per-dispatch cost; at B=64 R=2 one
+    # group exactly tiles the 2^14-slot ring (measured best, r4)
+    GROUP, INFLIGHT = 128, 8
     wl = MakoWorkload(n_keys=n_keys, seed=42)
     batches, versions = wl.make_batches(n_batches, batch_size)
     # the proxy-serialized form of the same batches (built where a proxy
@@ -222,6 +224,10 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
         # cpp baseline wouldn't (verdict parity is asserted below).
         CONFLICT_RING_CAPACITY=1 << 14,
         KEY_ENCODE_BYTES=32,
+        # window 1024 >= the MVCC span mako needs; the exact fast path
+        # covers every batch and the compare cost scales with the window
+        # (r4 sweep: 1024 beats the 4096 default by ~8%)
+        CONFLICT_WINDOW_SLOTS=1024,
     )
 
     results = {}

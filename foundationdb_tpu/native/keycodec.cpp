@@ -98,11 +98,16 @@ void kc_encode_batch(const uint8_t* flat, const int64_t* offs,
 
 namespace {
 
+struct KcEntry {        // one cache-line-friendly probe unit (16B)
+    uint64_t h;             // 0 = empty, 1 = tombstone
+    uint32_t id;
+    uint32_t pad;
+};
+
 struct KcDict {
     int64_t slots;          // device capacity D; ids 1..slots-1 (0 = sentinel)
     int64_t table_cap;      // power of two
-    uint64_t* table_h;      // 0 = empty, 1 = tombstone
-    uint32_t* table_id;
+    KcEntry* table;         // packed hash+id: one miss per probe, not two
     uint8_t** slot_key;     // owned copy of each slot's endpoint bytes
     int32_t* slot_len;
     uint64_t* slot_stamp;   // group counter at last reference
@@ -123,10 +128,10 @@ inline uint64_t kd_hash(const uint8_t* k, int64_t len) {
 inline int64_t kd_find(KcDict* d, const uint8_t* k, int64_t len, uint64_t h) {
     const uint64_t mask = d->table_cap - 1;
     for (uint64_t i = h & mask;; i = (i + 1) & mask) {
-        const uint64_t th = d->table_h[i];
+        const uint64_t th = d->table[i].h;
         if (th == 0) return -1;
         if (th == h) {
-            const uint32_t id = d->table_id[i];
+            const uint32_t id = d->table[i].id;
             if (d->slot_len[id] == len &&
                 memcmp(d->slot_key[id], k, len) == 0)
                 return static_cast<int64_t>(i);
@@ -137,7 +142,7 @@ inline int64_t kd_find(KcDict* d, const uint8_t* k, int64_t len, uint64_t h) {
 inline int64_t kd_find_insert_pos(KcDict* d, uint64_t h) {
     const uint64_t mask = d->table_cap - 1;
     for (uint64_t i = h & mask;; i = (i + 1) & mask) {
-        const uint64_t th = d->table_h[i];
+        const uint64_t th = d->table[i].h;
         if (th == 0 || th == 1) {
             if (th == 1) --d->tombstones;
             return static_cast<int64_t>(i);
@@ -146,21 +151,17 @@ inline int64_t kd_find_insert_pos(KcDict* d, uint64_t h) {
 }
 
 void kd_rebuild(KcDict* d) {
-    uint64_t* oh = d->table_h;
-    uint32_t* oid = d->table_id;
+    KcEntry* ot = d->table;
     const int64_t ocap = d->table_cap;
-    d->table_h = static_cast<uint64_t*>(calloc(d->table_cap, 8));
-    d->table_id = static_cast<uint32_t*>(calloc(d->table_cap, 4));
+    d->table = static_cast<KcEntry*>(calloc(d->table_cap, sizeof(KcEntry)));
     d->tombstones = 0;
     for (int64_t i = 0; i < ocap; ++i) {
-        if (oh[i] > 1) {
-            const int64_t j = kd_find_insert_pos(d, oh[i]);
-            d->table_h[j] = oh[i];
-            d->table_id[j] = oid[i];
+        if (ot[i].h > 1) {
+            const int64_t j = kd_find_insert_pos(d, ot[i].h);
+            d->table[j] = ot[i];
         }
     }
-    free(oh);
-    free(oid);
+    free(ot);
 }
 
 void kd_remove(KcDict* d, uint32_t id) {
@@ -169,7 +170,7 @@ void kd_remove(KcDict* d, uint32_t id) {
     const uint64_t h = kd_hash(k, d->slot_len[id]);
     const int64_t i = kd_find(d, k, d->slot_len[id], h);
     if (i >= 0) {
-        d->table_h[i] = 1;                          // tombstone
+        d->table[i].h = 1;                          // tombstone
         ++d->tombstones;
         --d->live;
     }
@@ -188,8 +189,7 @@ void* kc_dict_new(int64_t slots) {
     int64_t cap = 64;
     while (cap < slots * 4) cap <<= 1;
     d->table_cap = cap;
-    d->table_h = static_cast<uint64_t*>(calloc(cap, 8));
-    d->table_id = static_cast<uint32_t*>(calloc(cap, 4));
+    d->table = static_cast<KcEntry*>(calloc(cap, sizeof(KcEntry)));
     d->slot_key = static_cast<uint8_t**>(calloc(slots, sizeof(uint8_t*)));
     d->slot_len = static_cast<int32_t*>(calloc(slots, 4));
     d->slot_stamp = static_cast<uint64_t*>(calloc(slots, 8));
@@ -204,8 +204,7 @@ void kc_dict_free(void* p) {
     free(d->slot_key);
     free(d->slot_len);
     free(d->slot_stamp);
-    free(d->table_h);
-    free(d->table_id);
+    free(d->table);
     free(d);
 }
 
@@ -234,7 +233,7 @@ inline uint32_t kd_id_h(KcDict* d, const uint8_t* k, int64_t len,
                         int64_t* n_upd, int* overflow) {
     const int64_t found = kd_find(d, k, len, h);
     if (found >= 0) {
-        const uint32_t id = d->table_id[found];
+        const uint32_t id = d->table[found].id;
         d->slot_stamp[id] = d->group;
         return id;
     }
@@ -249,8 +248,8 @@ inline uint32_t kd_id_h(KcDict* d, const uint8_t* k, int64_t len,
     kd_remove(d, id);
     if ((d->live + d->tombstones) * 2 > d->table_cap) kd_rebuild(d);
     const int64_t pos = kd_find_insert_pos(d, h);
-    d->table_h[pos] = h;
-    d->table_id[pos] = id;
+    d->table[pos].h = h;
+    d->table[pos].id = id;
     d->slot_key[id] = static_cast<uint8_t*>(malloc(len ? len : 1));
     memcpy(d->slot_key[id], k, len);
     d->slot_len[id] = static_cast<int32_t>(len);
@@ -499,8 +498,16 @@ inline int64_t kd_ids_chunked(KcDict* d, const KeyRef* refs, int64_t n,
         const int64_t m = n - base < CHUNK ? n - base : CHUNK;
         for (int64_t j = 0; j < m; ++j) {
             h[j] = kd_hash(refs[base + j].p, refs[base + j].len);
-            __builtin_prefetch(&d->table_h[h[j] & mask], 0, 1);
-            __builtin_prefetch(&d->table_id[h[j] & mask], 0, 1);
+            __builtin_prefetch(&d->table[h[j] & mask], 0, 1);
+        }
+        // second wave: for probable hits, prefetch the confirm data
+        // (slot key bytes + stamp line) before the probe loop touches it
+        for (int64_t j = 0; j < m; ++j) {
+            const KcEntry& e = d->table[h[j] & mask];
+            if (e.h == h[j]) {
+                __builtin_prefetch(d->slot_key[e.id], 0, 1);
+                __builtin_prefetch(&d->slot_stamp[e.id], 1, 1);
+            }
         }
         for (int64_t j = 0; j < m; ++j) {
             const KeyRef& r = refs[base + j];
@@ -538,8 +545,9 @@ inline bool kd_wire_all_points(const uint8_t* blob, const int64_t* offs,
 extern "C" {
 
 // Fused group encoder.  Walks per-wire buffers (no concatenation):
-//   blobs[k], offs_list[k] (wire-local), counts[k]; nr/nw/versions are
-//   group-flat (nr/nw indexed by global txn t, snaps_list[k] per wire).
+//   blobs[k], offs_list[k], nr_list[k], nw_list[k], snaps_list[k] are
+//   ALL per-wire pointers indexed by wire-local txn i; counts[k] gives
+//   each wire's real txn count and versions[k] its commit version.
 // fused layout (u32 words), written here:
 //   [0, nids)            endpoint ids; nids = (compact?2:4)*K_pad*B*R
 //   [off_pi, off_pi+npi) snapshots [K_pad*B] + versions [K_pad] as i64

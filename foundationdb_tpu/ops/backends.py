@@ -344,11 +344,16 @@ class EncodedConflictBackend:
                                                d.n_upd)
                     raise ValueError("update buffer overflow on wire path")
                 fused, counts, compact, off_pi, n_upd = enc
-                if n_upd > FUSED_UPD_BUCKETS[-1]:
+                # the fused buffer's update region is sized to
+                # min(max_upd, largest bucket); a bucket past that
+                # capacity must ship out-of-band instead of overrunning
+                u_cap = min(d.max_upd, FUSED_UPD_BUCKETS[-1])
+                U = next((b for b in FUSED_UPD_BUCKETS if b >= n_upd),
+                         None)
+                if U is None or U > u_cap:
                     self.cs.apply_dict_updates(d.upd_slots, d.upd_lanes,
                                                n_upd)
-                    n_upd = 0
-                U = next(b for b in FUSED_UPD_BUCKETS if b >= n_upd)
+                    U = 0
                 total = d.pack_updates_into(fused, off_pi, K, self.B, U)
                 pending.append((counts, self.cs.resolve_group_submit_fused(
                     fused[:total], (K, self.B, self.R), compact, U)))
@@ -381,7 +386,7 @@ class EncodedConflictBackend:
                 else:
                     host = await _DeviceSyncWorker.shared().run(np.asarray, v)
                 for k, cnt in enumerate(counts):
-                    out.append([int(x) for x in host[k][:cnt]])
+                    out.append(host[k][:cnt].tolist())
             return out
 
         return finish()

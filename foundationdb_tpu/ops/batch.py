@@ -273,6 +273,13 @@ class DictEncoder:
         partial updates are real and must still ship)."""
         import ctypes
         K, B, R = len(wires), batch_size, ranges_per_txn
+        # the C driver's buffers assume every wire fits the kernel shape;
+        # out-of-bound counts must raise here, not corrupt native heap
+        for w in wires:
+            if w.count > B:
+                raise ValueError(f"wire batch of {w.count} exceeds {B}")
+            if len(w.nr) and (int(w.nr.max()) > R or int(w.nw.max()) > R):
+                raise ValueError(f"wire range count exceeds bucket {R}")
         self.begin_group()
         # update region sized to the largest SHIPPABLE bucket, not
         # max_upd: overflow past the bucket routes through

@@ -147,12 +147,82 @@ def _hist_check_T(rb, re, hbT, heT, hver, snap, width):
 
 
 # --------------------------------------------------------------------------
+# the sequential commit chain as a Pallas SMEM kernel (TPU only)
+
+
+def _pallas_for_platform(platform: str) -> bool:
+    """Pallas chain on real TPU platforms; the unrolled XLA chain on CPU
+    (identical integer semantics — the cross-backend parity tests hold
+    either way).  Decided per conflict set from ITS device, not the
+    process default backend (a CPU-placed twin in a TPU process must not
+    trace Mosaic).  Overridable for A/B measurement via FDBTPU_PALLAS=0."""
+    import os
+    flag = os.environ.get("FDBTPU_PALLAS", "auto")
+    if flag in ("0", "off"):
+        return False
+    if flag in ("1", "on"):
+        return True
+    return platform not in ("cpu",)
+
+
+@functools.cache
+def _chain_kernel_call(B: int, nw: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(packed_ref, flags_ref, out_ref):
+        # packed_ref [B, nw] i32; flags_ref [B, 2] i32 (hist, ok);
+        # out_ref [B] i32 conf flags.  Pure SMEM scalar loop — an int32
+        # while_loop (fori's int64 index under x64 trips Mosaic's
+        # convert_element_type lowering).
+        def cond(c):
+            return c[0] < B
+
+        def body(c):
+            i = c[0]
+            cw = c[1:]
+            hit = jnp.int32(0)
+            for w in range(nw):
+                hit = hit | (cw[w] & packed_ref[i, w])
+            conf = (flags_ref[i, 0] != 0) | (hit != 0)
+            commit = (flags_ref[i, 1] != 0) & ~conf
+            bit = jax.lax.shift_left(jnp.int32(1), i % 32)
+            wi = i // 32
+            new = tuple(
+                jnp.where(commit & (wi == w), cw[w] | bit, cw[w])
+                for w in range(nw))
+            out_ref[i] = jnp.where(conf, jnp.int32(1), jnp.int32(0))
+            return (i + jnp.int32(1),) + new
+        jax.lax.while_loop(cond, body, (jnp.int32(0),) * (nw + 1))
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B,), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+
+
+def _chain_pallas(packed, hist_conflict, ok, B: int, nw: int):
+    flags = jnp.stack([hist_conflict, ok], axis=1).astype(jnp.int32)
+    packed = packed.astype(jnp.int32)
+    # trace the pallas call with x64 OFF: this jax version's Mosaic
+    # lowering recurses on the index converts x64 mode inserts, and the
+    # axon PJRT x64-rewrite rejects s64 at custom-call boundaries — the
+    # kernel is pure int32 either way
+    with jax.enable_x64(False):
+        conf = _chain_kernel_call(B, nw)(packed, flags)
+    return conf.astype(bool)
+
+
+# --------------------------------------------------------------------------
 # single-batch core
 
 
 def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
                  write_end, snap, commit_version, *, width: int = DEFAULT_WIDTH,
-                 window: int = 0):
+                 window: int = 0, pallas: bool = False):
     """One resolve step: (state, batch) -> (state', verdicts[B] int8).
 
     Pure traceable core shared by the single-chip jit (``resolve_step``),
@@ -213,10 +283,14 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
                  width)
     M = m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
 
-    # 3. in-order commit resolution as a fully unrolled scalar bitmask
-    # chain: committed txns are bits in uint32 words; each step is a
-    # couple of scalar ALU ops (an under-filled [B]-vector lax.scan
-    # measured ~2.7x slower, bench/profile_kernel4.py).
+    # 3. in-order commit resolution (txn i conflicts with any committed
+    # j<i whose writes overlap its reads) — inherently sequential.  On a
+    # real TPU this runs as a tiny Pallas SMEM kernel (the XLA-compiled
+    # unrolled scalar chain measured ~66us/batch — each step's
+    # vector<->scalar extracts dominate; the same loop over SMEM scalars
+    # is ~100x cheaper).  On CPU backends the unrolled uint32-word chain
+    # remains: both compute identical integers, so verdicts are
+    # bit-identical across backends (the parity gate).
     nw = (B + 31) // 32
     Bpad = nw * 32
     Mp = jnp.pad(M, ((0, 0), (0, Bpad - B)))
@@ -224,22 +298,25 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
         Mp.reshape(B, nw, 32).astype(jnp.uint32)
         << jnp.arange(32, dtype=jnp.uint32)[None, None, :], axis=-1)  # [B, nw]
     ok = valid & ~too_old
-    cw = [jnp.uint32(0)] * nw
-    confw = [jnp.uint32(0)] * nw
-    for i in range(B):
-        hit = cw[0] & packed[i, 0]
-        for w in range(1, nw):
-            hit = hit | (cw[w] & packed[i, w])
-        conf = hist_conflict[i] | (hit != jnp.uint32(0))
-        commit = ok[i] & ~conf
-        wi, bi = divmod(i, 32)
-        bit = jnp.uint32(1 << bi)
-        cw[wi] = cw[wi] | jnp.where(commit, bit, jnp.uint32(0))
-        confw[wi] = confw[wi] | jnp.where(conf, bit, jnp.uint32(0))
-    # unpack the conf bit words vectorized (cheaper than stacking B scalars)
-    shifts = jnp.arange(32, dtype=jnp.uint32)
-    conf_vec = jnp.concatenate(
-        [(w >> shifts) & jnp.uint32(1) for w in confw])[:B].astype(bool)
+    if pallas:
+        conf_vec = _chain_pallas(packed, hist_conflict, ok, B, nw)
+    else:
+        cw = [jnp.uint32(0)] * nw
+        confw = [jnp.uint32(0)] * nw
+        for i in range(B):
+            hit = cw[0] & packed[i, 0]
+            for w in range(1, nw):
+                hit = hit | (cw[w] & packed[i, w])
+            conf = hist_conflict[i] | (hit != jnp.uint32(0))
+            commit = ok[i] & ~conf
+            wi, bi = divmod(i, 32)
+            bit = jnp.uint32(1 << bi)
+            cw[wi] = cw[wi] | jnp.where(commit, bit, jnp.uint32(0))
+            confw[wi] = confw[wi] | jnp.where(conf, bit, jnp.uint32(0))
+        # unpack the conf bit words vectorized (cheaper than B scalar stacks)
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        conf_vec = jnp.concatenate(
+            [(w >> shifts) & jnp.uint32(1) for w in confw])[:B].astype(bool)
     committed = ok & ~conf_vec
     verdicts = jnp.where(~valid, COMMITTED,
                          jnp.where(too_old, TOO_OLD,
@@ -277,7 +354,8 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
 
 def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
                       write_end, snap, commit_versions, *,
-                      width: int = DEFAULT_WIDTH, window: int = 0):
+                      width: int = DEFAULT_WIDTH, window: int = 0,
+                      pallas: bool = False):
     """K fused batches in one dispatch: inputs [K,B,R,L] / [K,B] / [K].
 
     Exactly equivalent to K sequential resolve_core calls (the scan
@@ -287,23 +365,28 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
     def body(st, x):
         rb, re, wb, we, sn, cv = x
         st2, verdicts = resolve_core(st, rb, re, wb, we, sn, cv,
-                                     width=width, window=window)
+                                     width=width, window=window,
+                                     pallas=pallas)
         return st2, verdicts
 
     return lax.scan(body, state, (read_begin, read_end, write_begin,
                                   write_end, snap, commit_versions))
 
 
-resolve_step = functools.partial(jax.jit, static_argnames=("width", "window"),
-                                 donate_argnums=(0,))(resolve_core)
-resolve_many = functools.partial(jax.jit, static_argnames=("width", "window"),
-                                 donate_argnums=(0,))(resolve_many_core)
+resolve_step = functools.partial(
+    jax.jit, static_argnames=("width", "window", "pallas"),
+    donate_argnums=(0,))(resolve_core)
+resolve_many = functools.partial(
+    jax.jit, static_argnames=("width", "window", "pallas"),
+    donate_argnums=(0,))(resolve_many_core)
 
 
-@functools.partial(jax.jit, static_argnames=("shape", "width", "window"),
+@functools.partial(jax.jit,
+                   static_argnames=("shape", "width", "window", "pallas"),
                    donate_argnums=(0,))
 def resolve_many_packed(state: ConflictState, pu32, pi64, *, shape,
-                        width: int = DEFAULT_WIDTH, window: int = 0):
+                        width: int = DEFAULT_WIDTH, window: int = 0,
+                        pallas: bool = False):
     """resolve_many on single-buffer inputs.
 
     The axon tunnel moves one big transfer at ~150MB/s but many small ones
@@ -323,15 +406,17 @@ def resolve_many_packed(state: ConflictState, pu32, pi64, *, shape,
     sn = pi64[:K * B].reshape(K, B)
     cvs = pi64[K * B:]
     return resolve_many_core(state, rb, re, wb, we, sn, cvs,
-                             width=width, window=window)
+                             width=width, window=window, pallas=pallas)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("shape", "width", "window", "compact"),
+                   static_argnames=("shape", "width", "window", "compact",
+                                    "pallas"),
                    donate_argnums=(0, 1))
 def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
                      pi64, *, shape, width: int = DEFAULT_WIDTH,
-                     window: int = 0, compact: bool = False):
+                     window: int = 0, compact: bool = False,
+                     pallas: bool = False):
     """resolve_many on dictionary-compressed inputs.
 
     The device keeps every recently-seen range endpoint's lane row in a
@@ -377,11 +462,12 @@ def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
 
 @functools.partial(jax.jit,
                    static_argnames=("shape", "width", "window", "compact",
-                                    "U"),
+                                    "U", "pallas"),
                    donate_argnums=(0, 1))
 def resolve_many_fused(state: ConflictState, dct, fused, *, shape,
                        width: int = DEFAULT_WIDTH, window: int = 0,
-                       compact: bool = False, U: int = 0):
+                       compact: bool = False, U: int = 0,
+                       pallas: bool = False):
     """resolve_many_ids on ONE fused input buffer.
 
     The axon tunnel charges ~0.5ms fixed per device_put call on top of
@@ -428,7 +514,8 @@ def resolve_many_fused(state: ConflictState, dct, fused, *, shape,
     sn = pi64[:K * B].reshape(K, B)
     cvs = pi64[K * B:]
     st, verdicts = resolve_many_core(state, rb, re, wb, we, sn, cvs,
-                                     width=width, window=window)
+                                     width=width, window=window,
+                                     pallas=pallas)
     return st, dct2, verdicts
 
 
@@ -462,7 +549,7 @@ def set_oldest_step(state: ConflictState, v) -> ConflictState:
 
 # group sizes compiled for resolve_many; a group of k batches is padded up
 # to the next bucket with ring-neutral padding batches (commit_version=-1)
-GROUP_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+GROUP_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 # update-count buckets compiled for resolve_many_ids: fine enough that a
 # warm dictionary ships little padding, coarse enough to bound compiles
@@ -494,6 +581,10 @@ class JaxConflictSet:
         self.device = device
         self.window = window
         self.dict_slots = dict_slots
+        # pallas chain decided by THIS set's device platform, not the
+        # process default (a CPU-placed twin must not trace Mosaic)
+        self._pallas = _pallas_for_platform(
+            device.platform if device is not None else jax.default_backend())
         self.state: ConflictState | None = None
         self._dct = None                # [L, D] device lane dictionary
         self._init_floor = oldest_version
@@ -575,7 +666,7 @@ class JaxConflictSet:
             self.state, put(eb.read_begin), put(eb.read_end),
             put(eb.write_begin), put(eb.write_end),
             put(eb.read_snapshot), jnp.int64(commit_version),
-            width=self.width, window=self.window)
+            width=self.width, window=self.window, pallas=self._pallas)
         self._start_d2h(verdicts)
         return verdicts
 
@@ -608,7 +699,7 @@ class JaxConflictSet:
         put = functools.partial(jax.device_put, device=self.device)
         self.state, verdicts = resolve_many_packed(
             self.state, put(pu32), put(pi64), shape=(K, B, R, L),
-            width=self.width, window=self.window)
+            width=self.width, window=self.window, pallas=self._pallas)
         self._start_d2h(verdicts)
         return verdicts
 
@@ -668,7 +759,7 @@ class JaxConflictSet:
             put(np.array(upd_slots[:U], copy=True)),
             put(np.array(upd_lanes[:, :U], copy=True)),
             put(pi64), shape=(K, B, R, L), width=self.width,
-            window=self.window, compact=compact)
+            window=self.window, compact=compact, pallas=self._pallas)
         self._start_d2h(verdicts)
         return verdicts
 
@@ -684,7 +775,8 @@ class JaxConflictSet:
         dev = jax.device_put(fused, self.device)
         self.state, self._dct, verdicts = resolve_many_fused(
             self.state, self._dct, dev, shape=(K, B, R, L),
-            width=self.width, window=self.window, compact=compact, U=U)
+            width=self.width, window=self.window, compact=compact, U=U,
+            pallas=self._pallas)
         self._start_d2h(verdicts)
         return verdicts
 
