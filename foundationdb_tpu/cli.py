@@ -207,16 +207,12 @@ class Cli:
             await self.run_txn(do)
             return f"Servers {cmd}d (takes effect at the next recovery)"
         if cmd == "configure":
-            from .core.system_data import CONF_FIELDS, conf_key
+            from .core.system_data import conf_key, validate_conf
 
             async def do(tr):
                 for part in args:
                     name, _, val = part.partition("=")
-                    if name not in CONF_FIELDS:
-                        raise ValueError(f"unknown configure field {name!r}; "
-                                         f"one of {CONF_FIELDS}")
-                    int(val)        # validate
-                    tr.set(conf_key(name), val.encode())
+                    tr.set(conf_key(name), validate_conf(name, val))
             await self.run_txn(do)
             return "Configuration changed (takes effect at the next recovery)"
         if cmd == "status" and args and args[0] == "json":

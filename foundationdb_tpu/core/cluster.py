@@ -104,12 +104,10 @@ class Cluster:
         the restart-resume half of checkpoint/resume (SURVEY.md §5.4(a))."""
         if fs is None or data_dir is None:
             return cls(config, knobs)
-        from ..storage.kv_store import MemoryKVStore
-        from ..storage.lsm import LSMKVStore
+        from ..storage import engine_class
         config = config or ClusterConfig()
         knobs = knobs or KNOBS
-        engine_cls = {"memory": MemoryKVStore,
-                      "lsm": LSMKVStore}[knobs.STORAGE_ENGINE]
+        engine_cls = engine_class(knobs.STORAGE_ENGINE)
         tlogs = [await TLog.open(knobs, fs, f"{data_dir}/tlog-{i}.dq")
                  for i in range(config.logs)]
         engines = {}

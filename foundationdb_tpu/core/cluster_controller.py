@@ -50,6 +50,9 @@ class ClusterConfigSpec:
     replication: int = 1          # storage replicas per shard
     log_replication: int = 2
     min_workers: int = 1          # recovery waits until this many registered
+    # desired IKeyValueStore engine for storage recruits; None = the
+    # worker's own STORAGE_ENGINE knob (set via `configure storage_engine=`)
+    storage_engine: str | None = None
 
 
 class ClusterController:
@@ -407,10 +410,11 @@ class ClusterController:
                             raise FdbError("no live source for moved shard")
                         wa = pick(30 + si)
                         si += 1
+                        eng = spec.storage_engine or self.knobs.STORAGE_ENGINE
                         a, t = await self._recruit(wa, "storage", {
                             "tag": tag, "shard_begin": rng.begin,
                             "shard_end": rng.end, "v0": rv,
-                            "log_cfg": wire_log_cfg,
+                            "log_cfg": wire_log_cfg, "engine": eng,
                             "fetch_from": {"addr": src["addr"],
                                            "token": src["token"],
                                            "tag": src["tag"],
@@ -419,7 +423,7 @@ class ClusterController:
                             "fetch_version": rv})
                         storage_meta.append({
                             "worker": [wa.ip, wa.port], "addr": a,
-                            "token": t, "tag": tag,
+                            "token": t, "tag": tag, "engine": eng,
                             "begin": rng.begin, "end": rng.end})
                         active_tags.add(tag)
                         TraceEvent("StorageMoveRecruited").detail("Tag", tag) \
@@ -430,6 +434,7 @@ class ClusterController:
                          for s in range(spec.storage_servers)]
             shard_map = ShardMap.even(spec.storage_servers, team_tags)
             i = 0
+            eng = spec.storage_engine or self.knobs.STORAGE_ENGINE
             for rng, tags in shard_map.ranges():
                 for tag in tags:
                     wa = pick(i)
@@ -437,10 +442,10 @@ class ClusterController:
                     a, t = await self._recruit(wa, "storage", {
                         "tag": tag, "shard_begin": rng.begin,
                         "shard_end": rng.end, "v0": 0,
-                        "log_cfg": wire_log_cfg})
+                        "log_cfg": wire_log_cfg, "engine": eng})
                     storage_meta.append({
                         "worker": [wa.ip, wa.port], "addr": a,
-                        "token": t, "tag": tag,
+                        "token": t, "tag": tag, "engine": eng,
                         "begin": rng.begin, "end": rng.end})
                     active_tags.add(tag)
 

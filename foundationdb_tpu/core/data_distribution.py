@@ -144,6 +144,24 @@ class DataDistributor:
                              [list(t) for t in layout["teams"]])
         next_tag = max(by_tag, default=-1) + 1
 
+        # --- engine migration: `configure storage_engine=X` makes every
+        # shard whose replicas run a different engine relocate onto
+        # freshly-recruited X-engine servers, one shard per round
+        # (REF:fdbclient/ManagementAPI.actor.cpp changeStorageType →
+        # DD gradually replaces wrong-store-type servers) ---
+        desired = await self._desired_engine()
+        if desired is not None:
+            for idx, (rng, team) in enumerate(shard_map.ranges()):
+                if any(by_tag[t].get("engine",
+                                     self.knobs.STORAGE_ENGINE) != desired
+                       for t in team if t in by_tag):
+                    await self._relocate(state, layout, idx, next_tag,
+                                         split_key=None, engine=desired)
+                    return
+            # all shards already on the desired engine: splits below must
+            # also recruit on it or each split's suffix would live-move a
+            # second time at the next mismatch scan
+
         for idx, (rng, team) in enumerate(shard_map.ranges()):
             sizes = []
             for tag in team:
@@ -166,9 +184,21 @@ class DataDistributor:
                 rng.begin, rng.end)
             if not split_key:
                 continue
-            await self._live_split(state, layout, idx, bytes(split_key),
-                                   next_tag)
+            await self._relocate(state, layout, idx, next_tag,
+                                 split_key=bytes(split_key), engine=desired)
             return                  # one relocation per round
+
+    async def _desired_engine(self) -> str | None:
+        from .system_data import conf_key
+        try:
+            raw = await self.db.get(conf_key("storage_engine"))
+        except Exception:  # noqa: BLE001 — unreadable conf: skip this round
+            return None
+        if not raw:
+            return None
+        from ..storage import ENGINE_NAMES
+        name = bytes(raw).decode(errors="replace")
+        return name if name in ENGINE_NAMES else None
 
     async def _current_layout(self, state: dict) -> dict | None:
         from ..rpc.wire import decode
@@ -185,31 +215,48 @@ class DataDistributor:
 
     # --- the live relocation protocol ---
 
-    async def _live_split(self, state: dict, layout: dict, idx: int,
-                          split_key: bytes, next_tag: int) -> None:
+    async def _relocate(self, state: dict, layout: dict, idx: int,
+                        next_tag: int, split_key: bytes | None = None,
+                        engine: str | None = None) -> None:
+        """Live-relocate shard ``idx``: with ``split_key`` the suffix
+        [split_key, end) moves to a fresh team (a split); without, the
+        WHOLE shard moves (manual move / engine migration).  ``engine``
+        recruits the destinations on a specific IKeyValueStore type."""
         rng = ShardMap([bytes(b) for b in layout["boundaries"]],
                        [list(t) for t in layout["teams"]]).shard_range(idx)
-        if not rng.begin < split_key < rng.end:
+        if split_key is not None and not rng.begin < split_key < rng.end:
             return
         src_team = list(layout["teams"][idx])
         dest_tags = [next_tag + i for i in range(len(src_team))]
         epoch0 = self.cc.epoch
-        move_rng = KeyRange(split_key, rng.end)
+        move_rng = (KeyRange(split_key, rng.end) if split_key is not None
+                    else rng)
+        # the index of the (possibly split-off) moving shard in the new
+        # layout: a split inserts a boundary so the suffix is idx+1
+        midx = idx + 1 if split_key is not None else idx
 
         # --- phase 1: startMove (dual-tagged write team) ---
-        start_layout = {
-            "boundaries": [*layout["boundaries"][:idx], split_key,
-                           *layout["boundaries"][idx:]],
-            "teams": [*(list(t) for t in layout["teams"][:idx]),
-                      src_team, src_team + dest_tags,
-                      *(list(t) for t in layout["teams"][idx + 1:])],
-            "moves": [{"begin": split_key, "end": rng.end, "src": src_team,
-                       "dest": dest_tags, "state": "in"}],
-        }
+        if split_key is not None:
+            start_layout = {
+                "boundaries": [*layout["boundaries"][:idx], split_key,
+                               *layout["boundaries"][idx:]],
+                "teams": [*(list(t) for t in layout["teams"][:idx]),
+                          src_team, src_team + dest_tags,
+                          *(list(t) for t in layout["teams"][idx + 1:])],
+            }
+        else:
+            start_layout = {
+                "boundaries": list(layout["boundaries"]),
+                "teams": [list(t) for t in layout["teams"]],
+            }
+            start_layout["teams"][midx] = src_team + dest_tags
+        start_layout["moves"] = [{"begin": move_rng.begin,
+                                  "end": move_rng.end, "src": src_team,
+                                  "dest": dest_tags, "state": "in"}]
         vs = await self._commit_layout(start_layout)
-        TraceEvent("DDMoveStarted").detail("Begin", split_key) \
-            .detail("End", rng.end).detail("Vs", vs) \
-            .detail("DestTags", dest_tags).log()
+        TraceEvent("DDMoveStarted").detail("Begin", move_rng.begin) \
+            .detail("End", move_rng.end).detail("Vs", vs) \
+            .detail("DestTags", dest_tags).detail("Engine", engine).log()
 
         dest_info: list[dict] = []
         try:
@@ -221,9 +268,9 @@ class DataDistributor:
                 wa = self._pick_worker(avoid=chosen)
                 chosen.add(wa.ip)
                 a, t = await self.cc._recruit(wa, "storage", {
-                    "tag": tag, "shard_begin": split_key,
-                    "shard_end": rng.end, "v0": vs,
-                    "log_cfg": wire_log_cfg,
+                    "tag": tag, "shard_begin": move_rng.begin,
+                    "shard_end": move_rng.end, "v0": vs,
+                    "log_cfg": wire_log_cfg, "engine": engine,
                     "fetch_from": {"addr": src_entry["addr"],
                                    "token": src_entry["token"],
                                    "tag": src_entry["tag"],
@@ -232,7 +279,10 @@ class DataDistributor:
                     "fetch_version": vs})
                 dest_info.append({"worker": [wa.ip, wa.port], "addr": a,
                                   "token": t, "tag": tag,
-                                  "begin": split_key, "end": rng.end})
+                                  "engine": engine
+                                  or self.knobs.STORAGE_ENGINE,
+                                  "begin": move_rng.begin,
+                                  "end": move_rng.end})
             await self._wait_caught_up(dest_info, vs, epoch0)
         except asyncio.CancelledError:
             # the distributor is being stopped (CC deposed / shutdown):
@@ -241,7 +291,7 @@ class DataDistributor:
             # rollback safe at the next recovery or DD round
             raise
         except Exception as e:
-            await self._abort_move(start_layout, idx, src_team, dest_info,
+            await self._abort_move(start_layout, midx, src_team, dest_info,
                                    epoch0)
             TraceEvent("DDMoveAborted", severity=30) \
                 .detail("Error", repr(e)[:200]).log()
@@ -251,11 +301,11 @@ class DataDistributor:
         flip_layout = {
             "boundaries": list(start_layout["boundaries"]),
             "teams": [list(t) for t in start_layout["teams"]],
-            "moves": [{"begin": split_key, "end": rng.end, "src": src_team,
-                       "dest": dest_tags, "state": "flip",
+            "moves": [{"begin": move_rng.begin, "end": move_rng.end,
+                       "src": src_team, "dest": dest_tags, "state": "flip",
                        "dest_info": dest_info}],
         }
-        flip_layout["teams"][idx + 1] = list(dest_tags)
+        flip_layout["teams"][midx] = list(dest_tags)
         vf = await self._commit_layout(flip_layout)
 
         # --- publish so clients re-route reads, then clear the journal.
@@ -267,10 +317,41 @@ class DataDistributor:
         await self._commit_layout({
             "boundaries": list(flip_layout["boundaries"]),
             "teams": [list(t) for t in flip_layout["teams"]]})
-        self.splits_done += 1
+        if split_key is not None:
+            self.splits_done += 1
         self.live_moves_done += 1
-        TraceEvent("DDMoveComplete").detail("Begin", split_key) \
-            .detail("End", rng.end).detail("Vf", vf).log()
+        TraceEvent("DDMoveComplete").detail("Begin", move_rng.begin) \
+            .detail("End", move_rng.end).detail("Vf", vf).log()
+        await self._retire_emptied_sources(state, src_team, move_rng)
+
+    async def _retire_emptied_sources(self, state: dict, src_team: list[int],
+                                      rng: KeyRange) -> None:
+        """After a WHOLE-shard move the source replicas serve nothing:
+        their state entries were narrowed to empty by the flip publish.
+        Stop the roles (destroy=True — the relinquished data must not be
+        reported resident after a reboot) and pop their tags at infinity
+        so they never pin a TLog queue.  Best-effort: a failure leaves an
+        idle fenced replica behind, never a correctness problem
+        (REF:fdbserver/DataDistribution.actor.cpp removeStorageServer)."""
+        live = {s["tag"] for s in (self.cc.last_state or state)["storage"]}
+        gone = []
+        for s in state["storage"]:
+            if s["tag"] in src_team and s["tag"] not in live \
+                    and s["begin"] <= rng.begin and s["end"] >= rng.end:
+                gone.append(s)
+        for s in gone:
+            try:
+                wa = NetworkAddress(*s["worker"])
+                w = self.cc.workers.get(wa)
+                if w is not None:
+                    await asyncio.wait_for(
+                        w.stop_role(s["token"], True),
+                        timeout=self.knobs.FAILURE_TIMEOUT)
+            except (Exception, asyncio.TimeoutError):  # noqa: BLE001
+                pass
+        if gone:
+            self._pop_tags_forever([s["tag"] for s in gone])
+            self.cc.active_tags -= {s["tag"] for s in gone}
 
     async def _publish_flip(self, mv: dict, boundaries, teams) -> None:
         """Publish a flipped move's cluster state: the layout's boundaries
@@ -328,19 +409,21 @@ class DataDistributor:
                 return
             await asyncio.sleep(self.knobs.DD_INTERVAL / 4)
 
-    async def _abort_move(self, start_layout: dict, idx: int,
+    async def _abort_move(self, start_layout: dict, midx: int,
                           src_team: list[int], dest_info: list[dict],
                           epoch0: int) -> None:
         """Roll a failed move back: write team reverts to src (the abort
         layout's team diff sends drop markers to the destinations), the
         destination roles stop, and their tags pop at infinity so they
-        never pin a TLog queue."""
+        never pin a TLog queue.  ``midx`` is the moving shard's index in
+        the start layout (suffix shard for a split, the shard itself for
+        a whole-shard move)."""
         if self.cc.epoch != epoch0:
             return      # a recovery already normalized the journal
         abort_layout = {
             "boundaries": list(start_layout["boundaries"]),
             "teams": [list(t) for t in start_layout["teams"]]}
-        abort_layout["teams"][idx + 1] = list(src_team)
+        abort_layout["teams"][midx] = list(src_team)
         try:
             # bounded: if the abort can't commit (pipeline already dead),
             # give up — the journal entry rolls the move back at recovery

@@ -46,15 +46,15 @@ async def include_servers(db, addrs: list[str]) -> None:
     await db.run(do)
 
 
-async def configure(db, **fields: int) -> None:
-    """configure(resolvers=2, logs=3, ...) — the fdbcli configure analog."""
-    from .system_data import CONF_FIELDS
+async def configure(db, **fields) -> None:
+    """configure(resolvers=2, storage_engine="btree", ...) — the fdbcli
+    configure analog.  ``storage_engine`` kicks off a live DataDistribution
+    migration of every shard onto the new engine type."""
+    from .system_data import validate_conf
 
     async def do(tr):
         for name, val in fields.items():
-            if name not in CONF_FIELDS:
-                raise ValueError(f"unknown configure field {name!r}")
-            tr.set(conf_key(name), str(int(val)).encode())
+            tr.set(conf_key(name), validate_conf(name, val))
     await db.run(do)
 
 

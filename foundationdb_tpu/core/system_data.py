@@ -57,19 +57,44 @@ def decode_backup_tags(rows: list[tuple[bytes, bytes]]) -> dict[str, int]:
 # conf keys the controller honors, mapping to ClusterConfigSpec fields
 CONF_FIELDS = ("commit_proxies", "grv_proxies", "resolvers", "logs",
                "log_replication")
+# string-valued conf keys (REF:fdbclient/DatabaseConfiguration.cpp
+# storageServerStoreType): `configure storage_engine=btree` makes
+# DataDistribution migrate every shard onto the new engine live
+CONF_STR_FIELDS = ("storage_engine",)
+
+
+def validate_conf(name: str, val) -> bytes:
+    """Validate one configure field and return the encoded value — the
+    single validator behind ManagementAPI.configure and the CLI."""
+    if name in CONF_STR_FIELDS:
+        from ..storage import ENGINE_NAMES
+        if name == "storage_engine" and val not in ENGINE_NAMES:
+            raise ValueError(f"unknown storage engine {val!r}; "
+                             f"one of {ENGINE_NAMES}")
+        return str(val).encode()
+    if name in CONF_FIELDS:
+        return str(int(val)).encode()
+    raise ValueError(f"unknown configure field {name!r}; one of "
+                     f"{CONF_FIELDS + CONF_STR_FIELDS}")
 
 
 def conf_key(field: str) -> bytes:
     return CONF_PREFIX + field.encode()
 
 
-def decode_conf(rows: list[tuple[bytes, bytes]]) -> dict[str, int]:
+def decode_conf(rows: list[tuple[bytes, bytes]]) -> dict[str, int | str]:
     """``\\xff/conf/...`` rows → {field: value}; unknown/garbage ignored."""
-    out: dict[str, int] = {}
+    out: dict[str, int | str] = {}
     for k, v in rows:
         if not k.startswith(CONF_PREFIX):
             continue
         name = k[len(CONF_PREFIX):].decode(errors="replace")
+        if name in CONF_STR_FIELDS:
+            from ..storage import ENGINE_NAMES
+            val = v.decode(errors="replace")
+            if name != "storage_engine" or val in ENGINE_NAMES:
+                out[name] = val
+            continue
         if name not in CONF_FIELDS:
             continue
         try:
@@ -130,4 +155,7 @@ def spec_with_conf(spec, conf: dict[str, int]):
     for field in CONF_FIELDS:
         if field in conf:
             kv[field] = max(1, int(conf[field]))
+    for field in CONF_STR_FIELDS:
+        if field in conf:
+            kv[field] = str(conf[field])
     return dataclasses.replace(spec, **kv) if kv else spec

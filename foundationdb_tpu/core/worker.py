@@ -107,11 +107,9 @@ class Worker:
         self.resident_tlogs: dict[tuple[int, int, int | None], int] = {}
         serve_role(transport, "worker", self, base_token)
 
-    def _engine_cls(self):
-        from ..storage.kv_store import MemoryKVStore
-        from ..storage.lsm import LSMKVStore
-        return {"memory": MemoryKVStore,
-                "lsm": LSMKVStore}[self.knobs.STORAGE_ENGINE]
+    def _engine_cls(self, name: str | None = None):
+        from ..storage import engine_class
+        return engine_class(name or self.knobs.STORAGE_ENGINE)
 
     async def open_resident(self) -> dict[int, int]:
         """Reboot path: reopen every storage engine found on this
@@ -129,7 +127,15 @@ class Worker:
             if tag.isdigit():
                 tags.add(int(tag))
         for tag in sorted(tags):
-            engine = await self._engine_cls().open(
+            eng_name = None
+            marker = f"{self.data_dir}/storage-{tag}.engine"
+            if marker in self.fs.listdir(marker):
+                mf = self.fs.open(marker)
+                blob = await mf.read(0, mf.size())
+                await mf.close()
+                if blob:
+                    eng_name = blob.decode(errors="replace")
+            engine = await self._engine_cls(eng_name).open(
                 self.fs, f"{self.data_dir}/storage-{tag}")
             meta = engine.meta
             if "shard" not in meta:
@@ -226,7 +232,19 @@ class Worker:
             for p in self.fs.listdir(base):
                 if p == base or p[len(base):len(base) + 1] == ".":
                     self.fs.remove(p)
-            obj.engine = await self._engine_cls().open(
+            # durable engine-type marker: reboot adoption must reopen the
+            # replica with the SAME engine class it was recruited with —
+            # after a live `configure storage_engine=` migration different
+            # tags on one machine run different engines, so the global
+            # knob cannot answer this (REF:fdbserver/worker.actor.cpp
+            # persists each storage file's KeyValueStoreType)
+            eng_name = params.get("engine") or self.knobs.STORAGE_ENGINE
+            mf = self.fs.open(base + ".engine")
+            await mf.write(0, eng_name.encode())
+            await mf.truncate(len(eng_name.encode()))
+            await mf.sync()
+            await mf.close()
+            obj.engine = await self._engine_cls(eng_name).open(
                 self.fs, f"{self.data_dir}/storage-{params['tag']}")
             if "shard" not in obj.engine.meta:
                 # persist the assignment IMMEDIATELY (the reference writes

@@ -41,7 +41,7 @@ class Knobs:
     IDLE_COMMIT_LIMIT: float = 5.0
 
     # --- storage ---
-    STORAGE_ENGINE: str = "memory"            # memory | lsm (IKeyValueStore)
+    STORAGE_ENGINE: str = "memory"            # memory | lsm | btree
     STORAGE_VERSION_WINDOW: int = 5_000_000   # in-memory MVCC window, versions
     STORAGE_DURABILITY_LAG: float = 0.25      # seconds between making versions durable
     STORAGE_FUTURE_VERSION_WAIT: float = 1.0  # read wait before future_version
