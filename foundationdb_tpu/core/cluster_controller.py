@@ -53,6 +53,15 @@ class ClusterConfigSpec:
     # desired IKeyValueStore engine for storage recruits; None = the
     # worker's own STORAGE_ENGINE knob (set via `configure storage_engine=`)
     storage_engine: str | None = None
+    # multi-region topology (REF:fdbclient/DatabaseConfiguration.cpp
+    # regions): list of {"id": dcid, "priority": int,
+    # "satellite": dcid | None, "satellite_logs": int}.  The highest-
+    # priority region with live workers hosts the transaction subsystem;
+    # its satellite DC hosts synchronous all-tag satellite TLogs; every
+    # OTHER region gets one storage replica per shard (the async remote
+    # copy reads fail over to when the primary region dies).  None =
+    # single-region (region-blind) recruitment.
+    regions: list | None = None
 
 
 class ClusterController:
@@ -71,6 +80,9 @@ class ClusterController:
         self.spec = spec
         self.base = base_token
         self.fm = FailureMonitor(transport, knobs)
+        # worker locality (dcid etc.) reported at registration — drives
+        # region-aware recruitment (REF:fdbrpc/Locality.h)
+        self.locality: dict[NetworkAddress, dict] = {}
         # replicas proven lost (their registered worker disowned the
         # token) — dropped from recovery planning; address liveness alone
         # can never retire them because the respawned process stays alive
@@ -200,17 +212,51 @@ class ClusterController:
                     break
                 if not locked and i not in dead:
                     dead.append(i)
+            # lock the satellites too: they hold EVERY tag, their acks
+            # gated every commit, so their tips bound the recovery
+            # version exactly like main logs and they keep all tags
+            # peekable after a whole primary-DC loss
+            sats = cur.get("satellites") or []
+            sat_dead = list(cur.get("sat_dead", []))
+            sat_nonces_old = cur.get("sat_nonce") or [None] * len(sats)
+            for i, (ip, port) in enumerate(sats):
+                candidates = [(NetworkAddress(ip, port),
+                               cur["sat_token"][i])]
+                res = self.resident_tlogs.get(
+                    (cur.get("epoch"), 1000 + i, sat_nonces_old[i]))
+                if res is not None and res[0] in self.workers:
+                    candidates.append(res)
+                locked = False
+                for addr_c, tok_c in candidates:
+                    stub = TLogClient(ct, addr_c, tok_c)
+                    try:
+                        tips.append(await asyncio.wait_for(
+                            stub.lock(), timeout=k.FAILURE_TIMEOUT * 2))
+                    except (FdbError, asyncio.TimeoutError):
+                        continue
+                    if (addr_c, tok_c) != candidates[0]:
+                        cur["satellites"][i] = (addr_c.ip, addr_c.port)
+                        cur["sat_token"][i] = tok_c
+                        TraceEvent("SatelliteTLogAdopted") \
+                            .detail("Epoch", cur.get("epoch")) \
+                            .detail("Index", i).log()
+                    locked = True
+                    break
+                if not locked and i not in sat_dead:
+                    sat_dead.append(i)
+            all_sats_dead = len(sat_dead) >= len(sats)
             n = len(cur["tlogs"])
             # every storage tag needs a live replica in the locked
-            # generation; a tag whose every hosting log is dead means real
-            # data loss and recovery MUST refuse rather than serve a gap
+            # generation; a tag whose every hosting log is dead — AND no
+            # live satellite copy exists — means real data loss and
+            # recovery MUST refuse rather than serve a gap
             # (log_system.py's cursor-level LogDataLoss, enforced here
             # before the cluster ever accepts a commit)
             repl = max(1, min(cur["replication"], n))
             needed_tags = {s["tag"] for s in prev_state.get("storage", [])}
             for tag in sorted(needed_tags):
                 hosts = [(tag + j) % n for j in range(repl)]
-                if all(h in dead for h in hosts):
+                if all(h in dead for h in hosts) and all_sats_dead:
                     TraceEvent("RecoveryDataLoss", severity=40) \
                         .detail("Tag", tag).detail("Hosts", hosts).log()
                     raise LogDataLoss()
@@ -219,6 +265,7 @@ class ClusterController:
             recovery_version = min(tips)
             cur["end"] = recovery_version
             cur["dead"] = sorted(dead)
+            cur["sat_dead"] = sorted(sat_dead)
         self.epoch = new_epoch
 
         # ---- materialize the database's own metadata (txnStateStore
@@ -240,8 +287,41 @@ class ClusterController:
         if len(live) < needed:
             raise FdbError("waiting for workers")
 
+        # ---- region-aware worker pools: the transaction subsystem lives
+        # in the highest-priority region with live workers; its satellite
+        # DC hosts synchronous satellite TLogs; other regions get storage
+        # replicas (REF:fdbserver/ClusterController recruitment across
+        # DatabaseConfiguration regions) ----
+        primary_region = None
+        sat_workers: list = []
+        remote_dcs: list[str] = []
+        by_dc: dict = {}
+        txn_live = live
+        if spec.regions:
+            def dc_of(a: NetworkAddress):
+                return (self.locality.get(a) or {}).get("dcid")
+            for a, w in live:
+                by_dc.setdefault(dc_of(a), []).append((a, w))
+            ordered = sorted(spec.regions,
+                             key=lambda r: -int(r.get("priority", 0)))
+            for r in ordered:
+                if by_dc.get(r["id"]):
+                    primary_region = r
+                    break
+            if primary_region is None:
+                raise FdbError("no live workers in any configured region")
+            txn_live = by_dc[primary_region["id"]]
+            sat_dc = primary_region.get("satellite")
+            sat_workers = by_dc.get(sat_dc, []) if sat_dc else []
+            remote_dcs = [r["id"] for r in ordered
+                          if r is not primary_region and by_dc.get(r["id"])]
+            TraceEvent("RecoveryRegions") \
+                .detail("Primary", primary_region["id"]) \
+                .detail("SatelliteWorkers", len(sat_workers)) \
+                .detail("RemoteDcs", remote_dcs).log()
+
         def pick(i: int) -> NetworkAddress:
-            return live[i % len(live)][0]
+            return txn_live[i % len(txn_live)][0]
 
         rv = recovery_version
         seq_addr, seq_tok = await self._recruit(
@@ -262,6 +342,23 @@ class ClusterController:
             tlog_toks.append(t)
             tlog_nonces.append(nonce)
 
+        # satellite TLogs: all-tag synchronous replicas in the primary
+        # region's satellite DC.  Index space 1000+ keeps their durable
+        # (epoch, index, nonce) file identities disjoint from main logs.
+        sat_addrs, sat_toks, sat_nonces = [], [], []
+        if primary_region is not None and sat_workers:
+            for i in range(max(1, int(primary_region.get(
+                    "satellite_logs", 1)))):
+                nonce = rng.random_int(1, 1 << 40)
+                wa = sat_workers[i % len(sat_workers)][0]
+                a, t = await self._recruit(wa, "tlog",
+                                           {"v0": rv, "epoch": new_epoch,
+                                            "index": 1000 + i,
+                                            "nonce": nonce})
+                sat_addrs.append(a)
+                sat_toks.append(t)
+                sat_nonces.append(nonce)
+
         new_gen = {
             "epoch": new_epoch,
             "begin": rv,
@@ -271,6 +368,10 @@ class ClusterController:
             "dead": [],
             "token": tlog_toks,
             "nonce": tlog_nonces,
+            "satellites": [tuple(a) for a in sat_addrs],
+            "sat_token": sat_toks,
+            "sat_nonce": sat_nonces,
+            "sat_dead": [],
         }
         log_cfg = old_log_cfg + [new_gen]
 
@@ -291,6 +392,28 @@ class ClusterController:
         # it arrive via its new tag.  REF:fdbserver/MoveKeys.actor.cpp. ----
         self.recovery_state = "REJOINING"
         wire_log_cfg = [self._wire_gen(g) for g in log_cfg]
+
+        async def recruit_remote_routers(remote_tags: dict[int, str]):
+            """One log router per remote storage tag, recruited IN the
+            remote DC: the region's replica peeks its router instead of
+            imposing cross-region peek load on the primary TLogs
+            (REF:fdbserver/LogRouter.actor.cpp).  The router pulls from
+            the router-less wire config (it must not route through
+            itself); storage recruits/rejoins after this get the
+            router-bearing config."""
+            nonlocal wire_log_cfg
+            for tag, dc in sorted(remote_tags.items()):
+                pool = by_dc.get(dc) or []
+                if not pool:
+                    continue
+                wa = pool[tag % len(pool)][0]
+                a, t = await self._recruit(wa, "log_router", {
+                    "tag": tag, "v0": rv, "log_cfg": wire_log_cfg})
+                new_gen["routers"] = new_gen.get("routers", []) \
+                    + [[tag, a[0], a[1], t]]
+            if remote_tags:
+                wire_log_cfg = [self._wire_gen(g) for g in log_cfg]
+
         storage_meta: list[dict] = []
         active_tags: set[int] = set()
         # rejoin RPCs run AFTER the coordinated state commits (pass 2):
@@ -319,6 +442,10 @@ class ClusterController:
             shard_map = ShardMap([bytes(b) for b in boundaries],
                                  [list(t) for t in teams])
             prev_by_tag = {s["tag"]: s for s in prev_storage}
+            if remote_dcs:
+                await recruit_remote_routers({
+                    s["tag"]: s["dcid"] for s in prev_storage
+                    if s.get("dcid") in remote_dcs})
             # what each REGISTERED worker actually hosts right now: a
             # respawned incarnation at a live address silently dropped
             # every pre-crash role; catching that HERE drops the corpse
@@ -430,23 +557,43 @@ class ClusterController:
                             .detail("Begin", rng.begin).detail("End", rng.end).log()
         else:
             rf = max(1, spec.replication)
-            team_tags = [[s * rf + r for r in range(rf)]
+            # with regions, each shard team carries ``rf`` primary-region
+            # replicas plus ONE replica per live remote region — the
+            # async remote copy reads fail over to on region loss
+            per = rf + len(remote_dcs)
+            team_tags = [[s * per + r for r in range(per)]
                          for s in range(spec.storage_servers)]
             shard_map = ShardMap.even(spec.storage_servers, team_tags)
+            if remote_dcs:
+                await recruit_remote_routers({
+                    team[rf + d_i]: dc
+                    for team in team_tags
+                    for d_i, dc in enumerate(remote_dcs)})
             i = 0
             eng = spec.storage_engine or self.knobs.STORAGE_ENGINE
+            rr_by_dc: dict[str, int] = {}
             for rng, tags in shard_map.ranges():
-                for tag in tags:
-                    wa = pick(i)
-                    i += 1
+                for r_i, tag in enumerate(tags):
+                    if r_i < rf:
+                        wa = pick(i)
+                        dc = (primary_region or {}).get("id")
+                        i += 1
+                    else:
+                        dc = remote_dcs[r_i - rf]
+                        pool = by_dc[dc]
+                        rr_by_dc[dc] = rr_by_dc.get(dc, 0) + 1
+                        wa = pool[rr_by_dc[dc] % len(pool)][0]
                     a, t = await self._recruit(wa, "storage", {
                         "tag": tag, "shard_begin": rng.begin,
                         "shard_end": rng.end, "v0": 0,
                         "log_cfg": wire_log_cfg, "engine": eng})
-                    storage_meta.append({
+                    entry = {
                         "worker": [wa.ip, wa.port], "addr": a,
                         "token": t, "tag": tag, "engine": eng,
-                        "begin": rng.begin, "end": rng.end})
+                        "begin": rng.begin, "end": rng.end}
+                    if dc is not None:
+                        entry["dcid"] = dc
+                    storage_meta.append(entry)
                     active_tags.add(tag)
 
         # ---- ratekeeper (admission control over the new storage set) ----
@@ -479,6 +626,8 @@ class ClusterController:
         state = {
             "epoch": new_epoch,
             "seq": 0,
+            "primary_dc": (primary_region or {}).get("id"),
+            "regions": spec.regions,
             "recovery_version": rv,
             "log_cfg": log_cfg,
             "sequencer": {"addr": seq_addr, "token": seq_tok},
@@ -561,8 +710,8 @@ class ClusterController:
         from ..rpc.wire import decode
         from .data import KeyRange, SYSTEM_PREFIX
         from .system_data import (KEY_SERVERS_PREFIX, LOCKED_KEY,
-                                  decode_backup_tags, decode_conf,
-                                  spec_with_conf)
+                                  REGIONS_KEY, decode_backup_tags,
+                                  decode_conf, spec_with_conf)
         if not prev_state:
             return spec, None, set(), {}, None
         sys_end = SYSTEM_PREFIX + b"\xfe"
@@ -602,6 +751,15 @@ class ClusterController:
                         layout = None
                 elif key == LOCKED_KEY:
                     locked = bytes(v)
+                elif key == REGIONS_KEY:
+                    # regions configured through the database itself
+                    # override the static spec (configure_regions)
+                    try:
+                        regs = decode(v)
+                        spec = dataclasses.replace(
+                            spec, regions=[dict(r) for r in regs] or None)
+                    except Exception:  # noqa: BLE001 — bad blob ignored
+                        pass
             if conf or layout or excluded or backup_tags or locked:
                 TraceEvent("RecoveryReadSystemState") \
                     .detail("Conf", str(conf)) \
@@ -623,7 +781,11 @@ class ClusterController:
                 "tlogs": [tuple(a) for a in g["tlogs"]],
                 "token": list(g.get("token", [])) or None,
                 "replication": g["replication"],
-                "dead": list(g.get("dead", []))}
+                "dead": list(g.get("dead", [])),
+                "satellites": [tuple(a) for a in g.get("satellites", [])],
+                "sat_token": list(g.get("sat_token", [])),
+                "sat_dead": list(g.get("sat_dead", [])),
+                "routers": [list(r) for r in g.get("routers", [])]}
 
     # --- the controller main loop ---
 
@@ -654,6 +816,8 @@ class ClusterController:
             watch = [NetworkAddress(*state["sequencer"]["addr"])]
             watch += [NetworkAddress(*g)
                       for g in state["log_cfg"][-1]["tlogs"]]
+            watch += [NetworkAddress(*g)
+                      for g in state["log_cfg"][-1].get("satellites", [])]
             watch += [NetworkAddress(*r["addr"]) for r in state["resolvers"]]
             watch += [NetworkAddress(*p["addr"])
                       for p in state["commit_proxies"] + state["grv_proxies"]]
@@ -669,6 +833,8 @@ class ClusterController:
             # gone — the address watch above never fires, yet the epoch
             # cannot commit (every push gets endpoint_not_found)
             waiters.append(asyncio.ensure_future(self._probe_roles(state)))
+            waiters.append(asyncio.ensure_future(
+                self._watch_region_preference(state)))
             try:
                 done, pending = await asyncio.wait(
                     waiters, return_when=asyncio.FIRST_COMPLETED)
@@ -693,6 +859,9 @@ class ClusterController:
         gen = state["log_cfg"][-1]
         toks = gen.get("token") or [None] * len(gen["tlogs"])
         targets += [(tuple(a), t) for a, t in zip(gen["tlogs"], toks)]
+        targets += [(tuple(a), t) for a, t in
+                    zip(gen.get("satellites", []),
+                        gen.get("sat_token", []))]
         targets += [(tuple(r["addr"]), r["token"])
                     for r in state["resolvers"]]
         targets += [(tuple(p["addr"]), p["token"]) for p in
@@ -721,6 +890,36 @@ class ClusterController:
                                 .detail("Addr", str(addr)) \
                                 .detail("Token", tok).log()
                             return
+
+    async def _watch_region_preference(self, state: dict) -> None:
+        """Automatic failback (REF:fdbserver/ClusterController.actor.cpp
+        betterMasterExists, region priority): when a HIGHER-priority
+        region than the current primary has live registered workers for
+        two consecutive probes, returning completes the run() watch and
+        the next recovery re-evaluates primaries — moving the transaction
+        subsystem home.  Never fires single-region."""
+        regions = state.get("regions")
+        cur = state.get("primary_dc")
+        if not regions or cur is None:
+            await asyncio.Event().wait()    # nothing to prefer; park
+        ordered = sorted(regions, key=lambda r: -int(r.get("priority", 0)))
+        better = [r["id"] for r in ordered]
+        better = better[:better.index(cur)] if cur in better else better
+        if not better:
+            await asyncio.Event().wait()    # already in the best region
+        streak = 0
+        while True:
+            await asyncio.sleep(self.knobs.FAILURE_TIMEOUT * 4)
+            alive = {(self.locality.get(a) or {}).get("dcid")
+                     for a, _ in self._live_workers()}
+            if any(dc in alive for dc in better):
+                streak += 1
+                if streak >= 2:     # dwell: a flapping region can't thrash
+                    TraceEvent("RegionFailback").detail("From", cur) \
+                        .detail("Candidates", better).log()
+                    return
+            else:
+                streak = 0
 
     async def stop(self) -> None:
         self._stopped = True

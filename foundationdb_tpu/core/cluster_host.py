@@ -38,9 +38,13 @@ class ClusterHost:
                  client_transport_factory: Callable[[], Transport],
                  base_token: int, coordinators: list,
                  spec: ClusterConfigSpec | None = None,
-                 fs=None, data_dir: str = "data") -> None:
+                 fs=None, data_dir: str = "data",
+                 locality: dict | None = None) -> None:
         self.id = host_id
         self.knobs = knobs
+        # locality (dcid, ...) rides every worker registration so the
+        # controller can recruit region-aware (REF:fdbrpc/Locality.h)
+        self.locality = dict(locality or {})
         self.transport = transport
         self.make_client_transport = client_transport_factory
         self.base = base_token
@@ -69,7 +73,8 @@ class ClusterHost:
 
     async def register_worker(self, addr: list, worker_token: int,
                               resident: dict | None = None,
-                              resident_tlogs: dict | None = None) -> bool:
+                              resident_tlogs: dict | None = None,
+                              locality: dict | None = None) -> bool:
         """RegisterWorkerRequest analog; False tells the caller this host
         is not (or no longer) the cluster controller.  ``resident`` maps
         storage tags this worker holds on disk to their serving tokens;
@@ -82,6 +87,8 @@ class ClusterHost:
         if wa not in self._registry:
             self._registry[wa] = WorkerClient(self._client_t, wa, worker_token)
             TraceEvent("CCRegisteredWorker").detail("Worker", str(wa)).log()
+        if locality and self.cc is not None:
+            self.cc.locality[wa] = dict(locality)
         if resident_tlogs and self.cc is not None:
             for key, token in resident_tlogs.items():
                 self._resident_tlog_map[tuple(key)] = (wa, int(token))
@@ -158,11 +165,13 @@ class ClusterHost:
             self._resident_map[tag] = (self.address, token)
         for key, token in self.worker.resident_tlogs.items():
             self._resident_tlog_map[key] = (self.address, token)
-        cstate = CoordinatedState(self.coordinators, self.id)
+        cstate = CoordinatedState(self.coordinators, self.id, knobs=k)
         self.cc = ClusterController(k, self.make_client_transport(), cstate,
                                     self._registry, self.spec, self.base)
         self.cc.resident = self._resident_map
         self.cc.resident_tlogs = self._resident_tlog_map
+        if self.locality:
+            self.cc.locality[self.address] = dict(self.locality)
         self._leading = True
         cc_task = asyncio.get_running_loop().create_task(
             self._run_cc(), name=f"cc-{self.id}")
@@ -193,8 +202,14 @@ class ClusterHost:
                         .detail("Host", self.id) \
                         .detail("Error", repr(cc_task.exception())[:200]).log()
                     return
+                # bound each renewal RPC: a dead coordinator must not
+                # stall the round past the live coordinators' lease
+                async def hb(c):
+                    return await asyncio.wait_for(
+                        c.leader_heartbeat(self.id),
+                        timeout=k.LEADER_LEASE_DURATION / 4)
                 replies = await asyncio.gather(
-                    *(c.leader_heartbeat(self.id) for c in self.coordinators),
+                    *(hb(c) for c in self.coordinators),
                     return_exceptions=True)
                 good = sum(1 for r in replies if r is True)
                 if good < len(self.coordinators) // 2 + 1:
@@ -233,7 +248,8 @@ class ClusterHost:
                 ok = await asyncio.wait_for(
                     stub.register_worker(me, self.worker.base,
                                          dict(self.worker.resident),
-                                         dict(self.worker.resident_tlogs)),
+                                         dict(self.worker.resident_tlogs),
+                                         dict(self.locality)),
                     timeout=k.FAILURE_TIMEOUT * 2)
             except (Exception, asyncio.TimeoutError):
                 ok = False

@@ -162,9 +162,11 @@ class CoordinatedState:
     MovableCoordinatedState in the reference: read-modify-write of the
     cluster state blob with single-decree safety."""
 
-    def __init__(self, coordinators: list, my_id: int) -> None:
+    def __init__(self, coordinators: list, my_id: int,
+                 knobs: Knobs | None = None) -> None:
         self.coordinators = coordinators      # Coordinator objects or stubs
         self.my_id = my_id
+        self.knobs = knobs
         self._gen_counter = 0
         self._read_gen: Generation | None = None
 
@@ -174,8 +176,18 @@ class CoordinatedState:
 
     async def _quorum(self, calls) -> list:
         """Run calls; return successful results, raising unless a
-        majority succeeded."""
-        results = await asyncio.gather(*calls, return_exceptions=True)
+        majority succeeded.  Each call is individually bounded: a dead
+        coordinator must cost at most the bound — derived from the knobs
+        like elect_leader's — not stall the whole round (its vote just
+        doesn't count)."""
+        timeout = (self.knobs.FAILURE_TIMEOUT * 2
+                   if self.knobs is not None else 4.0)
+
+        async def bounded(c):
+            return await asyncio.wait_for(c, timeout)
+
+        results = await asyncio.gather(*(bounded(c) for c in calls),
+                                       return_exceptions=True)
         ok = [r for r in results if not isinstance(r, BaseException)]
         if len(ok) < self._majority:
             real = [r for r in results if isinstance(r, FdbError)]
@@ -236,9 +248,21 @@ async def elect_leader(coordinators: list, candidate_id: int, address: Any,
 
     Phase 1 — candidacy, only when no live-leader majority exists:
     returns the winning (leader_id, address) the quorum agrees on (ties
-    broken by count, then lowest id — deterministic)."""
-    reads = await asyncio.gather(*(c.read_leader() for c in coordinators),
-                                 return_exceptions=True)
+    broken by count, then lowest id — deterministic).
+
+    Every per-coordinator RPC is bounded well under the lease duration:
+    an unreachable coordinator otherwise delays the round past the
+    winner's own lease (its grant expires before the winner ever learns
+    it won — the region-failover stand-down loop)."""
+    rpc_timeout = min(knobs.LEADER_LEASE_DURATION / 4,
+                      knobs.FAILURE_TIMEOUT)
+
+    async def bounded(c):
+        return await asyncio.wait_for(c, rpc_timeout)
+
+    reads = await asyncio.gather(
+        *(bounded(c.read_leader()) for c in coordinators),
+        return_exceptions=True)
     tally0: dict[tuple[int, Any], int] = {}
     for r in reads:
         if isinstance(r, BaseException) or r is None:
@@ -251,7 +275,7 @@ async def elect_leader(coordinators: list, candidate_id: int, address: Any,
         if votes >= len(coordinators) // 2 + 1:
             return lid, laddr
     results = await asyncio.gather(
-        *(c.candidacy(candidate_id, address) for c in coordinators),
+        *(bounded(c.candidacy(candidate_id, address)) for c in coordinators),
         return_exceptions=True)
     ok = [r for r in results if not isinstance(r, BaseException)]
     if len(ok) < len(coordinators) // 2 + 1:

@@ -264,8 +264,13 @@ class DataDistributor:
             src_entry = self._live_src_entry(state, move_rng)
             wire_log_cfg = [self.cc._wire_gen(g) for g in state["log_cfg"]]
             chosen: set[str] = {src_entry["worker"][0]}
-            for tag in dest_tags:
-                wa = self._pick_worker(avoid=chosen)
+            src_by_tag = {s["tag"]: s for s in state["storage"]}
+            for i_t, tag in enumerate(dest_tags):
+                # region-preserving placement: each dest replaces
+                # src_team[i_t] positionally, so a region-spanning team
+                # keeps one replica per region across splits/migrations
+                src_dc = (src_by_tag.get(src_team[i_t]) or {}).get("dcid")
+                wa = self._pick_worker(avoid=chosen, dcid=src_dc)
                 chosen.add(wa.ip)
                 a, t = await self.cc._recruit(wa, "storage", {
                     "tag": tag, "shard_begin": move_rng.begin,
@@ -277,12 +282,13 @@ class DataDistributor:
                                    "begin": src_entry["begin"],
                                    "end": src_entry["end"]},
                     "fetch_version": vs})
-                dest_info.append({"worker": [wa.ip, wa.port], "addr": a,
-                                  "token": t, "tag": tag,
-                                  "engine": engine
-                                  or self.knobs.STORAGE_ENGINE,
-                                  "begin": move_rng.begin,
-                                  "end": move_rng.end})
+                entry = {"worker": [wa.ip, wa.port], "addr": a,
+                         "token": t, "tag": tag,
+                         "engine": engine or self.knobs.STORAGE_ENGINE,
+                         "begin": move_rng.begin, "end": move_rng.end}
+                if src_dc is not None:
+                    entry["dcid"] = src_dc
+                dest_info.append(entry)
             await self._wait_caught_up(dest_info, vs, epoch0)
         except asyncio.CancelledError:
             # the distributor is being stopped (CC deposed / shutdown):
@@ -448,8 +454,9 @@ class DataDistributor:
     def _pop_tags_forever(self, tags: list[int]) -> None:
         state = self.cc.last_state or {}
         gen = (state.get("log_cfg") or [{}])[-1]
-        for (ip, port), tok in zip(gen.get("tlogs", []),
-                                   gen.get("token", [])):
+        targets = list(zip(gen.get("tlogs", []), gen.get("token", []))) + \
+            list(zip(gen.get("satellites", []), gen.get("sat_token", [])))
+        for (ip, port), tok in targets:
             stub = TLogClient(self.transport, NetworkAddress(ip, port), tok)
             for tag in tags:
                 try:
@@ -466,12 +473,22 @@ class DataDistributor:
                 return s
         raise MoveAborted("no live source replica for move range")
 
-    def _pick_worker(self, avoid: set[str] | None = None) -> NetworkAddress:
+    def _pick_worker(self, avoid: set[str] | None = None,
+                     dcid: str | None = None) -> NetworkAddress:
         """Round-robin over live workers, preferring machines not in
         ``avoid`` (the source and already-chosen team members) so one
         machine death cannot take out a whole replication team.  Falls
-        back to any live worker when the fleet is too small to avoid."""
+        back to any live worker when the fleet is too small to avoid.
+        With ``dcid`` the pool is restricted to that datacenter — a
+        region-spanning team must never silently lose its remote
+        replica to a region-blind pick, so an empty DC aborts the move
+        (the journal rolls it back) instead of degrading."""
         live = [a for a, _ in self.cc._live_workers()]
+        if dcid is not None:
+            live = [a for a in live
+                    if (self.cc.locality.get(a) or {}).get("dcid") == dcid]
+            if not live:
+                raise MoveAborted(f"no live workers in dc {dcid}")
         preferred = [a for a in live if not avoid or a.ip not in avoid]
         pool = preferred or live
         if not pool:

@@ -33,11 +33,16 @@ class LogRouter:
     TLog surface so any cursor built for TLogs works against a router."""
 
     def __init__(self, db, tag: Tag, begin: Version,
-                 consumers: list[str], poll_timeout: float = 1.0) -> None:
+                 consumers: list, poll_timeout: float = 1.0,
+                 stream=None) -> None:
         if not consumers:
             raise ClientInvalidOperation("log router needs >=1 consumer")
         self.tag = tag
-        self.stream = TagStream(db, tag, begin)
+        # default upstream: a recovery-resilient TagStream (the DR path).
+        # Epoch-scoped routers (multi-region remote feeds, re-recruited
+        # every recovery like TLogs) pass a CursorStream instead.
+        self.stream = stream if stream is not None \
+            else TagStream(db, tag, begin)
         self._versions: list[Version] = []      # ascending, parallel to _msgs
         self._msgs: list[list] = []
         self._floor: Version = begin            # versions < floor trimmed
@@ -147,6 +152,30 @@ class LogRouter:
         return {"tag": self.tag, "floor": self._floor, "end": self._end,
                 "buffered": len(self._versions),
                 "pops": dict(self._pops)}
+
+
+class CursorStream:
+    """TagStream-shaped pull over a FIXED epoch's LogSystem.  Multi-region
+    remote-feed routers ride this: they are per-epoch recruits (rebuilt at
+    every recovery, like the reference's log routers in
+    REF:fdbserver/TagPartitionedLogSystem.actor.cpp), so a frozen
+    generation view is correct — no cross-recovery cursor needed."""
+
+    def __init__(self, log_system, tag: Tag, begin: Version) -> None:
+        self.ls = log_system
+        self.tag = tag
+        self.cursor = log_system.cursor(tag, begin)
+
+    async def next(self) -> tuple[list[tuple[Version, list]], Version]:
+        reply = await self.cursor.next()
+        return list(reply.entries), reply.end_version
+
+    def pop(self, through: Version) -> None:
+        """Inclusive through-version (the TagStream.pop contract)."""
+        self.ls.pop(self.tag, through + 1)
+
+    def rewind(self, to_frontier: Version) -> None:
+        self.cursor.version = to_frontier + 1
 
 
 class RouterStream:

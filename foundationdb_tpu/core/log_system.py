@@ -38,13 +38,27 @@ class LogGeneration:
     """One epoch's set of TLogs.  ``tlogs`` entries are TLog objects
     in-process or TLogClient stubs over RPC — same surface either way.
     ``end_version`` is None while current, else the generation's
-    recovery_version: no entry above it is ever served."""
+    recovery_version: no entry above it is ever served.
+
+    ``satellites`` are SYNCHRONOUS all-tag replica logs in the primary
+    region's satellite DC (REF:fdbserver/TagPartitionedLogSystem.actor.cpp
+    satellite TLogs): every push replicates the whole tagged batch to
+    each satellite and acks only when they acked too, so losing the
+    entire primary DC loses no acked commit — recovery locks the
+    satellites and every tag peeks from them."""
     epoch: int
     begin_version: Version
     tlogs: list
     replication: int = 2
     end_version: Version | None = None
     dead: set[int] = dataclasses.field(default_factory=set)  # tlog indices
+    satellites: list = dataclasses.field(default_factory=list)
+    sat_dead: set[int] = dataclasses.field(default_factory=set)
+    # per-tag log-router feeds (REF:fdbserver/LogRouter.actor.cpp): a
+    # remote-region consumer of ``tag`` peeks its router FIRST so one
+    # upstream pull serves the region; main logs remain the fallback, so
+    # a dead router degrades to direct peeks instead of stalling
+    routers: dict = dataclasses.field(default_factory=dict)
 
     def logs_for_tag(self, tag: Tag) -> list[int]:
         n = len(self.tlogs)
@@ -53,6 +67,10 @@ class LogGeneration:
 
     def live_logs_for_tag(self, tag: Tag) -> list[int]:
         return [i for i in self.logs_for_tag(tag) if i not in self.dead]
+
+    def live_satellites(self) -> list[int]:
+        return [i for i in range(len(self.satellites))
+                if i not in self.sat_dead]
 
 
 class LogSystem:
@@ -105,8 +123,11 @@ class LogSystem:
                 await asyncio.sleep(deterministic_random().random() * 0.03)
             return await t.push(TLogPushRequest(prev_version, version, msgs))
 
-        await asyncio.gather(*(one(t, msgs)
-                               for t, msgs in zip(gen.tlogs, per_log)))
+        pushes = [one(t, msgs) for t, msgs in zip(gen.tlogs, per_log)]
+        # satellites replicate the FULL tagged batch (all-tag copies) and
+        # their acks gate the commit like any other log
+        pushes += [one(s, dict(tagged)) for s in gen.satellites]
+        await asyncio.gather(*pushes)
 
     # --- peek (REF: ILogSystem::peek / ServerPeekCursor) ---
 
@@ -122,6 +143,17 @@ class LogSystem:
                     gen.tlogs[i].pop(tag, version)
                 except FdbError:
                     pass    # a dying replica's pop is best-effort
+            for i in gen.live_satellites():
+                try:
+                    gen.satellites[i].pop(tag, version)
+                except FdbError:
+                    pass
+            r = gen.routers.get(tag)
+            if r is not None:
+                try:
+                    r.pop(tag, version)     # trims the router's buffer
+                except FdbError:
+                    pass
 
     def mark_dead(self, gen_index: int, tlog_index: int) -> None:
         self.generations[gen_index].dead.add(tlog_index)
@@ -158,14 +190,20 @@ class LogCursor:
         while True:
             gen_idx, gen = self._generation_for(self.version)
             is_current = gen_idx == len(self.ls.generations) - 1
-            replicas = gen.live_logs_for_tag(self.tag)
-            if not replicas:
+            # router feed first (one upstream pull per remote region),
+            # then main replicas, then the all-tag satellite fallback
+            # that keeps every tag peekable after a whole primary-DC loss
+            router = gen.routers.get(self.tag)
+            stubs = [router] if router is not None else []
+            stubs += [gen.tlogs[i] for i in gen.live_logs_for_tag(self.tag)]
+            stubs += [gen.satellites[i] for i in gen.live_satellites()]
+            if not stubs:
                 raise LogDataLoss()
             last_err: Exception | None = None
             reply = None
-            for i in replicas:
+            for t in stubs:
                 try:
-                    reply = await gen.tlogs[i].peek(self.tag, self.version)
+                    reply = await t.peek(self.tag, self.version)
                     break
                 except FdbError as e:
                     if e.retryable:

@@ -58,6 +58,26 @@ async def configure(db, **fields) -> None:
     await db.run(do)
 
 
+async def configure_regions(db, regions: list[dict] | None) -> None:
+    """Set (or clear, with None/[]) the multi-region topology: a list of
+    {"id": dcid, "priority": int, "satellite": dcid, "satellite_logs": n}.
+    Takes effect at the next recovery — the controller re-reads
+    ``\\xff/conf/regions`` and recruits region-aware
+    (REF:fdbclient/ManagementAPI.actor.cpp changeConfig regions=)."""
+    from ..rpc.wire import encode
+    from .system_data import REGIONS_KEY
+    for r in regions or []:
+        if "id" not in r:
+            raise ValueError(f"region missing 'id': {r!r}")
+
+    async def do(tr):
+        if regions:
+            tr.set(REGIONS_KEY, encode([dict(r) for r in regions]))
+        else:
+            tr.clear(REGIONS_KEY)
+    await db.run(do)
+
+
 # --- database lock (REF:fdbclient/ManagementAPI.actor.cpp lockDatabase) ---
 
 class DatabaseLockedByOther(ValueError):

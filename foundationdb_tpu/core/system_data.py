@@ -28,6 +28,11 @@ BACKUP_TAGS_PREFIX = BACKUP_PREFIX + b"tags/"
 # database lock (REF:fdbclient/SystemData.cpp databaseLockedKey): value is
 # the locking UID; commit proxies reject non-lock-aware transactions
 LOCKED_KEY = b"\xff/dbLocked"
+# multi-region topology (REF:fdbclient/DatabaseConfiguration.cpp regions
+# JSON under \xff/conf/regions): wire-encoded list of region dicts
+# ({"id", "priority", "satellite", "satellite_logs"}) — the controller
+# reads it at recovery and recruits region-aware (see ClusterConfigSpec)
+REGIONS_KEY = CONF_PREFIX + b"regions"
 
 
 def backup_tag_key(name: str) -> bytes:

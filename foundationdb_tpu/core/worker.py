@@ -36,26 +36,6 @@ from .tlog import TLog
 TOKEN_BLOCK = 16
 
 
-def log_system_config(ls: LogSystem) -> list[dict]:
-    """LogSystem → wire-friendly generation list (addresses+tokens, not
-    stubs).  Stub token blocks ride along so a worker reconstructing the
-    view dials each TLog at the token it was recruited at, not at its own
-    base block."""
-    out = []
-    for g in ls.generations:
-        out.append({
-            "epoch": g.epoch,
-            "begin": g.begin_version,
-            "end": g.end_version,
-            "tlogs": [(t.address.ip, t.address.port) if hasattr(t, "address")
-                      else t for t in g.tlogs],
-            "token": [getattr(t, "_base", None) for t in g.tlogs],
-            "replication": g.replication,
-            "dead": sorted(g.dead),
-        })
-    return out
-
-
 def generations_from_config(cfg: list[dict], transport: Transport,
                             base_token: int) -> list[LogGeneration]:
     """Wire generation list → stub-backed LogGenerations.  Each TLog is
@@ -68,10 +48,18 @@ def generations_from_config(cfg: list[dict], transport: Transport,
         stubs = [TLogClient(transport, NetworkAddress(ip, port),
                             tok if tok is not None else base_token)
                  for (ip, port), tok in zip(g["tlogs"], tokens)]
+        sats = [TLogClient(transport, NetworkAddress(ip, port), tok)
+                for (ip, port), tok in zip(g.get("satellites") or [],
+                                           g.get("sat_token") or [])]
+        from ..rpc.stubs import LogRouterClient
+        routers = {int(tag): LogRouterClient(
+                       transport, NetworkAddress(ip, port), tok)
+                   for tag, ip, port, tok in g.get("routers") or []}
         gens.append(LogGeneration(
             epoch=g["epoch"], begin_version=g["begin"], tlogs=stubs,
             replication=g["replication"], end_version=g["end"],
-            dead=set(g["dead"])))
+            dead=set(g["dead"]), satellites=sats,
+            sat_dead=set(g.get("sat_dead") or []), routers=routers))
     return gens
 
 
@@ -362,6 +350,18 @@ class Worker:
                                  KeyRange(p["shard_begin"], p["shard_end"]),
                                  ls, p.get("v0", 0), fetch_src=fetch_src,
                                  fetch_version=p.get("fetch_version", 0))
+        if role == "log_router":
+            # per-epoch remote-region feed: pulls ``tag`` once from the
+            # recruiting epoch's log system, serves peek/pop to the
+            # remote consumers (consumer names == the tag itself, so the
+            # TLog-shaped cursor calls work against the router verbatim)
+            from .log_router import CursorStream, LogRouter
+            t = self.make_client_transport()
+            ls = LogSystem(generations_from_config(p["log_cfg"], t,
+                                                   self.base))
+            begin = p.get("v0", 0) + 1
+            return LogRouter(None, p["tag"], begin, consumers=[p["tag"]],
+                             stream=CursorStream(ls, p["tag"], begin))
         if role == "ratekeeper":
             t = self.make_client_transport()
             storages = [StorageClient(t, addr(s["addr"]), s["token"],

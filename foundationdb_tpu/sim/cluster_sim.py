@@ -73,11 +73,14 @@ class SimMachine:
         # two incarnations in the same election
         host_id = self.index + 100 * self._boots
         self._boots += 1
+        locality = {}
+        if self.sim.dcids is not None:
+            locality["dcid"] = self.sim.dcids[self.index]
         self.host = ClusterHost(
             host_id, self.sim.knobs, transport, self._client_transport,
             BASE, coord_stubs, self.sim.spec,
             fs=self.fs if self.sim.durable_storage else None,
-            data_dir="data")
+            data_dir="data", locality=locality)
         self.host.start()
         self.alive = True
 
@@ -104,8 +107,13 @@ class SimulatedCluster:
     def __init__(self, knobs: Knobs | None = None, n_machines: int = 6,
                  n_coordinators: int = 3,
                  spec: ClusterConfigSpec | None = None,
-                 durable_storage: bool = False) -> None:
+                 durable_storage: bool = False,
+                 dcids: list[str] | None = None) -> None:
         self.durable_storage = durable_storage
+        # per-machine datacenter ids (multi-region topologies); rides
+        # worker registration as locality
+        assert dcids is None or len(dcids) == n_machines
+        self.dcids = dcids
         # sim-scale resolver shapes: the numpy conflict twin scans the
         # whole ever-written ring per batch, and append-slab rings consume
         # B*R slots per batch — production-sized shapes (64x8 over 2^16
@@ -163,6 +171,15 @@ class SimulatedCluster:
         state = await fetch_cluster_state(stubs)
         view = RecoveredClusterView(self.knobs, t, state)
         return RefreshingDatabase(view, stubs)
+
+    async def kill_dc(self, dcid: str) -> list:
+        """Region loss: kill every live machine whose locality is dcid."""
+        victims = [m for m in self.machines
+                   if self.dcids is not None and m.alive
+                   and self.dcids[m.index] == dcid]
+        for m in victims:
+            await m.kill()
+        return victims
 
     # --- fault targeting ---
 

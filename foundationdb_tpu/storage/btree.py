@@ -48,7 +48,6 @@ _FANOUT = 64                # max children per internal node
 _CACHE_NODES = 512          # shared LRU node cache entries
 _COMPACT_MIN = 1 << 20      # never compact files under 1MB
 _COMPACT_FACTOR = 5         # compact when file > factor * post-compact size
-_END_KEY = b"\xff\xff\xff\xff"
 
 
 class BTreeKVStore:
@@ -66,6 +65,7 @@ class BTreeKVStore:
         self._count = 0
         self._cache = _BlockCache(_CACHE_NODES)
         self._live_size = 0     # file end right after the last compaction
+        self._heads = [None, None]      # the two alternating header files
 
     # --- lifecycle ---
 
@@ -81,8 +81,8 @@ class BTreeKVStore:
         best = None
         for slot in (0, 1):
             hf = fs.open(kv._head_path(slot))
+            kv._heads[slot] = hf
             blob = await hf.read(0, hf.size())
-            await hf.close()
             if not blob:
                 continue
             try:
@@ -114,6 +114,10 @@ class BTreeKVStore:
         if self._f is not None:
             await self._f.close()
             self._f = None
+        for hf in self._heads:
+            if hf is not None:
+                await hf.close()
+        self._heads = [None, None]
 
     def __len__(self) -> int:
         return self._count
@@ -156,14 +160,18 @@ class BTreeKVStore:
         yield from self._walk(self._root, begin, end, reverse)
 
     def _walk(self, ref, begin, end, reverse):
+        """In-order walk of [begin, end); ``end=None`` means unbounded —
+        the whole-tree walk compaction relies on (a key range would
+        silently drop any key sorting above the chosen sentinel)."""
         node = self._read_node(ref)
         if node[0] == 0:
             kids = node[1]
             firsts = [bytes(c[0]) for c in kids]
             # children whose key range can intersect [begin, end)
             lo = max(0, bisect.bisect_right(firsts, begin) - 1)
-            hi = bisect.bisect_left(firsts, end)
-            idxs = range(lo, min(hi + 1, len(kids)))
+            hi = len(kids) if end is None else \
+                min(bisect.bisect_left(firsts, end) + 1, len(kids))
+            idxs = range(lo, hi)
             if reverse:
                 idxs = reversed(idxs)
             for i in idxs:
@@ -173,7 +181,7 @@ class BTreeKVStore:
             entries = node[1]
             keys = [bytes(e[0]) for e in entries]
             lo = bisect.bisect_left(keys, begin)
-            hi = bisect.bisect_left(keys, end)
+            hi = len(keys) if end is None else bisect.bisect_left(keys, end)
             idxs = range(lo, hi)
             if reverse:
                 idxs = reversed(idxs)
@@ -318,12 +326,11 @@ class BTreeKVStore:
                 "root": (list(self._root) if self._root else None),
                 "end": self._end, "count": self._count,
                 "live": self._live_size, "meta": self.meta}
-        hf = self.fs.open(self._head_path(self._gen % 2))
+        hf = self._heads[self._gen % 2]
         blob = encode(head)
         await hf.write(0, blob)
         await hf.truncate(len(blob))
         await hf.sync()
-        await hf.close()
 
     # --- compaction ---
 
@@ -332,7 +339,8 @@ class BTreeKVStore:
         build), flip the header to it, remove the old file.  A crash
         before the header flip leaves an orphan file that open() GCs."""
         old_f, old_path = self._f, self._file_path(self._fileno)
-        items = list(self.range(b"", _END_KEY))
+        items = list(self._walk(self._root, b"", None, False)) \
+            if self._root else []
         self._fileno += 1
         self._f = self.fs.open(self._file_path(self._fileno))
         await self._f.truncate(0)

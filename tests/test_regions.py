@@ -1,0 +1,258 @@
+"""Multi-region replication: satellites, remote replicas, region failover.
+
+Reference: REF:fdbserver/TagPartitionedLogSystem.actor.cpp (satellite
+TLogs), REF:fdbclient/DatabaseConfiguration.cpp (regions config) — a
+two-region cluster commits synchronously to the primary DC's logs AND a
+satellite DC's all-tag logs, while a remote region holds an async
+storage replica per shard.  Losing the whole primary DC must lose no
+acked commit: recovery locks the satellites, the remote region becomes
+primary, and its replicas serve everything that was ever acked.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+# machine layout: coordinators (first 3) span all three DCs so losing
+# any one DC keeps a 2/3 quorum
+DCIDS = ["dc1", "sat1", "dc2", "dc1", "dc2", "dc2"]
+REGIONS = [{"id": "dc1", "priority": 1, "satellite": "sat1",
+            "satellite_logs": 1},
+           {"id": "dc2", "priority": 0}]
+
+
+def _regions_spec(**kw) -> ClusterConfigSpec:
+    return ClusterConfigSpec(min_workers=6, logs=2, replication=1,
+                             regions=[dict(r) for r in REGIONS], **kw)
+
+
+def _dc_of_addr(addr, sim) -> str:
+    ip = addr[0] if isinstance(addr, (list, tuple)) else addr.ip
+    idx = int(ip.split(".")[-1]) - 1
+    return DCIDS[idx]
+
+
+def test_region_aware_recruitment():
+    """Txn subsystem in the primary DC, satellites in the satellite DC,
+    each shard team spanning primary + remote."""
+    async def main():
+        sim = SimulatedCluster(Knobs(), n_machines=6, dcids=DCIDS,
+                               spec=_regions_spec())
+        await sim.start()
+        state = await sim.wait_epoch(1)
+        gen = state["log_cfg"][-1]
+        assert all(_dc_of_addr(a, sim) == "dc1" for a in gen["tlogs"])
+        assert len(gen["satellites"]) == 1
+        assert all(_dc_of_addr(a, sim) == "sat1" for a in gen["satellites"])
+        assert _dc_of_addr(state["sequencer"]["addr"], sim) == "dc1"
+        for p in state["commit_proxies"] + state["grv_proxies"]:
+            assert _dc_of_addr(p["addr"], sim) == "dc1"
+        # every shard team: one dc1 replica + one dc2 replica
+        by_tag = {s["tag"]: s for s in state["storage"]}
+        for team in state["shard_teams"]:
+            dcs = sorted(by_tag[t]["dcid"] for t in team)
+            assert dcs == ["dc1", "dc2"], dcs
+        # each remote (dc2) tag is fed by a log router recruited IN dc2
+        routers = {r[0]: r for r in gen.get("routers", [])}
+        remote_tags = {s["tag"] for s in state["storage"]
+                       if s["dcid"] == "dc2"}
+        assert set(routers) == remote_tags, (routers, remote_tags)
+        for tag, ip, port, tok in routers.values():
+            assert _dc_of_addr([ip, port], sim) == "dc2"
+        # smoke: commits flow through the satellite-gated push path
+        db = await sim.database()
+        for i in range(25):
+            await db.set(b"r%03d" % i, b"v%03d" % i)
+        assert await db.get(b"r001") == b"v001"
+        # the remote replicas really consume through their routers: each
+        # router's frontier advanced past recruitment and its single
+        # consumer (the remote replica) popped it forward
+        await asyncio.sleep(2.0)
+        router_objs = [obj for m in sim.machines if m.host is not None
+                       for _tok, (role, obj) in m.host.worker.roles.items()
+                       if role == "log_router"]
+        assert len(router_objs) == len(remote_tags)
+        for r in router_objs:
+            met = r.metrics()
+            assert met["end"] > 1, met
+            assert max(met["pops"].values()) > 1, \
+                f"remote replica never popped its router: {met}"
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_primary_region_loss_no_acked_data_lost():
+    """Kill EVERY primary-DC machine mid-write-storm: the secondary
+    region must take over (new epoch, txn subsystem in dc2) and serve
+    every acked commit — the satellite logs gate acks, so nothing acked
+    can be lost with the whole primary DC gone."""
+    async def main():
+        k = Knobs()
+        sim = SimulatedCluster(k, n_machines=6, dcids=DCIDS,
+                               spec=_regions_spec())
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        db = await sim.database()
+
+        acked: dict[bytes, bytes] = {}
+        stop = asyncio.Event()
+
+        async def writer(wid: int) -> None:
+            i = 0
+            while not stop.is_set():
+                key, v = b"reg%02d%05d" % (wid, i), b"v" * 20
+                i += 1
+                try:
+                    async def do(tr, key=key, v=v):
+                        tr.set(key, v)
+                    await asyncio.wait_for(db.run(do), timeout=30)
+                except (Exception, asyncio.TimeoutError):  # noqa: BLE001
+                    continue        # unacked: allowed to vanish
+                acked[key] = v
+                await asyncio.sleep(0.05)
+
+        writers = [asyncio.ensure_future(writer(w)) for w in range(2)]
+        await asyncio.sleep(2.0)
+        assert len(acked) > 10
+        pre_kill = len(acked)
+
+        await sim.kill_dc("dc1")
+        # the secondary becomes primary: new epoch accepts commits with
+        # its txn subsystem recruited in dc2
+        state2 = await sim.wait_state(
+            lambda s: s["epoch"] > state1["epoch"]
+            and all(_dc_of_addr(a, sim) == "dc2"
+                    for a in s["log_cfg"][-1]["tlogs"]))
+        await asyncio.sleep(2.0)     # post-failover writes land
+        stop.set()
+        await asyncio.gather(*writers)
+        assert len(acked) > pre_kill, "no commits after failover"
+
+        db2 = await sim.database()
+        tr = db2.create_transaction()
+        while True:
+            try:
+                rows = await tr.get_range(b"reg", b"reh", limit=0)
+                break
+            except Exception as e:  # noqa: BLE001
+                await tr.on_error(e)
+        got = dict(rows)
+        missing = [key for key in acked if key not in got]
+        assert not missing, \
+            f"{len(missing)} ACKED rows lost after region loss: {missing[:5]}"
+        assert all(got[key] == v for key, v in acked.items())
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_dd_split_preserves_region_placement():
+    """A DataDistribution live split under a multi-region layout must
+    keep one replica per region in the new teams (region-preserving
+    destination placement), not collapse the shard into the primary."""
+    async def main():
+        k = Knobs().override(DD_ENABLED=True, DD_INTERVAL=1.0,
+                             DD_SHARD_SPLIT_BYTES=6_000)
+        sim = SimulatedCluster(k, n_machines=6, dcids=DCIDS,
+                               spec=_regions_spec())
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        n_before = len(state1["shard_teams"])
+        db = await sim.database()
+        for i in range(200):
+            await db.set(b"hot%05d" % i, b"v" * 40)
+        state2 = await sim.wait_state(
+            lambda s: len(s["shard_teams"]) > n_before)
+        by_tag = {s["tag"]: s for s in state2["storage"]}
+        for team in state2["shard_teams"]:
+            dcs = sorted(by_tag[t].get("dcid", "?") for t in team
+                         if t in by_tag)
+            assert dcs == ["dc1", "dc2"], \
+                f"split broke region spanning: {dcs}"
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_region_failback_when_primary_returns():
+    """After failover to dc2, rebooting the dc1 machines must move the
+    transaction subsystem BACK to the higher-priority region (automatic
+    failback) with no acked data lost across either transition."""
+    async def main():
+        k = Knobs()
+        sim = SimulatedCluster(k, n_machines=6, dcids=DCIDS,
+                               spec=_regions_spec())
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        db = await sim.database()
+        for i in range(15):
+            await db.set(b"fb%03d" % i, b"a")
+        victims = await sim.kill_dc("dc1")
+        state2 = await sim.wait_state(
+            lambda s: s["epoch"] > state1["epoch"]
+            and s.get("primary_dc") == "dc2")
+        db2 = await sim.database()
+        for i in range(15, 30):
+            while True:
+                try:
+                    await db2.set(b"fb%03d" % i, b"b")
+                    break
+                except Exception:  # noqa: BLE001 — follow the failover
+                    await asyncio.sleep(0.25)
+        for m in victims:
+            await m.reboot()
+        state3 = await sim.wait_state(
+            lambda s: s["epoch"] > state2["epoch"]
+            and s.get("primary_dc") == "dc1")
+        assert all(_dc_of_addr(a, sim) == "dc1"
+                   for a in state3["log_cfg"][-1]["tlogs"])
+        db3 = await sim.database()
+        tr = db3.create_transaction()
+        while True:
+            try:
+                rows = await tr.get_range(b"fb", b"fc", limit=0)
+                break
+            except Exception as e:  # noqa: BLE001
+                await tr.on_error(e)
+        assert len(rows) == 30, f"rows lost across failover+failback: " \
+            f"{len(rows)}/30"
+        await sim.stop()
+    run_simulation(main())
+
+
+def test_satellite_survives_in_old_generation_peek():
+    """After failover, a remote replica's catch-up reads of the OLD
+    generation come from the satellite (all main logs dead) — covered
+    implicitly above; here we assert the recovery marked the old
+    generation's main logs dead but kept a live satellite."""
+    async def main():
+        k = Knobs()
+        sim = SimulatedCluster(k, n_machines=6, dcids=DCIDS,
+                               spec=_regions_spec())
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        db = await sim.database()
+        for i in range(20):
+            await db.set(b"sat%03d" % i, b"x" * 10)
+        await sim.kill_dc("dc1")
+        state2 = await sim.wait_state(lambda s: s["epoch"] > state1["epoch"])
+        old_gen = state2["log_cfg"][-2]
+        assert len(old_gen["dead"]) == len(old_gen["tlogs"]), \
+            "all primary-DC logs should be dead in the locked generation"
+        assert len(old_gen.get("sat_dead", [])) < \
+            len(old_gen.get("satellites", [])), \
+            "a live satellite must back the locked generation"
+        db2 = await sim.database()
+        tr = db2.create_transaction()
+        while True:
+            try:
+                rows = await tr.get_range(b"sat", b"sau", limit=0)
+                break
+            except Exception as e:  # noqa: BLE001
+                await tr.on_error(e)
+        assert len(rows) == 20
+        await sim.stop()
+    run_simulation(main())
