@@ -29,8 +29,14 @@ class Transaction:
         self._knobs = cluster.knobs
         # LOCK_AWARE survives reset/on_error like an upstream persistent
         # transaction option (REF:fdbclient/NativeAPI.actor.cpp
-        # TransactionOptions held across resets by the retry loop)
+        # TransactionOptions held across resets by the retry loop).
+        # priority ("default" | "batch" | "immediate") and throttle_tag
+        # are the GRV admission options (PRIORITY_BATCH /
+        # PRIORITY_SYSTEM_IMMEDIATE / AUTO_THROTTLE_TAG upstream) —
+        # enforced by the Ratekeeper through the GRV proxies.
         self.lock_aware = False
+        self.priority = "default"
+        self.throttle_tag: str | None = None
         self.reset()
 
     # --- lifecycle ---
@@ -63,7 +69,7 @@ class Transaction:
         if self._read_version is None:
             proxy = deterministic_random().choice(self._cluster.grv_proxies)
             self._read_version = await proxy.get_read_version(
-                self.lock_aware)
+                self.lock_aware, self.priority, self.throttle_tag)
         return self._read_version
 
     def set_read_version(self, version: Version) -> None:

@@ -21,14 +21,18 @@ class GrvProxy:
         self.knobs = knobs
         self.sequencer = sequencer
         self.ratekeeper = ratekeeper
-        self._waiters: list[tuple[asyncio.Future, bool]] = []
+        # (future, lock_aware, priority, tag)
+        self._waiters: list[tuple[asyncio.Future, bool, str,
+                                  str | None]] = []
         self._batch_task: asyncio.Task | None = None
         self.total_grvs = 0
 
-    async def get_read_version(self, lock_aware: bool = False) -> Version:
+    async def get_read_version(self, lock_aware: bool = False,
+                               priority: str = "default",
+                               tag: str | None = None) -> Version:
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._waiters.append((fut, lock_aware))
+        self._waiters.append((fut, lock_aware, priority, tag))
         if self._batch_task is None or self._batch_task.done():
             self._batch_task = loop.create_task(self._serve_batch(),
                                                 name="grv-batch")
@@ -47,25 +51,47 @@ class GrvProxy:
         # one scheduler step, so get_read_version's done() gate is safe.
         while self._waiters:
             waiters, self._waiters = self._waiters, []
+            # group by (priority, tag) and serve each lane INDEPENDENTLY:
+            # an immediate (system) request must get its version while
+            # the batch lane is still crawling through its leftover
+            # budget, and an untagged default request must not wait out
+            # a throttled hot tag's bucket drain just because they share
+            # a batch — a single shared sequencer round after all
+            # admissions would invert priorities (the reference batches
+            # GRVs per priority for the same reason,
+            # REF:fdbserver/GrvProxyServer.actor.cpp + TagThrottler)
+            lanes: dict[tuple, list] = {}
+            for w in waiters:
+                lanes.setdefault((w[2], w[3]), []).append(w)
+            await asyncio.gather(*(self._serve_lane(prio, tag, ws)
+                                   for (prio, tag), ws in lanes.items()))
+
+    async def _serve_lane(self, prio: str, tag: str | None,
+                          waiters: list) -> None:
+        try:
             if self.ratekeeper is not None:
-                await self.ratekeeper.admit(len(waiters))
-            try:
-                version, lock_uid = \
-                    await self.sequencer.get_live_committed_version()
-                self.total_grvs += len(waiters)
-                for fut, lock_aware in waiters:
-                    if fut.done():
-                        continue
-                    if lock_uid is not None and not lock_aware:
-                        # the read side of the database lock (REF:
-                        # GetReadVersionReply.locked → NativeAPI throws):
-                        # an application still pointed at a switched-over
-                        # primary must hear about it, not read stale data
-                        from ..runtime.errors import DatabaseLocked
-                        fut.set_exception(DatabaseLocked())
-                    else:
-                        fut.set_result(version)
-            except Exception as e:
-                for fut, _ in waiters:
-                    if not fut.done():
-                        fut.set_exception(e)
+                # positional args only: this may be an RPC stub.  Inside
+                # the try: an unreachable ratekeeper must reject the
+                # waiters (clients retry), not hang them.
+                await self.ratekeeper.admit(
+                    len(waiters), prio,
+                    {tag: len(waiters)} if tag is not None else None)
+            version, lock_uid = \
+                await self.sequencer.get_live_committed_version()
+            self.total_grvs += len(waiters)
+            for fut, lock_aware, _prio, _tag in waiters:
+                if fut.done():
+                    continue
+                if lock_uid is not None and not lock_aware:
+                    # the read side of the database lock (REF:
+                    # GetReadVersionReply.locked → NativeAPI throws):
+                    # an application still pointed at a switched-over
+                    # primary must hear about it, not read stale data
+                    from ..runtime.errors import DatabaseLocked
+                    fut.set_exception(DatabaseLocked())
+                else:
+                    fut.set_result(version)
+        except Exception as e:
+            for fut, *_rest in waiters:
+                if not fut.done():
+                    fut.set_exception(e)

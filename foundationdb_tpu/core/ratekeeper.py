@@ -9,6 +9,19 @@ versions.  The effect: writers slow down *before* storage falls over.
 The smoothing/PID subtleties of the reference are reduced to the core
 proportional controller: full rate while queues are under target, then
 linear falloff to a floor as the worst queue approaches its limit.
+
+v2 adds the reference's two admission refinements:
+
+- **Per-tag throttling** (REF:fdbserver/TagThrottler.actor.cpp): GRV
+  demand is tracked per transaction tag (EWMA).  When the cluster is
+  limited AND one tag dominates demand (share ≥ TAG_THROTTLE_DEMAND_
+  SHARE), that tag alone is clamped to the computed budget through its
+  own token bucket and the global rate stays open — a hot tenant slows
+  down, cold tenants don't feel it.
+- **Priority lanes** (REF: GRV batch priority): ``immediate`` skips
+  admission entirely (system work), ``default`` spends the main budget,
+  ``batch`` spends only what default demand leaves over — background
+  work yields under pressure instead of competing.
 """
 
 from __future__ import annotations
@@ -18,6 +31,8 @@ import asyncio
 from ..runtime.knobs import Knobs
 from ..runtime.trace import TraceEvent
 
+_EWMA = 0.3     # demand smoothing per update interval
+
 
 class Ratekeeper:
     def __init__(self, knobs: Knobs, storage_servers, tlogs) -> None:
@@ -25,11 +40,22 @@ class Ratekeeper:
         self.storage_servers = storage_servers
         self.tlogs = tlogs
         self.rate_tps: float = knobs.RATEKEEPER_MAX_TPS
+        self.batch_rate_tps: float = knobs.RATEKEEPER_MAX_TPS
+        self.tag_rates: dict[str, float] = {}     # throttled tags only
         self._tokens: float = knobs.RATEKEEPER_MAX_TPS
+        self._batch_tokens: float = 0.0
+        self._tag_tokens: dict[str, tuple[float, float]] = {}  # tag->(tok,ts)
         self._admit_lock: asyncio.Lock | None = None
+        self._batch_lock: asyncio.Lock | None = None
         self._last_refill: float | None = None
+        self._batch_refill: float | None = None
         self._task: asyncio.Task | None = None
         self.limiting_reason = "unlimited"
+        # demand accounting since the last recompute (+ smoothed)
+        self._demand_window: dict[str, int] = {}
+        self._default_window = 0
+        self._tag_demand: dict[str, float] = {}
+        self._default_demand = 0.0
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(
@@ -96,62 +122,178 @@ class Ratekeeper:
             frac = m["queue_bytes"] / k.TARGET_TLOG_QUEUE_BYTES
             if frac > worst:
                 worst, reason = frac, f"tlog_queue_{i}"
+
+        # fold this window's demand into the smoothed per-tag/default
+        # view; tags ABSENT from the window decay toward zero — a tag
+        # that went idle must not keep its old hot score and hijack a
+        # later, unrelated overload
+        for tag in set(self._tag_demand) | set(self._demand_window):
+            prev = self._tag_demand.get(tag, 0.0)
+            cur = self._demand_window.get(tag, 0)
+            nxt = prev + _EWMA * (cur - prev)
+            if nxt < 0.5 and tag not in self._demand_window:
+                self._tag_demand.pop(tag, None)
+            else:
+                self._tag_demand[tag] = nxt
+        self._default_demand += _EWMA * (self._default_window
+                                         - self._default_demand)
+        self._demand_window = {}
+        self._default_window = 0
+
         if worst <= 0.5:
             rate = k.RATEKEEPER_MAX_TPS
+            self.tag_rates = {}
         else:
             # linear falloff: 1.0 at 50% of target, floor at 100%
             scale = max(0.0, min(1.0, 2.0 * (1.0 - worst)))
             rate = max(k.RATEKEEPER_MIN_TPS, k.RATEKEEPER_MAX_TPS * scale)
-            TraceEvent("RkRateLimited").detail("Reason", reason) \
-                .detail("TPSLimit", round(rate, 1)).log()
+            # tag attribution: when a single tag's smoothed demand share
+            # dominates, clamp that TAG to the budget and leave the
+            # global rate open — cold tags must not pay for a hot tenant
+            total = self._default_demand
+            hot = {t: d for t, d in self._tag_demand.items()
+                   if total > 0
+                   and d / total >= k.TAG_THROTTLE_DEMAND_SHARE}
+            if hot:
+                self.tag_rates = {t: rate for t in hot}
+                reason = "tag_throttle_" + "_".join(sorted(hot))
+                rate = k.RATEKEEPER_MAX_TPS
+                TraceEvent("RkTagThrottled").detail("Tags", sorted(hot)) \
+                    .detail("TagTPSLimit", round(min(
+                        self.tag_rates.values()), 1)).log()
+            else:
+                self.tag_rates = {}
+                TraceEvent("RkRateLimited").detail("Reason", reason) \
+                    .detail("TPSLimit", round(rate, 1)).log()
         self.rate_tps = rate
-        self.limiting_reason = reason if rate < k.RATEKEEPER_MAX_TPS else "unlimited"
+        # batch lane: background work gets what default demand leaves
+        self.batch_rate_tps = max(
+            k.RATEKEEPER_MIN_TPS, self.rate_tps - self._default_demand
+            / max(k.RATEKEEPER_UPDATE_INTERVAL, 1e-6))
+        # buckets of tags whose throttle lifted are garbage
+        self._tag_tokens = {t: v for t, v in self._tag_tokens.items()
+                            if t in self.tag_rates}
+        self.limiting_reason = reason \
+            if (rate < k.RATEKEEPER_MAX_TPS or self.tag_rates) \
+            else "unlimited"
 
     async def get_rate(self) -> float:
         """Current budget (RPC surface for status/monitoring)."""
         return self.rate_tps
 
+    async def get_throttle(self) -> dict:
+        """Full admission picture for status json."""
+        return {"tps_limit": self.rate_tps,
+                "batch_tps_limit": self.batch_rate_tps,
+                "throttled_tags": dict(self.tag_rates),
+                "reason": self.limiting_reason}
+
     # --- admission (spent by GRV proxies) ---
 
-    async def admit(self, n_txns: int) -> None:
-        """Block until the token bucket covers n_txns.
+    async def admit(self, n_txns: int, priority: str = "default",
+                    tags: dict[str, int] | None = None) -> None:
+        """Block until the lane's (and any throttled tags') token buckets
+        cover n_txns.  ``priority``: "immediate" skips admission (system
+        work must not deadlock behind the throttle it recovers),
+        "default" spends the main budget, "batch" spends the leftover
+        budget.  ``tags`` maps transaction tags to their txn counts
+        within this batch; counts for currently-throttled tags drain the
+        tag's own bucket FIRST, so a hot tag queues behind its clamp
+        while untagged/cold work sails through the open global bucket.
 
-        Admission is in installments: a batch larger than one second's rate
-        budget drains whatever tokens exist and sleeps for the remainder,
-        rather than waiting for the bucket (capped at rate_tps) to cover the
-        whole batch at once — which would never happen for
-        n_txns > rate_tps and wedge every GRV proxy behind it.
+        Admission is in installments: a batch larger than one second's
+        rate budget drains whatever tokens exist and sleeps for the
+        remainder, rather than waiting for the bucket (capped at the
+        rate) to cover the whole batch at once — which would never
+        happen for n_txns > rate and wedge every GRV proxy behind it.
 
         The lock makes admission FIFO across GRV proxies sharing this
-        Ratekeeper: without it, a stream of small batches could drain every
-        refill before a sleeping large batch wakes, starving it forever.
-        Tokens consumed by a batch that is cancelled mid-admission are
-        refunded.
+        Ratekeeper: without it, a stream of small batches could drain
+        every refill before a sleeping large batch wakes, starving it
+        forever.  Tokens consumed by a batch that is cancelled
+        mid-admission are refunded (main lane, where it matters).
         """
+        if priority == "immediate" or n_txns <= 0:
+            return
+        if priority == "default":
+            self._default_window += n_txns
+            for tag, cnt in (tags or {}).items():
+                self._demand_window[tag] = \
+                    self._demand_window.get(tag, 0) + cnt
         if self._admit_lock is None:
             self._admit_lock = asyncio.Lock()
+            self._batch_lock = asyncio.Lock()
+        # throttled-tag drains run OUTSIDE the lane locks: a clamped hot
+        # tag sleeping on its own bucket must not hold up cold work
+        # queued on the main lane (each bucket's read-update step is
+        # atomic between awaits, so interleaved drains stay correct —
+        # at the cost of strict FIFO within one throttled tag)
+        for tag, cnt in (tags or {}).items():
+            await self._drain_tag(tag, cnt)
+        if priority == "batch":
+            async with self._batch_lock:
+                await self._drain_batch(float(n_txns))
+        else:
+            async with self._admit_lock:
+                await self._drain_main(float(n_txns))
+
+    async def _drain_main(self, remaining: float) -> None:
         loop = asyncio.get_running_loop()
-        remaining = float(n_txns)
-        async with self._admit_lock:
-            try:
-                while True:
-                    now = loop.time()
-                    if self._last_refill is None:
-                        self._last_refill = now
-                    cap = max(self.rate_tps, 1.0)
-                    self._tokens = min(
-                        cap, self._tokens + (now - self._last_refill) * self.rate_tps)
+        n = remaining
+        try:
+            while True:
+                now = loop.time()
+                if self._last_refill is None:
                     self._last_refill = now
-                    take = min(self._tokens, remaining)
-                    self._tokens -= take
-                    remaining -= take
-                    if remaining <= 1e-9:
-                        return
-                    # Sleep only long enough to earn one bucket-cap of
-                    # tokens — sleeping for the full remainder would let the
-                    # cap clip most of the refill and stretch admission
-                    # quadratically.
-                    await asyncio.sleep(min(cap, remaining) / cap)
-            except asyncio.CancelledError:
-                self._tokens += float(n_txns) - remaining
-                raise
+                cap = max(self.rate_tps, 1.0)
+                self._tokens = min(
+                    cap,
+                    self._tokens + (now - self._last_refill) * self.rate_tps)
+                self._last_refill = now
+                take = min(self._tokens, remaining)
+                self._tokens -= take
+                remaining -= take
+                if remaining <= 1e-9:
+                    return
+                # Sleep only long enough to earn one bucket-cap of tokens
+                # — sleeping for the full remainder would let the cap clip
+                # most of the refill and stretch admission quadratically.
+                await asyncio.sleep(min(cap, remaining) / cap)
+        except asyncio.CancelledError:
+            self._tokens += n - remaining
+            raise
+
+    async def _drain_batch(self, remaining: float) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            now = loop.time()
+            if self._batch_refill is None:
+                self._batch_refill = now
+            rate = max(self.batch_rate_tps, 1.0)
+            self._batch_tokens = min(
+                rate,
+                self._batch_tokens + (now - self._batch_refill) * rate)
+            self._batch_refill = now
+            take = min(self._batch_tokens, remaining)
+            self._batch_tokens -= take
+            remaining -= take
+            if remaining <= 1e-9:
+                return
+            await asyncio.sleep(min(rate, remaining) / rate)
+
+    async def _drain_tag(self, tag: str, remaining: float) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            rate = self.tag_rates.get(tag)
+            if rate is None:
+                return          # (no longer) throttled: free
+            rate = max(rate, 1.0)
+            now = loop.time()
+            tok, last = self._tag_tokens.get(tag, (rate, now))
+            tok = min(rate, tok + (now - last) * rate)
+            take = min(tok, remaining)
+            self._tag_tokens[tag] = (tok - take, now)
+            remaining -= take
+            if remaining <= 1e-9:
+                return
+            await asyncio.sleep(min(rate, remaining) / rate)

@@ -77,8 +77,11 @@ async def cluster_status(knobs: Knobs, transport: Transport,
                 r["metrics"] = await asyncio.wait_for(tc.metrics(), timeout=t)
             elif r["role"] == "ratekeeper":
                 rc = RatekeeperClient(transport, addr(r["addr"]), r["token"])
-                r["tps_limit"] = await asyncio.wait_for(rc.get_rate(),
-                                                        timeout=t)
+                thr = await asyncio.wait_for(rc.get_throttle(), timeout=t)
+                r["tps_limit"] = thr["tps_limit"]
+                r["batch_tps_limit"] = thr["batch_tps_limit"]
+                r["throttled_tags"] = thr["throttled_tags"]
+                r["limiting_reason"] = thr["reason"]
         except Exception:   # noqa: BLE001 — partial status beats none
             r["metrics_error"] = True
 

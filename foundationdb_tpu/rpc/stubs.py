@@ -31,7 +31,8 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
                 ("get_latest_range", False), ("sample_split_key", False)],
     "commit_proxy": [("commit", False)],
     "grv_proxy": [("get_read_version", False)],
-    "ratekeeper": [("admit", False), ("get_rate", False)],
+    "ratekeeper": [("admit", False), ("get_rate", False),
+                   ("get_throttle", False)],
     "coordinator": [("read", False), ("write", False),
                     ("candidacy", False), ("leader_heartbeat", False),
                     ("open_database", False), ("read_leader", False)],

@@ -87,6 +87,10 @@ class Knobs:
     TARGET_DURABILITY_LAG_VERSIONS: int = 20_000_000  # 4x the MVCC window: steady-state lag == window is healthy
     RATEKEEPER_MAX_TPS: float = 1e6
     RATEKEEPER_MIN_TPS: float = 10.0
+    # a txn tag whose smoothed share of default-lane GRV demand reaches
+    # this while the cluster is limited gets its own clamp (tag
+    # throttling) instead of dragging the global rate down
+    TAG_THROTTLE_DEMAND_SHARE: float = 0.5
 
     # --- simulation ---
     SIM_NETWORK_MIN_DELAY: float = 0.0005

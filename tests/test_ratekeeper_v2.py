@@ -1,0 +1,204 @@
+"""Ratekeeper v2: per-tag throttling + priority admission lanes.
+
+Reference: REF:fdbserver/Ratekeeper.actor.cpp + TagThrottler.actor.cpp —
+when one transaction tag dominates demand while the cluster is limited,
+that tag alone is clamped; batch-priority work yields the leftover
+budget; immediate (system) work is never throttled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from foundationdb_tpu.core.grv_proxy import GrvProxy
+from foundationdb_tpu.core.ratekeeper import Ratekeeper
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+class OverloadedSS:
+    """durable engine with its queue right at the target (worst = 1.0)."""
+    tag = 0
+    engine = object()
+    bytes_input = 10_000
+    bytes_durable = 0
+    version = 0
+    durable_version = 0
+
+
+def _knobs():
+    return Knobs().override(TARGET_STORAGE_QUEUE_BYTES=10_000,
+                            RATEKEEPER_MAX_TPS=1000.0,
+                            RATEKEEPER_MIN_TPS=5.0)
+
+
+def test_hot_tag_throttled_cold_unaffected():
+    async def main():
+        rk = Ratekeeper(_knobs(), [OverloadedSS()], [])
+        # build smoothed demand: the "hot" tag dominates the default lane
+        for _ in range(8):
+            await rk.admit(90, tags={"hot": 90})
+            await rk.admit(10)                      # untagged cold work
+            await rk._recompute()
+        assert "hot" in rk.tag_rates, rk.limiting_reason
+        assert rk.tag_rates["hot"] == 5.0           # clamped to the floor
+        # the GLOBAL lane stays open: cold tenants don't pay
+        assert rk.rate_tps == 1000.0
+        assert "tag_throttle_hot" in rk.limiting_reason
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await rk.admit(50)                          # cold, untagged
+        assert loop.time() - t0 < 1.0, "cold work was throttled"
+        t0 = loop.time()
+        await rk.admit(50, tags={"hot": 50})        # hot tag queues
+        assert loop.time() - t0 >= 40 / 5.0
+        # recovery: queue drains -> throttle lifts
+        rk.storage_servers[0].bytes_durable = 10_000
+        await rk._recompute()
+        assert rk.tag_rates == {}
+        t0 = loop.time()
+        await rk.admit(50, tags={"hot": 50})
+        assert loop.time() - t0 < 1.0
+    run_simulation(main())
+
+
+def test_cold_admit_not_blocked_by_draining_hot_tag():
+    """A clamped hot tag sleeping on its own bucket must not hold the
+    admission lock against concurrent cold work."""
+    async def main():
+        rk = Ratekeeper(_knobs(), [OverloadedSS()], [])
+        for _ in range(8):
+            await rk.admit(90, tags={"hot": 90})
+            await rk._recompute()
+        assert rk.tag_rates.get("hot") == 5.0
+        loop = asyncio.get_running_loop()
+        hot = asyncio.ensure_future(rk.admit(100, tags={"hot": 100}))
+        await asyncio.sleep(0.1)        # hot is now draining its clamp
+        t0 = loop.time()
+        await rk.admit(20)              # cold, untagged
+        assert loop.time() - t0 < 0.5, "cold blocked behind hot drain"
+        assert not hot.done()
+        hot.cancel()
+        try:
+            await hot
+        except asyncio.CancelledError:
+            pass
+    run_simulation(main())
+
+
+def test_idle_tag_demand_decays():
+    """A tag that bursts and goes idle must not hijack a later overload:
+    its smoothed demand decays, so the global throttle engages and the
+    actual (untagged) offender is the one slowed."""
+    async def main():
+        rk = Ratekeeper(_knobs(), [OverloadedSS()], [])
+        for _ in range(8):              # the burst
+            await rk.admit(90, tags={"burst": 90})
+            await rk._recompute()
+        assert "burst" in rk.tag_rates
+        for _ in range(12):             # tag idle; untagged load dominates
+            await rk.admit(90)
+            await rk._recompute()
+        assert rk.tag_rates == {}, rk.tag_rates
+        assert rk.rate_tps == 5.0       # global throttle does the work
+        assert "storage_queue" in rk.limiting_reason
+        assert rk._tag_tokens == {}     # bucket state pruned with it
+    run_simulation(main())
+
+
+def test_no_dominant_tag_falls_back_to_global_throttle():
+    async def main():
+        rk = Ratekeeper(_knobs(), [OverloadedSS()], [])
+        for _ in range(8):
+            # three tags at ~33% each: none crosses the 50% share bar
+            await rk.admit(90, tags={"a": 30, "b": 30, "c": 30})
+            await rk._recompute()
+        assert rk.tag_rates == {}
+        assert rk.rate_tps == 5.0
+        assert "storage_queue" in rk.limiting_reason
+    run_simulation(main())
+
+
+def test_priority_lanes():
+    async def main():
+        k = _knobs()
+        rk = Ratekeeper(k, [OverloadedSS()], [])
+        # default demand ~ the whole budget: batch gets only the floor
+        for _ in range(8):
+            await rk.admit(int(1000 * k.RATEKEEPER_UPDATE_INTERVAL))
+            await rk._recompute()
+        assert rk.batch_rate_tps <= 2 * k.RATEKEEPER_MIN_TPS
+        loop = asyncio.get_running_loop()
+        # immediate: never throttled, even at the floor rate
+        t0 = loop.time()
+        await rk.admit(10_000, priority="immediate")
+        assert loop.time() - t0 < 0.01
+        # batch: crawls at the leftover rate
+        t0 = loop.time()
+        await rk.admit(30, priority="batch")
+        assert loop.time() - t0 >= 20 / (2 * k.RATEKEEPER_MIN_TPS)
+    run_simulation(main())
+
+
+def test_grv_proxy_routes_lanes_and_tags():
+    """The GRV proxy splits a mixed batch into lanes and forwards per-tag
+    counts; immediate requests are served without admission delay even
+    while the default lane is throttled hard."""
+    class FakeSequencer:
+        async def get_live_committed_version(self):
+            return 42, None
+
+    class RecordingRk(Ratekeeper):
+        def __init__(self):
+            super().__init__(_knobs(), [], [])
+            self.calls = []
+
+        async def admit(self, n, priority="default", tags=None):
+            self.calls.append((priority, n, tags))
+            if priority == "default":
+                await asyncio.sleep(1.0)    # simulated throttle delay
+
+    async def main():
+        k = Knobs()
+        rk = RecordingRk()
+        proxy = GrvProxy(k, FakeSequencer(), rk)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        futs = [asyncio.ensure_future(c) for c in (
+            proxy.get_read_version(),
+            proxy.get_read_version(False, "default", "hot"),
+            proxy.get_read_version(False, "immediate"),
+            proxy.get_read_version(False, "batch"))]
+        # NO priority inversion: the immediate (and batch) lanes resolve
+        # while the default lane is still sleeping in admission
+        assert await asyncio.wait_for(asyncio.shield(futs[2]), 0.5) == 42
+        assert loop.time() - t0 < 0.5
+        results = await asyncio.gather(*futs)
+        assert all(v == 42 for v in results)
+        # lanes are per (priority, tag): the tagged default request is
+        # admitted separately from the untagged one
+        calls = sorted(((p, n, tags) for p, n, tags in rk.calls),
+                       key=repr)
+        assert calls == sorted([("default", 1, None),
+                                ("default", 1, {"hot": 1}),
+                                ("immediate", 1, None),
+                                ("batch", 1, None)], key=repr), calls
+        assert loop.time() - t0 >= 1.0      # default lanes were admitted
+    run_simulation(main())
+
+
+def test_transaction_carries_priority_and_tag():
+    from foundationdb_tpu.client import Database
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+
+    async def main():
+        async with Cluster(ClusterConfig(), Knobs()) as cluster:
+            db = Database(cluster)
+            tr = db.create_transaction()
+            tr.priority = "batch"
+            tr.throttle_tag = "analytics"
+            tr.set(b"k", b"v")
+            await tr.commit()
+            tr2 = db.create_transaction()
+            assert await tr2.get(b"k") == b"v"
+    run_simulation(main())
