@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from typing import Any
 
-from ..runtime.errors import _err
+from ..runtime.errors import FdbError, _err
 
 MIN_API_VERSION = 200
 MAX_API_VERSION = 710
@@ -113,8 +113,66 @@ class MultiVersionDatabase:
             tr.set_versionstamped_value = _no_stamp
         return tr
 
-    def run(self, fn):
-        return self._db.run(fn)
+    async def run(self, fn):
+        """Retry loop that additionally survives CLUSTER UPGRADES: when
+        the cluster publishes a new protocol version, the pinned native
+        view raises cluster_version_changed; re-resolve (the analog of
+        dlopening the matching libfdb_c) and retry
+        (REF:fdbclient/MultiVersionTransaction.actor.cpp
+        MultiVersionDatabase protocol-version monitor)."""
+        import asyncio
+        while True:
+            try:
+                r = self._db.run(fn)
+                # the ctypes-over-C binding's run() is synchronous; the
+                # native client's is a coroutine — accept both
+                return await r if asyncio.iscoroutine(r) else r
+            except FdbError as e:
+                if e.code != 1039 or self.flavor != "native":
+                    raise
+                await self._re_resolve()
+
+    # convenience surface: routed through run() so every entry point —
+    # not just explicit run() callers — survives a cluster upgrade
+
+    async def get(self, key):
+        async def do(tr):
+            return await tr.get(key)
+        return await self.run(do)
+
+    async def set(self, key, value):
+        async def do(tr):
+            tr.set(key, value)
+        return await self.run(do)
+
+    async def clear(self, key):
+        async def do(tr):
+            tr.clear(key)
+        return await self.run(do)
+
+    async def clear_range(self, begin, end):
+        async def do(tr):
+            tr.clear_range(begin, end)
+        return await self.run(do)
+
+    async def get_range(self, begin, end, limit=0, reverse=False):
+        async def do(tr):
+            return await tr.get_range(begin, end, limit=limit,
+                                      reverse=reverse)
+        return await self.run(do)
+
+    async def _re_resolve(self) -> None:
+        """Adopt the cluster's published protocol: re-pin the view's
+        knobs to it and rebuild the stub set from the fresh state."""
+        from ..core.cluster_client import fetch_cluster_state
+        from ..runtime.trace import TraceEvent
+        state = await fetch_cluster_state(self._db.coordinators)
+        old = self._db.view.knobs.PROTOCOL_VERSION
+        self._db.view.knobs = self._db.view.knobs.override(
+            PROTOCOL_VERSION=state.get("protocol", old))
+        self._db.view.update(state)
+        TraceEvent("MultiVersionClientSwitched").detail("From", old) \
+            .detail("To", self._db.view.knobs.PROTOCOL_VERSION).log()
 
     def __getattr__(self, name: str):
         return getattr(self._db, name)

@@ -43,6 +43,15 @@ class RecoveredClusterView:
         shard move publishes the same epoch with a higher ``seq``."""
         if (state["epoch"], state.get("seq", 0)) <= (self.epoch, self.seq):
             return
+        proto = state.get("protocol")
+        if proto is not None and proto != self.knobs.PROTOCOL_VERSION:
+            # a single-version client cannot speak to an upgraded
+            # cluster; the multi-version facade catches this and
+            # re-resolves against the new protocol
+            from ..runtime.errors import ClusterVersionChanged
+            raise ClusterVersionChanged(
+                f"cluster protocol {proto}, client pinned "
+                f"{self.knobs.PROTOCOL_VERSION}")
         t = self.transport
 
         def addr(a):
@@ -148,6 +157,8 @@ class RefreshingDatabase(Database):
     async def refresh(self) -> None:
         try:
             self.view.update(await fetch_cluster_state(self.coordinators))
-        except FdbError:
+        except FdbError as e:
+            if e.code == 1039:      # cluster_version_changed must surface
+                raise               # (the multi-version client re-resolves)
             pass
 

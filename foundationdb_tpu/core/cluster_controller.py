@@ -123,6 +123,38 @@ class ClusterController:
         self._attempt_recruits.append((wa, token))
         return [wa.ip, wa.port], token
 
+    def _resident_copy(self, g: dict, i: int,
+                       satellite: bool) -> tuple | None:
+        """The rebooted durable copy of generation ``g``'s log ``i``
+        (satellite index space is offset by 1000), if a registered
+        worker reports one."""
+        nk = "sat_nonce" if satellite else "nonce"
+        count = len(g.get("satellites") or []) if satellite \
+            else len(g["tlogs"])
+        nonces = g.get(nk) or [None] * count
+        res = self.resident_tlogs.get(
+            (g.get("epoch"), (1000 + i if satellite else i), nonces[i]))
+        if res is None or res[0] not in self.workers:
+            return None
+        return res
+
+    def _repoint_resident(self, g: dict, i: int, satellite: bool,
+                          event: str) -> None:
+        """Rewrite an (ended) generation's recorded log endpoint to its
+        rebooted durable copy — no locking, the generation is immutable."""
+        res = self._resident_copy(g, i, satellite)
+        if res is None:
+            return
+        ak, tk = ("satellites", "sat_token") if satellite \
+            else ("tlogs", "token")
+        toks = g.setdefault(tk, [self.base] * len(g[ak]))
+        if (NetworkAddress(*g[ak][i]), toks[i]) != res:
+            g[ak][i] = (res[0].ip, res[0].port)
+            toks[i] = res[1]
+            TraceEvent(event).detail("Epoch", g.get("epoch")) \
+                .detail("Index", i).detail("Satellite", satellite) \
+                .detail("Addr", str(res[0])).log()
+
     async def _stop_attempt_recruits(self) -> None:
         """Tear down a FAILED recovery attempt's recruits.  Orphaned
         pipelines are not just waste: an orphan sequencer+proxy pair keeps
@@ -174,6 +206,20 @@ class ClusterController:
                 except (FdbError, asyncio.TimeoutError):
                     pass    # dead/unreachable: its commits can't ack anyway
             old_log_cfg = [dict(g) for g in prev_state["log_cfg"]]
+            # EVERY ended generation's recorded endpoints may be stale
+            # after a whole-cluster reboot, not just the latest one: a
+            # storage replica whose durable floor predates the previous
+            # generation pulls history from N generations back, so their
+            # durable copies must be re-pointed at the rebooted
+            # incarnations too (they reopen LOCKED; no lock round needed
+            # — an ended generation is immutable).
+            for g in old_log_cfg[:-1]:
+                for i in range(len(g["tlogs"])):
+                    self._repoint_resident(g, i, satellite=False,
+                                           event="TLogAdoptedOldGen")
+                for i in range(len(g.get("satellites") or [])):
+                    self._repoint_resident(g, i, satellite=True,
+                                           event="TLogAdoptedOldGen")
             cur = old_log_cfg[-1]
             tips: list[int] = []
             dead: list[int] = list(cur.get("dead", []))
@@ -186,10 +232,8 @@ class ClusterController:
                 candidates = [(NetworkAddress(ip, port),
                                cur["token"][i] if "token" in cur
                                else self.base)]
-                nonces = cur.get("nonce") or [None] * len(cur["tlogs"])
-                res = self.resident_tlogs.get(
-                    (cur.get("epoch"), i, nonces[i]))
-                if res is not None and res[0] in self.workers:
+                res = self._resident_copy(cur, i, satellite=False)
+                if res is not None:
                     candidates.append(res)
                 locked = False
                 for addr_c, tok_c in candidates:
@@ -218,13 +262,11 @@ class ClusterController:
             # peekable after a whole primary-DC loss
             sats = cur.get("satellites") or []
             sat_dead = list(cur.get("sat_dead", []))
-            sat_nonces_old = cur.get("sat_nonce") or [None] * len(sats)
             for i, (ip, port) in enumerate(sats):
                 candidates = [(NetworkAddress(ip, port),
                                cur["sat_token"][i])]
-                res = self.resident_tlogs.get(
-                    (cur.get("epoch"), 1000 + i, sat_nonces_old[i]))
-                if res is not None and res[0] in self.workers:
+                res = self._resident_copy(cur, i, satellite=True)
+                if res is not None:
                     candidates.append(res)
                 locked = False
                 for addr_c, tok_c in candidates:
@@ -626,6 +668,7 @@ class ClusterController:
         state = {
             "epoch": new_epoch,
             "seq": 0,
+            "protocol": k.PROTOCOL_VERSION,
             "primary_dc": (primary_region or {}).get("id"),
             "regions": spec.regions,
             "recovery_version": rv,

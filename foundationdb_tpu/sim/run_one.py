@@ -55,11 +55,19 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--kills", type=int, default=2)
     ap.add_argument("--no-buggify", action="store_true")
+    ap.add_argument("--spec", help="run a TOML test spec (tests/specs/*) "
+                    "instead of the built-in chaos mix")
     args = ap.parse_args(argv)
     try:
-        results = run_simulation(
-            simulate(args.seed, args.kills, not args.no_buggify),
-            seed=args.seed)
+        if args.spec:
+            from .spec import load_spec, run_spec
+            results = run_simulation(
+                run_spec(load_spec(args.spec), seed=args.seed),
+                seed=args.seed)
+        else:
+            results = run_simulation(
+                simulate(args.seed, args.kills, not args.no_buggify),
+                seed=args.seed)
     except BaseException as e:  # noqa: BLE001 — the signature IS the output
         print(json.dumps({"seed": args.seed, "ok": False,
                           "error": f"{type(e).__name__}: {e}"[:300]}))

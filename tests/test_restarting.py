@@ -1,0 +1,68 @@
+"""The restarting/upgrade test tier (REF:tests/restarting/) driven by
+spec files: a durable cluster stops mid-life, restarts as a "new
+binary" (bumped protocol version), and must prove continuity — data
+byte-for-byte, invariants green, multi-version client re-resolving
+across the upgrade while pinned clients get cluster_version_changed.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from foundationdb_tpu.client import multiversion as mv
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.sim.spec import load_spec, run_spec
+
+SPECS = os.path.join(os.path.dirname(__file__), "specs")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_api_version():
+    mv._reset_api_version_for_tests()
+    yield
+    mv._reset_api_version_for_tests()
+
+
+def test_cycle_restart_upgrade_spec():
+    spec = load_spec(os.path.join(SPECS, "cycle_restart.toml"))
+
+    async def main():
+        return await run_spec(spec, seed=11)
+
+    r = run_simulation(main(), seed=11)
+    assert r["restart"]["rows"] > 10
+    assert r["restart"]["new_protocol"] == r["restart"]["old_protocol"] + 1
+    assert r["restart"]["mv_client_switched"]
+    assert "phase1" in r and "phase2" in r
+
+
+def test_chaos_spec_runs():
+    spec = load_spec(os.path.join(SPECS, "attrition_cycle.toml"))
+
+    async def main():
+        return await run_spec(spec, seed=3)
+
+    r = run_simulation(main(), seed=3)
+    assert "phase1" in r and "restart" not in r
+
+
+def test_restart_without_protocol_bump():
+    """Plain whole-cluster restart (same binary): old clients keep
+    working, no version-changed error."""
+    spec = {
+        "config": {"machines": 4, "replication": 2,
+                   "durableStorage": True, "buggify": False},
+        "test": [{"testName": "Cycle", "nodeCount": 6,
+                  "transactionsPerClient": 10}],
+        "restart": {"protocolBump": False},
+    }
+
+    async def main():
+        return await run_spec(spec, seed=7)
+
+    r = run_simulation(main(), seed=7)
+    assert r["restart"]["rows"] > 5
+    assert r["restart"]["new_protocol"] == r["restart"]["old_protocol"]
+    assert "mv_client_switched" not in r["restart"]

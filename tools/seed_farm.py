@@ -24,14 +24,18 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_seed(seed: int, timeout: float) -> dict:
+def run_seed(seed: int, timeout: float, spec: str | None = None) -> dict:
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     t0 = time.time()
+    cmd = [sys.executable, "-m", "foundationdb_tpu.sim.run_one",
+           "--seed", str(seed)]
+    if spec:
+        # children run with cwd=REPO; a caller-relative path must not
+        # silently resolve against the wrong directory
+        cmd += ["--spec", os.path.abspath(spec)]
     try:
         p = subprocess.run(
-            [sys.executable, "-m", "foundationdb_tpu.sim.run_one",
-             "--seed", str(seed)],
-            cwd=REPO, env=env, capture_output=True, text=True,
+            cmd, cwd=REPO, env=env, capture_output=True, text=True,
             timeout=timeout)
     except subprocess.TimeoutExpired:
         return {"seed": seed, "ok": False, "error": "TIMEOUT",
@@ -52,13 +56,15 @@ def main() -> int:
     ap.add_argument("--start", type=int, default=0)
     ap.add_argument("--jobs", type=int, default=os.cpu_count() or 4)
     ap.add_argument("--timeout", type=float, default=180.0)
+    ap.add_argument("--spec", help="run a TOML spec (tests/specs/*) at "
+                    "every seed instead of the default chaos mix")
     args = ap.parse_args()
 
     buckets: dict[str, list[int]] = collections.defaultdict(list)
     ok = 0
     t0 = time.time()
     with concurrent.futures.ThreadPoolExecutor(args.jobs) as ex:
-        futs = {ex.submit(run_seed, s, args.timeout): s
+        futs = {ex.submit(run_seed, s, args.timeout, args.spec): s
                 for s in range(args.start, args.start + args.seeds)}
         for fut in concurrent.futures.as_completed(futs):
             r = fut.result()
