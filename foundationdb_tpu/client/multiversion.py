@@ -113,53 +113,45 @@ class MultiVersionDatabase:
             tr.set_versionstamped_value = _no_stamp
         return tr
 
-    async def run(self, fn):
-        """Retry loop that additionally survives CLUSTER UPGRADES: when
-        the cluster publishes a new protocol version, the pinned native
-        view raises cluster_version_changed; re-resolve (the analog of
-        dlopening the matching libfdb_c) and retry
+    async def _call(self, name, *args, **kwargs):
+        """Delegate to the inner database, surviving CLUSTER UPGRADES:
+        when the cluster publishes a new protocol version, the pinned
+        native view raises cluster_version_changed; re-resolve (the
+        analog of dlopening the matching libfdb_c) and retry
         (REF:fdbclient/MultiVersionTransaction.actor.cpp
-        MultiVersionDatabase protocol-version monitor)."""
+        MultiVersionDatabase protocol-version monitor).  Accepts both
+        the native client's coroutines and the ctypes-over-C binding's
+        synchronous methods, preserving each one's return value."""
         import asyncio
         while True:
             try:
-                r = self._db.run(fn)
-                # the ctypes-over-C binding's run() is synchronous; the
-                # native client's is a coroutine — accept both
+                r = getattr(self._db, name)(*args, **kwargs)
                 return await r if asyncio.iscoroutine(r) else r
             except FdbError as e:
                 if e.code != 1039 or self.flavor != "native":
                     raise
                 await self._re_resolve()
 
-    # convenience surface: routed through run() so every entry point —
-    # not just explicit run() callers — survives a cluster upgrade
+    # run + the convenience surface all route through _call, so every
+    # entry point — not just explicit run() callers — survives upgrades
 
-    async def get(self, key):
-        async def do(tr):
-            return await tr.get(key)
-        return await self.run(do)
+    def run(self, fn):
+        return self._call("run", fn)
 
-    async def set(self, key, value):
-        async def do(tr):
-            tr.set(key, value)
-        return await self.run(do)
+    def get(self, key):
+        return self._call("get", key)
 
-    async def clear(self, key):
-        async def do(tr):
-            tr.clear(key)
-        return await self.run(do)
+    def set(self, key, value):
+        return self._call("set", key, value)
 
-    async def clear_range(self, begin, end):
-        async def do(tr):
-            tr.clear_range(begin, end)
-        return await self.run(do)
+    def clear(self, key):
+        return self._call("clear", key)
 
-    async def get_range(self, begin, end, limit=0, reverse=False):
-        async def do(tr):
-            return await tr.get_range(begin, end, limit=limit,
-                                      reverse=reverse)
-        return await self.run(do)
+    def clear_range(self, begin, end):
+        return self._call("clear_range", begin, end)
+
+    def get_range(self, begin, end, **kwargs):
+        return self._call("get_range", begin, end, **kwargs)
 
     async def _re_resolve(self) -> None:
         """Adopt the cluster's published protocol: re-pin the view's

@@ -271,6 +271,16 @@ class StorageServer:
 
     async def _pull_loop(self) -> None:
         from ..runtime.errors import FdbError
+        # a moved-in shard's snapshot fetch must fully land (at its
+        # fetch version) BEFORE any pulled mutation above it applies:
+        # under network clogging the pull otherwise outruns the stalled
+        # fetch and violates the version-ordered apply invariant.  The
+        # TLog retains the window — the reference buffers update
+        # mutations during fetchKeys for the same reason
+        # (REF:fdbserver/storageserver.actor.cpp fetchWaitingForVersion).
+        # A FAILED fetch never completes this wait: the distributor
+        # aborts the move and destroys this role.
+        await self._fetch_done.wait()
         cursor = self.log_system.cursor(self.tag, self.version + 1)
         while True:
             try:

@@ -62,7 +62,9 @@ def main(argv=None) -> int:
         if args.spec:
             from .spec import load_spec, run_spec
             results = run_simulation(
-                run_spec(load_spec(args.spec), seed=args.seed),
+                run_spec(load_spec(args.spec), seed=args.seed,
+                         buggify_override=False if args.no_buggify
+                         else None),
                 seed=args.seed)
         else:
             results = run_simulation(

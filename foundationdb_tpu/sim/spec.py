@@ -49,14 +49,20 @@ def load_spec(path: str) -> dict:
         return tomllib.load(f)
 
 
-async def run_spec(spec: dict, seed: int = 0) -> dict:
+async def run_spec(spec: dict, seed: int = 0,
+                   buggify_override: bool | None = None) -> dict:
     """Run one spec against a fresh SimulatedCluster; returns a result
-    dict with per-phase workload results + restart continuity info."""
+    dict with per-phase workload results + restart continuity info.
+    ``buggify_override`` (the CLI's --no-buggify) beats the spec file —
+    triage runs must be able to isolate a failure from buggify noise."""
     from .cluster_sim import SimulatedCluster
 
     cfg = spec.get("config", {})
-    knobs = Knobs().override(BUGGIFY_ENABLED=bool(cfg.get("buggify", True)))
-    enable_buggify(bool(cfg.get("buggify", True)))
+    buggify = bool(cfg.get("buggify", True)) \
+        if buggify_override is None else buggify_override
+    knobs = Knobs().override(BUGGIFY_ENABLED=buggify,
+                             **cfg.get("knobs", {}))
+    enable_buggify(buggify)
     n = int(cfg.get("machines", 6))
     sim = SimulatedCluster(
         knobs, n_machines=n,
@@ -65,7 +71,9 @@ async def run_spec(spec: dict, seed: int = 0) -> dict:
         spec=ClusterConfigSpec(
             min_workers=n,
             replication=int(cfg.get("replication", 2)),
-            logs=int(cfg.get("logs", 2))))
+            logs=int(cfg.get("logs", 2)),
+            regions=[dict(r) for r in cfg["regions"]]
+            if cfg.get("regions") else None))
     await sim.start()
     state1 = await sim.wait_epoch(1)
     db = await sim.database()

@@ -44,7 +44,9 @@ class VersionedMap:
     # --- writes (storage role applies mutations in version order) ---
 
     def set(self, version: Version, key: bytes, value: bytes) -> None:
-        assert version >= self.latest_version, "mutations must arrive in version order"
+        assert version >= self.latest_version, \
+            f"mutations must arrive in version order " \
+            f"(v={version} < latest={self.latest_version})"
         self.latest_version = version
         chain = self._chains.get(key)
         if chain is None:

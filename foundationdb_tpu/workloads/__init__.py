@@ -10,4 +10,4 @@ violated by lost/phantom/reordered writes.
 from .workload import (TestWorkload, WorkloadContext, register_workload,
                        make_workload, run_workloads, run_workloads_on)
 from . import (attrition, conflict_range, consistency, cycle,  # noqa: F401  (register)
-               dynamic, increment, random_rw, serializability)
+               dynamic, increment, ops, random_rw, serializability)

@@ -31,6 +31,24 @@ class ConsistencyCheckWorkload(TestWorkload):
     async def check(self) -> bool:
         if self.ctx.client_id != 0:
             return True
+        from ..runtime.errors import FdbError
+        last: Exception | None = None
+        for _ in range(5):
+            try:
+                return await self._check_once()
+            except FdbError as e:
+                # the view can be stale after live moves / engine
+                # migration (same epoch, seq bump): the retired source
+                # roles answer endpoint_not_found to the raw replica
+                # reads — refresh the view and retry
+                last = e
+                refresh = getattr(self.db, "refresh", None)
+                if refresh is not None:
+                    await refresh()
+                await asyncio.sleep(0.25)
+        raise last  # type: ignore[misc]
+
+    async def _check_once(self) -> bool:
         tr = self.db.create_transaction()
         while True:
             try:

@@ -1,0 +1,51 @@
+"""The operational workload specs (backup/DR chaos, live-move storm,
+lock cycling + directory churn, region failover, engine migration) run
+green at a fixed seed each — the same specs the chaos farm fans out.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.sim.spec import load_spec, run_spec
+
+SPECS = os.path.join(os.path.dirname(__file__), "specs")
+
+
+def _run(name: str, seed: int) -> dict:
+    spec = load_spec(os.path.join(SPECS, name))
+
+    async def main():
+        return await run_spec(spec, seed=seed)
+
+    return run_simulation(main(), seed=seed)
+
+
+def test_backup_dr_chaos_spec():
+    r = _run("backup_dr_chaos.toml", 21)
+    assert r["phase1"]["BackupUnderAttrition"]["snapshots"] >= 1
+    assert r["phase1"]["MachineAttrition"]["machines_killed"] == 2
+
+
+def test_livemove_storm_spec():
+    r = _run("livemove_storm.toml", 22)
+    assert r["phase1"]["LiveMoveStorm"]["splits"] >= 1
+
+
+def test_lock_directory_spec():
+    r = _run("lock_directory.toml", 23)
+    assert r["phase1"]["LockCycling"]["lock_cycles"] == 3
+    assert r["phase1"]["DirectoryOps"]["dir_ops"] == 50   # 25 x 2 clients
+
+
+def test_region_chaos_spec():
+    r = _run("region_chaos.toml", 24)
+    assert r["phase1"]["RegionFailover"]["failover_rounds"] == 1
+
+
+def test_engine_migration_spec():
+    r = _run("engine_migration_chaos.toml", 25)
+    assert r["phase1"]["EngineMigration"]["migrated_replicas"] > 0
