@@ -513,6 +513,34 @@ def main() -> int:
                 })
             except Exception as e:  # noqa: BLE001 — configs 3-4 are extras
                 out["configs34_error"] = repr(e)[:300]
+            try:
+                # the abort-parity gate (BASELINE.md config-2): encoded
+                # abort rate vs exact on a range-heavy shape; fat txns
+                # ride the exact sidecar so only encoding widening is
+                # left and the relative delta must stay bounded
+                from foundationdb_tpu.bench.abort_parity import (
+                    parity_knobs, run_parity)
+                ap = run_parity(
+                    parity_knobs(), "tpu", n_batches=40,
+                    batch_size=24, seed=7, device=tpu_device)
+                out.update({
+                    "range_heavy_abort_rate_exact": ap["abort_rate_exact"],
+                    "range_heavy_abort_rate_encoded":
+                        ap["abort_rate_encoded"],
+                    "range_heavy_abort_rel_delta": ap["abort_rel_delta"],
+                    "widening_aborts_coalescing":
+                        ap["widening_aborts_coalescing"],
+                    "widening_aborts_encoding":
+                        ap["widening_aborts_encoding"],
+                    "abort_parity_safety_violations":
+                        ap["safety_violations"],
+                })
+                if ap["safety_violations"]:
+                    print("FATAL: encoded backend committed a txn the "
+                          "exact baseline aborted", file=sys.stderr)
+                    rc = 1
+            except Exception as e:  # noqa: BLE001 — gate is an extra
+                out["abort_parity_error"] = repr(e)[:300]
     except Exception as e:  # noqa: BLE001 — the JSON line must still appear
         out["error"] = repr(e)[:800]
         import traceback

@@ -157,45 +157,126 @@ class EncodedConflictBackend:
     byte-string TxnRequest interface."""
 
     def __init__(self, conflict_set, batch_txns: int, ranges_per_txn: int,
-                 width: int, dict_encoder=None):
+                 width: int, dict_encoder=None,
+                 exact_window: int = 5_000_000):
         self.cs = conflict_set
         self.B = batch_txns
         self.R = ranges_per_txn
         self.width = width
         self._dict = dict_encoder       # DictEncoder when transfer-compressed
+        self._exact_window = exact_window
+        # exact sidecar for FAT txns (more ranges than the kernel bucket):
+        # coalescing them measured ~5x abort inflation on range-heavy
+        # shapes (bench/abort_parity.py), so they are checked exactly
+        # instead — lazily created on the first fat txn.  The sidecar is
+        # only TRUSTED for snapshots >= _exact_since: it has seen every
+        # committed write from that version on (it is created mid-stream
+        # and wire-path resolves bypass it, so older history is
+        # incomplete — a fat txn with an older snapshot falls back to
+        # conservative coalescing instead of risking a missed conflict)
+        self._exact = None
+        self._exact_failed = False
+        self._exact_since: int | None = None
+
+    def _fat(self, t: TxnRequest) -> bool:
+        return len(t.read_ranges) > self.R or len(t.write_ranges) > self.R
+
+    def _exact_sidecar(self):
+        if self._exact is None and not self._exact_failed:
+            try:
+                from .conflict_cpp import CppConflictSet
+                self._exact = CppConflictSet()
+            except Exception:  # noqa: BLE001 — no native lib: coalesce
+                self._exact_failed = True
+        return self._exact
+
+    def _prepare(self, txns: list[TxnRequest],
+                 commit_version: int) -> tuple[list[TxnRequest], dict]:
+        """Hybrid fat-txn routing (the abort-parity gate): a txn with
+        more conflict ranges than the kernel bucket R is resolved
+        EXACTLY against a C++ interval-map sidecar instead of having
+        its ranges coalesced.  Returns (kernel-shaped txns, {txn index:
+        final verdict} for the fat ones).
+
+        The sidecar sees every txn in commit order: slim txns contribute
+        their exact writes UNCONDITIONALLY (reads dropped, snapshot
+        pinned at the commit version so they always insert — counting a
+        kernel-aborted slim txn's writes only over-approximates history,
+        which can only flip a fat verdict COMMITTED→CONFLICT: safe);
+        fat txns are checked with their exact reads and insert their
+        exact writes iff the sidecar commits them.  The kernel still
+        carries each fat txn's coalesced WRITES (later kernel checks
+        must see them — widened: safe) but no reads (its verdict is the
+        sidecar's, not the kernel's).  Without the native lib the old
+        conservative coalescing applies to reads too."""
+        fat_idx = [i for i, t in enumerate(txns) if self._fat(t)]
+        if not fat_idx and self._exact is None:
+            return txns, {}     # pure-slim workload: zero sidecar cost
+        side = self._exact_sidecar()
+        if side is not None and self._exact_since is None:
+            self._exact_since = commit_version
+        # a fat txn rides the sidecar only when the sidecar's history
+        # covers everything its check needs: every write in
+        # (snapshot, commit_version] must have been fed, i.e. snapshot
+        # >= _exact_since.  Older snapshots (including the creation
+        # batch's own fat txns) coalesce conservatively.
+        routable = set() if side is None else \
+            {i for i in fat_idx
+             if txns[i].read_snapshot >= self._exact_since}
+        if side is not None:
+            # feed EVERY batch: slim txns contribute exact writes
+            # unconditionally; routable fat txns check exact reads
+            shadow = [t if i in routable
+                      else TxnRequest([], t.write_ranges, commit_version)
+                      for i, t in enumerate(txns)]
+            side.set_oldest_version(
+                max(side.oldest_version,
+                    commit_version - self._exact_window))
+            verdicts = side.resolve_batch(shadow, commit_version)
+            fat_map = {i: int(verdicts[i]) for i in routable}
+        else:
+            fat_map = {}
+        kernel_txns = [
+            t if i not in set(fat_idx) else
+            (TxnRequest([], coalesce_ranges(t.write_ranges, self.R),
+                        t.read_snapshot) if i in routable else
+             TxnRequest(coalesce_ranges(t.read_ranges, self.R),
+                        coalesce_ranges(t.write_ranges, self.R),
+                        t.read_snapshot))
+            for i, t in enumerate(txns)]
+        return kernel_txns, fat_map
+
+    def _invalidate_sidecar(self, version: int) -> None:
+        """Wire-path resolves bypass the sidecar: its history is
+        incomplete from ``version`` on, so fat routing re-arms only for
+        snapshots at or above it."""
+        if self._exact is not None and self._exact_since is not None:
+            self._exact_since = max(self._exact_since, version)
 
     def _chunk_txns(self, txns: list[TxnRequest]) -> list[list[TxnRequest]]:
-        """Split an oversized batch into kernel-shaped txn chunks, with
-        over-bucket txns' ranges coalesced (conservative)."""
-        out = []
-        for start in range(0, len(txns), self.B):
-            out.append(
-                [t if len(t.read_ranges) <= self.R and len(t.write_ranges) <= self.R
-                 else TxnRequest(coalesce_ranges(t.read_ranges, self.R),
-                                 coalesce_ranges(t.write_ranges, self.R),
-                                 t.read_snapshot)
-                 for t in txns[start:start + self.B]])
-        return out
-
-    def _encode_chunks(self, txns: list[TxnRequest]):
-        """Split an oversized batch into kernel-shaped encoded chunks."""
-        from .batch import encode_batch
-        return [encode_batch(c, self.B, self.R, self.width)
-                for c in self._chunk_txns(txns)]
+        """Split a PREPARED (kernel-shaped) batch into B-txn chunks."""
+        return [txns[start:start + self.B]
+                for start in range(0, len(txns), self.B)]
 
     def _submit_chunks(self, txns: list[TxnRequest], commit_version: int):
-        """Encode + dispatch every chunk; returns [(n_txns, verdicts)] where
-        verdicts is a device array (jax cs) or host ndarray (numpy cs).
-        Multi-chunk batches go through the fused group dispatch when the
-        conflict set supports it (one device round trip instead of K)."""
-        ebs = self._encode_chunks(txns)
+        """Prepare + encode + dispatch every chunk; returns
+        ([(n_txns, verdicts)], fat_map) where verdicts is a device array
+        (jax cs) or host ndarray (numpy cs) and fat_map carries the
+        exact-path verdict overrides.  Multi-chunk batches go through
+        the fused group dispatch when the conflict set supports it (one
+        device round trip instead of K)."""
+        from .batch import encode_batch
+        ktxns, fat_map = self._prepare(txns, commit_version)
+        ebs = [encode_batch(c, self.B, self.R, self.width)
+               for c in self._chunk_txns(ktxns)]
         group = getattr(self.cs, "resolve_group_submit", None)
         if group is not None and len(ebs) > 1:
             # counts as a list marks a grouped [K,B] verdict array
             return [([e.count for e in ebs],
-                     group(ebs, [commit_version] * len(ebs)))]
+                     group(ebs, [commit_version] * len(ebs)))], fat_map
         submit = getattr(self.cs, "resolve_encoded_submit", self.cs.resolve_encoded)
-        return [(eb.count, submit(eb, commit_version)) for eb in ebs]
+        return [(eb.count, submit(eb, commit_version))
+                for eb in ebs], fat_map
 
     @staticmethod
     def _extract(n, host: np.ndarray) -> list[int]:
@@ -204,9 +285,12 @@ class EncodedConflictBackend:
         return [int(x) for x in host[:n]]
 
     def resolve(self, txns: list[TxnRequest], commit_version: int) -> list[int]:
+        pending, fat_map = self._submit_chunks(txns, commit_version)
         out: list[int] = []
-        for n, v in self._submit_chunks(txns, commit_version):
+        for n, v in pending:
             out.extend(self._extract(n, np.asarray(v)))
+        for i, code in fat_map.items():
+            out[i] = code
         return out
 
     def resolve_begin(self, txns: list[TxnRequest], commit_version: int):
@@ -216,7 +300,7 @@ class EncodedConflictBackend:
         single thread so device waits never block the loop; under the
         virtual-time simulator (where executors are forbidden and the
         backend is CPU-deterministic anyway) it syncs inline."""
-        pending = self._submit_chunks(txns, commit_version)
+        pending, fat_map = self._submit_chunks(txns, commit_version)
 
         async def finish() -> list[int]:
             from ..runtime.simloop import SimEventLoop
@@ -231,6 +315,8 @@ class EncodedConflictBackend:
                 else:
                     host = await _DeviceSyncWorker.shared().run(np.asarray, v)
                 out.extend(self._extract(n, host))
+            for i, code in fat_map.items():
+                out[i] = code
             return out
 
         return finish()
@@ -265,8 +351,11 @@ class EncodedConflictBackend:
         chunks: list[list[TxnRequest]] = []
         flat_cvs: list[int] = []
         spans: list[tuple[int, int]] = []   # (start, n_chunks) per batch
+        fat_maps: list[dict] = []           # exact-path overrides per batch
         for txns, v in zip(batches, versions):
-            cs_ = self._chunk_txns(txns)
+            ktxns, fmap = self._prepare(txns, v)
+            fat_maps.append(fmap)
+            cs_ = self._chunk_txns(ktxns)
             spans.append((len(chunks), len(cs_)))
             chunks.extend(cs_)
             flat_cvs.extend([v] * len(cs_))
@@ -307,11 +396,13 @@ class EncodedConflictBackend:
                     host = await _DeviceSyncWorker.shared().run(np.asarray, v)
                 rows.extend(host[i] for i in range(dn))
             out = []
-            for start, n_chunks in spans:
+            for bi, (start, n_chunks) in enumerate(spans):
                 verdicts: list[int] = []
                 for c in range(n_chunks):
                     verdicts.extend(int(x)
                                     for x in rows[start + c][:counts[start + c]])
+                for i, code in fat_maps[bi].items():
+                    verdicts[i] = code
                 out.append(verdicts)
             return out
 
@@ -326,6 +417,8 @@ class EncodedConflictBackend:
         otherwise."""
         assert self._dict is not None \
             and hasattr(self.cs, "resolve_group_submit_ids")
+        # wire batches bypass the exact sidecar: fat routing must re-arm
+        self._invalidate_sidecar(max(versions) if versions else 0)
         from .conflict_jax import (FUSED_UPD_BUCKETS, GROUP_BUCKETS,
                                    UPD_BUCKETS)
         max_k = GROUP_BUCKETS[-1]
@@ -398,10 +491,16 @@ class EncodedConflictBackend:
         if fn is None:
             return False
         fn(oldest_version)
+        # fresh-backend semantics include the exact sidecar: stale fat
+        # history must not outlive the ring
+        self._exact = None
+        self._exact_since = None
         return True
 
     def set_oldest_version(self, v: int) -> None:
         self.cs.set_oldest_version(v)
+        if self._exact is not None:
+            self._exact.set_oldest_version(v)
 
     @property
     def oldest_version(self) -> int:
@@ -446,4 +545,5 @@ def make_conflict_backend(knobs: Knobs, device=None):
     return EncodedConflictBackend(cs, knobs.RESOLVER_BATCH_TXNS,
                                   knobs.RESOLVER_RANGES_PER_TXN,
                                   knobs.KEY_ENCODE_BYTES,
-                                  dict_encoder=dict_encoder)
+                                  dict_encoder=dict_encoder,
+                                  exact_window=knobs.STORAGE_VERSION_WINDOW)

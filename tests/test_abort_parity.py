@@ -1,0 +1,99 @@
+"""Abort-parity gate (BASELINE.md: "a correctness gate, not just a perf
+one"): encoded backends may only widen conservatively, fat transactions
+ride the exact sidecar, and the aggregate abort-rate delta on a
+range-heavy workload stays bounded.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.bench.abort_parity import (RangeHeavyWorkload,
+                                                 parity_knobs, run_parity)
+from foundationdb_tpu.ops.batch import TxnRequest
+
+
+def _knobs(r=8):
+    return parity_knobs(RESOLVER_RANGES_PER_TXN=r)
+
+
+def test_range_heavy_abort_parity_gate():
+    report = run_parity(_knobs(), "numpy", n_batches=40, batch_size=24,
+                        seed=7)
+    assert report["safety_violations"] == 0
+    # fat txns ride the exact sidecar: coalescing itself contributes
+    # nothing.  The residual delta (~0.4% of txns absolute at this
+    # shape) is the irreducible conservative widening: a fat txn's
+    # WRITES still enter the kernel ring coalesced (slim checks must
+    # see them), so a slim read overlapping the widened span aborts
+    # where the exact baseline would not.
+    assert report["widening_aborts_coalescing"] == 0
+    assert report["abort_rel_delta"] < 0.15, report
+
+
+def test_fat_txn_exact_routing_matches_cpp():
+    """Batches of ONLY fat transactions (every txn over the R bucket)
+    must produce verdicts identical to the exact backend — they all ride
+    the sidecar.  A disjoint priming fat txn births the sidecar below
+    every later snapshot so the whole run is exact-routable."""
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+    wl = RangeHeavyWorkload(fat_fraction=1.0, fat_ranges=14, seed=3)
+    batches, versions = wl.make_batches(12, 16)
+    knobs = _knobs()
+    exact = make_conflict_backend(
+        knobs.override(RESOLVER_CONFLICT_BACKEND="cpp"))
+    enc = make_conflict_backend(
+        knobs.override(RESOLVER_CONFLICT_BACKEND="numpy"))
+    prime = [TxnRequest([(b"zzp0", b"zzp1")] * 14, [], 980_000)]
+    assert enc.resolve(prime, 990_000) == exact.resolve(prime, 990_000)
+    for txns, v in zip(batches, versions):
+        assert enc.resolve(txns, v) == exact.resolve(txns, v)
+
+
+def test_fat_txn_never_misses_pre_sidecar_slim_write():
+    """A slim-only batch commits before the sidecar exists; a later fat
+    txn reading that write with an old snapshot must still CONFLICT
+    (it coalesces — the sidecar's history can't be trusted below its
+    birth version), and once snapshots pass the birth version fat txns
+    ride the sidecar with complete history.  Verdicts must equal the
+    exact backend's throughout."""
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+    from foundationdb_tpu.ops.batch import CONFLICT
+    knobs = _knobs(r=2)
+    enc = make_conflict_backend(
+        knobs.override(RESOLVER_CONFLICT_BACKEND="numpy"))
+    exact = make_conflict_backend(
+        knobs.override(RESOLVER_CONFLICT_BACKEND="cpp"))
+    k = lambda i: b"pre%06d" % i
+    fat_reads = [(k(i), k(i + 1)) for i in range(0, 26, 2)]
+
+    rounds = [
+        # slim-only: sidecar must not yet exist
+        ([TxnRequest([], [(k(4), k(5))], 1_000_000)], 1_001_000),
+        # fat reads the pre-sidecar write, old snapshot -> CONFLICT
+        ([TxnRequest(fat_reads, [], 1_000_500)], 1_002_000),
+        # slim write the (now live) sidecar ingests
+        ([TxnRequest([], [(k(8), k(9))], 1_002_500)], 1_003_000),
+        # fat reads it with a post-birth snapshot -> exact-routed CONFLICT
+        ([TxnRequest(fat_reads, [], 1_002_500)], 1_004_000),
+    ]
+    got = [enc.resolve(t, v) for t, v in rounds]
+    want = [exact.resolve(t, v) for t, v in rounds]
+    assert got == want, (got, want)
+    assert got[1] == [CONFLICT] and got[3] == [CONFLICT]
+
+
+def test_hybrid_slim_sees_fat_writes():
+    """A slim txn reading a range a PREVIOUS fat txn wrote must conflict:
+    the fat txn's (coalesced) writes enter the kernel ring."""
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+    from foundationdb_tpu.ops.batch import CONFLICT, COMMITTED
+    knobs = _knobs(r=2)
+    enc = make_conflict_backend(
+        knobs.override(RESOLVER_CONFLICT_BACKEND="numpy"))
+    k = lambda i: b"hy%06d" % i
+    fat = TxnRequest([], [(k(i), k(i + 1)) for i in range(0, 12, 2)],
+                     1_000_000)
+    [v0] = enc.resolve([fat], 1_001_000)
+    assert v0 == COMMITTED
+    slim = TxnRequest([(k(4), k(5))], [], 1_000_500)  # read below commit
+    [v1] = enc.resolve([slim], 1_002_000)
+    assert v1 == CONFLICT, "fat txn's write invisible to kernel check"
