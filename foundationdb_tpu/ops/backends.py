@@ -236,8 +236,9 @@ class EncodedConflictBackend:
             fat_map = {i: int(verdicts[i]) for i in routable}
         else:
             fat_map = {}
+        fat = set(fat_idx)
         kernel_txns = [
-            t if i not in set(fat_idx) else
+            t if i not in fat else
             (TxnRequest([], coalesce_ranges(t.write_ranges, self.R),
                         t.read_snapshot) if i in routable else
              TxnRequest(coalesce_ranges(t.read_ranges, self.R),
@@ -542,8 +543,16 @@ def make_conflict_backend(knobs: Knobs, device=None):
                             dict_slots=dict_slots)
     else:
         raise ValueError(f"unknown RESOLVER_CONFLICT_BACKEND {kind!r}")
-    return EncodedConflictBackend(cs, knobs.RESOLVER_BATCH_TXNS,
-                                  knobs.RESOLVER_RANGES_PER_TXN,
-                                  knobs.KEY_ENCODE_BYTES,
-                                  dict_encoder=dict_encoder,
-                                  exact_window=knobs.STORAGE_VERSION_WINDOW)
+    return EncodedConflictBackend(
+        cs, knobs.RESOLVER_BATCH_TXNS,
+        knobs.RESOLVER_RANGES_PER_TXN,
+        knobs.KEY_ENCODE_BYTES,
+        dict_encoder=dict_encoder,
+        # the sidecar's self-imposed floor must track the TXN-LIFE window
+        # (the same floor the resolver applies to the whole backend) —
+        # never the storage MVCC window: a smaller floor than the
+        # kernel's TooOld-s fat txns whose snapshots are perfectly
+        # admissible, which livelocks any fat-txn retry loop whose GRV
+        # lags by more than the window (regression: a 6-machine sim with
+        # STORAGE_VERSION_WINDOW=1000 spun forever on a 20-write txn)
+        exact_window=knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS)

@@ -41,6 +41,7 @@ from ..core.cluster_controller import ClusterConfigSpec
 from ..runtime.buggify import enable_buggify
 from ..runtime.errors import FdbError
 from ..runtime.knobs import Knobs
+from ..runtime.trace import TraceEvent
 from ..workloads.workload import run_workloads_on
 
 
@@ -62,45 +63,60 @@ async def run_spec(spec: dict, seed: int = 0,
         if buggify_override is None else buggify_override
     knobs = Knobs().override(BUGGIFY_ENABLED=buggify,
                              **cfg.get("knobs", {}))
+    # buggify is a process-global flag: restore it on exit, or one spec
+    # run leaves fault injection armed for every later sim in the same
+    # process (surfaced as replica-lag flakes in unrelated suite tests)
+    from ..runtime.buggify import buggify_enabled
+    prev_buggify = buggify_enabled()
     enable_buggify(buggify)
-    n = int(cfg.get("machines", 6))
-    sim = SimulatedCluster(
-        knobs, n_machines=n,
-        durable_storage=bool(cfg.get("durableStorage", False)),
-        dcids=cfg.get("dcids"),
-        spec=ClusterConfigSpec(
-            min_workers=n,
-            replication=int(cfg.get("replication", 2)),
-            logs=int(cfg.get("logs", 2)),
-            regions=[dict(r) for r in cfg["regions"]]
-            if cfg.get("regions") else None))
-    await sim.start()
-    state1 = await sim.wait_epoch(1)
-    db = await sim.database()
+    sim = None
+    try:
+        n = int(cfg.get("machines", 6))
+        sim = SimulatedCluster(
+            knobs, n_machines=n,
+            durable_storage=bool(cfg.get("durableStorage", False)),
+            dcids=cfg.get("dcids"),
+            spec=ClusterConfigSpec(
+                min_workers=n,
+                replication=int(cfg.get("replication", 2)),
+                logs=int(cfg.get("logs", 2)),
+                regions=[dict(r) for r in cfg["regions"]]
+                if cfg.get("regions") else None))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        db = await sim.database()
 
-    def _phase_specs(tests: list[dict]) -> list[dict]:
-        out = []
-        for t in tests:
-            t = dict(t)
-            t["sim"] = sim      # chaos workloads opt-in to the handle
-            out.append(t)
-        return out
+        def _phase_specs(tests: list[dict]) -> list[dict]:
+            out = []
+            for t in tests:
+                t = dict(t)
+                t["sim"] = sim      # chaos workloads opt-in to the handle
+                out.append(t)
+            return out
 
-    results: dict = {"seed": seed}
-    results["phase1"] = await run_workloads_on(
-        db, _phase_specs(spec.get("test", [])),
-        client_count=int(cfg.get("clients", 2)))
+        results: dict = {"seed": seed}
+        results["phase1"] = await run_workloads_on(
+            db, _phase_specs(spec.get("test", [])),
+            client_count=int(cfg.get("clients", 2)))
 
-    restart = spec.get("restart")
-    if restart is not None:
-        results["restart"] = await _run_restart(sim, db, restart, state1)
-        if restart.get("test"):
-            db2 = await sim.database()
-            results["phase2"] = await run_workloads_on(
-                db2, _phase_specs(restart["test"]),
-                client_count=int(cfg.get("clients", 2)))
-    await sim.stop()
-    return results
+        restart = spec.get("restart")
+        if restart is not None:
+            results["restart"] = await _run_restart(sim, db, restart, state1)
+            if restart.get("test"):
+                db2 = await sim.database()
+                results["phase2"] = await run_workloads_on(
+                    db2, _phase_specs(restart["test"]),
+                    client_count=int(cfg.get("clients", 2)))
+        return results
+    finally:
+        # teardown runs on the failure path too (a workload assertion
+        # must not leak cluster tasks), and must not mask it
+        if sim is not None:
+            try:
+                await sim.stop()
+            except Exception:  # noqa: BLE001
+                TraceEvent("SpecSimStopFailed", severity=30).log()
+        enable_buggify(prev_buggify)
 
 
 async def _snapshot(db) -> list[tuple[bytes, bytes]]:

@@ -146,3 +146,29 @@ def test_pure_user_batch_repairs_without_fail_stop():
         assert await db.get(b"after") == b"ok"
 
     sim(body)
+
+
+def test_fat_txn_sidecar_floor_tracks_txn_life_window():
+    """The exact sidecar's self-imposed history floor must track the
+    txn-life window (MAX_WRITE_TRANSACTION_LIFE_VERSIONS), never the
+    storage MVCC window: a tighter floor TooOld-s fat transactions whose
+    snapshots the kernel itself would admit, which livelocks any fat-txn
+    retry loop whose GRV lags by more than the window (a 6-machine sim
+    with STORAGE_VERSION_WINDOW=1000 spun forever on a 20-write txn)."""
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+    from foundationdb_tpu.ops.batch import TxnRequest
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    knobs = Knobs().override(RESOLVER_CONFLICT_BACKEND="numpy",
+                             STORAGE_VERSION_WINDOW=1000,
+                             RESOLVER_RANGES_PER_TXN=4)
+    backend = make_conflict_backend(knobs)
+    writes = [(b"k%03d" % i, b"k%03d\x00" % i) for i in range(20)]
+    # birth the sidecar: first fat txn (20 > R=4), snapshot == cv floor
+    assert backend.resolve([TxnRequest([], writes, 1)], 10) == [0]
+    # a fat txn whose snapshot lags cv by far more than the storage
+    # window but well inside the txn-life window must still commit
+    cv = 2_000_000
+    snapshot = cv - 500_000
+    got = backend.resolve([TxnRequest([], writes, snapshot)], cv)
+    assert got == [0], f"fat txn TooOld'd inside the txn-life window: {got}"
