@@ -52,6 +52,14 @@ class LeaderInfo:
     lease_end: float        # virtual-time lease expiry (coordinator clock)
 
 
+@dataclasses.dataclass
+class Nomination:
+    candidate_id: int
+    address: Any
+    expires: float          # nominations are soft: a dead candidate's
+                            # entry lapses and the next-best takes over
+
+
 class Coordinator:
     """One coordinator process (role "coordinator")."""
 
@@ -63,6 +71,7 @@ class Coordinator:
         self.write_gen: Generation = GEN_ZERO
         self.value: Any = None
         self._leader: LeaderInfo | None = None
+        self._nominations: dict[int, Nomination] = {}
 
     # --- durability (OnDemandStore) ---
 
@@ -124,17 +133,54 @@ class Coordinator:
         return self.value
 
     # --- leader election (LeaderElectionRegInterface) ---
+    #
+    # Two-phase nominate/confirm (REF:fdbserver/LeaderElection.actor.cpp
+    # CandidacyRequest -> LeaderHeartbeat): NOMINATE records a candidate
+    # without granting anything; each coordinator independently converges
+    # on a deterministic best nominee; CONFIRM grants the lease only when
+    # the confirmer is still this coordinator's best nominee AND no other
+    # leader holds an unexpired lease.  Grant-on-first-ask (the previous
+    # single-phase candidacy) let a freshly-restarted coordinator hand
+    # its slot to whichever bystander asked first — split grants and
+    # leadership ping-pong under churn.  With two phases, two candidates
+    # can never both assemble confirming majorities inside one lease:
+    # the majorities intersect at a coordinator whose lease guard
+    # rejects the second confirm.
 
-    async def candidacy(self, candidate_id: int, address: Any) -> tuple[int, Any]:
-        """Offer to lead; returns the current leader (possibly the caller).
-        First viable candidate wins until its lease lapses."""
+    def _best_nominee(self, now: float) -> "Nomination | None":
+        live = [n for n in self._nominations.values() if now < n.expires]
+        if not live:
+            return None
+        return min(live, key=lambda n: n.candidate_id)
+
+    async def nominate(self, candidate_id: int, address: Any) -> list:
+        """Phase 1: record/refresh this candidacy; grants nothing.
+        Returns [0, leader_id, addr] when an unexpired confirmed leader
+        exists, else [1, best_nominee_id, addr]."""
         now = asyncio.get_running_loop().time()
-        if self._leader is None or now >= self._leader.lease_end:
-            self._leader = LeaderInfo(
-                candidate_id, address,
-                now + self.knobs.LEADER_LEASE_DURATION)
-            TraceEvent("CoordLeaderChange").detail("Leader", candidate_id).log()
-        return self._leader.leader_id, self._leader.address
+        self._nominations[candidate_id] = Nomination(
+            candidate_id, address, now + self.knobs.NOMINATION_TIMEOUT)
+        if self._leader is not None and now < self._leader.lease_end:
+            return [0, self._leader.leader_id, self._leader.address]
+        best = self._best_nominee(now)
+        return [1, best.candidate_id, best.address]
+
+    async def confirm(self, candidate_id: int) -> bool:
+        """Phase 2: grant the lease iff the caller is still this
+        coordinator's best nominee and no OTHER unexpired leader exists.
+        Idempotent for the incumbent (True without extending the lease —
+        renewal is leader_heartbeat's job)."""
+        now = asyncio.get_running_loop().time()
+        if self._leader is not None and now < self._leader.lease_end:
+            return self._leader.leader_id == candidate_id
+        best = self._best_nominee(now)
+        if best is None or best.candidate_id != candidate_id:
+            return False
+        self._leader = LeaderInfo(
+            candidate_id, best.address,
+            now + self.knobs.LEADER_LEASE_DURATION)
+        TraceEvent("CoordLeaderChange").detail("Leader", candidate_id).log()
+        return True
 
     async def read_leader(self) -> tuple[int, Any] | None:
         """Read-only leader query (the reference's monitorLeader side):
@@ -236,56 +282,105 @@ class CoordinatedState:
                 await asyncio.sleep(0.05)
 
 
+def _addr_key(a: Any):
+    """Addresses decode from the wire as lists; normalize for hashing."""
+    return tuple(a) if isinstance(a, list) else a
+
+
+def _addr_restore(a: Any):
+    return list(a) if isinstance(a, tuple) else a
+
+
 async def elect_leader(coordinators: list, candidate_id: int, address: Any,
                        knobs: Knobs) -> tuple[int, Any]:
-    """Find (or become) the leader.
+    """Find (or become) the leader — two-phase nominate/confirm.
 
     Phase 0 — read-only: if a MAJORITY already agrees on a live leader,
-    follow it without nominating.  Nominating unconditionally lets a
-    freshly-restarted coordinator (empty register) grant its slot to
-    whichever bystander asks first, seeding split grants and leadership
-    ping-pong while the incumbent is perfectly healthy.
+    follow it without nominating (a healthy incumbent is never disturbed
+    by an election storm — nominations grant nothing, but skipping them
+    keeps restarted-coordinator registers quiet).
 
-    Phase 1 — candidacy, only when no live-leader majority exists:
-    returns the winning (leader_id, address) the quorum agrees on (ties
-    broken by count, then lowest id — deterministic).
+    Phase 1 — nominate: record this candidacy at every coordinator and
+    learn each one's deterministic best nominee (lowest live candidate
+    id) or its confirmed leader.  A majority reporting the same
+    confirmed leader ⇒ follow it.
 
-    Every per-coordinator RPC is bounded well under the lease duration:
-    an unreachable coordinator otherwise delays the round past the
-    winner's own lease (its grant expires before the winner ever learns
-    it won — the region-failover stand-down loop)."""
-    rpc_timeout = min(knobs.LEADER_LEASE_DURATION / 4,
-                      knobs.FAILURE_TIMEOUT)
+    Phase 2 — confirm, only when a majority's best nominee is US: each
+    coordinator re-checks its own nominee view and incumbent lease at
+    grant time, so two candidates can never both assemble confirming
+    majorities inside one lease.  A majority of True ⇒ we lead.
+
+    Otherwise (someone else is the convergent nominee, or the confirm
+    race was lost) back off with per-candidate deterministic jitter and
+    retry until ELECTION_TIMEOUT, then raise CoordinatorsUnreachable so
+    the caller's outer loop takes over.  Every per-coordinator RPC is
+    bounded well under the lease duration: an unreachable coordinator
+    must not delay a round past the winner's own lease."""
+    from ..runtime.rng import DeterministicRandom
+
+    k = knobs
+    rpc_timeout = min(k.LEADER_LEASE_DURATION / 4, k.FAILURE_TIMEOUT)
+    majority = len(coordinators) // 2 + 1
+    loop = asyncio.get_running_loop()
+    # jitter decorrelates candidates' retry rounds; seeding off the
+    # candidate id keeps simulation replays exact
+    rng = DeterministicRandom((candidate_id << 16) ^ 0x1eade1ec)
+    deadline = loop.time() + k.ELECTION_TIMEOUT
 
     async def bounded(c):
         return await asyncio.wait_for(c, rpc_timeout)
 
-    reads = await asyncio.gather(
-        *(bounded(c.read_leader()) for c in coordinators),
-        return_exceptions=True)
-    tally0: dict[tuple[int, Any], int] = {}
-    for r in reads:
-        if isinstance(r, BaseException) or r is None:
-            continue
-        a = r[1]
-        key = (r[0], tuple(a) if isinstance(a, list) else a)
-        tally0[key] = tally0.get(key, 0) + 1
-    if tally0:
-        (lid, laddr), votes = max(tally0.items(), key=lambda kv: kv[1])
-        if votes >= len(coordinators) // 2 + 1:
-            return lid, laddr
-    results = await asyncio.gather(
-        *(bounded(c.candidacy(candidate_id, address)) for c in coordinators),
-        return_exceptions=True)
-    ok = [r for r in results if not isinstance(r, BaseException)]
-    if len(ok) < len(coordinators) // 2 + 1:
-        raise CoordinatorsUnreachable()
-    tally: dict[tuple[int, Any], int] = {}
-    for r in ok:
-        # addresses decode from the wire as lists; normalize for hashing
-        a = r[1]
-        key = (r[0], tuple(a) if isinstance(a, list) else a)
-        tally[key] = tally.get(key, 0) + 1
-    (leader_id, addr), _ = min(tally.items(),
-                               key=lambda kv: (-kv[1], kv[0][0]))
-    return leader_id, addr
+    def top(tally: dict) -> tuple[tuple[int, Any], int] | None:
+        if not tally:
+            return None
+        # deterministic: most votes, ties to the lowest candidate id
+        return min(tally.items(), key=lambda kv: (-kv[1], kv[0][0]))
+
+    while True:
+        # Phase 0: follow an already-confirmed live leader.
+        reads = await asyncio.gather(
+            *(bounded(c.read_leader()) for c in coordinators),
+            return_exceptions=True)
+        tally0: dict[tuple[int, Any], int] = {}
+        for r in reads:
+            if isinstance(r, BaseException) or r is None:
+                continue
+            key = (r[0], _addr_key(r[1]))
+            tally0[key] = tally0.get(key, 0) + 1
+        best0 = top(tally0)
+        if best0 is not None and best0[1] >= majority:
+            (lid, laddr), _ = best0
+            return lid, _addr_restore(laddr)
+
+        # Phase 1: nominate everywhere; tally leaders and nominees.
+        noms = await asyncio.gather(
+            *(bounded(c.nominate(candidate_id, address))
+              for c in coordinators),
+            return_exceptions=True)
+        ok = [r for r in noms if not isinstance(r, BaseException)]
+        if len(ok) < majority:
+            raise CoordinatorsUnreachable()
+        lead_tally: dict[tuple[int, Any], int] = {}
+        nom_tally: dict[tuple[int, Any], int] = {}
+        for kind, cid, a in ok:
+            t = lead_tally if kind == 0 else nom_tally
+            key = (cid, _addr_key(a))
+            t[key] = t.get(key, 0) + 1
+        bestl = top(lead_tally)
+        if bestl is not None and bestl[1] >= majority:
+            (lid, laddr), _ = bestl
+            return lid, _addr_restore(laddr)
+
+        # Phase 2: confirm only when the convergent nominee is us.
+        bestn = top(nom_tally)
+        if bestn is not None and bestn[1] >= majority \
+                and bestn[0][0] == candidate_id:
+            confs = await asyncio.gather(
+                *(bounded(c.confirm(candidate_id)) for c in coordinators),
+                return_exceptions=True)
+            if sum(1 for r in confs if r is True) >= majority:
+                return candidate_id, address
+
+        if loop.time() >= deadline:
+            raise CoordinatorsUnreachable()
+        await asyncio.sleep(k.ELECTION_BACKOFF * (0.5 + rng.random()))

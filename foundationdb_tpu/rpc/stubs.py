@@ -34,7 +34,8 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
     "ratekeeper": [("admit", False), ("get_rate", False),
                    ("get_throttle", False)],
     "coordinator": [("read", False), ("write", False),
-                    ("candidacy", False), ("leader_heartbeat", False),
+                    ("nominate", False), ("confirm", False),
+                    ("leader_heartbeat", False),
                     ("open_database", False), ("read_leader", False)],
     "worker": [("recruit", False), ("stop_role", False),
                ("rejoin_storage", False), ("list_roles", False)],

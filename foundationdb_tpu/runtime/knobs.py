@@ -69,6 +69,9 @@ class Knobs:
     LEADER_LEASE_DURATION: float = 2.0
     LEADER_HEARTBEAT_INTERVAL: float = 0.5
     RECOVERY_RETRY_DELAY: float = 0.5
+    NOMINATION_TIMEOUT: float = 1.0           # unrefreshed candidacies lapse
+    ELECTION_TIMEOUT: float = 8.0             # one elect_leader call's budget
+    ELECTION_BACKOFF: float = 0.15            # base inter-round retry delay
 
     # --- tlog ---
     TLOG_SPILL_THRESHOLD: int = 1 << 30
