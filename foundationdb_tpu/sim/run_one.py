@@ -37,6 +37,11 @@ async def simulate(seed: int, kills: int, buggify: bool) -> dict:
         {"testName": "Increment", "incrementsPerClient": 10},
         {"testName": "VersionStamp", "stampsPerClient": 8},
         {"testName": "Watches", "rounds": 3, "strictFires": False},
+        {"testName": "ApiCorrectness", "keyCount": 16,
+         "transactionsPerClient": 10, "opsPerTransaction": 6},
+        {"testName": "Sideband", "messages": 8},
+        {"testName": "BankTransfer", "accounts": 8,
+         "transfersPerClient": 8, "scanEvery": 4},
         {"testName": "ConfigureDatabase", "sim": sim, "rounds": 2,
          "secondsBetweenChanges": 2.5},
         {"testName": "MachineAttrition", "sim": sim, "machinesToKill": kills},

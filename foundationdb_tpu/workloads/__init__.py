@@ -9,5 +9,6 @@ violated by lost/phantom/reordered writes.
 
 from .workload import (TestWorkload, WorkloadContext, register_workload,
                        make_workload, run_workloads, run_workloads_on)
-from . import (attrition, conflict_range, consistency, cycle,  # noqa: F401  (register)
-               dynamic, increment, ops, random_rw, serializability)
+from . import (attrition, conflict_range, consistency,  # noqa: F401  (register)
+               correctness, cycle, dynamic, increment, ops, random_rw,
+               serializability)

@@ -49,3 +49,11 @@ def test_region_chaos_spec():
 def test_engine_migration_spec():
     r = _run("engine_migration_chaos.toml", 25)
     assert r["phase1"]["EngineMigration"]["migrated_replicas"] > 0
+
+
+def test_api_correctness_chaos_spec():
+    r = _run("api_correctness_chaos.toml", 26)
+    assert r["phase1"]["ApiCorrectness"]["committed"] == 40
+    assert r["phase1"]["Sideband"]["causally_checked"] == 15
+    assert r["phase1"]["BankTransfer"]["transfers"] == 30
+    assert r["phase1"]["MachineAttrition"]["machines_killed"] == 2

@@ -142,3 +142,26 @@ def test_increment_and_versionstamp_workloads():
     ], seed=7, config=multi(), client_count=3)
     assert res["Increment"]["increments"] == 36
     assert res["VersionStamp"]["stamped"] == 30
+
+
+def test_api_correctness_workload():
+    res = run_workloads([{"testName": "ApiCorrectness", "keyCount": 20,
+                          "transactionsPerClient": 15,
+                          "opsPerTransaction": 8}],
+                        seed=31, client_count=2)
+    assert res["ApiCorrectness"]["committed"] == 30
+    assert res["ApiCorrectness"]["reads_checked"] > 20
+
+
+def test_sideband_workload():
+    res = run_workloads([{"testName": "Sideband", "messages": 12}],
+                        seed=32, client_count=2)
+    assert res["Sideband"]["causally_checked"] == 12
+
+
+def test_bank_transfer_workload():
+    res = run_workloads([{"testName": "BankTransfer", "accounts": 8,
+                          "transfersPerClient": 12, "scanEvery": 4}],
+                        seed=33, client_count=3)
+    assert res["BankTransfer"]["transfers"] == 36
+    assert res["BankTransfer"]["scans"] >= 9
