@@ -536,8 +536,10 @@ def main() -> int:
                         ap["safety_violations"],
                 })
                 if ap["safety_violations"]:
-                    print("FATAL: encoded backend committed a txn the "
-                          "exact baseline aborted", file=sys.stderr)
+                    print("FATAL: encoded backend committed a txn whose "
+                          "reads conflict with its own committed history "
+                          "(non-serializable encoded execution)",
+                          file=sys.stderr)
                     rc = 1
             except Exception as e:  # noqa: BLE001 — gate is an extra
                 out["abort_parity_error"] = repr(e)[:300]

@@ -9,18 +9,23 @@ interval-map baseline would commit.  This harness measures HOW MUCH, on
 a range-heavy workload built to stress exactly those paths:
 
 - identical batches (same seed, same commit versions) run through the
-  exact backend and the encoded backend, each self-consistent;
-- on the prefix BEFORE the first verdict divergence the comparison is
-  1:1 per transaction: every encoded-CONFLICT/exact-COMMITTED verdict
-  is a *widening abort*, attributed to coalescing (the txn had > R
-  ranges) or to key encoding (it did not);
-- an encoded-COMMITTED/exact-CONFLICT verdict on that prefix is a
-  SAFETY violation (the conservative direction only is allowed);
-- past the divergence the two histories legitimately differ (different
-  commit sets), so only aggregate abort rates are compared.
+  exact backend and the encoded backend, each self-consistent; the
+  aggregate abort rates are compared between the two executions;
+- EVERY encoded verdict is then audited by a *shadow replay*: a fresh
+  exact interval map is fed exactly the writes the ENCODED execution
+  committed (in order), and each transaction's reads are checked
+  against it at its own snapshot.  Unlike a first-divergence prefix
+  comparison, the audit stays valid past any divergence — the shadow
+  mirrors the encoded history, not the exact backend's;
+- an encoded-COMMITTED verdict whose reads conflict with the encoded
+  execution's own committed history is a SAFETY violation (the
+  encoded execution would be non-serializable);
+- an encoded abort the shadow would have committed is a *widening
+  abort*, attributed to the fat-txn path (the txn had > R ranges) or
+  to key encoding (it did not).
 
 The gate: aggregate abort-rate delta relative to exact stays under
-``max_rel_delta`` and the prefix shows zero safety violations.
+``max_rel_delta`` and the audit shows zero safety violations.
 """
 
 from __future__ import annotations
@@ -100,12 +105,18 @@ def run_parity(knobs: Knobs, encoded_kind: str = "numpy",
     R = knobs.RESOLVER_RANGES_PER_TXN
 
     verdicts = {}
+    enc_warm_verdicts: list[list[int]] = []
     for kind in ("cpp", encoded_kind):
         backend = make_conflict_backend(
             knobs.override(RESOLVER_CONFLICT_BACKEND=kind),
             device=device if kind != "cpp" else None)
         for txns, v in zip(warm, warm_vs):
-            backend.resolve(txns, v)
+            row = list(backend.resolve(txns, v))
+            if kind == encoded_kind:
+                # only the encoded execution's warm verdicts feed the
+                # shadow audit; the exact backend's warmup just seeds
+                # its own history
+                enc_warm_verdicts.append(row)
         out = []
         for txns, v in zip(batches, versions):
             out.append(list(backend.resolve(txns, v)))
@@ -121,34 +132,46 @@ def run_parity(knobs: Knobs, encoded_kind: str = "numpy",
             for code in batch:
                 counts[key][names[code]] += 1
 
-    # 1:1 classification stops AT the first divergent transaction: past
-    # it the two histories legitimately differ (different commit sets),
-    # so a later exact-CONFLICT/encoded-COMMITTED in the same batch
-    # would be history drift, not a safety violation
+    # Shadow replay: audit EVERY encoded verdict, not a first-divergence
+    # prefix (a prefix comparison goes blind after the first benign
+    # widening abort — an unsafe verdict behind it would never be
+    # counted).  A fresh exact interval map is fed exactly the writes
+    # the ENCODED execution committed, in order; each txn's reads are
+    # checked against it at the txn's own snapshot, so the audit is
+    # valid for the whole run — the shadow mirrors the encoded history.
+    from ..ops.conflict_cpp import CppConflictSet
+    shadow = CppConflictSet()       # oldest stays 0: the audit never TooOlds
     widening_coalesce = widening_encoding = widening_too_old = 0
     safety_violations = 0
-    prefix_txns = 0
-    diverged = False
-    for bi, (ev, nv) in enumerate(zip(exact, enc)):
-        for ti, (e, n) in enumerate(zip(ev, nv)):
-            prefix_txns += 1
-            if e == n:
-                continue
-            diverged = True
-            fat = len(batches[bi][ti].read_ranges) > R \
-                or len(batches[bi][ti].write_ranges) > R
-            if n == CONFLICT and e == COMMITTED:
-                if fat:
+    audited = 0
+
+    def replay(txns, v, verdict_row, count: bool) -> None:
+        nonlocal widening_coalesce, widening_encoding, widening_too_old, \
+            safety_violations, audited
+        for t, n in zip(txns, verdict_row):
+            [chk] = shadow.resolve_batch(
+                [TxnRequest(t.read_ranges, [], t.read_snapshot)], v)
+            if n == COMMITTED:
+                if count and chk == CONFLICT:
+                    safety_violations += 1
+                shadow.resolve_batch([TxnRequest([], t.write_ranges, v)], v)
+            elif count and chk == COMMITTED:
+                fat = len(t.read_ranges) > R or len(t.write_ranges) > R
+                if n == TOO_OLD:
+                    widening_too_old += 1
+                elif fat:
                     widening_coalesce += 1
                 else:
                     widening_encoding += 1
-            elif n == TOO_OLD and e != TOO_OLD:
-                widening_too_old += 1
-            elif n == COMMITTED and e == CONFLICT:
-                safety_violations += 1
-            break
-        if diverged:
-            break
+            if count:
+                audited += 1
+
+    # warmup feeds the shadow's history but is not scored (the encoded
+    # backend's sidecar is also born during warmup — same window)
+    for (txns, v), row in zip(zip(warm, warm_vs), enc_warm_verdicts):
+        replay(txns, v, row, count=False)
+    for (txns, v), row in zip(zip(batches, versions), enc):
+        replay(txns, v, row, count=True)
 
     total = n_batches * batch_size
     exact_aborts = total - counts["exact"]["committed"]
@@ -161,7 +184,7 @@ def run_parity(knobs: Knobs, encoded_kind: str = "numpy",
         "abort_rate_encoded": round(enc_aborts / total, 4),
         "abort_rel_delta": round(rel, 4),
         "verdict_counts": counts,
-        "prefix_txns_compared": prefix_txns,
+        "txns_audited": audited,
         "widening_aborts_coalescing": widening_coalesce,
         "widening_aborts_encoding": widening_encoding,
         "widening_aborts_too_old": widening_too_old,

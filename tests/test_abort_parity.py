@@ -18,14 +18,18 @@ def _knobs(r=8):
 def test_range_heavy_abort_parity_gate():
     report = run_parity(_knobs(), "numpy", n_batches=40, batch_size=24,
                         seed=7)
+    # the shadow replay audits EVERY txn (960 here), so an unsafe
+    # verdict anywhere in the run — not just before the first benign
+    # divergence — fails the gate
+    assert report["txns_audited"] == 40 * 24
     assert report["safety_violations"] == 0
-    # fat txns ride the exact sidecar: coalescing itself contributes
-    # nothing.  The residual delta (~0.4% of txns absolute at this
-    # shape) is the irreducible conservative widening: a fat txn's
-    # WRITES still enter the kernel ring coalesced (slim checks must
-    # see them), so a slim read overlapping the widened span aborts
-    # where the exact baseline would not.
-    assert report["widening_aborts_coalescing"] == 0
+    # fat txns ride the exact sidecar, so coalescing-at-R contributes
+    # ~nothing; the audited residual is the sidecar's deliberate
+    # over-approximation (it counts even kernel-aborted slim txns'
+    # writes — conservative by design) plus fixed-width key-encoding
+    # widening.  Both must stay a hair's breadth from exact.
+    assert report["widening_aborts_coalescing"] <= 2, report
+    assert report["widening_aborts_encoding"] <= 4, report
     assert report["abort_rel_delta"] < 0.15, report
 
 
