@@ -560,11 +560,22 @@ class ClusterController:
                                 # strand the replica on the ended
                                 # generation; fail and retry
                                 raise FdbError("waiting for storage workers")
+                            TraceEvent("StorageRejoinPlan") \
+                                .detail("Tag", tag) \
+                                .detail("Decision", "worker-dead") \
+                                .detail("Addr", str(wa)).log()
                             continue   # dead: reads fail over to its team
                         if not self.fm.is_available(wa):
                             # skipped now; a registration reporting the tag
                             # resident re-triggers recovery via active_tags
+                            TraceEvent("StorageRejoinPlan") \
+                                .detail("Tag", tag) \
+                                .detail("Decision", "fm-unavailable") \
+                                .detail("Addr", str(wa)).log()
                             continue
+                        TraceEvent("StorageRejoinPlan").detail("Tag", tag) \
+                            .detail("Decision", "rejoin") \
+                            .detail("Addr", str(wa)).log()
                         rejoin_plan.append((wa, s))
                     else:
                         # moved/split-in range: fetch from a live replica of

@@ -142,11 +142,11 @@ class StorageServer:
         the recovery version (those mutations came from a generation's
         clamped, unacked suffix), swap in the new generation list, and
         restart the pull loop from the consistent cut."""
+        from ..runtime.trace import TraceEvent
         if self.durable_version > recovery_version:
             # durable state is ahead of the recovered history — this
             # replica cannot be rolled back and must be discarded/refetched
             # (the reference kills the storage server here)
-            from ..runtime.trace import TraceEvent
             TraceEvent("StorageRejoinAhead", severity=30) \
                 .detail("Tag", self.tag) \
                 .detail("DurableVersion", self.durable_version) \
@@ -188,6 +188,10 @@ class StorageServer:
                     ms = KeyRange(ms.begin, b)
             self._meta_shard = ms
         self.log_system.generations[:] = generations
+        TraceEvent("StorageRejoinRan").detail("Tag", self.tag) \
+            .detail("Version", self.version) \
+            .detail("RecoveryVersion", recovery_version) \
+            .detail("PullRestarted", running).log()
         if running:
             self._pull_task = asyncio.get_running_loop().create_task(
                 self._pull_loop(), name=f"storage-{self.tag}-pull")

@@ -44,6 +44,28 @@ def server_log_tail(log_path, n: int = 2000) -> str:
         return ""
 
 
+def teardown_servers(procs, logs=None) -> None:
+    """SIGTERM every live server, escalate to SIGKILL on a shared
+    deadline, and dump log tails when any server crashed."""
+    procs = list(procs.values()) if isinstance(procs, dict) else list(procs)
+    for pr in procs:
+        if pr.poll() is None:
+            pr.send_signal(signal.SIGTERM)
+    deadline = time.time() + 10
+    for pr in procs:
+        try:
+            pr.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            pr.kill()
+            pr.wait()
+    if logs:
+        tails = {str(lg): server_log_tail(lg) for lg in logs}
+        if any("Traceback" in t for t in tails.values()):
+            print("\n=== server logs ===")
+            for name, t in tails.items():
+                print(f"--- {name} ---\n{t}")
+
+
 def free_ports(n: int) -> list[int]:
     socks, ports = [], []
     for _ in range(n):
@@ -102,20 +124,7 @@ def test_three_process_cluster_smoke(tmp_path):
 
         asyncio.run(asyncio.wait_for(drive(), timeout=90.0))
     finally:
-        for pr in procs:
-            pr.send_signal(signal.SIGTERM)
-        deadline = time.time() + 10
-        for pr in procs:
-            try:
-                pr.wait(timeout=max(0.1, deadline - time.time()))
-            except subprocess.TimeoutExpired:
-                pr.kill()
-                pr.wait()
-        tails = [server_log_tail(lg) for lg in logs]
-        if any("Traceback" in t for t in tails):
-            print("\n=== server logs ===")
-            for i, t in enumerate(tails):
-                print(f"--- server {i} ---\n{t}")
+        teardown_servers(procs, logs)
 
 
 def test_dr_and_lock_through_cli(tmp_path):
@@ -170,12 +179,96 @@ def test_dr_and_lock_through_cli(tmp_path):
 
         asyncio.run(asyncio.wait_for(drive(), timeout=240.0))
     finally:
-        for pr in procs:
-            pr.send_signal(signal.SIGTERM)
-        deadline = time.time() + 10
-        for pr in procs:
-            try:
-                pr.wait(timeout=max(0.1, deadline - time.time()))
-            except subprocess.TimeoutExpired:
-                pr.kill()
-                pr.wait()
+        teardown_servers(procs)
+
+
+def test_tcp_leader_kill_failover(tmp_path):
+    """The wall-clock churn scenario the two-phase nominate/confirm
+    election exists for: SIGKILL the elected cluster controller's
+    process on a loaded single-CPU host, and the survivors must
+    re-elect exactly one leader, recover a new epoch, and serve
+    transactions — no split grant, no leadership ping-pong."""
+    ports = free_ports(3)
+    cf = ClusterFile("failover", "t1",
+                     [NetworkAddress("127.0.0.1", p) for p in ports])
+    cf_path = tmp_path / "fdb.cluster"
+    cf.save(str(cf_path))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = {}
+    logs = {p: tmp_path / f"server-{p}.log" for p in ports}
+    try:
+        for p in ports:
+            # min_workers=2: recovery after the kill must be able to
+            # complete with the two survivors.  replication=2 is the
+            # POINT of the scenario: with the default replication=1 a
+            # killed host's shard is *correctly* unavailable forever
+            # (its only replica died), so the post-failover reads would
+            # legitimately never succeed — diagnosed the hard way via
+            # per-replica error tracing
+            procs[p] = spawn_server(
+                [sys.executable, "-m", "foundationdb_tpu.server",
+                 "-C", str(cf_path), "-l", f"127.0.0.1:{p}",
+                 "--spec", "min_workers=2,replication=2"], logs[p], env)
+
+        async def drive():
+            from foundationdb_tpu.cli import open_cli
+            from foundationdb_tpu.rpc.stubs import CoordinatorClient
+            from foundationdb_tpu.rpc.tcp_transport import TcpTransport
+            from foundationdb_tpu.rpc.transport import WLTOKEN_COORDINATOR
+            from foundationdb_tpu.runtime.knobs import Knobs
+
+            cli = await open_cli(str(cf_path), Knobs(), timeout=90.0)
+            assert await cli.execute("set before failover") == "Committed"
+
+            # locate the elected leader through the coordinators
+            t = TcpTransport(NetworkAddress("127.0.0.1", 0))
+            leader_port = None
+            for p in ports:
+                co = CoordinatorClient(t, NetworkAddress("127.0.0.1", p),
+                                       WLTOKEN_COORDINATOR)
+                try:
+                    led = await asyncio.wait_for(co.read_leader(), 5.0)
+                except (Exception, asyncio.TimeoutError):
+                    continue
+                if led is not None:
+                    leader_port = led[1][1]
+                    break
+            assert leader_port in procs, f"no leader found ({leader_port})"
+
+            procs[leader_port].kill()          # SIGKILL: no goodbye
+            procs[leader_port].wait()
+
+            # the survivors re-elect and recover; every CLI call may
+            # retry through the recovery window.  Each attempt is
+            # bounded: a single hung await must surface as a diagnosable
+            # timeout (with parked-task stacks), not eat the whole budget
+            async def bounded(line, want, budget=60.0):
+                deadline = time.time() + budget
+                last = None
+                while True:
+                    try:
+                        out = await asyncio.wait_for(cli.execute(line), 30.0)
+                        if want in out:
+                            return out
+                        last = out
+                    except asyncio.TimeoutError:
+                        view = cli.view
+                        last = (f"{line!r} hung >30s; epoch={view.epoch} "
+                                f"teams={view.shard_map.shard_tags} "
+                                f"storage={[(s.tag, s._address.port) for s in view.storage_clients]}")
+                    except Exception as e:  # noqa: BLE001 — retry window
+                        last = repr(e)
+                    assert time.time() < deadline, f"no recovery: {last}"
+                    await asyncio.sleep(2.0)
+
+            await bounded("set after failover", "Committed")
+            assert await bounded("get before", "is") \
+                == "`before' is `failover'"
+            assert await bounded("get after", "is") == "`after' is `failover'"
+            out = await bounded("status", "epoch:")
+            assert "epoch:" in out
+
+        asyncio.run(asyncio.wait_for(drive(), timeout=300.0))
+    finally:
+        teardown_servers(procs, logs.values())
