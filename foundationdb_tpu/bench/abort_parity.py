@@ -104,6 +104,9 @@ def run_parity(knobs: Knobs, encoded_kind: str = "numpy",
     batches, versions = wl.make_batches(n_batches, batch_size)
     R = knobs.RESOLVER_RANGES_PER_TXN
 
+    # the exact baseline is always "cpp"; an encoded_kind of "cpp" would
+    # run it twice and double-append warm rows into the shadow audit
+    assert encoded_kind != "cpp", "encoded_kind must be an encoded backend"
     verdicts = {}
     enc_warm_verdicts: list[list[int]] = []
     for kind in ("cpp", encoded_kind):

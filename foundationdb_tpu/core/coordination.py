@@ -165,22 +165,47 @@ class Coordinator:
         best = self._best_nominee(now)
         return [1, best.candidate_id, best.address]
 
-    async def confirm(self, candidate_id: int) -> bool:
+    async def confirm(self, candidate_id: int, round_id: int = 0) -> bool:
         """Phase 2: grant the lease iff the caller is still this
         coordinator's best nominee and no OTHER unexpired leader exists.
         Idempotent for the incumbent (True without extending the lease —
-        renewal is leader_heartbeat's job)."""
+        renewal is leader_heartbeat's job).  ``round_id`` fences the grant
+        against stale withdraws (see withdraw)."""
         now = asyncio.get_running_loop().time()
         if self._leader is not None and now < self._leader.lease_end:
-            return self._leader.leader_id == candidate_id
+            if self._leader.leader_id == candidate_id:
+                self._lease_round = round_id     # re-fence for this round
+                return True
+            return False
         best = self._best_nominee(now)
         if best is None or best.candidate_id != candidate_id:
             return False
         self._leader = LeaderInfo(
             candidate_id, best.address,
             now + self.knobs.LEADER_LEASE_DURATION)
+        self._lease_round = round_id
         TraceEvent("CoordLeaderChange").detail("Leader", candidate_id).log()
         return True
+
+    async def withdraw(self, candidate_id: int, round_id: int = 0) -> bool:
+        """Release a lease this candidate holds HERE (losing candidates
+        call this after a failed confirm round).  A candidate that won
+        confirm at only a minority otherwise parks those coordinators
+        behind its unexpired lease for LEADER_LEASE_DURATION, stalling
+        the next election wave.  Safe: the caller did not believe it was
+        leader in ``round_id`` (it saw < majority), and the round fence
+        rejects a withdraw delivered late (e.g. past a client timeout
+        over TCP) after the SAME candidate legitimately won a LATER
+        confirm round — without it, the stale withdraw would revoke the
+        new lease and open a split-brain window."""
+        if self._leader is not None \
+                and self._leader.leader_id == candidate_id \
+                and getattr(self, "_lease_round", 0) == round_id:
+            self._leader = None
+            TraceEvent("CoordLeaseWithdrawn") \
+                .detail("Candidate", candidate_id).log()
+            return True
+        return False
 
     async def read_leader(self) -> tuple[int, Any] | None:
         """Read-only leader query (the reference's monitorLeader side):
@@ -336,21 +361,42 @@ async def elect_leader(coordinators: list, candidate_id: int, address: Any,
         # deterministic: most votes, ties to the lowest candidate id
         return min(tally.items(), key=lambda kv: (-kv[1], kv[0][0]))
 
-    while True:
-        # Phase 0: follow an already-confirmed live leader.
+    async def poll_leader() -> tuple[int, Any] | None:
+        """Read-only leader check: a MAJORITY agreeing on one unexpired
+        leader ⇒ (id, addr), else None."""
         reads = await asyncio.gather(
             *(bounded(c.read_leader()) for c in coordinators),
             return_exceptions=True)
-        tally0: dict[tuple[int, Any], int] = {}
+        tally: dict[tuple[int, Any], int] = {}
         for r in reads:
             if isinstance(r, BaseException) or r is None:
                 continue
             key = (r[0], _addr_key(r[1]))
-            tally0[key] = tally0.get(key, 0) + 1
-        best0 = top(tally0)
-        if best0 is not None and best0[1] >= majority:
-            (lid, laddr), _ = best0
+            tally[key] = tally.get(key, 0) + 1
+        best = top(tally)
+        if best is not None and best[1] >= majority:
+            (lid, laddr), _ = best
             return lid, _addr_restore(laddr)
+        return None
+
+    # Liveness under asymmetric partitions: a candidate every coordinator
+    # converges on (it can NOMINATE everywhere) whose CONFIRM path is
+    # broken would otherwise keep refreshing its nominations forever and
+    # park the election — rivals can never become best nominee.  After
+    # two consecutive failed confirm rounds as the convergent nominee,
+    # stand down: stop nominating long enough for our nominations to
+    # lapse (NOMINATION_TIMEOUT) so rivals converge, while still polling
+    # read-only for the leader they elect.
+    failed_confirms = 0
+    # round fence for confirm/withdraw: a withdraw delivered late (past a
+    # client timeout) must not revoke a lease won in a LATER round
+    round_id = 0
+
+    while True:
+        # Phase 0: follow an already-confirmed live leader.
+        led = await poll_leader()
+        if led is not None:
+            return led
 
         # Phase 1: nominate everywhere; tally leaders and nominees.
         noms = await asyncio.gather(
@@ -375,11 +421,40 @@ async def elect_leader(coordinators: list, candidate_id: int, address: Any,
         bestn = top(nom_tally)
         if bestn is not None and bestn[1] >= majority \
                 and bestn[0][0] == candidate_id:
+            round_id += 1
             confs = await asyncio.gather(
-                *(bounded(c.confirm(candidate_id)) for c in coordinators),
+                *(bounded(c.confirm(candidate_id, round_id))
+                  for c in coordinators),
                 return_exceptions=True)
             if sum(1 for r in confs if r is True) >= majority:
                 return candidate_id, address
+            failed_confirms += 1
+            # Lost the round: release every lease this round may have
+            # granted — including at coordinators whose True reply was
+            # LOST (timeout), or they stay parked behind the unexpired
+            # lease for LEADER_LEASE_DURATION.  Safe: we know we lost
+            # round_id, and the fence stops a late-delivered withdraw
+            # from revoking a lease we win in a later round.
+            await asyncio.gather(
+                *(bounded(c.withdraw(candidate_id, round_id))
+                  for c in coordinators),
+                return_exceptions=True)
+            if failed_confirms >= 2:
+                # our confirm path is broken while our nominate path works
+                # (asymmetric partition): stand down so our nominations
+                # lapse and rivals can converge; keep watching read-only
+                # for whoever they elect
+                failed_confirms = 0
+                lapse_end = loop.time() + k.NOMINATION_TIMEOUT * 1.25
+                while loop.time() < lapse_end:
+                    await asyncio.sleep(k.ELECTION_BACKOFF)
+                    led = await poll_leader()
+                    if led is not None:
+                        return led
+                    if loop.time() >= deadline:
+                        raise CoordinatorsUnreachable()
+        else:
+            failed_confirms = 0
 
         if loop.time() >= deadline:
             raise CoordinatorsUnreachable()

@@ -35,7 +35,7 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
                    ("get_throttle", False)],
     "coordinator": [("read", False), ("write", False),
                     ("nominate", False), ("confirm", False),
-                    ("leader_heartbeat", False),
+                    ("withdraw", False), ("leader_heartbeat", False),
                     ("open_database", False), ("read_leader", False)],
     "worker": [("recruit", False), ("stop_role", False),
                ("rejoin_storage", False), ("list_roles", False)],

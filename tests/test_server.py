@@ -224,16 +224,19 @@ def test_tcp_leader_kill_failover(tmp_path):
             # locate the elected leader through the coordinators
             t = TcpTransport(NetworkAddress("127.0.0.1", 0))
             leader_port = None
-            for p in ports:
-                co = CoordinatorClient(t, NetworkAddress("127.0.0.1", p),
-                                       WLTOKEN_COORDINATOR)
-                try:
-                    led = await asyncio.wait_for(co.read_leader(), 5.0)
-                except (Exception, asyncio.TimeoutError):
-                    continue
-                if led is not None:
-                    leader_port = led[1][1]
-                    break
+            try:
+                for p in ports:
+                    co = CoordinatorClient(t, NetworkAddress("127.0.0.1", p),
+                                           WLTOKEN_COORDINATOR)
+                    try:
+                        led = await asyncio.wait_for(co.read_leader(), 5.0)
+                    except (Exception, asyncio.TimeoutError):
+                        continue
+                    if led is not None:
+                        leader_port = led[1][1]
+                        break
+            finally:
+                await t.close()
             assert leader_port in procs, f"no leader found ({leader_port})"
 
             procs[leader_port].kill()          # SIGKILL: no goodbye
