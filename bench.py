@@ -191,10 +191,10 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
 
     from foundationdb_tpu.ops.batch import wire_from_txns
 
-    # K=128 fused groups amortize per-dispatch cost; at B=64 R=2 one
-    # group exactly tiles the 2^14-slot ring (measured best, r4; and
-    # INFLIGHT=16 measured no better than 8 in the same window)
-    GROUP, INFLIGHT = 128, 8
+    # K=256 fused groups (r5 canonical hot/cold ring: the scan carry no
+    # longer scales with ring capacity, so deeper groups amortize the
+    # dispatch further — r5 sweep: K=256 beat K=128 by ~1.3-1.6x)
+    GROUP, INFLIGHT = 256, 8
     wl = MakoWorkload(n_keys=n_keys, seed=42)
     batches, versions = wl.make_batches(n_batches, batch_size)
     # the proxy-serialized form of the same batches (built where a proxy
@@ -216,14 +216,13 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
         # both transfer volume and kernel rows vs the default bucket of 4
         # (BASELINE.md: range-count bucketing is swept separately)
         RESOLVER_RANGES_PER_TXN=2,
-        # append-slab ring sized to the MVCC window, NOT the run length:
-        # inside a lax.scan each dynamic_update_slice rewrites the whole
-        # ring buffer, so exec scales with capacity (measured 1.0 ->
-        # 0.25 ms/batch shrinking 2^18 -> 2^14 slots).  2^14 slots = 128
-        # batches of history at R=2; mako snapshot staleness is <= 6
-        # batches, so the rising floor never produces a TOO_OLD the exact
-        # cpp baseline wouldn't (verdict parity is asserted below).
-        CONFLICT_RING_CAPACITY=1 << 14,
+        # r5 canonical ring: capacity no longer costs per-batch (the
+        # whole-ring rewrite is gone; the cold ring shifts once per
+        # dispatch), so the ring holds 512 batches of history at R=2.
+        # mako snapshot staleness is <= 6 batches, so the rising floor
+        # never produces a TOO_OLD the exact cpp baseline wouldn't
+        # (verdict parity is asserted below).
+        CONFLICT_RING_CAPACITY=1 << 16,
         KEY_ENCODE_BYTES=32,
         # window 1024 >= the MVCC span mako needs; the exact fast path
         # covers every batch and the compare cost scales with the window
@@ -324,30 +323,55 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
     }
 
 
+def tpu_e2e_knobs(kind: str):
+    """The r5 tpu e2e operating point: shallow concurrent batches fused
+    by the resolver's group dispatcher (VERDICT r4 1b) — COMMIT_BATCH 5ms
+    pinned to one 64-txn chunk, group bucket pinned to one compile shape,
+    ring sized so 5s of writes never wedge the too-old floor, window
+    sized past snapshot staleness (~24 batches at tunnel latency)."""
+    from foundationdb_tpu.runtime import Knobs
+    knobs = Knobs().override(RESOLVER_CONFLICT_BACKEND=kind)
+    if kind == "tpu":
+        knobs = knobs.override(
+            COMMIT_BATCH_INTERVAL=0.005, GRV_BATCH_INTERVAL=0.003,
+            RESOLVER_BATCH_TXNS=64, COMMIT_BATCH_COUNT_LIMIT=64,
+            CONFLICT_RING_CAPACITY=1 << 17, CONFLICT_WINDOW_SLOTS=8192,
+            KEY_ENCODE_BYTES=32, RESOLVER_GROUP_BUCKET=8)
+    return knobs
+
+
 def run_e2e_phase(tpu_device, quiet: bool) -> dict:
     """Client-boundary mako TPS through GRV->commit (BASELINE configs 1-2)
-    for both backends; each gets its tuned server batching knobs (the
-    tunnel's ~64ms RTT wants deep commit batches on the tpu path)."""
+    for both backends, with the commit-path stage breakdown captured for
+    the artifact (VERDICT r4 1a)."""
     import asyncio
 
     from foundationdb_tpu.bench.e2e import run_e2e
-    from foundationdb_tpu.runtime import Knobs
 
     out = {}
-    cpp_knobs = Knobs().override(RESOLVER_CONFLICT_BACKEND="cpp")
-    out["cpp"] = asyncio.run(run_e2e(cpp_knobs, duration_s=3.0,
+    out["cpp"] = asyncio.run(run_e2e(tpu_e2e_knobs("cpp"), duration_s=5.0,
                                      n_clients=64, warmup_s=1.0))
-    tpu_knobs = Knobs().override(
-        RESOLVER_CONFLICT_BACKEND="tpu",
-        COMMIT_BATCH_INTERVAL=0.05, GRV_BATCH_INTERVAL=0.01,
-        RESOLVER_BATCH_TXNS=256)
-    out["tpu"] = asyncio.run(run_e2e(tpu_knobs, duration_s=5.0,
-                                     n_clients=256, device=tpu_device,
-                                     warmup_s=12.0))
+    out["tpu"] = asyncio.run(run_e2e(tpu_e2e_knobs("tpu"), duration_s=8.0,
+                                     n_clients=512, device=tpu_device,
+                                     warmup_s=15.0))
     if not quiet:
         print(f"[e2e cpp] {out['cpp']}", file=sys.stderr)
         print(f"[e2e tpu] {out['tpu']}", file=sys.stderr)
     return out
+
+
+def probe_rtt(tpu_device) -> float | None:
+    """Measured tunnel round-trip floor: tiny put+sync, min of 8."""
+    if tpu_device is None:
+        return None
+    import jax
+
+    xs = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_put(np.int32(1), tpu_device))
+        xs.append(time.perf_counter() - t0)
+    return round(min(xs) * 1e3, 2)
 
 
 def run_configs34_phase(tpu_device, quiet: bool) -> dict:
@@ -364,12 +388,8 @@ def run_configs34_phase(tpu_device, quiet: bool) -> dict:
     out = {}
     for kind in ("cpp", "tpu"):
         dev = tpu_device if kind == "tpu" else None
-        warm = 8.0 if kind == "tpu" else 1.0
-        knobs = Knobs().override(RESOLVER_CONFLICT_BACKEND=kind)
-        if kind == "tpu":
-            knobs = knobs.override(COMMIT_BATCH_INTERVAL=0.05,
-                                   GRV_BATCH_INTERVAL=0.01,
-                                   RESOLVER_BATCH_TXNS=256)
+        warm = 10.0 if kind == "tpu" else 1.0
+        knobs = tpu_e2e_knobs(kind)
         out[f"ycsb_{kind}"] = asyncio.run(run_ycsb_f(
             knobs, n_rows=20_000, duration_s=2.0, n_clients=64,
             device=dev, warmup_s=warm))
@@ -380,6 +400,73 @@ def run_configs34_phase(tpu_device, quiet: bool) -> dict:
             print(f"[ycsb {kind}] {out[f'ycsb_{kind}']}", file=sys.stderr)
             print(f"[tpcc {kind}] {out[f'tpcc_{kind}']}", file=sys.stderr)
     return out
+
+
+def project_local_attach(out: dict, e2e: dict) -> dict:
+    """Locally-attached projection (VERDICT r4 1c): what the tpu e2e
+    number becomes with the tunnel RTT removed, computed from MEASURED
+    components of THIS run — no constants from prior rounds.
+
+    Model (every input is a key already in the artifact):
+      device_ms   = grouped_us_per_batch * mean_group_size / 1000 + 1.0
+                    (measured fused-path per-batch cost x the e2e run's
+                     own mean dispatch group size, + 1ms dispatch margin)
+      local_sync  = device_ms + 1.0            (PCIe-class sync margin)
+      proj_p50    = e2e_p50_tpu - (sync_p50 - local_sync)
+      proj_tps    = n_clients / proj_p50 * (1 - abort_rate_cpp)
+                    (at local latency the OCC contention window shrinks
+                     to cpp-class, so cpp's measured abort rate applies)
+      tunnel_fraction_of_gap = (proj_tps - tps_tpu) / (tps_cpp - tps_tpu)
+    """
+    try:
+        sync = e2e["tpu"]["stages"]["resolver"]["sync"]["p50_ms"]
+        gsize = e2e["tpu"]["stages"]["fused_group_size_mean"] or 1.0
+        us_per_batch = out.get("grouped_us_per_batch_tpu") or 100.0
+        device_ms = us_per_batch * max(1.0, gsize) / 1000.0 + 1.0
+        local_sync = device_ms + 1.0
+        p50 = e2e["tpu"]["p50_ms"]
+        proj_p50 = max(1.0, p50 - (sync - local_sync))
+        proj_tps = e2e["tpu"]["n_clients"] / (proj_p50 / 1e3) \
+            * (1 - e2e["cpp"]["abort_rate"])
+        tps_tpu, tps_cpp = e2e["tpu"]["tps"], e2e["cpp"]["tps"]
+        frac = None
+        if tps_cpp > tps_tpu:
+            frac = max(0.0, min(1.0, (proj_tps - tps_tpu)
+                                / (tps_cpp - tps_tpu)))
+        return {
+            "proj_local_device_ms_per_dispatch": round(device_ms, 3),
+            "proj_local_e2e_p50_ms": round(proj_p50, 1),
+            "proj_local_e2e_tps": round(proj_tps, 1),
+            "proj_tunnel_fraction_of_gap":
+                None if frac is None else round(frac, 3),
+        }
+    except Exception as e:  # noqa: BLE001 — projection is an extra
+        return {"proj_error": repr(e)[:200]}
+
+
+def bench_context() -> dict:
+    """Run-context keys (VERDICT r4 item 10): which configuration
+    produced these numbers."""
+    import os
+
+    from foundationdb_tpu.core.cluster import ClusterConfig
+    cfg = ClusterConfig()
+    try:
+        load = os.getloadavg()
+    except OSError:
+        load = (None,) * 3
+    return {
+        "ctx_replication": cfg.replication,
+        "ctx_role_counts": {
+            "commit_proxies": cfg.commit_proxies,
+            "grv_proxies": cfg.grv_proxies,
+            "resolvers": cfg.resolvers,
+            "tlogs": cfg.logs,
+            "storage": cfg.storage_servers,
+        },
+        "ctx_host_load_1m": load[0],
+        "ctx_host_cpus": os.cpu_count(),
+    }
 
 
 def main() -> int:
@@ -474,7 +561,10 @@ def main() -> int:
             and res["cpp"]["grouped_matches_serial"],
             "verdict_parity": r["parity"],
             "verdict_mismatches": r["mismatches"],
+            "grouped_us_per_batch_tpu":
+                round(res["tpu"]["elapsed_s"] / args.batches * 1e6, 1),
         })
+        out.update(bench_context())
         if not r["parity"]:
             # a kernel that disagrees with the exact CPU baseline must fail
             # the bench, not just annotate the metric
@@ -494,6 +584,10 @@ def main() -> int:
 
         if not args.quick:
             try:
+                out["tunnel_rtt_ms"] = probe_rtt(tpu_device)
+            except Exception as e:  # noqa: BLE001
+                out["tunnel_rtt_error"] = repr(e)[:200]
+            try:
                 e2e = run_e2e_phase(tpu_device, args.quiet)
                 out.update({
                     "e2e_tps_tpu": rnd(e2e["tpu"]["tps"]),
@@ -506,7 +600,13 @@ def main() -> int:
                     "e2e_n_samples_cpp": e2e["cpp"]["n_samples"],
                     "e2e_abort_rate_tpu": rnd(e2e["tpu"]["abort_rate"], 3),
                     "e2e_abort_rate_cpp": rnd(e2e["cpp"]["abort_rate"], 3),
+                    "e2e_n_clients_tpu": e2e["tpu"]["n_clients"],
+                    "e2e_n_clients_cpp": e2e["cpp"]["n_clients"],
+                    # full commit-path stage breakdown (VERDICT r4 1a)
+                    "e2e_stages_tpu": e2e["tpu"]["stages"],
+                    "e2e_stages_cpp": e2e["cpp"]["stages"],
                 })
+                out.update(project_local_attach(out, e2e))
             except Exception as e:  # noqa: BLE001 — e2e must not kill the bench
                 out["e2e_error"] = repr(e)[:300]
             try:

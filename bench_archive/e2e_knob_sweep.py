@@ -1,0 +1,40 @@
+"""One-off r5: e2e knob sweep on the live tunnel — shallow concurrent
+batches (post eager-D2H fix) vs the r4 deep-batch config."""
+import asyncio
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from foundationdb_tpu.bench.e2e import run_e2e
+from foundationdb_tpu.runtime import Knobs
+
+dev = jax.devices()[0]
+print("device:", dev, file=sys.stderr)
+
+CONFIGS = {
+    "r4-deep": dict(COMMIT_BATCH_INTERVAL=0.05, GRV_BATCH_INTERVAL=0.01,
+                    RESOLVER_BATCH_TXNS=256),
+    "shallow-8ms": dict(COMMIT_BATCH_INTERVAL=0.008, GRV_BATCH_INTERVAL=0.005,
+                        RESOLVER_BATCH_TXNS=64),
+    "shallow-5ms": dict(COMMIT_BATCH_INTERVAL=0.005, GRV_BATCH_INTERVAL=0.005,
+                        RESOLVER_BATCH_TXNS=64),
+    # pinned single-chunk batches: every dispatch is the K=1 kernel, no
+    # mid-measurement compiles for new K buckets
+    "pinned-8ms": dict(COMMIT_BATCH_INTERVAL=0.008, GRV_BATCH_INTERVAL=0.005,
+                       RESOLVER_BATCH_TXNS=64, COMMIT_BATCH_COUNT_LIMIT=64),
+    "pinned-5ms": dict(COMMIT_BATCH_INTERVAL=0.005, GRV_BATCH_INTERVAL=0.005,
+                       RESOLVER_BATCH_TXNS=64, COMMIT_BATCH_COUNT_LIMIT=64),
+}
+
+which = sys.argv[1] if len(sys.argv) > 1 else "shallow-8ms"
+n_clients = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+cfg = CONFIGS[which]
+knobs = Knobs().override(RESOLVER_CONFLICT_BACKEND="tpu", **cfg)
+t0 = time.time()
+out = asyncio.run(run_e2e(knobs, duration_s=5.0, n_clients=n_clients,
+                          device=dev, warmup_s=12.0))
+print(which, n_clients, {k: round(v, 1) if isinstance(v, float) else v
+                         for k, v in out.items()})

@@ -1,0 +1,65 @@
+"""One-off r5: kernel-stage config sweep on the live tunnel with the
+canonical (hot/cold) ring.  Sweeps ring capacity / window / GROUP /
+INFLIGHT around the r4 operating point."""
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from bench import measure_grouped
+from foundationdb_tpu.bench.workload import MakoWorkload
+from foundationdb_tpu.ops.backends import make_conflict_backend
+from foundationdb_tpu.ops.batch import wire_from_txns
+from foundationdb_tpu.runtime import Knobs
+
+dev = jax.devices()[0]
+N_BATCHES = 4096
+
+wl = MakoWorkload(n_keys=1_000_000, seed=42)
+batches, versions = wl.make_batches(N_BATCHES, 64)
+wires = [wire_from_txns(b) for b in batches]
+
+CONFIGS = [
+    # (cap_pow, window, group, inflight)
+    (14, 1024, 128, 8),      # r4 operating point
+    (16, 1024, 128, 8),      # big ring now affordable?
+    (14, 512, 128, 8),
+    (14, 1024, 256, 8),
+    (16, 1024, 256, 8),
+    (14, 2048, 128, 8),
+    (14, 1024, 128, 16),
+]
+for cap_pow, window, group, inflight in CONFIGS:
+    knobs = Knobs().override(
+        RESOLVER_CONFLICT_BACKEND="tpu", RESOLVER_BATCH_TXNS=64,
+        RESOLVER_RANGES_PER_TXN=2, CONFLICT_RING_CAPACITY=1 << cap_pow,
+        KEY_ENCODE_BYTES=32, CONFLICT_WINDOW_SLOTS=window)
+    backend = make_conflict_backend(knobs, device=dev)
+    warm_b, warm_v = wl.make_batches(4 + group, 64,
+                                     start_version=versions[-1] + 10_000_000)
+    warm_w = [wire_from_txns(b) for b in warm_b]
+    for txns, v in zip(warm_b[:4], warm_v[:4]):
+        backend.resolve(txns, v)
+    measure_grouped(backend, warm_w[4:], warm_v[4:], group=group,
+                    inflight=inflight)
+    if backend.reset_ring(0):
+        measure_grouped(backend, wires, versions, group=group,
+                        inflight=inflight)
+        backend.reset_ring(0)
+    best = None
+    for _ in range(3):
+        el, verdicts = measure_grouped(backend, wires, versions, group=group,
+                                       inflight=inflight)
+        if best is None or el < best[0]:
+            best = (el, verdicts)
+        backend.reset_ring(0)
+    el, verdicts = best
+    flat = np.array([x for vs in verdicts for x in vs])
+    commits = int((flat == 0).sum())
+    print(f"cap=2^{cap_pow} win={window} K={group} if={inflight}: "
+          f"{el:.3f}s, {commits/el:,.0f} commits/s, "
+          f"{el/N_BATCHES*1e6:.0f}us/batch", flush=True)

@@ -1,0 +1,49 @@
+"""One-off r5: per-batch pipelined resolve cost on the live tunnel, with
+eager D2H issue in place.  Emulates the e2e resolver pattern: submit batch,
+advance chain, sync verdicts later — N batches in flight."""
+import asyncio
+import time
+
+import jax
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+from foundationdb_tpu.bench.workload import MakoWorkload
+from foundationdb_tpu.ops.backends import make_conflict_backend, resolve_begin
+from foundationdb_tpu.runtime import Knobs
+
+dev = jax.devices()[0]
+print("device:", dev)
+
+knobs = Knobs().override(
+    RESOLVER_CONFLICT_BACKEND="tpu", RESOLVER_BATCH_TXNS=64,
+    RESOLVER_RANGES_PER_TXN=2, CONFLICT_RING_CAPACITY=1 << 14,
+    KEY_ENCODE_BYTES=32, CONFLICT_WINDOW_SLOTS=1024)
+
+wl = MakoWorkload(n_keys=100_000, seed=42)
+batches, versions = wl.make_batches(256, 64)
+backend = make_conflict_backend(knobs, device=dev)
+
+# warm compile
+for txns, v in zip(batches[:4], versions[:4]):
+    backend.resolve(txns, v)
+
+
+async def pipelined(bs, vs, inflight):
+    t0 = time.perf_counter()
+    pending = []
+    out = []
+    for txns, v in zip(bs, vs):
+        if len(pending) >= inflight:
+            out.append(await pending.pop(0))
+        pending.append(resolve_begin(backend, txns, v))
+    for p in pending:
+        out.append(await p)
+    return time.perf_counter() - t0, out
+
+for inflight in (4, 16, 64):
+    el, out = asyncio.run(pipelined(batches[4:], versions[4:], inflight))
+    n = len(batches) - 4
+    print(f"inflight={inflight}: {el:.3f}s for {n} batches -> "
+          f"{el/n*1e3:.2f}ms/batch, {n*64/el:.0f} txns/s")

@@ -1,0 +1,73 @@
+"""One-off: measure tunnel RTT, sync cost, and pipelined sync throughput.
+
+Informs the r5 e2e redesign: how much does each device->host verdict sync
+cost when N dispatches are in flight?  Run on the live axon tunnel.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+dev = jax.devices()[0]
+print("device:", dev, dev.platform)
+
+# --- 1. bare RTT: tiny transfer + sync, repeated
+xs = []
+for _ in range(12):
+    t0 = time.perf_counter()
+    np.asarray(jax.device_put(np.int32(1), dev))
+    xs.append(time.perf_counter() - t0)
+print(f"tiny put+get RTT: min {min(xs)*1e3:.1f}ms p50 {sorted(xs)[len(xs)//2]*1e3:.1f}ms")
+
+# --- 2. jitted nop dispatch + sync (dispatch->result readback)
+@jax.jit
+def nop(x):
+    return x + 1
+
+x = jax.device_put(jnp.zeros((64,), jnp.int32), dev)
+nop(x).block_until_ready()      # compile
+xs = []
+for _ in range(12):
+    t0 = time.perf_counter()
+    np.asarray(nop(x))
+    xs.append(time.perf_counter() - t0)
+print(f"nop dispatch+sync: min {min(xs)*1e3:.1f}ms")
+
+# --- 3. pipelined syncs: N dispatches queued, sync each in order
+for n in (8, 32, 128):
+    t0 = time.perf_counter()
+    outs = [nop(x) for _ in range(n)]
+    for o in outs:
+        np.asarray(o)
+    el = time.perf_counter() - t0
+    print(f"pipelined x{n}: total {el*1e3:.1f}ms -> {el/n*1e3:.2f}ms/sync")
+
+# --- 4. chained compute, single sync (device compute isolation)
+@jax.jit
+def chain(x):
+    for _ in range(4):
+        x = x * 2 + 1
+    return x
+
+big = jax.device_put(jnp.zeros((1 << 14,), jnp.int64), dev)
+chain(big).block_until_ready()
+for n in (32, 128):
+    t0 = time.perf_counter()
+    y = big
+    for _ in range(n):
+        y = chain(y)
+    y.block_until_ready()
+    el = time.perf_counter() - t0
+    print(f"chained x{n} single sync: total {el*1e3:.1f}ms -> {el/n*1e3:.3f}ms/dispatch")
+
+# --- 5. H2D transfer bandwidth-ish: 1MB put + tiny compute + sync
+mb = np.zeros((1 << 18,), np.int32)  # 1MiB
+xs = []
+for _ in range(6):
+    t0 = time.perf_counter()
+    nop_big = jax.device_put(mb, dev)
+    nop_big.block_until_ready()
+    xs.append(time.perf_counter() - t0)
+print(f"1MiB device_put: min {min(xs)*1e3:.1f}ms")

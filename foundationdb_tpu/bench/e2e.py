@@ -45,6 +45,8 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
     conflicts = 0
     measuring = False
     latencies: list[float] = []
+    read_lat: list[float] = []      # client-side stage split (VERDICT 1a)
+    commit_lat: list[float] = []
     stop_at = time.perf_counter() + warmup_s + duration_s
 
     async def client(cid: int) -> None:
@@ -57,6 +59,7 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
             try:
                 for i in range(reads):
                     await tr.get(key(ks[i]))
+                t_read = time.perf_counter()
                 for i in range(writes):
                     tr.set(key(ks[reads + i]), b"v%016d" % cid)
                 await tr.commit()
@@ -65,7 +68,10 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
                     if started_measuring:
                         # a txn started in warmup may carry a compile
                         # stall; its latency is not a measured sample
-                        latencies.append(time.perf_counter() - t0)
+                        now = time.perf_counter()
+                        latencies.append(now - t0)
+                        read_lat.append(t_read - t0)
+                        commit_lat.append(now - t_read)
             except FdbError as e:
                 if measuring:
                     conflicts += 1
@@ -80,15 +86,40 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
         nonlocal measuring
         await asyncio.sleep(warmup_s)
         measuring = True
+        # drop warmup samples (compile stalls) from the stage breakdown
+        for role in (cluster.grv_proxies + cluster.commit_proxies
+                     + cluster.resolvers):
+            role.stages.reset()
+        for r in cluster.resolvers:
+            r.group_sizes.clear()
         return time.perf_counter()
 
     timer = asyncio.ensure_future(phase_timer())
     await asyncio.gather(*(client(i) for i in range(n_clients)))
     t0 = await timer
     elapsed = time.perf_counter() - t0
+    # commit-path stage breakdown (VERDICT r4 1a): where a committed
+    # txn's milliseconds actually go, per role
+    from ..runtime.latency_probe import merge_summaries
+    gsizes = [s for r in cluster.resolvers for s in r.group_sizes]
+    stages = {
+        "grv": merge_summaries([p.stages.summary()
+                                for p in cluster.grv_proxies]),
+        "proxy": merge_summaries([p.stages.summary()
+                                  for p in cluster.commit_proxies]),
+        "resolver": merge_summaries([r.stages.summary()
+                                     for r in cluster.resolvers]),
+        "fused_group_size_mean":
+            round(sum(gsizes) / len(gsizes), 2) if gsizes else None,
+        "fused_dispatches": len(gsizes),
+    }
     await cluster.stop()
 
     from .stats import latency_ms
+    stages["client"] = {
+        "read_phase": latency_ms(read_lat, (50, 99)),
+        "commit_phase": latency_ms(commit_lat, (50, 99)),
+    }
     return {
         "tps": committed / elapsed,
         "committed": committed,
@@ -96,6 +127,8 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
         "abort_rate": conflicts / max(1, committed + conflicts),
         **latency_ms(latencies, (50, 95, 99)),
         "elapsed_s": elapsed,
+        "n_clients": n_clients,
+        "stages": stages,
     }
 
 

@@ -174,7 +174,11 @@ class Coordinator:
         now = asyncio.get_running_loop().time()
         if self._leader is not None and now < self._leader.lease_end:
             if self._leader.leader_id == candidate_id:
-                self._lease_round = round_id     # re-fence for this round
+                # monotonic re-fence: a DELAYED confirm from an older
+                # round must never lower the fence, or the matching stale
+                # withdraw could revoke the newer win
+                self._lease_round = max(
+                    getattr(self, "_lease_round", 0), round_id)
                 return True
             return False
         best = self._best_nominee(now)
@@ -388,9 +392,13 @@ async def elect_leader(coordinators: list, candidate_id: int, address: Any,
     # lapse (NOMINATION_TIMEOUT) so rivals converge, while still polling
     # read-only for the leader they elect.
     failed_confirms = 0
-    # round fence for confirm/withdraw: a withdraw delivered late (past a
-    # client timeout) must not revoke a lease won in a LATER round
-    round_id = 0
+    # Round fence for confirm/withdraw: a withdraw delivered late (past a
+    # client timeout) must not revoke a lease won in a LATER round.
+    # Seeded from the monotonic clock so rounds stay strictly increasing
+    # ACROSS elect_leader invocations of the same candidate — a stale
+    # withdraw from a previous invocation must not match a fresh win's
+    # fence (the coordinator-side fence is monotonic too).
+    round_id = int(loop.time() * 1e6)
 
     while True:
         # Phase 0: follow an already-confirmed live leader.

@@ -26,6 +26,9 @@ class GrvProxy:
                                   str | None]] = []
         self._batch_task: asyncio.Task | None = None
         self.total_grvs = 0
+        from ..runtime.latency_probe import StageStats
+        # grv_wait: request arrival -> version handed back (VERDICT r4 1a)
+        self.stages = StageStats("GrvProxy")
 
     async def get_read_version(self, lock_aware: bool = False,
                                priority: str = "default",
@@ -36,7 +39,11 @@ class GrvProxy:
         if self._batch_task is None or self._batch_task.done():
             self._batch_task = loop.create_task(self._serve_batch(),
                                                 name="grv-batch")
-        return await fut
+        t0 = loop.time()
+        try:
+            return await fut
+        finally:
+            self.stages.record("grv_wait", loop.time() - t0)
 
     async def _serve_batch(self) -> None:
         from ..runtime.buggify import buggify

@@ -18,7 +18,8 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 
-from ..ops.backends import make_conflict_backend, resolve_begin
+from ..ops.backends import (make_conflict_backend, resolve_begin,
+                            resolve_group_begin)
 from ..ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnRequest
 from ..runtime.errors import ResolverFailed
 from ..runtime.knobs import Knobs
@@ -65,12 +66,32 @@ class Resolver:
         self.total_batches = 0
         self.total_txns = 0
         self.total_conflicts = 0
+        from ..runtime.latency_probe import StageStats
+        # commit-path breakdown (VERDICT r4 1a): chain_wait (version
+        # ordering), submit (encode+dispatch), sync (device->host verdicts)
+        self.stages = StageStats("Resolver")
         self._poisoned: BaseException | None = None
         # committed state transactions this epoch, in version order.  Kept
         # whole: state txns are rare (shard moves, config changes) and the
         # log resets every epoch with the role, so proxies can never fall
         # off its tail mid-epoch.
         self._state_log: list[tuple[Version, list]] = []
+        # --- adaptive group fusion (r5) ---
+        # Concurrent in-flight batches are fused into as few device
+        # dispatches as possible: batches arriving while dispatches are in
+        # flight accumulate and ship together, so device round-trips
+        # amortize across whatever concurrency exists WITHOUT adding any
+        # batching latency (an idle device dispatches immediately).  This
+        # is what lets shallow proxy batches saturate a high-RTT device
+        # link (VERDICT r4 item 1b).  Encoded backends only; the exact cpp
+        # baseline resolves per batch (host-side, ~us — fusion is noise).
+        self._fuse = knobs.RESOLVER_GROUP_FUSION \
+            and hasattr(self.backend, "resolve_group_begin")
+        self._pending: list[tuple[ResolveBatchRequest, asyncio.Future]] = []
+        self._dispatch_task: asyncio.Task | None = None
+        self._inflight_groups: list[asyncio.Future] = []
+        self._last_submitted_version: Version = epoch_begin_version
+        self.group_sizes: list[int] = []    # batches per fused dispatch
 
     async def _wait_for_version(self, prev_version: Version) -> None:
         if self.version >= prev_version:
@@ -108,10 +129,15 @@ class Resolver:
         if buggify("resolver_slow_batch"):
             from ..runtime.rng import deterministic_random
             await asyncio.sleep(deterministic_random().random() * 0.02)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
         await self._wait_for_version(req.prev_version)
+        self.stages.record("chain_wait", loop.time() - t0)
         if self._poisoned is not None:
             # poisoned while this batch was parked in the version queue
             raise ResolverFailed() from self._poisoned
+        if self._fuse:
+            return await self._resolve_fused(req, loop)
         finish = None
         try:
             # Split-phase resolve: the submit updates conflict history (on
@@ -120,7 +146,9 @@ class Resolver:
             # submit while batch N's verdicts are still syncing back to the
             # host.  This is what keeps the device busy instead of blocking
             # the event loop per batch (SURVEY §7 hard part 3).
+            t0 = loop.time()
             finish = resolve_begin(self.backend, req.txns, req.version)
+            self.stages.record("submit", loop.time() - t0)
             # slide the history window: writes older than the txn-life
             # window can no longer conflict with any admissible snapshot
             floor = req.version - self.knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
@@ -140,8 +168,10 @@ class Resolver:
                 self._advance_to(req.version)
             else:
                 self._advance_to(req.version)
+                t0 = loop.time()
                 verdicts = await finish
                 finish = None
+                self.stages.record("sync", loop.time() - t0)
         except asyncio.CancelledError:
             raise
         except BaseException as e:
@@ -157,6 +187,112 @@ class Resolver:
         entries = [(v, m) for v, m in self._state_log
                    if req.state_known_version < v <= req.version]
         return ResolveBatchReply(verdicts, entries or None)
+
+    # --- adaptive group fusion path (r5) ---
+
+    async def _resolve_fused(self, req: ResolveBatchRequest,
+                             loop) -> ResolveBatchReply:
+        """Enqueue the batch for the group dispatcher.  The version chain
+        advances at ENQUEUE time (submission order = enqueue order, kept
+        by the FIFO dispatcher), so later batches pipeline behind this one
+        exactly as the split-phase path did — except for state batches,
+        which hold the chain until their verdicts return (the same
+        pipeline barrier as the serial path: their committed mutations
+        must be in the state log before any later batch's reply)."""
+        fut = loop.create_future()
+        self._pending.append((req, fut))
+        if not req.state_txns:
+            self._advance_to(req.version)
+        if self._dispatch_task is None or self._dispatch_task.done():
+            self._dispatch_task = loop.create_task(
+                self._dispatch_loop(), name="resolver-group-dispatch")
+        t0 = loop.time()
+        verdicts = await fut
+        self.stages.record("sync", loop.time() - t0)
+        if req.state_txns:
+            for idx, muts in req.state_txns:
+                if verdicts[idx] == COMMITTED:
+                    self._state_log.append((req.version, muts))
+            self._advance_to(req.version)
+        self.total_batches += 1
+        self.total_txns += len(req.txns)
+        self.total_conflicts += sum(1 for v in verdicts if v != COMMITTED)
+        entries = [(v, m) for v, m in self._state_log
+                   if req.state_known_version < v <= req.version]
+        return ResolveBatchReply(verdicts, entries or None)
+
+    async def _dispatch_loop(self) -> None:
+        """Drain _pending into fused group submissions, a bounded number
+        of groups in flight.  Submission happens on THIS task in FIFO
+        order, so device history order == version order by construction."""
+        loop = asyncio.get_running_loop()
+        group: list[tuple[ResolveBatchRequest, asyncio.Future]] = []
+        try:
+            while self._pending:
+                while len(self._inflight_groups) >= \
+                        self.knobs.RESOLVER_MAX_INFLIGHT_GROUPS:
+                    await asyncio.wait({self._inflight_groups[0]})
+                    self._inflight_groups = [
+                        g for g in self._inflight_groups if not g.done()]
+                group = []
+                while self._pending \
+                        and len(group) < self.knobs.RESOLVER_GROUP_MAX:
+                    item = self._pending.pop(0)
+                    group.append(item)
+                    if item[0].state_txns:
+                        break       # barrier: a state batch ends its group
+                # slide the history window as of the PREVIOUS submission
+                # (same one-batch lag as the serial path's floor update)
+                floor = self._last_submitted_version \
+                    - self.knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+                if floor > 0:
+                    self.backend.set_oldest_version(floor)
+                self._last_submitted_version = group[-1][0].version
+                t0 = loop.time()
+                finish = resolve_group_begin(
+                    self.backend, [r.txns for r, _ in group],
+                    [r.version for r, _ in group])
+                self.stages.record("submit", loop.time() - t0)
+                if len(self.group_sizes) < 65536:
+                    self.group_sizes.append(len(group))
+                gf = loop.create_task(self._finish_group(group, finish),
+                                      name="resolver-group-finish")
+                self._inflight_groups.append(gf)
+                group = []
+        except BaseException as e:  # noqa: BLE001 — submission failure
+            self._poison_fused(e)
+            for _req, fut in group:     # the popped-but-unsubmitted group
+                if not fut.done():
+                    fut.set_exception(ResolverFailed())
+            raise
+
+    async def _finish_group(self, group, finish) -> None:
+        try:
+            rows = await finish
+        except asyncio.CancelledError:
+            for _req, fut in group:
+                if not fut.done():
+                    fut.set_exception(ResolverFailed())
+            raise
+        except BaseException as e:  # noqa: BLE001 — sync failure
+            self._poison_fused(e)
+            for _req, fut in group:
+                if not fut.done():
+                    fut.set_exception(ResolverFailed())
+            return
+        for (_req, fut), verdicts in zip(group, rows):
+            if not fut.done():
+                fut.set_result(verdicts)
+
+    def _poison_fused(self, e: BaseException) -> None:
+        """Fail-stop for the fused path: history may be partially mutated
+        (some group submitted, some not) — no further verdicts can be
+        trusted.  Queued batches fail immediately instead of hanging."""
+        self._poison(e)
+        pending, self._pending = self._pending, []
+        for _req, fut in pending:
+            if not fut.done():
+                fut.set_exception(ResolverFailed())
 
 
 def clip_txn_to_range(t: TxnRequest, r: KeyRange) -> TxnRequest:

@@ -39,6 +39,13 @@ async def _completed(value):
     return value
 
 
+# NOTE on device->host sync cost: verdict copies MUST be issued eagerly
+# at dispatch time (measured r5: 32 sequential np.asarray syncs cost the
+# full ~67ms tunnel RTT EACH when the copy is issued lazily, but ~2.2ms
+# each when started at dispatch).  JaxConflictSet._start_d2h does this
+# inside every resolve_*_submit — the single home of the policy.
+
+
 class _DeviceSyncWorker:
     """One daemon thread that performs blocking device→host syncs so the
     event loop never waits on the device.  A *daemon* thread rather than a
@@ -158,13 +165,17 @@ class EncodedConflictBackend:
 
     def __init__(self, conflict_set, batch_txns: int, ranges_per_txn: int,
                  width: int, dict_encoder=None,
-                 exact_window: int = 5_000_000):
+                 exact_window: int = 5_000_000, group_bucket: int = 0):
         self.cs = conflict_set
         self.B = batch_txns
         self.R = ranges_per_txn
         self.width = width
         self._dict = dict_encoder       # DictEncoder when transfer-compressed
         self._exact_window = exact_window
+        # pin group dispatches to one compiled K bucket (see the
+        # RESOLVER_GROUP_BUCKET knob); groups larger than the pin use the
+        # native buckets as before
+        self._group_bucket = group_bucket
         # exact sidecar for FAT txns (more ranges than the kernel bucket):
         # coalescing them measured ~5x abort inflation on range-heavy
         # shapes (bench/abort_parity.py), so they are checked exactly
@@ -180,6 +191,12 @@ class EncodedConflictBackend:
 
     def _fat(self, t: TxnRequest) -> bool:
         return len(t.read_ranges) > self.R or len(t.write_ranges) > self.R
+
+    def _k_bucket(self, n: int) -> int:
+        """Compiled K bucket for an n-chunk group, honoring the pin."""
+        from .conflict_jax import GROUP_BUCKETS
+        want = max(n, min(self._group_bucket, GROUP_BUCKETS[-1]))
+        return next(b for b in GROUP_BUCKETS if b >= want)
 
     def _exact_sidecar(self):
         if self._exact is None and not self._exact_failed:
@@ -370,7 +387,7 @@ class EncodedConflictBackend:
             if use_dict:
                 d = self._dict
                 from .conflict_jax import UPD_BUCKETS
-                K = next(b for b in GROUP_BUCKETS if b >= len(sub))
+                K = self._k_bucket(len(sub))
                 enc = d.encode_group(sub, self.B, self.R, K)
                 if enc is not None and d.n_upd <= UPD_BUCKETS[-1]:
                     ids, snaps, _counts, compact = enc
@@ -383,7 +400,8 @@ class EncodedConflictBackend:
                 # lanes-path this sub-group
                 self.cs.apply_dict_updates(d.upd_slots, d.upd_lanes, d.n_upd)
             ebs = [encode_batch(c, self.B, self.R, self.width) for c in sub]
-            pending.append((len(sub), group(ebs, subv)))
+            pending.append((len(sub),
+                            group(ebs, subv, k_pad=self._k_bucket(len(sub)))))
 
         async def finish() -> list[list[int]]:
             from ..runtime.simloop import SimEventLoop
@@ -430,7 +448,7 @@ class EncodedConflictBackend:
         for start in range(0, len(wires), max_k):
             sub = wires[start:start + max_k]
             subv = versions[start:start + max_k]
-            K = next(b for b in GROUP_BUCKETS if b >= len(sub))
+            K = self._k_bucket(len(sub))
             if fused_ok:
                 enc = d.encode_group_fused(sub, self.B, self.R, K, subv)
                 if enc is None:
@@ -548,6 +566,7 @@ def make_conflict_backend(knobs: Knobs, device=None):
         knobs.RESOLVER_RANGES_PER_TXN,
         knobs.KEY_ENCODE_BYTES,
         dict_encoder=dict_encoder,
+        group_bucket=knobs.RESOLVER_GROUP_BUCKET,
         # the sidecar's self-imposed floor must track the TXN-LIFE window
         # (the same floor the resolver applies to the whole backend) —
         # never the storage MVCC window: a smaller floor than the

@@ -63,11 +63,19 @@ SENTINEL_LANE = np.uint32(0xFFFFFFFF)
 
 
 class ConflictState(NamedTuple):
-    """Device-resident conflict history (lane-major, doubled ring)."""
-    hb: jax.Array     # [L, 2C] uint32 — range begin lanes (slot i == slot i+C)
-    he: jax.Array     # [L, 2C] uint32 — range end lanes
-    hver: jax.Array   # [2C] int64 — slot versions, -1 = never written
-    ptr: jax.Array    # [] int32 — next slab start, multiple of the slab size
+    """Device-resident conflict history — CANONICAL ring (r5 design).
+
+    Slots are kept oldest-first: slot C-1 is the newest write, slot 0 the
+    oldest retained.  Appending a slab of S new records is a static
+    shift-left by S plus a static-offset write — no ring pointer, no
+    doubled storage, no dynamic_update_slice whose cost scales with
+    capacity inside a scan (the r4 layout's whole-ring rewrite per batch
+    measured 1.0 -> 0.25 ms/batch just shrinking 2^18 -> 2^14 slots; the
+    canonical layout pays one O(C) shift per DISPATCH, ~50us of HBM
+    traffic, regardless of how many batches the dispatch fuses)."""
+    hb: jax.Array     # [L, C] uint32 — range begin lanes, oldest-first
+    he: jax.Array     # [L, C] uint32 — range end lanes
+    hver: jax.Array   # [C] int64 — slot versions, -1 = never written
     floor: jax.Array  # [] int64 — too-old boundary
 
 
@@ -75,10 +83,9 @@ def init_state(capacity: int, width: int = DEFAULT_WIDTH,
                oldest_version: int = 0) -> ConflictState:
     L = keycode.nlanes(width)
     return ConflictState(
-        hb=jnp.full((L, 2 * capacity), SENTINEL_LANE, jnp.uint32),
-        he=jnp.full((L, 2 * capacity), SENTINEL_LANE, jnp.uint32),
-        hver=jnp.full(2 * capacity, -1, jnp.int64),
-        ptr=jnp.int32(0),
+        hb=jnp.full((L, capacity), SENTINEL_LANE, jnp.uint32),
+        he=jnp.full((L, capacity), SENTINEL_LANE, jnp.uint32),
+        hver=jnp.full(capacity, -1, jnp.int64),
         floor=jnp.int64(oldest_version),
     )
 
@@ -220,77 +227,26 @@ def _chain_pallas(packed, hist_conflict, ok, B: int, nw: int):
 # single-batch core
 
 
-def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
-                 write_end, snap, commit_version, *, width: int = DEFAULT_WIDTH,
-                 window: int = 0, pallas: bool = False):
-    """One resolve step: (state, batch) -> (state', verdicts[B] int8).
+def _batch_verdicts(read_begin, read_end, write_begin, write_end,
+                    hist_conflict, too_old, valid, B: int,
+                    width: int, pallas: bool):
+    """Steps 2-3 of a batch resolve, shared by the single-batch and fused
+    group cores: intra-batch read-vs-write overlap matrix + in-order
+    commit resolution.  Returns (verdicts [B] int8, committed [B] bool).
 
-    Pure traceable core shared by the single-chip jit (``resolve_step``),
-    the fused multi-batch ``resolve_many`` and the shard_map multi-resolver
-    path (parallel/sharded.py).  Mirrors ConflictBatch::addTransaction +
-    detectConflicts (REF:fdbserver/SkipList.cpp) for a whole proxy batch.
-
-    ``commit_version < 0`` marks a padding batch (group-size alignment):
-    verdicts are computed but the ring is left bit-identically untouched.
-
-    ``window`` > 0 enables the exact fast path: the ring is chronological,
-    so only entries newer than a transaction's snapshot can conflict, and
-    those live in the last ``window`` slots unless a snapshot predates the
-    entry just outside the window — in which case lax.cond falls back to
-    the full-ring scan.  Verdicts are bit-identical either way.
-    """
-    C = state.hver.shape[0] // 2
-    B, R, L = read_begin.shape
-    S_ = B * R
-    # slabs must tile the ring exactly, or a slab would spill past C and
-    # dynamic_update_slice would clamp it into the doubled region
-    assert C % S_ == 0, f"ring capacity {C} not a multiple of slab {S_}"
-    i32 = jnp.int32
-
-    too_old = snap < state.floor
-    valid = snap >= 0
-
-    # 1. reads vs device history ring -> [B]
-    if window < 0:
-        raise ValueError(f"window must be >= 0, got {window}")
-    if window and window < C:
-        start = ((state.ptr - window) % C).astype(i32)
-        hbW = lax.dynamic_slice(state.hb, (i32(0), start), (L, window))
-        heW = lax.dynamic_slice(state.he, (i32(0), start), (L, window))
-        hvW = lax.dynamic_slice(state.hver, (start,), (window,))
-        # newest entry outside the window: slabs are version-dense (padding
-        # lanes carry the batch version too), so snapshots at or above this
-        # edge see every possible conflict inside the window alone.
-        edge_i = ((state.ptr - window - 1) % C).astype(i32)
-        v_edge = lax.dynamic_slice(state.hver, (edge_i,), (1,))[0]
-        fast_ok = jnp.all(~valid | too_old | (snap >= v_edge))
-        hist_conflict = lax.cond(
-            fast_ok,
-            lambda _: _hist_check_T(read_begin, read_end, hbW, heW, hvW,
-                                    snap, width),
-            lambda _: _hist_check_T(read_begin, read_end, state.hb[:, :C],
-                                    state.he[:, :C], state.hver[:C], snap,
-                                    width),
-            None)
-    else:
-        hist_conflict = _hist_check_T(read_begin, read_end, state.hb[:, :C],
-                                      state.he[:, :C], state.hver[:C], snap,
-                                      width)
-
-    # 2. intra-batch read-vs-write overlap matrix -> [B,B]
+    The in-order chain (txn i conflicts with any committed j<i whose
+    writes overlap its reads) is inherently sequential.  On a real TPU it
+    runs as a tiny Pallas SMEM kernel (the XLA-compiled unrolled scalar
+    chain measured ~66us/batch — each step's vector<->scalar extracts
+    dominate; the same loop over SMEM scalars is ~100x cheaper).  On CPU
+    backends the unrolled uint32-word chain remains: both compute
+    identical integers, so verdicts are bit-identical across backends
+    (the parity gate)."""
     m = _overlap(read_begin[:, :, None, None, :], read_end[:, :, None, None, :],
                  write_begin[None, None, :, :, :], write_end[None, None, :, :, :],
                  width)
     M = m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
 
-    # 3. in-order commit resolution (txn i conflicts with any committed
-    # j<i whose writes overlap its reads) — inherently sequential.  On a
-    # real TPU this runs as a tiny Pallas SMEM kernel (the XLA-compiled
-    # unrolled scalar chain measured ~66us/batch — each step's
-    # vector<->scalar extracts dominate; the same loop over SMEM scalars
-    # is ~100x cheaper).  On CPU backends the unrolled uint32-word chain
-    # remains: both compute identical integers, so verdicts are
-    # bit-identical across backends (the parity gate).
     nw = (B + 31) // 32
     Bpad = nw * 32
     Mp = jnp.pad(M, ((0, 0), (0, Bpad - B)))
@@ -321,35 +277,94 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
     verdicts = jnp.where(~valid, COMMITTED,
                          jnp.where(too_old, TOO_OLD,
                                    jnp.where(conf_vec, CONFLICT, COMMITTED)))
+    return verdicts, committed
 
-    # 4. append the batch's slab (committed writes; sentinel elsewhere).
-    is_pad = commit_version < 0
-    p = state.ptr
-    old_b = lax.dynamic_slice(state.hb, (i32(0), p), (L, S_))
-    old_e = lax.dynamic_slice(state.he, (i32(0), p), (L, S_))
-    old_v = lax.dynamic_slice(state.hver, (p,), (S_,))
+
+def _slab_from_writes(write_begin, write_end, committed, S_: int, L: int):
+    """[L, S_] lane slabs holding committed writes; sentinel elsewhere."""
     valid_w = write_begin[..., -1] != jnp.uint32(SENTINEL_LANE)      # [B,R]
     ins = (committed[:, None] & valid_w).reshape(S_)
-    new_b = jnp.where(ins[:, None], write_begin.reshape(S_, L),
-                      jnp.uint32(SENTINEL_LANE)).T                   # [L, S_]
-    new_e = jnp.where(ins[:, None], write_end.reshape(S_, L),
-                      jnp.uint32(SENTINEL_LANE)).T
-    new_v = jnp.broadcast_to(jnp.asarray(commit_version, state.hver.dtype),
-                             (S_,))
-    slab_b = jnp.where(is_pad, old_b, new_b)
-    slab_e = jnp.where(is_pad, old_e, new_e)
-    slab_v = jnp.where(is_pad, old_v, new_v)
-    floor2 = jnp.where(is_pad, state.floor,
-                       jnp.maximum(state.floor, jnp.max(old_v)))
-    hb2 = lax.dynamic_update_slice(state.hb, slab_b, (i32(0), p))
-    hb2 = lax.dynamic_update_slice(hb2, slab_b, (i32(0), p + C))
-    he2 = lax.dynamic_update_slice(state.he, slab_e, (i32(0), p))
-    he2 = lax.dynamic_update_slice(he2, slab_e, (i32(0), p + C))
-    hv2 = lax.dynamic_update_slice(state.hver, slab_v, (p,))
-    hv2 = lax.dynamic_update_slice(hv2, slab_v, (p + C,))
-    ptr2 = ((p + jnp.where(is_pad, 0, S_)) % C).astype(i32)
+    slab_b = jnp.where(ins[:, None], write_begin.reshape(S_, L),
+                       jnp.uint32(SENTINEL_LANE)).T                  # [L, S_]
+    slab_e = jnp.where(ins[:, None], write_end.reshape(S_, L),
+                       jnp.uint32(SENTINEL_LANE)).T
+    return slab_b, slab_e
 
-    return ConflictState(hb2, he2, hv2, ptr2, floor2), verdicts
+
+def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
+                 write_end, snap, commit_version, *, width: int = DEFAULT_WIDTH,
+                 window: int = 0, pallas: bool = False):
+    """One resolve step: (state, batch) -> (state', verdicts[B] int8).
+
+    Pure traceable core shared by the single-chip jit (``resolve_step``)
+    and the shard_map multi-resolver path (parallel/sharded.py).  Mirrors
+    ConflictBatch::addTransaction + detectConflicts
+    (REF:fdbserver/SkipList.cpp) for a whole proxy batch.
+
+    ``commit_version < 0`` marks a padding batch (group-size alignment):
+    verdicts are computed but the ring is left bit-identically untouched.
+
+    ``window`` > 0 enables the exact fast path: the ring is chronological
+    (canonical oldest-first), so only entries newer than a transaction's
+    snapshot can conflict, and those live in the last ``window`` slots
+    unless a snapshot predates the entry just outside the window — in
+    which case lax.cond falls back to the full-ring scan.  Verdicts are
+    bit-identical either way.  All slices here are at STATIC offsets.
+    """
+    C = state.hver.shape[0]
+    B, R, L = read_begin.shape
+    S_ = B * R
+    assert S_ <= C, f"slab {S_} exceeds ring capacity {C}"
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+
+    too_old = snap < state.floor
+    valid = snap >= 0
+
+    # 1. reads vs device history ring -> [B]
+    if window and window < C:
+        hbW = state.hb[:, C - window:]
+        heW = state.he[:, C - window:]
+        hvW = state.hver[C - window:]
+        # newest entry outside the window: slabs are version-dense (padding
+        # lanes carry the batch version too), so snapshots at or above this
+        # edge see every possible conflict inside the window alone.
+        v_edge = state.hver[C - window - 1]
+        fast_ok = jnp.all(~valid | too_old | (snap >= v_edge))
+        hist_conflict = lax.cond(
+            fast_ok,
+            lambda _: _hist_check_T(read_begin, read_end, hbW, heW, hvW,
+                                    snap, width),
+            lambda _: _hist_check_T(read_begin, read_end, state.hb,
+                                    state.he, state.hver, snap, width),
+            None)
+    else:
+        hist_conflict = _hist_check_T(read_begin, read_end, state.hb,
+                                      state.he, state.hver, snap, width)
+
+    # 2-3. intra-batch overlap + in-order commit chain
+    verdicts, committed = _batch_verdicts(
+        read_begin, read_end, write_begin, write_end,
+        hist_conflict, too_old, valid, B, width, pallas)
+
+    # 4. append the batch's slab: shift the canonical ring left by S_ and
+    # write the slab at the (static) tail.  Evicting the S_ oldest slots
+    # raises the too-old floor to their max version.
+    is_pad = commit_version < 0
+    slab_b, slab_e = _slab_from_writes(write_begin, write_end, committed,
+                                       S_, L)
+    slab_v = jnp.broadcast_to(jnp.asarray(commit_version, state.hver.dtype),
+                              (S_,))
+    shifted_b = jnp.concatenate([state.hb[:, S_:], slab_b], axis=1)
+    shifted_e = jnp.concatenate([state.he[:, S_:], slab_e], axis=1)
+    shifted_v = jnp.concatenate([state.hver[S_:], slab_v])
+    floor_s = jnp.maximum(state.floor, jnp.max(state.hver[:S_]))
+    hb2 = jnp.where(is_pad, state.hb, shifted_b)
+    he2 = jnp.where(is_pad, state.he, shifted_e)
+    hv2 = jnp.where(is_pad, state.hver, shifted_v)
+    floor2 = jnp.where(is_pad, state.floor, floor_s)
+
+    return ConflictState(hb2, he2, hv2, floor2), verdicts
 
 
 def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
@@ -358,19 +373,120 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
                       pallas: bool = False):
     """K fused batches in one dispatch: inputs [K,B,R,L] / [K,B] / [K].
 
-    Exactly equivalent to K sequential resolve_core calls (the scan
-    threads the ring), so a proxy batch group resolved fused is
-    bit-identical to the same batches resolved one dispatch each.
-    """
-    def body(st, x):
-        rb, re, wb, we, sn, cv = x
-        st2, verdicts = resolve_core(st, rb, re, wb, we, sn, cv,
-                                     width=width, window=window,
-                                     pallas=pallas)
-        return st2, verdicts
+    Hot/cold structure (r5): the big ring ("cold") stays STATIC for the
+    whole dispatch; per-batch work runs against a small "hot" staging
+    buffer seeded with the cold ring's newest ``window`` slots, which each
+    batch's slab is appended to at a scan-carried offset.  After the scan,
+    the K slabs are appended to the cold ring with ONE static shift.  The
+    scan carry is O(window + K*B*R) regardless of ring capacity — the r4
+    layout carried the whole ring through the scan and its per-batch
+    rewrite cost scaled with capacity, capping usable history.
 
-    return lax.scan(body, state, (read_begin, read_end, write_begin,
-                                  write_end, snap, commit_versions))
+    Semantics vs. K chained single-batch dispatches: identical except at
+    eviction edges — the too-old floor advances once per DISPATCH (to the
+    max version of the evicted slots) instead of once per batch, so a
+    fused group can only produce FEWER forced TOO_OLDs than the chained
+    equivalent, never more (both are sound conservative compactions, and
+    verdicts differ only for snapshots older than the retained history).
+    Padding batches (commit_version < 0, TRAILING by the callers'
+    construction) write sentinel slabs into the hot staging buffer but
+    are DROPPED at the final append — the cold ring advances by exactly
+    n_real*B*R slots, so a bucket-pinned dispatch carrying one real batch
+    burns one slab of history, not K (r5 review finding).
+    """
+    K, B, R, L = read_begin.shape
+    S_ = B * R
+    T = K * S_
+    C = state.hver.shape[0]
+    if window <= 0 or window >= C or T > C:
+        # compat path (tiny rings / windowless): chain the single-batch
+        # core; carries the whole ring, only viable for small capacities
+        def body(st, x):
+            rb, re, wb, we, sn, cv = x
+            st2, verdicts = resolve_core(st, rb, re, wb, we, sn, cv,
+                                         width=width, window=window,
+                                         pallas=pallas)
+            return st2, verdicts
+
+        return lax.scan(body, state, (read_begin, read_end, write_begin,
+                                      write_end, snap, commit_versions))
+
+    W = window
+    C_hot = 1 + W + T
+    start_floor = state.floor
+    # hot staging buffer: [edge slot | cold's W newest | K slabs]
+    hotb0 = jnp.concatenate(
+        [state.hb[:, C - W - 1:],
+         jnp.full((L, T), SENTINEL_LANE, jnp.uint32)], axis=1)
+    hote0 = jnp.concatenate(
+        [state.he[:, C - W - 1:],
+         jnp.full((L, T), SENTINEL_LANE, jnp.uint32)], axis=1)
+    hotv0 = jnp.concatenate(
+        [state.hver[C - W - 1:], jnp.full((T,), -1, jnp.int64)])
+    lastv0 = state.hver[C - 1]
+    cold_hb, cold_he, cold_hver = state.hb, state.he, state.hver
+    i32 = jnp.int32
+
+    def body(carry, x):
+        hotb, hote, hotv, lastv = carry
+        rb, re, wb, we, sn, cv, k = x
+        off = (k * S_).astype(i32)
+        too_old = sn < start_floor
+        valid = sn >= 0
+        # batch k's window = hot[1+k*S_ : 1+k*S_+W]; its edge = hot[k*S_]
+        winb = lax.dynamic_slice(hotb, (i32(0), off + 1), (L, W))
+        wine = lax.dynamic_slice(hote, (i32(0), off + 1), (L, W))
+        winv = lax.dynamic_slice(hotv, (off,), (W + 1,))
+        fast_ok = jnp.all(~valid | too_old | (sn >= winv[0]))
+
+        def fast(_):
+            return _hist_check_T(rb, re, winb, wine, winv[1:], sn, width)
+
+        def full(_):
+            # cold ring (loop-invariant operand) + the whole hot buffer;
+            # rows not yet written hold sentinel intervals (overlap
+            # nothing), so checking past the batch's offset is harmless
+            return (_hist_check_T(rb, re, cold_hb, cold_he, cold_hver,
+                                  sn, width)
+                    | _hist_check_T(rb, re, hotb, hote, hotv, sn, width))
+
+        hist_conflict = lax.cond(fast_ok, fast, full, None)
+        verdicts, committed = _batch_verdicts(
+            rb, re, wb, we, hist_conflict, too_old, valid, B, width, pallas)
+        is_pad = cv < 0
+        slab_b, slab_e = _slab_from_writes(wb, we, committed, S_, L)
+        lastv2 = jnp.where(is_pad, lastv, cv)
+        # pad slabs carry sentinel intervals (no pad txn commits) at the
+        # last real version: version-density keeps the edge test sound
+        slab_v = jnp.broadcast_to(lastv2, (S_,))
+        hotb2 = lax.dynamic_update_slice(hotb, slab_b, (i32(0), off + 1 + W))
+        hote2 = lax.dynamic_update_slice(hote, slab_e, (i32(0), off + 1 + W))
+        hotv2 = lax.dynamic_update_slice(hotv, slab_v, (off + 1 + W,))
+        return (hotb2, hote2, hotv2, lastv2), verdicts
+
+    (hotbF, hoteF, hotvF, _), verdicts = lax.scan(
+        body, (hotb0, hote0, hotv0, lastv0),
+        (read_begin, read_end, write_begin, write_end, snap,
+         commit_versions, jnp.arange(K)))
+
+    # Bulk append of the REAL slabs only: concat(cold, hot slab region)
+    # then one dynamic-offset slice of static size C starting at
+    # n_real*S_ — drops the n_real*S_ oldest cold slots and the trailing
+    # pad slabs in one static-shape op.  (Real batches precede pads, so
+    # the kept window is exactly cold[n_real*S_:] ++ real slabs.)
+    n_real = jnp.sum(commit_versions >= 0).astype(jnp.int32)
+    shift = n_real * jnp.int32(S_)
+    extb = jnp.concatenate([state.hb, hotbF[:, 1 + W:]], axis=1)
+    exte = jnp.concatenate([state.he, hoteF[:, 1 + W:]], axis=1)
+    extv = jnp.concatenate([state.hver, hotvF[1 + W:]])
+    hb2 = lax.dynamic_slice(extb, (jnp.int32(0), shift), (L, C))
+    he2 = lax.dynamic_slice(exte, (jnp.int32(0), shift), (L, C))
+    hv2 = lax.dynamic_slice(extv, (shift,), (C,))
+    # evicted = the n_real*S_ oldest cold slots
+    evict_mask = jnp.arange(T) < shift
+    floor2 = jnp.maximum(start_floor, jnp.max(
+        jnp.where(evict_mask, state.hver[:T], jnp.int64(-1))))
+    return ConflictState(hb2, he2, hv2, floor2), verdicts
 
 
 resolve_step = functools.partial(
@@ -548,8 +664,10 @@ def set_oldest_step(state: ConflictState, v) -> ConflictState:
 
 
 # group sizes compiled for resolve_many; a group of k batches is padded up
-# to the next bucket with ring-neutral padding batches (commit_version=-1)
-GROUP_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+# to the next bucket with padding batches (commit_version=-1, sentinel
+# slabs).  256 exists for the r5 hot/cold kernel, whose scan carry no
+# longer scales with ring capacity (deep groups were pointless before)
+GROUP_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 # update-count buckets compiled for resolve_many_ids: fine enough that a
 # warm dictionary ships little padding, coarse enough to bound compiles
@@ -671,19 +789,24 @@ class JaxConflictSet:
         return verdicts
 
     def resolve_group_submit(self, ebs: list[EncodedBatch],
-                             commit_versions: list[int]) -> jax.Array:
+                             commit_versions: list[int],
+                             k_pad: int | None = None) -> jax.Array:
         """Fuse a whole group of batches into ONE device dispatch.
 
         Returns the (unsynced) verdict array [K, B]; rows past len(ebs)
-        are padding.  Bit-identical to submitting the batches one by one:
-        padding batches carry commit_version=-1 and leave the ring
-        untouched."""
+        are padding (commit_version=-1, sentinel slabs).  ``k_pad``
+        overrides the bucket (compile-shape pinning)."""
         assert len(ebs) == len(commit_versions) and ebs
         B, R, L = ebs[0].read_begin.shape
         self._ensure_state(B, R)
         k = len(ebs)
-        K = next(b for b in GROUP_BUCKETS if b >= k) if k <= GROUP_BUCKETS[-1] \
-            else ((k + GROUP_BUCKETS[-1] - 1) // GROUP_BUCKETS[-1]) * GROUP_BUCKETS[-1]
+        if k_pad is not None and k_pad >= k:
+            K = k_pad
+        else:
+            K = next(b for b in GROUP_BUCKETS if b >= k) \
+                if k <= GROUP_BUCKETS[-1] \
+                else ((k + GROUP_BUCKETS[-1] - 1) // GROUP_BUCKETS[-1]) \
+                * GROUP_BUCKETS[-1]
         n = K * B * R * L
         pu32 = np.full(4 * n, 0xFFFFFFFF, dtype=np.uint32)
         kn = k * B * R * L

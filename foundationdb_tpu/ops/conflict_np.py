@@ -11,17 +11,21 @@ we brute-force compare every read range in the batch against a
 fixed-capacity ring of (interval, version) write records — embarrassingly
 parallel, exactly what a TPU's VPU wants.
 
-Ring semantics (append-only slabs, mirroring the device kernel):
+Ring semantics (canonical oldest-first ring, mirroring the r5 device
+kernel):
 
-- every resolved batch consumes a contiguous slab of B*R slots; lanes
-  that insert nothing store the sentinel interval [S, S) (overlaps
-  nothing) but still carry the batch's commit version, keeping the ring
-  version-dense so the device's window fast-path edge test is sound;
-- overwriting a slab raises the too-old ``floor`` to the overwritten
-  versions' max: history older than the evicted batch is gone, so any
-  snapshot preceding it gets TOO_OLD — the same safe fallback the
-  reference applies when history is compacted (setOldestVersion /
-  MAX_WRITE_TRANSACTION_LIFE_VERSIONS, REF:fdbserver/Resolver.actor.cpp).
+- slots are kept oldest-first: slot C-1 is the newest write; appending a
+  batch's slab of B*R records shifts the ring left by B*R and writes the
+  slab at the tail.  Lanes that insert nothing store the sentinel
+  interval [S, S) (overlaps nothing) but still carry the batch's commit
+  version, keeping the ring version-dense so the device's window
+  fast-path edge test is sound;
+- the B*R slots shifted out are evicted history: the too-old ``floor``
+  rises to their max version — history older than the evicted records is
+  gone, so any snapshot preceding it gets TOO_OLD — the same safe
+  fallback the reference applies when history is compacted
+  (setOldestVersion / MAX_WRITE_TRANSACTION_LIFE_VERSIONS,
+  REF:fdbserver/Resolver.actor.cpp).
 """
 
 from __future__ import annotations
@@ -56,15 +60,39 @@ class NumpyConflictSet:
         self.capacity = capacity
         self.width = width
         self.floor = np.int64(oldest_version)
-        self.hb = None    # [C, L] uint32 (row-major on host; device twin is [L, 2C])
-        self.he = None
-        self.hver = None  # [C] int64, -1 = never written
+        # Internal storage is a classic pointer ring (_hb/_he/_hver + ptr):
+        # a host array overwrites S_ slots in place, where the device
+        # kernel's canonical shift is nearly free HBM traffic but a full
+        # O(C) memcpy per batch here (measured 2x slower sim suite).  The
+        # SEMANTICS are identical — the slab at ptr is always the oldest
+        # retained — and the ``hb``/``he``/``hver`` properties expose the
+        # canonical oldest-first view for state-parity tests.
+        self._hb = None   # [C, L] uint32 (row-major on host; device twin is [L, C])
+        self._he = None
+        self._hver = None  # [C] int64, -1 = never written
         self.ptr = 0
         self.used = 0     # slots ever written (bounds the history scan)
         self._slab = None
 
+    def _canonical(self, arr):
+        p = self.ptr
+        return np.concatenate([arr[p:], arr[:p]], axis=0)
+
+    @property
+    def hb(self):
+        """Canonical (oldest-first) view — matches the device layout."""
+        return self._canonical(self._hb)
+
+    @property
+    def he(self):
+        return self._canonical(self._he)
+
+    @property
+    def hver(self):
+        return self._canonical(self._hver)
+
     def _ensure_state(self, B: int, R: int) -> None:
-        if self.hb is not None:
+        if self._hb is not None:
             if self._slab != B * R:
                 raise ValueError(
                     f"batch shape changed: slab {B * R} != {self._slab}")
@@ -74,9 +102,9 @@ class NumpyConflictSet:
         self.capacity = cap
         L = keycode.nlanes(self.width)
         S = keycode.sentinel(self.width)
-        self.hb = np.tile(S, (cap, 1))
-        self.he = np.tile(S, (cap, 1))
-        self.hver = np.full(cap, -1, np.int64)
+        self._hb = np.tile(S, (cap, 1))
+        self._he = np.tile(S, (cap, 1))
+        self._hver = np.full(cap, -1, np.int64)
 
     # --- ConflictSet API (mirrors newConflictSet/setOldestVersion/resolve) ---
 
@@ -97,13 +125,15 @@ class NumpyConflictSet:
 
         too_old = snap < self.floor
 
-        # 1. reads vs history ring, sliced to ever-written slots (the TPU
-        #    twin scans its full fixed-shape ring; sentinel rows compare
-        #    identically to absent ones, so verdicts match exactly)
+        # 1. reads vs history ring, sliced to ever-written slots (order is
+        #    irrelevant to a full scan; the TPU twin scans its full
+        #    fixed-shape ring — sentinel rows compare identically to
+        #    absent ones, so verdicts match exactly)
         U = self.used
         hit = _overlap(eb.read_begin[:, :, None, :], eb.read_end[:, :, None, :],
-                       self.hb[None, None, :U, :], self.he[None, None, :U, :], w)
-        newer = self.hver[None, None, :U] > snap[:, None, None]
+                       self._hb[None, None, :U, :],
+                       self._he[None, None, :U, :], w)
+        newer = self._hver[None, None, :U] > snap[:, None, None]
         hist_conflict = (hit & newer).any(axis=(1, 2))           # [B]
 
         # 2. intra-batch: reads of i vs writes of j: [B,R,1,1,L] x [1,1,B,R,L] -> [B,B]
@@ -126,21 +156,23 @@ class NumpyConflictSet:
             else:
                 committed[i] = True
 
-        # 4. append the slab: committed writes keep their ranges, every
-        #    other lane stores the sentinel interval; the whole slab takes
-        #    commit_version.  Overwriting raises the floor to the evicted
-        #    versions' max.
+        # 4. append the slab at ptr — the oldest retained slab (identical
+        #    semantics to the device kernel's canonical shift-left-and-
+        #    append; only the storage rotation differs).  Committed writes
+        #    keep their ranges, every other lane stores the sentinel
+        #    interval; the whole slab takes commit_version.  The S_
+        #    evicted slots raise the floor to their max version.
         SEN = keycode.sentinel(w)
         valid_w = eb.write_begin[..., -1] != 0xFFFFFFFF          # [B,R]
         ins = (committed[:, None] & valid_w).reshape(S_)
         p = self.ptr
-        old = self.hver[p:p + S_]
+        old = self._hver[p:p + S_]
         self.floor = max(self.floor, np.int64(old.max(initial=np.int64(-1))))
-        slab_b = np.where(ins[:, None], eb.write_begin.reshape(S_, L), SEN)
-        slab_e = np.where(ins[:, None], eb.write_end.reshape(S_, L), SEN)
-        self.hb[p:p + S_] = slab_b
-        self.he[p:p + S_] = slab_e
-        self.hver[p:p + S_] = commit_version
+        self._hb[p:p + S_] = np.where(ins[:, None],
+                                      eb.write_begin.reshape(S_, L), SEN)
+        self._he[p:p + S_] = np.where(ins[:, None],
+                                      eb.write_end.reshape(S_, L), SEN)
+        self._hver[p:p + S_] = commit_version
         self.ptr = (p + S_) % self.capacity
-        self.used = max(self.used, p + S_)
+        self.used = min(self.capacity, self.used + S_)
         return verdict

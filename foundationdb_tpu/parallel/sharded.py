@@ -43,11 +43,10 @@ from ..ops.keycode import DEFAULT_WIDTH
 class ShardedConflictState(NamedTuple):
     """ConflictState arrays with a leading resolver-shard axis, plus the
     partition boundary table (replicated).  Per-shard layout matches the
-    single-chip kernel: lane-major doubled ring (ops/conflict_jax.py)."""
-    hb: jax.Array     # [S, L, 2C]
-    he: jax.Array     # [S, L, 2C]
-    hver: jax.Array   # [S, 2C]
-    ptr: jax.Array    # [S]
+    single-chip kernel: lane-major canonical ring (ops/conflict_jax.py)."""
+    hb: jax.Array     # [S, L, C]
+    he: jax.Array     # [S, L, C]
+    hver: jax.Array   # [S, C]
     floor: jax.Array  # [S]
     part_lo: jax.Array  # [S, L] partition begin keys (encoded)
     part_hi: jax.Array  # [S, L] partition end keys
@@ -84,10 +83,9 @@ def init_sharded_state(mesh: Mesh, capacity_per_shard: int,
     C = capacity_per_shard
     bounds = make_partition_boundaries(S, width, split_keys)
     state = ShardedConflictState(
-        hb=jnp.full((S, L, 2 * C), 0xFFFFFFFF, jnp.uint32),
-        he=jnp.full((S, L, 2 * C), 0xFFFFFFFF, jnp.uint32),
-        hver=jnp.full((S, 2 * C), -1, jnp.int64),
-        ptr=jnp.zeros(S, jnp.int32),
+        hb=jnp.full((S, L, C), 0xFFFFFFFF, jnp.uint32),
+        he=jnp.full((S, L, C), 0xFFFFFFFF, jnp.uint32),
+        hver=jnp.full((S, C), -1, jnp.int64),
         floor=jnp.full(S, oldest_version, jnp.int64),
         part_lo=jnp.asarray(bounds[:-1]),
         part_hi=jnp.asarray(bounds[1:]),
@@ -117,14 +115,14 @@ def make_sharded_resolve_step(mesh: Mesh, width: int = DEFAULT_WIDTH,
     """
     from jax import shard_map
 
-    def local_step(hb, he, hver, ptr, floor, lo, hi, rb, re, wb, we, snap, cv):
+    def local_step(hb, he, hver, floor, lo, hi, rb, re, wb, we, snap, cv):
         # drop the leading length-1 shard axis inside the mapped body
-        st = ConflictState(hb[0], he[0], hver[0], ptr[0], floor[0])
+        st = ConflictState(hb[0], he[0], hver[0], floor[0])
         wbm, wem = _mask_writes_to_partition(wb, we, lo[0], hi[0], width)
         st2, verdicts = resolve_core(st, rb, re, wbm, wem, snap, cv,
                                      width=width, window=window)
         verdicts = jax.lax.pmax(verdicts, "resolvers")   # combine across partitions
-        return (st2.hb[None], st2.he[None], st2.hver[None], st2.ptr[None],
+        return (st2.hb[None], st2.he[None], st2.hver[None],
                 st2.floor[None], verdicts)
 
     sharded = P("resolvers")
@@ -134,18 +132,18 @@ def make_sharded_resolve_step(mesh: Mesh, width: int = DEFAULT_WIDTH,
     # the pmax guarantees the replicated verdict output is truly replicated.
     fn = shard_map(
         local_step, mesh=mesh,
-        in_specs=(sharded, sharded, sharded, sharded, sharded, sharded, sharded,
+        in_specs=(sharded, sharded, sharded, sharded, sharded, sharded,
                   repl, repl, repl, repl, repl, repl),
-        out_specs=(sharded, sharded, sharded, sharded, sharded, repl),
+        out_specs=(sharded, sharded, sharded, sharded, repl),
         check_vma=False,
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state: ShardedConflictState, rb, re, wb, we, snap, commit_version):
-        hb, he, hver, ptr, floor, verdicts = fn(
-            state.hb, state.he, state.hver, state.ptr, state.floor,
+        hb, he, hver, floor, verdicts = fn(
+            state.hb, state.he, state.hver, state.floor,
             state.part_lo, state.part_hi, rb, re, wb, we, snap, commit_version)
-        return ShardedConflictState(hb, he, hver, ptr, floor,
+        return ShardedConflictState(hb, he, hver, floor,
                                     state.part_lo, state.part_hi), verdicts
 
     return step

@@ -27,6 +27,18 @@ class Knobs:
     RESOLVER_RANGES_PER_TXN: int = 8          # padded read/write ranges per txn
     MAX_WRITE_TRANSACTION_LIFE_VERSIONS: int = 5_000_000  # ~5s at 1M versions/s (REF:fdbclient/ServerKnobs)
     VERSIONS_PER_SECOND: int = 1_000_000
+    # adaptive group fusion (r5): batches arriving while device dispatches
+    # are in flight fuse into grouped dispatches — amortizes the device
+    # round-trip across live concurrency without adding batching latency
+    RESOLVER_GROUP_FUSION: bool = True        # encoded backends only
+    RESOLVER_GROUP_MAX: int = 64              # max batches fused per dispatch
+    RESOLVER_MAX_INFLIGHT_GROUPS: int = 4     # device pipeline depth
+    # pin fused dispatches to ONE compiled K bucket (0 = native bucket
+    # quantization).  Production resolvers see varying group sizes; each
+    # new bucket is a fresh XLA compile (~10s over the tunnel) landing
+    # mid-traffic — padding every group to a fixed bucket trades a few KB
+    # of sentinel rows for a single warmup-time compile
+    RESOLVER_GROUP_BUCKET: int = 0
 
     # --- commit pipeline ---
     COMMIT_BATCH_INTERVAL: float = 0.002      # proxy batching window seconds (REF: COMMIT_TRANSACTION_BATCH_INTERVAL_MIN)

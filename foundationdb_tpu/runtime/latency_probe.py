@@ -1,0 +1,145 @@
+"""Commit-path latency instrumentation: per-stage stats + sampled per-txn
+TraceBatch probes.
+
+Reference: the reference attributes per-transaction stage latency with
+``TraceBatch`` events (REF:flow/Trace.h TraceBatch; SURVEY §5.1 "latency
+probes via TraceBatch for sampled transactions") and aggregates role-side
+stage timings into rolled metrics.  Two instruments here:
+
+- ``StageStats`` — a per-role accumulator of (stage -> seconds) samples;
+  roles on the commit path (GrvProxy, CommitProxy, Resolver) record each
+  stage's duration, and harnesses (bench/e2e.py) read ``summary()`` to
+  put a GRV-wait / batch-fill / version-wait / resolve / push breakdown
+  in the bench artifact (VERDICT r4 item 1a).
+- ``TraceBatch`` — sampled per-transaction probes: roughly 1 in
+  ``1/CLIENT_LATENCY_PROBE_SAMPLE`` transactions carries a probe; each
+  stage appends a (name, t) pair and the flush emits ONE structured
+  "TransactionTrace" TraceEvent with stage deltas in ms, so a single
+  sampled txn's whole commit path can be read off one trace line.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Optional
+
+from .trace import TraceEvent
+
+
+class StageStats:
+    """Bounded per-stage duration accumulator (seconds in, ms out)."""
+
+    __slots__ = ("name", "_samples", "_count", "_sum", "cap")
+
+    def __init__(self, name: str, cap: int = 65536) -> None:
+        self.name = name
+        self.cap = cap
+        self._samples: dict[str, list[float]] = {}
+        self._count: dict[str, int] = {}
+        self._sum: dict[str, float] = {}
+
+    def record(self, stage: str, seconds: float) -> None:
+        s = self._samples.setdefault(stage, [])
+        self._count[stage] = self._count.get(stage, 0) + 1
+        self._sum[stage] = self._sum.get(stage, 0.0) + seconds
+        if len(s) < self.cap:
+            s.append(seconds)
+
+    def reset(self) -> None:
+        self._samples.clear()
+        self._count.clear()
+        self._sum.clear()
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        """{stage: {n, mean_ms, p50_ms, p99_ms}} — percentiles over the
+        (bounded) retained samples, mean over everything recorded."""
+        out: dict[str, dict[str, float]] = {}
+        for stage, s in self._samples.items():
+            if not s:
+                continue
+            xs = sorted(s)
+            n = self._count[stage]
+            out[stage] = {
+                "n": n,
+                "mean_ms": round(self._sum[stage] / n * 1e3, 3),
+                "p50_ms": round(xs[len(xs) // 2] * 1e3, 3),
+                "p99_ms": round(xs[min(len(xs) - 1,
+                                       int(len(xs) * 0.99))] * 1e3, 3),
+            }
+        return out
+
+
+def merge_summaries(summaries: list[dict]) -> dict[str, dict[str, float]]:
+    """Weighted-mean merge of several roles' summaries (percentiles take
+    the max across roles — conservative for a breakdown artifact)."""
+    out: dict[str, dict[str, float]] = {}
+    for s in summaries:
+        for stage, row in s.items():
+            cur = out.get(stage)
+            if cur is None:
+                out[stage] = dict(row)
+                continue
+            n = cur["n"] + row["n"]
+            cur["mean_ms"] = round((cur["mean_ms"] * cur["n"]
+                                    + row["mean_ms"] * row["n"]) / n, 3)
+            cur["p50_ms"] = max(cur["p50_ms"], row["p50_ms"])
+            cur["p99_ms"] = max(cur["p99_ms"], row["p99_ms"])
+            cur["n"] = n
+    return out
+
+
+class TraceBatch:
+    """Sampled per-transaction stage probes (one trace line per sampled
+    txn).  ``attach()`` rolls the sampling dice; probes on unsampled ids
+    are no-ops, so the fast path costs one dict lookup."""
+
+    def __init__(self, sample_rate: float = 0.01, clock=None) -> None:
+        # deterministic counter-based sampling (no RNG: the probe must
+        # not perturb seeded simulation streams)
+        self._every = max(1, int(round(1.0 / sample_rate))) \
+            if sample_rate > 0 else 0
+        self._n = 0
+        self._live: dict[int, list[tuple[str, float]]] = {}
+        self._clock = clock
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock()
+        import asyncio
+        return asyncio.get_running_loop().time()
+
+    def attach(self, txn_id: int) -> bool:
+        """Maybe start a probe for this transaction; True if sampled."""
+        if not self._every:
+            return False
+        self._n += 1
+        if self._n % self._every:
+            return False
+        self._live[txn_id] = [("start", self._now())]
+        return True
+
+    def event(self, txn_id: int, name: str) -> None:
+        rec = self._live.get(txn_id)
+        if rec is not None:
+            rec.append((name, self._now()))
+
+    def discard(self, txn_id: int) -> None:
+        self._live.pop(txn_id, None)
+
+    def flush(self, txn_id: int, outcome: str = "committed") -> Optional[dict]:
+        """Emit the sampled txn's stage deltas as one TransactionTrace
+        event; returns the {stage: ms} dict (None if not sampled)."""
+        rec = self._live.pop(txn_id, None)
+        if rec is None:
+            return None
+        ev = TraceEvent("TransactionTrace")
+        ev.detail("Txn", txn_id).detail("Outcome", outcome)
+        deltas: dict[str, float] = {}
+        for (prev_name, prev_t), (name, t) in zip(rec, rec[1:]):
+            ms = round((t - prev_t) * 1e3, 3)
+            deltas[name] = ms
+            ev.detail(name.title().replace("_", "") + "Ms", ms)
+        total = round((rec[-1][1] - rec[0][1]) * 1e3, 3)
+        deltas["total"] = total
+        ev.detail("TotalMs", total).log()
+        return deltas

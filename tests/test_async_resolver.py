@@ -19,9 +19,10 @@ from foundationdb_tpu.runtime.knobs import Knobs
 from foundationdb_tpu.runtime.simloop import run_simulation
 
 
-def _knobs(backend):
+def _knobs(backend, fuse=True):
     return Knobs().override(RESOLVER_CONFLICT_BACKEND=backend,
-                            CONFLICT_RING_CAPACITY=4096)
+                            CONFLICT_RING_CAPACITY=4096,
+                            RESOLVER_GROUP_FUSION=fuse)
 
 
 def _batches(n_batches, txns_per_batch):
@@ -95,12 +96,13 @@ def test_event_loop_live_during_resolve():
 
 
 def test_batches_pipeline_submit_before_prior_finish():
-    """Batch N+1 must be submitted before batch N's verdict sync returns."""
+    """Batch N+1 must be submitted before batch N's verdict sync returns
+    (the split-phase path; the fused path is covered separately)."""
     reqs = _batches(3, 8)
     events = []
 
     async def run():
-        r = Resolver(_knobs("tpu"))
+        r = Resolver(_knobs("tpu", fuse=False))
         orig_begin = r.backend.resolve_begin
 
         def logged_begin(txns, version):
@@ -136,7 +138,7 @@ def test_resolver_fail_stops_after_sync_failure():
     reqs = _batches(3, 4)
 
     async def run():
-        r = Resolver(_knobs("tpu"))
+        r = Resolver(_knobs("tpu", fuse=False))
         await r.resolve(reqs[0])
 
         async def boom():
@@ -149,6 +151,49 @@ def test_resolver_fail_stops_after_sync_failure():
         r.backend.resolve_begin = orig
         with pytest.raises(ResolverFailed):
             await r.resolve(reqs[2])
+
+    _run_real_loop(run())
+
+
+def test_fused_group_parity_and_pipelining():
+    """The r5 group-fusion path: concurrent batches fuse into grouped
+    dispatches, verdicts match the serial split-phase path bit for bit,
+    and at least one dispatch carries more than one batch."""
+    reqs = _batches(8, 8)
+
+    async def run(fuse):
+        r = Resolver(_knobs("tpu", fuse=fuse))
+        outs = await asyncio.gather(*(r.resolve(req) for req in reqs))
+        return [o.verdicts for o in outs], list(r.group_sizes)
+
+    fused, sizes = _run_real_loop(run(True))
+    serial, _ = _run_real_loop(run(False))
+    assert fused == serial
+    # all batches went through fused dispatches
+    assert sum(sizes) == len(reqs)
+
+
+def test_fused_fail_stop_poisons_queue():
+    """A group sync failure must fail-stop the resolver and fail queued
+    batches instead of hanging them."""
+    from foundationdb_tpu.runtime.errors import ResolverFailed
+
+    reqs = _batches(4, 4)
+
+    async def run():
+        r = Resolver(_knobs("tpu", fuse=True))
+        await r.resolve(reqs[0])
+
+        def boom(batches, versions):
+            raise RuntimeError("device lost")
+
+        r.backend.resolve_group_begin = boom
+        results = await asyncio.gather(
+            *(r.resolve(req) for req in reqs[1:3]), return_exceptions=True)
+        assert all(isinstance(x, (ResolverFailed, RuntimeError))
+                   for x in results), results
+        with pytest.raises(ResolverFailed):
+            await r.resolve(reqs[3])
 
     _run_real_loop(run())
 

@@ -132,7 +132,7 @@ def test_dict_path_ring_state_matches_lanes_path():
         K(RESOLVER_CONFLICT_BACKEND="tpu", CONFLICT_DICT_SLOTS=min_slots))
     _run_groups(lanes, DeterministicRandom(5), n_groups=6)
     _run_groups(dct, DeterministicRandom(5), n_groups=6)
-    for f in ("hb", "he", "hver", "ptr", "floor"):
+    for f in ("hb", "he", "hver", "floor"):
         a = np.asarray(getattr(lanes.cs.state, f))
         b = np.asarray(getattr(dct.cs.state, f))
         assert (a == b).all(), f"ring field {f} diverged"

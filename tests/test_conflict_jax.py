@@ -50,15 +50,11 @@ def test_jax_numpy_bit_parity(seed, maxlen):
         tv = twin.resolve_encoded(eb, version)
         jv = kern.resolve_encoded(eb, version)
         np.testing.assert_array_equal(tv, jv, err_msg=f"verdicts diverge at step {step}")
-        # state parity over the live ring (slot C is write-only trash)
-        C = capacity
-        np.testing.assert_array_equal(twin.hb, np.asarray(kern.state.hb)[:, :C].T)
-        np.testing.assert_array_equal(twin.he, np.asarray(kern.state.he)[:, :C].T)
-        np.testing.assert_array_equal(twin.hver, np.asarray(kern.state.hver)[:C])
-        # the doubled half must mirror the live half exactly
-        np.testing.assert_array_equal(np.asarray(kern.state.hb)[:, C:],
-                                      np.asarray(kern.state.hb)[:, :C])
-        assert twin.ptr == int(kern.state.ptr)
+        # state parity over the canonical ring (twin is row-major [C, L],
+        # kernel lane-major [L, C])
+        np.testing.assert_array_equal(twin.hb, np.asarray(kern.state.hb).T)
+        np.testing.assert_array_equal(twin.he, np.asarray(kern.state.he).T)
+        np.testing.assert_array_equal(twin.hver, np.asarray(kern.state.hver))
         assert twin.oldest_version == kern.oldest_version
         if rng.coinflip(0.2):
             oldest = version - rng.random_int(10, 60)
@@ -117,15 +113,21 @@ def test_windowed_fast_path_parity(seed, window):
 
 
 def test_group_submit_matches_serial():
-    """resolve_group_submit (fused scan + bucket padding) must be
-    bit-identical to one-batch-at-a-time submission, including ring state."""
+    """resolve_group_submit (hot/cold fused scan + bucket padding) vs
+    one-batch-at-a-time submission: verdicts AND ring state must match
+    bit for bit — pad batches are dropped at the final append, so a
+    padded group advances the ring by exactly its real slabs, like the
+    serial chain.  (The fused floor advances once per dispatch instead
+    of once per batch; with snapshots inside retained history — the only
+    regime the parity gate covers — the end-of-dispatch floor is
+    identical.)"""
     rng = DeterministicRandom(21)
-    capacity = B * R * 8
-    serial = JaxConflictSet(capacity, W)
-    grouped = JaxConflictSet(capacity, W)
+    capacity = B * R * 64    # ample: snapshots never near the floor edge
+    window = B * R * 4
+    serial = JaxConflictSet(capacity, W, window=window)
+    grouped = JaxConflictSet(capacity, W, window=window)
     version = 100
-    for round_ in range(6):
-        k = rng.random_int(1, 7)        # hits buckets 1,2,4,8 incl. padding
+    for round_, k in enumerate([1, 2, 4, 3, 5, 6, 8]):
         ebs, cvs = [], []
         for _ in range(k):
             nt = rng.random_int(1, B + 1)
@@ -137,10 +139,11 @@ def test_group_submit_matches_serial():
         sv = [serial.resolve_encoded(eb, cv) for eb, cv in zip(ebs, cvs)]
         gv = np.asarray(grouped.resolve_group_submit(ebs, cvs))
         for i in range(k):
-            np.testing.assert_array_equal(sv[i], gv[i], err_msg=f"round {round_} batch {i}")
+            np.testing.assert_array_equal(
+                sv[i], gv[i], err_msg=f"round {round_} batch {i}")
         np.testing.assert_array_equal(np.asarray(serial.state.hver),
-                                      np.asarray(grouped.state.hver))
-        np.testing.assert_array_equal(np.asarray(serial.state.hb),
-                                      np.asarray(grouped.state.hb))
-        assert int(serial.state.ptr) == int(grouped.state.ptr)
+                                      np.asarray(grouped.state.hver),
+                                      err_msg=f"round {round_}")
+        np.testing.assert_array_equal(np.asarray(grouped.state.hb),
+                                      np.asarray(serial.state.hb))
         assert int(serial.state.floor) == int(grouped.state.floor)
