@@ -58,6 +58,10 @@ class Transaction:
         self._retry_count = 0
         self._watches_pending: list[tuple[bytes, bytes | None]] = []
         self._watch_futures: list[asyncio.Future] = []
+        tb = getattr(self._cluster, "trace_batch", None)
+        if tb is not None and getattr(self, "_probe_id", None) is not None:
+            tb.discard(self._probe_id)
+        self._probe_id: int | None = None
 
     def _check_mutable(self) -> None:
         if self._committing:
@@ -65,11 +69,23 @@ class Transaction:
 
     # --- read version ---
 
+    _probe_counter = 0      # class-wide txn ids for TraceBatch probes
+
     async def get_read_version(self) -> Version:
         if self._read_version is None:
+            # TraceBatch latency probe (REF:flow/Trace.h TraceBatch): a
+            # sampled fraction of transactions carry per-stage probes
+            # from GRV through commit, flushed as one TransactionTrace
+            tb = getattr(self._cluster, "trace_batch", None)
+            if tb is not None and self._probe_id is None:
+                Transaction._probe_counter += 1
+                if tb.attach(Transaction._probe_counter):
+                    self._probe_id = Transaction._probe_counter
             proxy = deterministic_random().choice(self._cluster.grv_proxies)
             self._read_version = await proxy.get_read_version(
                 self.lock_aware, self.priority, self.throttle_tag)
+            if self._probe_id is not None and tb is not None:
+                tb.event(self._probe_id, "grv")
         return self._read_version
 
     def set_read_version(self, version: Version) -> None:
@@ -356,10 +372,18 @@ class Transaction:
             # read-only txn commits trivially at its read version
             self._committed_version = self._read_version if self._read_version is not None else 0
             self._arm_watches(self._committed_version)
+            if self._probe_id is not None:
+                tb0 = getattr(self._cluster, "trace_batch", None)
+                if tb0 is not None:
+                    tb0.flush(self._probe_id, "read_only")
+                self._probe_id = None
             return self._committed_version
         if self._writes.bytes > self._knobs.TRANSACTION_SIZE_LIMIT:
             raise TransactionTooLarge()
         read_snapshot = await self.get_read_version()
+        tb = getattr(self._cluster, "trace_batch", None)
+        if self._probe_id is not None and tb is not None:
+            tb.event(self._probe_id, "commit_submit")
         req = CommitTransactionRequest(
             read_conflict_ranges=_coalesce(self._read_conflicts),
             write_conflict_ranges=_coalesce(self._write_conflicts),
@@ -374,9 +398,23 @@ class Transaction:
         except RequestMaybeDelivered:
             # the commit reached the proxy but its reply was lost: the
             # outcome is unknown and retrying blindly could double-commit
+            if self._probe_id is not None and tb is not None:
+                tb.event(self._probe_id, "commit_done")
+                tb.flush(self._probe_id, "unknown_result")
+                self._probe_id = None
             raise CommitUnknownResult() from None
+        except BaseException:
+            if self._probe_id is not None and tb is not None:
+                tb.event(self._probe_id, "commit_done")
+                tb.flush(self._probe_id, "aborted")
+                self._probe_id = None
+            raise
         finally:
             self._committing = False
+        if self._probe_id is not None and tb is not None:
+            tb.event(self._probe_id, "commit_done")
+            tb.flush(self._probe_id, "committed")
+            self._probe_id = None
         self._committed_version = result.version
         self._versionstamp = result.versionstamp
         self._arm_watches(result.version)

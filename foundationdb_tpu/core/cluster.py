@@ -92,6 +92,10 @@ class Cluster:
         self.commit_proxies = [CommitProxy(k, self.sequencer, self.resolvers,
                                            self.log_system, self.shard_map)
                                for _ in range(c.commit_proxies)]
+        # sampled per-txn stage probes (REF: TraceBatch; SURVEY §5.1)
+        from ..runtime.latency_probe import TraceBatch
+        self.trace_batch = TraceBatch(k.CLIENT_LATENCY_PROBE_SAMPLE)
+        self._profiler = None
         self._started = False
 
     @classmethod
@@ -137,9 +141,16 @@ class Cluster:
         for cp in self.commit_proxies:
             cp.start()
         self.ratekeeper.start()
+        # slow-task profiler (REF:flow/Profiler.actor.cpp): no-op under
+        # the virtual-time simulator, watchdog thread on a real loop
+        from ..runtime.profiler import SlowTaskProfiler
+        self._profiler = SlowTaskProfiler(self.knobs).start()
         self._started = True
 
     async def stop(self) -> None:
+        if self._profiler is not None:
+            self._profiler.stop()
+            self._profiler = None
         await self.ratekeeper.stop()
         for cp in self.commit_proxies:
             await cp.stop()

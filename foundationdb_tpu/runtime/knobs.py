@@ -52,6 +52,11 @@ class Knobs:
     COMMIT_EMPTY_BATCH_INTERVAL: float = 0.25
     IDLE_COMMIT_LIMIT: float = 5.0
 
+    # --- observability ---
+    SLOW_TASK_THRESHOLD: float = 0.2    # event-loop stall before a SlowTask
+    #                                     trace fires (REF:flow/Profiler)
+    CLIENT_LATENCY_PROBE_SAMPLE: float = 0.01   # TraceBatch sampling rate
+
     # --- storage ---
     STORAGE_ENGINE: str = "memory"            # memory | lsm | btree
     # wire/protocol version this "binary" speaks (the reference's

@@ -20,7 +20,6 @@ stage timings into rolled metrics.  Two instruments here:
 
 from __future__ import annotations
 
-import bisect
 from typing import Optional
 
 from .trace import TraceEvent

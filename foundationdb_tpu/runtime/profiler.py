@@ -1,0 +1,103 @@
+"""Slow-task profiler — catches event-loop stalls and attributes them.
+
+Reference: REF:flow/Profiler.actor.cpp — the reference samples the
+program counter when the Flow event loop runs one task for longer than a
+threshold, emitting a trace with the offending stack.  Same instrument
+here, asyncio-shaped: a watchdog THREAD watches a heartbeat the loop
+refreshes every tick; when the heartbeat goes stale past
+``SLOW_TASK_THRESHOLD`` the watchdog captures the loop thread's current
+Python stack via ``sys._current_frames`` and emits one
+``SlowTask`` TraceEvent with the duration and the innermost frames.
+
+The reference's single-threaded-event-loop discipline makes this the
+race-free observability primitive: a stall IS a bug (a coroutine doing
+blocking work on the loop), and the stack names it.  Under the
+virtual-time simulator the profiler is a no-op — virtual time never
+stalls and extra threads would break determinism.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import threading
+import time
+import traceback
+
+from .knobs import Knobs
+from .trace import TraceEvent
+
+
+class SlowTaskProfiler:
+    """Watchdog for one asyncio event loop (the production loop)."""
+
+    def __init__(self, knobs: Knobs | None = None,
+                 threshold: float | None = None) -> None:
+        k = knobs or Knobs()
+        self.threshold = threshold if threshold is not None \
+            else k.SLOW_TASK_THRESHOLD
+        self.interval = max(self.threshold / 4, 0.005)
+        self._beat = time.monotonic()
+        self._loop_thread_id: int | None = None
+        self._stop = threading.Event()
+        self._heartbeat_task: asyncio.Task | None = None
+        self._watchdog: threading.Thread | None = None
+        self.stalls = 0                 # total stalls caught
+        self.last_stall_s: float | None = None
+
+    # --- loop side ---
+
+    async def _heartbeat(self) -> None:
+        while not self._stop.is_set():
+            self._beat = time.monotonic()
+            await asyncio.sleep(self.interval)
+
+    def start(self) -> "SlowTaskProfiler":
+        from .simloop import SimEventLoop
+        loop = asyncio.get_running_loop()
+        if isinstance(loop, SimEventLoop):
+            return self             # no-op under the simulator (see module doc)
+        self._loop_thread_id = threading.get_ident()
+        self._beat = time.monotonic()
+        self._heartbeat_task = loop.create_task(
+            self._heartbeat(), name="slow-task-heartbeat")
+        self._watchdog = threading.Thread(
+            target=self._watch, daemon=True, name="slow-task-watchdog")
+        self._watchdog.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            self._heartbeat_task = None
+
+    # --- watchdog thread ---
+
+    def _watch(self) -> None:
+        # On detection the watchdog captures the loop thread's stack (the
+        # culprit is mid-stall, so the frame names it); the event is
+        # emitted when the heartbeat RESUMES, carrying the whole stall's
+        # duration rather than the duration at detection time.
+        stall_stack: str | None = None
+        stall_beat = 0.0
+        while not self._stop.is_set():
+            time.sleep(self.interval)
+            stale = time.monotonic() - self._beat
+            if stale >= self.threshold:
+                if stall_stack is None or self._beat > stall_beat:
+                    stall_beat = self._beat
+                    frame = sys._current_frames().get(self._loop_thread_id)
+                    stall_stack = "".join(
+                        traceback.format_stack(frame, limit=8)) \
+                        if frame is not None else "<no frame>"
+                continue
+            if stall_stack is not None:
+                # the stall just ended: heartbeat resumed
+                duration = self._beat - stall_beat
+                self.stalls += 1
+                self.last_stall_s = duration
+                TraceEvent("SlowTask", severity=30) \
+                    .detail("DurationMs", round(duration * 1e3, 1)) \
+                    .detail("Stack", stall_stack[-2000:]).log()
+                stall_stack = None
