@@ -58,13 +58,35 @@ class _CliDatabase:
 
 class Cli:
     def __init__(self, knobs: Knobs, view: RecoveredClusterView,
-                 coordinators: list) -> None:
+                 coordinators: list, coordinator_factory=None,
+                 cluster_file_path: str | None = None) -> None:
         self.knobs = knobs
         self.view = view
         self.coordinators = coordinators
+        self.coordinator_factory = coordinator_factory
+        self.cluster_file_path = cluster_file_path
 
     async def refresh(self) -> None:
-        self.view.update(await fetch_cluster_state(self.coordinators))
+        from .runtime.errors import CoordinatorsChanged
+        try:
+            self.view.update(await fetch_cluster_state(self.coordinators))
+        except CoordinatorsChanged as e:
+            # the quorum moved (changeQuorum): follow the forward pointer
+            addrs = getattr(e, "moved_to", None)
+            if addrs is None or self.coordinator_factory is None:
+                raise
+            self._repoint(addrs)
+            self.view.update(await fetch_cluster_state(self.coordinators))
+
+    def _repoint(self, addrs: list) -> None:
+        self.coordinators = self.coordinator_factory(addrs)
+        if self.cluster_file_path:
+            from .rpc.transport import NetworkAddress
+            cf = ClusterFile.load(self.cluster_file_path)
+            cf.coordinators = [NetworkAddress(a[0], a[1])
+                               if isinstance(a, (list, tuple)) else a
+                               for a in addrs]
+            cf.save(self.cluster_file_path)
 
     async def run_txn(self, fn):
         tr = Transaction(self.view)
@@ -215,6 +237,39 @@ class Cli:
                     tr.set(conf_key(name), validate_conf(name, val))
             await self.run_txn(do)
             return "Configuration changed (takes effect at the next recovery)"
+        if cmd == "coordinators":
+            # coordinators ip:port[,ip:port...] — changeQuorum
+            # (REF:fdbclient/ManagementAPI.actor.cpp::changeQuorum)
+            if not args:
+                return "coordinators: " + ",".join(
+                    f"{c._address.ip}:{c._address.port}"
+                    if hasattr(c, "_address") else repr(c)
+                    for c in self.coordinators)
+            if self.coordinator_factory is None:
+                return "ERROR: this cli session cannot change coordinators"
+            from .core.coordination import change_coordinators
+            raw = ",".join(args).split(",")
+            addrs = []
+            for part in raw:
+                ip, _, port = part.strip().rpartition(":")
+                if not ip or not port.isdigit():
+                    return f"ERROR: bad coordinator address `{part}'"
+                a = [ip, int(port)]
+                if a in addrs:
+                    # a duplicate would let one process vote twice,
+                    # silently collapsing the advertised fault tolerance
+                    return f"ERROR: duplicate coordinator address `{part}'"
+                addrs.append(a)
+            if len(addrs) % 2 == 0:
+                return "ERROR: coordinator count must be odd"
+            new_stubs = self.coordinator_factory(addrs)
+            # loop-clock mover id: unique enough for generation tie-breaks,
+            # deterministic under the simulator
+            mover = int(asyncio.get_running_loop().time() * 1e6) & 0xFFFFFF
+            await change_coordinators(self.coordinators, new_stubs, addrs,
+                                      self.knobs, mover_id=mover)
+            self._repoint(addrs)
+            return "Coordinators changed"
         if cmd == "status" and args and args[0] == "json":
             import json as _json
 
@@ -241,8 +296,13 @@ async def open_cli(cluster_file: str, knobs: Knobs,
                    timeout: float = 30.0, tls=None) -> Cli:
     cf = ClusterFile.load(cluster_file)
     t = TcpTransport(NetworkAddress("127.0.0.1", 0), tls=tls)
-    coords = [CoordinatorClient(t, a, WLTOKEN_COORDINATOR)
-              for a in cf.coordinators]
+
+    from .rpc.stubs import make_coordinator_stubs
+
+    def coord_factory(addrs):
+        return make_coordinator_stubs(addrs, transport=t)
+
+    coords = coord_factory(cf.coordinators)
     deadline = asyncio.get_running_loop().time() + timeout
     while True:
         try:
@@ -252,7 +312,9 @@ async def open_cli(cluster_file: str, knobs: Knobs,
             if asyncio.get_running_loop().time() > deadline:
                 raise
             await asyncio.sleep(0.5)
-    return Cli(knobs, RecoveredClusterView(knobs, t, state), coords)
+    return Cli(knobs, RecoveredClusterView(knobs, t, state), coords,
+               coordinator_factory=coord_factory,
+               cluster_file_path=cluster_file)
 
 
 async def amain(args) -> int:

@@ -51,6 +51,9 @@ class Transaction:
         self._read_conflicts: list[tuple[bytes, bytes]] = []
         self._write_conflicts: list[tuple[bytes, bytes]] = []
         self._read_version: Version | None = None
+        old_grv = getattr(self, "_grv_task", None)
+        if old_grv is not None and not old_grv.done():
+            old_grv.cancel()
         self._grv_task: asyncio.Task | None = None
         self._committed_version: Version | None = None
         self._versionstamp: bytes | None = None
@@ -72,20 +75,30 @@ class Transaction:
     _probe_counter = 0      # class-wide txn ids for TraceBatch probes
 
     async def get_read_version(self) -> Version:
-        if self._read_version is None:
-            # TraceBatch latency probe (REF:flow/Trace.h TraceBatch): a
-            # sampled fraction of transactions carry per-stage probes
-            # from GRV through commit, flushed as one TransactionTrace
-            tb = getattr(self._cluster, "trace_batch", None)
-            if tb is not None and self._probe_id is None:
-                Transaction._probe_counter += 1
-                if tb.attach(Transaction._probe_counter):
-                    self._probe_id = Transaction._probe_counter
-            proxy = deterministic_random().choice(self._cluster.grv_proxies)
-            self._read_version = await proxy.get_read_version(
-                self.lock_aware, self.priority, self.throttle_tag)
-            if self._probe_id is not None and tb is not None:
-                tb.event(self._probe_id, "grv")
+        if self._read_version is not None:
+            return self._read_version
+        # single-flight: concurrent first reads must share ONE snapshot —
+        # two GRV fetches would split the transaction's read version and
+        # commit-time conflict checking would miss writes between them
+        if self._grv_task is None:
+            self._grv_task = asyncio.get_running_loop().create_task(
+                self._fetch_read_version(), name="txn-grv")
+        return await asyncio.shield(self._grv_task)
+
+    async def _fetch_read_version(self) -> Version:
+        # TraceBatch latency probe (REF:flow/Trace.h TraceBatch): a
+        # sampled fraction of transactions carry per-stage probes
+        # from GRV through commit, flushed as one TransactionTrace
+        tb = getattr(self._cluster, "trace_batch", None)
+        if tb is not None and self._probe_id is None:
+            Transaction._probe_counter += 1
+            if tb.attach(Transaction._probe_counter):
+                self._probe_id = Transaction._probe_counter
+        proxy = deterministic_random().choice(self._cluster.grv_proxies)
+        self._read_version = await proxy.get_read_version(
+            self.lock_aware, self.priority, self.throttle_tag)
+        if self._probe_id is not None and tb is not None:
+            tb.event(self._probe_id, "grv")
         return self._read_version
 
     def set_read_version(self, version: Version) -> None:

@@ -117,13 +117,31 @@ async def fetch_cluster_state(coordinators: list) -> dict:
     replies = await asyncio.gather(
         *(c.open_database() for c in coordinators), return_exceptions=True)
     best: dict | None = None
+    moved: list | None = None
     for r in replies:
         if isinstance(r, BaseException) or not r:
             continue
+        if "__moved_to__" in r:
+            # a retired coordinator: the quorum moved (changeQuorum);
+            # surface the forward so the caller repoints
+            moved = r["__moved_to__"]
+            continue
+        if "__moving_to__" in r:
+            # mid-change intent marker: the preserved state inside is
+            # the live cluster state — clients keep working through the
+            # move window
+            r = r.get("__value__")
+            if not r:
+                continue
         if best is None or (r.get("epoch", 0), r.get("seq", 0)) > \
                 (best.get("epoch", 0), best.get("seq", 0)):
             best = r
     if best is None:
+        if moved is not None:
+            from ..runtime.errors import CoordinatorsChanged
+            e = CoordinatorsChanged()
+            e.moved_to = moved
+            raise e
         raise FdbError("no coordinator returned a cluster state")
     return best
 

@@ -855,6 +855,11 @@ class ClusterController:
             except asyncio.CancelledError:
                 raise
             except FdbError as e:
+                from ..runtime.errors import CoordinatorsChanged
+                if isinstance(e, CoordinatorsChanged):
+                    # quorum change (intent marker or retired set): the
+                    # host must complete/follow the move, not retry here
+                    raise
                 TraceEvent("RecoveryFailed", severity=30) \
                     .detail("Error", e.name).detail("Msg", str(e)).log()
                 await self._stop_attempt_recruits()
@@ -889,6 +894,10 @@ class ClusterController:
             waiters.append(asyncio.ensure_future(self._probe_roles(state)))
             waiters.append(asyncio.ensure_future(
                 self._watch_region_preference(state)))
+            # quorum-change watch: a changeQuorum intent written while
+            # we idle must be noticed (the mover may have died right
+            # after phase 1; the CC is then the one who completes it)
+            waiters.append(asyncio.ensure_future(self._watch_quorum_change()))
             try:
                 done, pending = await asyncio.wait(
                     waiters, return_when=asyncio.FIRST_COMPLETED)
@@ -896,7 +905,43 @@ class ClusterController:
                 for w in waiters:
                     w.cancel()
                 await asyncio.gather(*waiters, return_exceptions=True)
+            from ..runtime.errors import CoordinatorsChanged
+            for w in done:
+                exc = w.exception()
+                if isinstance(exc, CoordinatorsChanged):
+                    raise exc       # quorum change: the host completes it
+                if exc is not None:
+                    # a watcher died unexpectedly: recover in place (the
+                    # old behavior), never tear the CC down for it
+                    TraceEvent("WatcherFailed", severity=30) \
+                        .detail("Error", repr(exc)[:200]).log()
             TraceEvent("TxnRoleFailed").detail("Epoch", self.epoch).log()
+
+    async def _watch_quorum_change(self) -> None:
+        """Poll for a changeQuorum intent marker or a retired quorum;
+        completes (by raising CoordinatorsChanged) when one appears.
+        Uses open_database ONLY — it never registers a read generation,
+        so the poll cannot invalidate this CC's own cstate writes."""
+        from ..runtime.errors import CoordinatorsChanged
+        while True:
+            await asyncio.sleep(self.knobs.FAILURE_TIMEOUT * 2)
+            replies = await asyncio.gather(
+                *(c.open_database() for c in self.cstate.coordinators),
+                return_exceptions=True)
+            for r in replies:
+                if not isinstance(r, dict):
+                    continue
+                if "__moved_to__" in r:
+                    e = CoordinatorsChanged()
+                    e.moving_to = None      # forward exists: just follow
+                    raise e
+                if "__moving_to__" in r:
+                    # an un-completed intent (the mover died after phase
+                    # 1): this CC completes the move
+                    e = CoordinatorsChanged()
+                    e.moving_to = r["__moving_to__"]
+                    e.inner_value = r.get("__value__")
+                    raise e
 
     async def _probe_roles(self, state: dict) -> None:
         """Ping each recruited txn role's block-level liveness slot

@@ -46,5 +46,11 @@ class ClusterFile:
         return f"{self.description}:{self.cluster_id}@{addrs}\n"
 
     def save(self, path: str) -> None:
-        with open(path, "w") as f:
+        # atomic replace: several processes rewrite the shared file on a
+        # quorum change; a truncate-then-write would expose readers to a
+        # partial/empty file
+        import os
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             f.write(self.dump())
+        os.replace(tmp, path)

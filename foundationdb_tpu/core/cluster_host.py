@@ -39,7 +39,9 @@ class ClusterHost:
                  base_token: int, coordinators: list,
                  spec: ClusterConfigSpec | None = None,
                  fs=None, data_dir: str = "data",
-                 locality: dict | None = None) -> None:
+                 locality: dict | None = None,
+                 coordinator_factory: Callable[[list], list] | None = None,
+                 on_repoint: Callable[[list], None] | None = None) -> None:
         self.id = host_id
         self.knobs = knobs
         # locality (dcid, ...) rides every worker registration so the
@@ -49,6 +51,10 @@ class ClusterHost:
         self.make_client_transport = client_transport_factory
         self.base = base_token
         self.coordinators = coordinators
+        # quorum-change support (changeQuorum): rebuild stubs for a new
+        # coordinator set + notify (e.g. rewrite the cluster file)
+        self.coordinator_factory = coordinator_factory
+        self.on_repoint = on_repoint
         self.spec = spec or ClusterConfigSpec()
         self.worker = Worker(host_id, knobs, transport,
                              client_transport_factory, base_token,
@@ -139,6 +145,7 @@ class ClusterHost:
     # --- the main loop: elect, lead or follow, repeat ---
 
     async def run(self) -> None:
+        from ..runtime.errors import CoordinatorsChanged
         k = self.knobs
         await self.worker.open_resident()
         me = [self.address.ip, self.address.port]
@@ -146,13 +153,93 @@ class ClusterHost:
             try:
                 leader_id, leader_addr = await elect_leader(
                     self.coordinators, self.id, me, k)
+            except CoordinatorsChanged:
+                if not await self._follow_forward():
+                    await asyncio.sleep(k.RECOVERY_RETRY_DELAY)
+                continue
             except CoordinatorsUnreachable:
-                await asyncio.sleep(k.RECOVERY_RETRY_DELAY)
+                # an unreachable quorum may be a RETIRED quorum: check
+                # for forward pointers before blind retry
+                if not await self._follow_forward():
+                    await asyncio.sleep(k.RECOVERY_RETRY_DELAY)
                 continue
             if leader_id == self.id:
                 await self._lead()
             else:
                 await self._follow(leader_addr)
+
+    # --- quorum-change handling (changeQuorum) ---
+
+    async def _follow_forward(self) -> bool:
+        """If the current coordinator set has been retired, repoint to
+        the forwarded set.  True if a repoint happened."""
+        if self.coordinator_factory is None:
+            return False
+        k = self.knobs
+
+        async def fwd(c):
+            return await asyncio.wait_for(c.get_forward(), k.FAILURE_TIMEOUT)
+
+        fwds = await asyncio.gather(*(fwd(c) for c in self.coordinators),
+                                    return_exceptions=True)
+        for f in fwds:
+            if f and not isinstance(f, BaseException):
+                # finish retiring the rest of the old set first (a visible
+                # forward implies the new set holds the state): an
+                # un-retired old MAJORITY could otherwise still elect a
+                # leader for hosts that have not noticed the move yet.
+                # Members of BOTH sets keep serving.
+                new_keys = {(a[0], a[1]) for a in f}
+
+                def shared(c) -> bool:
+                    a = getattr(c, "_address", None)
+                    return a is not None and (a.ip, a.port) in new_keys
+
+                async def retire(c):
+                    return await asyncio.wait_for(c.move(f),
+                                                  k.FAILURE_TIMEOUT)
+                await asyncio.gather(
+                    *(retire(c) for c in self.coordinators if not shared(c)),
+                    return_exceptions=True)
+                self._repoint(f)
+                return True
+        return False
+
+    def _repoint(self, addrs: list) -> None:
+        TraceEvent("CoordinatorsRepointed").detail("Host", self.id) \
+            .detail("NewSet", str(addrs)).log()
+        self.coordinators = self.coordinator_factory(addrs)
+        if self.on_repoint is not None:
+            try:
+                self.on_repoint(addrs)
+            except Exception as e:  # noqa: BLE001 — cluster-file rewrite
+                TraceEvent("RepointCallbackFailed", severity=30) \
+                    .detail("Error", repr(e)[:200]).log()
+
+    async def _maybe_complete_move(self, exc: BaseException | None) -> bool:
+        """A CC that died on a quorum-change intent marker: complete the
+        interrupted move (phases 2-3) and repoint.  Safe for any host to
+        run; completion is idempotent (see complete_coordinator_move)."""
+        from ..runtime.errors import CoordinatorsChanged
+        moving_to = getattr(exc, "moving_to", None)
+        if not isinstance(exc, CoordinatorsChanged):
+            return False
+        if moving_to is None:
+            return await self._follow_forward()
+        if self.coordinator_factory is None:
+            return False
+        from .coordination import complete_coordinator_move
+        new_stubs = self.coordinator_factory(moving_to)
+        try:
+            await complete_coordinator_move(
+                self.coordinators, new_stubs, moving_to,
+                getattr(exc, "inner_value", None), self.knobs, self.id)
+        except Exception as e:  # noqa: BLE001 — retry via the run loop
+            TraceEvent("QuorumMoveCompleteFailed", severity=30) \
+                .detail("Error", repr(e)[:200]).log()
+            return False
+        self._repoint(moving_to)
+        return True
 
     async def _lead(self) -> None:
         """Run the ClusterController until the coordinator lease is lost."""
@@ -198,9 +285,13 @@ class ClusterHost:
             while True:
                 await asyncio.sleep(k.LEADER_HEARTBEAT_INTERVAL)
                 if cc_task.done():
+                    exc = cc_task.exception()
                     TraceEvent("CCActorDied", severity=40) \
                         .detail("Host", self.id) \
-                        .detail("Error", repr(cc_task.exception())[:200]).log()
+                        .detail("Error", repr(exc)[:200]).log()
+                    # a CC killed by a quorum-change intent completes the
+                    # move before standing down (changeQuorum crash path)
+                    await self._maybe_complete_move(exc)
                     return
                 # bound each renewal RPC: a dead coordinator must not
                 # stall the round past the live coordinators' lease

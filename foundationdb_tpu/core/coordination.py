@@ -72,6 +72,10 @@ class Coordinator:
         self.value: Any = None
         self._leader: LeaderInfo | None = None
         self._nominations: dict[int, Nomination] = {}
+        # set by change_coordinators (MovableCoordinatedState's forward
+        # pointer): once retired, this coordinator refuses register and
+        # election traffic and forwards callers to the new set
+        self.moved_to: list | None = None
 
     # --- durability (OnDemandStore) ---
 
@@ -87,6 +91,7 @@ class Coordinator:
                 co.max_read_gen = tuple(st["r"])
                 co.write_gen = tuple(st["w"])
                 co.value = st["v"]
+                co.moved_to = st.get("m")
             except Exception:
                 TraceEvent("CoordStateCorrupt", severity=30).detail(
                     "Path", path).log()
@@ -100,14 +105,44 @@ class Coordinator:
         await f.truncate(0)
         await f.write(0, encode({"r": list(self.max_read_gen),
                                  "w": list(self.write_gen),
-                                 "v": self.value}))
+                                 "v": self.value,
+                                 "m": self.moved_to}))
         await f.sync()
+
+    # --- quorum migration (MovableCoordinatedState,
+    #     REF:fdbserver/Coordination.actor.cpp) ---
+
+    def _check_moved(self) -> None:
+        if self.moved_to is not None:
+            from ..runtime.errors import CoordinatorsChanged
+            raise CoordinatorsChanged()
+
+    async def move(self, new_addrs: list) -> bool:
+        """Retire this coordinator: record the forward pointer and refuse
+        all register/election traffic from now on.  Idempotent.  Called
+        by change_coordinators AFTER the cluster state has been copied to
+        the new quorum — so a visible forward pointer always implies the
+        new set is authoritative."""
+        if self.moved_to is None:
+            self.moved_to = [list(a) if isinstance(a, tuple) else a
+                             for a in new_addrs]
+            self._leader = None
+            self._nominations.clear()
+            await self._persist()
+            TraceEvent("CoordinatorMoved").detail(
+                "NewSet", str(self.moved_to)).log()
+        return True
+
+    async def get_forward(self) -> list | None:
+        """Where did this quorum go?  None while still serving."""
+        return self.moved_to
 
     # --- generation register (GenerationRegInterface) ---
 
     async def read(self, gen: list | Generation) -> tuple[Generation, Generation, Any]:
         """Register a read at ``gen``; promise excludes older writers.
         Returns (max_read_gen, write_gen, value)."""
+        self._check_moved()
         gen = tuple(gen)
         if gen > self.max_read_gen:
             self.max_read_gen = gen
@@ -118,6 +153,7 @@ class Coordinator:
         """Accept iff gen is at least as new as every promise; returns the
         coordinator's max read generation (so a rejected writer learns
         what to beat)."""
+        self._check_moved()
         gen = tuple(gen)
         if gen < self.max_read_gen or gen <= self.write_gen:
             raise NotLatestGeneration()
@@ -129,7 +165,10 @@ class Coordinator:
     async def open_database(self) -> Any:
         """Read-only client entry (OpenDatabaseCoordRequest analog): hand
         back the latest accepted cluster state WITHOUT registering a read
-        generation — clients must never invalidate writers."""
+        generation — clients must never invalidate writers.  After a
+        quorum change, clients get the forward pointer instead."""
+        if self.moved_to is not None:
+            return {"__moved_to__": self.moved_to}
         return self.value
 
     # --- leader election (LeaderElectionRegInterface) ---
@@ -157,6 +196,7 @@ class Coordinator:
         """Phase 1: record/refresh this candidacy; grants nothing.
         Returns [0, leader_id, addr] when an unexpired confirmed leader
         exists, else [1, best_nominee_id, addr]."""
+        self._check_moved()
         now = asyncio.get_running_loop().time()
         self._nominations[candidate_id] = Nomination(
             candidate_id, address, now + self.knobs.NOMINATION_TIMEOUT)
@@ -171,6 +211,7 @@ class Coordinator:
         Idempotent for the incumbent (True without extending the lease —
         renewal is leader_heartbeat's job).  ``round_id`` fences the grant
         against stale withdraws (see withdraw)."""
+        self._check_moved()
         now = asyncio.get_running_loop().time()
         if self._leader is not None and now < self._leader.lease_end:
             if self._leader.leader_id == candidate_id:
@@ -217,6 +258,7 @@ class Coordinator:
         Candidacy-on-read is what seeds leader ping-pong: a respawned
         (empty) coordinator would grant to the first caller while the
         quorum still honors the incumbent's lease."""
+        self._check_moved()
         now = asyncio.get_running_loop().time()
         if self._leader is not None and now < self._leader.lease_end:
             return self._leader.leader_id, self._leader.address
@@ -224,6 +266,7 @@ class Coordinator:
 
     async def leader_heartbeat(self, candidate_id: int) -> bool:
         """Renew the lease; False tells a deposed leader to stand down."""
+        self._check_moved()
         now = asyncio.get_running_loop().time()
         if self._leader is not None and self._leader.leader_id == candidate_id \
                 and now < self._leader.lease_end:
@@ -268,14 +311,25 @@ class CoordinatedState:
             real = [r for r in results if isinstance(r, FdbError)]
             if real and all(isinstance(r, NotLatestGeneration) for r in real):
                 raise NotLatestGeneration()
+            from ..runtime.errors import CoordinatorsChanged
+            if any(isinstance(r, CoordinatorsChanged) for r in real):
+                # a retired quorum: the caller must follow the forward
+                # pointers (get_forward) to the new set
+                raise CoordinatorsChanged()
             raise CoordinatorsUnreachable()
         return ok
 
-    async def read(self) -> tuple[Generation, Any]:
+    async def read(self, raw: bool = False) -> tuple[Generation, Any]:
         """Phase-1 read from a majority: registers a fresh read generation
         and returns (read_gen, freshest accepted value).  After this, no
         writer at an older generation can commit at any majority (the two
-        majorities intersect at a coordinator holding our promise)."""
+        majorities intersect at a coordinator holding our promise).
+
+        If the freshest value is a quorum-change INTENT marker (written by
+        change_coordinators phase 1), normal consumers get
+        CoordinatorsChanged carrying the target set — the caller must
+        complete or follow the move (ClusterHost does).  ``raw=True``
+        (the mover itself) returns the marker."""
         self._gen_counter += 1
         gen = (self._gen_counter, self.my_id)
         replies = await self._quorum(
@@ -285,7 +339,14 @@ class CoordinatedState:
         self._gen_counter = max(self._gen_counter, max_seen[0])
         self._read_gen = gen
         best = max(replies, key=lambda r: r[1])    # freshest accepted write
-        return gen, best[2]
+        value = best[2]
+        if not raw and isinstance(value, dict) and "__moving_to__" in value:
+            from ..runtime.errors import CoordinatorsChanged
+            e = CoordinatorsChanged()
+            e.moving_to = value["__moving_to__"]
+            e.inner_value = value.get("__value__")
+            raise e
+        return gen, value
 
     async def write(self, value: Any) -> None:
         """Phase-2 write at the generation of OUR read phase — never a
@@ -309,6 +370,110 @@ class CoordinatedState:
                 return new
             except NotLatestGeneration:
                 await asyncio.sleep(0.05)
+
+
+async def change_coordinators(old_coords: list, new_coords: list,
+                              new_addrs: list, knobs: Knobs,
+                              mover_id: int = 0) -> None:
+    """Change the coordinator set — changeQuorum
+    (REF:fdbclient/ManagementAPI.actor.cpp::changeQuorum over
+    MovableCoordinatedState, REF:fdbserver/Coordination.actor.cpp).
+
+    Three phases, each crash-safe:
+      1. INTENT through the OLD quorum: the cluster-state value is
+         replaced by a generation-fenced marker {__moving_to__, __value__}.
+         Any concurrent writer (another mover, the CC) now conflicts; any
+         reader learns the move and can complete it (ClusterHost does).
+      2. COPY: the preserved value is written into the NEW quorum's
+         registers.  A crash before phase 3 leaves the old quorum
+         authoritative-but-marked; re-running is idempotent.
+      3. RETIRE: every old coordinator records the forward pointer and
+         refuses register/election traffic (majority required; the rest
+         best-effort — a visible forward always implies phase 2 is done,
+         so two quorums can never both accept writes: the old set's
+         majority is fenced by the intent generation until retired, and
+         retired coordinators serve only the forward).
+
+    ``new_addrs`` are the wire-shaped addresses ([ip, port]) recorded in
+    forward pointers and intent markers; ``new_coords`` the matching
+    stubs (or Coordinator objects in-process)."""
+    if len(new_coords) != len(new_addrs) or not new_coords:
+        raise ValueError("new coordinator stubs/addresses mismatch")
+    wire_addrs = [list(a) if isinstance(a, tuple) else
+                  ([a.ip, a.port] if hasattr(a, "ip") else list(a))
+                  for a in new_addrs]
+    cs_old = CoordinatedState(old_coords, mover_id, knobs=knobs)
+    while True:
+        _gen, cur = await cs_old.read(raw=True)
+        if isinstance(cur, dict) and "__moving_to__" in cur:
+            # an interrupted move: preserve the ORIGINAL value; our
+            # target set wins via the generation fence below
+            cur = cur.get("__value__")
+        try:
+            await cs_old.write({"__moving_to__": wire_addrs,
+                                "__value__": cur})
+            break
+        except NotLatestGeneration:
+            # the CC wrote cstate between our read and write: adopt the
+            # newer value and retry the intent (read_modify_write loop)
+            await asyncio.sleep(0.05)
+    await complete_coordinator_move(old_coords, new_coords, wire_addrs,
+                                    cur, knobs, mover_id)
+    TraceEvent("CoordinatorsChangedOK").detail(
+        "NewSet", str(wire_addrs)).log()
+
+
+async def complete_coordinator_move(old_coords: list, new_coords: list,
+                                    wire_addrs: list, value: Any,
+                                    knobs: Knobs, mover_id: int = 0) -> None:
+    """Phases 2-3 of change_coordinators — also the completion path a
+    ClusterHost runs when it finds an interrupted move's intent marker.
+
+    Clobber guard: if ANY old coordinator already serves a forward
+    pointer, phase 2 is known complete and a new-set CC may already be
+    writing newer state there — the copy is skipped and only the
+    retirement of the remaining old coordinators is finished.
+    Concurrent completers that both pass the guard write the SAME
+    preserved value (idempotent)."""
+    timeout = (knobs.FAILURE_TIMEOUT * 2 if knobs is not None else 4.0)
+
+    async def fwd(c):
+        return await asyncio.wait_for(c.get_forward(), timeout)
+
+    fwds = await asyncio.gather(*(fwd(c) for c in old_coords),
+                                return_exceptions=True)
+    already = any(f for f in fwds if f and not isinstance(f, BaseException))
+    if not already:
+        cs_new = CoordinatedState(new_coords, mover_id, knobs=knobs)
+        try:
+            await cs_new.read(raw=True)
+            await cs_new.write(value)
+        except NotLatestGeneration:
+            pass    # a racing completer's identical copy won — fine
+
+    async def retire(c):
+        return await asyncio.wait_for(c.move(wire_addrs), timeout)
+
+    # coordinators in BOTH sets keep serving (the common replace-one
+    # operation); safety holds because any still-electable old majority
+    # and any new majority intersect at a shared coordinator whose
+    # single-lease guard serializes the two elections
+    new_keys = {tuple(a) for a in wire_addrs}
+
+    def shared(c) -> bool:
+        if c in new_coords:
+            return True
+        a = getattr(c, "_address", None)
+        return a is not None and (a.ip, a.port) in {(k[0], k[1])
+                                                    for k in new_keys}
+
+    retiring = [c for c in old_coords if not shared(c)]
+    if retiring:
+        acks = await asyncio.gather(*(retire(c) for c in retiring),
+                                    return_exceptions=True)
+        good = sum(1 for a in acks if a is True)
+        if good < len(retiring) // 2 + 1:
+            raise CoordinatorsUnreachable()
 
 
 def _addr_key(a: Any):
@@ -413,6 +578,11 @@ async def elect_leader(coordinators: list, candidate_id: int, address: Any,
             return_exceptions=True)
         ok = [r for r in noms if not isinstance(r, BaseException)]
         if len(ok) < majority:
+            from ..runtime.errors import CoordinatorsChanged
+            if any(isinstance(r, CoordinatorsChanged) for r in noms):
+                # a retired quorum: surface the typed error so the caller
+                # follows the forward pointers instead of blind-retrying
+                raise CoordinatorsChanged()
             raise CoordinatorsUnreachable()
         lead_tally: dict[tuple[int, Any], int] = {}
         nom_tally: dict[tuple[int, Any], int] = {}

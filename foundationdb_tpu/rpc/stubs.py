@@ -36,7 +36,8 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
     "coordinator": [("read", False), ("write", False),
                     ("nominate", False), ("confirm", False),
                     ("withdraw", False), ("leader_heartbeat", False),
-                    ("open_database", False), ("read_leader", False)],
+                    ("open_database", False), ("read_leader", False),
+                    ("move", False), ("get_forward", False)],
     "worker": [("recruit", False), ("stop_role", False),
                ("rejoin_storage", False), ("list_roles", False)],
     "cluster_controller": [("register_worker", False),
@@ -157,6 +158,22 @@ class GrvProxyClient(RoleClient):
 
 class CoordinatorClient(RoleClient):
     role = "coordinator"
+
+
+def make_coordinator_stubs(addrs, transport=None, transport_factory=None,
+                           token=None):
+    """Build CoordinatorClients from wire-shaped ([ip, port]) or
+    NetworkAddress addresses — the ONE home of the address normalization
+    every quorum-change site needs.  Pass either a shared ``transport``
+    or a per-stub ``transport_factory``."""
+    from .transport import WLTOKEN_COORDINATOR, NetworkAddress
+    token = WLTOKEN_COORDINATOR if token is None else token
+    out = []
+    for a in addrs:
+        na = NetworkAddress(a[0], a[1]) if isinstance(a, (list, tuple)) else a
+        t = transport if transport is not None else transport_factory()
+        out.append(CoordinatorClient(t, na, token))
+    return out
 
 
 class LogRouterClient(RoleClient):

@@ -84,6 +84,12 @@ WrongShardServer = _err(1001, "wrong_shard_server",
 RequestMaybeDelivered = _err(1213, "request_maybe_delivered",
                              "Request may or may not have been delivered")
 
+CoordinatorsChanged = _err(1101 + 100, "coordinators_changed",
+                           "The coordinator set has changed; refetch the "
+                           "connection string and retry (upstream's "
+                           "coordinators_changed — its exact code was "
+                           "unverifiable this session, 1201 reserved here)")
+
 # resolver-internal (ours; no upstream equivalent needed on the wire)
 ResolverCapacityExceeded = _err(2900, "resolver_capacity_exceeded",
                                 "Conflict-set history ring overflowed; txn forced too-old")
@@ -97,5 +103,6 @@ LogDataLoss = _err(2902, "log_data_loss",
 # path converts it to commit_unknown_result (1021) before the client's
 # retry loop can see it, because re-running a maybe-delivered commit is
 # not idempotent.
-_RETRYABLE = {1001, 1004, 1007, 1009, 1012, 1020, 1021, 1026, 1031, 1037, 1039, 1191, 1213, 2900}
+_RETRYABLE = {1001, 1004, 1007, 1009, 1012, 1020, 1021, 1026, 1031, 1037,
+              1039, 1191, 1201, 1213, 2900}
 _MAYBE_COMMITTED = {1021}

@@ -87,18 +87,33 @@ async def run_server(cluster_file: str, listen: str, spec: ClusterConfigSpec,
         return TcpTransport(
             NetworkAddress(ip, int(port) * 1000 + next(counter)), tls=tls)
 
+    # EVERY process serves a coordination register (idle unless the
+    # connection string names its address) so `coordinators` can move the
+    # quorum onto any process — exactly like fdbserver
+    coordinator = Coordinator(knobs)
+    serve_role(transport, "coordinator", coordinator, WLTOKEN_COORDINATOR)
     if addr in cf.coordinators:
-        # the coordinator shares the process transport with the worker, so
-        # it lives at its own well-known token block
-        coordinator = Coordinator(knobs)
-        serve_role(transport, "coordinator", coordinator, WLTOKEN_COORDINATOR)
         TraceEvent("CoordinatorStarted").detail("Address", str(addr)).log()
 
-    coord_stubs = [CoordinatorClient(client_transport(), a, WLTOKEN_COORDINATOR)
-                   for a in cf.coordinators]
+    from .rpc.stubs import make_coordinator_stubs
+
+    def coord_factory(addrs):
+        return make_coordinator_stubs(addrs,
+                                      transport_factory=client_transport)
+
+    def on_repoint(addrs):
+        # persist the new connection string so a restart finds the new set
+        cf.coordinators = [NetworkAddress(a[0], a[1])
+                           if isinstance(a, (list, tuple)) else a
+                           for a in addrs]
+        cf.save(cluster_file)
+
+    coord_stubs = coord_factory(cf.coordinators)
     host_id = int(port)           # unique per process on one box
     host = ClusterHost(host_id, knobs, transport, client_transport, BASE,
-                       coord_stubs, spec)
+                       coord_stubs, spec,
+                       coordinator_factory=coord_factory,
+                       on_repoint=on_repoint)
     host.start()
     TraceEvent("ServerStarted").detail("Address", str(addr)) \
         .detail("Cluster", cf.cluster_id).log()

@@ -61,14 +61,22 @@ class SimMachine:
         """Boot (or reboot) the machine's process."""
         self.sim.net.reboot_ip(self.ip)
         transport = SimTransport(self.sim.net, self.addr)  # replaces listener
-        if self.is_coordinator:
-            self.coordinator = await Coordinator.open(
-                self.sim.knobs, self.fs, "coordination-0.fdq")
-            serve_role(transport, "coordinator", self.coordinator,
-                       WLTOKEN_COORDINATOR)
-        coord_stubs = [CoordinatorClient(self._client_transport(), a,
-                                         WLTOKEN_COORDINATOR)
-                       for a in self.sim.coord_addrs]
+        # EVERY machine serves a coordination register (idle unless its
+        # address is in the connection string) so `coordinators` can move
+        # the quorum onto any machine — like fdbserver, where any process
+        # can host coordination when the connection string names it
+        self.coordinator = await Coordinator.open(
+            self.sim.knobs, self.fs, "coordination-0.fdq")
+        serve_role(transport, "coordinator", self.coordinator,
+                   WLTOKEN_COORDINATOR)
+
+        from ..rpc.stubs import make_coordinator_stubs
+
+        def coord_factory(addrs):
+            return make_coordinator_stubs(
+                addrs, transport_factory=self._client_transport)
+
+        coord_stubs = coord_factory(self.sim.coord_addrs)
         # host ids must differ across boots or coordinators could confuse
         # two incarnations in the same election
         host_id = self.index + 100 * self._boots
@@ -80,7 +88,8 @@ class SimMachine:
             host_id, self.sim.knobs, transport, self._client_transport,
             BASE, coord_stubs, self.sim.spec,
             fs=self.fs if self.sim.durable_storage else None,
-            data_dir="data", locality=locality)
+            data_dir="data", locality=locality,
+            coordinator_factory=coord_factory)
         self.host.start()
         self.alive = True
 

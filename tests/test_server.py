@@ -127,6 +127,55 @@ def test_three_process_cluster_smoke(tmp_path):
         teardown_servers(procs, logs)
 
 
+def test_change_coordinators_through_cli(tmp_path):
+    """changeQuorum over real TCP: 4 processes, coordinators move from
+    {0,1,2} to {1,2,3} via the cli `coordinators` command; the cluster
+    file is rewritten and the cluster keeps serving."""
+    ports = free_ports(4)
+    cf = ClusterFile("movq", "t1",
+                     [NetworkAddress("127.0.0.1", p) for p in ports[:3]])
+    cf_path = tmp_path / "fdb.cluster"
+    cf.save(str(cf_path))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = []
+    logs = [tmp_path / f"server-{p}.log" for p in ports]
+    try:
+        for p, lg in zip(ports, logs):
+            procs.append(spawn_server(
+                [sys.executable, "-m", "foundationdb_tpu.server",
+                 "-C", str(cf_path), "-l", f"127.0.0.1:{p}",
+                 "--spec", "min_workers=4"], lg, env))
+
+        async def drive():
+            from foundationdb_tpu.cli import open_cli
+            from foundationdb_tpu.runtime.knobs import Knobs
+            cli = await open_cli(str(cf_path), Knobs(), timeout=60.0)
+            assert await cli.execute("set before move") == "Committed"
+            new = ",".join(f"127.0.0.1:{p}" for p in ports[1:])
+            out = await cli.execute(f"coordinators {new}")
+            assert out == "Coordinators changed", out
+            # the cli's cluster file now names the new set
+            cf2 = ClusterFile.load(str(cf_path))
+            assert [a.port for a in cf2.coordinators] == ports[1:]
+            # the cluster keeps serving through the new set (recovery may
+            # be in flight while hosts repoint: retry within a budget)
+            deadline = time.time() + 60
+            while True:
+                out = await cli.execute("set after move")
+                if out == "Committed":
+                    break
+                assert time.time() < deadline, out
+                await asyncio.sleep(1.0)
+            assert await cli.execute("get before") == "`before' is `move'"
+            out = await cli.execute("coordinators")
+            assert all(f":{p}" in out for p in ports[1:])
+
+        asyncio.run(asyncio.wait_for(drive(), timeout=150.0))
+    finally:
+        teardown_servers(procs, logs)
+
+
 def test_dr_and_lock_through_cli(tmp_path):
     """fdbdr analog end-to-end over real TCP: two single-process
     clusters, `dr start/status/switch` plus `lock`/`unlock` through the
