@@ -375,31 +375,54 @@ def probe_rtt(tpu_device) -> float | None:
 
 
 def run_configs34_phase(tpu_device, quiet: bool) -> dict:
-    """BASELINE configs 3–4: YCSB-F ops/sec and TPC-C NewOrder tpmC for
-    both backends (scaled-down row counts to keep bench wall time sane;
-    the workload *shape* — RMW contention, district hotspot — is the
-    config's point)."""
+    """BASELINE configs 3–4 at honest scale (VERDICT r4 item 5): YCSB-F
+    over 1M rows with 30s measured windows (n_samples >= 1e4 on the cpp
+    side) and TPC-C NewOrder windows long enough for >= 1e3 NewOrders."""
     import asyncio
 
     from foundationdb_tpu.bench.tpcc import run_tpcc_neworder
     from foundationdb_tpu.bench.ycsb import run_ycsb_f
-    from foundationdb_tpu.runtime import Knobs
 
     out = {}
     for kind in ("cpp", "tpu"):
         dev = tpu_device if kind == "tpu" else None
         warm = 10.0 if kind == "tpu" else 1.0
+        clients = 256 if kind == "tpu" else 64
         knobs = tpu_e2e_knobs(kind)
         out[f"ycsb_{kind}"] = asyncio.run(run_ycsb_f(
-            knobs, n_rows=20_000, duration_s=2.0, n_clients=64,
+            knobs, n_rows=1_000_000, duration_s=30.0, n_clients=clients,
             device=dev, warmup_s=warm))
         out[f"tpcc_{kind}"] = asyncio.run(run_tpcc_neworder(
-            knobs, duration_s=2.0, n_clients=32, device=dev,
+            knobs, duration_s=30.0, n_clients=clients // 2, device=dev,
             warmup_s=warm))
         if not quiet:
             print(f"[ycsb {kind}] {out[f'ycsb_{kind}']}", file=sys.stderr)
             print(f"[tpcc {kind}] {out[f'tpcc_{kind}']}", file=sys.stderr)
     return out
+
+
+def run_multi_resolver_phase(quiet: bool) -> dict:
+    """BASELINE config 5: the shard_map multi-resolver scaling numbers,
+    measured in a SUBPROCESS pinned to the 8-virtual-device CPU mesh (the
+    in-process backend may be the axon tunnel; the scaling SHAPE needs a
+    device-count axis this sandbox's single chip cannot provide)."""
+    import json as _json
+    import subprocess
+
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    p = subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.bench.multi_resolver"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    if p.returncode != 0 or not p.stdout.strip():
+        raise RuntimeError(
+            f"multi_resolver rc={p.returncode}: {p.stderr.strip()[-300:]}")
+    line = p.stdout.strip().splitlines()[-1]
+    res = _json.loads(line)["results"]
+    if not quiet:
+        print(f"[multi_resolver] {res}", file=sys.stderr)
+    return res
 
 
 def project_local_attach(out: dict, e2e: dict) -> dict:
@@ -618,6 +641,9 @@ def main() -> int:
                     "ycsb_p99_ms_cpp": rnd(c34["ycsb_cpp"]["p99_ms"]),
                     "ycsb_n_samples_tpu": c34["ycsb_tpu"]["n_samples"],
                     "ycsb_n_samples_cpp": c34["ycsb_cpp"]["n_samples"],
+                    "ycsb_n_rows": c34["ycsb_cpp"]["n_rows"],
+                    "ycsb_abort_codes_tpu": c34["ycsb_tpu"]["abort_codes"],
+                    "ycsb_abort_codes_cpp": c34["ycsb_cpp"]["abort_codes"],
                     "tpcc_tpmC_tpu": rnd(c34["tpcc_tpu"]["tpmC"]),
                     "tpcc_tpmC_cpp": rnd(c34["tpcc_cpp"]["tpmC"]),
                     "tpcc_livelock_tpu": c34["tpcc_tpu"]["livelock"],
@@ -626,9 +652,16 @@ def main() -> int:
                     "tpcc_n_samples_cpp": c34["tpcc_cpp"]["n_samples"],
                     "tpcc_abort_rate_tpu": rnd(c34["tpcc_tpu"]["abort_rate"], 3),
                     "tpcc_abort_rate_cpp": rnd(c34["tpcc_cpp"]["abort_rate"], 3),
+                    "tpcc_abort_codes_tpu": c34["tpcc_tpu"]["abort_codes"],
+                    "tpcc_abort_codes_cpp": c34["tpcc_cpp"]["abort_codes"],
                 })
             except Exception as e:  # noqa: BLE001 — configs 3-4 are extras
                 out["configs34_error"] = repr(e)[:300]
+            try:
+                out["multi_resolver_scaling"] = \
+                    run_multi_resolver_phase(args.quiet)
+            except Exception as e:  # noqa: BLE001 — config 5 is an extra
+                out["multi_resolver_error"] = repr(e)[:300]
             try:
                 # the abort-parity gate (BASELINE.md config-2): encoded
                 # abort rate vs exact on a range-heavy shape; fat txns

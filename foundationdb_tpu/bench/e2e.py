@@ -43,6 +43,7 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
 
     committed = 0
     conflicts = 0
+    abort_codes: dict[int, int] = {}
     measuring = False
     latencies: list[float] = []
     read_lat: list[float] = []      # client-side stage split (VERDICT 1a)
@@ -75,6 +76,7 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
             except FdbError as e:
                 if measuring:
                     conflicts += 1
+                    abort_codes[e.code] = abort_codes.get(e.code, 0) + 1
                 try:
                     await tr.on_error(e)
                     continue
@@ -125,6 +127,11 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
         "committed": committed,
         "aborts": conflicts,
         "abort_rate": conflicts / max(1, committed + conflicts),
+        # per-cause split (1020 true conflict / 1007 too old / other) +
+        # the batching window that widens the OCC contention window
+        # (VERDICT r4 item 4)
+        "abort_codes": {str(c): n for c, n in sorted(abort_codes.items())},
+        "commit_batch_interval_s": knobs.COMMIT_BATCH_INTERVAL,
         **latency_ms(latencies, (50, 95, 99)),
         "elapsed_s": elapsed,
         "n_clients": n_clients,

@@ -59,6 +59,7 @@ async def run_tpcc_neworder(knobs: Knobs, n_warehouses: int = 2,
 
     done = 0
     aborts = 0
+    abort_codes: dict[int, int] = {}
     measuring = False
     latencies: list[float] = []
     stop_at = time.perf_counter() + warmup_s + duration_s
@@ -105,6 +106,7 @@ async def run_tpcc_neworder(knobs: Knobs, n_warehouses: int = 2,
             except FdbError as e:
                 if measuring:
                     aborts += 1
+                    abort_codes[e.code] = abort_codes.get(e.code, 0) + 1
                 try:
                     await tr.on_error(e)
                     continue
@@ -137,6 +139,7 @@ async def run_tpcc_neworder(knobs: Knobs, n_warehouses: int = 2,
         "new_orders": done,
         "aborts": aborts,
         "abort_rate": abort_rate,
+        "abort_codes": {str(c): n for c, n in sorted(abort_codes.items())},
         **latency_ms(latencies, (50, 99)),
         "elapsed_s": elapsed,
     }

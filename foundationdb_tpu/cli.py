@@ -81,12 +81,7 @@ class Cli:
     def _repoint(self, addrs: list) -> None:
         self.coordinators = self.coordinator_factory(addrs)
         if self.cluster_file_path:
-            from .rpc.transport import NetworkAddress
-            cf = ClusterFile.load(self.cluster_file_path)
-            cf.coordinators = [NetworkAddress(a[0], a[1])
-                               if isinstance(a, (list, tuple)) else a
-                               for a in addrs]
-            cf.save(self.cluster_file_path)
+            ClusterFile.repoint(self.cluster_file_path, addrs)
 
     async def run_txn(self, fn):
         tr = Transaction(self.view)
@@ -218,14 +213,18 @@ class Cli:
                 return "DR aborted (destination keeps its prefix)"
             return f"ERROR: unknown dr subcommand `{sub}'"
         if cmd in ("exclude", "include"):
-            from .core import management
+            # through the special-key space (REF: fdbcli drives exclusion
+            # via \xff\xff/management/excluded/ since 6.3)
+            from .client.special_keys import ExcludedServersModule
+            prefix = ExcludedServersModule.prefix
 
             async def do(tr):
+                tr.special_key_space_enable_writes = True
                 for a in args:
                     if cmd == "exclude":
-                        tr.set(management.excluded_key(a), b"1")
+                        tr.set(prefix + a.encode(), b"1")
                     else:
-                        tr.clear(management.excluded_key(a))
+                        tr.clear(prefix + a.encode())
             await self.run_txn(do)
             return f"Servers {cmd}d (takes effect at the next recovery)"
         if cmd == "configure":
@@ -274,6 +273,9 @@ class Cli:
             import json as _json
 
             from .core.status import cluster_status
+            # refresh first: follows a coordinator change (repoint) the
+            # same way the plain `status` command does
+            await self.refresh()
             doc = await cluster_status(self.knobs, self.view.transport,
                                        self.coordinators)
             return _json.dumps(doc, indent=2, default=str)

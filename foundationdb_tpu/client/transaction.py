@@ -37,6 +37,9 @@ class Transaction:
         self.lock_aware = False
         self.priority = "default"
         self.throttle_tag: str | None = None
+        # SPECIAL_KEY_SPACE_ENABLE_WRITES (REF: the transaction option
+        # gating management writes through \xff\xff)
+        self.special_key_space_enable_writes = False
         self.reset()
 
     # --- lifecycle ---
@@ -65,6 +68,7 @@ class Transaction:
         if tb is not None and getattr(self, "_probe_id", None) is not None:
             tb.discard(self._probe_id)
         self._probe_id: int | None = None
+        self._special_error: bytes | None = None
 
     def _check_mutable(self) -> None:
         if self._committing:
@@ -129,31 +133,10 @@ class Transaction:
     async def _special_key(self, key: bytes) -> bytes | None:
         """The ``\\xff\\xff`` special-key space (REF:fdbclient/
         SpecialKeySpace.actor.cpp): module-backed reads answered by the
-        client, not storage.  No read conflict is taken."""
-        if key == b"\xff\xff/status/json":
-            import json
-
-            from ..core.status import cluster_status
-            rdb = getattr(self, "_rdb", None)
-            if rdb is None:
-                from ..runtime.errors import ClientInvalidOperation
-                raise ClientInvalidOperation(
-                    "status json needs a coordinator-backed database")
-            doc = await cluster_status(self._cluster.knobs,
-                                       self._cluster.transport,
-                                       rdb.coordinators)
-            return json.dumps(
-                doc, sort_keys=True,
-                default=lambda o: (o.hex() if isinstance(o, (bytes,
-                                                             bytearray))
-                                   else str(o))).encode()
-        if key == b"\xff\xff/connection_string":
-            rdb = getattr(self, "_rdb", None)
-            if rdb is None or not getattr(rdb, "connection_string", None):
-                return None
-            return rdb.connection_string.encode()
-        from ..runtime.errors import ClientInvalidOperation
-        raise ClientInvalidOperation(f"unknown special key {key!r}")
+        client, not storage.  No read conflict is taken.  Dispatch lives
+        in client/special_keys.py's module registry."""
+        from .special_keys import SPECIAL_KEY_SPACE
+        return await SPECIAL_KEY_SPACE.get(self, key)
 
     async def get_addresses_for_key(self, key: bytes) -> list[str]:
         from .locality import get_addresses_for_key
@@ -164,6 +147,16 @@ class Transaction:
                         ) -> list[tuple[bytes, bytes]]:
         """begin/end: bytes or KeySelector.  Returns up to ``limit`` pairs."""
         self._check_mutable()
+        if isinstance(begin, bytes) and begin.startswith(b"\xff\xff"):
+            # special-key range read: module-backed, may span modules
+            from .special_keys import SPECIAL_KEY_SPACE
+            if not isinstance(end, bytes):
+                from ..runtime.errors import ClientInvalidOperation
+                raise ClientInvalidOperation(
+                    "key selectors are not supported in the special-key "
+                    "space; pass byte bounds")
+            return await SPECIAL_KEY_SPACE.get_range(
+                self, begin, end, limit=limit, reverse=reverse)
         if isinstance(begin, KeySelector):
             begin = await self.get_key(begin, snapshot=True)
         if isinstance(end, KeySelector):
@@ -287,6 +280,13 @@ class Transaction:
 
     def set(self, key: bytes, value: bytes) -> None:
         self._check_mutable()
+        if key.startswith(b"\xff\xff"):
+            # special-key writes (REF: SpecialKeySpace RW modules) are
+            # rewritten onto real system keys inside this txn; gated by
+            # the SPECIAL_KEY_SPACE_ENABLE_WRITES option
+            from .special_keys import SPECIAL_KEY_SPACE
+            SPECIAL_KEY_SPACE.set(self, key, value)
+            return
         self._check_key(key)
         if len(value) > self._knobs.VALUE_SIZE_LIMIT:
             raise ValueTooLarge()
@@ -295,6 +295,10 @@ class Transaction:
 
     def clear(self, key: bytes) -> None:
         self._check_mutable()
+        if key.startswith(b"\xff\xff"):
+            from .special_keys import SPECIAL_KEY_SPACE
+            SPECIAL_KEY_SPACE.clear(self, key)
+            return
         self._check_key(key)
         self._writes.clear_range(key, key_after(key))
         self._write_conflicts.append((key, key_after(key)))
@@ -303,6 +307,19 @@ class Transaction:
         self._check_mutable()
         if begin >= end:
             return
+        if begin.startswith(b"\xff\xff"):
+            from .special_keys import SPECIAL_KEY_SPACE
+            SPECIAL_KEY_SPACE.clear(self, begin, end)
+            return
+        # both endpoints validated like any written key (upstream's
+        # clear_range raises key_too_large / key_outside_legal_range the
+        # same way); ``\xff`` as the exclusive end is legal — it means
+        # "to the end of the user keyspace"
+        if len(begin) > self._knobs.KEY_SIZE_LIMIT \
+                or len(end) > self._knobs.KEY_SIZE_LIMIT:
+            raise KeyTooLarge()
+        if end > b"\xff" and end.startswith(b"\xff\xff"):
+            raise KeyOutsideLegalRange()
         self._writes.clear_range(begin, end)
         self._write_conflicts.append((begin, end))
 

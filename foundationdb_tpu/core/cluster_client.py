@@ -59,6 +59,9 @@ class RecoveredClusterView:
 
         self.epoch = state["epoch"]
         self.seq = state.get("seq", 0)
+        # raw published state: special-key modules (worker_interfaces)
+        # read role addresses off it
+        self.state = state
         self.commit_proxies = [
             CommitProxyClient(t, addr(p["addr"]), p["token"])
             for p in state["commit_proxies"]]

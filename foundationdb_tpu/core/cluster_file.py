@@ -45,6 +45,17 @@ class ClusterFile:
         addrs = ",".join(f"{a.ip}:{a.port}" for a in self.coordinators)
         return f"{self.description}:{self.cluster_id}@{addrs}\n"
 
+    @classmethod
+    def repoint(cls, path: str, addrs: list) -> "ClusterFile":
+        """Rewrite the file at ``path`` with a new coordinator set given
+        wire-shaped ([ip, port]) or NetworkAddress entries — the ONE home
+        of the quorum-change file rewrite (cli + server both use it)."""
+        cf = cls.load(path)
+        cf.coordinators = [a if isinstance(a, NetworkAddress)
+                           else NetworkAddress(a[0], a[1]) for a in addrs]
+        cf.save(path)
+        return cf
+
     def save(self, path: str) -> None:
         # atomic replace: several processes rewrite the shared file on a
         # quorum change; a truncate-then-write would expose readers to a

@@ -66,6 +66,7 @@ class ClusterHost:
         self._registry: dict[NetworkAddress, WorkerClient] = {}
         self._leading = False
         self.cc: ClusterController | None = None
+        self.dd = None          # live DataDistributor while leading
         self._task: asyncio.Task | None = None
         self._stopped = False
         serve_role(transport, "cluster_controller", self,
@@ -277,6 +278,7 @@ class ClusterHost:
                 db = RefreshingDatabase(view, self.coordinators)
                 d = DataDistributor(k, t, self.cc, db)
                 d.start()
+                self.dd = d     # reachable for manual moves (RandomMoveKeys)
                 return d
 
             dd_task = asyncio.get_running_loop().create_task(
@@ -309,6 +311,7 @@ class ClusterHost:
                     return
         finally:
             self._leading = False
+            self.dd = None
             if k.DD_ENABLED:
                 dd_task.cancel()
                 try:

@@ -90,6 +90,16 @@ class DataDistributor:
         self.splits_done = 0
         self.live_moves_done = 0
         self._worker_rr = 0
+        # operator/workload-requested relocations (RandomMoveKeys): shard
+        # indices to move onto fresh teams, drained one per round
+        self._move_requests: list[int] = []
+
+    def request_relocation(self, shard_idx: int) -> None:
+        """Queue a manual live move of shard ``shard_idx`` onto a fresh
+        team (REF:fdbserver/workloads/RandomMoveKeys.actor.cpp drives
+        moveKeys directly; here the request rides DD's own relocation
+        machinery so journaling/rollback behave identically)."""
+        self._move_requests.append(shard_idx)
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(
@@ -149,6 +159,13 @@ class DataDistributor:
         # freshly-recruited X-engine servers, one shard per round
         # (REF:fdbclient/ManagementAPI.actor.cpp changeStorageType →
         # DD gradually replaces wrong-store-type servers) ---
+        # --- manual relocation requests first (RandomMoveKeys) ---
+        while self._move_requests:
+            idx = self._move_requests.pop(0)
+            if 0 <= idx < len(layout["teams"]):
+                await self._relocate(state, layout, idx, next_tag,
+                                     split_key=None, engine=None)
+                return
         desired = await self._desired_engine()
         if desired is not None:
             for idx, (rng, team) in enumerate(shard_map.ranges()):

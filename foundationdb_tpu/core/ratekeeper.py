@@ -42,6 +42,9 @@ class Ratekeeper:
         self.rate_tps: float = knobs.RATEKEEPER_MAX_TPS
         self.batch_rate_tps: float = knobs.RATEKEEPER_MAX_TPS
         self.tag_rates: dict[str, float] = {}     # throttled tags only
+        # operator-set clamps (REF: TagThrottleApi manual throttles):
+        # merged over the auto-detected set every update, never aged out
+        self.manual_tag_rates: dict[str, float] = {}
         self._tokens: float = knobs.RATEKEEPER_MAX_TPS
         self._batch_tokens: float = 0.0
         self._tag_tokens: dict[str, tuple[float, float]] = {}  # tag->(tok,ts)
@@ -165,6 +168,8 @@ class Ratekeeper:
                 self.tag_rates = {}
                 TraceEvent("RkRateLimited").detail("Reason", reason) \
                     .detail("TPSLimit", round(rate, 1)).log()
+        if self.manual_tag_rates:
+            self.tag_rates = {**self.tag_rates, **self.manual_tag_rates}
         self.rate_tps = rate
         # batch lane: background work gets what default demand leaves
         self.batch_rate_tps = max(
@@ -176,6 +181,17 @@ class Ratekeeper:
         self.limiting_reason = reason \
             if (rate < k.RATEKEEPER_MAX_TPS or self.tag_rates) \
             else "unlimited"
+
+    async def set_tag_throttle(self, tag: str, rate: float | None) -> bool:
+        """Manual tag clamp (REF: TagThrottleApi): rate in txns/s, None
+        lifts it.  Takes effect immediately and survives auto updates."""
+        if rate is None:
+            self.manual_tag_rates.pop(tag, None)
+            self.tag_rates.pop(tag, None)
+        else:
+            self.manual_tag_rates[tag] = float(rate)
+            self.tag_rates[tag] = float(rate)
+        return True
 
     async def get_rate(self) -> float:
         """Current budget (RPC surface for status/monitoring)."""

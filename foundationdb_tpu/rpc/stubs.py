@@ -32,7 +32,7 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
     "commit_proxy": [("commit", False)],
     "grv_proxy": [("get_read_version", False)],
     "ratekeeper": [("admit", False), ("get_rate", False),
-                   ("get_throttle", False)],
+                   ("get_throttle", False), ("set_tag_throttle", False)],
     "coordinator": [("read", False), ("write", False),
                     ("nominate", False), ("confirm", False),
                     ("withdraw", False), ("leader_heartbeat", False),

@@ -103,10 +103,7 @@ async def run_server(cluster_file: str, listen: str, spec: ClusterConfigSpec,
 
     def on_repoint(addrs):
         # persist the new connection string so a restart finds the new set
-        cf.coordinators = [NetworkAddress(a[0], a[1])
-                           if isinstance(a, (list, tuple)) else a
-                           for a in addrs]
-        cf.save(cluster_file)
+        ClusterFile.repoint(cluster_file, addrs)
 
     coord_stubs = coord_factory(cf.coordinators)
     host_id = int(port)           # unique per process on one box

@@ -199,6 +199,14 @@ class SimulatedCluster:
                 return m.host.cc
         return None
 
+    def leader_dd(self):
+        """The live DataDistributor, if any machine currently leads."""
+        for m in self.machines:
+            if m.alive and m.host is not None \
+                    and getattr(m.host, "dd", None) is not None:
+                return m.host.dd
+        return None
+
     async def txn_only_machines(self) -> list[SimMachine]:
         """Machines whose kill exercises recovery: hosting at least one
         txn-subsystem role, but no storage replica (re-replication needs

@@ -9,6 +9,6 @@ violated by lost/phantom/reordered writes.
 
 from .workload import (TestWorkload, WorkloadContext, register_workload,
                        make_workload, run_workloads, run_workloads_on)
-from . import (attrition, conflict_range, consistency,  # noqa: F401  (register)
-               correctness, cycle, dynamic, increment, ops, random_rw,
-               serializability)
+from . import (api_fuzz, attrition, conflict_range,  # noqa: F401  (register)
+               consistency, correctness, cycle, dynamic, increment, ops,
+               ops2, random_rw, serializability)

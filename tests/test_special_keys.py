@@ -1,0 +1,131 @@
+"""Special-key-space module framework (VERDICT r4 item 7).
+
+Reference: REF:fdbclient/SpecialKeySpace.actor.cpp — prefix-scoped
+modules under \\xff\\xff, management writes gated by the
+SPECIAL_KEY_SPACE_ENABLE_WRITES option and rewritten onto real system
+keys inside the same transaction."""
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.client.special_keys import (ExcludedServersModule,
+                                                  SpecialKeySpace)
+from foundationdb_tpu.client.transaction import Transaction
+from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+from foundationdb_tpu.core.management import EXCLUDED_PREFIX
+from foundationdb_tpu.runtime.errors import ClientInvalidOperation
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+PFX = ExcludedServersModule.prefix
+
+
+def test_exclusion_roundtrip_via_special_keys():
+    """Write an exclusion through \\xff\\xff/management/excluded/, read it
+    back through the special range AND the real system key — one txn."""
+    async def main():
+        cluster = Cluster(ClusterConfig(), Knobs())
+        cluster.start()
+        tr = Transaction(cluster)
+        tr.special_key_space_enable_writes = True
+        tr.set(PFX + b"10.0.0.9:4500", b"1")
+        await tr.commit()
+        tr.reset()
+
+        # special-range read
+        rows = await tr.get_range(PFX, PFX + b"\xff")
+        assert rows == [(PFX + b"10.0.0.9:4500", b"1")]
+        # point read through the module
+        assert await tr.get(PFX + b"10.0.0.9:4500") == b"1"
+        # the REAL system key was written (what recovery consumes)
+        assert await tr.get(EXCLUDED_PREFIX + b"10.0.0.9:4500") == b"1"
+
+        # include (clear) through the special key space
+        tr.reset()
+        tr.special_key_space_enable_writes = True
+        tr.clear(PFX + b"10.0.0.9:4500")
+        await tr.commit()
+        tr.reset()
+        assert await tr.get(PFX + b"10.0.0.9:4500") is None
+        assert await tr.get(EXCLUDED_PREFIX + b"10.0.0.9:4500") is None
+        await cluster.stop()
+    run_simulation(main())
+
+
+def test_writes_gated_by_option_and_error_message():
+    async def main():
+        cluster = Cluster(ClusterConfig(), Knobs())
+        cluster.start()
+        tr = Transaction(cluster)
+        with pytest.raises(ClientInvalidOperation):
+            tr.set(PFX + b"10.0.0.1:1", b"1")
+        # the rejection reason is readable at \xff\xff/error_message
+        msg = await tr.get(b"\xff\xff/error_message")
+        assert b"SPECIAL_KEY_SPACE_ENABLE_WRITES" in msg
+        # read-only modules refuse writes even with the option on
+        tr.special_key_space_enable_writes = True
+        with pytest.raises(ClientInvalidOperation):
+            tr.set(b"\xff\xff/status/json", b"nope")
+        msg = await tr.get(b"\xff\xff/error_message")
+        assert b"not writable" in msg
+        await cluster.stop()
+    run_simulation(main())
+
+
+def test_unknown_special_key_rejected():
+    async def main():
+        cluster = Cluster(ClusterConfig(), Knobs())
+        cluster.start()
+        tr = Transaction(cluster)
+        with pytest.raises(ClientInvalidOperation):
+            await tr.get(b"\xff\xff/no_such_module")
+        await cluster.stop()
+    run_simulation(main())
+
+
+def test_cross_module_range_read():
+    """A range read spanning several modules returns each module's rows
+    in key order (the reference's cross-module read)."""
+    async def main():
+        cluster = Cluster(ClusterConfig(), Knobs())
+        cluster.start()
+        tr = Transaction(cluster)
+        tr.special_key_space_enable_writes = True
+        tr.set(PFX + b"10.0.0.7:1", b"1")
+        await tr.commit()
+        tr.reset()
+        rows = await tr.get_range(b"\xff\xff/", b"\xff\xff/z")
+        keys = [k for k, _v in rows]
+        assert PFX + b"10.0.0.7:1" in keys
+        assert keys == sorted(keys)
+        await cluster.stop()
+    run_simulation(main())
+
+
+def test_module_dispatch_longest_prefix():
+    sks = SpecialKeySpace()
+    m = sks.module_for(PFX + b"1.2.3.4:5")
+    assert isinstance(m, ExcludedServersModule)
+    assert sks.module_for(b"\xff\xff/status/json") is not None
+    assert sks.module_for(b"\xff\xff/bogus") is None
+
+
+def test_worker_interfaces_module_lists_roles():
+    """Against a view-backed client (sim cluster), worker_interfaces
+    lists the published role addresses."""
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        sim = SimulatedCluster(n_machines=4, n_coordinators=3)
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"\xff\xff/worker_interfaces/",
+                                  b"\xff\xff/worker_interfaces/\xff")
+        assert rows, "no worker interfaces listed"
+        assert all(k.startswith(b"\xff\xff/worker_interfaces/")
+                   for k, _ in rows)
+        await sim.stop()
+    run_simulation(main(), seed=3)

@@ -1,0 +1,136 @@
+"""The r5 workload batch (VERDICT r4 item 6): WriteDuringRead,
+FuzzApiCorrectness, SelectorCorrectness, Storefront,
+SpecialKeySpaceCorrectness, LowLatency, BackupToDBCorrectness (fast,
+in-process cluster) + Rollback, RandomMoveKeys, TagThrottle (simulated
+multi-machine cluster)."""
+
+import asyncio
+
+from foundationdb_tpu.workloads import run_workloads, run_workloads_on
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+def test_write_during_read_and_fuzz():
+    res = run_workloads([
+        {"testName": "WriteDuringRead", "rounds": 6, "opsPerRound": 20},
+        {"testName": "FuzzApiCorrectness", "calls": 80},
+        {"testName": "ConsistencyCheck"},
+    ], seed=5, client_count=2)
+    assert res["WriteDuringRead"]["ryw_checks"] > 0
+    assert res["FuzzApiCorrectness"]["fuzz_typed_errors"] > 0
+    assert res["FuzzApiCorrectness"]["fuzz_calls_ok"] > 0
+
+
+def test_selector_storefront_specialkeys():
+    res = run_workloads([
+        {"testName": "SelectorCorrectness", "keys": 16, "probes": 40},
+        {"testName": "Storefront", "orders": 15},
+        {"testName": "SpecialKeySpaceCorrectness", "rounds": 3},
+        {"testName": "ConsistencyCheck"},
+    ], seed=6, client_count=2)
+    assert res["SelectorCorrectness"]["selector_checks"] > 0
+    assert res["Storefront"]["orders_placed"] > 0
+    assert res["SpecialKeySpaceCorrectness"]["skx_rounds"] > 0
+
+
+def test_lowlatency():
+    res = run_workloads([
+        {"testName": "LowLatency", "seconds": 3.0, "maxLatency": 10.0},
+    ], seed=7, client_count=1)
+    assert res["LowLatency"]["latency_probes"] > 0
+
+
+def test_backup_to_db_switchover_sim():
+    """DR switchover mid-traffic: the destination (now primary) serves a
+    byte-identical copy.  Needs a coordinator-backed db (the DR tag
+    stream follows recoveries)."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        sim = SimulatedCluster(
+            n_machines=5, spec=ClusterConfigSpec(min_workers=5))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        res = await run_workloads_on(db, [
+            {"testName": "BackupToDBCorrectness"},
+        ], client_count=1)
+        await sim.stop()
+        return res
+
+    run_simulation(main(), seed=24)
+
+
+def test_rollback_workload_sim():
+    """Acked writes survive a TLog-machine kill mid-stream."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        sim = SimulatedCluster(
+            n_machines=6, durable_storage=True,
+            spec=ClusterConfigSpec(min_workers=6, replication=2))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        res = await run_workloads_on(db, [
+            {"testName": "Rollback", "sim": sim, "writes": 30,
+             "killAt": 12},
+            {"testName": "Cycle", "nodeCount": 8,
+             "transactionsPerClient": 15},
+        ], client_count=2)
+        await sim.stop()
+        return res
+
+    res = run_simulation(main(), seed=21)
+    assert res["Rollback"]["rollback_kills"] >= 1
+    assert res["Rollback"]["acked_writes"] > 0
+
+
+def test_random_move_keys_sim():
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        sim = SimulatedCluster(
+            Knobs().override(DD_ENABLED=True),
+            n_machines=6, spec=ClusterConfigSpec(min_workers=6,
+                                                 replication=2))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        res = await run_workloads_on(db, [
+            {"testName": "RandomMoveKeys", "sim": sim, "moves": 2,
+             "secondsBetweenMoves": 1.5},
+            {"testName": "Cycle", "nodeCount": 8,
+             "transactionsPerClient": 20},
+        ], client_count=2)
+        await sim.stop()
+        return res
+
+    res = run_simulation(main(), seed=22)
+    assert res["RandomMoveKeys"]["moves_requested"] >= 1
+
+
+def test_tag_throttle_sim():
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        sim = SimulatedCluster(
+            n_machines=5, spec=ClusterConfigSpec(min_workers=5))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        res = await run_workloads_on(db, [
+            {"testName": "TagThrottle", "sim": sim, "seconds": 4.0,
+             "tagRate": 3.0},
+        ], client_count=1)
+        await sim.stop()
+        return res
+
+    res = run_simulation(main(), seed=23)
+    assert res["TagThrottle"]["untagged_txns"] \
+        > res["TagThrottle"]["tagged_txns"]
