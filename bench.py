@@ -642,6 +642,8 @@ def main() -> int:
                     "ycsb_n_samples_tpu": c34["ycsb_tpu"]["n_samples"],
                     "ycsb_n_samples_cpp": c34["ycsb_cpp"]["n_samples"],
                     "ycsb_n_rows": c34["ycsb_cpp"]["n_rows"],
+                    "ycsb_n_clients_tpu": c34["ycsb_tpu"]["n_clients"],
+                    "ycsb_n_clients_cpp": c34["ycsb_cpp"]["n_clients"],
                     "ycsb_abort_codes_tpu": c34["ycsb_tpu"]["abort_codes"],
                     "ycsb_abort_codes_cpp": c34["ycsb_cpp"]["abort_codes"],
                     "tpcc_tpmC_tpu": rnd(c34["tpcc_tpu"]["tpmC"]),
@@ -654,6 +656,8 @@ def main() -> int:
                     "tpcc_abort_rate_cpp": rnd(c34["tpcc_cpp"]["abort_rate"], 3),
                     "tpcc_abort_codes_tpu": c34["tpcc_tpu"]["abort_codes"],
                     "tpcc_abort_codes_cpp": c34["tpcc_cpp"]["abort_codes"],
+                    "tpcc_n_clients_tpu": c34["tpcc_tpu"]["n_clients"],
+                    "tpcc_n_clients_cpp": c34["tpcc_cpp"]["n_clients"],
                 })
             except Exception as e:  # noqa: BLE001 — configs 3-4 are extras
                 out["configs34_error"] = repr(e)[:300]

@@ -142,6 +142,7 @@ async def run_tpcc_neworder(knobs: Knobs, n_warehouses: int = 2,
         "abort_codes": {str(c): n for c, n in sorted(abort_codes.items())},
         **latency_ms(latencies, (50, 99)),
         "elapsed_s": elapsed,
+        "n_clients": n_clients,
     }
 
 

@@ -123,6 +123,7 @@ async def run_ycsb_f(knobs: Knobs, n_rows: int = 100_000,
         **latency_ms(latencies, (50, 99)),
         "elapsed_s": elapsed,
         "n_rows": n_rows,
+        "n_clients": n_clients,
     }
 
 

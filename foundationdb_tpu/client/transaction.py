@@ -318,7 +318,7 @@ class Transaction:
         if len(begin) > self._knobs.KEY_SIZE_LIMIT \
                 or len(end) > self._knobs.KEY_SIZE_LIMIT:
             raise KeyTooLarge()
-        if end > b"\xff" and end.startswith(b"\xff\xff"):
+        if end.startswith(b"\xff\xff"):
             raise KeyOutsideLegalRange()
         self._writes.clear_range(begin, end)
         self._write_conflicts.append((begin, end))

@@ -1,0 +1,169 @@
+"""VersionedMap direct tests — the MVCC window structure under its r5
+incremental compaction (touched-queue) rewrite.
+
+The invariant under guard: every chain entry at or below a compaction
+target has a queued (version, key) record, so the incremental
+forget_before/drop_before reach exactly the same state as a full-map
+walk would — checked here against a brute-force model over random
+interleavings of set / clear_range / forget_before / drop_before /
+rollback_after."""
+
+import pytest
+
+from foundationdb_tpu.runtime.rng import DeterministicRandom
+from foundationdb_tpu.storage.versioned_map import VersionedMap
+
+
+class ModelMap:
+    """Brute force: full history, compacted by whole-map walks."""
+
+    def __init__(self):
+        self.chains: dict[bytes, list[tuple[int, bytes | None]]] = {}
+        self.oldest = 0
+        self.latest = 0
+
+    def set(self, version, key, value):
+        self.latest = version
+        c = self.chains.setdefault(key, [])
+        if c and c[-1][0] == version:
+            c[-1] = (version, value)
+        else:
+            c.append((version, value))
+
+    def clear_range(self, version, begin, end):
+        self.latest = version
+        for key in list(self.chains):
+            if begin <= key < end and self.chains[key][-1][1] is not None:
+                self.set(version, key, None)
+
+    def get2(self, key, version):
+        c = self.chains.get(key)
+        if not c:
+            return False, None
+        best = None
+        for v, val in c:
+            if v <= version:
+                best = (True, val)
+        return best if best else (False, None)
+
+    def forget_before(self, version):
+        if version <= self.oldest:
+            return
+        self.oldest = version
+        for key in list(self.chains):
+            c = self.chains[key]
+            i = len(c) - 1
+            while i > 0 and c[i][0] > version:
+                i -= 1
+            del c[:i]
+            if len(c) == 1 and c[0][1] is None and c[0][0] <= version:
+                del self.chains[key]
+
+    def drop_before(self, version):
+        if version <= self.oldest:
+            return
+        self.oldest = version
+        for key in list(self.chains):
+            c = [e for e in self.chains[key] if e[0] > version]
+            if c:
+                self.chains[key] = c
+            else:
+                del self.chains[key]
+
+    def rollback_after(self, version):
+        if version >= self.latest:
+            return
+        self.latest = version
+        for key in list(self.chains):
+            c = [e for e in self.chains[key] if e[0] <= version]
+            if c:
+                self.chains[key] = c
+            else:
+                del self.chains[key]
+
+
+def _assert_equal(vm: VersionedMap, model: ModelMap, version: int, keys):
+    for key in keys:
+        assert vm.get2(key, version) == model.get2(key, version), \
+            (key, version)
+    assert sorted(model.chains) == vm._index
+    for key, chain in model.chains.items():
+        assert vm._chains[key] == chain, key
+
+
+@pytest.mark.parametrize("seed,consumer", [(0, "forget"), (1, "forget"),
+                                           (2, "drop"), (3, "drop"),
+                                           (4, "mixed_rollback"),
+                                           (5, "mixed_rollback")])
+def test_versioned_map_matches_brute_force(seed, consumer):
+    rng = DeterministicRandom(seed)
+    vm, model = VersionedMap(), ModelMap()
+    keys = [b"k%02d" % i for i in range(12)]
+    version = 0
+    for step in range(300):
+        version += rng.random_int(1, 5)
+        op = rng.random_int(0, 10)
+        if op < 6:
+            k = keys[rng.random_int(0, len(keys))]
+            val = b"v%d" % step
+            vm.set(version, k, val)
+            model.set(version, k, val)
+        elif op < 8:
+            lo = rng.random_int(0, len(keys))
+            hi = rng.random_int(lo, len(keys) + 1)
+            vm.clear_range(version, keys[lo] if lo < len(keys) else b"z",
+                           keys[hi] if hi < len(keys) else b"z")
+            model.clear_range(version, keys[lo] if lo < len(keys) else b"z",
+                              keys[hi] if hi < len(keys) else b"z")
+        elif op == 8:
+            target = version - rng.random_int(0, 12)
+            if consumer == "forget":
+                vm.forget_before(target)
+                model.forget_before(target)
+            elif consumer == "drop":
+                vm.drop_before(target)
+                model.drop_before(target)
+            else:
+                back = version - rng.random_int(0, 6)
+                vm.rollback_after(back)
+                model.rollback_after(back)
+                version = max(version - 6, model.latest, vm.latest_version)
+                vm.forget_before(back - 8)
+                model.forget_before(back - 8)
+        else:
+            # reads at several historical versions
+            probe = version - rng.random_int(0, 15)
+            if probe >= vm.oldest_version:
+                for k in keys:
+                    assert vm.get2(k, probe) == model.get2(k, probe)
+        _assert_equal(vm, model, version, keys)
+    # final full compaction drains the touched queue and converges
+    if consumer == "drop":
+        vm.drop_before(version)
+        model.drop_before(version)
+    else:
+        vm.forget_before(version)
+        model.forget_before(version)
+    _assert_equal(vm, model, version + 1, keys)
+    assert not vm._touched, f"queue not drained: {len(vm._touched)}"
+
+
+def test_rollback_purges_stale_queue_records():
+    """A rollback must not leave higher-version queue records parking
+    the incremental compaction (r5 review finding)."""
+    vm = VersionedMap()
+    vm.set(10, b"a", b"1")
+    vm.set(120, b"a", b"2")      # unacked suffix
+    vm.set(120, b"b", b"x")
+    vm.rollback_after(100)       # recovery cut
+    assert all(v <= 100 for v, _k in vm._touched)
+    # new generation writes at lower-than-rolled-back versions
+    vm.set(106, b"b", b"y")
+    vm.set(107, b"a", b"3")
+    vm.forget_before(106)
+    # the v=10 entry for "a" must be gone (folded into the base)
+    assert vm._chains[b"a"] == [(10, b"1"), (107, b"3")] or \
+        vm._chains[b"a"] == [(107, b"3")]
+    vm.forget_before(110)
+    assert vm._chains[b"a"] == [(107, b"3")]
+    assert vm._chains[b"b"] == [(106, b"y")]
