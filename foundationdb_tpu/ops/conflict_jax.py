@@ -4,28 +4,29 @@ Replaces REF:fdbserver/SkipList.cpp (ConflictBatch::detectConflicts) with a
 vectorized interval-overlap check compiled by XLA.  Second-generation
 design, shaped by measured axon-TPU behavior (bench/profile_kernel*.py):
 
-- **Lane-major doubled ring.**  History lives on device as
-  ``hb/he: [L, 2C] uint32`` — key lanes in sublanes, ring slots in the
-  minor (lane) dimension, so the [B,R,W]-shaped window compares tile the
-  VPU fully (the old ``[C, L]`` row-major layout left 120/128 lanes idle
-  and was ~15x slower).  The ring is stored twice (slot i also at i+C) so
-  any window of W slots is one contiguous ``lax.dynamic_slice`` — no
-  gather.
-- **Append-only slabs.**  Every batch consumes a contiguous slab of
-  B*R slots via two ``dynamic_update_slice`` writes (no scatter): lanes
-  that insert nothing carry the sentinel interval [S, S) — which overlaps
-  nothing — but still carry the batch's commit version, keeping the
-  ring's version sequence dense so the window fast-path edge test stays
-  sound.  Overwriting a slab raises the too-old ``floor`` to the
-  overwritten versions' max: history older than the evicted batch is
-  gone, so snapshots preceding it must get TOO_OLD (the same safe
-  fallback as setOldestVersion compaction,
+- **Lane-major CANONICAL ring (r5).**  History lives on device as
+  ``hb/he: [L, C] uint32`` — key lanes in sublanes, ring slots in the
+  minor dimension (the old ``[C, L]`` row-major layout left 120/128
+  lanes idle and was ~15x slower), kept oldest-first so every slice is
+  STATIC: appending is a shift-left + tail write, per-batch cost
+  independent of capacity (see ConflictState).  Lanes that insert
+  nothing carry the sentinel interval [S, S) — overlaps nothing — but
+  still carry the batch's commit version, keeping the ring version-dense
+  so the window fast-path edge test stays sound.  Evicted slots raise
+  the too-old ``floor`` to their max version: history older than the
+  eviction is gone, so snapshots preceding it must get TOO_OLD (the same
+  safe fallback as setOldestVersion compaction,
   REF:fdbserver/Resolver.actor.cpp).
-- **Fused multi-batch resolve.**  ``resolve_many`` scans K whole proxy
-  batches through the kernel in ONE device dispatch, threading the ring
-  through the scan.  On the axon tunnel a device round-trip costs ~64ms
-  real RTT; fusing + async readback amortize it away (K batches = one
-  dispatch, one verdict readback).
+- **Hot/cold fused multi-batch resolve (r5).**  ``resolve_many`` runs K
+  whole proxy batches in ONE device dispatch: the scan carries only a
+  small hot staging buffer (window seed + the group's slabs) while the
+  big cold ring stays static and is appended once per dispatch — pad
+  batches dropped.  On the axon tunnel a device round-trip costs ~64ms
+  real RTT; fusing + async readback amortize it away.
+- **Point-equality kernel (r5).**  When a group AND the whole ring are
+  point ranges [k, k+nul) (tracked host-side; the common OLTP shape),
+  the interval tests collapse to a lane-equality rule proven
+  bit-identical (_point_pair_rule) — ~4x fewer VPU ops per check.
 - **Bitmask commit resolution.**  The in-order intra-batch commit
   decision (txn i conflicts with committed j<i whose writes overlap its
   reads) is a fully unrolled scalar chain over uint32 bitmask words —
@@ -153,6 +154,50 @@ def _hist_check_T(rb, re, hbT, heT, hver, snap, width):
     return (hit & newer).any(axis=(1, 2))
 
 
+def _point_pair_rule(data_eq, la, lb, width):
+    """Point-range overlap reduced to an equality rule — BIT-IDENTICAL to
+    the interval path for point ranges [k, k+\\x00): with equal data
+    lanes, two points conflict iff their length lanes match, or one is
+    exactly ``width`` and the other the truncation marker ``width+1``
+    (the interval path's both-truncated conservatism).  Unequal data
+    lanes order strictly, so the interval test rejects them just as the
+    equality does.  Sentinels (0xFFFFFFFF length) never conflict."""
+    S = jnp.uint32(SENTINEL_LANE)
+    w, w1 = jnp.uint32(width), jnp.uint32(width + 1)
+    valid = (la != S) & (lb != S)
+    same_len = la == lb
+    trunc_edge = (jnp.minimum(la, lb) == w) & (jnp.maximum(la, lb) == w1)
+    return data_eq & valid & (same_len | trunc_edge)
+
+
+def _point_hist_check_T(rb, hbT, hver, snap, width):
+    """All-point history check: reads [B,R,L] (point begins) vs the
+    transposed history BEGIN slab [L,W] -> conflict [B].  ~4x fewer lane
+    ops than the dual possibly_lt interval test; see _point_pair_rule
+    for the exact-equivalence argument."""
+    L = rb.shape[-1]
+    W = hbT.shape[-1]
+    eq = jnp.ones(rb.shape[:-1] + (W,), bool)
+    for l in range(L - 1):
+        eq = eq & (rb[..., l:l + 1] == hbT[l][None, None, :])
+    hit = _point_pair_rule(eq, rb[..., -1:], hbT[-1][None, None, :], width)
+    newer = hver[None, None, :] > snap[:, None, None]
+    return (hit & newer).any(axis=(1, 2))
+
+
+def _point_intra(read_begin, write_begin, width):
+    """All-point intra-batch matrix: reads of i vs writes of j -> [B,B]."""
+    B = read_begin.shape[0]
+    eq = jnp.ones(read_begin.shape[:2] + write_begin.shape[:2], bool)
+    L = read_begin.shape[-1]
+    for l in range(L - 1):
+        eq = eq & (read_begin[:, :, None, None, l]
+                   == write_begin[None, None, :, :, l])
+    m = _point_pair_rule(eq, read_begin[:, :, None, None, -1],
+                         write_begin[None, None, :, :, -1], width)
+    return m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
+
+
 # --------------------------------------------------------------------------
 # the sequential commit chain as a Pallas SMEM kernel (TPU only)
 
@@ -229,7 +274,7 @@ def _chain_pallas(packed, hist_conflict, ok, B: int, nw: int):
 
 def _batch_verdicts(read_begin, read_end, write_begin, write_end,
                     hist_conflict, too_old, valid, B: int,
-                    width: int, pallas: bool):
+                    width: int, pallas: bool, points: bool = False):
     """Steps 2-3 of a batch resolve, shared by the single-batch and fused
     group cores: intra-batch read-vs-write overlap matrix + in-order
     commit resolution.  Returns (verdicts [B] int8, committed [B] bool).
@@ -242,10 +287,14 @@ def _batch_verdicts(read_begin, read_end, write_begin, write_end,
     backends the unrolled uint32-word chain remains: both compute
     identical integers, so verdicts are bit-identical across backends
     (the parity gate)."""
-    m = _overlap(read_begin[:, :, None, None, :], read_end[:, :, None, None, :],
-                 write_begin[None, None, :, :, :], write_end[None, None, :, :, :],
-                 width)
-    M = m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
+    if points:
+        M = _point_intra(read_begin, write_begin, width)
+    else:
+        m = _overlap(read_begin[:, :, None, None, :],
+                     read_end[:, :, None, None, :],
+                     write_begin[None, None, :, :, :],
+                     write_end[None, None, :, :, :], width)
+        M = m.any(axis=(1, 3)) & ~jnp.eye(B, dtype=bool)
 
     nw = (B + 31) // 32
     Bpad = nw * 32
@@ -293,7 +342,8 @@ def _slab_from_writes(write_begin, write_end, committed, S_: int, L: int):
 
 def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
                  write_end, snap, commit_version, *, width: int = DEFAULT_WIDTH,
-                 window: int = 0, pallas: bool = False):
+                 window: int = 0, pallas: bool = False,
+                 points: bool = False):
     """One resolve step: (state, batch) -> (state', verdicts[B] int8).
 
     Pure traceable core shared by the single-chip jit (``resolve_step``)
@@ -321,7 +371,14 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
     too_old = snap < state.floor
     valid = snap >= 0
 
-    # 1. reads vs device history ring -> [B]
+    # 1. reads vs device history ring -> [B].  ``points`` (all-point
+    # group over an all-point ring) swaps the interval test for the
+    # bit-equivalent equality rule — ~4x fewer lane ops.
+    def check(rb, re_, hbT, heT, hv):
+        if points:
+            return _point_hist_check_T(rb, hbT, hv, snap, width)
+        return _hist_check_T(rb, re_, hbT, heT, hv, snap, width)
+
     if window and window < C:
         hbW = state.hb[:, C - window:]
         heW = state.he[:, C - window:]
@@ -333,19 +390,18 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
         fast_ok = jnp.all(~valid | too_old | (snap >= v_edge))
         hist_conflict = lax.cond(
             fast_ok,
-            lambda _: _hist_check_T(read_begin, read_end, hbW, heW, hvW,
-                                    snap, width),
-            lambda _: _hist_check_T(read_begin, read_end, state.hb,
-                                    state.he, state.hver, snap, width),
+            lambda _: check(read_begin, read_end, hbW, heW, hvW),
+            lambda _: check(read_begin, read_end, state.hb, state.he,
+                            state.hver),
             None)
     else:
-        hist_conflict = _hist_check_T(read_begin, read_end, state.hb,
-                                      state.he, state.hver, snap, width)
+        hist_conflict = check(read_begin, read_end, state.hb, state.he,
+                              state.hver)
 
     # 2-3. intra-batch overlap + in-order commit chain
     verdicts, committed = _batch_verdicts(
         read_begin, read_end, write_begin, write_end,
-        hist_conflict, too_old, valid, B, width, pallas)
+        hist_conflict, too_old, valid, B, width, pallas, points)
 
     # 4. append the batch's slab: shift the canonical ring left by S_ and
     # write the slab at the (static) tail.  Evicting the S_ oldest slots
@@ -370,7 +426,7 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
 def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
                       write_end, snap, commit_versions, *,
                       width: int = DEFAULT_WIDTH, window: int = 0,
-                      pallas: bool = False):
+                      pallas: bool = False, points: bool = False):
     """K fused batches in one dispatch: inputs [K,B,R,L] / [K,B] / [K].
 
     Hot/cold structure (r5): the big ring ("cold") stays STATIC for the
@@ -405,7 +461,7 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
             rb, re, wb, we, sn, cv = x
             st2, verdicts = resolve_core(st, rb, re, wb, we, sn, cv,
                                          width=width, window=window,
-                                         pallas=pallas)
+                                         pallas=pallas, points=points)
             return st2, verdicts
 
         return lax.scan(body, state, (read_begin, read_end, write_begin,
@@ -439,20 +495,25 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
         winv = lax.dynamic_slice(hotv, (off,), (W + 1,))
         fast_ok = jnp.all(~valid | too_old | (sn >= winv[0]))
 
+        def hist(rb_, re_, hbT, heT, hv, sn_):
+            if points:
+                return _point_hist_check_T(rb_, hbT, hv, sn_, width)
+            return _hist_check_T(rb_, re_, hbT, heT, hv, sn_, width)
+
         def fast(_):
-            return _hist_check_T(rb, re, winb, wine, winv[1:], sn, width)
+            return hist(rb, re, winb, wine, winv[1:], sn)
 
         def full(_):
             # cold ring (loop-invariant operand) + the whole hot buffer;
             # rows not yet written hold sentinel intervals (overlap
             # nothing), so checking past the batch's offset is harmless
-            return (_hist_check_T(rb, re, cold_hb, cold_he, cold_hver,
-                                  sn, width)
-                    | _hist_check_T(rb, re, hotb, hote, hotv, sn, width))
+            return (hist(rb, re, cold_hb, cold_he, cold_hver, sn)
+                    | hist(rb, re, hotb, hote, hotv, sn))
 
         hist_conflict = lax.cond(fast_ok, fast, full, None)
         verdicts, committed = _batch_verdicts(
-            rb, re, wb, we, hist_conflict, too_old, valid, B, width, pallas)
+            rb, re, wb, we, hist_conflict, too_old, valid, B, width,
+            pallas, points)
         is_pad = cv < 0
         slab_b, slab_e = _slab_from_writes(wb, we, committed, S_, L)
         lastv2 = jnp.where(is_pad, lastv, cv)
@@ -490,19 +551,20 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
 
 
 resolve_step = functools.partial(
-    jax.jit, static_argnames=("width", "window", "pallas"),
+    jax.jit, static_argnames=("width", "window", "pallas", "points"),
     donate_argnums=(0,))(resolve_core)
 resolve_many = functools.partial(
-    jax.jit, static_argnames=("width", "window", "pallas"),
+    jax.jit, static_argnames=("width", "window", "pallas", "points"),
     donate_argnums=(0,))(resolve_many_core)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("shape", "width", "window", "pallas"),
+                   static_argnames=("shape", "width", "window", "pallas",
+                                    "points"),
                    donate_argnums=(0,))
 def resolve_many_packed(state: ConflictState, pu32, pi64, *, shape,
                         width: int = DEFAULT_WIDTH, window: int = 0,
-                        pallas: bool = False):
+                        pallas: bool = False, points: bool = False):
     """resolve_many on single-buffer inputs.
 
     The axon tunnel moves one big transfer at ~150MB/s but many small ones
@@ -522,17 +584,18 @@ def resolve_many_packed(state: ConflictState, pu32, pi64, *, shape,
     sn = pi64[:K * B].reshape(K, B)
     cvs = pi64[K * B:]
     return resolve_many_core(state, rb, re, wb, we, sn, cvs,
-                             width=width, window=window, pallas=pallas)
+                             width=width, window=window, pallas=pallas,
+                             points=points)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("shape", "width", "window", "compact",
-                                    "pallas"),
+                                    "pallas", "points"),
                    donate_argnums=(0, 1))
 def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
                      pi64, *, shape, width: int = DEFAULT_WIDTH,
                      window: int = 0, compact: bool = False,
-                     pallas: bool = False):
+                     pallas: bool = False, points: bool = False):
     """resolve_many on dictionary-compressed inputs.
 
     The device keeps every recently-seen range endpoint's lane row in a
@@ -572,18 +635,19 @@ def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
     sn = pi64[:K * B].reshape(K, B)
     cvs = pi64[K * B:]
     st, verdicts = resolve_many_core(state, rb, re, wb, we, sn, cvs,
-                                     width=width, window=window)
+                                     width=width, window=window,
+                                     pallas=pallas, points=points)
     return st, dct2, verdicts
 
 
 @functools.partial(jax.jit,
                    static_argnames=("shape", "width", "window", "compact",
-                                    "U", "pallas"),
+                                    "U", "pallas", "points"),
                    donate_argnums=(0, 1))
 def resolve_many_fused(state: ConflictState, dct, fused, *, shape,
                        width: int = DEFAULT_WIDTH, window: int = 0,
                        compact: bool = False, U: int = 0,
-                       pallas: bool = False):
+                       pallas: bool = False, points: bool = False):
     """resolve_many_ids on ONE fused input buffer.
 
     The axon tunnel charges ~0.5ms fixed per device_put call on top of
@@ -631,8 +695,24 @@ def resolve_many_fused(state: ConflictState, dct, fused, *, shape,
     cvs = pi64[K * B:]
     st, verdicts = resolve_many_core(state, rb, re, wb, we, sn, cvs,
                                      width=width, window=window,
-                                     pallas=pallas)
+                                     pallas=pallas, points=points)
     return st, dct2, verdicts
+
+
+def _np_point_end(x: np.ndarray, width: int) -> np.ndarray:
+    """Host twin of _point_end for the lanes-path pointness probe."""
+    ll = x[..., -1]
+    sent = ll == np.uint32(0xFFFFFFFF)
+    newll = np.where(sent, ll, np.minimum(ll + 1, np.uint32(width + 1)))
+    return np.concatenate([x[..., :-1], newll[..., None]], axis=-1)
+
+
+def _eb_is_point(eb: EncodedBatch, width: int) -> bool:
+    """True iff every range in the batch is a point [k, k+nul) — ~us of
+    numpy per batch, the gate for the equality-rule kernel."""
+    return bool(
+        np.array_equal(eb.read_end, _np_point_end(eb.read_begin, width))
+        and np.array_equal(eb.write_end, _np_point_end(eb.write_begin, width)))
 
 
 def _point_end(x, width):
@@ -707,6 +787,10 @@ class JaxConflictSet:
         self._dct = None                # [L, D] device lane dictionary
         self._init_floor = oldest_version
         self._slab = None
+        # True while every record in the ring is a point range: gates the
+        # equality-rule kernel (points=...); any range-bearing dispatch
+        # clears it until the next ring reset
+        self._ring_all_point = True
 
     def _ensure_state(self, B: int, R: int) -> None:
         if self.state is not None:
@@ -744,6 +828,7 @@ class JaxConflictSet:
         if self.device is not None:
             state = jax.device_put(state, self.device)
         self.state = state
+        self._ring_all_point = True
 
     def set_oldest_version(self, v: int) -> None:
         if self.state is None:
@@ -779,12 +864,16 @@ class JaxConflictSet:
         self._ensure_state(B, R)
         # jax.device_put stays asynchronous on the axon tunnel where
         # jnp.asarray blocks ~RTT per array once the session is degraded
+        pts = self._ring_all_point and _eb_is_point(eb, self.width)
+        use_points = pts
+        self._ring_all_point = self._ring_all_point and pts
         put = functools.partial(jax.device_put, device=self.device)
         self.state, verdicts = resolve_step(
             self.state, put(eb.read_begin), put(eb.read_end),
             put(eb.write_begin), put(eb.write_end),
             put(eb.read_snapshot), jnp.int64(commit_version),
-            width=self.width, window=self.window, pallas=self._pallas)
+            width=self.width, window=self.window, pallas=self._pallas,
+            points=use_points)
         self._start_d2h(verdicts)
         return verdicts
 
@@ -819,10 +908,15 @@ class JaxConflictSet:
         for i, e in enumerate(ebs):
             pi64[i * B:(i + 1) * B] = e.read_snapshot
         pi64[K * B:K * B + k] = commit_versions
+        pts = self._ring_all_point \
+            and all(_eb_is_point(e, self.width) for e in ebs)
+        use_points = pts
+        self._ring_all_point = self._ring_all_point and pts
         put = functools.partial(jax.device_put, device=self.device)
         self.state, verdicts = resolve_many_packed(
             self.state, put(pu32), put(pi64), shape=(K, B, R, L),
-            width=self.width, window=self.window, pallas=self._pallas)
+            width=self.width, window=self.window, pallas=self._pallas,
+            points=use_points)
         self._start_d2h(verdicts)
         return verdicts
 
@@ -877,12 +971,17 @@ class JaxConflictSet:
         # next group (begin_group clears them) while this dispatch's
         # device_put may still be staging asynchronously — a view would
         # alias the mutation and ship corrupted updates
+        # compact proves the GROUP is all-point (the native encoder's
+        # detection); the equality kernel also needs an all-point RING
+        use_points = compact and self._ring_all_point
+        self._ring_all_point = self._ring_all_point and compact
         self.state, self._dct, verdicts = resolve_many_ids(
             self.state, self._dct, put(ids),
             put(np.array(upd_slots[:U], copy=True)),
             put(np.array(upd_lanes[:, :U], copy=True)),
             put(pi64), shape=(K, B, R, L), width=self.width,
-            window=self.window, compact=compact, pallas=self._pallas)
+            window=self.window, compact=compact, pallas=self._pallas,
+            points=use_points)
         self._start_d2h(verdicts)
         return verdicts
 
@@ -895,11 +994,13 @@ class JaxConflictSet:
         K, B, R = shape
         self._ensure_state(B, R)
         L = keycode.nlanes(self.width)
+        use_points = compact and self._ring_all_point
+        self._ring_all_point = self._ring_all_point and compact
         dev = jax.device_put(fused, self.device)
         self.state, self._dct, verdicts = resolve_many_fused(
             self.state, self._dct, dev, shape=(K, B, R, L),
             width=self.width, window=self.window, compact=compact, U=U,
-            pallas=self._pallas)
+            pallas=self._pallas, points=use_points)
         self._start_d2h(verdicts)
         return verdicts
 

@@ -147,3 +147,61 @@ def test_group_submit_matches_serial():
         np.testing.assert_array_equal(np.asarray(grouped.state.hb),
                                       np.asarray(serial.state.hb))
         assert int(serial.state.floor) == int(grouped.state.floor)
+
+
+def test_point_equality_kernel_parity():
+    """All-point groups over an all-point ring take the equality-rule
+    kernel (r5); verdicts must stay bit-identical to the numpy twin's
+    interval path — including keys at the truncation boundary (exactly
+    W bytes vs longer keys sharing the W-byte prefix)."""
+    from foundationdb_tpu.ops.conflict_jax import _eb_is_point
+
+    rng = DeterministicRandom(31)
+    capacity = B * R * 16
+    twin = NumpyConflictSet(capacity, W)
+    kern = JaxConflictSet(capacity, W, window=B * R * 4)
+
+    def point(k):
+        return (k, k + b"\x00")
+
+    pool = [b"p%02d" % i for i in range(10)]
+    pool += [b"x" * W, b"x" * W + b"tail", b"x" * W + b"liat",
+             b"x" * (W - 1), b"y" * (W + 4)]
+    version = 100
+    for step in range(30):
+        nt = rng.random_int(1, B + 1)
+        txns = []
+        for _ in range(nt):
+            reads = [point(pool[rng.random_int(0, len(pool))])
+                     for _ in range(rng.random_int(0, R + 1))]
+            writes = [point(pool[rng.random_int(0, len(pool))])
+                      for _ in range(rng.random_int(0, R + 1))]
+            txns.append(TxnRequest(reads, writes,
+                                   rng.random_int(max(0, version - 50),
+                                                  version + 1)))
+        version += rng.random_int(1, 20)
+        eb = encode_batch(txns, B, R, W)
+        assert _eb_is_point(eb, W)
+        tv = twin.resolve_encoded(eb, version)
+        jv = kern.resolve_encoded(eb, version)
+        np.testing.assert_array_equal(tv, jv, err_msg=f"step {step}")
+        np.testing.assert_array_equal(twin.hver, np.asarray(kern.state.hver))
+    assert kern._ring_all_point     # the fast path actually engaged
+
+
+def test_range_dispatch_clears_point_ring_flag():
+    kern = JaxConflictSet(B * R * 8, W)
+    pt = encode_batch([TxnRequest([(b"a", b"a\x00")], [(b"a", b"a\x00")],
+                                  90)], B, R, W)
+    kern.resolve_encoded(pt, 100)
+    assert kern._ring_all_point
+    rg = encode_batch([TxnRequest([(b"a", b"c")], [(b"a", b"c")], 105)],
+                      B, R, W)
+    assert int(kern.resolve_encoded(rg, 110)[0]) == 0   # committed
+    assert not kern._ring_all_point
+    # still correct afterwards (interval path resumes)
+    v = kern.resolve_encoded(encode_batch(
+        [TxnRequest([(b"b", b"b\x00")], [], 105)], B, R, W), 120)
+    assert int(v[0]) == 1       # read b at snap 105 vs range write at 110
+    kern.reset_ring(0)
+    assert kern._ring_all_point
