@@ -864,9 +864,8 @@ class JaxConflictSet:
         self._ensure_state(B, R)
         # jax.device_put stays asynchronous on the axon tunnel where
         # jnp.asarray blocks ~RTT per array once the session is degraded
-        pts = self._ring_all_point and _eb_is_point(eb, self.width)
-        use_points = pts
-        self._ring_all_point = self._ring_all_point and pts
+        use_points = self._ring_all_point = \
+            self._ring_all_point and _eb_is_point(eb, self.width)
         put = functools.partial(jax.device_put, device=self.device)
         self.state, verdicts = resolve_step(
             self.state, put(eb.read_begin), put(eb.read_end),
@@ -908,10 +907,8 @@ class JaxConflictSet:
         for i, e in enumerate(ebs):
             pi64[i * B:(i + 1) * B] = e.read_snapshot
         pi64[K * B:K * B + k] = commit_versions
-        pts = self._ring_all_point \
+        use_points = self._ring_all_point = self._ring_all_point \
             and all(_eb_is_point(e, self.width) for e in ebs)
-        use_points = pts
-        self._ring_all_point = self._ring_all_point and pts
         put = functools.partial(jax.device_put, device=self.device)
         self.state, verdicts = resolve_many_packed(
             self.state, put(pu32), put(pi64), shape=(K, B, R, L),
@@ -940,6 +937,11 @@ class JaxConflictSet:
         snaps = np.full((K, B), -1, dtype=np.int64)
         for i, e in enumerate(ibs):
             snaps[i] = e.read_snapshot
+        # this legacy path carries no pointness proof (slot ids reveal
+        # nothing about the ranges behind them), so the dispatch runs the
+        # interval kernel and — soundly — clears the ring's all-point
+        # flag via compact=False in resolve_group_submit_ids; callers
+        # wanting the point fast path use the compact-detecting encoder
         return self.resolve_group_submit_ids(ids, snaps, (K, B, R),
                                              commit_versions, upd_slots,
                                              upd_lanes, n_upd)
