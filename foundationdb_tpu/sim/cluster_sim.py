@@ -222,6 +222,10 @@ class SimulatedCluster:
                      for p in state["commit_proxies"] + state["grv_proxies"]}
         if state.get("ratekeeper"):
             role_ips.add(state["ratekeeper"]["addr"][0])
+        # coordinator protection derives from the CURRENT quorum — a
+        # changeQuorum mid-run moves it, and the boot-time per-machine
+        # flag would protect a retired member while exposing a new one
+        coord_ips = {a.ip for a in self.coord_addrs}
         return [m for m in self.machines
-                if not m.is_coordinator and m.ip not in storage_ips
+                if m.ip not in coord_ips and m.ip not in storage_ips
                 and m.ip in role_ips]
