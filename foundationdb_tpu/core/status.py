@@ -91,6 +91,26 @@ async def cluster_status(knobs: Knobs, transport: Transport,
         r.pop("end", None)
 
     healthy = all(r["reachable"] for r in roles)
+
+    # cluster-wide apply-path rollup (the r5 bench collapse was an
+    # apply-throughput regression no metric surfaced; status now carries
+    # the storage roles' batched-apply counters so the next one is a
+    # falling mutations_per_sec / rising apply_batch_max_ms, not a
+    # timeout): sums over counters, max over worst-case latencies
+    storage_metrics = [r.get("metrics") for r in roles
+                       if r["role"] == "storage" and r.get("metrics")]
+    apply_rollup = {
+        "mutations_applied": sum(
+            m.get("mutations_applied", 0) for m in storage_metrics),
+        "mutations_per_sec": round(sum(
+            m.get("mutations_per_sec", 0.0) for m in storage_metrics), 1),
+        "index_merge_ms": round(sum(
+            m.get("index_merge_ms", 0.0) for m in storage_metrics), 3),
+        "apply_batch_max_ms": max(
+            (m.get("apply_batch_max_ms", 0.0) for m in storage_metrics),
+            default=0.0),
+    }
+
     return {
         "cluster": {
             "epoch": state["epoch"],
@@ -99,6 +119,7 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             "degraded_roles": [
                 {"role": r["role"], "addr": r["addr"]}
                 for r in roles if not r["reachable"]],
+            "storage_apply": apply_rollup,
         },
         "roles": roles,
         "shards": {

@@ -13,8 +13,12 @@ from __future__ import annotations
 
 import asyncio
 
+import time
+
 from ..runtime.errors import FutureVersion, TransactionTooOld
 from ..runtime.knobs import Knobs
+from ..runtime.latency_probe import StageStats
+from ..runtime.profiler import RateMeter
 from ..storage.kv_store import OP_CLEAR, OP_SET
 from ..storage.versioned_map import VersionedMap
 from .data import KeyRange, Mutation, MutationType, Version, apply_atomic
@@ -80,12 +84,36 @@ class StorageServer:
         from ..runtime.trace import CounterCollection
         self.counters = CounterCollection("StorageMetrics", str(tag))
         self._metrics_task = None
+        # apply-path observability (the r5 bench collapse was invisible
+        # until a SlowTask fired; these make the next regression a
+        # metric, not a timeout): per-batch apply timing + batch sizes
+        # via StageStats, mutation throughput via a RateMeter, and the
+        # index's merge counters read off the vmap
+        # cap 4096: summary() sorts the retained samples on every
+        # ratekeeper/status poll — keep that O(small), and the ring
+        # rotates ~minutes of trailing apply history at load
+        self.apply_stats = StageStats(f"storage-apply-{tag}", cap=4096)
+        self.apply_meter = RateMeter("mutations_applied")
+        self.apply_batch_size_max = 0
 
     async def metrics(self) -> dict:
         """Queue/lag sample for the Ratekeeper (StorageQueuingMetrics
         analog, REF:fdbserver/storageserver.actor.cpp)."""
+        apply_ms = self.apply_stats.summary().get("apply_batch", {})
+        meter = self.apply_meter.snapshot()
+        idx = self.vmap.index_stats()
         return {
             "tag": self.tag,
+            "mutations_applied": meter["count"],
+            "mutations_per_sec": meter["per_sec"],
+            "apply_batches": meter["batches"],
+            "apply_batch_size_mean": meter["mean_batch"],
+            "apply_batch_size_max": self.apply_batch_size_max,
+            "apply_batch_p99_ms": apply_ms.get("p99_ms", 0.0),
+            "apply_batch_max_ms": apply_ms.get("max_ms", 0.0),
+            "index_keys": idx["keys"],
+            "index_merges": idx["merges"],
+            "index_merge_ms": idx["merge_ms"],
             "durable_engine": self.engine is not None,
             "queue_bytes": self.bytes_input - self.bytes_durable,
             "version": self.version,
@@ -121,6 +149,8 @@ class StorageServer:
             c.counter("BytesDurable").value = self.bytes_durable
             c.counter("FinishedQueries").value = self.total_reads
             c.counter("Version").value = self.version
+            c.counter("MutationsApplied").value = self.apply_meter.count
+            c.counter("IndexMerges").value = self.vmap.index_stats()["merges"]
             c.log_metrics()
 
     async def stop(self) -> None:
@@ -229,12 +259,14 @@ class StorageServer:
                     await asyncio.sleep(0.1)
                     continue
                 raise
+            page: list[tuple[Version, int, bytes, bytes]] = []
             for k, val in kvs:
                 k, val = bytes(k), bytes(val)
-                self.vmap.set(v, k, val)
+                page.append((v, OP_SET, k, val))
                 self.logical_bytes += len(k) + len(val)
                 if self.engine is not None:
                     self._durability_buffer.append((v, (OP_SET, k, val)))
+            self.vmap.apply_batch(page)    # one index merge per page
             rows_total += len(kvs)
             if not more or not kvs:
                 break
@@ -309,8 +341,25 @@ class StorageServer:
                 # peeks span generations after recoveries
                 from ..runtime.rng import deterministic_random
                 await asyncio.sleep(deterministic_random().random() * 0.1)
-            for version, mutations in reply.entries:
-                self._apply(version, mutations)
+            # apply in bounded slices, yielding between them: a bulk
+            # load's reply can carry 100k+ mutations and one synchronous
+            # pass is a multi-100ms event-loop stall.  Versions are never
+            # split across slices, so readers at any intermediate version
+            # see a consistent prefix (the seed bumped per version too).
+            entries = reply.entries
+            cap = self.knobs.STORAGE_APPLY_CHUNK_MUTATIONS
+            i = 0
+            while i < len(entries):
+                chunk = [entries[i]]
+                nm = len(entries[i][1])
+                i += 1
+                while i < len(entries) and nm + len(entries[i][1]) <= cap:
+                    chunk.append(entries[i])
+                    nm += len(entries[i][1])
+                    i += 1
+                self._apply_batch(chunk)
+                if i < len(entries):
+                    await asyncio.sleep(0)
             if reply.end_version - 1 > self.version:
                 self._bump_version(reply.end_version - 1)
             if self.engine is None:
@@ -447,43 +496,80 @@ class StorageServer:
                 raise WrongShardServer()
 
     def _apply(self, version: Version, mutations: list[Mutation]) -> None:
+        """Single-version apply — thin wrapper over the batched path."""
+        self._apply_batch([(version, mutations)])
+
+    def _apply_batch(self,
+                     entries: list[tuple[Version, list[Mutation]]]) -> None:
+        """Apply a whole TLog pull reply — every (version, mutations)
+        pair — in ONE pass (REF: storageserver.actor.cpp::update applies
+        a full peek reply per wait too).
+
+        Plain sets and clears accumulate into one ``vmap.apply_batch``
+        call so fresh keys hit the key index as a single sorted merge
+        instead of a per-key insert (the r5 O(n²) collapse).  Ops that
+        need to OBSERVE state — atomics (read latest value) and
+        PRIVATE_DROP_SHARD (range-scan the handed-off rows) — flush the
+        pending run first, so they see exactly the sequential state."""
+        if not entries:
+            return
+        t0 = time.perf_counter()
         durable = self.engine is not None
-        for m in mutations:
-            if m.type == MutationType.PRIVATE_DROP_SHARD:
-                self._drop_shard(version, m.param1, m.param2)
-                continue
-            self.bytes_input += len(m.param1) + len(m.param2)
-            if m.type == MutationType.SET_VALUE:
-                self.logical_bytes += len(m.param1) + len(m.param2)
-                self.vmap.set(version, m.param1, m.param2)
-                if durable:
-                    self._durability_buffer.append(
-                        (version, (OP_SET, m.param1, m.param2)))
-                self._fire_watches(m.param1, m.param2)
-            elif m.type == MutationType.CLEAR_RANGE:
-                self.vmap.clear_range(version, m.param1, m.param2)
-                if durable:
-                    self._durability_buffer.append(
-                        (version, (OP_CLEAR, m.param1, m.param2)))
-                self._fire_watch_range(m.param1, m.param2)
-            else:
-                # atomics resolve against the latest value (window or
-                # engine) and store as plain sets/clears downstream
-                existing = self._get_latest(m.param1)
-                new = apply_atomic(m.type, existing, m.param2)
-                if new is None:
-                    self.vmap.clear_range(version, m.param1, m.param1 + b"\x00")
+        vops: list[tuple[Version, int, bytes, bytes]] = []
+        nmut = 0
+
+        def flush() -> None:
+            nonlocal vops
+            if vops:
+                self.vmap.apply_batch(vops)
+                vops = []
+
+        for version, mutations in entries:
+            for m in mutations:
+                if m.type == MutationType.PRIVATE_DROP_SHARD:
+                    flush()
+                    self._drop_shard(version, m.param1, m.param2)
+                    continue
+                nmut += 1
+                self.bytes_input += len(m.param1) + len(m.param2)
+                if m.type == MutationType.SET_VALUE:
+                    self.logical_bytes += len(m.param1) + len(m.param2)
+                    vops.append((version, OP_SET, m.param1, m.param2))
                     if durable:
                         self._durability_buffer.append(
-                            (version, (OP_CLEAR, m.param1, m.param1 + b"\x00")))
-                    self._fire_watches(m.param1, None)
+                            (version, (OP_SET, m.param1, m.param2)))
+                    self._fire_watches(m.param1, m.param2)
+                elif m.type == MutationType.CLEAR_RANGE:
+                    vops.append((version, OP_CLEAR, m.param1, m.param2))
+                    if durable:
+                        self._durability_buffer.append(
+                            (version, (OP_CLEAR, m.param1, m.param2)))
+                    self._fire_watch_range(m.param1, m.param2)
                 else:
-                    self.vmap.set(version, m.param1, new)
-                    if durable:
-                        self._durability_buffer.append(
-                            (version, (OP_SET, m.param1, new)))
-                    self._fire_watches(m.param1, new)
-        self._bump_version(version)
+                    # atomics resolve against the latest value (window or
+                    # engine) and store as plain sets/clears downstream
+                    flush()
+                    existing = self._get_latest(m.param1)
+                    new = apply_atomic(m.type, existing, m.param2)
+                    if new is None:
+                        end = m.param1 + b"\x00"
+                        vops.append((version, OP_CLEAR, m.param1, end))
+                        if durable:
+                            self._durability_buffer.append(
+                                (version, (OP_CLEAR, m.param1, end)))
+                        self._fire_watches(m.param1, None)
+                    else:
+                        vops.append((version, OP_SET, m.param1, new))
+                        if durable:
+                            self._durability_buffer.append(
+                                (version, (OP_SET, m.param1, new)))
+                        self._fire_watches(m.param1, new)
+        flush()
+        self._bump_version(entries[-1][0])
+        self.apply_stats.record("apply_batch", time.perf_counter() - t0)
+        self.apply_meter.add(nmut)
+        if nmut > self.apply_batch_size_max:
+            self.apply_batch_size_max = nmut
 
     def _bump_version(self, version: Version) -> None:
         if version <= self.version:

@@ -176,6 +176,38 @@ def encode_keys(keys: list[bytes], width: int = DEFAULT_WIDTH) -> np.ndarray:
     return out
 
 
+def prefix_u64(key: bytes) -> int:
+    """First 8 key bytes big-endian, zero-padded — lanes 0-1 of
+    ``encode_key`` fused into one uint64.  Monotone: a <= b implies
+    prefix_u64(a) <= prefix_u64(b), so a searchsorted over an array of
+    these narrows any exact bisect to the equal-prefix band."""
+    return int.from_bytes(key[:8].ljust(8, b"\x00"), "big")
+
+
+def encode_prefix_u64(keys: list[bytes]) -> np.ndarray:
+    """Vectorized ``prefix_u64`` over a sorted (or any) key list →
+    uint64[N].  Used by storage/key_index.py as the searchsorted fast
+    path for range bounds over large key indexes — the storage-side
+    cousin of the resolver's ``encode_keys`` lane packing."""
+    n = len(keys)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    lens = np.fromiter((len(k) for k in keys), dtype=np.int64, count=n)
+    flat = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    offs = np.empty(n + 1, dtype=np.int64)
+    offs[0] = 0
+    np.cumsum(lens, out=offs[1:])
+    starts = offs[:-1]
+    plens = np.minimum(lens, 8)
+    buf = np.zeros((n, 8), dtype=np.uint8)
+    cols = np.arange(8)[None, :]
+    mask = cols < plens[:, None]
+    # clip keeps the flat index in range for masked-out (padding) cells
+    src = np.minimum(starts[:, None] + cols, max(len(flat) - 1, 0))
+    buf[mask] = flat[src[mask]]
+    return buf.view(">u8").ravel().astype(np.uint64)
+
+
 def decode_trunc_flag(enc: np.ndarray, width: int = DEFAULT_WIDTH):
     """True where the encoded key was truncated (len lane == W+1)."""
     return enc[..., -1] == width + 1

@@ -68,6 +68,11 @@ class Knobs:
     STORAGE_DURABILITY_LAG: float = 0.25      # seconds between making versions durable
     STORAGE_FUTURE_VERSION_WAIT: float = 1.0  # read wait before future_version
     FETCH_KEYS_BYTES_PER_BATCH: int = 1 << 20
+    # max mutations one synchronous _apply_batch slice may hold: a bulk
+    # load's pull reply can carry 100k+ mutations, and applying them in
+    # one event-loop turn is a ~100-500ms stall (SlowTask); the pull
+    # loop yields between slices, never splitting a version
+    STORAGE_APPLY_CHUNK_MUTATIONS: int = 32768
 
     # --- transaction limits (REF:fdbclient/ClientKnobs, Limits in docs) ---
     KEY_SIZE_LIMIT: int = 10_000

@@ -28,7 +28,7 @@ from .trace import TraceEvent
 class StageStats:
     """Bounded per-stage duration accumulator (seconds in, ms out)."""
 
-    __slots__ = ("name", "_samples", "_count", "_sum", "cap")
+    __slots__ = ("name", "_samples", "_count", "_sum", "_max", "cap")
 
     def __init__(self, name: str, cap: int = 65536) -> None:
         self.name = name
@@ -36,22 +36,38 @@ class StageStats:
         self._samples: dict[str, list[float]] = {}
         self._count: dict[str, int] = {}
         self._sum: dict[str, float] = {}
+        # running max, tracked OUTSIDE the bounded sample list: a stall
+        # arriving after the cap fills must still move max_ms (the whole
+        # point of the apply-path consumer)
+        self._max: dict[str, float] = {}
 
     def record(self, stage: str, seconds: float) -> None:
         s = self._samples.setdefault(stage, [])
-        self._count[stage] = self._count.get(stage, 0) + 1
+        n = self._count.get(stage, 0)
+        self._count[stage] = n + 1
         self._sum[stage] = self._sum.get(stage, 0.0) + seconds
+        if seconds > self._max.get(stage, 0.0):
+            self._max[stage] = seconds
+        # ring overwrite, not first-N: percentiles must track the
+        # TRAILING cap samples on a long-lived role, or a regression
+        # arriving after the reservoir fills never moves p50/p99
         if len(s) < self.cap:
             s.append(seconds)
+        else:
+            s[n % self.cap] = seconds
 
     def reset(self) -> None:
         self._samples.clear()
         self._count.clear()
         self._sum.clear()
+        self._max.clear()
 
     def summary(self) -> dict[str, dict[str, float]]:
-        """{stage: {n, mean_ms, p50_ms, p99_ms}} — percentiles over the
-        (bounded) retained samples, mean over everything recorded."""
+        """{stage: {n, mean_ms, p50_ms, p99_ms, max_ms}} — percentiles
+        over the (bounded) retained samples, mean over everything
+        recorded.  ``max_ms`` names the worst single sample — the
+        apply-path consumer wants the longest event-loop occupancy, not
+        just the p99 (one 900ms index merge IS the r5 incident)."""
         out: dict[str, dict[str, float]] = {}
         for stage, s in self._samples.items():
             if not s:
@@ -64,6 +80,7 @@ class StageStats:
                 "p50_ms": round(xs[len(xs) // 2] * 1e3, 3),
                 "p99_ms": round(xs[min(len(xs) - 1,
                                        int(len(xs) * 0.99))] * 1e3, 3),
+                "max_ms": round(self._max[stage] * 1e3, 3),
             }
         return out
 
@@ -83,6 +100,9 @@ def merge_summaries(summaries: list[dict]) -> dict[str, dict[str, float]]:
                                     + row["mean_ms"] * row["n"]) / n, 3)
             cur["p50_ms"] = max(cur["p50_ms"], row["p50_ms"])
             cur["p99_ms"] = max(cur["p99_ms"], row["p99_ms"])
+            if "max_ms" in cur or "max_ms" in row:
+                cur["max_ms"] = max(cur.get("max_ms", 0.0),
+                                    row.get("max_ms", 0.0))
             cur["n"] = n
     return out
 

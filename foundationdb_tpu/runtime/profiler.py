@@ -28,6 +28,53 @@ from .knobs import Knobs
 from .trace import TraceEvent
 
 
+class RateMeter:
+    """Hot-path throughput counter: total count, batch count, and
+    wall-clock rate — no locks, no per-event timestamps, safe to bump
+    from the apply path at millions of events/sec.  The storage role
+    uses one for ``mutations_applied`` so an apply-throughput regression
+    (the r5 O(n²) index collapse) shows up as a falling rate in status
+    instead of a bench timeout."""
+
+    _WINDOW_S = 5.0
+
+    __slots__ = ("name", "count", "batches", "_t0", "_m0", "_m1")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.count = 0
+        self.batches = 0
+        self._t0 = time.monotonic()
+        # rolling window marks (time, count): per_sec is measured against
+        # a 5-10s trailing mark, NOT a per-reader delta — multiple pollers
+        # (ratekeeper, status) would otherwise shrink each other's window
+        # to nothing, and a lifetime average would dilute a stall on a
+        # long-lived server to noise
+        self._m0 = (self._t0, 0)
+        self._m1 = (self._t0, 0)
+
+    def add(self, n: int) -> None:
+        self.count += n
+        self.batches += 1
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        if now - self._m1[0] >= self._WINDOW_S:
+            self._m0 = self._m1
+            self._m1 = (now, self.count)
+        t0, c0 = self._m0
+        recent = (self.count - c0) / max(now - t0, 1e-9)
+        return {
+            "count": self.count,
+            "batches": self.batches,
+            "per_sec": round(recent, 1),
+            "per_sec_lifetime":
+                round(self.count / max(now - self._t0, 1e-9), 1),
+            "mean_batch": round(self.count / self.batches, 1)
+            if self.batches else 0.0,
+        }
+
+
 class SlowTaskProfiler:
     """Watchdog for one asyncio event loop (the production loop)."""
 

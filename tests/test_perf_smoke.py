@@ -1,0 +1,30 @@
+"""Tier-1 apply-throughput guard (tools/perf_smoke.py as a normal test).
+
+100k fresh keys through StorageServer._apply_batch inside a generous
+wall budget: the r5 O(n²) VersionedMap index collapse would blow this by
+an order of magnitude, so the next quadratic apply path fails CI here
+instead of timing out the north-star bench with no summary line."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import perf_smoke
+
+
+def test_apply_throughput_smoke():
+    perf_smoke.check(n_keys=100_000, budget_s=perf_smoke.DEFAULT_BUDGET_S)
+
+
+def test_apply_metrics_surface():
+    """The apply path must publish its observability counters — a silent
+    regression is the other half of the r5 incident."""
+    elapsed, metrics = perf_smoke.storage_apply_seconds(n_keys=5_000)
+    assert metrics["mutations_applied"] == 5_000
+    assert metrics["apply_batches"] == 3          # ceil(5000/2048)
+    assert metrics["apply_batch_size_max"] == 2048
+    assert metrics["index_keys"] == 5_000
+    assert metrics["apply_batch_p99_ms"] >= 0.0
+    assert metrics["mutations_per_sec"] > 0

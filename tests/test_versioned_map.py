@@ -86,7 +86,7 @@ def _assert_equal(vm: VersionedMap, model: ModelMap, version: int, keys):
     for key in keys:
         assert vm.get2(key, version) == model.get2(key, version), \
             (key, version)
-    assert sorted(model.chains) == vm._index
+    assert sorted(model.chains) == vm.keys()
     for key, chain in model.chains.items():
         assert vm._chains[key] == chain, key
 
@@ -146,6 +146,163 @@ def test_versioned_map_matches_brute_force(seed, consumer):
         model.forget_before(version)
     _assert_equal(vm, model, version + 1, keys)
     assert not vm._touched, f"queue not drained: {len(vm._touched)}"
+
+
+# --- apply_batch: batched apply must be state-identical to the loop ---
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_apply_batch_matches_sequential(seed):
+    """Property: apply_batch over any chunking of a version-ordered op
+    stream reaches EXACTLY the state (chains, index, touched queue,
+    oldest/latest) the sequential set/clear_range loop reaches, with
+    compactions interleaved between chunks."""
+    from foundationdb_tpu.storage.versioned_map import OP_CLEAR, OP_SET
+    rng = DeterministicRandom(seed)
+    seq, bat, model = VersionedMap(), VersionedMap(), ModelMap()
+    keys = [b"k%02d" % i for i in range(14)]
+    version = 0
+    pending: list[tuple[int, int, bytes, bytes]] = []
+
+    def flush():
+        nonlocal pending
+        for v, op, p1, p2 in pending:
+            if op == OP_SET:
+                seq.set(v, p1, p2)
+                model.set(v, p1, p2)
+            else:
+                seq.clear_range(v, p1, p2)
+                model.clear_range(v, p1, p2)
+        bat.apply_batch(pending)
+        pending = []
+
+    for step in range(400):
+        version += rng.random_int(1, 4)
+        op = rng.random_int(0, 12)
+        if op < 7:
+            k = keys[rng.random_int(0, len(keys))]
+            pending.append((version, OP_SET, k, b"v%d" % step))
+        elif op < 9:
+            lo = rng.random_int(0, len(keys))
+            hi = rng.random_int(lo, len(keys) + 1)
+            pending.append((version, OP_CLEAR,
+                            keys[lo] if lo < len(keys) else b"z",
+                            keys[hi] if hi < len(keys) else b"z"))
+        elif op == 9:
+            flush()
+        elif op == 10 and rng.random_int(0, 2):
+            flush()
+            target = version - rng.random_int(0, 10)
+            for vm in (seq, bat):
+                vm.forget_before(target)
+            model.forget_before(target)
+        elif op == 11:
+            flush()
+            back = version - rng.random_int(0, 5)
+            for vm in (seq, bat):
+                vm.rollback_after(back)
+            model.rollback_after(back)
+            version = max(version, seq.latest_version)
+        if rng.random_int(0, 4) == 0:
+            flush()
+            assert seq._chains == bat._chains
+            assert seq.keys() == bat.keys()
+            assert list(seq._touched) == list(bat._touched)
+            assert (seq.oldest_version, seq.latest_version) == \
+                (bat.oldest_version, bat.latest_version)
+            _assert_equal(bat, model, version, keys)
+    flush()
+    assert seq._chains == bat._chains
+    assert seq.keys() == bat.keys()
+    assert list(seq._touched) == list(bat._touched)
+    _assert_equal(bat, model, version, keys)
+
+
+def test_apply_batch_clear_sees_fresh_keys():
+    """A clear_range later in the same batch must tombstone keys whose
+    index insert was deferred earlier in the batch."""
+    from foundationdb_tpu.storage.versioned_map import OP_CLEAR, OP_SET
+    vm = VersionedMap()
+    vm.apply_batch([
+        (1, OP_SET, b"a", b"1"),
+        (1, OP_SET, b"b", b"2"),
+        (2, OP_CLEAR, b"a", b"b"),      # must see the fresh b"a"
+        (3, OP_SET, b"a", b"3"),
+    ])
+    assert vm.get(b"a", 1) == b"1"
+    assert vm.get(b"a", 2) is None      # tombstoned by the clear
+    assert vm.get(b"a", 3) == b"3"
+    assert vm.get(b"b", 3) == b"2"
+    assert vm.keys() == [b"a", b"b"]
+
+
+def test_index_range_bounds_across_runs():
+    """Range bounds must merge the base run and the pending overlay
+    (fresh keys land in the overlay until the next merge)."""
+    from foundationdb_tpu.storage.versioned_map import OP_SET
+    vm = VersionedMap()
+    # force a base run, then overlay keys interleaved with it
+    vm.apply_batch([(1, OP_SET, b"k%03d" % i, b"x") for i in range(0, 100, 2)])
+    vm._index._merge()
+    vm.apply_batch([(2, OP_SET, b"k%03d" % i, b"y") for i in range(1, 100, 2)])
+    got, more = vm.range_read(b"k010", b"k020", 2)
+    assert [k for k, _ in got] == [b"k%03d" % i for i in range(10, 20)]
+    assert not more
+    assert len(vm) == 100
+
+
+def test_apply_batch_vectorized_clear_bounds():
+    """A run of consecutive clears over a large base resolves its bounds
+    through the numpy searchsorted fast path (base >= 16k keys, >= 8
+    ranges) — must match the sequential clear_range loop exactly."""
+    from foundationdb_tpu.storage.versioned_map import OP_CLEAR, OP_SET
+    n = 20_000
+    sets = [(1, OP_SET, b"k%06d" % (i * 3), b"x") for i in range(n)]
+    seq, bat = VersionedMap(), VersionedMap()
+    seq.apply_batch(sets)
+    bat.apply_batch(sets)
+    seq._index._merge()
+    bat._index._merge()
+    clears = [(2 + i, OP_CLEAR, b"k%06d" % (i * 700), b"k%06d" % (i * 700 + 350))
+              for i in range(24)]
+    for v, _op, b, e in clears:
+        seq.clear_range(v, b, e)
+    bat.apply_batch(clears)
+    assert seq._chains == bat._chains
+    assert seq.keys() == bat.keys()
+    assert list(seq._touched) == list(bat._touched)
+    assert seq.latest_version == bat.latest_version
+
+
+@pytest.mark.slow
+def test_apply_batch_scales_near_linearly():
+    """The O(n²) guard: 1M fresh keys through apply_batch must land in
+    seconds (the seed bisect.insort path took minutes — the r5 bench
+    collapse) and scale near-linearly from 100k to 1M."""
+    import time
+
+    from foundationdb_tpu.storage.versioned_map import OP_SET
+
+    def load_seconds(n: int, chunk: int = 4096) -> float:
+        vm = VersionedMap()
+        # multiplicative hash → distinct, insertion-order-random keys
+        ks = [b"u%010d" % ((i * 2654435761) % (1 << 33)) for i in range(n)]
+        t0 = time.perf_counter()
+        v = 0
+        for s in range(0, n, chunk):
+            v += 1
+            vm.apply_batch([(v, OP_SET, k, b"x" * 16)
+                            for k in ks[s:s + chunk]])
+        dt = time.perf_counter() - t0
+        assert len(vm) == len(set(ks))
+        return dt
+
+    t_small = load_seconds(100_000)
+    t_big = load_seconds(1_000_000)
+    # seed path: ~1M O(n) memmove inserts ≈ minutes.  Batched path must
+    # stay in seconds (≥50x), and within ~3x of linear 100k→1M scaling.
+    assert t_big < 30.0, f"1M-key apply took {t_big:.1f}s"
+    assert t_big < max(t_small, 0.05) * 30, \
+        f"non-linear scaling: 100k={t_small:.2f}s 1M={t_big:.2f}s"
 
 
 def test_rollback_purges_stale_queue_records():

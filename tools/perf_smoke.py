@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Apply-path throughput smoke — the tier-1 guard against the next O(n²).
+
+The r5 bench collapse (BENCH_r05.json, rc 124) was a quadratic index
+insert in the storage apply path that no test caught: tier-1 runs small
+maps, the bench loads 1M rows, and nothing in between measured apply
+throughput.  This check fills the gap at tier-1 cost: 100k fresh keys
+through ``StorageServer._apply_batch`` must land well inside a generous
+wall-clock budget (seconds where the seed path took ~a minute and scaled
+quadratically beyond it).
+
+Run directly:  python tools/perf_smoke.py [-n 100000] [--budget 10]
+Run in CI:     wired as tests/test_perf_smoke.py (a normal tier-1 test).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_KEYS = 100_000
+DEFAULT_BUDGET_S = 10.0     # measured ~0.5s on a loaded 1-cpu host
+
+
+def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
+                          batch: int = 2048) -> tuple[float, dict]:
+    """Seconds to push ``n_keys`` fresh-key SETs through the storage
+    server's batched apply path, plus the server's apply metrics."""
+    from foundationdb_tpu.core.data import KeyRange, Mutation
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    async def main() -> tuple[float, dict]:
+        knobs = Knobs()
+        ss = StorageServer(knobs, 0, KeyRange(b"", b"\xff"), TLog(knobs))
+        # multiplicative-hash ids: distinct keys, random insertion order
+        # (sorted arrival would hide a quadratic insert's memmove cost)
+        keys = [b"smoke%010d" % ((i * 2654435761) % (1 << 33))
+                for i in range(n_keys)]
+        value = b"x" * 64
+        elapsed = 0.0
+        version = 0
+        for start in range(0, n_keys, batch):
+            version += 1
+            muts = [Mutation.set(k, value)
+                    for k in keys[start:start + batch]]
+            t0 = time.perf_counter()
+            ss._apply_batch([(version, muts)])
+            elapsed += time.perf_counter() - t0
+        metrics = await ss.metrics()
+        assert len(ss.vmap) == len(set(keys)), "apply lost keys"
+        return elapsed, metrics
+
+    return asyncio.run(main())
+
+
+def check(n_keys: int = DEFAULT_KEYS, budget_s: float = DEFAULT_BUDGET_S,
+          quiet: bool = False) -> float:
+    """Run the smoke; raises AssertionError past the budget."""
+    elapsed, metrics = storage_apply_seconds(n_keys)
+    if not quiet:
+        print(f"[perf_smoke] {n_keys} fresh keys applied in {elapsed:.3f}s "
+              f"({n_keys / elapsed / 1e3:.0f}k keys/s), "
+              f"index merges={metrics['index_merges']} "
+              f"({metrics['index_merge_ms']:.1f}ms), "
+              f"apply max={metrics['apply_batch_max_ms']:.1f}ms")
+    assert elapsed < budget_s, (
+        f"apply-path throughput regression: {n_keys} fresh keys took "
+        f"{elapsed:.1f}s (budget {budget_s:.0f}s) — the last time this "
+        f"shape went quadratic it was bisect.insort per key (r5)")
+    return elapsed
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
+    ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
+    args = ap.parse_args()
+    check(args.keys, args.budget)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
