@@ -10,10 +10,12 @@ retryability and resets, commit makes the txn immutable until reset.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 
 from ..core.cluster import Cluster
 from ..core.data import (CommitTransactionRequest, KeySelector, MutationType,
                          Version, key_after)
+from ..runtime import span as _span
 from ..runtime.errors import (CommitUnknownResult, FdbError, InvalidOption,
                               KeyOutsideLegalRange, KeyTooLarge,
                               RequestMaybeDelivered, TransactionCancelled,
@@ -21,6 +23,35 @@ from ..runtime.errors import (CommitUnknownResult, FdbError, InvalidOption,
                               UsedDuringCommit, ValueTooLarge)
 from ..runtime.rng import deterministic_random
 from .writemap import WriteMap
+
+# client-side span events for sampled transactions (the NativeAPI.*
+# locations of the reference's debugTransaction)
+_SPANS = _span.SpanSink("client")
+
+
+@contextlib.contextmanager
+def _hop(ctx: _span.SpanContext | None, evtype: str = "",
+         base: str = "", **details):
+    """Activate a child span of the txn's root for one client→role hop;
+    the active context rides the RPC envelope (rpc/transport.py) so the
+    serving role's span events key to this transaction.  With a
+    ``base`` location, emits ``{base}.Before`` on entry and pairs it
+    with ``{base}.Error`` if the hop raises (the success site emits its
+    own ``.After`` with result details) — the analyzer's consecutive-
+    pair stats need every Before closed."""
+    with _span.child_scope(ctx) as child:
+        if child is None:
+            yield None
+            return
+        if base:
+            _SPANS.event(evtype, child, base + ".Before", **details)
+        try:
+            yield child
+        except BaseException as e:
+            if base:
+                _SPANS.event(evtype, child, base + ".Error",
+                             Error=type(e).__name__)
+            raise
 
 
 class Transaction:
@@ -68,6 +99,7 @@ class Transaction:
         if tb is not None and getattr(self, "_probe_id", None) is not None:
             tb.discard(self._probe_id)
         self._probe_id: int | None = None
+        self._span: _span.SpanContext | None = None
         self._special_error: bytes | None = None
 
     def _check_mutable(self) -> None:
@@ -92,15 +124,23 @@ class Transaction:
     async def _fetch_read_version(self) -> Version:
         # TraceBatch latency probe (REF:flow/Trace.h TraceBatch): a
         # sampled fraction of transactions carry per-stage probes
-        # from GRV through commit, flushed as one TransactionTrace
+        # from GRV through commit, flushed as one TransactionTrace.
+        # The same counter-based sampling decision roots the distributed
+        # span (no extra RNG draw: seeded sim streams are unperturbed)
         tb = getattr(self._cluster, "trace_batch", None)
         if tb is not None and self._probe_id is None:
             Transaction._probe_counter += 1
             if tb.attach(Transaction._probe_counter):
                 self._probe_id = Transaction._probe_counter
+                self._span = _span.new_root(Transaction._probe_counter)
         proxy = deterministic_random().choice(self._cluster.grv_proxies)
-        self._read_version = await proxy.get_read_version(
-            self.lock_aware, self.priority, self.throttle_tag)
+        with _hop(self._span, "TransactionDebug",
+                  "NativeAPI.getReadVersion") as h:
+            self._read_version = await proxy.get_read_version(
+                self.lock_aware, self.priority, self.throttle_tag)
+            _SPANS.event("TransactionDebug", h,
+                         "NativeAPI.getReadVersion.After",
+                         Version=self._read_version)
         if self._probe_id is not None and tb is not None:
             tb.event(self._probe_id, "grv")
         return self._read_version
@@ -125,7 +165,10 @@ class Transaction:
             return payload
         if not snapshot:
             self._read_conflicts.append((key, key_after(key)))
-        base = await self._cluster.storage_for_key(key).get_value(key, version)
+        with _hop(self._span, "TransactionDebug", "NativeAPI.get") as h:
+            base = await self._cluster.storage_for_key(key).get_value(
+                key, version)
+            _SPANS.event("TransactionDebug", h, "NativeAPI.get.After")
         if kind == "stack":
             return WriteMap.fold_with_base(payload, base)
         return base
@@ -163,7 +206,10 @@ class Transaction:
             end = await self.get_key(end, snapshot=True)
         if begin >= end:
             return []
-        out = await self._merged_range(begin, end, limit, reverse)
+        with _hop(self._span, "TransactionDebug", "NativeAPI.getRange") as h:
+            out = await self._merged_range(begin, end, limit, reverse)
+            _SPANS.event("TransactionDebug", h, "NativeAPI.getRange.After",
+                         Rows=len(out))
         if not snapshot:
             # conflict range covers what was actually observed: the whole
             # requested range if exhausted, else up to the last-seen key
@@ -406,7 +452,11 @@ class Transaction:
                 tb0 = getattr(self._cluster, "trace_batch", None)
                 if tb0 is not None:
                     tb0.flush(self._probe_id, "read_only")
+                _SPANS.event("CommitDebug", self._span,
+                             "NativeAPI.commit.ReadOnly",
+                             Version=self._committed_version)
                 self._probe_id = None
+                self._span = None
             return self._committed_version
         if self._writes.bytes > self._knobs.TRANSACTION_SIZE_LIMIT:
             raise TransactionTooLarge()
@@ -424,7 +474,11 @@ class Transaction:
         self._committing = True
         try:
             proxy = deterministic_random().choice(self._cluster.commit_proxies)
-            result = await proxy.commit(req)
+            with _hop(self._span, "CommitDebug", "NativeAPI.commit",
+                      Mutations=len(req.mutations)) as h:
+                result = await proxy.commit(req)
+                _SPANS.event("CommitDebug", h, "NativeAPI.commit.After",
+                             Version=result.version)
         except RequestMaybeDelivered:
             # the commit reached the proxy but its reply was lost: the
             # outcome is unknown and retrying blindly could double-commit
@@ -432,12 +486,18 @@ class Transaction:
                 tb.event(self._probe_id, "commit_done")
                 tb.flush(self._probe_id, "unknown_result")
                 self._probe_id = None
+            _SPANS.event("CommitDebug", self._span,
+                         "NativeAPI.commit.UnknownResult")
+            self._span = None
             raise CommitUnknownResult() from None
         except BaseException:
             if self._probe_id is not None and tb is not None:
                 tb.event(self._probe_id, "commit_done")
                 tb.flush(self._probe_id, "aborted")
                 self._probe_id = None
+            # no extra event: the _hop already paired the commit hop
+            # with NativeAPI.commit.Error
+            self._span = None
             raise
         finally:
             self._committing = False
@@ -445,6 +505,7 @@ class Transaction:
             tb.event(self._probe_id, "commit_done")
             tb.flush(self._probe_id, "committed")
             self._probe_id = None
+        self._span = None
         self._committed_version = result.version
         self._versionstamp = result.versionstamp
         self._arm_watches(result.version)

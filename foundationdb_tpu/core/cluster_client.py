@@ -36,6 +36,11 @@ class RecoveredClusterView:
         self.transport = transport
         self.epoch = -1
         self.seq = -1
+        # same sampled per-txn probes as the in-process Cluster: THIS is
+        # what roots distributed spans for clients of a real cluster —
+        # without it, attribution stopped at the wire (the ISSUE 2 gap)
+        from ..runtime.latency_probe import TraceBatch
+        self.trace_batch = TraceBatch(knobs.CLIENT_LATENCY_PROBE_SAMPLE)
         self.update(state)
 
     def update(self, state: dict) -> None:

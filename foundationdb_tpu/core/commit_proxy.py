@@ -19,6 +19,7 @@ import asyncio
 import struct
 
 from ..ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnRequest
+from ..runtime import span as _span
 from ..runtime.errors import (ClientInvalidOperation, ClusterVersionChanged,
                               CommitUnknownResult, NotCommitted,
                               TransactionTooOld)
@@ -110,6 +111,9 @@ class CommitProxy:
         # per-stage commit-path breakdown (VERDICT r4 1a): batch_fill /
         # version_wait / resolve / push, read by bench harnesses
         self.stages = StageStats("CommitProxy")
+        # CommitDebug span events for sampled txns: queued / batch
+        # milestones / reply, keyed by the wire-propagated trace id
+        self.spans = _span.SpanSink("CommitProxy")
         self._metrics_task = None
         # fail-stop (see _repair_chain): once set, new commits are refused
         # and the role-liveness ping probes dead, driving an epoch recovery
@@ -257,18 +261,35 @@ class CommitProxy:
         # forever; their outcome is genuinely unknown (broken promise)
         from ..runtime.errors import RequestMaybeDelivered
         while not self._queue.empty():
-            _, fut, _t = self._queue.get_nowait()
+            _, fut, _t, _ctx = self._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(RequestMaybeDelivered())
 
     # --- client-facing ---
+
+    async def metrics(self) -> dict:
+        """Role counters for status (span rollup + commit load)."""
+        return {
+            "total_batches": self.total_batches,
+            "total_committed": self.total_committed,
+            "total_conflicts": self.total_conflicts,
+            **self.spans.counters(),
+        }
 
     async def commit(self, req: CommitTransactionRequest) -> CommitResult:
         if self._failed is not None:
             raise ClusterVersionChanged() from self._failed
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._queue.put_nowait((req, fut, loop.time()))
+        # capture the wire-propagated span NOW: the batch runs in its own
+        # task later, where the request context is long gone
+        ctx = _span.current_span()
+        if ctx is not None and ctx.sampled:
+            self.spans.event("CommitDebug", ctx,
+                             "CommitProxyServer.commit.queued")
+        else:
+            ctx = None
+        self._queue.put_nowait((req, fut, loop.time(), ctx))
         return await fut
 
     # --- batching (REF: commitBatcher) ---
@@ -361,16 +382,18 @@ class CommitProxy:
     # --- the pipeline (REF: commitBatch) ---
 
     async def _commit_batch(self, batch: list[tuple[CommitTransactionRequest,
-                                                    asyncio.Future, float]]
+                                                    asyncio.Future, float,
+                                                    object]]
                             ) -> None:
         # Pre-validate anything that could raise during tagging (malformed
         # versionstamp offsets) BEFORE a version is assigned, so a bad
         # request fails alone instead of wedging the version chain.
         now = asyncio.get_running_loop().time()
-        for _req, _fut, t_enq in batch:
+        for _req, _fut, t_enq, _ctx in batch:
             self.stages.record("batch_fill", now - t_enq)
-        valid: list[tuple[CommitTransactionRequest, asyncio.Future]] = []
-        for req, fut, _t in batch:
+        valid: list[tuple[CommitTransactionRequest, asyncio.Future,
+                          _span.SpanContext | None]] = []
+        for req, fut, _t, ctx in batch:
             try:
                 if is_state_txn(req):
                     check_state_txn_reads(req)
@@ -406,8 +429,12 @@ class CommitProxy:
                         raise ClientInvalidOperation(
                             "private mutation type in client commit")
                     self._substitute_versionstamp(m, 0, 0)
-                valid.append((req, fut))
+                valid.append((req, fut, ctx))
             except Exception as pre_err:
+                # pair the .queued event for a pre-validation reject
+                self.spans.event("CommitDebug", ctx,
+                                 "CommitProxyServer.commitBatch.Rejected",
+                                 Error=type(pre_err).__name__)
                 if not fut.done():
                     from ..runtime.errors import DatabaseLocked
                     fut.set_exception(
@@ -415,8 +442,20 @@ class CommitProxy:
                         else ClientInvalidOperation())
         if not valid:
             return
-        reqs = [r for r, _ in valid]
-        futs = [f for _, f in valid]
+        reqs = [r for r, _, _ in valid]
+        futs = [f for _, f, _ in valid]
+        ctxs = [c for _, _, c in valid]
+        # sampled txns riding this batch; downstream hops (resolver, TLog
+        # push) key to the FIRST — extra sampled txns keep their
+        # proxy-level milestones but lose per-hop spans (counted dropped)
+        sampled = [c for c in ctxs if c is not None]
+        batch_ctx = sampled[0] if sampled else None
+        if len(sampled) > 1:
+            self.spans.drop(len(sampled) - 1)
+        for c in sampled:
+            self.spans.event("CommitDebug", c,
+                             "CommitProxyServer.commitBatch.Before",
+                             Txns=len(reqs))
         batch_began = asyncio.get_running_loop().time()
         prev_version = version = None
         resolved = pushed = push_started = False
@@ -427,6 +466,10 @@ class CommitProxy:
             t0 = loop.time()
             prev_version, version = await self.sequencer.get_commit_version()
             self.stages.record("version_wait", loop.time() - t0)
+            for c in sampled:
+                self.spans.event("CommitDebug", c,
+                                 "CommitProxyServer.commitBatch."
+                                 "GotCommitVersion", Version=version)
             txns = [TxnRequest(r.read_conflict_ranges, r.write_conflict_ranges,
                                r.read_snapshot) for r in reqs]
             state_txns = None
@@ -446,9 +489,18 @@ class CommitProxy:
                                         state_txns,
                                         self.state_applied_version))
             t0 = loop.time()
-            replies = await asyncio.gather(*(ask(r) for r in self.resolvers))
+            # the resolver hop inherits a child span via the contextvar:
+            # gather's tasks copy the active context at creation, so the
+            # (possibly remote) resolvers see the sampled trace
+            with _span.child_scope(batch_ctx):
+                replies = await asyncio.gather(
+                    *(ask(r) for r in self.resolvers))
             self.stages.record("resolve", loop.time() - t0)
             resolved = True
+            for c in sampled:
+                self.spans.event("CommitDebug", c,
+                                 "CommitProxyServer.commitBatch."
+                                 "AfterResolution", Version=version)
 
             # AND the verdicts: TOO_OLD dominates, then CONFLICT
             final = [COMMITTED] * len(reqs)
@@ -514,9 +566,14 @@ class CommitProxy:
 
             push_started = True
             t0 = loop.time()
-            await self.log_system.push(prev_version, version, tagged)
+            with _span.child_scope(batch_ctx):
+                await self.log_system.push(prev_version, version, tagged)
             self.stages.record("push", loop.time() - t0)
             pushed = True
+            for c in sampled:
+                self.spans.event("CommitDebug", c,
+                                 "CommitProxyServer.commitBatch."
+                                 "AfterLogPush", Version=version)
             self.sequencer.report_committed(version)
 
             self.total_batches += 1
@@ -526,6 +583,11 @@ class CommitProxy:
             for i, fut in enumerate(futs):
                 if fut.done():
                     continue
+                self.spans.event("CommitDebug", ctxs[i],
+                                 "CommitProxyServer.commitBatch.Reply",
+                                 Version=version,
+                                 Committed=bool(final[i] == COMMITTED
+                                                and i not in locked_out))
                 if i in locked_out:
                     from ..runtime.errors import DatabaseLocked
                     fut.set_exception(DatabaseLocked())
@@ -552,6 +614,10 @@ class CommitProxy:
             TraceEvent("CommitBatchFailed", severity=30) \
                 .detail("Version", version).detail("Resolved", resolved) \
                 .detail("Pushed", pushed).detail("Error", repr(e)[:200]).log()
+            for c in sampled:
+                self.spans.event("CommitDebug", c,
+                                 "CommitProxyServer.commitBatch.Error",
+                                 Version=version, Error=type(e).__name__)
             # once any TLog may hold the batch, the outcome is ambiguous:
             # clients must see commit_unknown_result (maybe-committed), not
             # a freely-retryable transport error that would double-apply

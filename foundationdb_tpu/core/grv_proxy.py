@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 
 from ..runtime.knobs import Knobs
+from ..runtime.span import SpanSink, current_span, no_span
 from .data import Version
 from .sequencer import Sequencer
 
@@ -21,27 +22,57 @@ class GrvProxy:
         self.knobs = knobs
         self.sequencer = sequencer
         self.ratekeeper = ratekeeper
-        # (future, lock_aware, priority, tag)
-        self._waiters: list[tuple[asyncio.Future, bool, str,
-                                  str | None]] = []
+        # (future, lock_aware, priority, tag, span_ctx)
+        self._waiters: list[tuple] = []
         self._batch_task: asyncio.Task | None = None
         self.total_grvs = 0
         from ..runtime.latency_probe import StageStats
         # grv_wait: request arrival -> version handed back (VERDICT r4 1a)
         self.stages = StageStats("GrvProxy")
+        # TransactionDebug span events for sampled requests (the
+        # GrvProxyServer.queued/reply locations of the reference)
+        self.spans = SpanSink("GrvProxy")
+        self.sampled_txns = 0
+
+    async def metrics(self) -> dict:
+        """Role counters for status (span rollup + GRV load)."""
+        return {
+            "total_grvs": self.total_grvs,
+            "sampled_txns": self.sampled_txns,
+            **self.spans.counters(),
+        }
 
     async def get_read_version(self, lock_aware: bool = False,
                                priority: str = "default",
                                tag: str | None = None) -> Version:
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._waiters.append((fut, lock_aware, priority, tag))
+        ctx = current_span()
+        if ctx is not None and ctx.sampled:
+            self.sampled_txns += 1
+            self.spans.event("TransactionDebug", ctx,
+                             "GrvProxyServer.queued", Priority=priority)
+        else:
+            ctx = None
+        self._waiters.append((fut, lock_aware, priority, tag, ctx))
         if self._batch_task is None or self._batch_task.done():
-            self._batch_task = loop.create_task(self._serve_batch(),
-                                                name="grv-batch")
+            # mask the request's span: this task outlives the request
+            # (it drains every later batch), and its sequencer/ratekeeper
+            # calls must not be attributed to whichever sampled txn
+            # happened to spawn it
+            with no_span():
+                self._batch_task = loop.create_task(self._serve_batch(),
+                                                    name="grv-batch")
         t0 = loop.time()
         try:
             return await fut
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            # pair the .queued event when the batch fails the waiter
+            self.spans.event("TransactionDebug", ctx,
+                             "GrvProxyServer.Error", Error=type(e).__name__)
+            raise
         finally:
             self.stages.record("grv_wait", loop.time() - t0)
 
@@ -86,7 +117,7 @@ class GrvProxy:
             version, lock_uid = \
                 await self.sequencer.get_live_committed_version()
             self.total_grvs += len(waiters)
-            for fut, lock_aware, _prio, _tag in waiters:
+            for fut, lock_aware, _prio, _tag, ctx in waiters:
                 if fut.done():
                     continue
                 if lock_uid is not None and not lock_aware:
@@ -94,9 +125,13 @@ class GrvProxy:
                     # GetReadVersionReply.locked → NativeAPI throws):
                     # an application still pointed at a switched-over
                     # primary must hear about it, not read stale data
+                    # (no reply span — get_read_version pairs .queued
+                    # with the .Error its waiter raises)
                     from ..runtime.errors import DatabaseLocked
                     fut.set_exception(DatabaseLocked())
                 else:
+                    self.spans.event("TransactionDebug", ctx,
+                                     "GrvProxyServer.reply", Version=version)
                     fut.set_result(version)
         except Exception as e:
             for fut, *_rest in waiters:

@@ -23,6 +23,7 @@ from ..ops.backends import (make_conflict_backend, resolve_begin,
 from ..ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnRequest
 from ..runtime.errors import ResolverFailed
 from ..runtime.knobs import Knobs
+from ..runtime.span import SpanSink, current_span, no_span
 from .data import KeyRange, Version
 
 
@@ -70,6 +71,8 @@ class Resolver:
         # commit-path breakdown (VERDICT r4 1a): chain_wait (version
         # ordering), submit (encode+dispatch), sync (device->host verdicts)
         self.stages = StageStats("Resolver")
+        # CommitDebug span events for sampled batches (wire-propagated)
+        self.spans = SpanSink("Resolver")
         self._poisoned: BaseException | None = None
         # committed state transactions this epoch, in version order.  Kept
         # whole: state txns are rare (shard moves, config changes) and the
@@ -92,6 +95,15 @@ class Resolver:
         self._inflight_groups: list[asyncio.Future] = []
         self._last_submitted_version: Version = epoch_begin_version
         self.group_sizes: list[int] = []    # batches per fused dispatch
+
+    async def metrics(self) -> dict:
+        """Role counters for status (span rollup + resolve load)."""
+        return {
+            "total_batches": self.total_batches,
+            "total_txns": self.total_txns,
+            "total_conflicts": self.total_conflicts,
+            **self.spans.counters(),
+        }
 
     async def _wait_for_version(self, prev_version: Version) -> None:
         if self.version >= prev_version:
@@ -129,6 +141,24 @@ class Resolver:
         if buggify("resolver_slow_batch"):
             from ..runtime.rng import deterministic_random
             await asyncio.sleep(deterministic_random().random() * 0.02)
+        span_ctx = current_span()
+        self.spans.event("CommitDebug", span_ctx,
+                         "Resolver.resolveBatch.Before",
+                         Version=req.version, Txns=len(req.txns))
+        try:
+            return await self._resolve_impl(req, span_ctx)
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            # close the span: a poisoned/failed batch must not leave an
+            # unpaired .Before in the analyzer's segment stats
+            self.spans.event("CommitDebug", span_ctx,
+                             "Resolver.resolveBatch.Error",
+                             Version=req.version, Error=type(e).__name__)
+            raise
+
+    async def _resolve_impl(self, req: ResolveBatchRequest,
+                            span_ctx) -> ResolveBatchReply:
         loop = asyncio.get_running_loop()
         t0 = loop.time()
         await self._wait_for_version(req.prev_version)
@@ -137,7 +167,7 @@ class Resolver:
             # poisoned while this batch was parked in the version queue
             raise ResolverFailed() from self._poisoned
         if self._fuse:
-            return await self._resolve_fused(req, loop)
+            return await self._resolve_fused(req, loop, span_ctx)
         finish = None
         try:
             # Split-phase resolve: the submit updates conflict history (on
@@ -184,6 +214,11 @@ class Resolver:
         self.total_batches += 1
         self.total_txns += len(req.txns)
         self.total_conflicts += sum(1 for v in verdicts if v != COMMITTED)
+        self.spans.event("CommitDebug", span_ctx,
+                         "Resolver.resolveBatch.After",
+                         Version=req.version,
+                         Conflicts=sum(1 for v in verdicts
+                                       if v != COMMITTED))
         entries = [(v, m) for v, m in self._state_log
                    if req.state_known_version < v <= req.version]
         return ResolveBatchReply(verdicts, entries or None)
@@ -191,7 +226,7 @@ class Resolver:
     # --- adaptive group fusion path (r5) ---
 
     async def _resolve_fused(self, req: ResolveBatchRequest,
-                             loop) -> ResolveBatchReply:
+                             loop, span_ctx=None) -> ResolveBatchReply:
         """Enqueue the batch for the group dispatcher.  The version chain
         advances at ENQUEUE time (submission order = enqueue order, kept
         by the FIFO dispatcher), so later batches pipeline behind this one
@@ -204,8 +239,11 @@ class Resolver:
         if not req.state_txns:
             self._advance_to(req.version)
         if self._dispatch_task is None or self._dispatch_task.done():
-            self._dispatch_task = loop.create_task(
-                self._dispatch_loop(), name="resolver-group-dispatch")
+            # long-lived FIFO dispatcher: mask the current request's span
+            # so later groups aren't attributed to this transaction
+            with no_span():
+                self._dispatch_task = loop.create_task(
+                    self._dispatch_loop(), name="resolver-group-dispatch")
         t0 = loop.time()
         verdicts = await fut
         self.stages.record("sync", loop.time() - t0)
@@ -217,6 +255,11 @@ class Resolver:
         self.total_batches += 1
         self.total_txns += len(req.txns)
         self.total_conflicts += sum(1 for v in verdicts if v != COMMITTED)
+        self.spans.event("CommitDebug", span_ctx,
+                         "Resolver.resolveBatch.After",
+                         Version=req.version,
+                         Conflicts=sum(1 for v in verdicts
+                                       if v != COMMITTED))
         entries = [(v, m) for v, m in self._state_log
                    if req.state_known_version < v <= req.version]
         return ResolveBatchReply(verdicts, entries or None)

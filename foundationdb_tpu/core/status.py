@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import asyncio
 
-from ..rpc.stubs import RatekeeperClient, StorageClient, TLogClient
+from ..rpc.stubs import (CommitProxyClient, GrvProxyClient, RatekeeperClient,
+                         ResolverClient, StorageClient, TLogClient)
 from ..rpc.transport import Endpoint, NetworkAddress, Transport, WLTOKEN_PING
 from ..runtime.knobs import Knobs
 from .cluster_client import fetch_cluster_state
@@ -45,15 +46,19 @@ async def cluster_status(knobs: Knobs, transport: Transport,
         roles.append({"role": "log", "addr": list(a),
                       "token": gen["token"][i], "index": i})
     for r in state["resolvers"]:
-        roles.append({"role": "resolver", "addr": list(r["addr"])})
+        roles.append({"role": "resolver", "addr": list(r["addr"]),
+                      "token": r["token"],
+                      "begin": r["begin"], "end": r["end"]})
     for s in state["storage"]:
         roles.append({"role": "storage", "addr": list(s["addr"]),
                       "token": s["token"], "tag": s["tag"],
                       "begin": s["begin"], "end": s["end"]})
     for p in state["commit_proxies"]:
-        roles.append({"role": "commit_proxy", "addr": list(p["addr"])})
+        roles.append({"role": "commit_proxy", "addr": list(p["addr"]),
+                      "token": p["token"]})
     for p in state["grv_proxies"]:
-        roles.append({"role": "grv_proxy", "addr": list(p["addr"])})
+        roles.append({"role": "grv_proxy", "addr": list(p["addr"]),
+                      "token": p["token"]})
     if state.get("ratekeeper"):
         roles.append({"role": "ratekeeper",
                       "addr": list(state["ratekeeper"]["addr"]),
@@ -75,6 +80,17 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             elif r["role"] == "log":
                 tc = TLogClient(transport, addr(r["addr"]), r["token"])
                 r["metrics"] = await asyncio.wait_for(tc.metrics(), timeout=t)
+            elif r["role"] == "resolver":
+                rc2 = ResolverClient(transport, addr(r["addr"]), r["token"],
+                                     KeyRange(r["begin"], r["end"]))
+                r["metrics"] = await asyncio.wait_for(rc2.metrics(),
+                                                      timeout=t)
+            elif r["role"] == "grv_proxy":
+                gc = GrvProxyClient(transport, addr(r["addr"]), r["token"])
+                r["metrics"] = await asyncio.wait_for(gc.metrics(), timeout=t)
+            elif r["role"] == "commit_proxy":
+                cc = CommitProxyClient(transport, addr(r["addr"]), r["token"])
+                r["metrics"] = await asyncio.wait_for(cc.metrics(), timeout=t)
             elif r["role"] == "ratekeeper":
                 rc = RatekeeperClient(transport, addr(r["addr"]), r["token"])
                 thr = await asyncio.wait_for(rc.get_throttle(), timeout=t)
@@ -111,6 +127,23 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             default=0.0),
     }
 
+    # distributed-tracing rollup (ISSUE 2): every metric-bearing role
+    # reports its span counters; sampled_txns comes from the GRV proxies
+    # (where every sampled root first crosses the wire).  SERVER-side
+    # sinks only: client NativeAPI.* events and wire-level RpcDebug
+    # receives are counted in their own client processes, so the trace
+    # file always holds MORE events than this rollup
+    # — a deficit there is expected, not span loss.
+    all_metrics = [r.get("metrics") for r in roles if r.get("metrics")]
+    tracing_rollup = {
+        "spans_emitted": sum(
+            m.get("spans_emitted", 0) for m in all_metrics),
+        "spans_dropped": sum(
+            m.get("spans_dropped", 0) for m in all_metrics),
+        "sampled_txns": sum(
+            m.get("sampled_txns", 0) for m in all_metrics),
+    }
+
     return {
         "cluster": {
             "epoch": state["epoch"],
@@ -120,6 +153,7 @@ async def cluster_status(knobs: Knobs, transport: Transport,
                 {"role": r["role"], "addr": r["addr"]}
                 for r in roles if not r["reachable"]],
             "storage_apply": apply_rollup,
+            "tracing": tracing_rollup,
         },
         "roles": roles,
         "shards": {

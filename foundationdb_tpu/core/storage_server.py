@@ -19,6 +19,8 @@ from ..runtime.errors import FutureVersion, TransactionTooOld
 from ..runtime.knobs import Knobs
 from ..runtime.latency_probe import StageStats
 from ..runtime.profiler import RateMeter
+from ..runtime.span import SpanSink, current_span
+from ..runtime.trace import Severity, TraceEvent, get_trace_log
 from ..storage.kv_store import OP_CLEAR, OP_SET
 from ..storage.versioned_map import VersionedMap
 from .data import KeyRange, Mutation, MutationType, Version, apply_atomic
@@ -95,6 +97,10 @@ class StorageServer:
         self.apply_stats = StageStats(f"storage-apply-{tag}", cap=4096)
         self.apply_meter = RateMeter("mutations_applied")
         self.apply_batch_size_max = 0
+        # TransactionDebug span events for sampled reads; the batched
+        # apply path is correlated by VERSION RANGE instead (see
+        # _apply_batch — mutations do not carry trace ids)
+        self.spans = SpanSink("StorageServer")
 
     async def metrics(self) -> dict:
         """Queue/lag sample for the Ratekeeper (StorageQueuingMetrics
@@ -124,6 +130,7 @@ class StorageServer:
             "shard_end": self.shard.end,
             "fetch_done": self._fetch_done.is_set(),
             "fetch_failed": self._fetch_failed,
+            **self.spans.counters(),
         }
 
     # --- lifecycle ---
@@ -514,6 +521,11 @@ class StorageServer:
         if not entries:
             return
         t0 = time.perf_counter()
+        # the trace-visible duration must come from the TRACE clock
+        # (virtual under simulation): a wall-clock number in the JSONL
+        # would break same-seed bit-identical sim output
+        emit_debug = get_trace_log().min_severity <= Severity.DEBUG
+        tt0 = get_trace_log().clock() if emit_debug else 0.0
         durable = self.engine is not None
         vops: list[tuple[Version, int, bytes, bytes]] = []
         nmut = 0
@@ -566,10 +578,26 @@ class StorageServer:
                         self._fire_watches(m.param1, new)
         flush()
         self._bump_version(entries[-1][0])
-        self.apply_stats.record("apply_batch", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.apply_stats.record("apply_batch", dt)
         self.apply_meter.add(nmut)
         if nmut > self.apply_batch_size_max:
             self.apply_batch_size_max = nmut
+        # Apply-path correlation event: mutations carry no trace id (the
+        # apply is asynchronous to every commit), so the analyzer joins a
+        # sampled txn's commit VERSION against this batch's version range
+        # instead.  DEBUG severity + the min_severity guard keep the hot
+        # path free when nobody collects debug traces (the ≤5%
+        # perf_smoke budget).
+        if nmut and emit_debug:
+            TraceEvent("StorageApplyDebug", severity=Severity.DEBUG) \
+                .detail("Role", "StorageServer").detail("Tag", self.tag) \
+                .detail("MinVersion", entries[0][0]) \
+                .detail("MaxVersion", entries[-1][0]) \
+                .detail("Mutations", nmut) \
+                .detail("DurationMs",
+                        round((get_trace_log().clock() - tt0) * 1e3, 3)) \
+                .log()
 
     def _bump_version(self, version: Version) -> None:
         if version <= self.version:
@@ -599,12 +627,28 @@ class StorageServer:
             raise TransactionTooOld()
 
     async def get_value(self, key: bytes, version: Version) -> bytes | None:
-        await self._wait_fetched()
-        await self._wait_for_version(version)
-        self._check_too_old(version)
-        self._check_dropped(version, key, key + b"\x00")
+        span_ctx = current_span()
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.read.Before",
+                         Version=version, Tag=self.tag)
+        try:
+            await self._wait_fetched()
+            await self._wait_for_version(version)
+            self._check_too_old(version)
+            self._check_dropped(version, key, key + b"\x00")
+        except BaseException as e:
+            # close the span: TooOld/FutureVersion are ROUTINE on
+            # retried reads, and an unpaired .Before skews the analyzer
+            self.spans.event("TransactionDebug", span_ctx,
+                             "StorageServer.read.Error",
+                             Version=version, Tag=self.tag,
+                             Error=type(e).__name__)
+            raise
         self.total_reads += 1
         found, v = self.vmap.get2(key, version)
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.read.After",
+                         Version=version, Tag=self.tag)
         if found:
             return v
         # no window entry at or <= version: the engine's durable state
@@ -644,18 +688,41 @@ class StorageServer:
                              limit: int = 0, reverse: bool = False,
                              byte_limit: int = 0
                              ) -> tuple[list[tuple[bytes, bytes]], bool]:
-        await self._wait_fetched()
-        await self._wait_for_version(version)
-        self._check_too_old(version)
-        self._check_dropped(version, begin, end)
+        span_ctx = current_span()
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.rangeRead.Before",
+                         Version=version, Tag=self.tag)
+        try:
+            await self._wait_fetched()
+            await self._wait_for_version(version)
+            self._check_too_old(version)
+            self._check_dropped(version, begin, end)
+        except BaseException as e:
+            self.spans.event("TransactionDebug", span_ctx,
+                             "StorageServer.rangeRead.Error",
+                             Version=version, Tag=self.tag,
+                             Error=type(e).__name__)
+            raise
         self.total_reads += 1
         b = max(begin, self.shard.begin)
         e = min(end, self.shard.end)
         if b >= e:
+            # still close the span: an unpaired .Before would skew the
+            # analyzer's consecutive-pair segment stats
+            self.spans.event("TransactionDebug", span_ctx,
+                             "StorageServer.rangeRead.After",
+                             Version=version, Tag=self.tag, Rows=0)
             return [], False
         if self.engine is None:
-            return self.vmap.range_read(b, e, version, limit, reverse, byte_limit)
-        return self._merged_range_read(b, e, version, limit, reverse, byte_limit)
+            result = self.vmap.range_read(b, e, version, limit, reverse,
+                                          byte_limit)
+        else:
+            result = self._merged_range_read(b, e, version, limit, reverse,
+                                             byte_limit)
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.rangeRead.After",
+                         Version=version, Tag=self.tag, Rows=len(result[0]))
+        return result
 
     def _merged_range_read(self, begin: bytes, end: bytes, version: Version,
                            limit: int, reverse: bool, byte_limit: int

@@ -18,6 +18,7 @@ import bisect
 import dataclasses
 
 from ..runtime.knobs import Knobs
+from ..runtime.span import SpanSink, current_span
 from .data import Mutation, Version
 
 Tag = int
@@ -116,6 +117,8 @@ class TLog:
         self.locked = False          # generation locked by recovery
         self.total_pushes = 0
         self.total_bytes = 0
+        # CommitDebug span events for sampled pushes (wire-propagated)
+        self.spans = SpanSink("TLog")
 
     @classmethod
     async def open(cls, knobs: Knobs, fs, path: str,
@@ -164,6 +167,7 @@ class TLog:
             "mem_bytes": self.mem_bytes,
             "version": self.version,
             "locked": self.locked,
+            **self.spans.counters(),
         }
 
     async def _wait_for_version(self, prev_version: Version) -> None:
@@ -203,6 +207,21 @@ class TLog:
         In-memory engine: durability is immediate.  The version-ordering
         wait still applies so peeks never observe gaps.
         """
+        span_ctx = current_span()
+        self.spans.event("CommitDebug", span_ctx, "TLog.push.Before",
+                         Version=req.version)
+        try:
+            return await self._push_impl(req, span_ctx)
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            # TLogStopped is ROUTINE during recovery; every .Before
+            # must close or the analyzer's pair stats skew
+            self.spans.event("CommitDebug", span_ctx, "TLog.push.Error",
+                             Version=req.version, Error=type(e).__name__)
+            raise
+
+    async def _push_impl(self, req: TLogPushRequest, span_ctx) -> Version:
         if self.locked:
             from ..runtime.errors import TLogStopped
             raise TLogStopped()
@@ -218,6 +237,8 @@ class TLog:
             # consumers — ack idempotently instead (a version's content is
             # deterministic for its batch, so the stored copy is identical).
             self.total_pushes += 1
+            self.spans.event("CommitDebug", span_ctx, "TLog.push.After",
+                             Version=req.version, Duplicate=True)
             return self.version
         for tag, msgs in req.messages.items():
             if msgs:
@@ -274,6 +295,8 @@ class TLog:
             if not fut.done():
                 fut.set_result(None)
         self._peek_waiters.clear()
+        self.spans.event("CommitDebug", span_ctx, "TLog.push.After",
+                         Version=req.version)
         return req.version
 
     async def peek(self, tag: Tag, begin_version: Version) -> TLogPeekReply:

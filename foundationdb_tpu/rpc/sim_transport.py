@@ -107,6 +107,7 @@ class SimTransport(Transport):
 
     async def request(self, endpoint: Endpoint, payload: Any,
                       timeout: float | None = None) -> Any:
+        payload = self.attach_span(payload)   # sampled ctx rides the wire
         loop = asyncio.get_running_loop()
         d1 = self.network._delay(self.address, endpoint.address)
         if d1 is None:
@@ -149,6 +150,8 @@ class SimTransport(Transport):
         return reply
 
     def one_way(self, endpoint: Endpoint, payload: Any) -> None:
+        payload = self.attach_span(payload)
+
         async def deliver():
             d = self.network._delay(self.address, endpoint.address)
             if d is None:

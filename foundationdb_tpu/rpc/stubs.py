@@ -15,6 +15,7 @@ import asyncio
 from typing import Any
 
 from ..core.data import KeyRange
+from ..runtime.span import SpanSink, current_span
 from .transport import Endpoint, NetworkAddress, Transport
 
 # method table per role: (name, oneway?)
@@ -23,14 +24,16 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
                   ("get_live_committed_version", False),
                   ("report_committed", True), ("lock", False),
                   ("report_lock", True)],
-    "resolver": [("resolve", False)],
+    "resolver": [("resolve", False), ("metrics", False)],
     "tlog": [("push", False), ("peek", False), ("pop", True),
              ("lock", False), ("metrics", False)],
     "storage": [("get_value", False), ("get_key_values", False),
                 ("watch_value", False), ("metrics", False),
                 ("get_latest_range", False), ("sample_split_key", False)],
-    "commit_proxy": [("commit", False)],
-    "grv_proxy": [("get_read_version", False)],
+    # metrics appended LAST: token layout is base+index, so new methods
+    # must never reorder existing slots
+    "commit_proxy": [("commit", False), ("metrics", False)],
+    "grv_proxy": [("get_read_version", False), ("metrics", False)],
     "ratekeeper": [("admit", False), ("get_rate", False),
                    ("get_throttle", False), ("set_tag_throttle", False)],
     "coordinator": [("read", False), ("write", False),
@@ -47,6 +50,11 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
 
 TOKEN_BLOCK = 16  # tokens reserved per role instance
 
+# wire-level receive events for sampled requests: one per dispatched RPC,
+# timestamping the server-side arrival of each hop so the trace analyzer
+# can split client-observed latency into network/queue vs service time
+_RPC_SPANS = SpanSink("rpc")
+
 
 def serve_role(transport: Transport, role: str, obj: Any,
                base_token: int) -> None:
@@ -60,7 +68,10 @@ def serve_role(transport: Transport, role: str, obj: Any,
     for i, (name, _oneway) in enumerate(ROLE_METHODS[role]):
         method = getattr(obj, name)
 
-        async def handler(args, method=method):
+        async def handler(args, method=method, loc=f"{role}.{name}"):
+            ctx = current_span()
+            if ctx is not None and ctx.sampled:
+                _RPC_SPANS.event("RpcDebug", ctx, loc)
             result = method(*args)
             if asyncio.iscoroutine(result):
                 result = await result

@@ -161,6 +161,7 @@ class TcpTransport(Transport):
 
     async def request(self, endpoint: Endpoint, payload: Any,
                       timeout: float | None = None) -> Any:
+        payload = self.attach_span(payload)   # sampled ctx rides the frame
         peer = await self._get_peer(endpoint.address)
         reply_id = next(self._reply_ids)
         fut = asyncio.get_running_loop().create_future()
@@ -177,6 +178,8 @@ class TcpTransport(Transport):
         return await fut
 
     def one_way(self, endpoint: Endpoint, payload: Any) -> None:
+        payload = self.attach_span(payload)
+
         async def go():
             try:
                 peer = await self._get_peer(endpoint.address)

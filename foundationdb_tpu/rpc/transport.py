@@ -14,6 +14,7 @@ import dataclasses
 import itertools
 from typing import Any, Awaitable, Callable
 
+from ..runtime import span as _span
 from ..runtime.errors import FdbError, error_from_code
 
 # well-known tokens (REF: WLTOKEN_* in FlowTransport.actor.cpp)
@@ -63,17 +64,25 @@ class RequestDispatcher:
         self._handlers.pop(token, None)
 
     async def dispatch(self, token: int, payload: Any) -> tuple[bool, Any]:
-        """Returns (ok, reply_or_error_code)."""
+        """Returns (ok, reply_or_error_code).  A payload wrapped in a
+        SpanEnvelope (a sampled request) re-activates the sender's span
+        context around the handler, so role code reads it back with
+        ``current_span()`` — the receive half of wire propagation."""
+        payload, ctx = _span.detach(payload)
         h = self._handlers.get(token)
         if h is None:
             # endpoint_not_found: the role at this token is gone (stopped,
             # or its process rebooted).  Retryable — clients refresh their
             # cluster view and re-dial the new generation.
             return False, 1012
+        tok = _span.activate(ctx) if ctx is not None else None
         try:
             return True, await h(payload)
         except FdbError as e:
             return False, e.code
+        finally:
+            if tok is not None:
+                _span.deactivate(tok)
 
     @property
     def tokens(self) -> list[int]:
@@ -101,6 +110,13 @@ class Transport:
     def one_way(self, endpoint: Endpoint, payload: Any) -> None:
         """Fire-and-forget send (PacketWriter without reply token)."""
         raise NotImplementedError
+
+    @staticmethod
+    def attach_span(payload: Any) -> Any:
+        """Envelope hook every transport calls at send time: wraps the
+        payload with the active sampled span context (no-op otherwise),
+        so cross-role attribution survives the wire."""
+        return _span.attach(payload)
 
     async def close(self) -> None:
         pass

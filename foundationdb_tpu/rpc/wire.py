@@ -225,11 +225,13 @@ def _register_core_structs() -> None:
     from ..core import resolver as r
     from ..core import tlog as t
     from ..ops import batch as b
+    from ..runtime import span as sp
     register_enum(d.MutationType, eid=0)
     for i, cls in enumerate([
         d.Mutation, d.KeyRange, d.KeySelector, d.CommitTransactionRequest,
         d.CommitResult, b.TxnRequest, r.ResolveBatchRequest,
         r.ResolveBatchReply, t.TLogPushRequest, t.TLogPeekReply,
+        sp.SpanEnvelope,
     ]):
         register_struct(cls, sid=i)
 

@@ -63,7 +63,9 @@ class Knobs:
     # currentProtocolVersion): published in the cluster state; a client
     # pinned to a different version gets cluster_version_changed and the
     # multi-version client re-resolves (REF:fdbclient/MultiVersionTransaction)
-    PROTOCOL_VERSION: int = 710
+    # 711: SpanEnvelope (wire struct id 10) may wrap any sampled request —
+    # a 710 peer cannot decode it, so the version gate must fence them
+    PROTOCOL_VERSION: int = 711
     STORAGE_VERSION_WINDOW: int = 5_000_000   # in-memory MVCC window, versions
     STORAGE_DURABILITY_LAG: float = 0.25      # seconds between making versions durable
     STORAGE_FUTURE_VERSION_WAIT: float = 1.0  # read wait before future_version

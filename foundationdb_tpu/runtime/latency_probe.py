@@ -112,7 +112,8 @@ class TraceBatch:
     txn).  ``attach()`` rolls the sampling dice; probes on unsampled ids
     are no-ops, so the fast path costs one dict lookup."""
 
-    def __init__(self, sample_rate: float = 0.01, clock=None) -> None:
+    def __init__(self, sample_rate: float = 0.01, clock=None,
+                 live_cap: int = 4096) -> None:
         # deterministic counter-based sampling (no RNG: the probe must
         # not perturb seeded simulation streams)
         self._every = max(1, int(round(1.0 / sample_rate))) \
@@ -120,6 +121,12 @@ class TraceBatch:
         self._n = 0
         self._live: dict[int, list[tuple[str, float]]] = {}
         self._clock = clock
+        # bound the live table: a sampled txn abandoned without
+        # flush/discard (client crash mid-retry, dropped task) would
+        # otherwise leak its probe record forever.  Insertion order IS
+        # age (dict semantics), so eviction drops the oldest probe.
+        self._live_cap = max(1, live_cap)
+        self.evictions = 0
 
     def _now(self) -> float:
         if self._clock is not None:
@@ -135,6 +142,10 @@ class TraceBatch:
         if self._n % self._every:
             return False
         self._live[txn_id] = [("start", self._now())]
+        if len(self._live) > self._live_cap:
+            oldest = next(iter(self._live))
+            del self._live[oldest]
+            self.evictions += 1
         return True
 
     def event(self, txn_id: int, name: str) -> None:

@@ -144,7 +144,15 @@ class SlowTaskProfiler:
                 duration = self._beat - stall_beat
                 self.stalls += 1
                 self.last_stall_s = duration
+                # Begin/End ride the MONOTONIC clock — the same base a
+                # real asyncio loop's time() (and hence every span
+                # event's Time) uses.  The event's own Time field comes
+                # from the watchdog THREAD where no loop runs, so it
+                # falls back to wall time; trace_tool's SlowTask↔span
+                # overlap join must use these fields, not Time.
                 TraceEvent("SlowTask", severity=30) \
                     .detail("DurationMs", round(duration * 1e3, 1)) \
+                    .detail("BeginMonotonic", round(stall_beat, 6)) \
+                    .detail("EndMonotonic", round(self._beat, 6)) \
                     .detail("Stack", stall_stack[-2000:]).log()
                 stall_stack = None

@@ -35,7 +35,13 @@ cluster_version_changed), then runs phase 2.
 
 from __future__ import annotations
 
-import tomllib
+try:                            # tomllib is stdlib only from py3.11
+    import tomllib
+except ModuleNotFoundError:     # py3.10: the same parser's PyPI name
+    try:
+        import tomli as tomllib
+    except ModuleNotFoundError:
+        tomllib = None          # last resort: the minimal parser below
 
 from ..core.cluster_controller import ClusterConfigSpec
 from ..runtime.buggify import enable_buggify
@@ -47,7 +53,109 @@ from ..workloads.workload import run_workloads_on
 
 def load_spec(path: str) -> dict:
     with open(path, "rb") as f:
-        return tomllib.load(f)
+        blob = f.read()
+    if tomllib is not None:
+        return tomllib.loads(blob.decode("utf-8"))
+    return _parse_spec_toml(blob.decode("utf-8"))
+
+
+def _parse_value(s: str):
+    s = s.strip()
+    if s.startswith("[") and s.endswith("]"):
+        inner = s[1:-1].strip()
+        return [_parse_value(x) for x in _split_top(inner)] if inner else []
+    if s in ("true", "false"):
+        return s == "true"
+    if len(s) >= 2 and s[0] in "\"'" and s[-1] == s[0]:
+        return s[1:-1]
+    try:
+        return int(s)
+    except ValueError:
+        return float(s)
+
+
+def _strip_comment(s: str) -> str:
+    """Cut a trailing ``# comment`` outside quotes (quote-aware, so a
+    '#' inside a quoted string or an array of strings survives)."""
+    quote = None
+    for i, ch in enumerate(s):
+        if quote:
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+        elif ch == "#":
+            return s[:i]
+    return s
+
+
+def _split_top(s: str) -> list[str]:
+    """Split an inline array body on top-level commas."""
+    out, depth, cur, quote = [], 0, [], None
+    for ch in s:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = None
+            continue
+        if ch in "\"'":
+            quote = ch
+            cur.append(ch)
+        elif ch == "[":
+            depth += 1
+            cur.append(ch)
+        elif ch == "]":
+            depth -= 1
+            cur.append(ch)
+        elif ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur and "".join(cur).strip():
+        out.append("".join(cur))
+    return out
+
+
+def _parse_spec_toml(text: str) -> dict:
+    """Minimal TOML-subset parser covering the sim spec files: comments,
+    ``[table]`` / ``[[array.of.tables]]`` headers with dotted names, and
+    ``key = value`` where value is a string, int, float, bool, or an
+    inline array of those.  Used only when neither tomllib nor tomli is
+    importable (old interpreter, bare image)."""
+    root: dict = {}
+    cur = root
+
+    def descend(parts: list[str]) -> dict:
+        node = root
+        for p in parts:
+            nxt = node.get(p)
+            if isinstance(nxt, list):
+                node = nxt[-1]
+            elif isinstance(nxt, dict):
+                node = nxt
+            else:
+                node = node.setdefault(p, {})
+        return node
+
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[["):
+            parts = line[2:line.index("]]")].strip().split(".")
+            parent = descend(parts[:-1])
+            parent.setdefault(parts[-1], [])
+            cur = {}
+            parent[parts[-1]].append(cur)
+        elif line.startswith("["):
+            parts = line[1:line.index("]")].strip().split(".")
+            parent = descend(parts[:-1])
+            cur = parent.setdefault(parts[-1], {})
+        else:
+            key, _, val = line.partition("=")
+            cur[key.strip()] = _parse_value(_strip_comment(val).strip())
+    return root
 
 
 async def run_spec(spec: dict, seed: int = 0,

@@ -15,11 +15,11 @@ REF:fdbserver/IKeyValueStore.h).
 
 from __future__ import annotations
 
-import bisect
 from typing import Iterator
 
 from ..rpc.wire import decode, encode
 from .disk_queue import DiskQueue
+from .key_index import PackedKeyIndex
 
 _SNAPSHOT_WAL_BYTES = 1 << 24   # rewrite snapshot when WAL exceeds 16MB
 
@@ -32,7 +32,12 @@ class MemoryKVStore:
         self.fs = fs
         self.prefix = prefix
         self._data: dict[bytes, bytes] = {}
-        self._index: list[bytes] = []
+        # PackedKeyIndex instead of the seed's flat bisect.insort list:
+        # the engine sees the same batched workload as the MVCC window
+        # (durability ticks, GC clears), so it gets the same structure —
+        # amortized O(log n) inserts and ONE vectorized searchsorted for
+        # a batch of clear bounds (ROADMAP open item b)
+        self._index = PackedKeyIndex()
         self.meta: dict = {}
         self._wal: DiskQueue | None = None
         self._wal_file = None
@@ -62,6 +67,7 @@ class MemoryKVStore:
                 continue    # torn snapshot: fall back to an older one
             finally:
                 await f.close()
+        kv._index.add_many(sorted(kv._data))
         kv._wal_file = fs.open(prefix + ".wal")
         kv._wal, frames = await DiskQueue.open(kv._wal_file)
         for frame, _end in frames:
@@ -70,17 +76,44 @@ class MemoryKVStore:
                 continue    # already folded into the snapshot
             kv._apply(rec["ops"])
             kv.meta = rec["meta"]
-        kv._index = sorted(kv._data)
         return kv
 
     def _apply(self, ops: list[tuple[int, bytes, bytes]]) -> None:
-        """ops: ordered (OP_SET, key, value) / (OP_CLEAR, begin, end)."""
-        for op, p1, p2 in ops:
+        """ops: ordered (OP_SET, key, value) / (OP_CLEAR, begin, end).
+
+        Maintains data AND index together.  Fresh keys batch into one
+        sorted overlay append; a run of consecutive clears (the
+        durability loop's GC commit is exactly that) resolves every
+        bound in ONE vectorized ``ranges_keys`` call instead of the
+        seed's full-dict scan per clear."""
+        data = self._data
+        index = self._index
+        fresh: list[bytes] = []
+        i, n = 0, len(ops)
+        while i < n:
+            op, p1, p2 = ops[i]
             if op == OP_SET:
-                self._data[p1] = p2
-            else:
-                for k in [k for k in self._data if p1 <= k < p2]:
-                    del self._data[k]
+                if p1 not in data:
+                    fresh.append(p1)
+                data[p1] = p2
+                i += 1
+                continue
+            # clears must see fresh keys from this batch in the index
+            if fresh:
+                index.add_many(fresh)
+                fresh = []
+            j = i
+            while j < n and ops[j][0] == OP_CLEAR:
+                j += 1
+            dead: set[bytes] = set()
+            for keys in index.ranges_keys([(o[1], o[2]) for o in ops[i:j]]):
+                dead.update(keys)
+            for k in dead:
+                del data[k]
+            index.discard_many(list(dead))
+            i = j
+        if fresh:
+            index.add_many(fresh)
 
     # --- reads ---
 
@@ -89,9 +122,7 @@ class MemoryKVStore:
 
     def range(self, begin: bytes, end: bytes,
               reverse: bool = False) -> Iterator[tuple[bytes, bytes]]:
-        lo = bisect.bisect_left(self._index, begin)
-        hi = bisect.bisect_left(self._index, end)
-        keys = self._index[lo:hi]
+        keys = self._index.keys_in_range(begin, end)
         if reverse:
             keys = reversed(keys)
         for k in keys:
@@ -110,18 +141,8 @@ class MemoryKVStore:
         rec = encode({"gen": self._snap_gen, "ops": ops, "meta": meta})
         await self._wal.push(rec)
         await self._wal.commit()
-        self._apply(ops)
+        self._apply(ops)        # data + index together, clears batched
         self.meta = meta
-        # maintain the sorted index incrementally, in op order
-        for op, p1, p2 in ops:
-            if op == OP_SET:
-                i = bisect.bisect_left(self._index, p1)
-                if i >= len(self._index) or self._index[i] != p1:
-                    self._index.insert(i, p1)
-            else:
-                lo = bisect.bisect_left(self._index, p1)
-                hi = bisect.bisect_left(self._index, p2)
-                del self._index[lo:hi]
         if self._wal.bytes_used > _SNAPSHOT_WAL_BYTES:
             await self._snapshot()
 

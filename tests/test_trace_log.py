@@ -1,0 +1,146 @@
+"""TraceLog rolling + event-schema serializability (ISSUE 2 satellite).
+
+The trace JSONL substrate is now load-bearing for the distributed
+tracing toolkit (tools/trace_tool.py reconstructs timelines from rolled
+files alone), so rolling behavior and the JSON-serializability of every
+event shape get their own coverage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from foundationdb_tpu.runtime.latency_probe import TraceBatch
+from foundationdb_tpu.runtime.span import SpanContext, SpanSink
+from foundationdb_tpu.runtime.trace import (CounterCollection, Histogram,
+                                            Severity, TraceEvent, TraceLog,
+                                            get_trace_log, set_trace_log)
+
+
+def _mklog(tmp_path, **kw) -> tuple[TraceLog, str]:
+    path = os.path.join(str(tmp_path), "trace.jsonl")
+    return TraceLog(path=path, clock=time.time, **kw), path
+
+
+def _lines(path: str) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_roll_at_byte_boundary(tmp_path):
+    log, path = _mklog(tmp_path, roll_bytes=400)
+    for i in range(50):
+        TraceEvent("RollProbe", log=log).detail("I", i).log()
+    log.close()
+    rolls = [p for p in os.listdir(tmp_path) if p.startswith("trace.jsonl.")]
+    assert rolls, "no rolled file despite exceeding roll_bytes"
+    # every rolled generation is itself valid JSONL and the total event
+    # count survives the rolls
+    total = sum(len(_lines(os.path.join(str(tmp_path), p)))
+                for p in rolls) + len(_lines(path))
+    assert total == 50
+    # the live file was restarted below the threshold
+    assert os.path.getsize(path) < 400
+
+
+def test_roll_sequence_continues_across_restart(tmp_path):
+    """A restarted process must continue the .N sequence past files left
+    by its predecessor, never overwrite them."""
+    log, path = _mklog(tmp_path, roll_bytes=200)
+    for i in range(20):
+        TraceEvent("Gen1", log=log).detail("I", i).log()
+    log.close()
+    gens1 = sorted(int(p.rsplit(".", 1)[1])
+                   for p in os.listdir(tmp_path)
+                   if p.startswith("trace.jsonl."))
+    assert gens1
+    first_roll = _lines(os.path.join(str(tmp_path), f"trace.jsonl.{gens1[0]}"))
+
+    # "restart": a fresh TraceLog on the same path
+    log2, _ = _mklog(tmp_path, roll_bytes=200)
+    for i in range(20):
+        TraceEvent("Gen2", log=log2).detail("I", i).log()
+    log2.close()
+    gens2 = sorted(int(p.rsplit(".", 1)[1])
+                   for p in os.listdir(tmp_path)
+                   if p.startswith("trace.jsonl."))
+    assert gens2[-1] > gens1[-1], "roll sequence did not continue"
+    assert len(gens2) == len(set(gens2)), "duplicate roll generation"
+    # the predecessor's first rolled file is untouched
+    assert _lines(os.path.join(str(tmp_path),
+                               f"trace.jsonl.{gens1[0]}")) == first_roll
+
+
+def test_every_event_shape_is_json_serializable(tmp_path):
+    """One of each emitted event family — role events with bytes/error
+    details, metrics emissions, latency probes, span events — must
+    produce a parseable JSONL line."""
+    log, path = _mklog(tmp_path, min_severity=Severity.DEBUG)
+    prev = get_trace_log()
+    set_trace_log(log)
+    try:
+        # plain detail chain with awkward value types
+        TraceEvent("ShapeProbe").detail("Bytes", b"\x00\xff") \
+            .detail("Float", 1.5).detail("NoneV", None) \
+            .detail("List", [1, "a"]).log()
+        # error enrichment
+        TraceEvent("ShapeError").error(ValueError("boom")).log()
+        # histogram + counter collection metrics
+        h = Histogram("Shape", "Latency")
+        h.sample(123.0)
+        h.log_metrics(log)
+        cc = CounterCollection("Shape", "id0")
+        cc.counter("Ops").add(3)
+        cc.log_metrics(log)
+        # TraceBatch flush (TransactionTrace)
+        t = {"v": 0.0}
+
+        def clock():
+            t["v"] += 0.01
+            return t["v"]
+        tb = TraceBatch(1.0, clock=clock)
+        assert tb.attach(1)
+        tb.event(1, "grv")
+        tb.event(1, "commit_done")
+        assert tb.flush(1) is not None
+        # span events (the distributed-tracing schema)
+        sink = SpanSink("test-role")
+        ctx = SpanContext(42, 7, 3, True)
+        sink.event("TransactionDebug", ctx, "Test.location", Version=9)
+        sink.event("CommitDebug", ctx, "Test.other", Error="X",
+                   severity=Severity.DEBUG)
+        # storage apply correlation event shape
+        TraceEvent("StorageApplyDebug", severity=Severity.DEBUG) \
+            .detail("Tag", 0).detail("MinVersion", 1) \
+            .detail("MaxVersion", 5).detail("Mutations", 10) \
+            .detail("DurationMs", 0.5).log()
+    finally:
+        set_trace_log(prev)
+        log.close()
+    events = _lines(path)
+    types = {e["Type"] for e in events}
+    assert {"ShapeProbe", "ShapeError", "HistogramShapeLatency",
+            "ShapeMetrics", "TransactionTrace", "TransactionDebug",
+            "CommitDebug", "StorageApplyDebug"} <= types
+    for e in events:
+        assert "Time" in e and "Severity" in e
+    spans = [e for e in events if e["Type"] in
+             ("TransactionDebug", "CommitDebug")]
+    for e in spans:
+        assert e["TraceID"] == "%016x" % 42
+        assert e["SpanID"] == 7 and e["ParentID"] == 3
+
+
+def test_trace_batch_live_table_is_bounded():
+    """Abandoned sampled probes must not leak: past the cap the oldest
+    record is evicted and counted (ISSUE 2 satellite)."""
+    tb = TraceBatch(1.0, clock=lambda: 0.0, live_cap=8)
+    for i in range(20):
+        assert tb.attach(i)
+    assert len(tb._live) == 8
+    assert tb.evictions == 12
+    # the evicted probes are gone (flush is a no-op), the newest survive
+    assert tb.flush(0) is None
+    assert tb.flush(19) is not None

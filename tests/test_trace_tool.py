@@ -1,0 +1,215 @@
+"""End-to-end distributed tracing: wire propagation + trace_tool (ISSUE 2).
+
+The tier-1 acceptance test: a small seeded multi-role sim writes its
+trace JSONL, tools/trace_tool.py reconstructs per-trace cross-role
+timelines from the file alone, and at least one sampled transaction
+yields a COMPLETE client→GRV→commit→resolve→TLog→storage chain.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import trace_tool
+
+from foundationdb_tpu.runtime import span as span_mod
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.runtime.trace import (Severity, TraceLog,
+                                            get_trace_log, set_trace_log)
+
+
+# --- unit: the envelope over the wire ---
+
+def test_span_envelope_wire_roundtrip():
+    from foundationdb_tpu.rpc.wire import decode, encode
+    env = span_mod.SpanEnvelope(0x2a, 7, 3, [b"payload", 1, None])
+    out = decode(encode(env))
+    assert isinstance(out, span_mod.SpanEnvelope)
+    assert (out.trace_id, out.span_id, out.parent_id) == (0x2a, 7, 3)
+    assert out.payload == [b"payload", 1, None]
+
+
+def test_dispatcher_reactivates_span_context():
+    """A sampled payload wrapped by the transport must surface as
+    current_span() inside the handler — the receive half of wire
+    propagation — and be invisible to unsampled requests."""
+    from foundationdb_tpu.rpc.transport import (NetworkAddress,
+                                                RequestDispatcher)
+
+    async def main():
+        seen = []
+        disp = RequestDispatcher()
+
+        async def handler(payload):
+            seen.append((payload, span_mod.current_span()))
+            return payload
+        tok = disp.register(handler)
+
+        ctx = span_mod.SpanContext(9, 2, 1, True)
+        env = span_mod.SpanEnvelope(ctx.trace_id, ctx.span_id,
+                                    ctx.parent_id, "hello")
+        ok, reply = await disp.dispatch(tok, env)
+        assert ok and reply == "hello"
+        ok, reply = await disp.dispatch(tok, "bare")
+        assert ok and reply == "bare"
+        assert seen[0][0] == "hello"
+        assert seen[0][1] is not None and seen[0][1].trace_id == 9
+        assert seen[1][1] is None      # context did not leak across calls
+    asyncio.run(main())
+
+
+def test_transport_attach_only_wraps_sampled():
+    from foundationdb_tpu.rpc.transport import Transport
+    assert Transport.attach_span("x") == "x"    # no active span: untouched
+    tok = span_mod.activate(span_mod.SpanContext(1, 2, 0, True))
+    try:
+        wrapped = Transport.attach_span("x")
+    finally:
+        span_mod.deactivate(tok)
+    assert isinstance(wrapped, span_mod.SpanEnvelope)
+    assert wrapped.payload == "x" and wrapped.trace_id == 1
+
+
+# --- unit: the analyzer over synthetic events ---
+
+def _ev(t, type_, trace, role, loc, **kw):
+    d = {"Time": t, "Severity": 10, "Type": type_, "TraceID": trace,
+         "SpanID": 1, "ParentID": 0, "Role": role, "Location": loc}
+    d.update(kw)
+    return d
+
+
+def test_trace_tool_reconstruct_and_rank():
+    tid = "%016x" % 5
+    events = [
+        _ev(1.000, "TransactionDebug", tid, "client",
+            "NativeAPI.getReadVersion.Before"),
+        _ev(1.002, "TransactionDebug", tid, "GrvProxy",
+            "GrvProxyServer.reply", Version=100),
+        _ev(1.004, "CommitDebug", tid, "CommitProxy",
+            "CommitProxyServer.commitBatch.GotCommitVersion", Version=120),
+        _ev(1.006, "CommitDebug", tid, "Resolver",
+            "Resolver.resolveBatch.After", Version=120),
+        _ev(1.009, "CommitDebug", tid, "TLog", "TLog.push.After",
+            Version=120),
+        _ev(1.010, "CommitDebug", tid, "client", "NativeAPI.commit.After",
+            Version=120),
+        # a second, faster trace
+        _ev(2.000, "TransactionDebug", "%016x" % 6, "client",
+            "NativeAPI.getReadVersion.Before"),
+        _ev(2.001, "TransactionDebug", "%016x" % 6, "client",
+            "NativeAPI.getReadVersion.After", Version=130),
+        # a conflicted trace: the proxy's Committed=false verdict must
+        # win over the client's LATER generic commit.Error event
+        _ev(3.000, "CommitDebug", "%016x" % 7, "CommitProxy",
+            "CommitProxyServer.commitBatch.Reply", Version=140,
+            Committed=False),
+        _ev(3.001, "CommitDebug", "%016x" % 7, "client",
+            "NativeAPI.commit.Error", Error="NotCommitted"),
+        # async storage apply covering trace 5's commit version
+        {"Time": 1.2, "Severity": 5, "Type": "StorageApplyDebug", "Tag": 0,
+         "MinVersion": 110, "MaxVersion": 125, "Mutations": 3,
+         "DurationMs": 0.4},
+        # a stall overlapping trace 5
+        {"Time": 1.008, "Severity": 30, "Type": "SlowTask",
+         "DurationMs": 5.0},
+    ]
+    report = trace_tool.analyze(events, top=5)
+    assert report["traces"] == 3
+    assert report["outcomes"].get("conflict") == 1
+    assert report["slowest"][0]["trace_id"] == tid
+    assert report["slowest"][0]["outcome"] == "committed"
+    assert report["slowest"][0]["commit_version"] == 120
+    assert report["slowest"][0]["slow_tasks"] == 1
+    assert report["slow_task_correlated"] == 1
+    # the storage apply joined by version range completes the chain
+    traces = trace_tool.reconstruct(events)
+    trace_tool.join_storage_applies(traces, events)
+    assert traces[tid]["storage_applies"][0]["Tag"] == 0
+    assert trace_tool.is_complete(traces[tid])
+    # segments got stats
+    assert any(row["n"] for row in report["span_stats"].values())
+
+
+def test_trace_tool_rolled_paths(tmp_path):
+    base = os.path.join(str(tmp_path), "t.jsonl")
+    for name in ("t.jsonl", "t.jsonl.1", "t.jsonl.2", "t.jsonl.bak"):
+        with open(os.path.join(str(tmp_path), name), "w") as f:
+            f.write('{"Type": "X", "Time": 0}\n')
+    paths = trace_tool.rolled_paths(base)
+    assert paths == [base + ".1", base + ".2", base]
+    assert len(trace_tool.load_events(paths)) == 3
+
+
+# --- the tier-1 acceptance sim (ISSUE 2 CI satellite) ---
+
+def test_sim_trace_reconstructs_cross_role_timeline(tmp_path):
+    """Seeded multi-role sim → trace JSONL → trace_tool: at least one
+    sampled transaction must reconstruct into a complete
+    client→GRV→commit→resolve→TLog→storage timeline, and the status
+    rollup must surface the span counters."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.core.status import cluster_status
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    path = os.path.join(str(tmp_path), "trace.jsonl")
+    # DEBUG severity captures the StorageApplyDebug correlation events;
+    # sample rate 1.0 makes the (deterministic, counter-based) sampler
+    # fire on every transaction
+    log = TraceLog(path=path, min_severity=Severity.DEBUG)
+    prev_log = get_trace_log()
+    set_trace_log(log)
+    span_mod.reset_totals()
+    knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0)
+
+    async def main():
+        sim = SimulatedCluster(knobs, n_machines=5,
+                               spec=ClusterConfigSpec(min_workers=5,
+                                                      replication=2))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        for i in range(4):
+            async def body(tr, i=i):
+                await tr.get(b"trace-k%d" % i)     # storage read span
+                tr.set(b"trace-k%d" % i, b"v%d" % i)
+            await db.run(body)
+        # let the storage pull loops apply the commits (the async half
+        # the analyzer joins by version range)
+        await asyncio.sleep(1.5)
+        ct = sim.client_transport()
+        doc = await cluster_status(sim.knobs, ct, sim.coordinator_stubs(ct))
+        await sim.stop()
+        return doc
+
+    doc = run_simulation(main(), seed=1234)
+    set_trace_log(prev_log)
+    log.close()
+
+    events = trace_tool.load_events(trace_tool.rolled_paths(path))
+    traces = trace_tool.reconstruct(events)
+    trace_tool.join_storage_applies(traces, events)
+    assert traces, "no sampled transaction produced span events"
+    complete = {tid: tr for tid, tr in traces.items()
+                if trace_tool.is_complete(tr)}
+    assert complete, (
+        "no complete client→GRV→commit→resolve→TLog→storage timeline; "
+        "roles seen: %r" % {tid: tr["roles"] for tid, tr in traces.items()})
+    # the report runs end-to-end off the file alone
+    report = trace_tool.analyze(events)
+    assert report["complete"] >= 1
+    assert report["span_stats"]
+    committed = [tr for tr in complete.values()
+                 if tr["outcome"] == "committed"]
+    assert committed and committed[0]["commit_version"] is not None
+    # span counters surfaced through role metrics into the status rollup
+    tracing = doc["cluster"]["tracing"]
+    assert tracing["spans_emitted"] > 0
+    assert tracing["sampled_txns"] >= 4
