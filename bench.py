@@ -75,6 +75,80 @@ def call_bounded(name: str, fn, budget_s: float, errors: dict):
 
 
 # --------------------------------------------------------------------------
+# per-stage trace capture (ROADMAP PR 2 follow-up (b))
+
+
+def stage_trace_begin(name: str, out: dict | None = None):
+    """Route the process TraceLog to a per-stage JSONL file; returns an
+    opaque token for stage_trace_end.  Never raises — tracing must not
+    take a bench stage down.  Once ANY stage has timed out, later
+    stages skip tracing entirely: the abandoned daemon thread keeps
+    emitting through the global TraceLog, and its events landing in a
+    later stage's file would corrupt that stage's report."""
+    if out is not None and out.get("stages_timed_out"):
+        return None
+    try:
+        from foundationdb_tpu.runtime.trace import (TraceLog, get_trace_log,
+                                                    set_trace_log)
+        os.makedirs(PROBE_DIR, exist_ok=True)
+        path = os.path.join(PROBE_DIR, f"bench_trace_{name}.jsonl")
+        # every rolled .N sibling must go (rolled_paths globs them all —
+        # stale files from a previous run would merge into this report)
+        base = os.path.basename(path)
+        for entry in os.listdir(PROBE_DIR):
+            if entry == base or (entry.startswith(base + ".")
+                                 and entry[len(base) + 1:].isdigit()):
+                try:
+                    os.remove(os.path.join(PROBE_DIR, entry))
+                except OSError:
+                    pass
+        prev = get_trace_log()
+        set_trace_log(TraceLog(path=path))
+        return prev, path
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] stage trace setup failed for {name}: {e!r}",
+              file=sys.stderr)
+        return None
+
+
+def stage_trace_end(token, out: dict, name: str, top: int = 5) -> None:
+    """Restore the previous TraceLog and attach a compact trace_tool
+    top-k slow-transaction report for the stage to the artifact.  A
+    TIMED-OUT stage's abandoned daemon thread may still be emitting:
+    leave its (line-buffered) log open rather than close it out from
+    under the thread — the final os._exit reaps the handle."""
+    if token is None:
+        return
+    prev, path = token
+    try:
+        from foundationdb_tpu.runtime.trace import (get_trace_log,
+                                                    set_trace_log)
+        log = get_trace_log()
+        set_trace_log(prev)
+        if not out.get("stages_timed_out"):
+            # no abandoned stage thread can be holding this log
+            log.close()
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import trace_tool
+        events = trace_tool.load_events(trace_tool.rolled_paths(path))
+        rep = trace_tool.analyze(events, top=top)
+        out[f"trace_{name}"] = {
+            "file": os.path.relpath(path, REPO),
+            "traces": rep["traces"],
+            "complete": rep["complete"],
+            "outcomes": rep["outcomes"],
+            "slow_task_correlated": rep["slow_task_correlated"],
+            "top_slow": [
+                {"trace_id": s["trace_id"], "total_ms": s["total_ms"],
+                 "outcome": s["outcome"],
+                 "slow_tasks": s["slow_tasks"]}
+                for s in rep["slowest"]],
+        }
+    except Exception as e:  # noqa: BLE001 — report the gap, keep the bench
+        out[f"trace_{name}_error"] = repr(e)[:200]
+
+
+# --------------------------------------------------------------------------
 # TPU tunnel probing
 
 
@@ -632,9 +706,11 @@ def main() -> int:
                 out["tunnel_rtt_ms"] = probe_rtt(tpu_device)
             except Exception as e:  # noqa: BLE001
                 out["tunnel_rtt_error"] = repr(e)[:200]
+            tok = stage_trace_begin("e2e", out)
             e2e = call_bounded(
                 "e2e", lambda: run_e2e_phase(tpu_device, args.quiet),
                 args.stage_timeout, out)
+            stage_trace_end(tok, out, "e2e")
             if e2e is not None:
                 out.update({
                     "e2e_tps_tpu": rnd(e2e["tpu"]["tps"]),
@@ -657,6 +733,7 @@ def main() -> int:
             # the per-workload budgets inside bound any wedge; this guard
             # covers setup failures (imports, knob construction) so the
             # later stages — including the abort-parity GATE — still run
+            tok = stage_trace_begin("configs34", out)
             try:
                 c34 = run_configs34_phase(tpu_device, args.quiet,
                                           budget_s=args.stage_timeout / 2)
@@ -667,6 +744,9 @@ def main() -> int:
                 if k.endswith("_error") or k == "stages_timed_out":
                     out[k] = out.get(k, []) + v if k == "stages_timed_out" \
                         else v
+            # after the merge so per-workload timeouts inside configs34
+            # are visible to the don't-close-under-a-live-thread guard
+            stage_trace_end(tok, out, "configs34")
             # flatten per-(workload, backend) INDEPENDENTLY: when one
             # side timed out, the other side's measured numbers must
             # still reach the artifact (the degrade contract)

@@ -231,10 +231,13 @@ class DRAgent:
         transaction size limit, so a version always fits one dest txn."""
         chunk: list[tuple[Version, list]] = []
         nmuts = nbytes = 0
+        from ..core.data import MutationBatch
         for v, muts in entries:
             chunk.append((v, muts))
             nmuts += len(muts)
-            nbytes += sum(len(m.param1) + len(m.param2) for m in muts)
+            # packed batches size in O(1); legacy Mutation lists sum
+            nbytes += muts.nbytes if isinstance(muts, MutationBatch) \
+                else sum(len(m.param1) + len(m.param2) for m in muts)
             if nmuts >= 500 or nbytes >= (1 << 20):
                 await self._apply_chunk(chunk)
                 chunk, nmuts, nbytes = [], 0, 0

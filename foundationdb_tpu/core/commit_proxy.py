@@ -25,7 +25,8 @@ from ..runtime.errors import (ClientInvalidOperation, ClusterVersionChanged,
                               TransactionTooOld)
 from ..runtime.knobs import Knobs
 from .data import (SYSTEM_PREFIX, CommitResult, CommitTransactionRequest,
-                   Mutation, MutationType, Version, pack_versionstamp)
+                   Mutation, MutationBatch, MutationBatchBuilder,
+                   MutationType, Version, pack_versionstamp)
 from .resolver import ResolveBatchRequest, Resolver, clip_txn_to_range
 from .sequencer import Sequencer
 from .shard_map import ShardMap, write_team_drops
@@ -459,7 +460,7 @@ class CommitProxy:
         batch_began = asyncio.get_running_loop().time()
         prev_version = version = None
         resolved = pushed = push_started = False
-        repair_tagged: dict[int, list[Mutation]] | None = None
+        repair_tagged: dict[int, MutationBatch] | None = None
         is_state = any(is_state_txn(r) for r in reqs)
         loop = asyncio.get_running_loop()
         try:
@@ -532,8 +533,13 @@ class CommitProxy:
             # tag mutations of committed txns, in batch order; the log
             # system replicates each tag onto its hosting logs.  With a
             # backup tag active, the whole ordered stream rides under it
-            # too (the continuous mutation-log backup feed).
-            tagged: dict[int, list[Mutation]] = {}
+            # too (the continuous mutation-log backup feed).  The packed
+            # MutationBatch is built ONCE here; each tag's payload is an
+            # index slice of it (``select``), and a tag owning every
+            # mutation — the single-shard common case — ships the batch
+            # itself with zero copies.
+            builder = MutationBatchBuilder()
+            tag_idx: dict[int, list[int]] = {}
             order = 0
             orders: list[int] = [0] * len(reqs)
             locked_out: set[int] = set()
@@ -551,17 +557,26 @@ class CommitProxy:
                         tags = shard_map.tags_for_range(m.param1, m.param2)
                     else:
                         tags = shard_map.tags_for_key(m.param1)
+                    mi = builder.add(int(m.type), m.param1, m.param2)
                     for t in tags:
-                        tagged.setdefault(t, []).append(m)
+                        tag_idx.setdefault(t, []).append(mi)
                     for bt in backup_tags:
-                        tagged.setdefault(bt, []).append(m)
+                        # a backup tag numerically colliding with a
+                        # storage tag must not index the mutation twice
+                        # (the seed's list append duplicated it — which
+                        # double-applied atomics on that replica)
+                        if bt not in tags:
+                            tag_idx.setdefault(bt, []).append(mi)
                 order += 1
             # ownership handoff markers for a layout change this batch
             # committed: each losing tag sees the drop at exactly this
             # version in its own mutation stream
             for t, b, e in my_drops:
-                tagged.setdefault(t, []).append(
-                    Mutation(MutationType.PRIVATE_DROP_SHARD, b, e))
+                mi = builder.add(int(MutationType.PRIVATE_DROP_SHARD), b, e)
+                tag_idx.setdefault(t, []).append(mi)
+            batch_packed = builder.finish()
+            tagged: dict[int, MutationBatch] = {
+                t: batch_packed.select(ix) for t, ix in tag_idx.items()}
             repair_tagged = tagged
 
             push_started = True
@@ -637,7 +652,7 @@ class CommitProxy:
 
     async def _repair_chain(self, prev_version: Version, version: Version,
                             resolved: bool, pushed: bool,
-                            tagged: dict[int, list[Mutation]] | None = None,
+                            tagged: dict[int, MutationBatch] | None = None,
                             carries_state: bool = False,
                             cause: BaseException | None = None) -> None:
         """Complete an interrupted batch's version chain.  Once the batch

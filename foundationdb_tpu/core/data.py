@@ -12,8 +12,23 @@ from __future__ import annotations
 import dataclasses
 import enum
 import struct
+import sys
+from array import array as _array
 
 from ..runtime.errors import InvertedRange, KeyOutsideLegalRange
+
+# MutationBatch.bounds is little-endian u32 ON THE WIRE (like every other
+# fixed-width field in rpc/wire.py); the fast in-memory views below are
+# native-order, so big-endian hosts byte-swap at the boundary (a no-op on
+# the little-endian hosts everything actually runs on)
+_NATIVE_LE = sys.byteorder == "little"
+
+
+def _bounds_to_wire(bounds: "_array") -> bytes:
+    if not _NATIVE_LE:
+        bounds = _array("I", bounds)
+        bounds.byteswap()
+    return bounds.tobytes()
 
 Version = int
 INVALID_VERSION: Version = -1
@@ -141,6 +156,198 @@ class Mutation:
     @property
     def is_atomic(self) -> bool:
         return self.type in ATOMIC_TYPES
+
+
+@dataclasses.dataclass
+class MutationBatch:
+    """Packed columnar mutation batch — the commit pipeline's wire form
+    (PROTOCOL_VERSION 712).
+
+    Built ONCE per commit batch at the commit proxy and shipped as-is
+    through tagging, TLog append/spill/peek, and the storage apply path
+    (the flat-buffer discipline of REF:fdbserver/TLogServer.actor.cpp's
+    opaque StringRef message blocks: mutation payloads never need to be
+    re-materialized between roles).  Layout:
+
+    - ``types``  — one ``MutationType`` code byte per mutation;
+    - ``bounds`` — native little-endian u32 pairs, one per mutation:
+      (param1 end, param2 end), cumulative offsets into ``blob`` (so
+      mutation i's param1 starts at pair i-1's param2 end);
+    - ``blob``   — every param1+param2 concatenated in mutation order.
+
+    ``nbytes`` (the TLog's queue accounting unit) is O(1): len(blob).
+    Consumers that need ``Mutation`` objects (atomics, metadata paths,
+    backup/DR replay) decode lazily per item via ``__iter__``/indexing.
+    For simple SET/CLEAR batches the type codes coincide with the
+    storage engines' WAL op codes (OP_SET=0, OP_CLEAR=1), so a packed
+    batch doubles as a durability-buffer segment with zero copies.
+    """
+
+    types: bytes = b""
+    bounds: bytes = b""
+    blob: bytes = b""
+
+    def __len__(self) -> int:
+        return len(self.types)
+
+    def __bool__(self) -> bool:
+        return bool(self.types)
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.blob)
+
+    def offsets(self):
+        """Indexable u32 view of ``bounds`` (cached; index 2i = param1
+        end, 2i+1 = param2 end of mutation i).  A zero-copy memoryview
+        cast on little-endian hosts; a byte-swapped array on big-endian
+        ones (bounds is little-endian on the wire)."""
+        offs = self.__dict__.get("_offs")
+        if offs is None:
+            if _NATIVE_LE:
+                offs = memoryview(self.bounds).cast("I")
+            else:
+                offs = _array("I")
+                offs.frombytes(self.bounds)
+                offs.byteswap()
+            self.__dict__["_offs"] = offs
+        return offs
+
+    @property
+    def simple_only(self) -> bool:
+        """True when every op is a plain SET_VALUE/CLEAR_RANGE — the
+        storage fast path that never builds ``Mutation`` objects."""
+        s = self.__dict__.get("_simple")
+        if s is None:
+            t = self.types
+            s = (max(t) <= 1) if t else True
+            self.__dict__["_simple"] = s
+        return s
+
+    def param1(self, i: int) -> bytes:
+        offs = self.offsets()
+        return self.blob[(offs[2 * i - 1] if i else 0):offs[2 * i]]
+
+    def param2(self, i: int) -> bytes:
+        offs = self.offsets()
+        return self.blob[offs[2 * i]:offs[2 * i + 1]]
+
+    def mutation(self, i: int) -> "Mutation":
+        offs = self.offsets()
+        start = offs[2 * i - 1] if i else 0
+        e1, e2 = offs[2 * i], offs[2 * i + 1]
+        return Mutation(MutationType(self.types[i]),
+                        self.blob[start:e1], self.blob[e1:e2])
+
+    def __getitem__(self, i: int) -> "Mutation":
+        n = len(self.types)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        return self.mutation(i)
+
+    def __iter__(self):
+        for i in range(len(self.types)):
+            yield self.mutation(i)
+
+    def iter_ops(self):
+        """(type_code, param1, param2) triples — the engine WAL op shape
+        for simple-only batches (type codes == OP codes)."""
+        offs = self.offsets()
+        blob = self.blob
+        types = self.types
+        prev = 0
+        for i in range(len(types)):
+            e1, e2 = offs[2 * i], offs[2 * i + 1]
+            yield types[i], blob[prev:e1], blob[e1:e2]
+            prev = e2
+
+    def set_payload_bytes(self) -> int:
+        """Sum of param bytes over SET_VALUE ops (logical-size
+        accounting) without materializing any payload."""
+        offs = self.offsets()
+        types = self.types
+        total = prev = 0
+        for i in range(len(types)):
+            e2 = offs[2 * i + 1]
+            if types[i] == 0:           # SET_VALUE
+                total += e2 - prev
+            prev = e2
+        return total
+
+    def select(self, idxs: list[int]) -> "MutationBatch":
+        """Sub-batch of the given (non-decreasing) mutation indices —
+        how the proxy slices one packed batch per destination tag.
+        Selecting exactly everything returns self (the single-shard
+        common case ships with zero copies); a same-length list with
+        duplicates is NOT the identity and is sliced for real."""
+        if len(idxs) == len(self.types) \
+                and all(idxs[i] == i for i in range(len(idxs))):
+            return self
+        offs = self.offsets()
+        blob = self.blob
+        bounds = _array("I")
+        chunks: list[bytes] = []
+        pos = 0
+        for i in idxs:
+            start = offs[2 * i - 1] if i else 0
+            e1, e2 = offs[2 * i], offs[2 * i + 1]
+            chunks.append(blob[start:e2])
+            pos += e2 - start
+            bounds.append(pos - (e2 - e1))
+            bounds.append(pos)
+        return MutationBatch(bytes(self.types[i] for i in idxs),
+                             _bounds_to_wire(bounds), b"".join(chunks))
+
+    @classmethod
+    def from_mutations(cls, muts) -> "MutationBatch":
+        b = MutationBatchBuilder()
+        for m in muts:
+            b.add(int(m.type), m.param1, m.param2)
+        return b.finish()
+
+
+class MutationBatchBuilder:
+    """Append-only MutationBatch assembly (one blob join at finish)."""
+
+    __slots__ = ("_types", "_bounds", "_chunks", "_pos")
+
+    def __init__(self) -> None:
+        self._types = bytearray()
+        self._bounds = _array("I")
+        self._chunks: list[bytes] = []
+        self._pos = 0
+
+    def __len__(self) -> int:
+        return len(self._types)
+
+    def add(self, type_code: int, p1: bytes, p2: bytes) -> int:
+        """Append one mutation; returns its index in the batch."""
+        i = len(self._types)
+        self._types.append(type_code)
+        self._chunks.append(p1)
+        self._chunks.append(p2)
+        self._pos += len(p1)
+        self._bounds.append(self._pos)
+        self._pos += len(p2)
+        self._bounds.append(self._pos)
+        return i
+
+    def finish(self) -> MutationBatch:
+        assert self._pos < (1 << 32), "mutation batch blob exceeds u32 offsets"
+        return MutationBatch(bytes(self._types),
+                             _bounds_to_wire(self._bounds),
+                             b"".join(self._chunks))
+
+
+def as_mutation_batch(msgs) -> MutationBatch:
+    """Normalize a TLog message payload: packed batches pass through,
+    legacy ``list[Mutation]`` (old DiskQueue frames, unit tests, sidecar
+    producers) packs once at the boundary."""
+    if isinstance(msgs, MutationBatch):
+        return msgs
+    return MutationBatch.from_mutations(msgs)
 
 
 def _pad_to_common(a: bytes, b: bytes) -> tuple[bytes, bytes, int]:

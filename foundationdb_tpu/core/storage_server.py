@@ -22,8 +22,10 @@ from ..runtime.profiler import RateMeter
 from ..runtime.span import SpanSink, current_span
 from ..runtime.trace import Severity, TraceEvent, get_trace_log
 from ..storage.kv_store import OP_CLEAR, OP_SET
+from ..storage.packed_ops import DurabilityRing
 from ..storage.versioned_map import VersionedMap
-from .data import KeyRange, Mutation, MutationType, Version, apply_atomic
+from .data import (KeyRange, Mutation, MutationBatch, MutationType, Version,
+                   apply_atomic)
 from .tlog import TLog, Tag
 
 
@@ -55,7 +57,10 @@ class StorageServer:
         self.oldest_version: Version = v0
         self.vmap.oldest_version = v0
         self.vmap.latest_version = v0
-        self._durability_buffer: list[tuple[Version, tuple[int, bytes, bytes]]] = []
+        # pending-durable ops, packed (a ring of MutationBatch segments
+        # with a bisect version cursor — each durability tick commits a
+        # slice instead of rebuilding a tuple list, ROADMAP PR 1 (c))
+        self._dbuf = DurabilityRing()
         self._version_waiters: dict[Version, list[asyncio.Future]] = {}
         self._watches: dict[bytes, list[tuple[bytes | None, asyncio.Future]]] = {}
         self._pull_task: asyncio.Task | None = None
@@ -199,9 +204,7 @@ class StorageServer:
             self._pull_task = None
         if self.version > recovery_version:
             self.vmap.rollback_after(recovery_version)
-            self._durability_buffer = [
-                (v, op) for v, op in self._durability_buffer
-                if v <= recovery_version]
+            self._dbuf.rollback_after(recovery_version)
             self.version = recovery_version
         if any(v > recovery_version for v, _b, _e in self._dropped):
             # a PRIVATE_DROP_SHARD applied from a generation's unacked
@@ -272,7 +275,7 @@ class StorageServer:
                 page.append((v, OP_SET, k, val))
                 self.logical_bytes += len(k) + len(val)
                 if self.engine is not None:
-                    self._durability_buffer.append((v, (OP_SET, k, val)))
+                    self._dbuf.append(v, OP_SET, k, val)
             self.vmap.apply_batch(page)    # one index merge per page
             rows_total += len(kvs)
             if not more or not kvs:
@@ -388,7 +391,11 @@ class StorageServer:
             await asyncio.sleep(self.knobs.STORAGE_DURABILITY_LAG)
             floor = self.version - self.knobs.STORAGE_VERSION_WINDOW
             if floor > self.durable_version:
-                ops = [op for v, op in self._durability_buffer if v <= floor]
+                # O(slice): the packed ring bisects its version cursor;
+                # nothing else in the buffer is touched.  The cursor only
+                # advances AFTER the engine committed, so a failed tick
+                # retries the identical slice.
+                ops = self._dbuf.peek_through(floor)
                 try:
                     await self.engine.commit(ops, {
                         "durable_version": floor,
@@ -403,11 +410,8 @@ class StorageServer:
                     TraceEvent("StorageDurabilityError", severity=40).detail(
                         "Tag", self.tag).error(e).log()
                     continue
-                self._durability_buffer = [(v, op) for v, op in
-                                           self._durability_buffer
-                                           if v > floor]
-                self.bytes_durable += sum(
-                    len(p1) + len(p2) for _, p1, p2 in ops)
+                self._dbuf.pop_through(floor)
+                self.bytes_durable += ops.nbytes
                 self.durable_version = floor
                 self.oldest_version = floor
                 self.vmap.drop_before(floor)  # engine authoritative <= floor
@@ -507,17 +511,21 @@ class StorageServer:
         self._apply_batch([(version, mutations)])
 
     def _apply_batch(self,
-                     entries: list[tuple[Version, list[Mutation]]]) -> None:
+                     entries: list[tuple[Version, MutationBatch]]) -> None:
         """Apply a whole TLog pull reply — every (version, mutations)
         pair — in ONE pass (REF: storageserver.actor.cpp::update applies
         a full peek reply per wait too).
 
-        Plain sets and clears accumulate into one ``vmap.apply_batch``
-        call so fresh keys hit the key index as a single sorted merge
-        instead of a per-key insert (the r5 O(n²) collapse).  Ops that
-        need to OBSERVE state — atomics (read latest value) and
-        PRIVATE_DROP_SHARD (range-scan the handed-off rows) — flush the
-        pending run first, so they see exactly the sequential state."""
+        A packed ``MutationBatch`` of plain sets/clears with no watches
+        armed takes the COLUMNAR fast path: the whole batch feeds
+        ``vmap.apply_packed`` (param bytes sliced from the blob exactly
+        once, no ``Mutation`` objects), the durability ring takes the
+        batch as one zero-copy segment, and the byte accounting is O(1)
+        off the blob length.  Everything else — atomics (read latest
+        value), PRIVATE_DROP_SHARD (range-scan the handed-off rows),
+        armed watches — falls back to lazy per-item decode; ops that
+        observe state flush the pending run first, so they see exactly
+        the sequential state."""
         if not entries:
             return
         t0 = time.perf_counter()
@@ -537,6 +545,16 @@ class StorageServer:
                 vops = []
 
         for version, mutations in entries:
+            if (isinstance(mutations, MutationBatch)
+                    and mutations.simple_only and not self._watches):
+                flush()
+                nmut += len(mutations)
+                self.bytes_input += mutations.nbytes
+                self.logical_bytes += mutations.set_payload_bytes()
+                self.vmap.apply_packed(version, mutations)
+                if durable:
+                    self._dbuf.extend_packed(version, mutations)
+                continue
             for m in mutations:
                 if m.type == MutationType.PRIVATE_DROP_SHARD:
                     flush()
@@ -548,14 +566,13 @@ class StorageServer:
                     self.logical_bytes += len(m.param1) + len(m.param2)
                     vops.append((version, OP_SET, m.param1, m.param2))
                     if durable:
-                        self._durability_buffer.append(
-                            (version, (OP_SET, m.param1, m.param2)))
+                        self._dbuf.append(version, OP_SET, m.param1, m.param2)
                     self._fire_watches(m.param1, m.param2)
                 elif m.type == MutationType.CLEAR_RANGE:
                     vops.append((version, OP_CLEAR, m.param1, m.param2))
                     if durable:
-                        self._durability_buffer.append(
-                            (version, (OP_CLEAR, m.param1, m.param2)))
+                        self._dbuf.append(version, OP_CLEAR, m.param1,
+                                          m.param2)
                     self._fire_watch_range(m.param1, m.param2)
                 else:
                     # atomics resolve against the latest value (window or
@@ -567,14 +584,13 @@ class StorageServer:
                         end = m.param1 + b"\x00"
                         vops.append((version, OP_CLEAR, m.param1, end))
                         if durable:
-                            self._durability_buffer.append(
-                                (version, (OP_CLEAR, m.param1, end)))
+                            self._dbuf.append(version, OP_CLEAR, m.param1,
+                                              end)
                         self._fire_watches(m.param1, None)
                     else:
                         vops.append((version, OP_SET, m.param1, new))
                         if durable:
-                            self._durability_buffer.append(
-                                (version, (OP_SET, m.param1, new)))
+                            self._dbuf.append(version, OP_SET, m.param1, new)
                         self._fire_watches(m.param1, new)
         flush()
         self._bump_version(entries[-1][0])

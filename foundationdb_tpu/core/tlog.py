@@ -19,7 +19,7 @@ import dataclasses
 
 from ..runtime.knobs import Knobs
 from ..runtime.span import SpanSink, current_span
-from .data import Mutation, Version
+from .data import MutationBatch, Version, as_mutation_batch
 
 Tag = int
 
@@ -40,19 +40,19 @@ class _TagStore:
 
     def __init__(self) -> None:
         self.versions: list[Version] = []
-        self.entries: list[list[Mutation]] = []
+        self.entries: list[MutationBatch] = []
         self.sizes: list[int] = []
         self.start = 0
         self.mem_bytes = 0
         self.spilled_below: Version = 0
 
-    def append(self, version: Version, msgs: list[Mutation], nbytes: int) -> None:
+    def append(self, version: Version, msgs: MutationBatch, nbytes: int) -> None:
         self.versions.append(version)
         self.entries.append(msgs)
         self.sizes.append(nbytes)
         self.mem_bytes += nbytes
 
-    def slice_from(self, begin: Version) -> list[tuple[Version, list[Mutation]]]:
+    def slice_from(self, begin: Version) -> list[tuple[Version, MutationBatch]]:
         i = max(self.start, bisect.bisect_left(self.versions, begin))
         return list(zip(self.versions[i:], self.entries[i:]))
 
@@ -86,15 +86,19 @@ class _TagStore:
 
 @dataclasses.dataclass
 class TLogPushRequest:
-    """TLogCommitRequest: messages grouped by destination tag."""
+    """TLogCommitRequest: messages grouped by destination tag.
+
+    Values are packed ``MutationBatch``es on the wire (PROTOCOL_VERSION
+    712); a bare ``list[Mutation]`` is still accepted at ``push`` for
+    sidecar producers and tests and is packed at the boundary."""
     prev_version: Version
     version: Version
-    messages: dict[Tag, list[Mutation]]
+    messages: dict[Tag, MutationBatch]
 
 
 @dataclasses.dataclass
 class TLogPeekReply:
-    entries: list[tuple[Version, list[Mutation]]]
+    entries: list[tuple[Version, MutationBatch]]
     end_version: Version       # caller has everything < end_version for this tag
 
 
@@ -136,7 +140,12 @@ class TLog:
             rec = decode(frame)
             version = rec["v"]
             for tag, msgs in rec["m"].items():
-                nbytes = sum(len(m.param1) + len(m.param2) for m in msgs)
+                # new frames hold packed MutationBatches (nbytes O(1));
+                # frames written before the 712 format hold Mutation
+                # lists and pack once here — recovery equivalence across
+                # the format change
+                msgs = as_mutation_batch(msgs)
+                nbytes = msgs.nbytes
                 tlog._store(tag).append(version, msgs, nbytes)
                 tlog._hosted.add(tag)
                 tlog._tag_tip[tag] = max(tlog._tag_tip.get(tag, 0), version)
@@ -240,19 +249,25 @@ class TLog:
             self.spans.event("CommitDebug", span_ctx, "TLog.push.After",
                              Version=req.version, Duplicate=True)
             return self.version
-        for tag, msgs in req.messages.items():
+        # normalize IN PLACE so the DiskQueue frame below stores the
+        # packed form too — appends, spill re-reads, and recovery all
+        # share one encode done at (or before) the proxy
+        messages = req.messages
+        for tag, msgs in messages.items():
+            if not isinstance(msgs, MutationBatch):
+                messages[tag] = msgs = as_mutation_batch(msgs)
             if msgs:
-                nbytes = sum(len(m.param1) + len(m.param2) for m in msgs)
+                nbytes = msgs.nbytes
                 self._store(tag).append(req.version, msgs, nbytes)
                 self._hosted.add(tag)
                 self._tag_tip[tag] = max(self._tag_tip.get(tag, 0),
                                          req.version)
                 self.total_bytes += nbytes
         if self.queue is not None:
-            if req.messages:
+            if messages:
                 from ..rpc.wire import encode
                 end = await self.queue.push(encode({"v": req.version,
-                                                    "m": req.messages}))
+                                                    "m": messages}))
                 self._frame_ends.append((req.version, end))
             # the fsync that makes commits durable; the tip rides the
             # header so a reopened log still reports it after pops AND
@@ -322,7 +337,7 @@ class TLog:
         st = self._log.get(tag)
         if st is None:
             return TLogPeekReply([], tip + 1)
-        entries: list[tuple[Version, list[Mutation]]] = []
+        entries: list[tuple[Version, MutationBatch]] = []
         if begin_version < st.spilled_below and self.queue is not None:
             entries.extend(e for e in await self._peek_spilled(
                 tag, begin_version, st.spilled_below) if e[0] <= tip)
@@ -347,7 +362,7 @@ class TLog:
             rec = decode(payload)
             v = rec["v"]
             if begin <= v < below and tag in rec["m"] and rec["m"][tag]:
-                out.append((v, rec["m"][tag]))
+                out.append((v, as_mutation_batch(rec["m"][tag])))
         return out
 
     def _maybe_spill(self) -> None:

@@ -40,6 +40,29 @@ from ..ops.conflict_jax import ConflictState, _possibly_lt, resolve_core
 from ..ops.keycode import DEFAULT_WIDTH
 
 
+def _resolve_shard_map():
+    """(shard_map callable, replication-check kwargs) for this jax build,
+    or (None, {}) when the build has neither spelling.  Newer jax exposes
+    ``jax.shard_map`` (``check_vma``); older builds only have
+    ``jax.experimental.shard_map.shard_map`` (``check_rep``)."""
+    try:
+        from jax import shard_map as sm
+        return sm, {"check_vma": False}
+    except ImportError:
+        pass
+    try:
+        from jax.experimental.shard_map import shard_map as sm
+        return sm, {"check_rep": False}
+    except ImportError:
+        return None, {}
+
+
+def have_shard_map() -> bool:
+    """Capability probe: can this jax build run the sharded resolver?
+    Tests and benches gate on this instead of failing on import."""
+    return _resolve_shard_map()[0] is not None
+
+
 class ShardedConflictState(NamedTuple):
     """ConflictState arrays with a leading resolver-shard axis, plus the
     partition boundary table (replicated).  Per-shard layout matches the
@@ -113,7 +136,12 @@ def make_sharded_resolve_step(mesh: Mesh, width: int = DEFAULT_WIDTH,
     enables each shard's exact fast-path scan (CONFLICT_WINDOW_SLOTS knob),
     same semantics as the single-chip kernel.
     """
-    from jax import shard_map
+    shard_map, rep_kwargs = _resolve_shard_map()
+    if shard_map is None:
+        raise ImportError(
+            "this jax build exposes neither jax.shard_map nor "
+            "jax.experimental.shard_map (probe with "
+            "parallel.sharded.have_shard_map)")
 
     def local_step(hb, he, hver, floor, lo, hi, rb, re, wb, we, snap, cv):
         # drop the leading length-1 shard axis inside the mapped body
@@ -127,15 +155,16 @@ def make_sharded_resolve_step(mesh: Mesh, width: int = DEFAULT_WIDTH,
 
     sharded = P("resolvers")
     repl = P()
-    # check_vma=False: resolve_core is shared with the single-chip jit, so
-    # its internals (scan carry) are not annotated with varying manual axes;
-    # the pmax guarantees the replicated verdict output is truly replicated.
+    # replication checking off (check_vma / legacy check_rep): resolve_core
+    # is shared with the single-chip jit, so its internals (scan carry) are
+    # not annotated with varying manual axes; the pmax guarantees the
+    # replicated verdict output is truly replicated.
     fn = shard_map(
         local_step, mesh=mesh,
         in_specs=(sharded, sharded, sharded, sharded, sharded, sharded,
                   repl, repl, repl, repl, repl, repl),
         out_specs=(sharded, sharded, sharded, sharded, repl),
-        check_vma=False,
+        **rep_kwargs,
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))

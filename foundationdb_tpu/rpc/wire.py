@@ -231,7 +231,7 @@ def _register_core_structs() -> None:
         d.Mutation, d.KeyRange, d.KeySelector, d.CommitTransactionRequest,
         d.CommitResult, b.TxnRequest, r.ResolveBatchRequest,
         r.ResolveBatchReply, t.TLogPushRequest, t.TLogPeekReply,
-        sp.SpanEnvelope,
+        sp.SpanEnvelope, d.MutationBatch,
     ]):
         register_struct(cls, sid=i)
 

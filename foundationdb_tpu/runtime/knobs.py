@@ -65,7 +65,10 @@ class Knobs:
     # multi-version client re-resolves (REF:fdbclient/MultiVersionTransaction)
     # 711: SpanEnvelope (wire struct id 10) may wrap any sampled request —
     # a 710 peer cannot decode it, so the version gate must fence them
-    PROTOCOL_VERSION: int = 711
+    # 712: packed columnar MutationBatch (wire struct id 11) replaces
+    # list[Mutation] in TLogPushRequest/TLogPeekReply payloads — a 711
+    # peer cannot decode the struct id, so the gate fences it
+    PROTOCOL_VERSION: int = 712
     STORAGE_VERSION_WINDOW: int = 5_000_000   # in-memory MVCC window, versions
     STORAGE_DURABILITY_LAG: float = 0.25      # seconds between making versions durable
     STORAGE_FUTURE_VERSION_WAIT: float = 1.0  # read wait before future_version

@@ -104,9 +104,12 @@ class TraceLog:
         self._written = 0
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        # under the write lock: a concurrent emit() must never see a
+        # closed-but-not-None handle (ValueError on a live thread)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
 
 _GLOBAL = TraceLog()

@@ -190,9 +190,9 @@ class BTreeKVStore:
 
     # --- writes ---
 
-    async def commit(self, ops: list[tuple[int, bytes, bytes]],
-                     meta: dict) -> None:
-        """Durably apply one ordered op batch: CoW-update the tree at the
+    async def commit(self, ops, meta: dict) -> None:
+        """Durably apply one ordered op batch (a tuple list or a
+        ``PackedOps`` slice — only iterated): CoW-update the tree at the
         file tail, fsync data, then flip the commit header."""
         eff: dict[bytes, bytes | None] = {}
         for op, p1, p2 in ops:

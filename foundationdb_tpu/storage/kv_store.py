@@ -17,9 +17,11 @@ from __future__ import annotations
 
 from typing import Iterator
 
+from ..core.data import MutationBatch
 from ..rpc.wire import decode, encode
 from .disk_queue import DiskQueue
 from .key_index import PackedKeyIndex
+from .packed_ops import PackedOps
 
 _SNAPSHOT_WAL_BYTES = 1 << 24   # rewrite snapshot when WAL exceeds 16MB
 
@@ -74,12 +76,20 @@ class MemoryKVStore:
             rec = decode(frame)
             if rec["gen"] < kv._snap_gen:
                 continue    # already folded into the snapshot
-            kv._apply(rec["ops"])
+            if "pk" in rec:
+                # packed frame (712 format): (types, bounds, blob)
+                # segments straight back into the apply pass
+                kv._apply(PackedOps([MutationBatch(*p) for p in rec["pk"]]))
+            else:
+                # pre-712 frame: the tuple-list op log
+                kv._apply(rec["ops"])
             kv.meta = rec["meta"]
         return kv
 
-    def _apply(self, ops: list[tuple[int, bytes, bytes]]) -> None:
-        """ops: ordered (OP_SET, key, value) / (OP_CLEAR, begin, end).
+    def _apply(self, ops) -> None:
+        """ops: ordered (OP_SET, key, value) / (OP_CLEAR, begin, end) —
+        any iterable of triples (a tuple list, or a ``PackedOps`` slice
+        decoded lazily per op).
 
         Maintains data AND index together.  Fresh keys batch into one
         sorted overlay append; a run of consecutive clears (the
@@ -89,29 +99,32 @@ class MemoryKVStore:
         data = self._data
         index = self._index
         fresh: list[bytes] = []
-        i, n = 0, len(ops)
-        while i < n:
-            op, p1, p2 = ops[i]
-            if op == OP_SET:
-                if p1 not in data:
-                    fresh.append(p1)
-                data[p1] = p2
-                i += 1
-                continue
-            # clears must see fresh keys from this batch in the index
-            if fresh:
-                index.add_many(fresh)
-                fresh = []
-            j = i
-            while j < n and ops[j][0] == OP_CLEAR:
-                j += 1
+        clears: list[tuple[bytes, bytes]] = []
+
+        def flush_clears() -> None:
             dead: set[bytes] = set()
-            for keys in index.ranges_keys([(o[1], o[2]) for o in ops[i:j]]):
+            for keys in index.ranges_keys(clears):
                 dead.update(keys)
             for k in dead:
                 del data[k]
             index.discard_many(list(dead))
-            i = j
+            clears.clear()
+
+        for op, p1, p2 in ops:
+            if op == OP_SET:
+                if clears:
+                    flush_clears()
+                if p1 not in data:
+                    fresh.append(p1)
+                data[p1] = p2
+            else:
+                # clears must see fresh keys from this batch in the index
+                if fresh:
+                    index.add_many(fresh)
+                    fresh = []
+                clears.append((p1, p2))
+        if clears:
+            flush_clears()
         if fresh:
             index.add_many(fresh)
 
@@ -135,10 +148,19 @@ class MemoryKVStore:
 
     # --- writes ---
 
-    async def commit(self, ops: list[tuple[int, bytes, bytes]],
-                     meta: dict) -> None:
-        """Durably apply one ordered op batch (the durability tick)."""
-        rec = encode({"gen": self._snap_gen, "ops": ops, "meta": meta})
+    async def commit(self, ops, meta: dict) -> None:
+        """Durably apply one ordered op batch (the durability tick).
+
+        A ``PackedOps`` slice rides into the WAL frame as its raw
+        (types, bounds, blob) byte strings — the same objects the TLog
+        pull handed the durability ring, zero-copy end to end; a plain
+        tuple list (GC clears, engine tests) keeps the legacy frame
+        shape."""
+        if isinstance(ops, PackedOps):
+            rec = encode({"gen": self._snap_gen, "pk": ops.wire_parts(),
+                          "meta": meta})
+        else:
+            rec = encode({"gen": self._snap_gen, "ops": ops, "meta": meta})
         await self._wal.push(rec)
         await self._wal.commit()
         self._apply(ops)        # data + index together, clears batched

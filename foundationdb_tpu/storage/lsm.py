@@ -215,8 +215,11 @@ class LSMKVStore:
                 for k in [k for k in self._mem if p1 <= k < p2]:
                     self._mem[k] = _TOMBSTONE
 
-    async def commit(self, ops: list[tuple[int, bytes, bytes]],
-                     meta: dict) -> None:
+    async def commit(self, ops, meta: dict) -> None:
+        if not isinstance(ops, list):
+            # PackedOps slice from the durability ring: this engine's WAL
+            # frames stay tuple-shaped, so materialize the slice once
+            ops = [(op, p1, p2) for op, p1, p2 in ops]
         rec = encode({"gen": self._gen, "ops": ops, "meta": meta})
         await self._wal.push(rec)
         await self._wal.commit()

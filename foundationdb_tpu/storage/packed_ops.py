@@ -1,0 +1,132 @@
+"""Packed op slices — the storage durability buffer and its engine feed.
+
+Reference: REF:fdbserver/storageserver.actor.cpp updateStorage — the
+reference drains the MVCC window's aged-out versions into the engine in
+version order.  The seed kept that pending set as a Python list of
+(version, (op, p1, p2)) tuples rebuilt by TWO full list comprehensions
+per durability tick (ROADMAP PR 1 follow-up (c)): O(total buffered) per
+tick regardless of how little aged out.
+
+``DurabilityRing`` replaces it with an append-only ring of packed
+segments (each a simple-only ``MutationBatch`` — op codes ARE the engine
+WAL op codes) plus a bisect version cursor: each tick commits the slice
+of whole segments at or below the durable floor and advances the cursor,
+O(slice) instead of O(buffer).  A TLog pull batch that took the storage
+fast path lands here as ONE zero-copy segment (the same types/bounds/
+blob objects, no per-op materialization); stragglers (resolved atomics,
+fetchKeys rows) accumulate into small builder segments.
+
+``PackedOps`` is the slice handed to ``engine.commit``: iterable of
+(op, p1, p2) for engines that replay ops, with ``wire_parts()`` exposing
+the raw (types, bounds, blob) triples so the memory engine's WAL frame
+encodes three contiguous byte strings per segment instead of thousands
+of tuple elements.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from ..core.data import MutationBatch, MutationBatchBuilder, Version
+
+__all__ = ["PackedOps", "DurabilityRing"]
+
+
+class PackedOps:
+    """An ordered, zero-copy run of packed op segments."""
+
+    __slots__ = ("segments",)
+
+    def __init__(self, segments: list[MutationBatch]) -> None:
+        self.segments = segments
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.segments)
+
+    def __bool__(self) -> bool:
+        return any(self.segments)
+
+    def __iter__(self):
+        for seg in self.segments:
+            yield from seg.iter_ops()
+
+    @property
+    def nbytes(self) -> int:
+        return sum(s.nbytes for s in self.segments)
+
+    def wire_parts(self) -> list[tuple[bytes, bytes, bytes]]:
+        return [(s.types, s.bounds, s.blob) for s in self.segments]
+
+
+class DurabilityRing:
+    """Version-ordered packed op buffer with a bisect commit cursor.
+
+    Segments are (version, MutationBatch) pairs appended in apply order;
+    versions are non-decreasing, and a version is never split across the
+    commit floor (the floor compares whole versions).  ``peek_through``
+    returns the committable slice WITHOUT consuming it — the caller pops
+    only after the engine commit succeeded, so a failed tick retries the
+    same slice (the disk-trouble contract of the seed's loop).
+    """
+
+    __slots__ = ("_versions", "_segs", "_start", "_pend", "_pend_version")
+
+    def __init__(self) -> None:
+        self._versions: list[Version] = []
+        self._segs: list[MutationBatch] = []
+        self._start = 0                     # segments below are committed
+        self._pend: MutationBatchBuilder | None = None
+        self._pend_version: Version = -1
+
+    def append(self, version: Version, op: int, p1: bytes, p2: bytes) -> None:
+        """Buffer one op (atomics resolved at apply time, fetchKeys rows)."""
+        if self._pend is not None and self._pend_version != version:
+            self._seal()
+        if self._pend is None:
+            self._pend = MutationBatchBuilder()
+            self._pend_version = version
+        self._pend.add(op, p1, p2)
+
+    def extend_packed(self, version: Version, batch: MutationBatch) -> None:
+        """Buffer a whole simple-only batch as one zero-copy segment."""
+        self._seal()
+        self._versions.append(version)
+        self._segs.append(batch)
+
+    def _seal(self) -> None:
+        if self._pend is not None and len(self._pend):
+            self._versions.append(self._pend_version)
+            self._segs.append(self._pend.finish())
+        self._pend = None
+
+    def __len__(self) -> int:
+        n = sum(len(s) for s in self._segs[self._start:])
+        if self._pend is not None:
+            n += len(self._pend)
+        return n
+
+    def peek_through(self, floor: Version) -> PackedOps:
+        """The committable slice: every buffered op at version <= floor."""
+        self._seal()
+        i = bisect.bisect_right(self._versions, floor, lo=self._start)
+        return PackedOps(self._segs[self._start:i])
+
+    def pop_through(self, floor: Version) -> None:
+        """Advance the cursor past the committed slice (amortized trim)."""
+        i = bisect.bisect_right(self._versions, floor, lo=self._start)
+        self._start = i
+        if self._start > 64 and self._start * 2 > len(self._segs):
+            del self._versions[:self._start]
+            del self._segs[:self._start]
+            self._start = 0
+
+    def rollback_after(self, version: Version) -> None:
+        """Discard buffered ops newer than ``version`` (storage rejoin:
+        the unacked suffix of a dead log generation rolls back before
+        it could ever become durable)."""
+        if self._pend is not None and self._pend_version > version:
+            self._pend = None
+        self._seal()
+        i = bisect.bisect_right(self._versions, version, lo=self._start)
+        del self._versions[i:]
+        del self._segs[i:]

@@ -168,6 +168,71 @@ class VersionedMap:
         self.latest_version = latest
         return n
 
+    def apply_packed(self, version: Version, batch) -> int:
+        """Apply one version's simple-only packed ``MutationBatch`` (type
+        codes are OP_SET/OP_CLEAR by construction — MutationType values 0
+        and 1) straight off its columnar arrays: param bytes are sliced
+        from the blob exactly once, and no per-op tuple or ``Mutation``
+        object is ever built.  State after the call is identical to
+        ``apply_batch`` over the equivalent (version, op, p1, p2) run
+        (tests/test_mutation_batch.py proves equivalence on randomized
+        workloads)."""
+        assert version >= self.latest_version, \
+            f"mutations must arrive in version order " \
+            f"(v={version} < latest={self.latest_version})"
+        chains = self._chains
+        touched = self._touched
+        index = self._index
+        types = batch.types
+        offs = batch.offsets()
+        blob = batch.blob
+        fresh: list[bytes] = []
+        clears: list[tuple[bytes, bytes]] = []
+
+        def flush_clears() -> None:
+            for keys in index.ranges_keys(clears):
+                for key in keys:
+                    chain = chains[key]
+                    if chain[-1][1] is not None:
+                        touched.append((version, key))
+                        if chain[-1][0] == version:
+                            chain[-1] = (version, None)
+                        else:
+                            chain.append((version, None))
+            clears.clear()
+
+        prev = 0
+        for i in range(len(types)):
+            e1, e2 = offs[2 * i], offs[2 * i + 1]
+            p1 = blob[prev:e1]
+            if types[i] == OP_SET:
+                if clears:
+                    flush_clears()
+                p2 = blob[e1:e2]
+                touched.append((version, p1))
+                chain = chains.get(p1)
+                if chain is None:
+                    chains[p1] = [(version, p2)]
+                    fresh.append(p1)
+                elif chain[-1][0] == version:
+                    chain[-1] = (version, p2)
+                else:
+                    chain.append((version, p2))
+            else:
+                # clears must see fresh keys from this batch in the
+                # index; consecutive clears resolve vectorized
+                if fresh:
+                    index.add_many(fresh)
+                    fresh = []
+                clears.append((p1, blob[e1:e2]))
+            prev = e2
+        if clears:
+            flush_clears()
+        if fresh:
+            index.add_many(fresh)
+        self.latest_version = version
+        return len(types)
+
     # --- reads ---
 
     def get(self, key: bytes, version: Version) -> bytes | None:

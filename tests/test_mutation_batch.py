@@ -1,0 +1,313 @@
+"""MutationBatch — the packed columnar commit-pipeline wire form.
+
+Encode/decode properties (empty batch, zero-length values, CLEAR_RANGE
+ends, versionstamp ops, 64KB+ blobs), the PROTOCOL_VERSION 712 fence, the
+packed-apply == per-Mutation-apply equivalence on randomized workloads,
+and recovery equivalence across the frame-format change (old tuple/list
+frames ↔ new packed frames) for both the TLog DiskQueue and the memory
+engine WAL.
+"""
+
+import pytest
+
+from foundationdb_tpu.core.data import (KeyRange, Mutation, MutationBatch,
+                                        MutationBatchBuilder, MutationType,
+                                        as_mutation_batch)
+from foundationdb_tpu.rpc.wire import decode, encode
+from foundationdb_tpu.runtime import DeterministicRandom
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+def random_mutations(rng, n, atomics=False):
+    muts = []
+    for _ in range(n):
+        k = bytes(rng.random_int(0, 256) for _ in range(rng.random_int(1, 12)))
+        roll = rng.random_int(0, 10)
+        if roll < 6 or not atomics:
+            muts.append(Mutation.set(k, b"v" * rng.random_int(0, 20)))
+        elif roll < 8:
+            muts.append(Mutation.clear_range(k, k + b"\xff"))
+        else:
+            muts.append(Mutation(MutationType.ADD, k, b"\x01" * 8))
+    return muts
+
+
+# --- encode/decode properties ---
+
+def test_empty_batch():
+    mb = MutationBatch.from_mutations([])
+    assert len(mb) == 0 and not mb and mb.nbytes == 0
+    assert mb.simple_only
+    assert list(mb) == []
+    out = decode(encode(mb))
+    assert out == mb
+
+
+def test_zero_length_values_and_keys():
+    muts = [Mutation.set(b"k", b""), Mutation.set(b"", b""),
+            Mutation.set(b"", b"v")]
+    mb = MutationBatch.from_mutations(muts)
+    assert list(mb) == muts
+    assert decode(encode(mb)) == mb
+    assert mb.nbytes == 2
+    assert mb.set_payload_bytes() == 2
+    assert [mb.param1(i) for i in range(3)] == [b"k", b"", b""]
+    assert [mb.param2(i) for i in range(3)] == [b"", b"", b"v"]
+
+
+def test_clear_range_ends():
+    muts = [Mutation.clear_range(b"a", b"b\x00"),
+            Mutation.clear_range(b"", b"\xff\xff\xff"),
+            Mutation.clear_range(b"x", b"x")]
+    mb = MutationBatch.from_mutations(muts)
+    assert list(mb) == muts
+    assert [mb.param1(i) for i in range(3)] == [b"a", b"", b"x"]
+    assert [mb.param2(i) for i in range(3)] == [b"b\x00", b"\xff\xff\xff", b"x"]
+    assert mb.simple_only
+    assert mb.set_payload_bytes() == 0
+
+
+def test_versionstamp_and_private_ops_not_simple():
+    muts = [Mutation.set(b"a", b"1"),
+            Mutation(MutationType.SET_VERSIONSTAMPED_KEY, b"k" * 14, b"v"),
+            Mutation(MutationType.PRIVATE_DROP_SHARD, b"a", b"z")]
+    mb = MutationBatch.from_mutations(muts)
+    assert not mb.simple_only
+    assert list(mb) == muts
+    assert decode(encode(mb)) == mb
+    assert mb[-1].type == MutationType.PRIVATE_DROP_SHARD
+
+
+def test_large_blob_roundtrip():
+    big = bytes(range(256)) * 256 + b"tail"          # > 64KB
+    muts = [Mutation.set(b"big%03d" % i, big) for i in range(3)]
+    mb = MutationBatch.from_mutations(muts)
+    assert mb.nbytes > 3 * (1 << 16)
+    assert decode(encode(mb)) == mb
+    assert list(decode(encode(mb))) == muts
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_roundtrip_and_accessors(seed):
+    rng = DeterministicRandom(seed)
+    muts = random_mutations(rng, rng.random_int(1, 120), atomics=True)
+    mb = MutationBatch.from_mutations(muts)
+    assert len(mb) == len(muts)
+    assert mb.nbytes == sum(len(m.param1) + len(m.param2) for m in muts)
+    assert mb.set_payload_bytes() == sum(
+        len(m.param1) + len(m.param2) for m in muts
+        if m.type == MutationType.SET_VALUE)
+    for i, m in enumerate(muts):
+        assert mb[i] == m
+    assert decode(encode(mb)) == mb
+    assert list(as_mutation_batch(muts)) == muts
+    assert as_mutation_batch(mb) is mb
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_select_slices(seed):
+    rng = DeterministicRandom(100 + seed)
+    muts = random_mutations(rng, 60, atomics=True)
+    mb = MutationBatch.from_mutations(muts)
+    idxs = [i for i in range(len(muts)) if rng.random_int(0, 2)]
+    sub = mb.select(idxs)
+    assert list(sub) == [muts[i] for i in idxs]
+    # selecting everything is the zero-copy identity
+    assert mb.select(list(range(len(muts)))) is mb
+
+
+def test_select_duplicates_are_not_identity():
+    """A same-LENGTH index list with duplicates (a backup tag colliding
+    with a storage tag) must slice for real — the identity shortcut
+    would leak other tags' mutations (incl. PRIVATE_DROP_SHARD) to the
+    wrong storage server."""
+    muts = [Mutation.set(b"k", b"v"),
+            Mutation(MutationType.PRIVATE_DROP_SHARD, b"a", b"z")]
+    mb = MutationBatch.from_mutations(muts)
+    dup = mb.select([0, 0])
+    assert dup is not mb
+    assert list(dup) == [muts[0], muts[0]]
+
+
+def test_builder_indices():
+    b = MutationBatchBuilder()
+    assert b.add(0, b"k1", b"v1") == 0
+    assert b.add(1, b"a", b"z") == 1
+    mb = b.finish()
+    assert mb[0] == Mutation.set(b"k1", b"v1")
+    assert mb[1] == Mutation.clear_range(b"a", b"z")
+
+
+# --- the protocol fence (711 peer must be refused) ---
+
+def test_version_gate_fences_711_peer():
+    from foundationdb_tpu.core.cluster_client import RecoveredClusterView
+    from foundationdb_tpu.runtime.errors import ClusterVersionChanged
+    new = Knobs()
+    assert new.PROTOCOL_VERSION == 712
+    old = new.override(PROTOCOL_VERSION=711)
+    state = {"epoch": 1, "seq": 0, "protocol": new.PROTOCOL_VERSION}
+    with pytest.raises(ClusterVersionChanged):
+        RecoveredClusterView(old, None, state)
+
+
+# --- packed apply == per-Mutation apply (randomized) ---
+
+def make_storage(knobs):
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+    return StorageServer(knobs, 0, KeyRange(b"", b"\xff"), TLog(knobs))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_packed_apply_equivalence(seed):
+    """_apply_batch over MutationBatch entries (columnar fast path +
+    lazy fallbacks) must produce the identical MVCC state as the same
+    entries applied as Mutation lists."""
+    async def main():
+        rng = DeterministicRandom(seed)
+        knobs = Knobs()
+        ss_list = make_storage(knobs)
+        ss_packed = make_storage(knobs)
+        version = 0
+        all_entries = []
+        for _ in range(12):
+            version += rng.random_int(1, 5)
+            muts = random_mutations(rng, rng.random_int(1, 40), atomics=True)
+            all_entries.append((version, muts))
+        for v, muts in all_entries:
+            ss_list._apply_batch([(v, list(muts))])
+        # packed side: whole reply in one call, like the pull loop
+        ss_packed._apply_batch(
+            [(v, MutationBatch.from_mutations(muts))
+             for v, muts in all_entries])
+        assert ss_list.vmap.keys() == ss_packed.vmap.keys()
+        for probe_v in (version, version - 2, 1):
+            for k in ss_list.vmap.keys():
+                assert ss_list.vmap.get2(k, probe_v) == \
+                    ss_packed.vmap.get2(k, probe_v), (k, probe_v)
+        assert ss_list.bytes_input == ss_packed.bytes_input
+        assert ss_list.logical_bytes == ss_packed.logical_bytes
+        assert ss_list.version == ss_packed.version
+    run_simulation(main())
+
+
+def test_packed_apply_respects_armed_watches():
+    """An armed watch forces the per-item path so it still fires."""
+    import asyncio
+
+    async def main():
+        ss = make_storage(Knobs())
+        ss._apply_batch([(1, MutationBatch.from_mutations(
+            [Mutation.set(b"w", b"a")]))])
+        fut = asyncio.get_running_loop().create_task(
+            ss.watch_value(b"w", b"a", 1))
+        await asyncio.sleep(0)
+        assert not fut.done()
+        ss._apply_batch([(2, MutationBatch.from_mutations(
+            [Mutation.set(b"w", b"b")]))])
+        await asyncio.sleep(0)
+        assert fut.done() and fut.exception() is None
+    run_simulation(main())
+
+
+# --- durability ring slices (satellite: engine receives packed slices) ---
+
+def test_durability_ring_slices_and_rollback():
+    from foundationdb_tpu.storage.packed_ops import DurabilityRing
+    ring = DurabilityRing()
+    ring.append(1, 0, b"a", b"1")
+    ring.extend_packed(2, MutationBatch.from_mutations(
+        [Mutation.set(b"b", b"2"), Mutation.clear_range(b"c", b"d")]))
+    ring.append(3, 0, b"e", b"3")
+    assert len(ring) == 4
+    ops = ring.peek_through(2)
+    assert [(op, p1, p2) for op, p1, p2 in ops] == [
+        (0, b"a", b"1"), (0, b"b", b"2"), (1, b"c", b"d")]
+    assert ops.nbytes == 6
+    # peek is non-destructive (failed engine commit retries the slice)
+    assert [(op, p1, p2) for op, p1, p2 in ring.peek_through(2)] == \
+        [(0, b"a", b"1"), (0, b"b", b"2"), (1, b"c", b"d")]
+    ring.pop_through(2)
+    assert [(op, p1, p2) for op, p1, p2 in ring.peek_through(99)] == \
+        [(0, b"e", b"3")]
+    ring.append(4, 0, b"f", b"4")
+    ring.rollback_after(3)
+    assert [(op, p1, p2) for op, p1, p2 in ring.peek_through(99)] == \
+        [(0, b"e", b"3")]
+
+
+# --- recovery equivalence: old frames ↔ new frames ---
+
+def test_tlog_recovers_old_format_frames():
+    """A DiskQueue written before the 712 packed format (frames holding
+    Mutation lists) must recover into the same peekable state as one
+    written with packed frames."""
+    from foundationdb_tpu.core.tlog import TLog, TLogPushRequest
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.storage.disk_queue import DiskQueue
+
+    async def main():
+        knobs = Knobs()
+        fs = SimFileSystem()
+        muts = {1: [Mutation.set(b"k1", b"v1")],
+                2: [Mutation.set(b"k2", b"v2"),
+                    Mutation.clear_range(b"a", b"b")]}
+        # old-format frames, synthesized exactly as the pre-712 TLog
+        # wrote them: {"v": version, "m": {tag: [Mutation, ...]}}
+        q, _ = await DiskQueue.open(fs.open("old.dq"))
+        for v, ms in muts.items():
+            await q.push(encode({"v": v, "m": {0: ms}}))
+        await q.commit(meta=2)
+        # new-format frames via the live push path
+        new = await TLog.open(knobs, fs, "new.dq")
+        for v, ms in muts.items():
+            await new.push(TLogPushRequest(v - 1, v, {0: list(ms)}))
+        old = await TLog.open(knobs, fs, "old.dq")
+        r_old = await old.peek(0, 1)
+        r_new = await new.peek(0, 1)
+        assert [(v, list(ms)) for v, ms in r_old.entries] == \
+            [(v, list(ms)) for v, ms in r_new.entries]
+        assert old.version == 2
+        # spilled re-reads decode old frames too
+        old._log[0].evict_below(2)
+        r_spill = await old.peek(0, 1)
+        assert [(v, list(ms)) for v, ms in r_spill.entries] == \
+            [(v, list(ms)) for v, ms in r_new.entries]
+    run_simulation(main())
+
+
+def test_kv_store_recovers_old_and_new_wal_frames():
+    """The memory engine must replay pre-712 tuple-list WAL frames and
+    712 packed frames to the same recovered state."""
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.storage.disk_queue import DiskQueue
+    from foundationdb_tpu.storage.kv_store import MemoryKVStore
+    from foundationdb_tpu.storage.packed_ops import DurabilityRing
+
+    ops = [(0, b"k1", b"v1"), (0, b"k2", b"v2"), (1, b"k1", b"k2"),
+           (0, b"k3", b"v3")]
+
+    async def main():
+        fs = SimFileSystem()
+        # old format: hand-write a tuple-list frame into the WAL
+        q, _ = await DiskQueue.open(fs.open("old.wal"))
+        await q.push(encode({"gen": 0, "ops": ops, "meta": {"dv": 7}}))
+        await q.commit()
+        old = await MemoryKVStore.open(fs, "old")
+        # new format: commit the packed slice through the engine
+        ring = DurabilityRing()
+        for op, p1, p2 in ops:
+            ring.append(7, op, p1, p2)
+        new = await MemoryKVStore.open(fs, "new")
+        await new.commit(ring.peek_through(7), {"dv": 7})
+        new2 = await MemoryKVStore.open(fs, "new")   # replay packed frame
+        for kv in (old, new, new2):
+            assert kv.get(b"k1") is None
+            assert kv.get(b"k2") == b"v2"
+            assert kv.get(b"k3") == b"v3"
+            assert list(kv.range(b"", b"\xff")) == [(b"k2", b"v2"),
+                                                    (b"k3", b"v3")]
+            assert kv.meta == {"dv": 7}
+    run_simulation(main())

@@ -18,6 +18,17 @@ def test_apply_throughput_smoke():
     perf_smoke.check(n_keys=100_000, budget_s=perf_smoke.DEFAULT_BUDGET_S)
 
 
+def test_commit_pipeline_throughput_smoke():
+    """The whole in-process commit pipeline (proxy → resolver → TLog →
+    storage apply) under concurrent writers must clear a generous floor:
+    a quadratic shape ANYWHERE on the commit path — proxy tagging, TLog
+    queue accounting, peek re-materialization, durability buffering —
+    blows the budget by an order of magnitude (measured ~0.5s against
+    the 60s budget on a loaded 2-cpu host)."""
+    perf_smoke.check_pipeline(n_txns=perf_smoke.PIPE_TXNS,
+                              budget_s=perf_smoke.PIPE_BUDGET_S)
+
+
 def test_apply_metrics_surface():
     """The apply path must publish its observability counters — a silent
     regression is the other half of the r5 incident."""

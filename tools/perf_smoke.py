@@ -1,16 +1,24 @@
 #!/usr/bin/env python
-"""Apply-path throughput smoke — the tier-1 guard against the next O(n²).
+"""Perf smokes — tier-1 guards against the next O(n²) in the write path.
 
-The r5 bench collapse (BENCH_r05.json, rc 124) was a quadratic index
-insert in the storage apply path that no test caught: tier-1 runs small
-maps, the bench loads 1M rows, and nothing in between measured apply
-throughput.  This check fills the gap at tier-1 cost: 100k fresh keys
-through ``StorageServer._apply_batch`` must land well inside a generous
-wall-clock budget (seconds where the seed path took ~a minute and scaled
-quadratically beyond it).
+Stage 1 (``apply``): the r5 bench collapse (BENCH_r05.json, rc 124) was a
+quadratic index insert in the storage apply path that no test caught:
+tier-1 runs small maps, the bench loads 1M rows, and nothing in between
+measured apply throughput.  This check fills the gap at tier-1 cost:
+100k fresh keys through ``StorageServer._apply_batch`` must land well
+inside a generous wall-clock budget (seconds where the seed path took
+~a minute and scaled quadratically beyond it).
 
-Run directly:  python tools/perf_smoke.py [-n 100000] [--budget 10]
-Run in CI:     wired as tests/test_perf_smoke.py (a normal tier-1 test).
+Stage 2 (``pipeline``): the FULL in-process commit pipeline — client →
+GRV/commit proxy → sequencer → resolver → TLog → storage pull/apply —
+under concurrent write transactions, asserting a throughput floor.  The
+apply smoke cannot see a regression upstream of the storage role (proxy
+tagging, TLog queue accounting, peek re-materialization); this one
+fails fast on any O(n²)-class slip anywhere on the commit path instead
+of at the north-star bench with no summary line.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|all]
+Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
 from __future__ import annotations
@@ -25,6 +33,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 DEFAULT_KEYS = 100_000
 DEFAULT_BUDGET_S = 10.0     # measured ~0.5s on a loaded 1-cpu host
+PIPE_TXNS = 400
+PIPE_CLIENTS = 32
+PIPE_BUDGET_S = 60.0        # measured ~1-2s on a loaded 2-cpu host
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -77,12 +88,127 @@ def check(n_keys: int = DEFAULT_KEYS, budget_s: float = DEFAULT_BUDGET_S,
     return elapsed
 
 
+def commit_pipeline_seconds(n_txns: int = PIPE_TXNS,
+                            n_clients: int = PIPE_CLIENTS,
+                            deadline_s: float | None = None
+                            ) -> tuple[float, dict]:
+    """Wall seconds to commit ``n_txns`` write transactions through a
+    fresh in-process cluster (proxy → resolver → TLog → storage), plus
+    end-of-run stats.  Every commit is awaited at the client boundary,
+    and storage must have APPLIED the final version before the clock
+    stops — the whole pipeline is inside the measured window.
+
+    ``deadline_s`` bounds the whole run: a WEDGED pipeline (deadlock,
+    stalled storage pull — the class this guard exists for) raises
+    AssertionError instead of hanging the test runner forever."""
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    # the exact C++ conflict set (what the bench's cpp side runs); the
+    # numpy twin's padded window rescans dominate the measurement long
+    # before the pipeline itself does, so only fall back if the native
+    # build is genuinely unavailable
+    knobs = Knobs()
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        knobs = knobs.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin, generous budget
+        pass
+
+    async def main() -> tuple[float, dict]:
+        cluster = Cluster(ClusterConfig(), knobs)
+        cluster.start()
+        committed = 0
+        retried = 0
+        issued = iter(range(n_txns))
+        t0 = time.perf_counter()
+
+        async def client(cid: int) -> None:
+            nonlocal committed, retried
+            tr = Transaction(cluster)
+            for i in issued:
+                while True:
+                    try:
+                        tr.set(b"pipe%08d" % i, b"v" * 64)
+                        tr.set(b"pipe-b%08d" % i, b"w" * 64)
+                        await tr.commit()
+                        committed += 1
+                        tr.reset()
+                        break
+                    except FdbError as e:
+                        retried += 1
+                        await tr.on_error(e)
+
+        async def drive() -> None:
+            await asyncio.gather(*(client(c) for c in range(n_clients)))
+            # commit versions must be APPLIED on storage (not only logged)
+            tip = cluster.sequencer.committed_version
+            while min(s.version for s in cluster.storage_servers) < tip:
+                await asyncio.sleep(0.01)
+
+        try:
+            await asyncio.wait_for(drive(), deadline_s)
+        except asyncio.TimeoutError:
+            await cluster.stop()
+            raise AssertionError(
+                f"commit pipeline wedged: {committed}/{n_txns} txns "
+                f"committed when the {deadline_s:.0f}s deadline hit — a "
+                f"deadlock or stalled storage pull, not just slowness"
+            ) from None
+        elapsed = time.perf_counter() - t0
+        stats = {
+            "committed": committed,
+            "retried": retried,
+            "tps": committed / elapsed if elapsed else 0.0,
+            "storage_version": min(s.version
+                                   for s in cluster.storage_servers),
+            "mutations_applied": sum(
+                s.apply_meter.count for s in cluster.storage_servers),
+        }
+        await cluster.stop()
+        return elapsed, stats
+
+    return asyncio.run(main())
+
+
+def check_pipeline(n_txns: int = PIPE_TXNS, n_clients: int = PIPE_CLIENTS,
+                   budget_s: float = PIPE_BUDGET_S,
+                   quiet: bool = False) -> float:
+    """Run the commit-pipeline smoke; raises AssertionError past the
+    budget (a generous floor: ~1-2s measured, minutes when an O(n²)
+    shape lands anywhere on the commit path).  The budget doubles as a
+    hard deadline so a wedged pipeline fails instead of hanging CI."""
+    elapsed, stats = commit_pipeline_seconds(n_txns, n_clients,
+                                             deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] commit pipeline: {stats['committed']} txns in "
+              f"{elapsed:.3f}s ({stats['tps']:.0f} tps, "
+              f"{stats['retried']} retries, "
+              f"{stats['mutations_applied']} mutations applied)")
+    assert stats["committed"] == n_txns, stats
+    assert elapsed < budget_s, (
+        f"commit-pipeline throughput regression: {n_txns} txns took "
+        f"{elapsed:.1f}s (budget {budget_s:.0f}s) — proxy tagging, TLog "
+        f"accounting, or storage apply grew a quadratic shape")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
     ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
+    ap.add_argument("--stage", choices=("apply", "pipeline", "all"),
+                    default="all")
+    ap.add_argument("--txns", type=int, default=PIPE_TXNS)
+    ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
     args = ap.parse_args()
-    check(args.keys, args.budget)
+    if args.stage in ("apply", "all"):
+        check(args.keys, args.budget)
+    if args.stage in ("pipeline", "all"):
+        check_pipeline(args.txns, budget_s=args.pipe_budget)
     return 0
 
 
