@@ -555,6 +555,94 @@ def run_multi_resolver_phase(quiet: bool) -> dict:
     return res
 
 
+def run_feed_tail_phase(quiet: bool) -> dict:
+    """Change-feed tail stage (ISSUE 4): concurrent writers + a LIVE
+    feed consumer over the in-process commit pipeline.  Reports
+    streaming throughput and per-delivery lag — delivery wall time
+    minus the owning commit's ack wall time — the number a derived
+    read path (cache, index, replication fan-out) actually serves at."""
+    import asyncio
+
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    n_txns, n_clients = 600, 24
+    knobs = Knobs()
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        knobs = knobs.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin is fine for this shape
+        pass
+
+    async def main() -> dict:
+        cluster = Cluster(ClusterConfig(storage_servers=2), knobs)
+        cluster.start()
+        db = Database(cluster)
+        await db.create_change_feed(b"bench-feed", b"bf", b"bg")
+        commit_t: dict[int, float] = {}
+        committed = 0
+        max_version = 0
+        issued = iter(range(n_txns))
+        t0 = time.perf_counter()
+
+        async def client(cid: int) -> None:
+            nonlocal committed, max_version
+            tr = Transaction(cluster)
+            for i in issued:
+                while True:
+                    try:
+                        tr.set(b"bf%08d" % i, b"v" * 100)
+                        v = await tr.commit()
+                        commit_t.setdefault(v, time.perf_counter())
+                        max_version = max(max_version, v)
+                        committed += 1
+                        tr.reset()
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+
+        lags: list[float] = []
+        seen = 0
+
+        async def consume() -> None:
+            nonlocal seen
+            cur = db.read_change_feed(b"bench-feed")
+            while committed < n_txns or cur.version <= max_version:
+                for v, b in await cur.next():
+                    now = time.perf_counter()
+                    seen += len(b)
+                    tc = commit_t.get(v)
+                    if tc is not None:
+                        lags.append((now - tc) * 1e3)
+
+        consumer = asyncio.ensure_future(consume())
+        await asyncio.gather(*(client(c) for c in range(n_clients)))
+        await consumer
+        elapsed = time.perf_counter() - t0
+        await cluster.stop()
+        lags.sort()
+        return {
+            "feed_mutations_per_sec":
+                round(seen / elapsed, 1) if elapsed else 0.0,
+            "feed_lag_ms_p50":
+                round(lags[len(lags) // 2], 2) if lags else None,
+            "feed_lag_ms_p99":
+                round(lags[min(len(lags) - 1, int(len(lags) * 0.99))], 2)
+                if lags else None,
+            "feed_mutations_seen": seen,
+            "feed_txns": committed,
+        }
+
+    r = asyncio.run(main())
+    if not quiet:
+        print(f"[bench] feed tail: {r}", file=sys.stderr)
+    return r
+
+
 def project_local_attach(out: dict, e2e: dict) -> dict:
     """Locally-attached projection (VERDICT r4 1c): what the tpu e2e
     number becomes with the tunnel RTT removed, computed from MEASURED
@@ -777,6 +865,14 @@ def main() -> int:
                 args.stage_timeout, out)
             if mr is not None:
                 out["multi_resolver_scaling"] = mr
+
+            # change-feed tail (ISSUE 4): streaming throughput + lag of
+            # a live consumer riding the same pipeline
+            ft = call_bounded(
+                "feed_tail", lambda: run_feed_tail_phase(args.quiet),
+                args.stage_timeout, out)
+            if ft is not None:
+                out.update(ft)
 
             def abort_parity():
                 # the abort-parity gate (BASELINE.md config-2): encoded

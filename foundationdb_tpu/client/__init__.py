@@ -8,4 +8,5 @@ RYW layer is not optional in practice.
 
 from .database import Database
 from .transaction import Transaction
+from .change_feed import ChangeFeedCursor
 from ..core.data import KeySelector

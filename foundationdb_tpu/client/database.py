@@ -64,3 +64,29 @@ class Database:
     async def get_range(self, begin, end, limit: int = 0,
                         reverse: bool = False) -> list[tuple[bytes, bytes]]:
         return await self.run(lambda tr: tr.get_range(begin, end, limit, reverse))
+
+    # --- change feeds (ISSUE 4; see client/change_feed.py) ---
+
+    async def create_change_feed(self, feed_id: bytes, begin: bytes,
+                                 end: bytes) -> Version:
+        """Register a feed over [begin, end); returns the registration's
+        commit version (mutations strictly above it flow in)."""
+        from .change_feed import create_change_feed
+        return await create_change_feed(self, feed_id, begin, end)
+
+    async def destroy_change_feed(self, feed_id: bytes) -> None:
+        from .change_feed import destroy_change_feed
+        await destroy_change_feed(self, feed_id)
+
+    async def pop_change_feed(self, feed_id: bytes, version: Version) -> None:
+        """Durably release feed data at or below ``version``."""
+        from .change_feed import pop_change_feed
+        await pop_change_feed(self, feed_id, version)
+
+    def read_change_feed(self, feed_id: bytes, begin_version: Version = 0,
+                         begin: bytes | None = None,
+                         end: bytes | None = None):
+        """A ChangeFeedCursor resuming at ``begin_version`` (exclusive of
+        already-processed versions; pass 0 to start from registration)."""
+        from .change_feed import ChangeFeedCursor
+        return ChangeFeedCursor(self, feed_id, begin_version, begin, end)

@@ -24,9 +24,10 @@ from ..runtime.errors import (ClientInvalidOperation, ClusterVersionChanged,
                               CommitUnknownResult, NotCommitted,
                               TransactionTooOld)
 from ..runtime.knobs import Knobs
-from .data import (SYSTEM_PREFIX, CommitResult, CommitTransactionRequest,
-                   Mutation, MutationBatch, MutationBatchBuilder,
-                   MutationType, Version, pack_versionstamp)
+from .data import (PRIVATE_TYPES, SYSTEM_PREFIX, CommitResult,
+                   CommitTransactionRequest, Mutation, MutationBatch,
+                   MutationBatchBuilder, MutationType, Version,
+                   pack_versionstamp)
 from .resolver import ResolveBatchRequest, Resolver, clip_txn_to_range
 from .sequencer import Sequencer
 from .shard_map import ShardMap, write_team_drops
@@ -85,6 +86,15 @@ class CommitProxy:
         # database lock (REF: lockedKey in ProxyCommitData): while set,
         # only lock-aware transactions may commit.  Versioned the same way.
         self._locks: list[tuple[Version, bytes | None]] = [(-1, locked)]
+        # registered change feeds: feed id -> (begin, end).  Unlike the
+        # shard maps / backup tags / locks, no consumer ever needs the
+        # registry AT a historical version — markers are computed inside
+        # _apply_metadata, which runs strictly in version order — so a
+        # plain dict suffices (\xff/changeFeeds state transactions
+        # mutate it at their exact commit version on every proxy, and
+        # the OWNING proxy injects PRIVATE_FEED_* markers into the
+        # owning storage tags' streams)
+        self._feeds: dict[bytes, tuple[bytes, bytes]] = {}
         # versioned shard-map history: the map at index i is effective for
         # commit versions >= its change version.  Layout changes arrive as
         # state-transaction entries (the txnStateStore of this proxy) and
@@ -98,13 +108,20 @@ class CommitProxy:
         # entry for version V may be applied by ANOTHER in-flight batch
         # whose reply arrived first — the batch that OWNS version V must
         # still find and push V's markers exactly once.
-        self._pending_drops: dict[Version, list[tuple[int, bytes, bytes]]] = {}
+        self._pending_drops: dict[Version,
+                                  list[tuple[int, int, bytes, bytes]]] = {}
         self._queue: asyncio.Queue = asyncio.Queue()
         self._batcher_task: asyncio.Task | None = None
         self._inflight: set[asyncio.Task] = set()
         self.total_batches = 0
         self.total_committed = 0
         self.total_conflicts = 0
+        # this proxy's fully-acked frontier: the newest version whose
+        # push every hosting log acked.  Rides every later push (real
+        # and empty) as TLogPushRequest.known_committed, giving
+        # downstream consumers a committed floor (feed heartbeats must
+        # never expose a possibly-unacked applied tip).
+        self._known_committed: Version = 0
         from ..runtime.trace import CounterCollection, Histogram
         from ..runtime.latency_probe import StageStats
         self.counters = CounterCollection("ProxyCommit")
@@ -145,19 +162,21 @@ class CommitProxy:
     # --- metadata mutations (REF:fdbserver/ApplyMetadataMutation.cpp) ---
 
     def _apply_state_entries(self, entries, own_version: Version | None = None
-                             ) -> list[tuple[int, bytes, bytes]]:
+                             ) -> list[tuple[int, int, bytes, bytes]]:
         """Apply committed state entries in version order; returns the
-        drop markers for the entry at ``own_version`` (only the proxy that
-        owns that batch pushes them to the TLogs — exactly once).  The
-        markers are retrieved from _pending_drops rather than the apply
-        call, because a pipelined batch at a higher version may have
-        applied our entry before our own reply arrived."""
-        for v, muts in sorted(entries or []):
+        private markers (shard drops, feed lifecycle) for the entry at
+        ``own_version`` (only the proxy that owns that batch pushes them
+        to the TLogs — exactly once).  The markers are retrieved from
+        _pending_drops rather than the apply call, because a pipelined
+        batch at a higher version may have applied our entry before our
+        own reply arrived.  Entries arrive sorted by version; the
+        piggyback ships mutations packed (MutationBatch) since 713."""
+        for v, muts in sorted(entries or [], key=lambda e: e[0]):
             if v <= self.state_applied_version:
                 continue
-            drops = self._apply_metadata(v, muts)
-            if drops:
-                self._pending_drops[v] = drops
+            markers = self._apply_metadata(v, muts)
+            if markers:
+                self._pending_drops[v] = markers
                 if len(self._pending_drops) > 256:
                     # entries owned by other proxies are never popped;
                     # old ones can no longer be claimed by any batch
@@ -168,14 +187,56 @@ class CommitProxy:
         return self._pending_drops.pop(own_version, [])
 
     def _apply_metadata(self, version: Version, muts
-                        ) -> list[tuple[int, bytes, bytes]]:
+                        ) -> list[tuple[int, int, bytes, bytes]]:
+        """Returns (tag, private mutation type, param1, param2) markers
+        the owning batch must inject into those tags' streams."""
         from ..rpc.wire import decode
         from ..runtime.trace import TraceEvent
         from .system_data import (BACKUP_PREFIX, BACKUP_TAGS_PREFIX,
+                                  CHANGE_FEED_POP_PREFIX, CHANGE_FEED_PREFIX,
                                   LAYOUT_KEY, LOCKED_KEY, backup_tag_key)
         backup_key = BACKUP_PREFIX + b"tag"
-        drops: list[tuple[int, bytes, bytes]] = []
+        markers: list[tuple[int, int, bytes, bytes]] = []
         for m in muts:
+            # -- change-feed lifecycle (create / pop via SET) --
+            if m.type == MutationType.SET_VALUE \
+                    and m.param1.startswith(CHANGE_FEED_PREFIX):
+                fid = m.param1[len(CHANGE_FEED_PREFIX):]
+                try:
+                    info = decode(m.param2)
+                    fb, fe = bytes(info["b"]), bytes(info["e"])
+                except Exception as e:  # noqa: BLE001 — bad blob: ignore
+                    TraceEvent("ProxyBadFeed", severity=30) \
+                        .detail("Error", repr(e)[:100]).log()
+                    continue
+                if fid not in self._feeds:  # re-register is idempotent
+                    self._feeds[fid] = (fb, fe)
+                    for t in self._maps[-1][1].tags_for_range(fb, fe):
+                        markers.append(
+                            (t, int(MutationType.PRIVATE_FEED_REGISTER),
+                             fid, bytes(m.param2)))
+                    TraceEvent("ProxyFeedRegistered") \
+                        .detail("Version", version).detail("Feed", fid) \
+                        .detail("Begin", fb).detail("End", fe).log()
+                continue
+            if m.type == MutationType.SET_VALUE \
+                    and m.param1.startswith(CHANGE_FEED_POP_PREFIX):
+                fid = m.param1[len(CHANGE_FEED_POP_PREFIX):]
+                rng = self._feeds.get(fid)
+                try:
+                    int(decode(m.param2))
+                except Exception as e:  # noqa: BLE001 — bad blob: a
+                    # forwarded garbage payload would crash every owning
+                    # storage server's apply loop
+                    TraceEvent("ProxyBadFeedPop", severity=30) \
+                        .detail("Error", repr(e)[:100]).log()
+                    rng = None
+                if rng is not None:
+                    for t in self._maps[-1][1].tags_for_range(*rng):
+                        markers.append(
+                            (t, int(MutationType.PRIVATE_FEED_POP),
+                             fid, bytes(m.param2)))
+                continue
             # -- mutation-log tag arm/disarm (named slots) --
             name = None
             if m.param1 == backup_key:
@@ -204,6 +265,18 @@ class CommitProxy:
                     self._backup_tags.append((version, cur))
                     TraceEvent("ProxyBackupTag").detail("Version", version) \
                         .detail("Armed", sorted(cur)).log()
+                # -- change-feed destroy (clear of the registration key) --
+                doomed = {fid: rng for fid, rng in self._feeds.items()
+                          if m.param1 <= CHANGE_FEED_PREFIX + fid < m.param2}
+                for fid, rng in doomed.items():
+                    del self._feeds[fid]
+                    for t in self._maps[-1][1].tags_for_range(*rng):
+                        markers.append(
+                            (t, int(MutationType.PRIVATE_FEED_DESTROY),
+                             fid, b""))
+                    TraceEvent("ProxyFeedDestroyed") \
+                        .detail("Version", version).detail("Feed", fid) \
+                        .log()
                 if m.param1 <= LOCKED_KEY < m.param2:
                     self._locks.append((version, None))
                     self.sequencer.report_lock(version, None)
@@ -226,12 +299,14 @@ class CommitProxy:
                 TraceEvent("ProxyBadLayout", severity=40) \
                     .detail("Error", repr(e)[:100]).log()   # kill the proxy
                 continue
-            drops.extend(write_team_drops(self._maps[-1][1], new))
+            drop_type = int(MutationType.PRIVATE_DROP_SHARD)
+            markers.extend((t, drop_type, b, e) for t, b, e
+                           in write_team_drops(self._maps[-1][1], new))
             self._maps.append((version, new))
             TraceEvent("ProxyLayoutApplied").detail("Version", version) \
                 .detail("Shards", len(new.shard_tags)) \
-                .detail("Drops", len(drops)).log()
-        return drops
+                .detail("Drops", len(markers)).log()
+        return markers
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -369,7 +444,10 @@ class CommitProxy:
                                     self.state_applied_version))
                 for r in self.resolvers))
             self._apply_state_entries(replies[0].state_entries)
-            await self.log_system.push(prev_version, version, {})
+            await self.log_system.push(prev_version, version, {},
+                                       self._known_committed)
+            if version > self._known_committed:
+                self._known_committed = version
             self.sequencer.report_committed(version)
         except Exception as e:
             from ..runtime.trace import TraceEvent
@@ -423,10 +501,11 @@ class CommitProxy:
                             from ..runtime.errors import DatabaseLocked
                             raise DatabaseLocked()
                 for m in req.mutations:
-                    if m.type == MutationType.PRIVATE_DROP_SHARD:
-                        # proxies append drop markers themselves after
+                    if m.type in PRIVATE_TYPES:
+                        # proxies append private markers themselves after
                         # tagging; one arriving IN a client request is
-                        # forged and would discard a shard
+                        # forged and would discard a shard or corrupt a
+                        # feed's lifecycle
                         raise ClientInvalidOperation(
                             "private mutation type in client commit")
                     self._substitute_versionstamp(m, 0, 0)
@@ -477,9 +556,13 @@ class CommitProxy:
             if is_state:
                 # singleton by the batcher's construction; ranges ride
                 # unclipped + mutations piggyback so every resolver logs
-                # the identical committed-state stream
+                # the identical committed-state stream.  Packed since 713
+                # (ROADMAP PR 3 follow-up (a)): one encode here, and the
+                # resolver's state log + every proxy's reply share the
+                # same columnar struct the rest of the pipeline speaks.
                 assert len(reqs) == 1
-                state_txns = [(0, list(reqs[0].mutations))]
+                state_txns = [(0, MutationBatch.from_mutations(
+                    reqs[0].mutations))]
 
             # broadcast to all resolvers, clipped to each partition
             async def ask(res: Resolver):
@@ -513,7 +596,7 @@ class CommitProxy:
             # other proxies' — identical on every resolver, take the
             # first's) BEFORE tagging, then tag with the map as of THIS
             # batch's version
-            my_drops = self._apply_state_entries(
+            my_markers = self._apply_state_entries(
                 replies[0].state_entries, own_version=version)
             shard_map = self.map_at(version)
             backup_tags = self.backup_tags_at(version)
@@ -568,11 +651,11 @@ class CommitProxy:
                         if bt not in tags:
                             tag_idx.setdefault(bt, []).append(mi)
                 order += 1
-            # ownership handoff markers for a layout change this batch
-            # committed: each losing tag sees the drop at exactly this
-            # version in its own mutation stream
-            for t, b, e in my_drops:
-                mi = builder.add(int(MutationType.PRIVATE_DROP_SHARD), b, e)
+            # private markers for metadata this batch committed (shard
+            # handoffs, feed register/pop/destroy): each addressed tag
+            # sees the marker at exactly this version in its own stream
+            for t, mt, p1, p2 in my_markers:
+                mi = builder.add(mt, p1, p2)
                 tag_idx.setdefault(t, []).append(mi)
             batch_packed = builder.finish()
             tagged: dict[int, MutationBatch] = {
@@ -582,9 +665,12 @@ class CommitProxy:
             push_started = True
             t0 = loop.time()
             with _span.child_scope(batch_ctx):
-                await self.log_system.push(prev_version, version, tagged)
+                await self.log_system.push(prev_version, version, tagged,
+                                           self._known_committed)
             self.stages.record("push", loop.time() - t0)
             pushed = True
+            if version > self._known_committed:
+                self._known_committed = version
             for c in sampled:
                 self.spans.event("CommitDebug", c,
                                  "CommitProxyServer.commitBatch."
@@ -620,9 +706,15 @@ class CommitProxy:
                     self.counters.counter("TxnConflicts").add(1)
                     fut.set_exception(NotCommitted())
         except asyncio.CancelledError:
+            # cancelled mid-push (role stop during an epoch change): some
+            # TLog may already hold the batch, so the outcome is exactly
+            # as ambiguous as the non-cancel failure path — a freely
+            # retryable error here would let a client double-commit
+            err = CommitUnknownResult() if push_started \
+                else ClusterVersionChanged()
             for fut in futs:
                 if not fut.done():
-                    fut.set_exception(ClusterVersionChanged())
+                    fut.set_exception(err)
             raise
         except Exception as e:
             from ..runtime.trace import TraceEvent
@@ -689,7 +781,7 @@ class CommitProxy:
             if not pushed:
                 await self.log_system.push(prev_version, version,
                                            tagged if resolved and tagged
-                                           else {})
+                                           else {}, self._known_committed)
             self.sequencer.report_committed(version)
         except Exception:
             pass  # a failed repair means the epoch is dead; recovery's job

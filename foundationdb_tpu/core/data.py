@@ -127,12 +127,28 @@ class MutationType(enum.IntEnum):
     # \xff\xff systemKeysPrefix).  param1=begin, param2=end of the range
     # this tag stops owning as of the mutation's version.
     PRIVATE_DROP_SHARD = 30
+    # Change-feed control markers (REF:fdbserver/ApplyMetadataMutation.cpp
+    # changeFeedPrivatePrefix): a \xff/changeFeeds state transaction is
+    # translated by the OWNING commit proxy into these, tagged to every
+    # storage tag whose shard intersects the feed range, so feed
+    # lifecycle transitions land at an exact point in each tag's version
+    # order.  REGISTER: param1=feed id, param2=encoded {begin, end}.
+    # DESTROY: param1=feed id.  POP: param1=feed id, param2=encoded
+    # pop version (the consumer's durable low-water mark).
+    PRIVATE_FEED_REGISTER = 31
+    PRIVATE_FEED_DESTROY = 32
+    PRIVATE_FEED_POP = 33
 
+
+PRIVATE_TYPES = frozenset((
+    MutationType.PRIVATE_DROP_SHARD, MutationType.PRIVATE_FEED_REGISTER,
+    MutationType.PRIVATE_FEED_DESTROY, MutationType.PRIVATE_FEED_POP,
+))
 
 ATOMIC_TYPES = frozenset(
     t for t in MutationType
-    if t not in (MutationType.SET_VALUE, MutationType.CLEAR_RANGE,
-                 MutationType.PRIVATE_DROP_SHARD)
+    if t not in (MutationType.SET_VALUE, MutationType.CLEAR_RANGE)
+    and t not in PRIVATE_TYPES
 )
 
 
@@ -278,27 +294,53 @@ class MutationBatch:
 
     def select(self, idxs: list[int]) -> "MutationBatch":
         """Sub-batch of the given (non-decreasing) mutation indices —
-        how the proxy slices one packed batch per destination tag.
+        how the proxy slices one packed batch per destination tag and
+        how a storage server clips a batch to a change feed's range.
         Selecting exactly everything returns self (the single-shard
         common case ships with zero copies); a same-length list with
-        duplicates is NOT the identity and is sliced for real."""
-        if len(idxs) == len(self.types) \
-                and all(idxs[i] == i for i in range(len(idxs))):
+        duplicates is NOT the identity and is sliced for real.
+
+        Offset arithmetic is vectorized with numpy above a small-list
+        threshold (ROADMAP PR 3 follow-up (b)): change feeds make
+        per-apply ``select`` calls hot, and the cumulative-offset
+        rebuild is exactly a gather + cumsum."""
+        n_sel = len(idxs)
+        if n_sel == len(self.types) \
+                and all(idxs[i] == i for i in range(n_sel)):
             return self
-        offs = self.offsets()
         blob = self.blob
-        bounds = _array("I")
-        chunks: list[bytes] = []
-        pos = 0
-        for i in idxs:
-            start = offs[2 * i - 1] if i else 0
-            e1, e2 = offs[2 * i], offs[2 * i + 1]
-            chunks.append(blob[start:e2])
-            pos += e2 - start
-            bounds.append(pos - (e2 - e1))
-            bounds.append(pos)
-        return MutationBatch(bytes(self.types[i] for i in idxs),
-                             _bounds_to_wire(bounds), b"".join(chunks))
+        if n_sel < 16:
+            # tiny slices (the proxy's few-mutations-per-tag case):
+            # numpy call overhead exceeds the loop
+            offs = self.offsets()
+            bounds = _array("I")
+            chunks: list[bytes] = []
+            pos = 0
+            for i in idxs:
+                start = offs[2 * i - 1] if i else 0
+                e1, e2 = offs[2 * i], offs[2 * i + 1]
+                chunks.append(blob[start:e2])
+                pos += e2 - start
+                bounds.append(pos - (e2 - e1))
+                bounds.append(pos)
+            return MutationBatch(bytes(self.types[i] for i in idxs),
+                                 _bounds_to_wire(bounds), b"".join(chunks))
+        import numpy as np
+        idx = np.asarray(idxs, dtype=np.int64)
+        offs = np.frombuffer(self.bounds, dtype="<u4").astype(np.int64)
+        e1 = offs[2 * idx]
+        e2 = offs[2 * idx + 1]
+        # param1 of mutation i starts at pair i-1's param2 end (0 for i=0);
+        # offs[-1] under the mask is never selected by the where
+        starts = np.where(idx > 0, offs[2 * idx - 1], 0)
+        pos = np.cumsum(e2 - starts)
+        bounds_arr = np.empty(2 * n_sel, dtype="<u4")
+        bounds_arr[0::2] = pos - (e2 - e1)
+        bounds_arr[1::2] = pos
+        types = np.frombuffer(self.types, dtype=np.uint8)[idx].tobytes()
+        return MutationBatch(
+            types, bounds_arr.tobytes(),
+            b"".join(blob[s:e] for s, e in zip(starts.tolist(), e2.tolist())))
 
     @classmethod
     def from_mutations(cls, muts) -> "MutationBatch":

@@ -11,7 +11,7 @@ from __future__ import annotations
 import asyncio
 
 from ..runtime.knobs import Knobs
-from ..runtime.span import SpanSink, current_span, no_span
+from ..runtime.span import ServerSampler, SpanSink, current_span, no_span
 from .data import Version
 from .sequencer import Sequencer
 
@@ -33,6 +33,12 @@ class GrvProxy:
         # GrvProxyServer.queued/reply locations of the reference)
         self.spans = SpanSink("GrvProxy")
         self.sampled_txns = 0
+        # deterministic 1-in-N SERVER-side roots for requests arriving
+        # without a sampled client context (ROADMAP PR 2 follow-up (a)):
+        # a GRV/read-only-heavy workload whose client never samples —
+        # old bindings, sidecar probes — still shows up in the trace
+        # file with GrvProxyServer.queued/reply timelines
+        self._server_sampler = ServerSampler(namespace=1)
 
     async def metrics(self) -> dict:
         """Role counters for status (span rollup + GRV load)."""
@@ -48,6 +54,8 @@ class GrvProxy:
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         ctx = current_span()
+        if ctx is None:
+            ctx = self._server_sampler.root(self.knobs.SERVER_SPAN_SAMPLE)
         if ctx is not None and ctx.sampled:
             self.sampled_txns += 1
             self.spans.event("TransactionDebug", ctx,

@@ -71,3 +71,10 @@ class ReplicaGroup:
 
     async def watch_value(self, key: bytes, value, version: int):
         return await self._call("watch_value", key, value, version)
+
+    async def change_feed_stream(self, req):
+        """Feed long-poll with the same replica failover as reads: the
+        retained window is replicated (every team member captures from
+        its own tag stream), so a dead replica costs one retry, not a
+        gap in the stream."""
+        return await self._call("change_feed_stream", req)

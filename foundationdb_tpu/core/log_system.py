@@ -100,11 +100,13 @@ class LogSystem:
     # --- push (REF: LogSystem::push) ---
 
     async def push(self, prev_version: Version, version: Version,
-                   tagged: dict[Tag, list]) -> None:
+                   tagged: dict[Tag, list],
+                   known_committed: Version = 0) -> None:
         """Replicate each tag's messages onto its hosting logs; every log
         receives the push frame (possibly tagless) so all version chains
         stay gap-free.  Acks only when ALL logs acked — which is what makes
-        min(tips) a safe recovery version later."""
+        min(tips) a safe recovery version later.  ``known_committed`` is
+        the pusher's fully-acked frontier, forwarded to every log."""
         import asyncio
         gen = self.current
         per_log: list[dict[Tag, list]] = [{} for _ in gen.tlogs]
@@ -121,7 +123,8 @@ class LogSystem:
                 # replicas receive the push at very different times —
                 # stresses recovery's min(tip) reasoning
                 await asyncio.sleep(deterministic_random().random() * 0.03)
-            return await t.push(TLogPushRequest(prev_version, version, msgs))
+            return await t.push(TLogPushRequest(prev_version, version, msgs,
+                                                known_committed))
 
         pushes = [one(t, msgs) for t, msgs in zip(gen.tlogs, per_log)]
         # satellites replicate the FULL tagged batch (all-tag copies) and
@@ -216,7 +219,10 @@ class LogCursor:
                 raise last_err  # type: ignore[misc]
             if gen.end_version is not None:
                 # clamp: entries above a locked generation's end were
-                # never acked and must not be applied
+                # never acked and must not be applied.  Everything an
+                # ENDED generation serves is committed by construction
+                # (the recovery version IS the acked frontier), so its
+                # known_committed is the clamp itself.
                 clamp = gen.end_version
                 entries = [(v, m) for v, m in reply.entries if v <= clamp]
                 end = min(reply.end_version, clamp + 1)
@@ -225,7 +231,7 @@ class LogCursor:
                     self.version = max(self.version, clamp + 1)
                     continue
                 self.version = max(self.version, end)
-                return TLogPeekReply(entries, end)
+                return TLogPeekReply(entries, end, clamp)
             self.version = max(self.version, reply.end_version)
             return reply
 

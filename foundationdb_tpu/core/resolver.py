@@ -24,7 +24,7 @@ from ..ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnRequest
 from ..runtime.errors import ResolverFailed
 from ..runtime.knobs import Knobs
 from ..runtime.span import SpanSink, current_span, no_span
-from .data import KeyRange, Version
+from .data import KeyRange, Version, as_mutation_batch
 
 
 @dataclasses.dataclass
@@ -33,8 +33,12 @@ class ResolveBatchRequest:
 
     ``state_txns`` carries the mutations of system-keyspace ("state")
     transactions in this batch as (txn_index, mutations) pairs — the
-    txnStateTransactions piggyback of the reference.  The proxy sends
-    state transactions' conflict ranges UNCLIPPED to every resolver and
+    txnStateTransactions piggyback of the reference.  Since 713 the
+    mutations ship as one packed ``MutationBatch`` (the same columnar
+    struct the rest of the pipeline speaks — ROADMAP PR 3 follow-up
+    (a)); a bare ``list[Mutation]`` from a sidecar producer still
+    normalizes at the state-log boundary.  The proxy sends state
+    transactions' conflict ranges UNCLIPPED to every resolver and
     alone in their batch, so all resolvers compute the identical verdict
     and log the identical committed-state stream.
 
@@ -46,14 +50,14 @@ class ResolveBatchRequest:
     prev_version: Version
     version: Version
     txns: list[TxnRequest]
-    state_txns: list | None = None          # [(txn_index, [Mutation])]
+    state_txns: list | None = None      # [(txn_index, MutationBatch)]
     state_known_version: Version = -1
 
 
 @dataclasses.dataclass
 class ResolveBatchReply:
     verdicts: list[int]   # per-txn COMMITTED/CONFLICT/TOO_OLD
-    state_entries: list | None = None       # [(version, [Mutation])]
+    state_entries: list | None = None   # [(version, MutationBatch)]
 
 
 class Resolver:
@@ -194,7 +198,8 @@ class Resolver:
                 finish = None
                 for idx, muts in req.state_txns:
                     if verdicts[idx] == COMMITTED:
-                        self._state_log.append((req.version, muts))
+                        self._state_log.append(
+                            (req.version, as_mutation_batch(muts)))
                 self._advance_to(req.version)
             else:
                 self._advance_to(req.version)
@@ -250,7 +255,8 @@ class Resolver:
         if req.state_txns:
             for idx, muts in req.state_txns:
                 if verdicts[idx] == COMMITTED:
-                    self._state_log.append((req.version, muts))
+                    self._state_log.append(
+                        (req.version, as_mutation_batch(muts)))
             self._advance_to(req.version)
         self.total_batches += 1
         self.total_txns += len(req.txns)

@@ -127,6 +127,29 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             default=0.0),
     }
 
+    # change-feed rollup (ISSUE 4): the storage roles' feed retention +
+    # stream counters, so a stuck consumer shows up as rising
+    # feed_mem/spilled bytes and a dead one as a flat streams count —
+    # before the retention window becomes a memory incident
+    feed_ids: set = set()
+    for m in storage_metrics:
+        feed_ids.update(bytes(i) for i in m.get("feed_ids") or [])
+    feed_rollup = {
+        # distinct ids across the fleet: max() would undercount feeds
+        # living on disjoint servers, sum() would double-count replicas
+        "active_feeds": len(feed_ids),
+        "retained_entries": sum(
+            m.get("feed_entries", 0) for m in storage_metrics),
+        "retained_bytes": sum(
+            m.get("feed_mem_bytes", 0) for m in storage_metrics),
+        "spilled_bytes": sum(
+            m.get("feed_spilled_bytes", 0) for m in storage_metrics),
+        "streams_served": sum(
+            m.get("feed_streams_served", 0) for m in storage_metrics),
+        "mutations_captured": sum(
+            m.get("feed_mutations_captured", 0) for m in storage_metrics),
+    }
+
     # distributed-tracing rollup (ISSUE 2): every metric-bearing role
     # reports its span counters; sampled_txns comes from the GRV proxies
     # (where every sampled root first crosses the wire).  SERVER-side
@@ -153,6 +176,7 @@ async def cluster_status(knobs: Knobs, transport: Transport,
                 {"role": r["role"], "addr": r["addr"]}
                 for r in roles if not r["reachable"]],
             "storage_apply": apply_rollup,
+            "change_feeds": feed_rollup,
             "tracing": tracing_rollup,
         },
         "roles": roles,

@@ -24,8 +24,9 @@ from ..runtime.trace import Severity, TraceEvent, get_trace_log
 from ..storage.kv_store import OP_CLEAR, OP_SET
 from ..storage.packed_ops import DurabilityRing
 from ..storage.versioned_map import VersionedMap
-from .data import (KeyRange, Mutation, MutationBatch, MutationType, Version,
-                   apply_atomic)
+from .change_feed import ChangeFeedStore, ChangeFeedStreamReply
+from .data import (KeyRange, Mutation, MutationBatch, MutationBatchBuilder,
+                   MutationType, Version, apply_atomic)
 from .tlog import TLog, Tag
 
 
@@ -55,6 +56,12 @@ class StorageServer:
         self.version: Version = v0
         self.durable_version: Version = v0
         self.oldest_version: Version = v0
+        # committed floor learned from TLog peeks (knownCommittedVersion):
+        # applied versions ABOVE it may still be clamped out by a
+        # recovery, so feed heartbeats expose min(version, this) — a
+        # consumer's cursor must never advance past data that could be
+        # rolled back and re-assigned
+        self.known_committed: Version = v0
         self.vmap.oldest_version = v0
         self.vmap.latest_version = v0
         # pending-durable ops, packed (a ring of MutationBatch segments
@@ -62,6 +69,9 @@ class StorageServer:
         # slice instead of rebuilding a tuple list, ROADMAP PR 1 (c))
         self._dbuf = DurabilityRing()
         self._version_waiters: dict[Version, list[asyncio.Future]] = {}
+        # feed streams parked until the COMMITTED frontier (not the raw
+        # applied tip) reaches their cursor: (target, future)
+        self._feed_waiters: list[tuple[Version, asyncio.Future]] = []
         self._watches: dict[bytes, list[tuple[bytes | None, asyncio.Future]]] = {}
         self._pull_task: asyncio.Task | None = None
         self._durability_task: asyncio.Task | None = None
@@ -106,6 +116,19 @@ class StorageServer:
         # apply path is correlated by VERSION RANGE instead (see
         # _apply_batch — mutations do not carry trace ids)
         self.spans = SpanSink("StorageServer")
+        # change feeds hosted by this server (ISSUE 4): armed by
+        # PRIVATE_FEED_REGISTER markers in the tag stream, fed by the
+        # apply path, served by change_feed_stream.  The worker swaps in
+        # a DiskQueue-backed store (with recovered spill frames) on
+        # durable deployments; registrations themselves ride the engine
+        # meta so a rebooted replica re-arms before replaying the TLog.
+        self.feeds = ChangeFeedStore()
+        if engine is not None:
+            self.feeds.restore(engine.meta.get("feeds") or [], [], 0)
+        # deterministic 1-in-N server-side span roots for feed streams
+        # arriving without a sampled client context (ROADMAP PR 2 (a))
+        from ..runtime.span import ServerSampler
+        self._server_sampler = ServerSampler(namespace=2)
 
     async def metrics(self) -> dict:
         """Queue/lag sample for the Ratekeeper (StorageQueuingMetrics
@@ -135,6 +158,7 @@ class StorageServer:
             "shard_end": self.shard.end,
             "fetch_done": self._fetch_done.is_set(),
             "fetch_failed": self._fetch_failed,
+            **self.feeds.metrics(),
             **self.spans.counters(),
         }
 
@@ -205,6 +229,10 @@ class StorageServer:
         if self.version > recovery_version:
             self.vmap.rollback_after(recovery_version)
             self._dbuf.rollback_after(recovery_version)
+            # feed entries captured from the dead generation's unacked
+            # suffix must never reach a consumer: exactly-once depends
+            # on rolling them back with the MVCC window
+            self.feeds.rollback_after(recovery_version)
             self.version = recovery_version
         if any(v > recovery_version for v, _b, _e in self._dropped):
             # a PRIVATE_DROP_SHARD applied from a generation's unacked
@@ -281,6 +309,23 @@ class StorageServer:
             if not more or not kvs:
                 break
             b = bytes(kvs[-1][0]) + b"\x00"
+        # change-feed handoff rides fetchKeys (ISSUE 4): the source
+        # exports every overlapping feed's registration + retained
+        # window at the fetch version; entries above it arrive through
+        # this server's own tag pull, which is still gated on
+        # _fetch_done — so registration lands before any capture could
+        # miss.  Same retry discipline as the row pages.
+        while True:
+            try:
+                exported = await self._fetch_src.fetch_feed_state(
+                    self.shard.begin, self.shard.end, v)
+            except FdbError as err:
+                if err.retryable:
+                    await asyncio.sleep(0.1)
+                    continue
+                raise
+            self.feeds.install(exported)
+            break
         self._fetch_done.set()
         TraceEvent("FetchKeysComplete").detail("Tag", self.tag) \
             .detail("Rows", rows_total).detail("Version", v).log()
@@ -339,6 +384,10 @@ class StorageServer:
                     await asyncio.sleep(0.1)
                     continue
                 raise
+            kc = getattr(reply, "known_committed", 0)
+            if kc > self.known_committed:
+                self.known_committed = kc
+                self._wake_committed_waiters()
             if not reply.entries and reply.end_version - 1 <= self.version:
                 # no progress (e.g. the generation is locked but not yet
                 # ended): poll gently instead of spinning
@@ -402,6 +451,9 @@ class StorageServer:
                         "tag": self.tag,
                         "shard": (self._meta_shard.begin,
                                   self._meta_shard.end),
+                        # feed registrations ride the engine meta so a
+                        # rebooted replica re-arms before TLog replay
+                        "feeds": self.feeds.export_meta(),
                     })
                 except Exception as e:
                     # disk trouble (ENOSPC, IO error): keep the buffer
@@ -415,7 +467,29 @@ class StorageServer:
                 self.durable_version = floor
                 self.oldest_version = floor
                 self.vmap.drop_before(floor)  # engine authoritative <= floor
+                # spill sealed feed segments BEFORE popping the TLog:
+                # the pop drops their replay copies, so the side queue
+                # must durably hold every sub-floor entry first — on
+                # disk trouble the pop is withheld and the TLog keeps
+                # the window until a later spill succeeds
+                if self.feeds.feeds:
+                    try:
+                        await self.feeds.maybe_spill(floor)
+                    except Exception as e:  # noqa: BLE001 — retry later
+                        TraceEvent("ChangeFeedSpillError", severity=40) \
+                            .detail("Tag", self.tag).error(e).log()
+                        continue
                 self.log_system.pop(self.tag, floor + 1)
+            elif self.feeds.feeds:
+                # idle tick: still release the side queue's popped
+                # prefix, finish any previously-failed spill, and let
+                # the withheld TLog pop catch up
+                try:
+                    await self.feeds.maybe_spill(self.durable_version)
+                    self.log_system.pop(self.tag, self.durable_version + 1)
+                except Exception as e:  # noqa: BLE001 — retry next tick
+                    TraceEvent("ChangeFeedSpillError", severity=40) \
+                        .detail("Tag", self.tag).error(e).log()
             # GC relinquished ranges (live-move handoffs): once the drop
             # version is STRICTLY below the durable floor, no legal read
             # can touch the range (reads at or below the drop version —
@@ -438,6 +512,10 @@ class StorageServer:
                             "tag": self.tag,
                             "shard": (self._meta_shard.begin,
                                       self._meta_shard.end),
+                            # engines replace meta wholesale: omitting
+                            # the feeds here would silently disarm every
+                            # feed on the next reboot
+                            "feeds": self.feeds.export_meta(),
                         })
                 except Exception as e:   # noqa: BLE001 — retry next tick
                     TraceEvent("StorageDurabilityError", severity=40).detail(
@@ -485,6 +563,12 @@ class StorageServer:
             for _, fut in self._watches.pop(key):
                 if not fut.done():
                     fut.set_exception(WrongShardServer())
+        # feed handoff: a fully-relinquished feed fences at the drop
+        # version (consumers re-route to the destination, which received
+        # the retained window through fetch_feed_state); a partial drop
+        # (split) excludes just the moved subrange so this server keeps
+        # serving the keys it still owns
+        self.feeds.fence(version, begin, end, remaining=self._meta_shard)
         TraceEvent("StorageShardDropped").detail("Tag", self.tag) \
             .detail("Begin", begin).detail("End", end) \
             .detail("Version", version).log()
@@ -554,11 +638,46 @@ class StorageServer:
                 self.vmap.apply_packed(version, mutations)
                 if durable:
                     self._dbuf.extend_packed(version, mutations)
+                if self.feeds.feeds:
+                    # armed feeds retain zero-copy index slices of the
+                    # SAME packed batch the apply path just consumed,
+                    # clipped to this server's owned range
+                    self.feeds.capture(version, mutations,
+                                       shard=self._meta_shard)
                 continue
+            # feed capture on the lazy path retains the EFFECTIVE ops
+            # (atomics resolved to the set/clear the engine stores) —
+            # what a consumer replaying the feed must see
+            fb = MutationBatchBuilder() if self.feeds.feeds else None
             for m in mutations:
                 if m.type == MutationType.PRIVATE_DROP_SHARD:
                     flush()
                     self._drop_shard(version, m.param1, m.param2)
+                    continue
+                if m.type == MutationType.PRIVATE_FEED_REGISTER:
+                    from ..rpc.wire import decode
+                    try:
+                        info = decode(m.param2)
+                        self.feeds.register(m.param1, bytes(info["b"]),
+                                            bytes(info["e"]), version)
+                    except Exception as e:  # noqa: BLE001 — a corrupt
+                        # marker must not take the whole pull loop (and
+                        # every other feed) down with it
+                        TraceEvent("BadFeedMarker", severity=30) \
+                            .detail("Tag", self.tag).error(e).log()
+                    if fb is None and self.feeds.feeds:
+                        fb = MutationBatchBuilder()
+                    continue
+                if m.type == MutationType.PRIVATE_FEED_DESTROY:
+                    self.feeds.destroy(m.param1)
+                    continue
+                if m.type == MutationType.PRIVATE_FEED_POP:
+                    from ..rpc.wire import decode
+                    try:
+                        self.feeds.pop(m.param1, int(decode(m.param2)))
+                    except Exception as e:  # noqa: BLE001 — see above
+                        TraceEvent("BadFeedMarker", severity=30) \
+                            .detail("Tag", self.tag).error(e).log()
                     continue
                 nmut += 1
                 self.bytes_input += len(m.param1) + len(m.param2)
@@ -567,12 +686,16 @@ class StorageServer:
                     vops.append((version, OP_SET, m.param1, m.param2))
                     if durable:
                         self._dbuf.append(version, OP_SET, m.param1, m.param2)
+                    if fb is not None:
+                        fb.add(OP_SET, m.param1, m.param2)
                     self._fire_watches(m.param1, m.param2)
                 elif m.type == MutationType.CLEAR_RANGE:
                     vops.append((version, OP_CLEAR, m.param1, m.param2))
                     if durable:
                         self._dbuf.append(version, OP_CLEAR, m.param1,
                                           m.param2)
+                    if fb is not None:
+                        fb.add(OP_CLEAR, m.param1, m.param2)
                     self._fire_watch_range(m.param1, m.param2)
                 else:
                     # atomics resolve against the latest value (window or
@@ -586,12 +709,19 @@ class StorageServer:
                         if durable:
                             self._dbuf.append(version, OP_CLEAR, m.param1,
                                               end)
+                        if fb is not None:
+                            fb.add(OP_CLEAR, m.param1, end)
                         self._fire_watches(m.param1, None)
                     else:
                         vops.append((version, OP_SET, m.param1, new))
                         if durable:
                             self._dbuf.append(version, OP_SET, m.param1, new)
+                        if fb is not None:
+                            fb.add(OP_SET, m.param1, new)
                         self._fire_watches(m.param1, new)
+            if fb is not None and len(fb):
+                self.feeds.capture(version, fb.finish(),
+                                   shard=self._meta_shard)
         flush()
         self._bump_version(entries[-1][0])
         dt = time.perf_counter() - t0
@@ -624,6 +754,27 @@ class StorageServer:
             for fut in self._version_waiters.pop(v):
                 if not fut.done():
                     fut.set_result(None)
+        if self._feed_waiters:
+            self._wake_committed_waiters()
+
+    def _feed_frontier(self) -> Version:
+        """The newest version a feed stream may expose: applied AND known
+        committed.  A server that never learned a committed floor (bare
+        unit-test setups applying directly, no proxy pushes) serves the
+        raw applied tip."""
+        return min(self.version, self.known_committed) \
+            if self.known_committed > 0 else self.version
+
+    def _wake_committed_waiters(self) -> None:
+        fr = self._feed_frontier()
+        keep = []
+        for target, fut in self._feed_waiters:
+            if fr >= target:
+                if not fut.done():
+                    fut.set_result(None)
+            else:
+                keep.append((target, fut))
+        self._feed_waiters = keep
 
     # --- read path ---
 
@@ -790,6 +941,88 @@ class StorageServer:
                     return out, (w is not None or next(eng, None) is not None)
                 g = next(eng, None)
         return out, False
+
+    # --- change feeds (REF: storageserver.actor.cpp changeFeedStreamQ) ---
+
+    async def change_feed_stream(self, req) -> ChangeFeedStreamReply:
+        """One long-poll of a feed cursor: every retained entry of the
+        feed at versions in [req.begin_version, reply.end_version), in
+        version order.  An empty reply with an advanced end_version is
+        the heartbeat that lets a consumer prove absence-of-data for a
+        version range and resume exactly-once after a failover.  Spans:
+        sampled client contexts propagate; otherwise a deterministic
+        1-in-N server-side root covers streaming consumers that never
+        run transactions (ROADMAP PR 2 follow-up (a))."""
+        from ..runtime.errors import (ChangeFeedNotRegistered,
+                                      ChangeFeedPopped, WrongShardServer)
+        span_ctx = current_span()
+        if span_ctx is None:
+            span_ctx = self._server_sampler.root(
+                self.knobs.SERVER_SPAN_SAMPLE)
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.changeFeedStream.Before",
+                         Feed=req.feed_id, Begin=req.begin_version,
+                         Tag=self.tag)
+        self.feeds.streams_served += 1
+        try:
+            await self._wait_fetched()
+            f = self.feeds.feeds.get(req.feed_id)
+            if f is None:
+                raise ChangeFeedNotRegistered()
+            if f.fence is not None and req.begin_version > f.fence:
+                # range relinquished: the destination holds the window
+                raise WrongShardServer()
+            if req.begin_version <= f.popped_version:
+                raise ChangeFeedPopped()
+            if req.begin_version > self._feed_frontier():
+                # bounded long-poll for COMMITTED progress; a quiet tag
+                # returns an empty heartbeat instead of parking forever
+                fut = asyncio.get_running_loop().create_future()
+                self._feed_waiters.append((req.begin_version, fut))
+                try:
+                    await asyncio.wait_for(
+                        fut, timeout=self.knobs.CHANGE_FEED_POLL_WAIT)
+                except asyncio.TimeoutError:
+                    pass
+                finally:
+                    # reclaim the parked slot on timeout AND on
+                    # cancellation (a disconnecting consumer): repeated
+                    # polls on a quiet tag must not grow the list (a
+                    # slot already removed by the wake pass filters as
+                    # a no-op)
+                    self._feed_waiters = [
+                        (t2, f2) for t2, f2 in self._feed_waiters
+                        if f2 is not fut]
+            tip = self._feed_frontier()
+            limit = req.byte_limit or self.knobs.CHANGE_FEED_STREAM_BYTES
+            try:
+                entries, truncated = await self.feeds.read(
+                    req.feed_id, req.begin_version, limit, tip)
+                ranges = self.feeds.serving_ranges(req.feed_id,
+                                                   self._meta_shard)
+            except KeyError:
+                # destroyed between the fence check and the spill read
+                raise ChangeFeedNotRegistered() from None
+        except BaseException as e:
+            self.spans.event("TransactionDebug", span_ctx,
+                             "StorageServer.changeFeedStream.Error",
+                             Feed=req.feed_id, Tag=self.tag,
+                             Error=type(e).__name__)
+            raise
+        end = (truncated + 1) if truncated is not None else tip + 1
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.changeFeedStream.After",
+                         Feed=req.feed_id, Tag=self.tag,
+                         Entries=len(entries), End=end)
+        return ChangeFeedStreamReply(entries, end, f.popped_version, ranges)
+
+    async def fetch_feed_state(self, begin: bytes, end: bytes,
+                               version: Version) -> list:
+        """Feed half of the fetchKeys handoff: export every overlapping
+        feed's registration + retained window at or below ``version``
+        for a move destination (REF:fdbserver/storageserver.actor.cpp
+        fetchChangeFeedApplier)."""
+        return await self.feeds.handoff(begin, end, version)
 
     # --- watches (REF: storageserver.actor.cpp watchValueQ) ---
 

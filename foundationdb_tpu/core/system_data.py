@@ -28,6 +28,23 @@ BACKUP_TAGS_PREFIX = BACKUP_PREFIX + b"tags/"
 # database lock (REF:fdbclient/SystemData.cpp databaseLockedKey): value is
 # the locking UID; commit proxies reject non-lock-aware transactions
 LOCKED_KEY = b"\xff/dbLocked"
+# change feeds (REF:fdbclient/SystemData.cpp changeFeedPrefix): a feed is
+# registered by writing \xff/changeFeeds/<id> -> encode({begin, end}) —
+# a state transaction, so every commit proxy applies it at the exact
+# commit version and the owning proxy injects PRIVATE_FEED_* markers
+# into the owning storage tags' streams.  Destroy = clear the key.
+# Pop rides its own key (\xff/changeFeedPop/<id> -> encode(version)) so
+# popping never disturbs the registration row.
+CHANGE_FEED_PREFIX = b"\xff/changeFeeds/"
+CHANGE_FEED_POP_PREFIX = b"\xff/changeFeedPop/"
+
+
+def change_feed_key(feed_id: bytes) -> bytes:
+    return CHANGE_FEED_PREFIX + feed_id
+
+
+def change_feed_pop_key(feed_id: bytes) -> bytes:
+    return CHANGE_FEED_POP_PREFIX + feed_id
 # multi-region topology (REF:fdbclient/DatabaseConfiguration.cpp regions
 # JSON under \xff/conf/regions): wire-encoded list of region dicts
 # ({"id", "priority", "satellite", "satellite_logs"}) — the controller

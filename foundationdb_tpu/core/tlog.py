@@ -90,16 +90,28 @@ class TLogPushRequest:
 
     Values are packed ``MutationBatch``es on the wire (PROTOCOL_VERSION
     712); a bare ``list[Mutation]`` is still accepted at ``push`` for
-    sidecar producers and tests and is packed at the boundary."""
+    sidecar producers and tests and is packed at the boundary.
+
+    ``known_committed`` is the pushing proxy's fully-acked frontier
+    (REF:fdbserver/TLogServer.actor.cpp knownCommittedVersion): every
+    version at or below it was acked by EVERY hosting log of an earlier
+    batch.  It rides every push — real and empty — so consumers that
+    must never observe a possibly-unacked version (change-feed
+    heartbeats) have a committed floor to clamp against."""
     prev_version: Version
     version: Version
     messages: dict[Tag, MutationBatch]
+    known_committed: Version = 0
 
 
 @dataclasses.dataclass
 class TLogPeekReply:
     entries: list[tuple[Version, MutationBatch]]
     end_version: Version       # caller has everything < end_version for this tag
+    # the serving log's known-committed frontier: entries above it MAY
+    # still be clamped out by a recovery (unacked suffix) — change-feed
+    # heartbeats must not advance a consumer past it
+    known_committed: Version = 0
 
 
 class TLog:
@@ -107,6 +119,9 @@ class TLog:
                  queue=None) -> None:
         self.knobs = knobs
         self.version: Version = epoch_begin_version
+        # fully-acked frontier learned from proxy pushes (the epoch's
+        # begin version is committed by recovery's definition)
+        self.known_committed: Version = epoch_begin_version
         self.queue = queue                      # DiskQueue when durable
         self.path: str | None = None            # backing file when durable
         self._frame_ends: list[tuple[Version, int]] = []  # for pop_to + spill reads
@@ -234,6 +249,8 @@ class TLog:
         if self.locked:
             from ..runtime.errors import TLogStopped
             raise TLogStopped()
+        if req.known_committed > self.known_committed:
+            self.known_committed = req.known_committed
         await self._wait_for_version(req.prev_version)
         if self.locked:
             from ..runtime.errors import TLogStopped
@@ -334,9 +351,10 @@ class TLog:
         # twice on the next peek (replica divergence found by
         # ConsistencyCheck at sim seed 10)
         tip = self.version
+        kc = self.known_committed
         st = self._log.get(tag)
         if st is None:
-            return TLogPeekReply([], tip + 1)
+            return TLogPeekReply([], tip + 1, kc)
         entries: list[tuple[Version, MutationBatch]] = []
         if begin_version < st.spilled_below and self.queue is not None:
             entries.extend(e for e in await self._peek_spilled(
@@ -344,7 +362,7 @@ class TLog:
         entries.extend(
             e for e in st.slice_from(max(begin_version, st.spilled_below))
             if e[0] <= tip)
-        return TLogPeekReply(entries, tip + 1)
+        return TLogPeekReply(entries, tip + 1, kc)
 
     async def _peek_spilled(self, tag: Tag, begin: Version,
                             below: Version) -> list:

@@ -132,6 +132,7 @@ class Worker:
             ls = LogSystem([LogGeneration(epoch=0, begin_version=0,
                                           tlogs=[], replication=1)])
             ss = StorageServer(self.knobs, tag, shard, ls, engine=engine)
+            await self._attach_feed_store(ss, f"{self.data_dir}/storage-{tag}")
             token = self._alloc_block()
             serve_role(self.transport, "storage", ss, token)
             self.roles[token] = ("storage", ss)
@@ -234,6 +235,10 @@ class Worker:
             await mf.close()
             obj.engine = await self._engine_cls(eng_name).open(
                 self.fs, f"{self.data_dir}/storage-{params['tag']}")
+            # durable change-feed side queue (spilled retention segments
+            # survive reboots; a fresh recruit starts empty — the
+            # leftover cleanup above removed any stale .feeds.dq)
+            await self._attach_feed_store(obj, base)
             if "shard" not in obj.engine.meta:
                 # persist the assignment IMMEDIATELY (the reference writes
                 # storage metadata at creation): a replica that crashes
@@ -251,6 +256,19 @@ class Worker:
         TraceEvent("WorkerRecruited").detail("Worker", self.id) \
             .detail("Role", role).detail("Token", token).log()
         return token
+
+    async def _attach_feed_store(self, ss: StorageServer, base: str) -> None:
+        """Swap a DiskQueue-backed ChangeFeedStore into a durable storage
+        server: registrations come from the engine meta, spilled
+        retention segments re-index from the side queue's surviving
+        frames (ISSUE 4 retention spill/recovery)."""
+        from ..storage.disk_queue import DiskQueue
+        from .change_feed import ChangeFeedStore
+        queue, frames = await DiskQueue.open(self.fs.open(base + ".feeds.dq"))
+        store = ChangeFeedStore(queue)
+        meta = ss.engine.meta.get("feeds") if ss.engine is not None else None
+        store.restore(meta or [], frames, queue.front_offset)
+        ss.feeds = store
 
     async def stop_role(self, token: int, destroy: bool = False) -> bool:
         """Stop a hosted role.  ``destroy=True`` additionally deletes the

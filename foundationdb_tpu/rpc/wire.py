@@ -221,6 +221,7 @@ def decode(data: bytes) -> Any:
 
 def _register_core_structs() -> None:
     """Register the shared RPC structs in one canonical order."""
+    from ..core import change_feed as cf
     from ..core import data as d
     from ..core import resolver as r
     from ..core import tlog as t
@@ -232,6 +233,7 @@ def _register_core_structs() -> None:
         d.CommitResult, b.TxnRequest, r.ResolveBatchRequest,
         r.ResolveBatchReply, t.TLogPushRequest, t.TLogPeekReply,
         sp.SpanEnvelope, d.MutationBatch,
+        cf.ChangeFeedStreamRequest, cf.ChangeFeedStreamReply,
     ]):
         register_struct(cls, sid=i)
 

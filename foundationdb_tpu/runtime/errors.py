@@ -99,6 +99,16 @@ ResolverFailed = _err(2901, "resolver_failed",
 LogDataLoss = _err(2902, "log_data_loss",
                    "Every replica of a log tag is gone; recovery impossible")
 
+# change feeds (upstream's exact codes were unverifiable this session;
+# the 2903/2904 block is reserved here for them)
+ChangeFeedNotRegistered = _err(2903, "change_feed_not_registered",
+                               "No such change feed on this storage server "
+                               "(never registered, destroyed, or the range "
+                               "moved — consumers refresh and retry briefly)")
+ChangeFeedPopped = _err(2904, "change_feed_popped",
+                        "Requested change-feed data was released by a pop "
+                        "(cursor is below the durable low-water mark)")
+
 # 1213 is retryable for idempotent operations (reads, GRV); the commit
 # path converts it to commit_unknown_result (1021) before the client's
 # retry loop can see it, because re-running a maybe-delivered commit is

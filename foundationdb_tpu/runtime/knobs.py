@@ -68,7 +68,26 @@ class Knobs:
     # 712: packed columnar MutationBatch (wire struct id 11) replaces
     # list[Mutation] in TLogPushRequest/TLogPeekReply payloads — a 711
     # peer cannot decode the struct id, so the gate fences it
-    PROTOCOL_VERSION: int = 712
+    # 713: change feeds — ChangeFeedStreamRequest/Reply (wire struct ids
+    # 12/13), PRIVATE_FEED_* mutation opcodes in tag streams, and the
+    # packed-MutationBatch state-transaction piggyback; a 712 peer can
+    # decode none of these, so the gate fences it
+    PROTOCOL_VERSION: int = 713
+    # --- change feeds ---
+    # (sealed feed segments at or below the durable floor ALWAYS spill
+    # to the DiskQueue side file on durable servers — a durability
+    # obligation, not a memory knob: the TLog pop drops their replay
+    # copies in the same tick)
+    # default reply byte cap for one change_feed_stream long-poll
+    CHANGE_FEED_STREAM_BYTES: int = 1 << 20
+    # how long a feed stream long-polls for new versions before
+    # returning an empty heartbeat reply
+    CHANGE_FEED_POLL_WAIT: float = 0.5
+    # server-side span sampling for requests arriving WITHOUT a sampled
+    # client context (GRV/read-only-heavy workloads and feed streams):
+    # a deterministic counter-based 1-in-N root per serving role (0
+    # disables).  Matches the client probe default.
+    SERVER_SPAN_SAMPLE: float = 0.01
     STORAGE_VERSION_WINDOW: int = 5_000_000   # in-memory MVCC window, versions
     STORAGE_DURABILITY_LAG: float = 0.25      # seconds between making versions durable
     STORAGE_FUTURE_VERSION_WAIT: float = 1.0  # read wait before future_version

@@ -104,6 +104,41 @@ def new_root(trace_id: int) -> SpanContext:
     return SpanContext(_trace_salt() | trace_id, next(_ids), 0, True)
 
 
+def new_server_root(seq: int, namespace: int = 1) -> SpanContext:
+    """Server-side root for a request that arrived WITHOUT a sampled
+    client context (GRV-only / read-only-heavy clients, feed-stream
+    consumers — ROADMAP PR 2 follow-up (a)).  ``namespace`` keeps the
+    serving role's trace ids disjoint from client probe counters (and
+    from other roles') in one process: client roots use the low bits
+    raw, so any namespace >= 1 shifted past them cannot collide."""
+    TOTALS["sampled_txns"] += 1
+    return SpanContext(_trace_salt() | ((namespace & 0xFF) << 24) | seq,
+                       next(_ids), 0, True)
+
+
+class ServerSampler:
+    """Deterministic counter-based 1-in-N server-side root sampling —
+    the one home of the period arithmetic every serving role shares
+    (GRV proxy, feed streams).  ``root()`` returns a fresh root context
+    on sampled requests, None otherwise; never draws from the seeded
+    RNG, so sim streams are unperturbed."""
+
+    __slots__ = ("namespace", "count")
+
+    def __init__(self, namespace: int) -> None:
+        self.namespace = namespace
+        self.count = 0
+
+    def root(self, sample_rate: float) -> SpanContext | None:
+        if sample_rate <= 0:
+            return None
+        self.count += 1
+        period = max(1, round(1 / sample_rate))
+        if self.count % period:
+            return None
+        return new_server_root(self.count, self.namespace)
+
+
 def child_of(ctx: SpanContext) -> SpanContext:
     """A new span under ``ctx`` — created at explicit role-boundary
     forwarding sites (client→GRV, proxy→resolver, proxy→TLog, ...)."""

@@ -48,6 +48,11 @@ async def simulate(seed: int, kills: int, buggify: bool) -> dict:
         {"testName": "SelectorCorrectness", "keys": 12, "probes": 25},
         {"testName": "Storefront", "orders": 10},
         {"testName": "SpecialKeySpaceCorrectness", "rounds": 2},
+        # change-feed completeness under the whole chaos mix (ISSUE 4):
+        # exactly-once, exact-version, in-order delivery while machines
+        # die, ranges move and BUGGIFY fires
+        {"testName": "ChangeFeed", "transactionsPerClient": 10,
+         "popAfter": 6},
         {"testName": "LowLatency", "seconds": 6.0, "maxLatency": 30.0},
         # (the r5 "DD+swizzle causal failures" turned out to be the API
         # fuzzer's unscoped clear_range wiping other workloads' keys —

@@ -132,5 +132,11 @@ class DiskQueue:
         return self._end
 
     @property
+    def front_offset(self) -> int:
+        """First live logical offset (recovery re-indexes frames from
+        here — the change-feed side queue's restore path)."""
+        return self._front
+
+    @property
     def bytes_used(self) -> int:
         return self._end - self._front

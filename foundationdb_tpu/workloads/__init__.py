@@ -9,6 +9,6 @@ violated by lost/phantom/reordered writes.
 
 from .workload import (TestWorkload, WorkloadContext, register_workload,
                        make_workload, run_workloads, run_workloads_on)
-from . import (api_fuzz, attrition, conflict_range,  # noqa: F401  (register)
-               consistency, correctness, cycle, dynamic, increment, ops,
-               ops2, random_rw, serializability)
+from . import (api_fuzz, attrition, change_feed,  # noqa: F401  (register)
+               conflict_range, consistency, correctness, cycle, dynamic,
+               increment, ops, ops2, random_rw, serializability)

@@ -1,0 +1,706 @@
+"""Change feeds (ISSUE 4): versioned streaming change capture.
+
+Coverage: store capture/pop/read semantics over packed batches, the
+retention spill/recovery round trip through DiskQueue, the numpy
+``select`` equivalence, the 713 protocol fence, commit-proxy marker
+routing (including the register/pop/destroy vs range-split race), the
+apply-path capture of resolved atomics, rollback of unacked feed
+entries at storage rejoin, and the client cursor lifecycle end-to-end
+(create → stream → pop → resume → destroy).
+
+The seeded-sim completeness proofs (buggify + attrition failover,
+bit-identical across two same-seed runs; duplicate-free resume after a
+mid-stream storage kill; feed handoff across a live range split) live
+at the bottom — they are the subsystem's acceptance tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.core.change_feed import (ChangeFeedStore,
+                                               ChangeFeedStreamRequest)
+from foundationdb_tpu.core.data import (KeyRange, Mutation, MutationBatch,
+                                        MutationType)
+from foundationdb_tpu.core.storage_server import StorageServer
+from foundationdb_tpu.core.tlog import TLog
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+def batch(*muts: Mutation) -> MutationBatch:
+    return MutationBatch.from_mutations(muts)
+
+
+# --- store semantics ---
+
+def test_store_capture_clips_to_range_and_reads_in_order():
+    async def main():
+        st = ChangeFeedStore()
+        st.register(b"f", b"b", b"d", 10)
+        st.capture(11, batch(Mutation.set(b"a", b"0"),   # below range
+                             Mutation.set(b"b1", b"1"),
+                             Mutation.set(b"d", b"2")))  # at end: out
+        st.capture(12, batch(Mutation.clear_range(b"a", b"c"),  # overlaps
+                             Mutation.set(b"zz", b"3")))
+        st.capture(13, batch(Mutation.set(b"x", b"4")))  # fully outside
+        entries, trunc = await st.read(b"f", 1, 0, 100)
+        assert trunc is None
+        assert [(v, [(m.param1, m.param2) for m in b])
+                for v, b in entries] == [
+            (11, [(b"b1", b"1")]),
+            # the overlapping clear is CLIPPED to the feed range: the
+            # consumer must never see keys outside what it subscribed to
+            (12, [(b"b", b"c")]),
+        ]
+        # capture at or below the registration version is ignored
+        st.capture(10, batch(Mutation.set(b"b9", b"old")))
+        entries, _ = await st.read(b"f", 1, 0, 100)
+        assert len(entries) == 2
+        # pop releases the prefix; reads resume above it
+        st.pop(b"f", 11)
+        entries, _ = await st.read(b"f", 12, 0, 100)
+        assert [v for v, _b in entries] == [12]
+        assert st.feeds[b"f"].popped_version == 11
+    asyncio.run(main())
+
+
+def test_store_zero_copy_identity_slice():
+    """A batch fully inside the feed range is retained as the SAME
+    object the apply path consumed — the PR's zero-copy motivation."""
+    async def main():
+        st = ChangeFeedStore()
+        st.register(b"f", b"", b"\xff", 0)
+        b = batch(Mutation.set(b"k1", b"v1"), Mutation.set(b"k2", b"v2"))
+        st.capture(5, b)
+        entries, _ = await st.read(b"f", 1, 0, 10)
+        assert entries[0][1] is b
+    asyncio.run(main())
+
+
+def test_store_spill_and_recovery_roundtrip():
+    """Retention outgrows memory → sealed segments spill to the side
+    DiskQueue; a reopened queue + engine meta restores the exact same
+    stream (the reboot path), and pops release the dead prefix."""
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.storage.disk_queue import DiskQueue
+
+    async def main():
+        fs = SimFileSystem()
+        q, _ = await DiskQueue.open(fs.open("feeds.dq"))
+        st = ChangeFeedStore(q)
+        st.register(b"f", b"", b"\xff", 0)
+        payload = b"x" * 200
+        for v in range(1, 11):
+            st.capture(v, batch(Mutation.set(b"k%03d" % v, payload)))
+        # durable floor 6: only versions <= 6 may spill
+        spilled = await st.maybe_spill(6, 800)
+        assert spilled > 0
+        f = st.feeds[b"f"]
+        assert f.spilled and f.spilled[-1][0] <= 6
+        # the stream reads back complete and ordered across the seam
+        entries, _ = await st.read(b"f", 1, 0, 100)
+        assert [v for v, _b in entries] == list(range(1, 11))
+        assert all(b[0].param1 == b"k%03d" % v for v, b in entries)
+
+        # reboot: reopen the queue, restore from engine-meta + frames
+        meta = st.export_meta()
+        q2, frames = await DiskQueue.open(fs.open("feeds.dq"))
+        st2 = ChangeFeedStore(q2)
+        st2.restore(meta, frames, q2.front_offset)
+        entries2, _ = await st2.read(b"f", 1, 0, 100)
+        spilled_versions = [v for v, *_ in st2.feeds[b"f"].spilled]
+        assert spilled_versions == [v for v, *_ in f.spilled]
+        assert [(v, b[0].param1) for v, b in entries2] == \
+            [(v, b"k%03d" % v) for v, _st, _en, _nb in f.spilled]
+
+        # pop past the spilled prefix releases queue space
+        used_before = q.bytes_used
+        st.pop(b"f", 6)
+        await st.maybe_spill(6, 1 << 30)      # runs the release pass
+        assert q.bytes_used < used_before
+        entries3, _ = await st.read(b"f", 7, 0, 100)
+        assert [v for v, _b in entries3] == [7, 8, 9, 10]
+    asyncio.run(main())
+
+
+# --- numpy select (ROADMAP PR 3 follow-up (b)) ---
+
+def test_select_numpy_matches_naive():
+    import random
+    rng = random.Random(42)
+    muts = [Mutation.set(b"k%04d" % i, bytes(rng.randrange(256)
+                                             for _ in range(rng.randrange(9))))
+            if rng.random() < 0.7
+            else Mutation.clear_range(b"a%04d" % i, b"b%04d" % i)
+            for i in range(200)]
+    mb = MutationBatch.from_mutations(muts)
+    for _ in range(20):
+        k = rng.randrange(0, 200)
+        idxs = sorted(rng.sample(range(200), k))
+        sub = mb.select(idxs)            # numpy path for len >= 16
+        assert [sub[j] for j in range(len(idxs))] == [muts[i] for i in idxs]
+    # duplicate-bearing same-length list is NOT the identity
+    idxs = [0, 0] + list(range(2, 200))
+    sub = mb.select(idxs)
+    assert sub is not mb and sub[1] == muts[0]
+    # true identity is zero-copy
+    assert mb.select(list(range(200))) is mb
+
+
+# --- the protocol fence (712 peer must be refused) ---
+
+def test_version_gate_fences_712_peer():
+    from foundationdb_tpu.core.cluster_client import RecoveredClusterView
+    from foundationdb_tpu.runtime.errors import ClusterVersionChanged
+    new = Knobs()
+    assert new.PROTOCOL_VERSION == 713
+    old = new.override(PROTOCOL_VERSION=712)
+    state = {"epoch": 1, "seq": 0, "protocol": new.PROTOCOL_VERSION}
+    with pytest.raises(ClusterVersionChanged):
+        RecoveredClusterView(old, None, state)
+
+
+def test_feed_wire_structs_roundtrip():
+    from foundationdb_tpu.core.change_feed import ChangeFeedStreamReply
+    from foundationdb_tpu.rpc.wire import decode, encode
+    req = ChangeFeedStreamRequest(b"f", 42, 1024)
+    assert decode(encode(req)) == req
+    rep = ChangeFeedStreamReply(
+        [(7, batch(Mutation.set(b"k", b"v")))], 9, 3)
+    got = decode(encode(rep))
+    assert got.end_version == 9 and got.popped_version == 3
+    assert got.entries[0][0] == 7 and got.entries[0][1][0].param1 == b"k"
+
+
+# --- commit-proxy marker routing ---
+
+def _proxy():
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    cluster = Cluster(ClusterConfig(storage_servers=4))
+    return cluster.commit_proxies[0]
+
+
+def _reg_mut(feed_id: bytes, begin: bytes, end: bytes) -> Mutation:
+    from foundationdb_tpu.core.system_data import change_feed_key
+    from foundationdb_tpu.rpc.wire import encode
+    return Mutation.set(change_feed_key(feed_id),
+                        encode({"b": begin, "e": end}))
+
+
+def test_proxy_routes_feed_markers_to_owning_tags():
+    from foundationdb_tpu.core.system_data import (change_feed_key,
+                                                   change_feed_pop_key)
+    from foundationdb_tpu.rpc.wire import encode
+    p = _proxy()
+    # register over shards 1-2 of the 4-shard even map
+    markers = p._apply_metadata(10, [_reg_mut(b"f", b"\x50", b"\x90")])
+    assert sorted(m[0] for m in markers) == [1, 2]
+    assert all(m[1] == int(MutationType.PRIVATE_FEED_REGISTER)
+               for m in markers)
+    # pop routes to the same owners, payload untouched
+    markers = p._apply_metadata(11, [Mutation.set(
+        change_feed_pop_key(b"f"), encode(10))])
+    assert sorted((m[0], m[1]) for m in markers) == \
+        [(1, int(MutationType.PRIVATE_FEED_POP)),
+         (2, int(MutationType.PRIVATE_FEED_POP))]
+    # pop of an unregistered feed routes nowhere
+    assert p._apply_metadata(12, [Mutation.set(
+        change_feed_pop_key(b"nope"), encode(1))]) == []
+    # destroy = clear of the registration key
+    key = change_feed_key(b"f")
+    markers = p._apply_metadata(13, [Mutation.clear_range(
+        key, key + b"\x00")])
+    assert sorted((m[0], m[1]) for m in markers) == \
+        [(1, int(MutationType.PRIVATE_FEED_DESTROY)),
+         (2, int(MutationType.PRIVATE_FEED_DESTROY))]
+    assert p._feeds == {}
+
+
+def test_proxy_feed_pop_follows_range_split():
+    """The race the satellite names: after a layout change moves the
+    feed's range to new tags, a pop/destroy must route to the NEW
+    owners — the versioned registry + current map compose correctly."""
+    from foundationdb_tpu.core.system_data import (LAYOUT_KEY,
+                                                   change_feed_pop_key)
+    from foundationdb_tpu.rpc.wire import encode
+    p = _proxy()
+    markers = p._apply_metadata(10, [_reg_mut(b"f", b"\x00", b"\x40")])
+    assert sorted(m[0] for m in markers) == [0]
+    # split shard 0 at \x20; the right half moves to fresh tag 9
+    layout = {"boundaries": [b"\x20", b"\x40", b"\x80", b"\xc0"],
+              "teams": [[0], [9], [1], [2], [3]]}
+    p._apply_metadata(11, [Mutation.set(LAYOUT_KEY, encode(layout))])
+    markers = p._apply_metadata(12, [Mutation.set(
+        change_feed_pop_key(b"f"), encode(11))])
+    assert sorted(m[0] for m in markers) == [0, 9]
+
+
+def test_client_cannot_forge_private_markers():
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.runtime.errors import ClientInvalidOperation
+
+    async def main():
+        async with Cluster(ClusterConfig()) as cluster:
+            db = Database(cluster)
+            tr = db.create_transaction()
+            tr._writes.atomic(MutationType.PRIVATE_FEED_DESTROY, b"f", b"")
+            tr._write_conflicts.append((b"f", b"f\x00"))
+            with pytest.raises(ClientInvalidOperation):
+                await tr.commit()
+    run_simulation(main())
+
+
+# --- storage apply path: effective capture + rollback ---
+
+def _register_marker(feed_id: bytes, begin: bytes, end: bytes) -> Mutation:
+    from foundationdb_tpu.rpc.wire import encode
+    return Mutation(MutationType.PRIVATE_FEED_REGISTER, feed_id,
+                    encode({"b": begin, "e": end}))
+
+
+def test_storage_captures_resolved_atomics():
+    async def main():
+        k = Knobs()
+        ss = StorageServer(k, 0, KeyRange(b"", b"\xff"), TLog(k))
+        ss._apply(5, [_register_marker(b"f", b"", b"\xff")])
+        ss._apply(6, [Mutation.set(b"ctr", (5).to_bytes(8, "little"))])
+        ss._apply(7, [Mutation(MutationType.ADD, b"ctr",
+                               (3).to_bytes(8, "little"))])
+        ss._apply(8, [Mutation(MutationType.COMPARE_AND_CLEAR, b"ctr",
+                               (8).to_bytes(8, "little"))])
+        entries, _ = await ss.feeds.read(b"f", 1, 0, 100)
+        flat = [(v, m.type, m.param1, m.param2)
+                for v, b in entries for m in b]
+        assert flat == [
+            (6, MutationType.SET_VALUE, b"ctr", (5).to_bytes(8, "little")),
+            # the feed sees the RESOLVED add, not the operand
+            (7, MutationType.SET_VALUE, b"ctr", (8).to_bytes(8, "little")),
+            # compare-and-clear resolves to a single-key clear
+            (8, MutationType.CLEAR_RANGE, b"ctr", b"ctr\x00"),
+        ]
+    asyncio.run(main())
+
+
+def test_storage_rejoin_rolls_back_unacked_feed_entries():
+    async def main():
+        k = Knobs()
+        ss = StorageServer(k, 0, KeyRange(b"", b"\xff"), TLog(k))
+        ss._apply(5, [_register_marker(b"f", b"", b"\xff")])
+        ss._apply(10, [Mutation.set(b"a", b"1")])
+        ss._apply(20, [Mutation.set(b"b", b"2")])
+        ss._apply(30, [Mutation.set(b"c", b"3")])
+        await ss.rejoin(ss.log_system.generations, 20)
+        entries, _ = await ss.feeds.read(b"f", 1, 0, 100)
+        assert [v for v, _b in entries] == [10, 20]
+        # a feed registered in the rolled-back suffix vanishes entirely
+        ss._apply(25, [_register_marker(b"g", b"", b"\xff")])
+        await ss.rejoin(ss.log_system.generations, 21)
+        assert b"g" not in ss.feeds.feeds
+    run_simulation(main())
+
+
+def test_stream_fences_and_errors():
+    from foundationdb_tpu.runtime.errors import (ChangeFeedNotRegistered,
+                                                 ChangeFeedPopped,
+                                                 WrongShardServer)
+
+    async def main():
+        k = Knobs()
+        ss = StorageServer(k, 0, KeyRange(b"", b"\xff"), TLog(k))
+        with pytest.raises(ChangeFeedNotRegistered):
+            await ss.change_feed_stream(ChangeFeedStreamRequest(b"f", 1))
+        ss._apply(5, [_register_marker(b"f", b"", b"\x80")])
+        ss._apply(6, [Mutation.set(b"a", b"1")])
+        ss._apply(7, [Mutation(MutationType.PRIVATE_FEED_POP, b"f",
+                               __import__("foundationdb_tpu.rpc.wire",
+                                          fromlist=["encode"]).encode(6))])
+        with pytest.raises(ChangeFeedPopped):
+            await ss.change_feed_stream(ChangeFeedStreamRequest(b"f", 6))
+        # a drop over the feed range fences streams above the handoff
+        ss._apply(9, [Mutation(MutationType.PRIVATE_DROP_SHARD,
+                               b"", b"\x80")])
+        with pytest.raises(WrongShardServer):
+            await ss.change_feed_stream(ChangeFeedStreamRequest(b"f", 10))
+    run_simulation(main())
+
+
+# --- client cursor end-to-end (in-process cluster) ---
+
+def test_cursor_lifecycle_end_to_end():
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.runtime.errors import ChangeFeedPopped
+
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs()) as cluster:
+            db = Database(cluster)
+            v0 = await db.create_change_feed(b"f1", b"", b"\xfe")
+            committed = []
+            for i in range(6):
+                tr = db.create_transaction()
+                while True:
+                    try:
+                        tr.set(b"k%02d" % i, b"v%d" % i)
+                        committed.append((b"k%02d" % i,
+                                          await tr.commit()))
+                        break
+                    except BaseException as e:
+                        await tr.on_error(e)
+            tip = max(v for _k, v in committed)
+            loop = asyncio.get_running_loop()
+            cur = db.read_change_feed(b"f1")
+            entries = await cur.drain_through(tip,
+                                              deadline=loop.time() + 60)
+            got = [(m.param1, v) for v, b in entries for m in b]
+            assert sorted(got) == sorted(committed)
+            assert all(v > v0 for _k, v in got)
+            # versions non-decreasing as delivered
+            vs = [v for v, _b in entries]
+            assert vs == sorted(vs)
+
+            # pop releases the prefix; a resumed cursor above it is exact
+            mid = entries[2][0]
+            await db.pop_change_feed(b"f1", mid)
+            await asyncio.sleep(1.0)     # markers reach the storages
+            cur2 = db.read_change_feed(b"f1", begin_version=mid + 1)
+            e2 = await cur2.drain_through(tip, deadline=loop.time() + 60)
+            assert [(m.param1, v) for v, b in e2 for m in b] == \
+                [g for g in got if g[1] > mid]
+            # a cursor below the low-water mark is refused
+            with pytest.raises(ChangeFeedPopped):
+                stale = db.read_change_feed(b"f1", begin_version=1)
+                await stale.drain_through(tip, deadline=loop.time() + 60)
+    run_simulation(main())
+
+# --- acceptance sims (ISSUE 4) ---
+
+def _chaos_changefeed_run(seed: int) -> dict:
+    """Buggify + machine-attrition chaos around the ChangeFeed
+    completeness workload: 2 writers + 1 consumer, one txn-role machine
+    killed mid-run (epoch recovery + rollback path), feed popped
+    mid-stream."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.runtime.buggify import enable_buggify
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+    from foundationdb_tpu.workloads import run_workloads_on
+
+    knobs = Knobs().override(BUGGIFY_ENABLED=True)
+    enable_buggify(True)
+
+    async def main():
+        sim = SimulatedCluster(knobs, n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6,
+                                                      replication=2))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        results = await run_workloads_on(db, [
+            {"testName": "ChangeFeed", "transactionsPerClient": 12,
+             "popAfter": 8},
+            {"testName": "MachineAttrition", "sim": sim,
+             "machinesToKill": 1, "secondsBetweenKills": 2.0},
+        ], client_count=3)
+        await sim.stop()
+        return results
+
+    try:
+        return run_simulation(main(), seed=seed)
+    finally:
+        enable_buggify(False)
+
+
+def test_sim_completeness_under_buggify_attrition_bit_identical():
+    """The acceptance criterion verbatim: every committed mutation in
+    the feed range delivered exactly once, in version order, under
+    buggify + an attrition-driven failover — and the whole delivered
+    stream bit-identical across two same-seed runs (the workload's
+    check() enforces exactness; the crc pins the bytes)."""
+    r1 = _chaos_changefeed_run(29)
+    assert r1["ChangeFeed"]["delivered"] >= r1["ChangeFeed"]["commits"] > 0
+    assert r1["MachineAttrition"]["machines_killed"] >= 1
+    assert r1["ChangeFeed"]["popped_at"] > 0
+    r2 = _chaos_changefeed_run(29)
+    assert r1 == r2
+
+
+def test_sim_duplicate_free_resume_after_storage_kill():
+    """Mid-stream kill of a machine hosting a feed-range storage
+    replica (durable storage): the cursor fails over to the surviving
+    replica and, after the reboot, the stream stays complete and
+    duplicate-free — the begin-version cursor + committed-floor
+    heartbeat contract."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        sim = SimulatedCluster(Knobs(), n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6,
+                                                      replication=2),
+                               durable_storage=True)
+        await sim.start()
+        state = await sim.wait_epoch(1)
+        db = await sim.database()
+        await db.create_change_feed(b"rk", b"rk/", b"rk0")
+        committed: list[tuple[bytes, int]] = []
+        unknown: list[bytes] = []
+
+        async def write(i: int) -> None:
+            tr = db.create_transaction()
+            while True:
+                try:
+                    tr.set(b"rk/%04d" % i, b"v%d" % i)
+                    committed.append((b"rk/%04d" % i, await tr.commit()))
+                    return
+                except BaseException as e:
+                    from foundationdb_tpu.runtime.errors import \
+                        CommitUnknownResult
+                    if isinstance(e, CommitUnknownResult):
+                        unknown.append(b"rk/%04d" % i)
+                        return
+                    await tr.on_error(e)
+
+        for i in range(6):
+            await write(i)
+        cur = db.read_change_feed(b"rk")
+        loop = asyncio.get_running_loop()
+        first = await cur.drain_through(max(v for _k, v in committed),
+                                        deadline=loop.time() + 120)
+
+        # kill a non-coordinator machine hosting a replica of rk/'s
+        # shard, keep writing through the outage, then reboot it
+        coord_ips = {a.ip for a in sim.coord_addrs}
+        replica_ips = [s["worker"][0] for s in state["storage"]
+                       if s["begin"] <= b"rk/" < s["end"]]
+        # prefer a non-coordinator host; a 3-coordinator quorum survives
+        # one member's kill+reboot, so fall back if placement forces it
+        victims = [ip for ip in replica_ips if ip not in coord_ips] \
+            or replica_ips
+        assert victims, "no killable feed-range replica"
+        machine = next(m for m in sim.machines if m.ip == victims[0])
+        await machine.kill()
+        for i in range(6, 12):
+            await write(i)
+        await machine.reboot()
+        for i in range(12, 15):
+            await write(i)
+
+        tip = max(v for _k, v in committed)
+        rest = await cur.drain_through(tip, deadline=loop.time() + 240)
+        got = [(m.param1, v) for v, b in first + rest for m in b]
+        acked = {k for k, _v in committed}
+        # exactly once, at the exact commit version, for every ack
+        assert sorted(g for g in got if g[0] in acked) == sorted(committed)
+        # strays must be maybe-committed writes, at most once each
+        from collections import Counter
+        strays = Counter(k for k, _v in got if k not in acked)
+        assert all(k in unknown and n == 1 for k, n in strays.items())
+        # delivered in version order
+        vs = [v for v, _b in first + rest]
+        assert vs == sorted(vs)
+        await sim.stop()
+
+    run_simulation(main(), seed=41)
+
+
+def test_sim_feed_handoff_across_live_split():
+    """Register/pop vs range-split races: a live DD split relocates the
+    feed's hot half while writes flow; the destination receives the
+    retained window via fetch_feed_state, the source fences, and the
+    consumer's merged cursor stays complete and duplicate-free."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        k = Knobs().override(DD_ENABLED=True, DD_INTERVAL=1.0,
+                             DD_SHARD_SPLIT_BYTES=6_000)
+        sim = SimulatedCluster(k, n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        n_shards = len(state1["shard_teams"])
+        db = await sim.database()
+        await db.create_change_feed(b"hot", b"hot", b"hou")
+        committed: list[tuple[bytes, bytes, int]] = []
+        stop = asyncio.Event()
+
+        async def writer(wid: int) -> None:
+            i = 0
+            while not stop.is_set():
+                key = b"hot%02d%05d" % (wid, i)
+                val = b"v" * 40
+                i += 1
+                tr = db.create_transaction()
+                while True:
+                    try:
+                        tr.set(key, val)
+                        committed.append((key, val, await tr.commit()))
+                        break
+                    except BaseException as e:
+                        from foundationdb_tpu.runtime.errors import \
+                            CommitUnknownResult
+                        if isinstance(e, CommitUnknownResult):
+                            break     # unique key; never retried
+                        await tr.on_error(e)
+                await asyncio.sleep(0.05)
+
+        writers = [asyncio.ensure_future(writer(w)) for w in range(2)]
+        await sim.wait_state(lambda s: s.get("seq", 0) > 0
+                             and len(s["shard_teams"]) > n_shards)
+        await asyncio.sleep(2.0)          # writes continue post-flip
+        stop.set()
+        await asyncio.gather(*writers)
+
+        tip = max(v for _k, _val, v in committed)
+        cur = db.read_change_feed(b"hot")
+        loop = asyncio.get_running_loop()
+        entries = await cur.drain_through(tip, deadline=loop.time() + 240)
+        got = sorted((m.param1, v) for v, b in entries for m in b)
+        assert got == sorted((k, v) for k, _val, v in committed), \
+            f"{len(got)} delivered vs {len(committed)} committed"
+        await sim.stop()
+
+    run_simulation(main(), seed=5)
+
+
+# --- feed stream spans → trace file (ROADMAP PR 2 follow-up (a)) ---
+
+def test_feed_stream_spans_reach_trace_file(tmp_path):
+    """A feed consumer never runs a sampled transaction, so the stream
+    path roots its own server-side spans (knob SERVER_SPAN_SAMPLE):
+    the trace file must carry changeFeedStream Before/After events
+    trace_tool can group into a consumer timeline."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import trace_tool
+
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.runtime import span as span_mod
+    from foundationdb_tpu.runtime.trace import (TraceLog, get_trace_log,
+                                                set_trace_log)
+
+    path = os.path.join(str(tmp_path), "trace.jsonl")
+    log = TraceLog(path=path)
+    prev = get_trace_log()
+    set_trace_log(log)
+    span_mod.reset_totals()
+    knobs = Knobs().override(SERVER_SPAN_SAMPLE=1.0)
+
+    async def main():
+        async with Cluster(ClusterConfig(), knobs) as cluster:
+            db = Database(cluster)
+            await db.create_change_feed(b"tf", b"t", b"u")
+            for i in range(3):
+                await db.set(b"t%d" % i, b"v")
+            cur = db.read_change_feed(b"tf")
+            tip = cluster.sequencer.committed_version
+            await cur.drain_through(
+                tip, deadline=asyncio.get_running_loop().time() + 60)
+
+    run_simulation(main(), seed=77)
+    set_trace_log(prev)
+    log.close()
+
+    events = trace_tool.load_events(trace_tool.rolled_paths(path))
+    feed_events = [e for e in events
+                   if str(e.get("Location", "")).startswith(
+                       "StorageServer.changeFeedStream")]
+    assert feed_events, "no feed-stream span events reached the file"
+    assert all(e.get("TraceID") for e in feed_events)
+    befores = sum(1 for e in feed_events
+                  if e["Location"].endswith(".Before"))
+    closes = sum(1 for e in feed_events
+                 if e["Location"].endswith((".After", ".Error")))
+    assert befores == closes, "unpaired feed-stream span events"
+    # the analyzer groups them into per-consumer-poll timelines
+    traces = trace_tool.reconstruct(feed_events)
+    assert traces
+
+
+# --- review-hardening regressions ---
+
+def test_capture_clips_clears_to_shard():
+    """A CLEAR spanning a shard boundary inside the feed range must be
+    captured CLIPPED by each owning server, or the consumer's per-shard
+    merge would deliver the overlap once per shard."""
+    async def main():
+        left = ChangeFeedStore()
+        left.register(b"f", b"a", b"z", 0)
+        left.capture(5, batch(Mutation.clear_range(b"c", b"p")),
+                     shard=KeyRange(b"a", b"m"))
+        right = ChangeFeedStore()
+        right.register(b"f", b"a", b"z", 0)
+        right.capture(5, batch(Mutation.clear_range(b"c", b"p")),
+                      shard=KeyRange(b"m", b"z"))
+        el, _ = await left.read(b"f", 1, 0, 10)
+        er, _ = await right.read(b"f", 1, 0, 10)
+        assert [m for _v, b in el for m in b] == \
+            [Mutation.clear_range(b"c", b"m")]
+        assert [m for _v, b in er for m in b] == \
+            [Mutation.clear_range(b"m", b"p")]
+        # SETs outside the shard are dropped entirely
+        left.capture(6, batch(Mutation.set(b"q", b"1"),
+                              Mutation.set(b"b", b"2")),
+                     shard=KeyRange(b"a", b"m"))
+        el, _ = await left.read(b"f", 6, 0, 10)
+        assert [m.param1 for _v, b in el for m in b] == [b"b"]
+    asyncio.run(main())
+
+
+def test_bad_pop_blob_rejected_and_survived():
+    """A malformed \\xff/changeFeedPop blob must neither route markers
+    (proxy) nor kill the apply loop (storage defense in depth)."""
+    from foundationdb_tpu.core.system_data import change_feed_pop_key
+    p = _proxy()
+    p._apply_metadata(10, [_reg_mut(b"f", b"\x00", b"\x40")])
+    assert p._apply_metadata(11, [Mutation.set(
+        change_feed_pop_key(b"f"), b"\xff\xfegarbage")]) == []
+
+    async def main():
+        k = Knobs()
+        ss = StorageServer(k, 0, KeyRange(b"", b"\xff"), TLog(k))
+        ss._apply(5, [_register_marker(b"g", b"", b"\xff")])
+        # a forged/corrupt marker reaches the apply loop: logged, skipped
+        ss._apply(6, [Mutation(MutationType.PRIVATE_FEED_POP, b"g",
+                               b"\x00junk"),
+                      Mutation.set(b"k", b"v")])
+        entries, _ = await ss.feeds.read(b"g", 1, 0, 10)
+        assert [m.param1 for _v, b in entries for m in b] == [b"k"]
+    asyncio.run(main())
+
+
+def test_spill_is_durability_not_memory_pressure():
+    """Every sealed entry at or below the floor spills each tick even
+    far under any memory budget — the TLog pop in the same tick drops
+    the replay copies, so an unspilled sub-floor entry would be lost to
+    the next crash."""
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.storage.disk_queue import DiskQueue
+
+    async def main():
+        fs = SimFileSystem()
+        q, _ = await DiskQueue.open(fs.open("d.dq"))
+        st = ChangeFeedStore(q)
+        st.register(b"f", b"", b"\xff", 0)
+        for v in range(1, 6):
+            st.capture(v, batch(Mutation.set(b"k%d" % v, b"x")))
+        await st.maybe_spill(3)           # durability pass, no mem cap
+        f = st.feeds[b"f"]
+        assert [v for v, *_ in f.spilled] == [1, 2, 3]
+        assert list(f.versions[f.start:]) == [4, 5]
+        # the spilled prefix survives a reopen even though memory was
+        # nowhere near any budget
+        q2, frames = await DiskQueue.open(fs.open("d.dq"))
+        st2 = ChangeFeedStore(q2)
+        st2.restore(st.export_meta(), frames, q2.front_offset)
+        entries, _ = await st2.read(b"f", 1, 0, 10)
+        assert [v for v, _b in entries] == [1, 2, 3]
+    asyncio.run(main())

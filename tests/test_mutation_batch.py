@@ -145,7 +145,9 @@ def test_version_gate_fences_711_peer():
     from foundationdb_tpu.core.cluster_client import RecoveredClusterView
     from foundationdb_tpu.runtime.errors import ClusterVersionChanged
     new = Knobs()
-    assert new.PROTOCOL_VERSION == 712
+    # 712 introduced the packed MutationBatch; later protocol bumps
+    # (713 change feeds) must keep fencing a pre-712 peer
+    assert new.PROTOCOL_VERSION >= 712
     old = new.override(PROTOCOL_VERSION=711)
     state = {"epoch": 1, "seq": 0, "protocol": new.PROTOCOL_VERSION}
     with pytest.raises(ClusterVersionChanged):

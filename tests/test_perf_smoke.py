@@ -29,6 +29,16 @@ def test_commit_pipeline_throughput_smoke():
                               budget_s=perf_smoke.PIPE_BUDGET_S)
 
 
+def test_feed_tail_throughput_smoke():
+    """The change-feed path on top of the pipeline (ISSUE 4): capture
+    hook (per-apply MutationBatch.select), retention scan, stream read
+    and the client cursor merge — a live consumer must observe every
+    committed mutation inside a generous floor (measured ~1s against
+    the 60s budget on a loaded 2-cpu host).  Completeness is asserted
+    too: a silently lossy feed is worse than a slow one."""
+    perf_smoke.check_feed(budget_s=perf_smoke.FEED_BUDGET_S)
+
+
 def test_apply_metrics_surface():
     """The apply path must publish its observability counters — a silent
     regression is the other half of the r5 incident."""

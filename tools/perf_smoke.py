@@ -17,7 +17,14 @@ tagging, TLog queue accounting, peek re-materialization); this one
 fails fast on any O(n²)-class slip anywhere on the commit path instead
 of at the north-star bench with no summary line.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|all]
+Stage 3 (``feed``): the commit pipeline with a change feed armed over
+the whole written range and a consumer tailing it live — guards the
+capture hook (per-apply ``MutationBatch.select``), the stream read
+path, and end-to-end feed lag.  A regression that made capture
+per-mutation-object, or the stream path quadratic in retained
+entries, fails here at tier-1 cost instead of at the north-star bench.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -36,6 +43,9 @@ DEFAULT_BUDGET_S = 10.0     # measured ~0.5s on a loaded 1-cpu host
 PIPE_TXNS = 400
 PIPE_CLIENTS = 32
 PIPE_BUDGET_S = 60.0        # measured ~1-2s on a loaded 2-cpu host
+FEED_TXNS = 300
+FEED_CLIENTS = 16
+FEED_BUDGET_S = 60.0        # measured ~1-2s on a loaded 2-cpu host
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -196,19 +206,125 @@ def check_pipeline(n_txns: int = PIPE_TXNS, n_clients: int = PIPE_CLIENTS,
     return elapsed
 
 
+def feed_tail_seconds(n_txns: int = FEED_TXNS, n_clients: int = FEED_CLIENTS,
+                      deadline_s: float | None = None) -> tuple[float, dict]:
+    """Wall seconds for a live consumer to observe EVERY mutation of
+    ``n_txns`` write transactions through a change feed armed over the
+    written range — the full capture → retain → stream → cursor-merge
+    path on top of the commit pipeline.  The clock stops when the
+    consumer has drained through the last commit's version, so feed lag
+    is inside the measured window."""
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    knobs = Knobs()
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        knobs = knobs.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin, generous budget
+        pass
+
+    async def main() -> tuple[float, dict]:
+        cluster = Cluster(ClusterConfig(storage_servers=2), knobs)
+        cluster.start()
+        db = Database(cluster)
+        await db.create_change_feed(b"smoke-feed", b"feed", b"feee")
+        committed = 0
+        max_version = 0
+        issued = iter(range(n_txns))
+        t0 = time.perf_counter()
+
+        async def client(cid: int) -> None:
+            nonlocal committed, max_version
+            tr = Transaction(cluster)
+            for i in issued:
+                while True:
+                    try:
+                        tr.set(b"feed%08d" % i, b"v" * 64)
+                        tr.set(b"feed-b%08d" % i, b"w" * 64)
+                        max_version = max(max_version, await tr.commit())
+                        committed += 1
+                        tr.reset()
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+
+        seen = 0
+
+        async def consume(cur) -> None:
+            nonlocal seen
+            while committed < n_txns or cur.version <= max_version:
+                for _v, batch in await cur.next():
+                    seen += len(batch)
+
+        async def drive() -> None:
+            cur = db.read_change_feed(b"smoke-feed")
+            consumer = asyncio.ensure_future(consume(cur))
+            await asyncio.gather(*(client(c) for c in range(n_clients)))
+            await consumer
+
+        try:
+            await asyncio.wait_for(drive(), deadline_s)
+        except asyncio.TimeoutError:
+            await cluster.stop()
+            raise AssertionError(
+                f"feed tail wedged: consumer saw {seen} mutations of "
+                f"{committed * 2} committed when the {deadline_s:.0f}s "
+                f"deadline hit — capture, stream, or heartbeat stalled"
+            ) from None
+        elapsed = time.perf_counter() - t0
+        stats = {
+            "committed": committed,
+            "mutations_seen": seen,
+            "feed_mutations_per_sec": seen / elapsed if elapsed else 0.0,
+        }
+        await cluster.stop()
+        return elapsed, stats
+
+    return asyncio.run(main())
+
+
+def check_feed(n_txns: int = FEED_TXNS, n_clients: int = FEED_CLIENTS,
+               budget_s: float = FEED_BUDGET_S, quiet: bool = False) -> float:
+    """Run the feed-tail smoke; raises AssertionError past the budget or
+    on an incomplete stream."""
+    elapsed, stats = feed_tail_seconds(n_txns, n_clients,
+                                       deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] feed tail: {stats['mutations_seen']} mutations "
+              f"streamed in {elapsed:.3f}s "
+              f"({stats['feed_mutations_per_sec']:.0f} muts/s)")
+    assert stats["committed"] == n_txns, stats
+    assert stats["mutations_seen"] == 2 * n_txns, (
+        f"feed stream incomplete: {stats['mutations_seen']} of "
+        f"{2 * n_txns} committed mutations delivered")
+    assert elapsed < budget_s, (
+        f"feed-tail throughput regression: {n_txns} txns took "
+        f"{elapsed:.1f}s (budget {budget_s:.0f}s) — capture select, "
+        f"retention scan, or the stream read grew a quadratic shape")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
     ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
-    ap.add_argument("--stage", choices=("apply", "pipeline", "all"),
+    ap.add_argument("--stage", choices=("apply", "pipeline", "feed", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
+    ap.add_argument("--feed-budget", type=float, default=FEED_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
     if args.stage in ("pipeline", "all"):
         check_pipeline(args.txns, budget_s=args.pipe_budget)
+    if args.stage in ("feed", "all"):
+        check_feed(budget_s=args.feed_budget)
     return 0
 
 
