@@ -643,6 +643,120 @@ def run_feed_tail_phase(quiet: bool) -> dict:
     return r
 
 
+def run_read_point_phase(quiet: bool) -> dict:
+    """Batched read-path stage (ISSUE 5): rows loaded through real
+    commits, then (a) concurrent clients hammering coalesced point
+    reads — the YCSB/e2e read shape — with client-boundary latency,
+    and (b) clients streaming ``get_multi`` batches.  Captures the
+    read side of the BENCH_r* trajectory from this PR on:
+    point_reads_per_sec, multiget_keys_per_sec, read p50/p99."""
+    import asyncio
+
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    n_rows, point_clients, mg_clients, batch = 100_000, 64, 16, 64
+    duration_s = 5.0
+    knobs = Knobs()
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        knobs = knobs.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin is fine for this shape
+        pass
+
+    def key(i: int) -> bytes:
+        return b"rp%08d" % (i % n_rows)
+
+    async def main() -> dict:
+        cluster = Cluster(ClusterConfig(storage_servers=2), knobs)
+        cluster.start()
+
+        async def loader(lo: int, hi: int) -> None:
+            tr = Transaction(cluster)
+            for start in range(lo, hi, 500):
+                while True:
+                    for i in range(start, min(start + 500, hi)):
+                        tr.set(key(i), b"v" * 100)
+                    try:
+                        await tr.commit()
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+                tr.reset()
+
+        span = (n_rows + 15) // 16
+        await asyncio.gather(*(loader(j * span, min((j + 1) * span, n_rows))
+                               for j in range(16)))
+
+        from foundationdb_tpu.bench.workload import ZipfianGenerator
+        zipf = ZipfianGenerator(n_rows, 0.99, 17)
+
+        # --- (a) coalesced point reads, client-boundary latency ---
+        points = 0
+        lat: list[float] = []
+        stop_at = time.perf_counter() + duration_s
+
+        async def point_reader(cid: int) -> None:
+            nonlocal points
+            tr = Transaction(cluster)
+            await tr.get_read_version()
+            while time.perf_counter() < stop_at:
+                k = key(int(zipf.sample(1)[0]))
+                t0 = time.perf_counter()
+                v = await tr.get(k, snapshot=True)
+                lat.append(time.perf_counter() - t0)
+                assert v is not None
+                points += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(point_reader(c)
+                               for c in range(point_clients)))
+        point_elapsed = time.perf_counter() - t0
+
+        # --- (b) multiget batches ---
+        mg_keys = 0
+        stop2 = time.perf_counter() + duration_s
+
+        async def mg_reader(cid: int) -> None:
+            nonlocal mg_keys
+            tr = Transaction(cluster)
+            await tr.get_read_version()
+            while time.perf_counter() < stop2:
+                ks = sorted({key(int(i)) for i in zipf.sample(batch)})
+                got = await tr.get_multi(ks, snapshot=True)
+                assert all(v is not None for v in got)
+                mg_keys += len(got)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(mg_reader(c) for c in range(mg_clients)))
+        mg_elapsed = time.perf_counter() - t0
+        co = getattr(cluster, "_read_coalescer", None)
+        await cluster.stop()
+        lat.sort()
+        return {
+            "point_reads_per_sec": round(points / point_elapsed, 1),
+            "multiget_keys_per_sec": round(mg_keys / mg_elapsed, 1),
+            "read_p50_ms":
+                round(lat[len(lat) // 2] * 1e3, 3) if lat else None,
+            "read_p99_ms":
+                round(lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 3)
+                if lat else None,
+            "read_n_samples": len(lat),
+            "read_batch_mean": (co.stats()["read_batch_mean"]
+                                if co is not None else None),
+            "read_batch_max": (co.stats()["read_batch_max"]
+                               if co is not None else None),
+        }
+
+    r = asyncio.run(main())
+    if not quiet:
+        print(f"[bench] read point: {r}", file=sys.stderr)
+    return r
+
+
 def project_local_attach(out: dict, e2e: dict) -> dict:
     """Locally-attached projection (VERDICT r4 1c): what the tpu e2e
     number becomes with the tunnel RTT removed, computed from MEASURED
@@ -873,6 +987,14 @@ def main() -> int:
                 args.stage_timeout, out)
             if ft is not None:
                 out.update(ft)
+
+            # batched read path (ISSUE 5): point + multiget throughput
+            # and client-boundary read latency
+            rp = call_bounded(
+                "read_point", lambda: run_read_point_phase(args.quiet),
+                args.stage_timeout, out)
+            if rp is not None:
+                out.update(rp)
 
             def abort_parity():
                 # the abort-parity gate (BASELINE.md config-2): encoded

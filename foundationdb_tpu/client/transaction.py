@@ -166,12 +166,104 @@ class Transaction:
         if not snapshot:
             self._read_conflicts.append((key, key_after(key)))
         with _hop(self._span, "TransactionDebug", "NativeAPI.get") as h:
-            base = await self._cluster.storage_for_key(key).get_value(
-                key, version)
+            base = await self._storage_read(key, version)
             _SPANS.event("TransactionDebug", h, "NativeAPI.get.After")
         if kind == "stack":
             return WriteMap.fold_with_base(payload, base)
         return base
+
+    async def _storage_read(self, key: bytes, version: Version
+                            ) -> bytes | None:
+        """One storage point read.  With CLIENT_COALESCE_READS (the
+        default) it rides the cluster's multiget batcher: every
+        concurrent point read landing in the same event-loop tick —
+        this transaction's or any other's at any read version — groups
+        by owning shard into one packed GetValuesRequest
+        (client/read_coalescer.py).  Off, it is the scalar pre-714
+        one-RPC-per-key path the equivalence tests compare against."""
+        group = self._cluster.storage_for_key(key)
+        if not getattr(self._knobs, "CLIENT_COALESCE_READS", True):
+            return await group.get_value(key, version)
+        co = getattr(self._cluster, "_read_coalescer", None)
+        if co is None:
+            from .read_coalescer import ReadCoalescer
+            co = ReadCoalescer()
+            self._cluster._read_coalescer = co
+        return await co.submit(group, key, version)
+
+    async def get_multi(self, keys: list[bytes], snapshot: bool = False
+                        ) -> list[bytes | None]:
+        """Batched point reads: the values of ``keys`` in input order
+        (the fdb_transaction_get_multi surface ISSUE 5 adds).  Per-key
+        semantics are EXACTLY a ``get`` loop's — RYW overlays fold,
+        non-snapshot reads record one read-conflict range per key,
+        special keys answer client-side — but the storage half ships
+        as one packed multiget per owning shard, fanned out and
+        reassembled in key order."""
+        self._check_mutable()
+        results: list[bytes | None] = [None] * len(keys)
+        fetch: list[tuple[int, bytes, str, object]] = []
+        for i, key in enumerate(keys):
+            if key.startswith(b"\xff\xff"):
+                results[i] = await self._special_key(key)
+                continue
+            self._check_key(key)
+            kind, payload = self._writes.lookup(key)
+            if kind == "value" and not snapshot:
+                results[i] = payload    # RYW: fully determined
+                continue
+            fetch.append((i, key, kind, payload))
+        if not fetch:
+            return results
+        version = await self.get_read_version()
+        # group by owning shard — ONE packed GetValuesRequest per shard,
+        # fanned out concurrently, no per-key task/future (the per-key
+        # async overhead is exactly what this path amortizes away)
+        from ..core.data import GetValuesRequest
+        from ..runtime.errors import error_from_code
+        per_shard: dict[object, list[bytes]] = {}
+        waits: list[tuple[int, str, object, bytes]] = []
+        for i, key, kind, payload in fetch:
+            if kind == "value":         # snapshot read of a buffered set
+                results[i] = payload
+                continue
+            if not snapshot:
+                self._read_conflicts.append((key, key_after(key)))
+            g = self._cluster.storage_for_key(key)
+            per_shard.setdefault(g, []).append(key)
+            waits.append((i, kind, payload, key))
+        if not waits:
+            return results
+        reqs = [(g, sorted(set(ks))) for g, ks in per_shard.items()]
+        with _hop(self._span, "TransactionDebug", "NativeAPI.getValues",
+                  Keys=len(waits), Shards=len(reqs)) as h:
+            replies = await asyncio.gather(
+                *(g.get_values(GetValuesRequest.from_keys(sk, version))
+                  for g, sk in reqs),
+                return_exceptions=True)
+            err = next((r for r in replies if isinstance(r, BaseException)),
+                       None)
+            if err is not None:
+                raise err
+            valmap: dict[bytes, bytes | None] = {}
+            errcode: int | None = None
+            for (_g, sk), rep in zip(reqs, replies):
+                for j, k in enumerate(sk):
+                    ec, valmap[k] = rep.unpack(j)
+                    if errcode is None and ec is not None:
+                        errcode = ec
+            if errcode is not None:
+                # one bad key fails the call exactly as it would have
+                # failed the scalar get() loop — the txn's retry loop
+                # owns recovery
+                raise error_from_code(errcode)
+            _SPANS.event("TransactionDebug", h, "NativeAPI.getValues.After",
+                         Keys=len(waits))
+        for i, kind, payload, key in waits:
+            base = valmap[key]
+            results[i] = (WriteMap.fold_with_base(payload, base)
+                          if kind == "stack" else base)
+        return results
 
     async def _special_key(self, key: bytes) -> bytes | None:
         """The ``\\xff\\xff`` special-key space (REF:fdbclient/
@@ -224,18 +316,29 @@ class Transaction:
 
     async def _snapshot_stream(self, begin: bytes, end: bytes,
                                version: Version, reverse: bool,
-                               chunk: int = 128):
+                               chunk: int | None = None):
         """Yield storage rows of [begin, end) in key order (or reverse),
         following each shard's 'more' flag — no row is ever silently
-        dropped by a fetch limit."""
+        dropped by a fetch limit.
+
+        The per-fetch row limit starts at CLIENT_RANGE_CHUNK_ROWS and
+        DOUBLES after every truncated reply (the iterator-mode growth
+        of REF:fdbclient/NativeAPI.actor.cpp getRange), capped where
+        the next reply would exceed CLIENT_RANGE_CHUNK_BYTES at the
+        observed mean row size — a long scan converges to few large
+        fetches without letting huge rows blow the reply budget."""
+        if chunk is None:
+            chunk = self._knobs.CLIENT_RANGE_CHUNK_ROWS
+        budget = self._knobs.CLIENT_RANGE_CHUNK_BYTES
         servers = self._cluster.storages_for_range(begin, end)
         servers.sort(key=lambda ss: ss.shard.begin, reverse=reverse)
         for ss in servers:
             b = max(begin, ss.shard.begin)
             e = min(end, ss.shard.end)
             while b < e:
+                # budget rides positionally: RPC stubs are *args-only
                 kvs, more = await ss.get_key_values(b, e, version, chunk,
-                                                    reverse)
+                                                    reverse, budget)
                 for kv in kvs:
                     yield kv
                 if not more:
@@ -244,6 +347,9 @@ class Transaction:
                     e = kvs[-1][0]            # exclusive end: continue below
                 else:
                     b = key_after(kvs[-1][0])
+                nbytes = sum(len(k) + len(v) for k, v in kvs)
+                avg = max(1, nbytes // max(1, len(kvs)))
+                chunk = max(chunk, min(chunk * 2, max(1, budget // avg)))
 
     async def _merged_range(self, begin: bytes, end: bytes, limit: int,
                             reverse: bool) -> list[tuple[bytes, bytes]]:

@@ -216,6 +216,11 @@ class ChangeFeedStore:
         # spill frames in offset order: (start, end, feed_id, version);
         # the dead prefix (popped/destroyed feeds) is released via pop_to
         self._frames: list[tuple[int, int, bytes, Version]] = []
+        # cached segment decomposition of the armed feed ranges (the
+        # capture hook's one-interval-pass index, ROADMAP PR 4 (c)):
+        # (key, boundaries, covering-feed lists); rebuilt whenever the
+        # eligible feed set or its clipped ranges change
+        self._seg_cache: tuple | None = None
         # serializes stream reads against spills: a read's disk awaits
         # must not interleave with maybe_spill moving entries between
         # the memory window and the spilled list, or the read's stale
@@ -300,7 +305,8 @@ class ChangeFeedStore:
         same contract ``serving_ranges`` advertises)."""
         if not self.feeds or not batch:
             return
-        ops = None
+        # eligibility is per (feed, version): cheap O(feeds) each call
+        elig: list[tuple[bytes, bytes, FeedState]] = []
         for f in self.feeds.values():
             if version <= f.register_version or version <= f.popped_version:
                 continue
@@ -311,12 +317,38 @@ class ChangeFeedStore:
                 rb, re_ = max(rb, shard.begin), min(re_, shard.end)
                 if rb >= re_:
                     continue
-            if ops is None:
-                ops = list(batch.iter_ops())
-            idxs = [i for i, (t, p1, p2) in enumerate(ops)
-                    if (rb <= p1 < re_ if t == 0
-                        else (p1 < re_ and rb < p2))]
-            if idxs:
+            elig.append((rb, re_, f))
+        if not elig:
+            return
+        # ONE interval pass over the batch (ROADMAP PR 4 (c)): the
+        # eligible feed ranges decompose into disjoint segments (cached
+        # across applies while the feed set is stable), each op bisects
+        # into its segment(s) once, and the covering feeds collect op
+        # INDICES — so a server hosting many overlapping feeds scans the
+        # batch once, not once per feed.  Per-feed slice assembly
+        # (select + boundary clip) is unchanged.
+        bounds, cover = self._segments(elig)
+        idxs: list[list[int]] = [[] for _ in elig]
+        last = [-1] * len(elig)
+        nseg = len(cover)
+        for i, (t, p1, p2) in enumerate(batch.iter_ops()):
+            if t == 0:
+                s = bisect.bisect_right(bounds, p1) - 1
+                if 0 <= s < nseg:
+                    for fpos in cover[s]:
+                        idxs[fpos].append(i)
+            else:
+                lo = bisect.bisect_right(bounds, p1) - 1
+                if lo < 0:
+                    lo = 0
+                hi = min(bisect.bisect_left(bounds, p2), nseg)
+                for s in range(lo, hi):
+                    for fpos in cover[s]:
+                        if last[fpos] != i:
+                            last[fpos] = i
+                            idxs[fpos].append(i)
+        for (rb, re_, f), fidx in zip(elig, idxs):
+            if fidx:
                 # one clip pass: excluded pieces plus everything outside
                 # [rb, re_) — SETs are already range-filtered, this
                 # trims boundary-spanning CLEARs to exactly the piece
@@ -325,10 +357,30 @@ class ChangeFeedStore:
                 if rb > b"":
                     clip.append((0, b"", rb))
                 clip.append((0, re_, b"\xff\xff\xff\xff"))
-                sub = _filter_excluded(batch.select(idxs), clip)
+                sub = _filter_excluded(batch.select(fidx), clip)
                 if sub:
                     f.retain(version, sub)
                     self.total_captured += len(sub)
+
+    def _segments(self, elig: list) -> tuple[list[bytes], list[list[int]]]:
+        """Disjoint elementary segments of the eligible (clipped) feed
+        ranges: ``bounds[s]`` starts segment s = [bounds[s],
+        bounds[s+1]) and ``cover[s]`` lists the positions in ``elig``
+        covering it (the final boundary starts no segment).  Cached on
+        the exact (feed identity, clipped range) tuple — stable across
+        the thousands of applies between feed lifecycle events."""
+        key = tuple((id(f), rb, re_) for rb, re_, f in elig)
+        cached = self._seg_cache
+        if cached is not None and cached[0] == key:
+            return cached[1], cached[2]
+        pts = sorted({p for rb, re_, _f in elig for p in (rb, re_)})
+        cover: list[list[int]] = [[] for _ in range(max(0, len(pts) - 1))]
+        for fpos, (rb, re_, _f) in enumerate(elig):
+            for s in range(bisect.bisect_left(pts, rb),
+                           bisect.bisect_left(pts, re_)):
+                cover[s].append(fpos)
+        self._seg_cache = (key, pts, cover)
+        return pts, cover
 
     # --- the stream read ---
 

@@ -350,6 +350,125 @@ class MutationBatch:
         return b.finish()
 
 
+class _PackedKeys:
+    """Shared surface for the packed key/value columns of the multiget
+    wire structs: one contiguous ``blob`` plus little-endian u32
+    cumulative end offsets (``bounds``), exactly the MutationBatch
+    offset discipline with a single column."""
+
+    def __len__(self) -> int:
+        return len(self.bounds) // 4
+
+    def offsets(self):
+        offs = self.__dict__.get("_offs")
+        if offs is None:
+            if _NATIVE_LE:
+                offs = memoryview(self.bounds).cast("I")
+            else:
+                offs = _array("I")
+                offs.frombytes(self.bounds)
+                offs.byteswap()
+            self.__dict__["_offs"] = offs
+        return offs
+
+    def _item(self, blob: bytes, i: int) -> bytes:
+        offs = self.offsets()
+        return blob[(offs[i - 1] if i else 0):offs[i]]
+
+
+# GetValuesReply per-key status codes: one byte per key so a single
+# too-old/moved key degrades that KEY, not the whole batch RPC.
+GV_FOUND, GV_MISSING, GV_TOO_OLD, GV_FUTURE_VERSION, GV_WRONG_SHARD = range(5)
+# status byte -> FDB error code (runtime.errors.error_from_code)
+GV_ERROR_CODES = {GV_TOO_OLD: 1007, GV_FUTURE_VERSION: 1009,
+                  GV_WRONG_SHARD: 1001}
+
+
+@dataclasses.dataclass
+class GetValuesRequest(_PackedKeys):
+    """Packed multi-key point-read batch (PROTOCOL_VERSION 714) — the
+    getValuesQ analog of the paper's storage-server read batching
+    (REF:fdbserver/storageserver.actor.cpp getValueQ, batched).
+
+    ``keys`` holds every probe key concatenated in SORTED ascending
+    order (distinct — the client's coalescer dedupes); ``bounds`` is
+    one little-endian u32 cumulative end offset per key.  Sortedness is
+    part of the wire contract: the storage server resolves shard/drop
+    fences as contiguous index runs via bisect, and the engines'
+    ``get_batch`` descend their sorted runs once per leaf/block run.
+    """
+
+    version: Version = 0
+    bounds: bytes = b""
+    keys: bytes = b""
+
+    def key(self, i: int) -> bytes:
+        return self._item(self.keys, i)
+
+    def iter_keys(self):
+        offs = self.offsets()
+        blob = self.keys
+        prev = 0
+        for i in range(len(offs)):
+            e = offs[i]
+            yield blob[prev:e]
+            prev = e
+
+    @classmethod
+    def from_keys(cls, keys: list, version: Version) -> "GetValuesRequest":
+        bounds = _array("I")
+        pos = 0
+        for k in keys:
+            pos += len(k)
+            bounds.append(pos)
+        return cls(version, _bounds_to_wire(bounds), b"".join(keys))
+
+
+@dataclasses.dataclass
+class GetValuesReply(_PackedKeys):
+    """Reply to GetValuesRequest: ``codes`` is one status byte per key
+    (GV_FOUND / GV_MISSING / a GV_* error code), ``blob`` the found
+    values concatenated, ``bounds`` one cumulative u32 end per key
+    (missing/errored keys occupy a zero-length span)."""
+
+    codes: bytes = b""
+    bounds: bytes = b""
+    blob: bytes = b""
+
+    def value(self, i: int) -> bytes:
+        return self._item(self.blob, i)
+
+    def unpack(self, i: int) -> tuple[int | None, bytes | None]:
+        """(FDB error code or None, value or None) for key i — the ONE
+        home of the per-key status contract, shared by the coalescer
+        and ``get_multi`` so the decode can never diverge.  GV_MISSING
+        (and any unknown future code) decodes as (None, None)."""
+        c = self.codes[i]
+        if c == GV_FOUND:
+            return None, self.value(i)
+        return GV_ERROR_CODES.get(c), None
+
+    @classmethod
+    def build(cls, codes, values: list) -> "GetValuesReply":
+        """``values`` aligned with ``codes``; None contributes nothing."""
+        bounds = _array("I")
+        chunks: list[bytes] = []
+        pos = 0
+        for v in values:
+            if v:
+                chunks.append(v)
+                pos += len(v)
+            bounds.append(pos)
+        return cls(bytes(codes), _bounds_to_wire(bounds), b"".join(chunks))
+
+    @classmethod
+    def uniform(cls, code: int, n: int) -> "GetValuesReply":
+        """Whole-batch status (a batch-wide wait failed before any
+        per-key work): every key carries ``code``, no payload."""
+        return cls(bytes([code]) * n, _bounds_to_wire(_array("I", [0] * n)),
+                   b"")
+
+
 class MutationBatchBuilder:
     """Append-only MutationBatch assembly (one blob join at finish)."""
 

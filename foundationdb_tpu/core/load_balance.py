@@ -41,27 +41,73 @@ class ReplicaGroup:
     def replicas(self) -> list:
         return [m.storage for m in self._models]
 
-    async def _call(self, method: str, *args):
+    async def _failover(self, attempt):
+        """THE replica-selection policy — score-ordered iteration with
+        outstanding/penalty bookkeeping, shared by scalar and batched
+        reads so the two can never diverge.  ``attempt(storage)``
+        returns (served, value); served=False penalizes the replica
+        and remembers ``value`` as the every-replica-refused fallback.
+        Retryable FdbErrors penalize and continue; others raise."""
         now = asyncio.get_running_loop().time()
         order = sorted(self._models,
                        key=lambda m: (m.score(now), deterministic_random().random()))
         last_err: BaseException | None = None
+        fallback = None
+        have_fallback = False
         for m in order:
             m.outstanding += 1
             try:
-                return await getattr(m.storage, method)(*args)
+                served, value = await attempt(m.storage)
             except FdbError as e:
                 last_err = e
                 if not e.retryable:
                     raise
                 # penalize this replica and try the next one
                 m.penalty_until = asyncio.get_running_loop().time() + 1.0
+                continue
             finally:
                 m.outstanding -= 1
+            if served:
+                return value
+            fallback, have_fallback = value, True
+            m.penalty_until = asyncio.get_running_loop().time() + 1.0
+        if have_fallback:
+            return fallback
         raise last_err  # all replicas failed
+
+    async def _call(self, method: str, *args):
+        async def attempt(storage):
+            return True, await getattr(storage, method)(*args)
+        return await self._failover(attempt)
 
     async def get_value(self, key: bytes, version: int):
         return await self._call("get_value", key, version)
+
+    async def get_values(self, req):
+        """Batched point reads with the same replica failover as scalar
+        reads.  Per-key failures ride the reply as status codes (no
+        exception, no failover — the whole team answers identically for
+        a moved range), but a reply that is WHOLESALE future_version
+        means only that this replica lags its team: try the next one,
+        exactly as the scalar path's retryable-exception failover
+        would."""
+        from .data import GV_FUTURE_VERSION, GV_TOO_OLD
+
+        async def attempt(storage):
+            reply = await storage.get_values(req)
+            # a WHOLESALE future_version (replica lags its team) or
+            # too_old (replica's MVCC floor compacted past the read —
+            # a teammate's independently-advancing floor may still
+            # cover it) means only that THIS replica can't serve the
+            # version: both are retryable per-replica on the scalar
+            # path, so try the next one; if every replica refuses, the
+            # client sees the code per key
+            wholesale = bool(reply.codes) and (
+                all(c == GV_FUTURE_VERSION for c in reply.codes)
+                or all(c == GV_TOO_OLD for c in reply.codes))
+            return not wholesale, reply
+
+        return await self._failover(attempt)
 
     async def get_key_values(self, begin: bytes, end: bytes, version: int,
                              limit: int = 0, reverse: bool = False,

@@ -234,6 +234,7 @@ def _register_core_structs() -> None:
         r.ResolveBatchReply, t.TLogPushRequest, t.TLogPeekReply,
         sp.SpanEnvelope, d.MutationBatch,
         cf.ChangeFeedStreamRequest, cf.ChangeFeedStreamReply,
+        d.GetValuesRequest, d.GetValuesReply,
     ]):
         register_struct(cls, sid=i)
 

@@ -72,7 +72,10 @@ class Knobs:
     # 12/13), PRIVATE_FEED_* mutation opcodes in tag streams, and the
     # packed-MutationBatch state-transaction piggyback; a 712 peer can
     # decode none of these, so the gate fences it
-    PROTOCOL_VERSION: int = 713
+    # 714: batched multiget reads — GetValuesRequest/Reply (wire struct
+    # ids 14/15) on the storage read surface; a 713 peer cannot decode
+    # the struct ids, so the gate fences it
+    PROTOCOL_VERSION: int = 714
     # --- change feeds ---
     # (sealed feed segments at or below the durable floor ALWAYS spill
     # to the DiskQueue side file on durable servers — a durability
@@ -97,6 +100,21 @@ class Knobs:
     # one event-loop turn is a ~100-500ms stall (SlowTask); the pull
     # loop yields between slices, never splitting a version
     STORAGE_APPLY_CHUNK_MUTATIONS: int = 32768
+
+    # --- client read path ---
+    # same-tick point-read coalescing: concurrent Transaction.get calls
+    # (across transactions sharing a read version too — GRV batching
+    # makes shared versions the common case) group by owning shard into
+    # ONE packed GetValuesRequest, single-flight per shard.  Off =
+    # scalar one-RPC-per-key reads (the pre-714 path; equivalence tests
+    # compare against it)
+    CLIENT_COALESCE_READS: bool = True
+    # range-read streaming: first fetch asks for this many rows per
+    # shard, then DOUBLES each round (the iterator-mode growth of
+    # REF:fdbclient/NativeAPI.actor.cpp getRange) until a reply would
+    # exceed CLIENT_RANGE_CHUNK_BYTES at the observed mean row size
+    CLIENT_RANGE_CHUNK_ROWS: int = 128
+    CLIENT_RANGE_CHUNK_BYTES: int = 1 << 20
 
     # --- transaction limits (REF:fdbclient/ClientKnobs, Limits in docs) ---
     KEY_SIZE_LIMIT: int = 10_000

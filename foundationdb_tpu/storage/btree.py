@@ -153,6 +153,44 @@ class BTreeKVStore:
             return bytes(entries[j][1])
         return None
 
+    def get_batch(self, keys: list[bytes]) -> list[bytes | None]:
+        """Batched point reads over SORTED keys: ONE root-to-leaf
+        descent per leaf RUN — every consecutive probe key routing to
+        the same leaf resolves off the single decoded node, so a batch
+        of n keys over l distinct leaves costs l descents instead of n
+        (the multiget engine fall-through, ISSUE 5)."""
+        out: list[bytes | None] = [None] * len(keys)
+        if self._root is None or not keys:
+            return out
+        i, n = 0, len(keys)
+        while i < n:
+            ref = self._root
+            node = self._read_node(ref)
+            upper: bytes | None = None  # tightest right bound on the path
+            while node[0] == 0:
+                kids = node[1]
+                firsts = [bytes(c[0]) for c in kids]
+                j = bisect.bisect_right(firsts, keys[i]) - 1
+                if j < 0:
+                    j = 0
+                if j + 1 < len(kids):
+                    nb = firsts[j + 1]
+                    if upper is None or nb < upper:
+                        upper = nb
+                ref = (kids[j][1], kids[j][2])
+                node = self._read_node(ref)
+            entries = node[1]
+            lkeys = [bytes(e[0]) for e in entries]
+            # every probe key below the path's right bound lives (if
+            # anywhere) in THIS leaf
+            hi = n if upper is None else bisect.bisect_left(keys, upper, i)
+            for t in range(i, max(hi, i + 1)):
+                j2 = bisect.bisect_left(lkeys, keys[t])
+                if j2 < len(lkeys) and lkeys[j2] == keys[t]:
+                    out[t] = bytes(entries[j2][1])
+            i = max(hi, i + 1)
+        return out
+
     def range(self, begin: bytes, end: bytes,
               reverse: bool = False) -> Iterator[tuple[bytes, bytes]]:
         if self._root is None:

@@ -133,6 +133,12 @@ class MemoryKVStore:
     def get(self, key: bytes) -> bytes | None:
         return self._data.get(key)
 
+    def get_batch(self, keys: list[bytes]) -> list[bytes | None]:
+        """Batched point reads (sorted keys by the wire contract; this
+        engine's dict probe doesn't care)."""
+        get = self._data.get
+        return [get(k) for k in keys]
+
     def range(self, begin: bytes, end: bytes,
               reverse: bool = False) -> Iterator[tuple[bytes, bytes]]:
         keys = self._index.keys_in_range(begin, end)

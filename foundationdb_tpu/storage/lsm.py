@@ -53,6 +53,9 @@ class _Run:
         self.index = decode(self._f.read_sync(idx_off, size - 12 - idx_off))
         # index: list of [first_key, offset, length]
         self.first_keys = [bytes(e[0]) for e in self.index]
+        # lazy keycode-packed u64 prefixes of first_keys: the batched
+        # probe's vectorized searchsorted operand (get_batch_into)
+        self._fk_pfx = None
 
     def _block(self, i: int) -> list:
         key = (self.path, i)
@@ -75,6 +78,52 @@ class _Run:
             v = blk[j][1]
             return True, (bytes(v) if v is not None else None)
         return False, None
+
+    def get_batch_into(self, keys: list[bytes], idxs: list[int],
+                       out: list) -> list[int]:
+        """Probe ``keys[i] for i in idxs`` (idxs ascending over sorted
+        keys) against this run, writing hits — including tombstones —
+        into ``out``; returns the still-unresolved indices for the next
+        (older) run.  The block per probe resolves in ONE vectorized
+        ``searchsorted`` over keycode-packed u64 prefixes of the sparse
+        index (the PackedKeyIndex bound-batch discipline), a bisect
+        refining inside the equal-prefix band; each touched block is
+        then decoded exactly once per batch."""
+        fk = self.first_keys
+        if not fk:
+            return idxs
+        if len(idxs) >= 16 and len(fk) >= 16:
+            import numpy as np
+
+            from ..ops.keycode import encode_prefix_u64
+            if self._fk_pfx is None:
+                self._fk_pfx = encode_prefix_u64(fk)
+            probes = encode_prefix_u64([keys[i] for i in idxs])
+            los = np.searchsorted(self._fk_pfx, probes, side="left")
+            his = np.searchsorted(self._fk_pfx, probes, side="right")
+            blocks = [bisect.bisect_right(fk, keys[i], int(lo), int(hi)) - 1
+                      for i, lo, hi in zip(idxs, los, his)]
+        else:
+            blocks = [bisect.bisect_right(fk, keys[i]) - 1 for i in idxs]
+        remaining: list[int] = []
+        cur = -1
+        bkeys: list[bytes] = []
+        blk: list = []
+        for i, b in zip(idxs, blocks):
+            if b < 0:
+                remaining.append(i)
+                continue
+            if b != cur:        # idxs sorted => blocks non-decreasing
+                cur = b
+                blk = self._block(b)
+                bkeys = [bytes(e[0]) for e in blk]
+            j = bisect.bisect_left(bkeys, keys[i])
+            if j < len(bkeys) and bkeys[j] == keys[i]:
+                v = blk[j][1]
+                out[i] = bytes(v) if v is not None else None
+            else:
+                remaining.append(i)
+        return remaining
 
     def iter_range(self, begin: bytes, end: bytes,
                    reverse: bool = False) -> Iterator[tuple[bytes, bytes | None]]:
@@ -179,6 +228,25 @@ class LSMKVStore:
             if found:
                 return v
         return None
+
+    def get_batch(self, keys: list[bytes]) -> list[bytes | None]:
+        """Batched point reads over SORTED keys (the multiget engine
+        fall-through): one memtable dict pass, then each run probed
+        once via its vectorized sparse-index search — every touched
+        data block decodes once per batch instead of once per key."""
+        out: list[bytes | None] = [None] * len(keys)
+        mem = self._mem
+        pending: list[int] = []
+        for i, k in enumerate(keys):
+            if k in mem:
+                out[i] = mem[k]     # value or tombstone (None): resolved
+            else:
+                pending.append(i)
+        for run in self._runs:
+            if not pending:
+                break
+            pending = run.get_batch_into(keys, pending, out)
+        return out
 
     def range(self, begin: bytes, end: bytes,
               reverse: bool = False) -> Iterator[tuple[bytes, bytes]]:

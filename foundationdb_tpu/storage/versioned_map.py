@@ -252,6 +252,38 @@ class VersionedMap:
             return False, None
         return True, chain[i][1]
 
+    def get2_batch(self, keys: list[bytes],
+                   version: Version) -> list[tuple[bool, bytes | None]]:
+        """Batched ``get2`` — one pass over the whole probe list (the
+        multiget read path's window probe, ISSUE 5).  Result i is
+        exactly ``get2(keys[i], version)``; callers separate the
+        found=False entries in the same pass and resolve them through
+        the engine's ``get_batch``.
+
+        Cheaper than a ``get2`` loop by construction, not cleverness:
+        one bound method per batch instead of per key, and the common
+        cases — no chain at all, or the chain tip already at-or-below
+        ``version`` (every key outside the current commit wave) —
+        resolve without the keyed bisect."""
+        chains = self._chains
+        out: list[tuple[bool, bytes | None]] = []
+        append = out.append
+        br = bisect.bisect_right
+        for key in keys:
+            chain = chains.get(key)
+            if chain is None:
+                append((False, None))
+                continue
+            v0, val = chain[-1]
+            if v0 <= version:
+                append((True, val))
+            elif chain[0][0] > version:
+                append((False, None))
+            else:
+                i = br(chain, version, key=lambda e: e[0]) - 1
+                append((True, chain[i][1]))
+        return out
+
     def get_latest(self, key: bytes) -> bytes | None:
         chain = self._chains.get(key)
         return chain[-1][1] if chain else None

@@ -1,0 +1,504 @@
+"""Batched multiget read path (ISSUE 5): equivalence + fence tests.
+
+The contract under test everywhere here: the batched surfaces —
+``VersionedMap.get2_batch``, the engines' ``get_batch``,
+``StorageServer.get_values``, ``Transaction.get_multi`` and the
+same-tick coalescer behind ``Transaction.get`` — return BYTE-IDENTICAL
+results to the scalar one-key-at-a-time paths they replace, on
+randomized workloads including RYW overlays, cleared ranges,
+too-old/future-version keys mid-batch, relinquished ranges and shard
+boundaries.  Plus the 714 protocol fence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from foundationdb_tpu.core.data import (GV_FOUND, GV_FUTURE_VERSION,
+                                        GV_MISSING, GV_TOO_OLD,
+                                        GV_WRONG_SHARD, GetValuesReply,
+                                        GetValuesRequest, KeyRange, Mutation)
+from foundationdb_tpu.runtime.knobs import Knobs
+
+
+def krand(rng: random.Random) -> bytes:
+    return b"k%04d" % rng.randrange(600)
+
+
+# --- wire structs ---
+
+def test_get_values_wire_roundtrip():
+    from foundationdb_tpu.rpc.wire import decode, encode
+    req = GetValuesRequest.from_keys([b"a", b"bb", b"", b"ccc"], 99)
+    got = decode(encode(req))
+    assert got == req
+    assert list(got.iter_keys()) == [b"a", b"bb", b"", b"ccc"]
+    assert [got.key(i) for i in range(4)] == [b"a", b"bb", b"", b"ccc"]
+    rep = GetValuesReply.build(bytearray([0, 1, 2, 0]),
+                               [b"v0", None, None, b""])
+    got = decode(encode(rep))
+    assert got.value(0) == b"v0" and got.value(3) == b""
+    assert got.codes == bytes([0, 1, 2, 0])
+    uni = GetValuesReply.uniform(GV_TOO_OLD, 3)
+    assert len(uni) == 3 and set(uni.codes) == {GV_TOO_OLD}
+    assert uni.value(1) == b""
+
+
+# --- the protocol fence (713 peer must be refused) ---
+
+def test_version_gate_fences_713_peer():
+    from foundationdb_tpu.core.cluster_client import RecoveredClusterView
+    from foundationdb_tpu.runtime.errors import ClusterVersionChanged
+    new = Knobs()
+    assert new.PROTOCOL_VERSION == 714
+    old = new.override(PROTOCOL_VERSION=713)
+    state = {"epoch": 1, "seq": 0, "protocol": new.PROTOCOL_VERSION}
+    with pytest.raises(ClusterVersionChanged):
+        RecoveredClusterView(old, None, state)
+
+
+# --- VersionedMap.get2_batch ---
+
+def test_get2_batch_matches_scalar():
+    from foundationdb_tpu.storage.versioned_map import VersionedMap
+    rng = random.Random(7)
+    vm = VersionedMap()
+    version = 0
+    for _ in range(40):
+        version += rng.randrange(1, 3)
+        ops = []
+        for _ in range(rng.randrange(1, 30)):
+            if rng.random() < 0.15:
+                b = krand(rng)
+                ops.append((version, 1, b, b + b"\xff"))
+            else:
+                ops.append((version, 0, krand(rng),
+                            b"v%d" % rng.randrange(1000)))
+        vm.apply_batch(ops)
+    probes = sorted({krand(rng) for _ in range(200)} | {b"zz-missing"})
+    for v in (0, 1, version // 2, version, version + 5):
+        assert vm.get2_batch(probes, v) == [vm.get2(k, v) for k in probes]
+
+
+# --- engine get_batch (memory / lsm / btree) ---
+
+def _engine_workload(rng: random.Random):
+    """Ordered op batches + the final expected dict."""
+    batches = []
+    for r in range(12):
+        ops = []
+        for _ in range(rng.randrange(5, 60)):
+            if rng.random() < 0.1:
+                b = krand(rng)
+                ops.append((1, b, b + b"\xff"))
+            else:
+                ops.append((0, krand(rng), b"val%05d" % rng.randrange(9999)))
+        batches.append(ops)
+    return batches
+
+
+@pytest.mark.parametrize("engine_name", ["memory", "lsm", "btree"])
+def test_engine_get_batch_matches_scalar(engine_name, monkeypatch):
+    import foundationdb_tpu.storage.lsm as lsm_mod
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.storage import engine_class
+    if engine_name == "lsm":
+        # small thresholds: force flushes + several runs so the batched
+        # probe actually walks the sorted-run indexes
+        monkeypatch.setattr(lsm_mod, "_MEMTABLE_BYTES", 1500)
+        monkeypatch.setattr(lsm_mod, "_BLOCK_BYTES", 128)
+
+    async def main():
+        rng = random.Random(13 + len(engine_name))
+        fs = SimFileSystem()
+        kv = await engine_class(engine_name).open(fs, f"db/{engine_name}")
+        for i, ops in enumerate(_engine_workload(rng)):
+            await kv.commit(ops, {"durable_version": i})
+        probes = sorted({krand(rng) for _ in range(300)}
+                        | {b"", b"zzzz", b"k0000"})
+        assert kv.get_batch(probes) == [kv.get(k) for k in probes]
+        # and after reopen (runs/tree recovered from disk)
+        await kv.close()
+        kv2 = await engine_class(engine_name).open(fs, f"db/{engine_name}")
+        assert kv2.get_batch(probes) == [kv2.get(k) for k in probes]
+        await kv2.close()
+
+    asyncio.run(main())
+
+
+# --- StorageServer.get_values ---
+
+def _apply_random(ss, rng: random.Random, versions: int = 20) -> int:
+    version = ss.version
+    for _ in range(versions):
+        version += rng.randrange(1, 3)
+        muts = []
+        for _ in range(rng.randrange(1, 25)):
+            if rng.random() < 0.12:
+                b = krand(rng)
+                muts.append(Mutation.clear_range(b, b + b"\xff"))
+            else:
+                muts.append(Mutation.set(krand(rng),
+                                         b"v%05d" % rng.randrange(9999)))
+        ss._apply_batch([(version, muts)])
+    return version
+
+
+def test_storage_get_values_matches_scalar():
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+
+    async def main():
+        rng = random.Random(23)
+        knobs = Knobs()
+        ss = StorageServer(knobs, 0, KeyRange(b"", b"\xff"), TLog(knobs))
+        tip = _apply_random(ss, rng)
+        probes = sorted({krand(rng) for _ in range(150)} | {b"nope"})
+        for v in (tip, tip - 3, ss.oldest_version):
+            rep = await ss.get_values(GetValuesRequest.from_keys(probes, v))
+            for i, k in enumerate(probes):
+                scalar = await ss.get_value(k, v)
+                if rep.codes[i] == GV_FOUND:
+                    assert rep.value(i) == scalar, (k, v)
+                else:
+                    assert rep.codes[i] == GV_MISSING and scalar is None
+
+    asyncio.run(main())
+
+
+def test_storage_get_values_engine_fallthrough():
+    """Keys whose chains left the MVCC window resolve through the
+    engine's batched probe — same bytes as scalar get_value."""
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.storage.kv_store import MemoryKVStore
+
+    async def main():
+        rng = random.Random(31)
+        fs = SimFileSystem()
+        eng = await MemoryKVStore.open(fs, "db/ss-eng")
+        # durable rows below the window
+        await eng.commit([(0, b"k%04d" % i, b"durable%04d" % i)
+                          for i in range(0, 600, 2)],
+                         {"durable_version": 0})
+        knobs = Knobs()
+        ss = StorageServer(knobs, 0, KeyRange(b"", b"\xff"), TLog(knobs),
+                           engine=eng)
+        tip = _apply_random(ss, rng, versions=10)
+        probes = sorted({b"k%04d" % rng.randrange(620) for _ in range(200)})
+        rep = await ss.get_values(GetValuesRequest.from_keys(probes, tip))
+        for i, k in enumerate(probes):
+            scalar = await ss.get_value(k, tip)
+            got = rep.value(i) if rep.codes[i] == GV_FOUND else None
+            assert got == scalar, (k, got, scalar)
+
+    asyncio.run(main())
+
+
+def test_storage_get_values_per_key_fences():
+    """A batch mixing healthy keys with relinquished-range keys gets
+    per-key wrong_shard codes — the good keys still answer; and
+    batch-wide too-old / future-version mark every key without failing
+    the RPC."""
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+
+    async def main():
+        knobs = Knobs().override(STORAGE_FUTURE_VERSION_WAIT=0.05)
+        ss = StorageServer(knobs, 0, KeyRange(b"b", b"y"), TLog(knobs))
+        ss._apply_batch([(5, [Mutation.set(b"c1", b"v1"),
+                              Mutation.set(b"m1", b"v2"),
+                              Mutation.set(b"p1", b"v3")])])
+        ss._drop_shard(6, b"m", b"n")   # live-move handoff of [m, n)
+        ss._apply_batch([(7, [Mutation.set(b"c2", b"v4")])])
+        probes = [b"a0", b"c1", b"m1", b"p1", b"z0"]
+        # above the drop version: m1 fenced, shard-outside keys fenced,
+        # the rest healthy
+        rep = await ss.get_values(GetValuesRequest.from_keys(probes, 7))
+        assert list(rep.codes) == [GV_WRONG_SHARD, GV_FOUND, GV_WRONG_SHARD,
+                                   GV_FOUND, GV_WRONG_SHARD]
+        assert rep.value(1) == b"v1" and rep.value(3) == b"v3"
+        # at-or-below the drop version the range still serves history
+        rep = await ss.get_values(GetValuesRequest.from_keys([b"m1"], 6))
+        assert list(rep.codes) == [GV_FOUND] and rep.value(0) == b"v2"
+        # batch-wide too-old
+        ss.oldest_version = 7
+        rep = await ss.get_values(GetValuesRequest.from_keys(probes, 3))
+        assert set(rep.codes) == {GV_TOO_OLD}
+        # batch-wide future version (nothing ever applies version 99)
+        rep = await ss.get_values(GetValuesRequest.from_keys(probes, 99))
+        assert set(rep.codes) == {GV_FUTURE_VERSION}
+
+    asyncio.run(main())
+
+
+# --- replica failover on wholesale can't-serve replies ---
+
+def test_get_values_fails_over_lagged_and_compacted_replicas():
+    """A replica answering WHOLESALE future_version (lags its team) or
+    WHOLESALE too_old (MVCC floor compacted past the read) is skipped
+    for a teammate that can serve — the batched twin of the scalar
+    path's retryable-exception failover — and only when EVERY replica
+    refuses does the client see the per-key code."""
+    from foundationdb_tpu.core.load_balance import ReplicaGroup
+
+    class _Stub:
+        tag = 0
+
+        def __init__(self, reply):
+            self._reply = reply
+
+        async def get_values(self, req):
+            return self._reply
+
+    async def main():
+        good = GetValuesReply.build(bytes([GV_FOUND]), [b"served"])
+        for bad_code in (GV_FUTURE_VERSION, GV_TOO_OLD):
+            bad = GetValuesReply.uniform(bad_code, 1)
+            req = GetValuesRequest.from_keys([b"k"], 10)
+            shard = KeyRange(b"", b"\xff")
+            # whichever order the score picks, the serving replica wins
+            g = ReplicaGroup(shard, [_Stub(bad), _Stub(good)])
+            rep = await g.get_values(req)
+            assert list(rep.codes) == [GV_FOUND] and rep.value(0) == b"served"
+            # every replica refusing surfaces the code per key
+            g2 = ReplicaGroup(shard, [_Stub(bad), _Stub(bad)])
+            rep2 = await g2.get_values(req)
+            assert set(rep2.codes) == {bad_code}
+
+    asyncio.run(main())
+
+
+# --- Transaction.get_multi / coalescing ---
+
+def _seed_cluster(knobs=None, shards: int = 3):
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    return Cluster(ClusterConfig(storage_servers=shards),
+                   knobs or Knobs())
+
+
+async def _load(cluster, rows: dict[bytes, bytes]) -> None:
+    from foundationdb_tpu.client.transaction import Transaction
+    tr = Transaction(cluster)
+    for k, v in rows.items():
+        tr.set(k, v)
+    await tr.commit()
+
+
+def _overlay(tr, rng: random.Random) -> None:
+    """A randomized RYW overlay: sets, clears, atomic stacks."""
+    for _ in range(25):
+        tr.set(krand(rng), b"ryw%04d" % rng.randrange(999))
+    b = krand(rng)
+    tr.clear_range(b, b + b"\x80")
+    for _ in range(6):
+        tr.add(krand(rng), (rng.randrange(1, 200)).to_bytes(4, "little"))
+
+
+def test_get_multi_matches_get_loop():
+    from foundationdb_tpu.client.transaction import Transaction
+
+    async def main():
+        cluster = _seed_cluster()
+        cluster.start()
+        rng = random.Random(41)
+        await _load(cluster, {krand(rng): b"base%04d" % i
+                              for i in range(300)})
+        for snapshot in (False, True):
+            tr_a = Transaction(cluster)
+            tr_b = Transaction(cluster)
+            rng2 = random.Random(43)
+            _overlay(tr_a, random.Random(99))
+            _overlay(tr_b, random.Random(99))
+            probes = [krand(rng2) for _ in range(120)] + [b"zz-missing"]
+            batched = await tr_a.get_multi(probes, snapshot=snapshot)
+            scalar = [await tr_b.get(k, snapshot=snapshot) for k in probes]
+            assert batched == scalar
+            # conflict bookkeeping per key must match the scalar loop's
+            assert sorted(tr_a._read_conflicts) == \
+                sorted(tr_b._read_conflicts)
+        await cluster.stop()
+
+    asyncio.run(main())
+
+
+def test_concurrent_gets_coalesce_and_match():
+    from foundationdb_tpu.client.transaction import Transaction
+
+    async def main():
+        cluster = _seed_cluster(shards=2)
+        cluster.start()
+        rows = {b"c%04d" % i: b"v%04d" % i for i in range(100)}
+        await _load(cluster, rows)
+        tr = Transaction(cluster)
+        keys = sorted(rows) + [b"missing1", b"missing2"]
+        conc = await asyncio.gather(*(tr.get(k, snapshot=True)
+                                      for k in keys))
+        assert conc == [rows.get(k) for k in keys]
+        co = cluster._read_coalescer
+        assert co.max_batch > 1, "concurrent gets never formed a batch"
+        # the knob-off scalar path returns the same bytes
+        k2 = Knobs().override(CLIENT_COALESCE_READS=False)
+        c2 = _seed_cluster(knobs=k2, shards=2)
+        c2.start()
+        await _load(c2, rows)
+        tr2 = Transaction(c2)
+        seq = await asyncio.gather(*(tr2.get(k, snapshot=True)
+                                     for k in keys))
+        assert seq == conc
+        assert getattr(c2, "_read_coalescer", None) is None
+        await c2.stop()
+        await cluster.stop()
+
+    asyncio.run(main())
+
+
+def test_get_multi_spans_shard_boundaries():
+    from foundationdb_tpu.client.transaction import Transaction
+
+    async def main():
+        cluster = _seed_cluster(shards=4)
+        cluster.start()
+        rows = {bytes([b]) + b"-key": bytes([b]) * 3
+                for b in range(1, 250, 7)}
+        await _load(cluster, rows)
+        tr = Transaction(cluster)
+        probes = sorted(rows) + [b"\x00nope", b"\xfe\xfe"]
+        got = await tr.get_multi(probes)
+        assert got == [rows.get(k) for k in probes]
+        # the fan-out really touched several shards
+        touched = {id(cluster.storage_for_key(k)) for k in rows}
+        assert len(touched) > 1
+        await cluster.stop()
+
+    asyncio.run(main())
+
+
+# --- batched change-feed capture (ROADMAP PR 4 (c)) ---
+
+def _naive_capture(feeds, version, batch, shard):
+    """The pre-ISSUE-5 per-feed scan, kept as the reference model."""
+    from foundationdb_tpu.core.change_feed import _filter_excluded
+    out = {}
+    for fid, f in feeds.items():
+        if version <= f.register_version or version <= f.popped_version:
+            continue
+        if f.fence is not None and version > f.fence:
+            continue
+        rb, re_ = f.range.begin, f.range.end
+        if shard is not None:
+            rb, re_ = max(rb, shard.begin), min(re_, shard.end)
+            if rb >= re_:
+                continue
+        ops = list(batch.iter_ops())
+        idxs = [i for i, (t, p1, p2) in enumerate(ops)
+                if (rb <= p1 < re_ if t == 0 else (p1 < re_ and rb < p2))]
+        if idxs:
+            clip = list(f.excluded)
+            if rb > b"":
+                clip.append((0, b"", rb))
+            clip.append((0, re_, b"\xff\xff\xff\xff"))
+            sub = _filter_excluded(batch.select(idxs), clip)
+            if sub:
+                out[fid] = [sub.mutation(i) for i in range(len(sub))]
+    return out
+
+
+def test_capture_interval_pass_matches_per_feed_scan():
+    from foundationdb_tpu.core.change_feed import ChangeFeedStore
+    from foundationdb_tpu.core.data import MutationBatchBuilder
+    rng = random.Random(59)
+    store = ChangeFeedStore()
+    # overlapping, nested and disjoint feeds, one excluded subrange
+    feeds = [(b"f1", b"k01", b"k40"), (b"f2", b"k20", b"k80"),
+             (b"f3", b"k25", b"k30"), (b"f4", b"k70", b"k99"),
+             (b"f5", b"", b"\xff")]
+    for fid, b, e in feeds:
+        store.register(fid, b, e, 0)
+    store.feeds[b"f2"].excluded = [(1, b"k55", b"k60")]
+    shard = KeyRange(b"k0", b"k9")
+    for version in range(1, 15):
+        bld = MutationBatchBuilder()
+        for _ in range(rng.randrange(1, 25)):
+            if rng.random() < 0.3:
+                lo = rng.randrange(95)
+                # cap at 99: two-digit keys keep the range lexicographic
+                # (a real client can never commit an inverted clear)
+                hi = min(lo + rng.randrange(1, 20), 99)
+                bld.add(1, b"k%02d" % lo, b"k%02d" % hi)
+            else:
+                bld.add(0, b"k%02d" % rng.randrange(99),
+                        b"p%04d" % rng.randrange(999))
+        batch = bld.finish()
+        expect = _naive_capture(store.feeds, version, batch, shard)
+        before = {fid: len(f.versions) for fid, f in store.feeds.items()}
+        store.capture(version, batch, shard=shard)
+        for fid, f in store.feeds.items():
+            grew = len(f.versions) - before[fid]
+            if fid in expect:
+                assert grew == 1, (version, fid)
+                got = [f.batches[-1].mutation(i)
+                       for i in range(len(f.batches[-1]))]
+                assert got == expect[fid], (version, fid)
+            else:
+                assert grew == 0, (version, fid)
+
+
+# --- adaptive range-read chunking (satellite b) ---
+
+def test_snapshot_stream_adaptive_chunk():
+    from foundationdb_tpu.client.transaction import Transaction
+
+    async def main():
+        knobs = Knobs().override(CLIENT_RANGE_CHUNK_ROWS=16)
+        cluster = _seed_cluster(knobs=knobs, shards=1)
+        cluster.start()
+        rows = {b"r%05d" % i: b"x" * 20 for i in range(700)}
+        await _load(cluster, rows)
+        tr = Transaction(cluster)
+        seen_limits: list[int] = []
+        group = cluster.storage_for_key(b"r00000")
+        inner = group.get_key_values
+
+        async def spy(begin, end, version, limit=0, reverse=False,
+                      byte_limit=0):
+            seen_limits.append(limit)
+            return await inner(begin, end, version, limit, reverse,
+                               byte_limit)
+
+        group.get_key_values = spy
+        got = await tr.get_range(b"r", b"s")
+        assert got == sorted(rows.items())
+        # the knob seeds the first fetch; later fetches doubled
+        assert seen_limits[0] == 16
+        assert seen_limits[1] == 32 and max(seen_limits) >= 128
+        # huge rows pin the chunk at the byte budget
+        knobs2 = Knobs().override(CLIENT_RANGE_CHUNK_ROWS=4,
+                                  CLIENT_RANGE_CHUNK_BYTES=4000)
+        c2 = _seed_cluster(knobs=knobs2, shards=1)
+        c2.start()
+        await _load(c2, {b"big%03d" % i: b"y" * 900 for i in range(40)})
+        tr2 = Transaction(c2)
+        limits2: list[int] = []
+        g2 = c2.storage_for_key(b"big000")
+        inner2 = g2.get_key_values
+
+        async def spy2(begin, end, version, limit=0, reverse=False,
+                       byte_limit=0):
+            limits2.append(limit)
+            return await inner2(begin, end, version, limit, reverse,
+                                byte_limit)
+
+        g2.get_key_values = spy2
+        got2 = await tr2.get_range(b"big", b"bih")
+        assert len(got2) == 40
+        assert max(limits2) <= 4000 // 900, \
+            "chunk outgrew the reply byte budget"
+        await c2.stop()
+        await cluster.stop()
+
+    asyncio.run(main())

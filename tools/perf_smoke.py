@@ -24,7 +24,16 @@ path, and end-to-end feed lag.  A regression that made capture
 per-mutation-object, or the stream path quadratic in retained
 entries, fails here at tier-1 cost instead of at the north-star bench.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|all]
+Stage 4 (``read``): the batched multiget read path (ISSUE 5) through
+the full pipeline — rows loaded via real commits, then a scalar
+``get()`` loop measured against ``get_multi`` at batch >= 32 (the
+batched path must hold a >= 3x per-key throughput edge), then N
+concurrent readers mixing coalesced point reads and multigets under a
+wall-clock floor.  An O(n)-per-key slip anywhere on the read path —
+client coalescing, wire packing, the batched vmap/engine probes —
+fails here at tier-1 cost, not at r-bench.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -46,6 +55,12 @@ PIPE_BUDGET_S = 60.0        # measured ~1-2s on a loaded 2-cpu host
 FEED_TXNS = 300
 FEED_CLIENTS = 16
 FEED_BUDGET_S = 60.0        # measured ~1-2s on a loaded 2-cpu host
+READ_ROWS = 4096
+READ_OPS = 1536             # keys probed per side (24 x 64-key batches)
+READ_BATCH = 64             # multiget batch size (acceptance: >= 32)
+READ_READERS = 8
+READ_BUDGET_S = 60.0        # measured ~2s on a loaded 2-cpu host
+READ_SPEEDUP_FLOOR = 3.0    # multiget keys/s vs scalar get()/s
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -309,15 +324,151 @@ def check_feed(n_txns: int = FEED_TXNS, n_clients: int = FEED_CLIENTS,
     return elapsed
 
 
+def read_path_seconds(n_rows: int = READ_ROWS, n_ops: int = READ_OPS,
+                      batch: int = READ_BATCH,
+                      n_readers: int = READ_READERS,
+                      deadline_s: float | None = None
+                      ) -> tuple[float, dict]:
+    """Wall seconds for the read-path smoke: ``n_rows`` loaded through
+    real commits, one reader measuring a scalar ``get()`` loop vs
+    ``get_multi`` at ``batch`` over the SAME keys (byte-identical
+    results asserted in situ), then ``n_readers`` concurrent clients
+    mixing coalesced point reads with multigets.  Returns (total
+    elapsed, stats incl. the batched-vs-scalar speedup)."""
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    knobs = Knobs()
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        knobs = knobs.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin, generous budget
+        pass
+
+    def key(i: int) -> bytes:
+        return b"read%08d" % (i % n_rows)
+
+    async def main() -> tuple[float, dict]:
+        cluster = Cluster(ClusterConfig(storage_servers=2), knobs)
+        cluster.start()
+        t_all = time.perf_counter()
+
+        async def loader(lo: int, hi: int) -> None:
+            tr = Transaction(cluster)
+            for start in range(lo, hi, 256):
+                while True:
+                    for i in range(start, min(start + 256, hi)):
+                        tr.set(key(i), b"v%08d" % i)
+                    try:
+                        await tr.commit()
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+                tr.reset()
+
+        span = (n_rows + 7) // 8
+        await asyncio.gather(*(loader(j * span, min((j + 1) * span, n_rows))
+                               for j in range(8)))
+
+        # --- scalar vs multiget, one reader, identical key stream ---
+        tr = Transaction(cluster)
+        probe = [key(i * 2654435761) for i in range(n_ops)]
+        t0 = time.perf_counter()
+        scalar = []
+        for k in probe:
+            scalar.append(await tr.get(k, snapshot=True))
+        t_scalar = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        batched = []
+        for start in range(0, n_ops, batch):
+            batched.extend(await tr.get_multi(probe[start:start + batch],
+                                              snapshot=True))
+        t_multi = time.perf_counter() - t0
+        assert batched == scalar, \
+            "multiget diverged from the scalar get() loop"
+        assert all(v is not None for v in scalar), "load lost rows"
+
+        # --- concurrent readers: coalesced points + multigets ---
+        async def reader(rid: int) -> int:
+            tr = Transaction(cluster)
+            seen = 0
+            for round_ in range(6):
+                ks = [key((rid * 131 + round_ * 977 + j * 37) * 2654435761)
+                      for j in range(batch)]
+                got = await tr.get_multi(sorted(set(ks)), snapshot=True)
+                seen += len(got)
+                pts = await asyncio.gather(
+                    *(tr.get(k, snapshot=True) for k in ks[:16]))
+                assert all(v is not None for v in pts)
+                seen += len(pts)
+            return seen
+
+        t0 = time.perf_counter()
+        seen = sum(await asyncio.gather(*(reader(r)
+                                          for r in range(n_readers))))
+        t_conc = time.perf_counter() - t0
+        co = getattr(cluster, "_read_coalescer", None)
+        stats = {
+            "scalar_reads_per_sec": n_ops / t_scalar if t_scalar else 0.0,
+            "multiget_keys_per_sec": n_ops / t_multi if t_multi else 0.0,
+            "speedup": (t_scalar / t_multi) if t_multi else 0.0,
+            "concurrent_reads": seen,
+            "concurrent_s": t_conc,
+            **(co.stats() if co is not None else {}),
+        }
+        elapsed = time.perf_counter() - t_all
+        await cluster.stop()
+        return elapsed, stats
+
+    async def bounded():
+        return await asyncio.wait_for(main(), deadline_s)
+
+    try:
+        return asyncio.run(bounded())
+    except asyncio.TimeoutError:
+        raise AssertionError(
+            f"read smoke wedged: the {deadline_s:.0f}s deadline hit — "
+            f"a stalled coalescer flush or batched probe, not just "
+            f"slowness") from None
+
+
+def check_read(budget_s: float = READ_BUDGET_S, quiet: bool = False
+               ) -> float:
+    """Run the read-path smoke; raises AssertionError past the budget
+    or below the batched-vs-scalar speedup floor."""
+    elapsed, stats = read_path_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] read path: scalar "
+              f"{stats['scalar_reads_per_sec']:.0f} keys/s, multiget "
+              f"{stats['multiget_keys_per_sec']:.0f} keys/s "
+              f"({stats['speedup']:.1f}x), batches mean="
+              f"{stats.get('read_batch_mean')} max="
+              f"{stats.get('read_batch_max')}")
+    assert elapsed < budget_s, (
+        f"read-path throughput regression: the smoke took {elapsed:.1f}s "
+        f"(budget {budget_s:.0f}s) — client coalescing, wire packing, or "
+        f"the batched vmap/engine probes grew an O(n)-per-key shape")
+    assert stats["speedup"] >= READ_SPEEDUP_FLOOR, (
+        f"multiget speedup {stats['speedup']:.2f}x under the "
+        f"{READ_SPEEDUP_FLOOR:.0f}x floor vs the scalar get() loop at "
+        f"batch {READ_BATCH} — the batched read path lost its edge")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
     ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
-    ap.add_argument("--stage", choices=("apply", "pipeline", "feed", "all"),
+    ap.add_argument("--stage",
+                    choices=("apply", "pipeline", "feed", "read", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
     ap.add_argument("--feed-budget", type=float, default=FEED_BUDGET_S)
+    ap.add_argument("--read-budget", type=float, default=READ_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -325,6 +476,8 @@ def main() -> int:
         check_pipeline(args.txns, budget_s=args.pipe_budget)
     if args.stage in ("feed", "all"):
         check_feed(budget_s=args.feed_budget)
+    if args.stage in ("read", "all"):
+        check_read(budget_s=args.read_budget)
     return 0
 
 
