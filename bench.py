@@ -265,6 +265,29 @@ def measure_pipelined(backend, batches, versions):
     return time.perf_counter() - t0, verdicts
 
 
+def measure_device_pipeline(backend, batches, versions, knobs):
+    """THE commit dispatch path since ISSUE 6: the same batches through
+    device/pipeline.py's DevicePipeline — host-side queueing, fused
+    dispatch, bounded-depth pipelining over the donated-buffer ring.
+    Every batch is enqueued before the pump first runs, so grouping is
+    deterministic (group_max-sized chunks in version order).  Returns
+    (elapsed, verdicts, pipeline metrics)."""
+    import asyncio
+
+    from foundationdb_tpu.device.pipeline import DevicePipeline
+
+    async def run():
+        pipe = DevicePipeline(backend, knobs)
+        t0 = time.perf_counter()
+        futs = [pipe.submit(t, v) for t, v in zip(batches, versions)]
+        rows = [await f for f in futs]
+        elapsed = time.perf_counter() - t0
+        await pipe.close()
+        return elapsed, rows, pipe.metrics()
+
+    return asyncio.run(run())
+
+
 def measure_grouped(backend, wires, versions, group: int, inflight: int = 4):
     """THE throughput path: serialized wire batches (the proxy→resolver
     payload) fused into groups — one device dispatch + one overlapped
@@ -358,6 +381,13 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
             backend.resolve(txns, v)
         measure_grouped(backend, warm_wires[4:], warm_versions[4:],
                         group=GROUP, inflight=INFLIGHT)
+        from foundationdb_tpu.device.pipeline import supports_pipeline
+        if supports_pipeline(backend):
+            # compile the lanes-path group bucket the DevicePipeline
+            # measurement below dispatches (RESOLVER_GROUP_MAX fusion,
+            # distinct jit entry from the wire path measure_grouped warms)
+            measure_device_pipeline(fresh(), warm_batches[4:4 + n_serial],
+                                    warm_versions[4:4 + n_serial], knobs)
         if getattr(backend, "reset_ring", lambda *_: False)(0):
             # fill the transfer dictionary with the measured key set and
             # compile the steady-state update-bucket kernels, then clear
@@ -368,7 +398,8 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
                             inflight=INFLIGHT)
             backend.reset_ring(0)
 
-        # 1. serial latency probe (prefix): every batch synced before the next
+        # 1. serial latency probe (prefix): every batch synced before the
+        # next — the UNPIPELINED baseline of the ISSUE 6 in-run A/B
         elapsed, verdicts, lat = measure_backend(
             fresh(), batches[:n_serial], versions[:n_serial])
         flat = np.array([x for vs in verdicts for x in vs])
@@ -376,6 +407,20 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
         pipe_elapsed, pipe_verdicts = measure_pipelined(
             fresh(), batches[:n_serial], versions[:n_serial])
         pipe_flat = np.array([x for vs in pipe_verdicts for x in vs])
+        # 2b. the device commit pipeline (ISSUE 6) over the same prefix:
+        # fused pipelined dispatch with the overlap/queue observability
+        # the artifact now carries.  Encoded backends only — the cpp
+        # interval map resolves host-side per batch and gains nothing.
+        dp = None
+        dp_backend = fresh()
+        if supports_pipeline(dp_backend):
+            dp_elapsed, dp_verdicts, dp_metrics = measure_device_pipeline(
+                dp_backend, batches[:n_serial], versions[:n_serial], knobs)
+            dp = {
+                "elapsed": dp_elapsed,
+                "flat": np.array([x for vs in dp_verdicts for x in vs]),
+                "metrics": dp_metrics,
+            }
         # 3. fused-group throughput over the FULL run — the headline
         # number.  Best of 4 passes: single-pass numbers swing 2x+ with
         # transient host load AND tunnel RTT weather (r4 measured the
@@ -420,7 +465,22 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
             "pipelined_matches_serial": bool((pipe_flat == flat).all()),
             "grouped_matches_serial":
                 bool((grp_flat[:len(flat)] == flat).all()),
+            "unpipelined_txns_per_sec": len(flat) / elapsed,
         }
+        if dp is not None:
+            m = dp["metrics"]
+            results[kind].update({
+                "device_pipelined_txns_per_sec":
+                    len(dp["flat"]) / dp["elapsed"],
+                "device_pipeline_matches_serial":
+                    bool((dp["flat"] == flat).all()),
+                "pipeline_depth": m["device_pipeline_depth"],
+                "pipeline_dispatch_us_per_batch":
+                    m["device_dispatch_us_per_batch"],
+                "pipeline_overlap_ratio": m["device_overlap_ratio"],
+                "pipeline_group_mean": m["device_group_mean"],
+                "pipeline_dispatches": m["device_dispatches"],
+            })
         all_verdicts[kind] = grp_flat
         if not quiet:
             print(f"[{kind}] {results[kind]}", file=sys.stderr)
@@ -436,12 +496,23 @@ def run(n_batches: int, batch_size: int, n_keys: int, quiet: bool,
     }
 
 
-def tpu_e2e_knobs(kind: str):
+def tpu_e2e_knobs(kind: str, device=None):
     """The r5 tpu e2e operating point: shallow concurrent batches fused
     by the resolver's group dispatcher (VERDICT r4 1b) — COMMIT_BATCH 5ms
     pinned to one 64-txn chunk, group bucket pinned to one compile shape,
     ring sized so 5s of writes never wedge the too-old floor, window
-    sized past snapshot staleness (~24 batches at tunnel latency)."""
+    sized past snapshot staleness (~24 batches at tunnel latency).
+
+    With NO device (the jax backend running on host CPU — this box's
+    BENCH_r0* fallback mode), the tunnel sizing is actively wrong: the
+    8192-slot window multiplies kernel compare cost the host CPUs pay
+    for real, and snapshot staleness is loop-scheduling-deep, not
+    tunnel-RTT-deep.  r08's zeroed jax stages (e2e_tps_tpu 0.0,
+    tpcc_livelock true, abort_rate 1.0, every abort code 1007) were
+    exactly this: tunnel-scale concurrency drove every transaction past
+    the 5s MVCC life window on a 2-cpu host.  Host-CPU mode shrinks the
+    window/ring to the measured-good CPU shape; the client counts scale
+    down in the phase drivers below."""
     from foundationdb_tpu.runtime import Knobs
     knobs = Knobs().override(RESOLVER_CONFLICT_BACKEND=kind)
     if kind == "tpu":
@@ -450,7 +521,17 @@ def tpu_e2e_knobs(kind: str):
             RESOLVER_BATCH_TXNS=64, COMMIT_BATCH_COUNT_LIMIT=64,
             CONFLICT_RING_CAPACITY=1 << 17, CONFLICT_WINDOW_SLOTS=8192,
             KEY_ENCODE_BYTES=32, RESOLVER_GROUP_BUCKET=8)
+        if device is None:
+            knobs = knobs.override(
+                CONFLICT_RING_CAPACITY=1 << 16, CONFLICT_WINDOW_SLOTS=1024)
     return knobs
+
+
+# client counts for the jax-backend workload stages, per attach mode:
+# (e2e, ycsb, tpcc).  The tunnel numbers amortize a ~64ms RTT across
+# deep concurrency; host-CPU mode must stay inside what a 2-cpu box
+# serves within the MVCC life window (see tpu_e2e_knobs)
+_TPU_CLIENTS = {"device": (512, 256, 128), "host-cpu": (32, 32, 16)}
 
 
 def run_e2e_phase(tpu_device, quiet: bool) -> dict:
@@ -461,15 +542,18 @@ def run_e2e_phase(tpu_device, quiet: bool) -> dict:
 
     from foundationdb_tpu.bench.e2e import run_e2e
 
+    mode = "device" if tpu_device is not None else "host-cpu"
+    n_clients = _TPU_CLIENTS[mode][0]
     out = {}
     out["cpp"] = asyncio.run(run_e2e(tpu_e2e_knobs("cpp"), duration_s=5.0,
                                      n_clients=64, warmup_s=1.0))
-    out["tpu"] = asyncio.run(run_e2e(tpu_e2e_knobs("tpu"), duration_s=8.0,
-                                     n_clients=512, device=tpu_device,
-                                     warmup_s=15.0))
+    out["tpu"] = asyncio.run(run_e2e(tpu_e2e_knobs("tpu", tpu_device),
+                                     duration_s=8.0, n_clients=n_clients,
+                                     device=tpu_device, warmup_s=20.0))
+    out["tpu"]["mode"] = mode
     if not quiet:
         print(f"[e2e cpp] {out['cpp']}", file=sys.stderr)
-        print(f"[e2e tpu] {out['tpu']}", file=sys.stderr)
+        print(f"[e2e tpu/{mode}] {out['tpu']}", file=sys.stderr)
     return out
 
 
@@ -502,21 +586,25 @@ def run_configs34_phase(tpu_device, quiet: bool,
     from foundationdb_tpu.bench.tpcc import run_tpcc_neworder
     from foundationdb_tpu.bench.ycsb import run_ycsb_f
 
-    out: dict = {}
+    mode = "device" if tpu_device is not None else "host-cpu"
+    out: dict = {"tpu_mode": mode}
     for kind in ("cpp", "tpu"):
         dev = tpu_device if kind == "tpu" else None
-        warm = 10.0 if kind == "tpu" else 1.0
-        clients = 256 if kind == "tpu" else 64
-        knobs = tpu_e2e_knobs(kind)
+        warm = 15.0 if kind == "tpu" else 1.0
+        if kind == "tpu":
+            clients, tpcc_clients = _TPU_CLIENTS[mode][1:]
+        else:
+            clients, tpcc_clients = 64, 32
+        knobs = tpu_e2e_knobs(kind, dev)
 
         def ycsb(knobs=knobs, clients=clients, dev=dev, warm=warm):
             return asyncio.run(run_ycsb_f(
                 knobs, n_rows=1_000_000, duration_s=30.0, n_clients=clients,
                 device=dev, warmup_s=warm))
 
-        def tpcc(knobs=knobs, clients=clients, dev=dev, warm=warm):
+        def tpcc(knobs=knobs, clients=tpcc_clients, dev=dev, warm=warm):
             return asyncio.run(run_tpcc_neworder(
-                knobs, duration_s=30.0, n_clients=clients // 2, device=dev,
+                knobs, duration_s=30.0, n_clients=clients, device=dev,
                 warmup_s=warm))
 
         res = call_bounded(f"ycsb_{kind}", ycsb, budget_s, out)
@@ -927,6 +1015,10 @@ def main() -> int:
                     "e2e_abort_rate_cpp": rnd(e2e["cpp"]["abort_rate"], 3),
                     "e2e_n_clients_tpu": e2e["tpu"]["n_clients"],
                     "e2e_n_clients_cpp": e2e["cpp"]["n_clients"],
+                    # which attach mode produced the jax-side numbers
+                    # (host-cpu = the no-TPU fallback operating point;
+                    # r08's zeroed stages ran tunnel sizing here)
+                    "e2e_tpu_mode": e2e["tpu"].get("mode"),
                     # full commit-path stage breakdown (VERDICT r4 1a)
                     "e2e_stages_tpu": e2e["tpu"]["stages"],
                     "e2e_stages_cpp": e2e["cpp"]["stages"],
@@ -949,6 +1041,8 @@ def main() -> int:
             # after the merge so per-workload timeouts inside configs34
             # are visible to the don't-close-under-a-live-thread guard
             stage_trace_end(tok, out, "configs34")
+            if c34.get("tpu_mode"):
+                out["configs34_tpu_mode"] = c34["tpu_mode"]
             # flatten per-(workload, backend) INDEPENDENTLY: when one
             # side timed out, the other side's measured numbers must
             # still reach the artifact (the degrade contract)
@@ -1076,7 +1170,34 @@ def process_resolver_result(r, out: dict, args, fallback: bool) -> int:
             "grouped_us_per_batch_tpu":
                 round(res["tpu"]["elapsed_s"] / args.batches * 1e6, 1),
         })
+    # ISSUE 6: the device commit pipeline's in-run A/B + dispatch shape,
+    # so the trajectory shows WHY the resolver number moved (depth,
+    # fusion width, per-batch dispatch cost, transfer/kernel overlap)
+    tpu = res["tpu"]
+    if "device_pipelined_txns_per_sec" in tpu:
+        out.update({
+            "device_pipelined_txns_per_sec":
+                round(tpu["device_pipelined_txns_per_sec"], 1),
+            "unpipelined_txns_per_sec":
+                round(tpu["unpipelined_txns_per_sec"], 1),
+            "pipeline_ab_ratio": round(
+                tpu["device_pipelined_txns_per_sec"]
+                / tpu["unpipelined_txns_per_sec"], 2)
+            if tpu["unpipelined_txns_per_sec"] else None,
+            "pipeline_depth": tpu["pipeline_depth"],
+            "pipeline_dispatch_us_per_batch":
+                round(tpu["pipeline_dispatch_us_per_batch"], 1),
+            "pipeline_overlap_ratio": tpu["pipeline_overlap_ratio"],
+            "pipeline_group_mean": tpu["pipeline_group_mean"],
+            "pipeline_dispatches": tpu["pipeline_dispatches"],
+            "device_pipeline_verdicts_match":
+                tpu["device_pipeline_matches_serial"],
+        })
     rc = 0
+    if not out.get("device_pipeline_verdicts_match", True):
+        print("FATAL: device-pipeline verdicts diverge from serial",
+              file=sys.stderr)
+        rc = 1
     if not r["parity"]:
         # a kernel that disagrees with the exact CPU baseline must fail
         # the bench, not just annotate the metric

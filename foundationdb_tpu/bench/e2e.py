@@ -94,6 +94,8 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
             role.stages.reset()
         for r in cluster.resolvers:
             r.group_sizes.clear()
+            if r._pipeline is not None:
+                r._pipeline.reset_stats()
         return time.perf_counter()
 
     timer = asyncio.ensure_future(phase_timer())
@@ -115,6 +117,27 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
             round(sum(gsizes) / len(gsizes), 2) if gsizes else None,
         "fused_dispatches": len(gsizes),
     }
+    # device commit pipeline shape (ISSUE 6): depth, fusion width,
+    # per-batch dispatch cost and transfer/kernel overlap — why the
+    # resolver sync number moved, not just that it did
+    pipes = [r._pipeline.metrics() for r in cluster.resolvers
+             if r._pipeline is not None]
+    if pipes:
+        stages["resolver_device"] = {
+            "pipeline_depth": pipes[0]["device_pipeline_depth"],
+            "dispatches": sum(p["device_dispatches"] for p in pipes),
+            "group_mean": round(
+                sum(p["device_batches_dispatched"] for p in pipes)
+                / max(1, sum(p["device_dispatches"] for p in pipes)), 2),
+            "dispatch_us_per_batch": round(
+                sum(p["device_dispatch_us_per_batch"] for p in pipes)
+                / len(pipes), 1),
+            "overlap_ratio": round(
+                sum(p["device_overlap_ratio"] for p in pipes)
+                / len(pipes), 3),
+            "queue_peak": max(p["device_queue_peak"] for p in pipes),
+            "inflight_peak": max(p["device_inflight_peak"] for p in pipes),
+        }
     await cluster.stop()
 
     from .stats import latency_ms

@@ -154,6 +154,8 @@ class Cluster:
         await self.ratekeeper.stop()
         for cp in self.commit_proxies:
             await cp.stop()
+        for r in self.resolvers:
+            await r.stop()
         for ss in self.storage_servers:
             await ss.stop()
         self._started = False

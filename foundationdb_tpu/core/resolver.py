@@ -99,15 +99,50 @@ class Resolver:
         self._inflight_groups: list[asyncio.Future] = []
         self._last_submitted_version: Version = epoch_begin_version
         self.group_sizes: list[int] = []    # batches per fused dispatch
+        # --- device commit pipeline (ISSUE 6) ---
+        # The encoded backends' dispatch path moves into
+        # device/pipeline.py: persistent on-device ConflictState in
+        # donated buffers, host-side queueing, bounded-depth pipelined
+        # dispatch with overlap accounting.  The legacy in-role dispatch
+        # loop stays as the knob-off fallback; the cpp interval map
+        # resolves host-side per batch and never rides a pipeline.
+        self._pipeline = None
+        if self._fuse and knobs.RESOLVER_DEVICE_PIPELINE:
+            from ..device.pipeline import DevicePipeline, supports_pipeline
+            if supports_pipeline(self.backend):
+                self._pipeline = DevicePipeline(
+                    self.backend, knobs, on_poison=self._poison,
+                    epoch_begin_version=epoch_begin_version)
+                # one list: e2e's stage breakdown clears/reads the
+                # resolver's group_sizes regardless of which path ran
+                self.group_sizes = self._pipeline.group_sizes
 
     async def metrics(self) -> dict:
-        """Role counters for status (span rollup + resolve load)."""
+        """Role counters for status (span rollup + resolve load +
+        device-pipeline queue/in-flight depth — cluster.resolver_device)."""
         return {
             "total_batches": self.total_batches,
             "total_txns": self.total_txns,
             "total_conflicts": self.total_conflicts,
             **self.spans.counters(),
+            **(self._pipeline.metrics() if self._pipeline is not None
+               else {}),
         }
+
+    async def close(self, discard: bool = False) -> None:
+        """Generation end: drain (or discard) the device pipeline so no
+        in-flight dispatch outlives the role — recovery replaces the
+        resolver, and its successor must not race verdict readbacks
+        against a ring it never saw (clean drain/rollback, ISSUE 6)."""
+        if self._pipeline is not None:
+            await self._pipeline.close(discard=discard)
+
+    async def stop(self) -> None:
+        """Role teardown (worker stop_role / machine kill): the rollback
+        path — recovery replaces the resolver, so queued batches fail
+        with ResolverFailed instead of resolving against a ring the next
+        generation won't trust."""
+        await self.close(discard=True)
 
     async def _wait_for_version(self, prev_version: Version) -> None:
         if self.version >= prev_version:
@@ -238,17 +273,30 @@ class Resolver:
         exactly as the split-phase path did — except for state batches,
         which hold the chain until their verdicts return (the same
         pipeline barrier as the serial path: their committed mutations
-        must be in the state log before any later batch's reply)."""
-        fut = loop.create_future()
-        self._pending.append((req, fut))
-        if not req.state_txns:
-            self._advance_to(req.version)
-        if self._dispatch_task is None or self._dispatch_task.done():
-            # long-lived FIFO dispatcher: mask the current request's span
-            # so later groups aren't attributed to this transaction
-            with no_span():
-                self._dispatch_task = loop.create_task(
-                    self._dispatch_loop(), name="resolver-group-dispatch")
+        must be in the state log before any later batch's reply).
+
+        With RESOLVER_DEVICE_PIPELINE on, the dispatch moves into
+        device/pipeline.py (ISSUE 6): same enqueue-order contract, but
+        the pump owns ring compaction, bounded-depth pipelining, and the
+        overlap/queue-depth observability the in-role loop never had.
+        A state batch submits as a pipeline BARRIER so its group ends at
+        it and its verdicts never wait on later batches' kernels."""
+        if self._pipeline is not None:
+            fut = self._pipeline.submit(req.txns, req.version, span_ctx,
+                                        barrier=bool(req.state_txns))
+            if not req.state_txns:
+                self._advance_to(req.version)
+        else:
+            fut = loop.create_future()
+            self._pending.append((req, fut))
+            if not req.state_txns:
+                self._advance_to(req.version)
+            if self._dispatch_task is None or self._dispatch_task.done():
+                # long-lived FIFO dispatcher: mask the current request's
+                # span so later groups aren't attributed to this txn
+                with no_span():
+                    self._dispatch_task = loop.create_task(
+                        self._dispatch_loop(), name="resolver-group-dispatch")
         t0 = loop.time()
         verdicts = await fut
         self.stages.record("sync", loop.time() - t0)
@@ -283,6 +331,12 @@ class Resolver:
                     await asyncio.wait({self._inflight_groups[0]})
                     self._inflight_groups = [
                         g for g in self._inflight_groups if not g.done()]
+                if self._poisoned is not None or not self._pending:
+                    # a group sync that failed while we were parked at
+                    # the in-flight gate poisoned the resolver and
+                    # drained _pending — exit instead of assembling an
+                    # empty group and dying on group[-1]
+                    break
                 group = []
                 while self._pending \
                         and len(group) < self.knobs.RESOLVER_GROUP_MAX:

@@ -150,6 +150,58 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             m.get("feed_mutations_captured", 0) for m in storage_metrics),
     }
 
+    # device-commit-pipeline rollup (ISSUE 6): the resolvers' DevicePipeline
+    # queue/in-flight counters, so a slow commit's wait shows up as rising
+    # queue depth (host-side backlog) vs dispatch/readback p99 (device-side
+    # cost) without grepping role metrics — the status half of the
+    # ResolverDevice.enqueue/dispatch/readback span events trace_tool joins
+    resolver_metrics = [r.get("metrics") for r in roles
+                        if r["role"] == "resolver" and r.get("metrics")]
+    device_resolvers = [m for m in resolver_metrics
+                        if m.get("device_pipeline")]
+    resolver_device_rollup = {
+        "pipelined_resolvers": len(device_resolvers),
+        "enqueued": sum(m.get("device_enqueued", 0)
+                        for m in device_resolvers),
+        "dispatches": sum(m.get("device_dispatches", 0)
+                          for m in device_resolvers),
+        "queue_depth": sum(m.get("device_queue_depth", 0)
+                           for m in device_resolvers),
+        "queue_peak": max((m.get("device_queue_peak", 0)
+                           for m in device_resolvers), default=0),
+        "inflight": sum(m.get("device_inflight", 0)
+                        for m in device_resolvers),
+        "inflight_peak": max((m.get("device_inflight_peak", 0)
+                              for m in device_resolvers), default=0),
+        "dispatch_p99_ms": max((m.get("device_dispatch_p99_ms", 0.0)
+                                for m in device_resolvers), default=0.0),
+        "readback_p99_ms": max((m.get("device_readback_p99_ms", 0.0)
+                                for m in device_resolvers), default=0.0),
+        "overlap_ratio": round(
+            sum(m.get("device_overlap_ratio", 0.0)
+                for m in device_resolvers) / len(device_resolvers), 3)
+        if device_resolvers else 0.0,
+        "poisoned": sum(m.get("device_poisoned", 0)
+                        for m in device_resolvers),
+    }
+
+    # device read serving rollup (ISSUE 6): how much of get_values'
+    # missing-key traffic the PackedKeyIndex device mirror actually
+    # answered vs fell back to the engine path (stale mirror / below
+    # the batch threshold), plus the mirror re-upload volume
+    device_reads_rollup = {
+        "active_servers": sum(
+            1 for m in storage_metrics if m.get("device_read_active")),
+        "batches_served": sum(
+            m.get("device_read_batches", 0) for m in storage_metrics),
+        "keys_served": sum(
+            m.get("device_read_keys", 0) for m in storage_metrics),
+        "fallbacks": sum(
+            m.get("device_read_fallbacks", 0) for m in storage_metrics),
+        "mirror_uploads": sum(
+            m.get("device_read_uploads", 0) for m in storage_metrics),
+    }
+
     # distributed-tracing rollup (ISSUE 2): every metric-bearing role
     # reports its span counters; sampled_txns comes from the GRV proxies
     # (where every sampled root first crosses the wire).  SERVER-side
@@ -177,6 +229,8 @@ async def cluster_status(knobs: Knobs, transport: Transport,
                 for r in roles if not r["reachable"]],
             "storage_apply": apply_rollup,
             "change_feeds": feed_rollup,
+            "resolver_device": resolver_device_rollup,
+            "device_reads": device_reads_rollup,
             "tracing": tracing_rollup,
         },
         "roles": roles,

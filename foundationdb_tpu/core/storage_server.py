@@ -129,6 +129,17 @@ class StorageServer:
         # arriving without a sampled client context (ROADMAP PR 2 (a))
         from ..runtime.span import ServerSampler
         self._server_sampler = ServerSampler(namespace=2)
+        # device gather path for point-read serving (ISSUE 6): a device
+        # mirror of the engine's PackedKeyIndex answers get_values'
+        # missing-key pass with one vectorized searchsorted per batch.
+        # Capability-probed: engines without a packed index (or no
+        # usable jax) report inactive and the engine path serves.
+        self._device_reads = None
+        if engine is not None and knobs.STORAGE_DEVICE_READ_SERVE:
+            from ..device.read_serve import DeviceReadServer
+            srv = DeviceReadServer(engine, knobs)
+            if srv.active:
+                self._device_reads = srv
 
     async def metrics(self) -> dict:
         """Queue/lag sample for the Ratekeeper (StorageQueuingMetrics
@@ -160,6 +171,8 @@ class StorageServer:
             "fetch_failed": self._fetch_failed,
             **self.feeds.metrics(),
             **self.spans.counters(),
+            **(self._device_reads.metrics()
+               if self._device_reads is not None else {}),
         }
 
     # --- lifecycle ---
@@ -898,7 +911,16 @@ class StorageServer:
                 missing.append(i)
         if missing:
             if self.engine is not None:
-                got = self.engine.get_batch([keys[i] for i in missing])
+                miss_keys = [keys[i] for i in missing]
+                # device gather first (ISSUE 6): one vectorized
+                # searchsorted over the mirrored key prefixes answers the
+                # whole batch; None = take the engine path (below
+                # threshold, stale mirror — identical results either way)
+                got = None
+                if self._device_reads is not None:
+                    got = self._device_reads.get_batch(miss_keys)
+                if got is None:
+                    got = self.engine.get_batch(miss_keys)
                 for i, v in zip(missing, got):
                     if v is None:
                         codes[i] = GV_MISSING

@@ -1,0 +1,9 @@
+"""Device-resident subsystems (ISSUE 6).
+
+``pipeline``   — the resolver's device commit pipeline: persistent
+                 on-device ConflictState in donated buffers, host-side
+                 batch queueing, fused pipelined dispatch.
+``read_serve`` — device gather path for point-read serving: a mirror of
+                 the storage engine's PackedKeyIndex key prefixes served
+                 by one vectorized searchsorted per batch.
+"""

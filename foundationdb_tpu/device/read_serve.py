@@ -1,0 +1,161 @@
+"""Device gather path for point-read serving (ISSUE 6; the PR 5
+follow-up "TPU gather kernel for point serving").
+
+``get_values``' missing-key pass — the sorted keys the MVCC window does
+not resolve — is exactly a batched sorted-probe over the storage
+engine's key space, and ``PackedKeyIndex`` already keeps that key space
+as two sorted runs with keycode-u64 prefixes (storage/key_index.py).
+This module mirrors the BASE run's u64 prefixes as a device array and
+answers a whole batch with ONE vectorized ``searchsorted`` pair
+(left/right bounds) on device, the same pack-keys-into-lanes discipline
+the resolver kernel uses.  The host then only refines inside the
+(usually single-element) equal-prefix band and gathers values for the
+keys that exist — no per-key descent over the big run.
+
+Freshness contract: the mirror is stamped with the index ``gen`` counter
+(bumped whenever the base run mutates: merges, discards).  A batch
+arriving with a stale mirror is served by the ENGINE path — identical
+results, tested — and triggers a re-upload so the next batch is fresh;
+the pending overlay (keys inserted since the last merge) is always
+probed host-side, so the mirror only ever needs to track merges, not
+every insert.  The re-upload happens inline on that first stale batch:
+its host half is the index's own cached ``_prefixes()`` array — the
+same once-per-merge encode the numpy bound path already pays — and
+``jax.device_put`` returns before the transfer completes, so only the
+prefix (re)encode can land on the serving path, once per merge.  Batches below ``STORAGE_DEVICE_READ_MIN_BATCH`` skip the
+device entirely (a lone probe's dispatch overhead beats any gather win —
+the same threshold reasoning as PackedKeyIndex.ranges_keys).
+
+Results are BYTE-IDENTICAL to ``engine.get_batch`` by construction: the
+device only locates candidate bands; membership is decided by the same
+bisect refinement the host index uses, and values come from the same
+engine storage.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+from ..runtime.knobs import Knobs
+
+
+def _jax_ready() -> bool:
+    """The mirror needs uint64 device arrays: jax importable with x64 on
+    (without x64 jnp silently truncates u64 to u32 — a wrong-band bug,
+    not a slowdown — so this gate is correctness, not convenience)."""
+    try:
+        import jax
+        return bool(jax.config.jax_enable_x64)
+    except Exception:   # noqa: BLE001 — no jax: engine path only
+        return False
+
+
+class DeviceKeyDirectory:
+    """Device mirror of one PackedKeyIndex base run's u64 prefixes."""
+
+    def __init__(self, index, device=None) -> None:
+        self._index = index
+        self._device = device
+        self._pfx_dev = None
+        self._gen = -1          # index.gen the mirror was built at
+        self.uploads = 0
+        self.uploaded_keys = 0
+
+    @property
+    def fresh(self) -> bool:
+        return self._pfx_dev is not None and self._gen == self._index.gen
+
+    def refresh(self) -> None:
+        """Re-upload the base run's prefixes (called on merge/discard
+        staleness, not per batch).  Runs inline: the prefix array is the
+        index's shared once-per-merge cache and device_put returns
+        before the transfer completes (see the module docstring)."""
+        import jax
+        pfx = self._index.base_prefixes()
+        self._gen = self._index.gen
+        self._pfx_dev = jax.device_put(pfx, self._device) \
+            if self._device is not None else jax.device_put(pfx)
+        self.uploads += 1
+        self.uploaded_keys += int(pfx.shape[0])
+
+    def lookup(self, keys: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+        """One device dispatch for the whole batch: (lo, hi) candidate
+        bands over the base run per key.  Caller must hold ``fresh``."""
+        import jax.numpy as jnp
+        from ..ops.keycode import encode_prefix_u64
+        probes = encode_prefix_u64(keys)
+        los = jnp.searchsorted(self._pfx_dev, probes, side="left")
+        his = jnp.searchsorted(self._pfx_dev, probes, side="right")
+        return np.asarray(los), np.asarray(his)
+
+
+class DeviceReadServer:
+    """Per-storage-server device read path over the engine's key index.
+
+    ``get_batch(keys)`` returns the same list ``engine.get_batch`` would,
+    or None to tell the caller to take the engine path (below threshold,
+    stale mirror, engine without a packed index, no usable jax)."""
+
+    def __init__(self, engine, knobs: Knobs, device=None) -> None:
+        self.engine = engine
+        self.knobs = knobs
+        self.min_batch = max(1, knobs.STORAGE_DEVICE_READ_MIN_BATCH)
+        index = getattr(engine, "packed_index", None)
+        self._dir = None
+        if index is not None and knobs.STORAGE_DEVICE_READ_SERVE \
+                and _jax_ready():
+            self._dir = DeviceKeyDirectory(index, device)
+        # --- observability (storage metrics → status rollup) ---
+        self.served_batches = 0
+        self.served_keys = 0
+        self.fallbacks = 0      # batches routed to the engine path
+
+    @property
+    def active(self) -> bool:
+        return self._dir is not None
+
+    def get_batch(self, keys: list[bytes]):
+        if self._dir is None or len(keys) < self.min_batch:
+            if self._dir is not None:
+                self.fallbacks += 1
+            return None
+        index = self._dir._index
+        if not self._dir.fresh:
+            # stale mirror: serve THIS batch off the engine, refresh so
+            # the next one rides the device (refresh on merge, not per
+            # batch — steady-state reads never pay an upload)
+            self.fallbacks += 1
+            self._dir.refresh()
+            return None
+        los, his = self._dir.lookup(keys)
+        base = index.base_run()
+        pending = index.pending_run()
+        get = self.engine.get
+        out: list[bytes | None] = []
+        for k, lo, hi in zip(keys, los, his):
+            lo, hi = int(lo), int(hi)
+            present = False
+            if lo < hi:
+                i = bisect.bisect_left(base, k, lo, hi)
+                present = i < hi and base[i] == k
+            if not present and pending:
+                j = bisect.bisect_left(pending, k)
+                present = j < len(pending) and pending[j] == k
+            out.append(get(k) if present else None)
+        self.served_batches += 1
+        self.served_keys += len(keys)
+        return out
+
+    def metrics(self) -> dict:
+        d = self._dir
+        return {
+            "device_read_active": int(self.active),
+            "device_read_batches": self.served_batches,
+            "device_read_keys": self.served_keys,
+            "device_read_fallbacks": self.fallbacks,
+            "device_read_uploads": d.uploads if d is not None else 0,
+            "device_read_uploaded_keys":
+                d.uploaded_keys if d is not None else 0,
+        }

@@ -438,13 +438,16 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
     layout carried the whole ring through the scan and its per-batch
     rewrite cost scaled with capacity, capping usable history.
 
-    Semantics vs. K chained single-batch dispatches: identical except at
-    eviction edges — the too-old floor advances once per DISPATCH (to the
-    max version of the evicted slots) instead of once per batch, so a
-    fused group can only produce FEWER forced TOO_OLDs than the chained
-    equivalent, never more (both are sound conservative compactions, and
-    verdicts differ only for snapshots older than the retained history).
-    Padding batches (commit_version < 0, TRAILING by the callers'
+    Semantics vs. K chained single-batch dispatches: identical, INCLUDING
+    at eviction edges — each batch in the scan sees the too-old floor the
+    chained path would give it (start floor maxed with the running max of
+    the cold slots evicted by its predecessors' appends; slots are
+    oldest-first so the per-batch edge is one strided slice + cummax).
+    The dispatch-level floor of the original r5 kernel advanced once per
+    dispatch, which was sound-conservative but broke the "verdicts
+    bit-identical to the CPU twin" gate when a fused group wrapped the
+    ring (ADVICE r5 finding; the PR 6 resolve smoke exercises exactly
+    this boundary).  Padding batches (commit_version < 0, TRAILING by the callers'
     construction) write sentinel slabs into the hot staging buffer but
     are DROPPED at the final append — the cold ring advances by exactly
     n_real*B*R slots, so a bucket-pinned dispatch carrying one real batch
@@ -470,6 +473,19 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
     W = window
     C_hot = 1 + W + T
     start_floor = state.floor
+    # per-batch too-old floors, exactly as the chained path would raise
+    # them: batch k's floor = start floor maxed with every cold slot its
+    # predecessors' appends evicted, i.e. max(cold[:k*S_]).  Slots are
+    # appended in version order, so each evicted prefix's LAST slot
+    # carries its max and the strided slice suffices; cummax makes the
+    # edge sequence monotone.  (This relies on the oldest-first ring
+    # invariant every backend maintains — a non-monotone ring would need
+    # a true per-prefix max.  Trailing pad batches read a floor too;
+    # their verdicts are discarded by construction.)
+    edges = lax.cummax(state.hver[S_ - 1:T - 1:S_]) if K > 1 \
+        else jnp.zeros((0,), state.hver.dtype)
+    floors = jnp.maximum(start_floor, jnp.concatenate(
+        [jnp.full((1,), jnp.iinfo(jnp.int64).min, state.hver.dtype), edges]))
     # hot staging buffer: [edge slot | cold's W newest | K slabs]
     hotb0 = jnp.concatenate(
         [state.hb[:, C - W - 1:],
@@ -485,9 +501,9 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
 
     def body(carry, x):
         hotb, hote, hotv, lastv = carry
-        rb, re, wb, we, sn, cv, k = x
+        rb, re, wb, we, sn, cv, k, flr = x
         off = (k * S_).astype(i32)
-        too_old = sn < start_floor
+        too_old = sn < flr
         valid = sn >= 0
         # batch k's window = hot[1+k*S_ : 1+k*S_+W]; its edge = hot[k*S_]
         winb = lax.dynamic_slice(hotb, (i32(0), off + 1), (L, W))
@@ -528,7 +544,7 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
     (hotbF, hoteF, hotvF, _), verdicts = lax.scan(
         body, (hotb0, hote0, hotv0, lastv0),
         (read_begin, read_end, write_begin, write_end, snap,
-         commit_versions, jnp.arange(K)))
+         commit_versions, jnp.arange(K), floors))
 
     # Bulk append of the REAL slabs only: concat(cold, hot slab region)
     # then one dynamic-offset slice of static size C starting at

@@ -39,6 +39,17 @@ class Knobs:
     # mid-traffic — padding every group to a fixed bucket trades a few KB
     # of sentinel rows for a single warmup-time compile
     RESOLVER_GROUP_BUCKET: int = 0
+    # device commit pipeline (ISSUE 6): the resolver's encoded backends
+    # dispatch through device/pipeline.py's DevicePipeline — persistent
+    # on-device ConflictState in donated buffers, batches enqueued
+    # host-side and fused into pipelined dispatches so batch N+1's
+    # encode+transfer overlaps batch N's kernel and N-1's verdict
+    # readback.  Off = the legacy per-role dispatch loop (bit-identical
+    # verdicts either way; the knob exists for fallback and A/B)
+    RESOLVER_DEVICE_PIPELINE: bool = True
+    # in-flight dispatch depth for the device pipeline (two-deep default:
+    # one group on the device, one group's verdicts reading back)
+    RESOLVER_PIPELINE_DEPTH: int = 2
 
     # --- commit pipeline ---
     COMMIT_BATCH_INTERVAL: float = 0.002      # proxy batching window seconds (REF: COMMIT_TRANSACTION_BATCH_INTERVAL_MIN)
@@ -100,6 +111,16 @@ class Knobs:
     # one event-loop turn is a ~100-500ms stall (SlowTask); the pull
     # loop yields between slices, never splitting a version
     STORAGE_APPLY_CHUNK_MUTATIONS: int = 32768
+
+    # --- device read serving (ISSUE 6) ---
+    # serve get_values' missing-key pass (the keys the MVCC window does
+    # not resolve) through a device-resident mirror of the engine's
+    # PackedKeyIndex: one vectorized searchsorted over keycode-u64
+    # prefixes per batch instead of a per-key host descent.  The mirror
+    # refreshes on index merges; a stale mirror or a batch below the
+    # threshold falls back to the engine path (identical results, tested)
+    STORAGE_DEVICE_READ_SERVE: bool = True
+    STORAGE_DEVICE_READ_MIN_BATCH: int = 64
 
     # --- client read path ---
     # same-tick point-read coalescing: concurrent Transaction.get calls

@@ -46,7 +46,12 @@ class StageStats:
         n = self._count.get(stage, 0)
         self._count[stage] = n + 1
         self._sum[stage] = self._sum.get(stage, 0.0) + seconds
-        if seconds > self._max.get(stage, 0.0):
+        # seed-or-raise, never strict-compare against a 0.0 default: a
+        # virtual-time clock (SimEventLoop) measures synchronous work as
+        # EXACTLY 0.0 seconds, and `0.0 > 0.0` left the stage out of
+        # _max while _samples had it — summary() then KeyErrored
+        m = self._max.get(stage)
+        if m is None or seconds > m:
             self._max[stage] = seconds
         # ring overwrite, not first-N: percentiles must track the
         # TRAILING cap samples on a long-lived role, or a regression

@@ -53,7 +53,7 @@ _SMALL_DISCARD = 32     # below this, per-key del beats a full filter pass
 
 
 class PackedKeyIndex:
-    __slots__ = ("_base", "_pending", "_pfx", "merges", "merge_s")
+    __slots__ = ("_base", "_pending", "_pfx", "merges", "merge_s", "gen")
 
     def __init__(self) -> None:
         self._base: list[bytes] = []
@@ -61,6 +61,11 @@ class PackedKeyIndex:
         self._pfx: np.ndarray | None = None  # lazy uint64 prefixes of _base
         self.merges = 0                      # observability: merge count
         self.merge_s = 0.0                   # ...and total merge seconds
+        # base-run generation: bumped whenever _base mutates (merge,
+        # discard).  Device mirrors (device/read_serve.py) stamp their
+        # uploaded copy with this and refresh on mismatch; the pending
+        # overlay is probed host-side, so inserts alone never stale them
+        self.gen = 0
 
     def __len__(self) -> int:
         return len(self._base) + len(self._pending)
@@ -116,6 +121,7 @@ class PackedKeyIndex:
         self._pending = []
         self._pfx = None
         self.merges += 1
+        self.gen += 1
         self.merge_s += time.perf_counter() - t0
 
     # --- removals ---
@@ -143,11 +149,13 @@ class PackedKeyIndex:
                     hit = True
             if hit:
                 self._pfx = None
+                self.gen += 1
         else:
             nb = len(base)
             self._base = [k for k in base if k not in dead]
             if len(self._base) != nb:
                 self._pfx = None
+                self.gen += 1
 
     # --- bound queries ---
     #
@@ -227,6 +235,21 @@ class PackedKeyIndex:
                 yield b[j]
                 j += 1
         yield from a[i:] if i < na else b[j:]
+
+    # --- device-mirror accessors (device/read_serve.py) ---
+
+    def base_run(self) -> list[bytes]:
+        """The sorted base run itself (NOT a copy — read-only callers)."""
+        return self._base
+
+    def pending_run(self) -> list[bytes]:
+        """The sorted pending overlay (NOT a copy — read-only callers)."""
+        return self._pending
+
+    def base_prefixes(self) -> np.ndarray:
+        """The base run's keycode-u64 prefixes (the cached array the
+        numpy bound path uses — one home for the encoding)."""
+        return self._prefixes()
 
     # --- observability ---
 

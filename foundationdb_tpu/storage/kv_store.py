@@ -130,6 +130,12 @@ class MemoryKVStore:
 
     # --- reads ---
 
+    @property
+    def packed_index(self):
+        """The engine's PackedKeyIndex — the capability probe the device
+        read path keys on (device/read_serve.py)."""
+        return self._index
+
     def get(self, key: bytes) -> bytes | None:
         return self._data.get(key)
 

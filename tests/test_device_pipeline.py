@@ -1,0 +1,506 @@
+"""Device commit pipeline + device read serving (ISSUE 6).
+
+Pipeline semantics against a scripted fake backend (enqueue order,
+fusion, barriers, poison/drain/close), verdict parity of the CPU twin
+vs the jax backend under the SAME pipeline grouping (too-old floors
+included), the resolver integration (knob on/off equivalence, barrier
+state batches, teardown), and the storage-side device gather path
+(engine-path equivalence, staleness/threshold fallbacks, the
+PackedKeyIndex generation contract the mirror keys on).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.device.pipeline import DevicePipeline, supports_pipeline
+from foundationdb_tpu.device.read_serve import DeviceReadServer
+from foundationdb_tpu.ops.batch import TxnRequest
+from foundationdb_tpu.runtime.errors import ResolverFailed
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.storage.key_index import PackedKeyIndex
+from foundationdb_tpu.storage.kv_store import OP_SET, MemoryKVStore
+
+
+# --------------------------------------------------------------------------
+# pipeline semantics over a scripted backend
+
+
+class FakeBackend:
+    """Minimal encoded-backend twin: records every group dispatch and
+    floor update; verdicts are (version, index-in-batch) echoes so
+    reorderings are detectable in the output."""
+
+    def __init__(self, fail_on_dispatch: int | None = None,
+                 fail_sync_on_dispatch: int | None = None) -> None:
+        self.groups: list[tuple[list[int], int]] = []  # (versions, floor)
+        self.floor = 0
+        self._dispatches = 0
+        self._fail_on = fail_on_dispatch
+        self._fail_sync_on = fail_sync_on_dispatch
+
+    def set_oldest_version(self, v: int) -> None:
+        self.floor = max(self.floor, v)
+
+    def resolve_group_begin(self, batches, versions):
+        self._dispatches += 1
+        n = self._dispatches
+        if self._fail_on is not None and n == self._fail_on:
+            raise RuntimeError("scripted dispatch failure")
+        self.groups.append((list(versions), self.floor))
+
+        async def finish():
+            await asyncio.sleep(0)
+            if self._fail_sync_on is not None and n == self._fail_sync_on:
+                raise RuntimeError("scripted sync failure")
+            return [[(v, i) for i in range(len(txns))]
+                    for txns, v in zip(batches, versions)]
+
+        return finish()
+
+
+def _txns(n: int) -> list[TxnRequest]:
+    return [TxnRequest([(b"a", b"b")], [(b"a", b"b")], 0)] * n
+
+
+def _knobs(**over) -> Knobs:
+    return Knobs().override(**over)
+
+
+def test_supports_pipeline_probe():
+    assert supports_pipeline(FakeBackend())
+    assert not supports_pipeline(object())
+
+
+def test_pipeline_preserves_enqueue_order_and_fuses():
+    async def main():
+        be = FakeBackend()
+        pipe = DevicePipeline(be, _knobs(RESOLVER_GROUP_MAX=4))
+        futs = [pipe.submit(_txns(2), 100 + i) for i in range(10)]
+        rows = [await f for f in futs]
+        await pipe.close()
+        # verdicts come back per batch, in enqueue order, undisturbed by
+        # the group boundaries
+        assert rows == [[(100 + i, 0), (100 + i, 1)] for i in range(10)]
+        # every batch was submitted upfront, so fusion packed
+        # group_max-sized groups in version order
+        assert [vs for vs, _ in be.groups] == [
+            [100, 101, 102, 103], [104, 105, 106, 107], [108, 109]]
+        m = pipe.metrics()
+        assert m["device_enqueued"] == 10
+        assert m["device_dispatches"] == 3
+        assert m["device_batches_dispatched"] == 10
+        assert m["device_readbacks"] == 3
+        assert m["device_group_mean"] == pytest.approx(10 / 3, abs=0.01)
+        assert m["device_queue_depth"] == 0 and m["device_inflight"] == 0
+    asyncio.run(main())
+
+
+def test_pipeline_slides_oldest_version_with_one_group_lag():
+    async def main():
+        window = 50
+        be = FakeBackend()
+        pipe = DevicePipeline(
+            be, _knobs(RESOLVER_GROUP_MAX=2,
+                       MAX_WRITE_TRANSACTION_LIFE_VERSIONS=window))
+        for v in (100, 110, 120, 130):
+            pipe.submit(_txns(1), v)
+        await pipe.drain()
+        await pipe.close()
+        # group [100,110] dispatches at the epoch floor (no lag source),
+        # group [120,130] at 110-50: the PREVIOUS group's last version
+        assert [f for _, f in be.groups] == [0, 110 - window]
+    asyncio.run(main())
+
+
+def test_pipeline_barrier_ends_group():
+    async def main():
+        be = FakeBackend()
+        pipe = DevicePipeline(be, _knobs(RESOLVER_GROUP_MAX=8))
+        pipe.submit(_txns(1), 100)
+        pipe.submit(_txns(1), 110, barrier=True)   # a state-txn batch
+        pipe.submit(_txns(1), 120)
+        await pipe.drain()
+        await pipe.close()
+        assert [vs for vs, _ in be.groups] == [[100, 110], [120]]
+    asyncio.run(main())
+
+
+def test_pipeline_poison_on_dispatch_failure():
+    async def main():
+        poisons = []
+        be = FakeBackend(fail_on_dispatch=1)
+        pipe = DevicePipeline(be, _knobs(RESOLVER_GROUP_MAX=2),
+                              on_poison=poisons.append)
+        futs = [pipe.submit(_txns(1), 100 + i) for i in range(5)]
+        for f in futs:
+            with pytest.raises(ResolverFailed):
+                await f
+        assert len(poisons) == 1
+        assert pipe.poisoned is not None
+        # a submit after poison fails immediately instead of hanging
+        with pytest.raises(ResolverFailed):
+            await pipe.submit(_txns(1), 200)
+        assert pipe.metrics()["device_poisoned"] == 1
+        await pipe.close()
+    asyncio.run(main())
+
+
+def test_pipeline_poison_on_sync_failure():
+    async def main():
+        be = FakeBackend(fail_sync_on_dispatch=1)
+        pipe = DevicePipeline(be, _knobs(RESOLVER_GROUP_MAX=2))
+        futs = [pipe.submit(_txns(1), 100 + i) for i in range(3)]
+        outcomes = []
+        for f in futs:
+            try:
+                outcomes.append(await f)
+            except ResolverFailed:
+                outcomes.append("failed")
+        # the failed dispatch's two batches fail and the pipeline
+        # poisons; the third batch's dispatch was already in flight
+        # AHEAD of the failure (depth 2) and still delivers — exactly
+        # the legacy fused path's discipline.  Nothing submitted AFTER
+        # the poison resolves.
+        assert outcomes == ["failed", "failed", [(102, 0)]]
+        assert pipe.poisoned is not None
+        with pytest.raises(ResolverFailed):
+            await pipe.submit(_txns(1), 200)
+        await pipe.close()
+    asyncio.run(main())
+
+
+def test_pipeline_pump_survives_poison_while_parked_at_depth_gate():
+    """A readback failing while the pump is parked at the depth gate
+    poisons the pipeline and DRAINS the queue; the resumed pump must
+    exit cleanly instead of assembling an empty group and dying on
+    group[-1] (regression: unhandled IndexError killed the pump task)."""
+    async def main():
+        be = FakeBackend(fail_sync_on_dispatch=1)
+        pipe = DevicePipeline(be, _knobs(RESOLVER_GROUP_MAX=1,
+                                         RESOLVER_PIPELINE_DEPTH=2))
+        futs = [pipe.submit(_txns(1), 100 + i) for i in range(6)]
+        for f in futs:
+            try:
+                await f
+            except ResolverFailed:
+                pass
+        await pipe.drain()
+        assert pipe._pump_task.done()
+        assert pipe._pump_task.exception() is None   # clean exit, no crash
+        await pipe.close()
+    asyncio.run(main())
+
+
+def test_pipeline_close_discard_fails_queued():
+    async def main():
+        be = FakeBackend()
+        pipe = DevicePipeline(be, _knobs())
+        fut = pipe.submit(_txns(1), 100)
+        await pipe.close(discard=True)
+        with pytest.raises(ResolverFailed):
+            await fut
+        with pytest.raises(ResolverFailed):
+            await pipe.submit(_txns(1), 110)
+    asyncio.run(main())
+
+
+def test_pipeline_reset_stats_keeps_queue_state():
+    async def main():
+        be = FakeBackend()
+        pipe = DevicePipeline(be, _knobs())
+        await pipe.submit(_txns(1), 100)
+        assert pipe.metrics()["device_dispatches"] == 1
+        pipe.reset_stats()
+        m = pipe.metrics()
+        assert m["device_dispatches"] == 0 and m["device_enqueued"] == 0
+        await pipe.resolve(_txns(1), 110)
+        assert pipe.metrics()["device_dispatches"] == 1
+        await pipe.close()
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------
+# verdict parity: CPU twin vs jax backend under the same pipeline
+
+
+def test_pipeline_parity_numpy_vs_jax_with_evictions():
+    """Both encoded backends through DevicePipeline with deterministic
+    grouping over a workload whose ring evicts and whose snapshots cross
+    the too-old floor: verdicts must be bit-identical (the ISSUE 6
+    invariant; the perf_smoke resolve stage runs the bigger version)."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0] + "/tools")
+    import perf_smoke
+
+    knobs = Knobs().override(
+        RESOLVER_BATCH_TXNS=8, RESOLVER_RANGES_PER_TXN=2,
+        CONFLICT_RING_CAPACITY=256, KEY_ENCODE_BYTES=16,
+        CONFLICT_WINDOW_SLOTS=32,
+        MAX_WRITE_TRANSACTION_LIFE_VERSIONS=300)
+    batches, versions = perf_smoke._resolve_workload(24, 8, 2, 77)
+
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+
+    async def run(kind: str) -> list:
+        be = make_conflict_backend(
+            knobs.override(RESOLVER_CONFLICT_BACKEND=kind))
+        pipe = DevicePipeline(be, knobs)
+        futs = [pipe.submit(t, v) for t, v in zip(batches, versions)]
+        rows = [await f for f in futs]
+        await pipe.close()
+        return [x for r in rows for x in r]
+
+    twin = asyncio.run(run("numpy"))
+    dev = asyncio.run(run("tpu"))
+    assert twin == dev
+    from foundationdb_tpu.ops.batch import TOO_OLD
+    assert any(x == TOO_OLD for x in twin), \
+        "workload failed to exercise the too-old boundary"
+
+
+# --------------------------------------------------------------------------
+# resolver integration
+
+
+def _resolve_requests(n_batches: int, seed: int):
+    import sys
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0] + "/tools")
+    import perf_smoke
+
+    from foundationdb_tpu.core.resolver import ResolveBatchRequest
+
+    batches, versions = perf_smoke._resolve_workload(n_batches, 6, 2, seed)
+    reqs = []
+    prev = 0
+    for txns, v in zip(batches, versions):
+        reqs.append(ResolveBatchRequest(prev, v, txns))
+        prev = v
+    return reqs
+
+
+def test_resolver_pipeline_knob_equivalence():
+    """The SAME request stream through a pipeline-on and a pipeline-off
+    resolver yields identical verdicts (numpy backend; serial awaited
+    submission so both paths see one batch per dispatch)."""
+    from foundationdb_tpu.core.resolver import Resolver
+
+    reqs = _resolve_requests(20, 99)
+
+    def run(pipeline_on: bool) -> list:
+        knobs = Knobs().override(
+            RESOLVER_BATCH_TXNS=6, RESOLVER_RANGES_PER_TXN=2,
+            CONFLICT_RING_CAPACITY=256, KEY_ENCODE_BYTES=16,
+            MAX_WRITE_TRANSACTION_LIFE_VERSIONS=300,
+            RESOLVER_DEVICE_PIPELINE=pipeline_on)
+
+        async def main():
+            r = Resolver(knobs)
+            assert (r._pipeline is not None) == pipeline_on
+            out = []
+            for req in reqs:
+                reply = await r.resolve(req)
+                out.extend(reply.verdicts)
+            await r.stop()
+            return out
+        return asyncio.run(main())
+
+    assert run(True) == run(False)
+
+
+def test_resolver_stop_discards_pipeline():
+    from foundationdb_tpu.core.resolver import Resolver
+
+    reqs = _resolve_requests(4, 5)
+    knobs = Knobs().override(
+        RESOLVER_BATCH_TXNS=6, RESOLVER_RANGES_PER_TXN=2,
+        CONFLICT_RING_CAPACITY=256, KEY_ENCODE_BYTES=16)
+
+    async def main():
+        r = Resolver(knobs)
+        assert r._pipeline is not None
+        fut = r._pipeline.submit([t for t in reqs[0].txns], reqs[0].version)
+        await r.stop()
+        with pytest.raises(ResolverFailed):
+            await fut
+        # metrics still answer after teardown (status probes survive)
+        m = await r.metrics()
+        assert m["device_poisoned"] == 1
+    asyncio.run(main())
+
+
+def test_legacy_dispatch_loop_survives_poison_while_parked_at_gate():
+    """The knob-OFF twin of the pump depth-gate regression: a group sync
+    failing while the legacy _dispatch_loop is parked at the in-flight
+    gate poisons the resolver and drains _pending; the resumed loop must
+    exit cleanly instead of assembling an empty group and dying on
+    group[-1] (IndexError)."""
+    from foundationdb_tpu.core.resolver import Resolver
+
+    reqs = _resolve_requests(4, 42)
+    knobs = Knobs().override(
+        RESOLVER_BATCH_TXNS=6, RESOLVER_RANGES_PER_TXN=2,
+        CONFLICT_RING_CAPACITY=256, KEY_ENCODE_BYTES=16,
+        RESOLVER_DEVICE_PIPELINE=False,
+        RESOLVER_GROUP_MAX=1, RESOLVER_MAX_INFLIGHT_GROUPS=1)
+
+    async def main():
+        r = Resolver(knobs)
+        assert r._pipeline is None and r._fuse
+        r.backend = FakeBackend(fail_sync_on_dispatch=1)
+        outs = await asyncio.gather(*(r.resolve(req) for req in reqs),
+                                    return_exceptions=True)
+        assert all(isinstance(o, ResolverFailed) for o in outs)
+        for _ in range(5):      # let the parked loop resume and exit
+            await asyncio.sleep(0)
+        assert r._dispatch_task.done()
+        assert r._dispatch_task.exception() is None
+    asyncio.run(main())
+
+
+def test_resolver_metrics_carry_pipeline_counters():
+    from foundationdb_tpu.core.resolver import Resolver
+
+    knobs = Knobs().override(RESOLVER_BATCH_TXNS=6,
+                             CONFLICT_RING_CAPACITY=256,
+                             KEY_ENCODE_BYTES=16)
+
+    async def main():
+        r = Resolver(knobs)
+        for req in _resolve_requests(3, 11):
+            await r.resolve(req)
+        m = await r.metrics()
+        assert m["device_pipeline"] == 1
+        assert m["device_enqueued"] == 3
+        assert m["device_dispatches"] >= 1
+        assert m["total_batches"] == 3
+        await r.stop()
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------
+# PackedKeyIndex generation contract (what the device mirror keys on)
+
+
+def test_key_index_gen_tracks_base_mutations_only():
+    idx = PackedKeyIndex()
+    g0 = idx.gen
+    idx.add_many([b"k%03d" % i for i in range(10)])
+    # inserts live in the pending overlay until a merge: the mirror
+    # probes the overlay host-side, so gen must NOT move yet
+    pend = len(idx.pending_run())
+    if pend:                      # small adds stay pending
+        assert idx.gen == g0
+    idx._merge()
+    assert idx.gen > g0
+    g1 = idx.gen
+    assert idx.base_run() == sorted(b"k%03d" % i for i in range(10))
+    assert idx.pending_run() == []
+    assert len(idx.base_prefixes()) == 10
+    idx.discard_many([b"k003"])
+    assert idx.gen > g1
+
+
+# --------------------------------------------------------------------------
+# device read serving
+
+
+def _engine_with(n: int) -> MemoryKVStore:
+    kv = MemoryKVStore(None, "t")
+    kv._apply([(OP_SET, b"dk%05d" % i, b"v%05d" % i) for i in range(n)])
+    return kv
+
+
+def test_device_read_server_matches_engine_path():
+    kv = _engine_with(500)
+    kv.packed_index._merge()
+    knobs = Knobs().override(STORAGE_DEVICE_READ_MIN_BATCH=4)
+    srv = DeviceReadServer(kv, knobs)
+    assert srv.active
+    # the mirror cold-starts stale: the FIRST batch is served by the
+    # engine path (None = caller falls through) and primes the upload
+    assert srv.get_batch([b"dk00000"] * 8) is None
+    # mix of present keys, missing keys, and keys beyond both ends
+    keys = sorted({b"dk%05d" % (i * 37 % 700) for i in range(64)}
+                  | {b"aaaa", b"zzzz"})
+    got = srv.get_batch(keys)
+    assert got is not None
+    assert got == kv.get_batch(keys)
+    m = srv.metrics()
+    assert m["device_read_batches"] == 1
+    assert m["device_read_keys"] == len(keys)
+    assert m["device_read_fallbacks"] == 1
+    assert m["device_read_uploads"] == 1
+
+
+def test_device_read_server_probes_pending_overlay():
+    """Keys inserted since the last merge live in the pending overlay;
+    the mirror stays fresh (gen unmoved) and the overlay is probed
+    host-side — results still identical to the engine."""
+    kv = _engine_with(200)
+    kv.packed_index._merge()
+    knobs = Knobs().override(STORAGE_DEVICE_READ_MIN_BATCH=4)
+    srv = DeviceReadServer(kv, knobs)
+    srv.get_batch([b"dk%05d" % i for i in range(8)])    # builds the mirror
+    gen = kv.packed_index.gen
+    kv._apply([(OP_SET, b"zz-new%02d" % i, b"nv") for i in range(4)])
+    if kv.packed_index.gen != gen:
+        pytest.skip("small add unexpectedly merged — overlay not testable")
+    keys = [b"zz-new00", b"zz-new03", b"dk00001", b"zz-none"]
+    got = srv.get_batch(sorted(keys))
+    assert got == kv.get_batch(sorted(keys))
+
+
+def test_device_read_server_stale_mirror_falls_back_then_refreshes():
+    kv = _engine_with(300)
+    kv.packed_index._merge()
+    knobs = Knobs().override(STORAGE_DEVICE_READ_MIN_BATCH=4)
+    srv = DeviceReadServer(kv, knobs)
+    keys = [b"dk%05d" % i for i in range(16)]
+    assert srv.get_batch(keys) is None          # cold start primes mirror
+    assert srv.get_batch(keys) is not None
+    uploads = srv._dir.uploads
+    # a merge bumps gen: the NEXT batch takes the engine path (correct
+    # results either way) and triggers a re-upload for the one after
+    kv._apply([(OP_SET, b"dk%05d" % (1000 + i), b"nv") for i in range(600)])
+    kv.packed_index._merge()
+    assert srv.get_batch(keys) is None          # stale: engine serves
+    assert srv._dir.uploads == uploads + 1      # ...and refresh happened
+    got = srv.get_batch(keys)                   # fresh again: device serves
+    assert got == kv.get_batch(keys)
+    assert srv.metrics()["device_read_fallbacks"] == 2  # cold start + stale
+
+
+def test_device_read_server_threshold_and_knob_gates():
+    kv = _engine_with(100)
+    knobs = Knobs().override(STORAGE_DEVICE_READ_MIN_BATCH=32)
+    srv = DeviceReadServer(kv, knobs)
+    assert srv.active
+    assert srv.get_batch([b"dk00001"] * 8) is None      # below threshold
+    assert srv.metrics()["device_read_fallbacks"] == 1
+    off = DeviceReadServer(kv, Knobs().override(
+        STORAGE_DEVICE_READ_SERVE=False))
+    assert not off.active
+    assert off.get_batch([b"dk%05d" % i for i in range(64)]) is None
+
+
+def test_storage_server_wires_device_reads():
+    """The capability probe: an engine-backed storage server arms the
+    device read path (jax+x64 are on under conftest) and surfaces its
+    counters through metrics(); engineless servers stay inactive."""
+    from foundationdb_tpu.core.data import KeyRange
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+
+    async def main():
+        knobs = Knobs()
+        ss = StorageServer(knobs, 0, KeyRange(b"", b"\xff"), TLog(knobs),
+                           engine=_engine_with(50))
+        assert ss._device_reads is not None
+        assert (await ss.metrics())["device_read_active"] == 1
+        bare = StorageServer(knobs, 1, KeyRange(b"", b"\xff"), TLog(knobs))
+        assert bare._device_reads is None
+        assert "device_read_active" not in await bare.metrics()
+    asyncio.run(main())

@@ -49,6 +49,18 @@ def test_read_path_throughput_smoke():
     perf_smoke.check_read(budget_s=perf_smoke.READ_BUDGET_S)
 
 
+def test_resolve_pipeline_smoke():
+    """The device commit pipeline (ISSUE 6): the same randomized batches
+    — with snapshots crossing the too-old floor and a ring small enough
+    to evict mid-run — through the conflict_np CPU twin and the jax
+    backend, both under DevicePipeline with identical deterministic
+    grouping, verdicts asserted bit-identical in situ; then the in-run
+    A/B where pipelined dispatch must beat the unpipelined per-batch
+    sync loop by >= 2x (measured ~6x on a loaded 2-cpu host).  The
+    budget doubles as a hard wedge deadline."""
+    perf_smoke.check_resolve(budget_s=perf_smoke.RESOLVE_BUDGET_S)
+
+
 def test_apply_metrics_surface():
     """The apply path must publish its observability counters — a silent
     regression is the other half of the r5 incident."""

@@ -213,3 +213,11 @@ def test_sim_trace_reconstructs_cross_role_timeline(tmp_path):
     tracing = doc["cluster"]["tracing"]
     assert tracing["spans_emitted"] > 0
     assert tracing["sampled_txns"] >= 4
+    # device-commit-pipeline rollup (ISSUE 6): the resolvers ran the
+    # pipeline path and their queue/dispatch counters reached status
+    rd = doc["cluster"]["resolver_device"]
+    assert rd["pipelined_resolvers"] >= 1
+    assert rd["dispatches"] >= 1
+    assert rd["enqueued"] >= rd["dispatches"]
+    assert rd["poisoned"] == 0
+    assert "device_reads" in doc["cluster"]

@@ -33,7 +33,18 @@ wall-clock floor.  An O(n)-per-key slip anywhere on the read path —
 client coalescing, wire packing, the batched vmap/engine probes —
 fails here at tier-1 cost, not at r-bench.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|all]
+Stage 5 (``resolve``): the device commit pipeline (ISSUE 6) — the SAME
+randomized batches (including snapshots stale enough to cross the
+too-old floor and a ring small enough to evict mid-run) through the
+deterministic CPU twin (``conflict_np``) and the jax backend, BOTH
+driven by ``device/pipeline.py``'s DevicePipeline under identical
+grouping, with verdicts asserted bit-identical in situ; then an in-run
+A/B — pipelined dispatch vs the unpipelined per-batch sync loop — that
+must hold a >= 2x throughput edge.  A dispatch-path regression (lost
+fusion, a sync sneaking onto the submit path, a parity break at an
+eviction edge) fails here at tier-1 cost, not at r-bench.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -61,6 +72,10 @@ READ_BATCH = 64             # multiget batch size (acceptance: >= 32)
 READ_READERS = 8
 READ_BUDGET_S = 60.0        # measured ~2s on a loaded 2-cpu host
 READ_SPEEDUP_FLOOR = 3.0    # multiget keys/s vs scalar get()/s
+RESOLVE_BATCHES = 96
+RESOLVE_TXNS = 16           # per batch (RESOLVER_BATCH_TXNS for the run)
+RESOLVE_BUDGET_S = 150.0    # measured ~12s incl. jax compiles (2-cpu host)
+RESOLVE_AB_FLOOR = 2.0      # pipelined vs unpipelined txns/s
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -458,17 +473,195 @@ def check_read(budget_s: float = READ_BUDGET_S, quiet: bool = False
     return elapsed
 
 
+def _resolve_workload(n_batches: int, batch_txns: int, ranges: int,
+                      seed: int) -> tuple[list, list[int]]:
+    """Randomized conflict batches exercising every verdict class: hot
+    overlapping point ranges (CONFLICT), fresh keys (COMMITTED), and
+    snapshots stale enough to cross the too-old floor — both the
+    MAX_WRITE_TRANSACTION_LIFE window floor the pipeline slides between
+    dispatches and the ring-EVICTION floor (the capacity below forces
+    evictions mid-run, the resolve_many per-batch eviction-edge path)."""
+    import random
+
+    from foundationdb_tpu.ops.batch import TxnRequest
+
+    rng = random.Random(seed)
+    batches, versions = [], []
+    v = 1_000
+    for _ in range(n_batches):
+        v += rng.randint(1, 30)
+        txns = []
+        for _ in range(batch_txns):
+            def rg():
+                k = b"rk%06d" % rng.randint(0, 400)
+                return (k, k + b"\x00")
+            snap = v - rng.choice([1, 2, 5, 50, 200, 500, 1500])
+            txns.append(TxnRequest(
+                [rg() for _ in range(rng.randint(1, ranges))],
+                [rg() for _ in range(rng.randint(1, ranges))], snap))
+        batches.append(txns)
+        versions.append(v)
+    return batches, versions
+
+
+def resolve_pipeline_seconds(n_batches: int = RESOLVE_BATCHES,
+                             batch_txns: int = RESOLVE_TXNS,
+                             deadline_s: float | None = None
+                             ) -> tuple[float, dict]:
+    """The device-commit-pipeline smoke (ISSUE 6).  Same randomized
+    batches three ways:
+
+    - CPU twin (``numpy`` backend) through DevicePipeline — the
+      deterministic parity reference;
+    - jax backend through DevicePipeline (the device path; host CPU
+      here, a TPU chip in production) — verdicts must be BIT-IDENTICAL
+      to the twin, too-old floors included;
+    - jax backend through the unpipelined per-batch sync loop — the
+      in-run A/B baseline the pipelined path must beat by >= 2x.
+
+    Grouping is deterministic by construction: every batch is submitted
+    before the pump task first runs, so groups are group_max-sized
+    chunks in version order and both backends see the identical floor
+    schedule (which is what makes bit-parity assertable at TOO_OLD
+    boundaries).  Returns (elapsed, stats)."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from foundationdb_tpu.device.pipeline import DevicePipeline
+    from foundationdb_tpu.ops.backends import (make_conflict_backend,
+                                               resolve_begin)
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    # a ring of 2048 slots at 16 txns x 2 ranges evicts well inside the
+    # run; the 400-version life window plus the stale snapshots above
+    # force TOO_OLD verdicts through BOTH floor mechanisms
+    knobs = Knobs().override(
+        RESOLVER_BATCH_TXNS=batch_txns, RESOLVER_RANGES_PER_TXN=2,
+        CONFLICT_RING_CAPACITY=2048, KEY_ENCODE_BYTES=16,
+        CONFLICT_WINDOW_SLOTS=64,
+        MAX_WRITE_TRANSACTION_LIFE_VERSIONS=400)
+    batches, versions = _resolve_workload(n_batches, batch_txns, 2, 1234)
+    n_txns = sum(len(b) for b in batches)
+
+    async def run_pipe(kind: str) -> tuple[list, float, dict]:
+        be = make_conflict_backend(
+            knobs.override(RESOLVER_CONFLICT_BACKEND=kind))
+        pipe = DevicePipeline(be, knobs)
+        t0 = time.perf_counter()
+        futs = [pipe.submit(t, v) for t, v in zip(batches, versions)]
+        rows = [await f for f in futs]
+        elapsed = time.perf_counter() - t0
+        await pipe.close()
+        return rows, elapsed, pipe.metrics()
+
+    async def run_serial(kind: str) -> tuple[list, float]:
+        """The unpipelined baseline: one dispatch per batch, verdicts
+        synced before the next submit, the serial path's one-batch-lag
+        floor schedule."""
+        be = make_conflict_backend(
+            knobs.override(RESOLVER_CONFLICT_BACKEND=kind))
+        window = knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+        t0 = time.perf_counter()
+        rows = []
+        last = 0
+        for t, v in zip(batches, versions):
+            floor = last - window
+            if floor > 0:
+                be.set_oldest_version(floor)
+            last = v
+            rows.append(await resolve_begin(be, t, v))
+        return rows, time.perf_counter() - t0
+
+    def flat(rows: list) -> list[int]:
+        return [x for r in rows for x in r]
+
+    async def main() -> tuple[float, dict]:
+        t_all = time.perf_counter()
+        twin_rows, _, _ = await run_pipe("numpy")
+        # warm the jax jit cache (group buckets + K=1) so the measured
+        # passes see steady-state dispatch, not compiles
+        await run_pipe("tpu")
+        await run_serial("tpu")
+        dev_rows, dev_s, metrics = await run_pipe("tpu")
+        ser_rows, ser_s = await run_serial("tpu")
+        twin, dev = flat(twin_rows), flat(dev_rows)
+        assert twin == dev, (
+            "device-pipeline verdicts diverged from the conflict_np CPU "
+            "twin on %d of %d txns — abort-rate divergence is a "
+            "correctness bug, not noise" % (
+                sum(1 for a, b in zip(twin, dev) if a != b), len(twin)))
+        from foundationdb_tpu.ops.batch import TOO_OLD
+        stats = {
+            "n_batches": n_batches,
+            "n_txns": n_txns,
+            "pipelined_txns_per_sec": n_txns / dev_s if dev_s else 0.0,
+            "unpipelined_txns_per_sec": n_txns / ser_s if ser_s else 0.0,
+            "speedup": ser_s / dev_s if dev_s else 0.0,
+            "too_old_verdicts": sum(1 for x in dev if x == TOO_OLD),
+            "serial_matches_pipeline": flat(ser_rows) == dev,
+            "dispatches": metrics["device_dispatches"],
+            "group_mean": metrics["device_group_mean"],
+            "dispatch_us_per_batch": metrics["device_dispatch_us_per_batch"],
+            "overlap_ratio": metrics["device_overlap_ratio"],
+        }
+        return time.perf_counter() - t_all, stats
+
+    async def bounded():
+        return await asyncio.wait_for(main(), deadline_s)
+
+    try:
+        return asyncio.run(bounded())
+    except asyncio.TimeoutError:
+        raise AssertionError(
+            f"resolve smoke wedged: the {deadline_s:.0f}s deadline hit — "
+            f"a stuck pump task, a lost readback, or a dispatch that "
+            f"never completed, not just slowness") from None
+
+
+def check_resolve(budget_s: float = RESOLVE_BUDGET_S,
+                  quiet: bool = False) -> float:
+    """Run the device-pipeline smoke; raises AssertionError on verdict
+    divergence from the CPU twin, below the pipelined-vs-unpipelined
+    A/B floor, past the budget, or if the randomized workload failed to
+    exercise the too-old boundary at all."""
+    elapsed, stats = resolve_pipeline_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] resolve: {stats['n_txns']} txns pipelined at "
+              f"{stats['pipelined_txns_per_sec']:.0f} txns/s vs "
+              f"{stats['unpipelined_txns_per_sec']:.0f} unpipelined "
+              f"({stats['speedup']:.1f}x), {stats['dispatches']} dispatches "
+              f"(group mean {stats['group_mean']}, "
+              f"{stats['dispatch_us_per_batch']:.0f}us/batch), "
+              f"{stats['too_old_verdicts']} TOO_OLD verdicts")
+    assert stats["too_old_verdicts"] > 0, (
+        "the randomized workload produced no TOO_OLD verdicts — the "
+        "ring-eviction/life-window boundary went unexercised, so the "
+        "parity assertion above proved less than it claims")
+    assert elapsed < budget_s, (
+        f"resolve smoke took {elapsed:.1f}s (budget {budget_s:.0f}s) — "
+        f"encode, dispatch, or readback grew a per-batch stall")
+    assert stats["speedup"] >= RESOLVE_AB_FLOOR, (
+        f"device pipeline speedup {stats['speedup']:.2f}x under the "
+        f"{RESOLVE_AB_FLOOR:.0f}x floor vs the unpipelined per-batch "
+        f"sync loop — fusion or overlap regressed on the dispatch path")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
     ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
     ap.add_argument("--stage",
-                    choices=("apply", "pipeline", "feed", "read", "all"),
+                    choices=("apply", "pipeline", "feed", "read",
+                             "resolve", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
     ap.add_argument("--feed-budget", type=float, default=FEED_BUDGET_S)
     ap.add_argument("--read-budget", type=float, default=READ_BUDGET_S)
+    ap.add_argument("--resolve-budget", type=float,
+                    default=RESOLVE_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -478,6 +671,8 @@ def main() -> int:
         check_feed(budget_s=args.feed_budget)
     if args.stage in ("read", "all"):
         check_read(budget_s=args.read_budget)
+    if args.stage in ("resolve", "all"):
+        check_resolve(budget_s=args.resolve_budget)
     return 0
 
 
