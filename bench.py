@@ -845,6 +845,199 @@ def run_read_point_phase(quiet: bool) -> dict:
     return r
 
 
+def run_hot_shard_phase(quiet: bool) -> dict:
+    """Hot-shard stage (ISSUE 7): sustained zipf-0.99 write+read skew
+    against a LIVE cluster — the 6-machine simulated fleet running on
+    the real clock, with data distribution's heat policy and the
+    client read spread armed.  One shard absorbs the whole skew; the
+    heat tracker must drive a LIVE split under continuous traffic and
+    the ratekeeper's heat path must arm a tag throttle for the hot
+    tenant.  Emits client-boundary read p99 before vs after the split,
+    heat relocation counters, tag-throttle activations, and the
+    post-split abort-rate delta."""
+    import asyncio
+
+    from foundationdb_tpu.bench.workload import ZipfianGenerator
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.core.status import cluster_status
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    # 6 writers / 12 readers: enough skew to trip the heat policy in
+    # seconds without saturating a 2-cpu host — at saturation every
+    # window's p99 is event-loop stall noise (±50% run-to-run, see
+    # BASELINE r08) and the split's effect drowns
+    n_keys, writers_n, readers_n = 20_000, 6, 12
+    window_s, split_wait_s = 12.0, 60.0
+    knobs = Knobs().override(
+        DD_ENABLED=True, DD_INTERVAL=0.5,
+        DD_SHARD_SPLIT_BYTES=1 << 30,           # size policy silent
+        # the heat policy starts DISARMED and is flipped on the LIVE
+        # distributor only after the pre-split window closes, so the
+        # "before" samples can never contain the split; the long
+        # cooldown keeps a SECOND relocation's fetchKeys churn out of
+        # the post-split window (the stage measures steady state after
+        # one split, not a handoff transient)
+        DD_SHARD_HEAT_SPLITS=False, DD_SHARD_HOT_RW_PER_SEC=100.0,
+        DD_HEAT_SUSTAIN_ROUNDS=2, DD_HEAT_COOLDOWN_S=60.0,
+        SHARD_HEAT_HALFLIFE=3.0,
+        CLIENT_READ_LOAD_BALANCE="rotate",
+        # heat-armed admission: the hot tag sheds (floor high enough
+        # that writers keep feeding the heat signal)
+        RATEKEEPER_HEAT_THROTTLE=True,
+        RATEKEEPER_HOT_SHARD_WRITES_PER_SEC=50.0,
+        RATEKEEPER_HEAT_WEDGE_S=5.0,
+        TARGET_STORAGE_QUEUE_BYTES=50_000,
+        RATEKEEPER_MIN_TPS=50.0)
+
+    zipf = ZipfianGenerator(n_keys, 0.99, 23)
+
+    def key(i: int) -> bytes:
+        return b"hot%06d" % (i % n_keys)
+
+    async def main() -> dict:
+        sim = SimulatedCluster(knobs, n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6,
+                                                      replication=2))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        n_shards0 = len(state1["shard_teams"])
+        db = await sim.database()
+        stop = asyncio.Event()
+        commits = [0, 0]        # [pre-split window, post-split window]
+        aborts = [0, 0]
+        lat: list[list[float]] = [[], []]
+        win = {"i": None}       # None = not measuring
+
+        async def writer(wid: int) -> None:
+            tr = db.create_transaction()
+            tr.throttle_tag = "hot"
+            while not stop.is_set():
+                for i in zipf.sample(4):
+                    tr.set(key(int(i)), b"v" * 256)
+                try:
+                    await tr.commit()
+                    if win["i"] is not None:
+                        commits[win["i"]] += 1
+                    tr.reset()
+                except Exception as e:   # noqa: BLE001 — count + retry
+                    if win["i"] is not None \
+                            and getattr(e, "code", None) == 1020:
+                        aborts[win["i"]] += 1    # not_committed
+                    try:
+                        await tr.on_error(e)
+                    except Exception:    # noqa: BLE001 — fresh txn
+                        tr = db.create_transaction()
+                        tr.throttle_tag = "hot"
+
+        async def reader(rid: int) -> None:
+            while not stop.is_set():
+                tr = db.create_transaction()
+                # batch lane: the readers are background-scan shaped, and
+                # keeping them off the default lane leaves the tagged
+                # writers as its dominant demand — what the heat throttle
+                # keys its tag attribution on
+                tr.priority = "batch"
+                t0 = time.perf_counter()
+                try:
+                    await tr.get(key(int(zipf.sample(1)[0])), snapshot=True)
+                    if win["i"] is not None:
+                        lat[win["i"]].append(time.perf_counter() - t0)
+                except Exception as e:   # noqa: BLE001 — follow the move
+                    try:
+                        await tr.on_error(e)
+                    except Exception:    # noqa: BLE001
+                        pass
+
+        tasks = [asyncio.ensure_future(writer(w)) for w in range(writers_n)]
+        tasks += [asyncio.ensure_future(reader(r)) for r in range(readers_n)]
+
+        await asyncio.sleep(3.0)                 # warmup + rate build-up
+        win["i"] = 0
+        await asyncio.sleep(window_s)            # pre-split window
+        win["i"] = None
+
+        # arm the heat policy on the live distributor AFTER the clean
+        # pre-split window (in-process access; a lost leadership before
+        # the arm surfaces as hot_shard_split_timeout)
+        arm_deadline = time.perf_counter() + 20.0
+        while time.perf_counter() < arm_deadline:
+            dd_live = sim.leader_dd()
+            if dd_live is not None:
+                dd_live.knobs = dd_live.knobs.override(
+                    DD_SHARD_HEAT_SPLITS=True)
+                break
+            await asyncio.sleep(0.25)
+
+        split_t0 = time.perf_counter()
+        split_timeout = False
+        try:
+            await asyncio.wait_for(
+                sim.wait_state(
+                    lambda s: len(s["shard_teams"]) > n_shards0),
+                timeout=split_wait_s)
+        except asyncio.TimeoutError:
+            split_timeout = True
+        split_wait = time.perf_counter() - split_t0
+
+        # post-flip settle: let the destination team's fetchKeys catch-up
+        # and the clients' shard-map refreshes drain before measuring
+        await asyncio.sleep(5.0)
+        win["i"] = 1
+        await asyncio.sleep(window_s)            # post-split window
+        win["i"] = None
+        stop.set()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+        ct = sim.client_transport()
+        doc = await cluster_status(sim.knobs, ct, sim.coordinator_stubs(ct))
+        dd = sim.leader_dd()
+        await sim.stop()
+
+        def pct(xs: list[float], p: float) -> float | None:
+            # np.percentile, same semantics as every other stage's
+            # latency fields in this artifact
+            return round(float(np.percentile(xs, p)) * 1e3, 2) \
+                if xs else None
+
+        def p99(xs: list[float]) -> float | None:
+            return pct(xs, 99.0)
+
+        def abort_rate(i: int) -> float | None:
+            n = commits[i] + aborts[i]
+            return round(aborts[i] / n, 4) if n else None
+
+        hm = doc["cluster"]["hot_moves"]
+        sh = doc["cluster"]["shard_heat"]
+        ab0, ab1 = abort_rate(0), abort_rate(1)
+        return {
+            "hot_shard_p99_ms_before_split": p99(lat[0]),
+            "hot_shard_p99_ms_after_split": p99(lat[1]),
+            "hot_shard_p50_ms_before_split": pct(lat[0], 50.0),
+            "hot_shard_p50_ms_after_split": pct(lat[1], 50.0),
+            "hot_shard_reads_before": len(lat[0]),
+            "hot_shard_reads_after": len(lat[1]),
+            "heat_splits_done": hm["heat_splits"] + hm["heat_moves"],
+            "heat_splits_published": hm,
+            "heat_splits_dd": (None if dd is None
+                               else dd.heat_splits_done + dd.heat_moves_done),
+            "tag_throttle_activations": sh["heat_throttle_activations"],
+            "hot_shard_abort_rate_before": ab0,
+            "hot_shard_abort_rate_after": ab1,
+            "hot_shard_abort_delta": (round(ab1 - ab0, 4)
+                                      if ab0 is not None and ab1 is not None
+                                      else None),
+            "hot_shard_split_wait_s": round(split_wait, 2),
+            "hot_shard_split_timeout": split_timeout,
+            "hot_shard_top": sh["top_shards"][:2],
+        }
+
+    r = asyncio.run(main())
+    if not quiet:
+        print(f"[bench] hot shard: {r}", file=sys.stderr)
+    return r
+
+
 def project_local_attach(out: dict, e2e: dict) -> dict:
     """Locally-attached projection (VERDICT r4 1c): what the tpu e2e
     number becomes with the tunnel RTT removed, computed from MEASURED
@@ -1089,6 +1282,15 @@ def main() -> int:
                 args.stage_timeout, out)
             if rp is not None:
                 out.update(rp)
+
+            # hot-shard economics (ISSUE 7): a live heat split under
+            # sustained zipf skew, with before/after read p99 and the
+            # admission-control counters
+            hs = call_bounded(
+                "hot_shard", lambda: run_hot_shard_phase(args.quiet),
+                args.stage_timeout, out)
+            if hs is not None:
+                out.update(hs)
 
             def abort_parity():
                 # the abort-parity gate (BASELINE.md config-2): encoded

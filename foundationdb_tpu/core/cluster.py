@@ -84,7 +84,7 @@ class Cluster:
                                    engine=engine)
                 self.storage_servers.append(ss)
                 team.append(ss)
-            self._replica_groups.append(ReplicaGroup(rng, team))
+            self._replica_groups.append(ReplicaGroup(rng, team, k))
 
         self.ratekeeper = Ratekeeper(k, self.storage_servers, self.tlogs)
         self.grv_proxies = [GrvProxy(k, self.sequencer, self.ratekeeper)

@@ -85,8 +85,8 @@ class RecoveredClusterView:
         self._groups = []
         for rng, tags in self.shard_map.ranges():
             replicas = [by_tag[tg] for tg in tags if tg in by_tag]
-            self._groups.append(ReplicaGroup(rng, replicas) if replicas
-                                else None)
+            self._groups.append(ReplicaGroup(rng, replicas, self.knobs)
+                                if replicas else None)
 
     # --- location lookup (getKeyLocation analog) ---
 

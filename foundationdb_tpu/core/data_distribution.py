@@ -93,6 +93,24 @@ class DataDistributor:
         # operator/workload-requested relocations (RandomMoveKeys): shard
         # indices to move onto fresh teams, drained one per round
         self._move_requests: list[int] = []
+        # heat-driven relocation state (ISSUE 7): consecutive-hot-round
+        # streaks per shard range (hysteresis), a post-relocation
+        # cooldown deadline, and the counters the dd_stats publish
+        # carries into status
+        self._heat_streak: dict[tuple[bytes, bytes], int] = {}
+        self._heat_cooldown_until = 0.0
+        self.heat_splits_done = 0
+        self.heat_moves_done = 0
+        self.last_heat_rw_per_sec = 0.0
+
+    def stats(self) -> dict:
+        """Relocation counters (published with every flip; see
+        cluster.hot_moves in status)."""
+        return {"splits": self.splits_done,
+                "live_moves": self.live_moves_done,
+                "heat_splits": self.heat_splits_done,
+                "heat_moves": self.heat_moves_done,
+                "last_heat_rw_per_sec": self.last_heat_rw_per_sec}
 
     def request_relocation(self, shard_idx: int) -> None:
         """Queue a manual live move of shard ``shard_idx`` onto a fresh
@@ -201,9 +219,147 @@ class DataDistributor:
                 rng.begin, rng.end)
             if not split_key:
                 continue
+            # the ranking snapshot can race a concurrent split (another
+            # DD incarnation, or a relocation that landed between the
+            # metrics read and here): RE-FETCH the winner's size before
+            # committing, so a just-split shard's stale logical_bytes
+            # cannot trigger an immediate re-split of the shrunk remnant
+            try:
+                m2 = await asyncio.wait_for(
+                    self._storage_stub(src).metrics(),
+                    timeout=self.knobs.FAILURE_TIMEOUT)
+            except Exception:   # noqa: BLE001 — replica died: next round
+                continue
+            if m2.get("logical_bytes", 0) < self.knobs.DD_SHARD_SPLIT_BYTES:
+                continue
             await self._relocate(state, layout, idx, next_tag,
                                  split_key=bytes(split_key), engine=desired)
             return                  # one relocation per round
+
+        # --- heat policy (ISSUE 7): split/move shards by LOAD, not just
+        # size.  Runs only when no size-driven relocation fired, behind
+        # its own knob so the deterministic same-seed sims replay the
+        # pre-heat behavior with the knob off. ---
+        if self.knobs.DD_SHARD_HEAT_SPLITS:
+            await self._heat_round(state, layout, shard_map, by_tag,
+                                   next_tag, desired)
+
+    # --- heat-driven relocation (ISSUE 7) ---
+
+    async def _shard_heat(self, team: list[int], by_tag: dict) -> dict | None:
+        """One shard's merged heat sample: reads SUM over the team (the
+        client spreads them), writes/write-bytes MAX (every replica
+        applies the full stream), reservoirs concatenated so the split
+        midpoint sees every replica's sampled keys."""
+        async def one(s: dict) -> dict | None:
+            try:
+                return await asyncio.wait_for(
+                    self._storage_stub(s).shard_metrics(),
+                    timeout=self.knobs.FAILURE_TIMEOUT)
+            except Exception:   # noqa: BLE001 — dead replica: skip
+                return None
+        samples = [m for m in await asyncio.gather(
+            *(one(by_tag[t]) for t in team if t in by_tag)) if m is not None]
+        if not samples:
+            return None
+        # aggregate duplicate keys across replica reservoirs by MEAN
+        # (every replica applies the full write stream, so a key both
+        # replicas sampled would otherwise count twice — which would
+        # both defeat weighted_split_key's single-key move-guard and
+        # skew the midpoint toward doubly-sampled keys)
+        merged: dict[bytes, list[float]] = {}
+        for m in samples:
+            for k, w in m.get("samples") or []:
+                merged.setdefault(bytes(k), []).append(float(w))
+        reads = sum(m["reads_per_sec"] for m in samples)
+        writes = max(m["writes_per_sec"] for m in samples)
+        return {"reads_per_sec": reads, "writes_per_sec": writes,
+                "rw_per_sec": reads + writes,
+                "samples": sorted((k, sum(ws) / len(ws))
+                                  for k, ws in merged.items())}
+
+    async def _heat_round(self, state: dict, layout: dict, shard_map,
+                          by_tag: dict, next_tag: int,
+                          engine: str | None) -> None:
+        """At most one heat-driven relocation per round: the hottest
+        shard sustaining DD_SHARD_HOT_RW_PER_SEC for
+        DD_HEAT_SUSTAIN_ROUNDS consecutive rounds (hysteresis) splits at
+        the reservoir's heat midpoint; when the heat straddles a single
+        key it falls back to the byte-midpoint sample and, failing that,
+        MOVES whole to a fresh team on other machines.  A cooldown after
+        every heat relocation keeps oscillating load from thrashing
+        fetchKeys."""
+        now = asyncio.get_running_loop().time()
+        if now < self._heat_cooldown_until:
+            return
+        k = self.knobs
+        hottest: tuple[float, int, KeyRange, dict] | None = None
+        live_keys: set[tuple[bytes, bytes]] = set()
+        ranges = shard_map.ranges()
+        # one concurrent sweep, not O(shards x replicas) serialized
+        # round-trips — a serialized sweep on a wide cluster would
+        # outlast DD_INTERVAL and stall the sustain-streak clock
+        heats = await asyncio.gather(
+            *(self._shard_heat(team, by_tag) for _rng, team in ranges))
+        for idx, ((rng, team), h) in enumerate(zip(ranges, heats)):
+            key = (rng.begin, rng.end)
+            # the shard EXISTS, so its streak survives the prune below
+            # even when this round's sample failed (a one-round RPC
+            # timeout must not reset a 15-round sustain streak and
+            # delay the needed split by another full sustain window)
+            live_keys.add(key)
+            if h is None:
+                continue
+            if h["rw_per_sec"] >= k.DD_SHARD_HOT_RW_PER_SEC:
+                self._heat_streak[key] = self._heat_streak.get(key, 0) + 1
+            else:
+                self._heat_streak.pop(key, None)
+            if self._heat_streak.get(key, 0) >= k.DD_HEAT_SUSTAIN_ROUNDS \
+                    and (hottest is None or h["rw_per_sec"] > hottest[0]):
+                hottest = (h["rw_per_sec"], idx, rng, h)
+        # streaks of shards that no longer exist (post-split boundaries)
+        self._heat_streak = {key: n for key, n in self._heat_streak.items()
+                             if key in live_keys}
+        if hottest is None:
+            return
+        rw, idx, rng, h = hottest
+        self.last_heat_rw_per_sec = round(rw, 1)
+        from .shard_load import weighted_split_key
+        split = weighted_split_key(h["samples"], rng.begin, rng.end)
+        src_entry = None
+        if split is None:
+            # heat concentrated on one key (or reservoir too thin): try
+            # the byte midpoint so at least the COLD half escapes
+            for tag in ranges[idx][1]:
+                if tag in by_tag:
+                    src_entry = by_tag[tag]
+                    break
+            if src_entry is not None:
+                try:
+                    split = await asyncio.wait_for(
+                        self._storage_stub(src_entry).sample_split_key(
+                            rng.begin, rng.end),
+                        timeout=k.FAILURE_TIMEOUT)
+                except Exception:   # noqa: BLE001 — move instead
+                    split = None
+        ev = "DDHotSplit" if split else "DDHotMove"
+        TraceEvent(ev).detail("Begin", rng.begin).detail("End", rng.end) \
+            .detail("TriggerRwPerSec", round(rw, 1)) \
+            .detail("ReadsPerSec", round(h["reads_per_sec"], 1)) \
+            .detail("WritesPerSec", round(h["writes_per_sec"], 1)) \
+            .detail("SplitKey", bytes(split) if split else None) \
+            .detail("Streak", self._heat_streak.get((rng.begin, rng.end))) \
+            .log()
+        before = self.live_moves_done
+        await self._relocate(state, layout, idx, next_tag,
+                             split_key=bytes(split) if split else None,
+                             engine=engine,
+                             heat="split" if split else "move")
+        if self.live_moves_done > before:
+            self._heat_cooldown_until = \
+                asyncio.get_running_loop().time() + k.DD_HEAT_COOLDOWN_S
+            # boundaries changed: every streak is stale
+            self._heat_streak.clear()
 
     async def _desired_engine(self) -> str | None:
         from .system_data import conf_key
@@ -234,11 +390,14 @@ class DataDistributor:
 
     async def _relocate(self, state: dict, layout: dict, idx: int,
                         next_tag: int, split_key: bytes | None = None,
-                        engine: str | None = None) -> None:
+                        engine: str | None = None,
+                        heat: str | None = None) -> None:
         """Live-relocate shard ``idx``: with ``split_key`` the suffix
         [split_key, end) moves to a fresh team (a split); without, the
         WHOLE shard moves (manual move / engine migration).  ``engine``
-        recruits the destinations on a specific IKeyValueStore type."""
+        recruits the destinations on a specific IKeyValueStore type;
+        ``heat`` ("split" | "move") attributes the relocation to the
+        heat policy in the published dd_stats."""
         rng = ShardMap([bytes(b) for b in layout["boundaries"]],
                        [list(t) for t in layout["teams"]]).shard_range(idx)
         if split_key is not None and not rng.begin < split_key < rng.end:
@@ -331,6 +490,16 @@ class DataDistributor:
         flip_layout["teams"][midx] = list(dest_tags)
         vf = await self._commit_layout(flip_layout)
 
+        # the flip is durable: count the relocation BEFORE the publish so
+        # the dd_stats riding the publish already include it
+        if split_key is not None:
+            self.splits_done += 1
+        self.live_moves_done += 1
+        if heat == "split":
+            self.heat_splits_done += 1
+        elif heat == "move":
+            self.heat_moves_done += 1
+
         # --- publish so clients re-route reads, then clear the journal.
         # If anything here fails, the flip journal entry survives and the
         # next round's reconciliation re-publishes from it. ---
@@ -340,9 +509,6 @@ class DataDistributor:
         await self._commit_layout({
             "boundaries": list(flip_layout["boundaries"]),
             "teams": [list(t) for t in flip_layout["teams"]]})
-        if split_key is not None:
-            self.splits_done += 1
-        self.live_moves_done += 1
         TraceEvent("DDMoveComplete").detail("Begin", move_rng.begin) \
             .detail("End", move_rng.end).detail("Vf", vf).log()
         await self._retire_emptied_sources(state, src_team, move_rng)
@@ -391,6 +557,10 @@ class DataDistributor:
             s = dict(s)
             s["shard_boundaries"] = [bytes(x) for x in boundaries]
             s["shard_teams"] = [list(t) for t in teams]
+            # relocation counters ride the published state so status can
+            # roll them up (cluster.hot_moves) without a DD RPC surface;
+            # counts cover THIS distributor's lifetime
+            s["dd_stats"] = self.stats()
             storage = []
             for entry in s["storage"]:
                 if entry["tag"] in dest_tags:

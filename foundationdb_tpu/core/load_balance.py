@@ -20,10 +20,12 @@ from .data import KeyRange
 class _ReplicaModel:
     """Per-replica queue model (QueueModel analog)."""
 
-    def __init__(self, storage) -> None:
+    def __init__(self, storage, index: int) -> None:
         self.storage = storage
+        self.index = index
         self.outstanding = 0
         self.penalty_until = 0.0
+        self.served = 0       # reads this replica answered (spread stats)
 
     def score(self, now: float) -> tuple[int, int]:
         return (1 if now < self.penalty_until else 0, self.outstanding)
@@ -32,25 +34,55 @@ class _ReplicaModel:
 class ReplicaGroup:
     """Storage-compatible read surface over a replication team."""
 
-    def __init__(self, shard: KeyRange, replicas: list) -> None:
+    def __init__(self, shard: KeyRange, replicas: list,
+                 knobs=None) -> None:
         self.shard = shard
         self.tag = replicas[0].tag     # representative (for diagnostics)
-        self._models = [_ReplicaModel(s) for s in replicas]
+        self._models = [_ReplicaModel(s, i) for i, s in enumerate(replicas)]
+        # read-spreading policy (ISSUE 7, knob CLIENT_READ_LOAD_BALANCE):
+        # how a HEALTHY team is ordered for the first attempt.  Failover
+        # semantics — penalties, outstanding bookkeeping, wholesale-
+        # refusal fallback — are identical under every policy; scalar
+        # and batched reads share this one home.
+        self.policy = (knobs.CLIENT_READ_LOAD_BALANCE
+                       if knobs is not None else "score")
+        self._rr = 0
 
     @property
     def replicas(self) -> list:
         return [m.storage for m in self._models]
 
+    def spread_counts(self) -> list[int]:
+        """Reads served per replica, in team order (spread diagnostics)."""
+        return [m.served for m in self._models]
+
+    def _order(self, now: float) -> list:
+        if self.policy == "rotate" and len(self._models) > 1:
+            # round-robin the healthy replicas (zipfian read fan-out);
+            # the stable sort keeps rotation order within each penalty
+            # class, so penalized replicas still sort last
+            start = self._rr % len(self._models)
+            self._rr += 1
+            rot = self._models[start:] + self._models[:start]
+            return sorted(rot, key=lambda m: m.score(now)[0])
+        if self.policy == "least":
+            # deterministic least-outstanding (stable index tiebreak)
+            return sorted(self._models, key=lambda m: m.score(now))
+        # "score": the pre-heat policy — least-outstanding with a
+        # random tiebreak among equals
+        return sorted(self._models,
+                      key=lambda m: (m.score(now),
+                                     deterministic_random().random()))
+
     async def _failover(self, attempt):
-        """THE replica-selection policy — score-ordered iteration with
+        """THE replica-selection policy — policy-ordered iteration with
         outstanding/penalty bookkeeping, shared by scalar and batched
         reads so the two can never diverge.  ``attempt(storage)``
         returns (served, value); served=False penalizes the replica
         and remembers ``value`` as the every-replica-refused fallback.
         Retryable FdbErrors penalize and continue; others raise."""
         now = asyncio.get_running_loop().time()
-        order = sorted(self._models,
-                       key=lambda m: (m.score(now), deterministic_random().random()))
+        order = self._order(now)
         last_err: BaseException | None = None
         fallback = None
         have_fallback = False
@@ -68,6 +100,7 @@ class ReplicaGroup:
             finally:
                 m.outstanding -= 1
             if served:
+                m.served += 1
                 return value
             fallback, have_fallback = value, True
             m.penalty_until = asyncio.get_running_loop().time() + 1.0

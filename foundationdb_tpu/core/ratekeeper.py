@@ -59,6 +59,14 @@ class Ratekeeper:
         self._default_window = 0
         self._tag_demand: dict[str, float] = {}
         self._default_demand = 0.0
+        # shard-heat admission (ISSUE 7): tags clamped because one
+        # shard's write rate alone would wedge its storage queue,
+        # armed BEFORE the global falloff engages
+        self.heat_tag_rates: dict[str, float] = {}
+        self.heat_throttle_activations = 0
+        self._heat_armed: set[str] = set()
+        self._last_heat_budgets: dict[str, float] = {}   # blind-tick hold
+        self.hot_shards: list[dict] = []      # per-shard heat rank (status)
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(
@@ -107,6 +115,36 @@ class Ratekeeper:
             *(self._sample_tlog(tl) for tl in self.tlogs),
             return_exceptions=True)
         n_ss = len(self.storage_servers)
+        # shard heat rides the SAME metrics sweep (zero extra RPCs —
+        # the reservoir payload only DD needs stays on shard_metrics);
+        # servers that don't report heat scalars (bare test fakes, old
+        # peers) simply don't contribute
+        heat: list[dict] = []
+        if k.RATEKEEPER_HEAT_THROTTLE:
+            heat = [m for m in samples[:n_ss]
+                    if not isinstance(m, BaseException)
+                    and "shard_writes_per_sec" in m]
+            # rank per SHARD for status: replicas merge on the shard
+            # bounds (reads SUM — the client spreads them; writes MAX —
+            # every replica applies the full stream), or one hot
+            # shard's replicas would fill every top-k slot
+            by_shard: dict = {}
+            for m in heat:
+                e = by_shard.setdefault(
+                    (m.get("shard_begin"), m.get("shard_end")),
+                    {"tags": [], "reads_per_sec": 0.0,
+                     "writes_per_sec": 0.0})
+                e["tags"].append(m["tag"])
+                e["reads_per_sec"] = round(
+                    e["reads_per_sec"] + m.get("shard_reads_per_sec", 0.0),
+                    3)
+                e["writes_per_sec"] = max(e["writes_per_sec"],
+                                          m["shard_writes_per_sec"])
+            for e in by_shard.values():
+                e["rw_per_sec"] = round(
+                    e["reads_per_sec"] + e["writes_per_sec"], 3)
+            self.hot_shards = sorted(by_shard.values(),
+                                     key=lambda e: -e["rw_per_sec"])[:3]
         for m in samples[:n_ss]:
             if isinstance(m, BaseException):
                 continue       # unreachable replica: the CC handles failure
@@ -168,6 +206,82 @@ class Ratekeeper:
                 self.tag_rates = {}
                 TraceEvent("RkRateLimited").detail("Reason", reason) \
                     .detail("TPSLimit", round(rate, 1)).log()
+        # --- heat-armed tag throttling (ISSUE 7): when ONE shard's
+        # write-byte rate alone would fill the storage queue target
+        # within RATEKEEPER_HEAT_WEDGE_S, clamp the dominant demand tag
+        # BEFORE the global falloff engages — the hot tenant sheds at
+        # GRV while the cluster-wide rate (and every cold tag) stays
+        # open.  Arms only with a dominant tag: untagged workloads see
+        # no behavior change.
+        self.heat_tag_rates = {}
+        armed_now: set[str] = set()
+        if not heat and k.RATEKEEPER_HEAT_THROTTLE and self._heat_armed:
+            # blind tick (every heat-bearing sample failed — recovery,
+            # reboot, partition): HOLD the armed clamp instead of
+            # releasing a one-interval burst mid-overload and
+            # double-counting the activation on the next tick
+            for t in self._heat_armed:
+                if t not in self.tag_rates:
+                    self.tag_rates[t] = self._last_heat_budgets.get(
+                        t, k.RATEKEEPER_MIN_TPS)
+                    self.heat_tag_rates[t] = self.tag_rates[t]
+            armed_now = set(self._heat_armed)
+        if heat:
+            hot = max(heat, key=lambda h: h["shard_writes_per_sec"])
+            wedge_bytes = hot.get("shard_write_bytes_per_sec", 0.0) \
+                * k.RATEKEEPER_HEAT_WEDGE_S
+            # disarm hysteresis: once armed, the clamp holds until the
+            # rates fall below HALF the arm thresholds — without it a
+            # clamped tag's decaying write rate oscillates around the
+            # threshold and every disarm releases a burst that re-arms
+            # it one tick later (arm/release thrash, the admission
+            # analog of the DD streak hysteresis)
+            hys = 0.5 if self._heat_armed else 1.0
+            if (hot["shard_writes_per_sec"]
+                    >= hys * k.RATEKEEPER_HOT_SHARD_WRITES_PER_SEC
+                    and wedge_bytes >= hys * k.TARGET_STORAGE_QUEUE_BYTES):
+                total = self._default_demand
+                dominant = [t for t, d in self._tag_demand.items()
+                            if total > 0
+                            and d / total >= k.TAG_THROTTLE_DEMAND_SHARE]
+                for t in dominant:
+                    # a tag the queue-depth falloff already clamped still
+                    # counts as ARMED: hysteresis and the activation
+                    # counter must not reset just because the clamp
+                    # migrated between mechanisms for a tick
+                    armed_now.add(t)
+                    if t in self.tag_rates:
+                        budget = self.tag_rates[t]
+                    else:
+                        # budget: scale the tag's own demand rate down by
+                        # the factor that stops the wedge (floor-guarded)
+                        demand_tps = self._tag_demand[t] \
+                            / max(k.RATEKEEPER_UPDATE_INTERVAL, 1e-6)
+                        factor = k.TARGET_STORAGE_QUEUE_BYTES \
+                            / max(wedge_bytes, 1e-9)
+                        budget = max(k.RATEKEEPER_MIN_TPS,
+                                     demand_tps * factor)
+                        self.tag_rates[t] = budget
+                        self.heat_tag_rates[t] = budget
+                    if t not in self._heat_armed:
+                        self.heat_throttle_activations += 1
+                        TraceEvent("RkHeatTagThrottled") \
+                            .detail("Tag", t) \
+                            .detail("TagTPSLimit", round(budget, 1)) \
+                            .detail("ShardTag", hot["tag"]) \
+                            .detail("WritesPerSec", round(
+                                hot["shard_writes_per_sec"], 1)) \
+                            .detail("WriteBytesPerSec", round(
+                                hot.get("shard_write_bytes_per_sec", 0.0),
+                                1)) \
+                            .log()
+                if dominant and rate >= k.RATEKEEPER_MAX_TPS \
+                        and self.heat_tag_rates:
+                    reason = "heat_tag_throttle_" + "_".join(
+                        sorted(self.heat_tag_rates))
+        self._heat_armed = armed_now
+        if self.heat_tag_rates:
+            self._last_heat_budgets = dict(self.heat_tag_rates)
         if self.manual_tag_rates:
             self.tag_rates = {**self.tag_rates, **self.manual_tag_rates}
         self.rate_tps = rate
@@ -202,6 +316,9 @@ class Ratekeeper:
         return {"tps_limit": self.rate_tps,
                 "batch_tps_limit": self.batch_rate_tps,
                 "throttled_tags": dict(self.tag_rates),
+                "heat_throttled_tags": dict(self.heat_tag_rates),
+                "heat_throttle_activations": self.heat_throttle_activations,
+                "hot_shards": [dict(h) for h in self.hot_shards],
                 "reason": self.limiting_reason}
 
     # --- admission (spent by GRV proxies) ---

@@ -97,6 +97,9 @@ async def cluster_status(knobs: Knobs, transport: Transport,
                 r["tps_limit"] = thr["tps_limit"]
                 r["batch_tps_limit"] = thr["batch_tps_limit"]
                 r["throttled_tags"] = thr["throttled_tags"]
+                r["heat_throttled_tags"] = thr.get("heat_throttled_tags", {})
+                r["heat_throttle_activations"] = \
+                    thr.get("heat_throttle_activations", 0)
                 r["limiting_reason"] = thr["reason"]
         except Exception:   # noqa: BLE001 — partial status beats none
             r["metrics_error"] = True
@@ -202,6 +205,53 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             m.get("device_read_uploads", 0) for m in storage_metrics),
     }
 
+    # shard-heat rollup (ISSUE 7): the top-k hottest shards by decayed
+    # read+write rate plus the active (heat-armed) tag throttles — the
+    # first place a zipfian hotspot shows up, before it becomes an
+    # abort-rate or tail-latency incident
+    rk_rows = [r for r in roles if r["role"] == "ratekeeper"]
+    rk = rk_rows[0] if rk_rows else {}
+    # aggregate per SHARD, not per server: with replication >= 2 one hot
+    # shard's replicas would otherwise occupy multiple top-k slots and
+    # push the genuinely-next-hottest shard out of the rollup.  Reads
+    # SUM over the team (the client spreads them), writes MAX (every
+    # replica applies the full stream) — the DD merge discipline.
+    by_shard: dict = {}
+    for m in storage_metrics:
+        key = (bytes(m.get("shard_begin") or b""),
+               bytes(m.get("shard_end") or b""))
+        e = by_shard.setdefault(key, {"tags": [], "reads_per_sec": 0.0,
+                                      "writes_per_sec": 0.0})
+        e["tags"].append(m["tag"])
+        e["reads_per_sec"] = round(
+            e["reads_per_sec"] + m.get("shard_reads_per_sec", 0.0), 3)
+        e["writes_per_sec"] = max(
+            e["writes_per_sec"], m.get("shard_writes_per_sec", 0.0))
+    for e in by_shard.values():
+        e["rw_per_sec"] = round(e["reads_per_sec"] + e["writes_per_sec"], 3)
+    heat_ranked = sorted(by_shard.values(),
+                         key=lambda e: -e["rw_per_sec"])
+    shard_heat_rollup = {
+        "top_shards": heat_ranked[:5],
+        "tracked_servers": len(storage_metrics),
+        "throttled_tags": rk.get("throttled_tags", {}),
+        "heat_throttled_tags": rk.get("heat_throttled_tags", {}),
+        "heat_throttle_activations": rk.get("heat_throttle_activations", 0),
+    }
+
+    # hot-move rollup (ISSUE 7): the data distributor's relocation
+    # counters ride the published cluster state (dd_stats lands with
+    # every flip publish), so heat splits/moves are visible without a
+    # DD RPC surface; all-zero until the first relocation publishes
+    dd_stats = state.get("dd_stats") or {}
+    hot_moves_rollup = {
+        "splits": dd_stats.get("splits", 0),
+        "live_moves": dd_stats.get("live_moves", 0),
+        "heat_splits": dd_stats.get("heat_splits", 0),
+        "heat_moves": dd_stats.get("heat_moves", 0),
+        "last_heat_rw_per_sec": dd_stats.get("last_heat_rw_per_sec", 0.0),
+    }
+
     # distributed-tracing rollup (ISSUE 2): every metric-bearing role
     # reports its span counters; sampled_txns comes from the GRV proxies
     # (where every sampled root first crosses the wire).  SERVER-side
@@ -231,6 +281,8 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             "change_feeds": feed_rollup,
             "resolver_device": resolver_device_rollup,
             "device_reads": device_reads_rollup,
+            "shard_heat": shard_heat_rollup,
+            "hot_moves": hot_moves_rollup,
             "tracing": tracing_rollup,
         },
         "roles": roles,

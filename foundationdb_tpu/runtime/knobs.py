@@ -130,6 +130,13 @@ class Knobs:
     # scalar one-RPC-per-key reads (the pre-714 path; equivalence tests
     # compare against it)
     CLIENT_COALESCE_READS: bool = True
+    # replica-read spreading (ISSUE 7): how ReplicaGroup orders a team
+    # for snapshot-safe reads.  "score" = the pre-heat policy (penalty,
+    # outstanding, random tiebreak); "rotate" = round-robin across
+    # healthy replicas (zipfian read fan-out); "least" = deterministic
+    # least-outstanding.  Failover semantics are identical under every
+    # policy — only the FIRST-choice order changes.
+    CLIENT_READ_LOAD_BALANCE: str = "score"
     # range-read streaming: first fetch asks for this many rows per
     # shard, then DOUBLES each round (the iterator-mode growth of
     # REF:fdbclient/NativeAPI.actor.cpp getRange) until a reply would
@@ -170,6 +177,27 @@ class Knobs:
     DD_SHARD_SPLIT_BYTES: int = 1 << 24       # split threshold (logical bytes)
     DD_MOVE_TIMEOUT: float = 30.0             # live-move catch-up deadline
 
+    # --- shard heat (ISSUE 7) ---
+    # per-storage-server decayed read/write rate tracking + key
+    # reservoir (core/shard_load.py): always on — a few float ops per
+    # batch, no RNG from the global sim stream — shipped to DD and the
+    # Ratekeeper via the shard_metrics RPC.  The CONSUMERS are each
+    # knob-gated; DD's heat policy and the client read spread default
+    # OFF so same-seed sims replay the pre-heat behavior bit-exactly.
+    SHARD_HEAT_HALFLIFE: float = 10.0         # rate decay half-life, seconds
+    SHARD_HEAT_SAMPLES: int = 64              # reservoir capacity (keys)
+    SHARD_HEAT_KEY_SAMPLE: int = 8            # sample 1 key per N recorded ops
+    # heat-driven relocation: a shard sustaining DD_SHARD_HOT_RW_PER_SEC
+    # (reads summed over the team + writes) for DD_HEAT_SUSTAIN_ROUNDS
+    # consecutive DD rounds splits at the reservoir's heat midpoint —
+    # or MOVES to a fresh team when the heat straddles a single key —
+    # then cools down for DD_HEAT_COOLDOWN_S so oscillating load cannot
+    # thrash fetchKeys
+    DD_SHARD_HEAT_SPLITS: bool = False
+    DD_SHARD_HOT_RW_PER_SEC: float = 5000.0
+    DD_HEAT_SUSTAIN_ROUNDS: int = 2
+    DD_HEAT_COOLDOWN_S: float = 10.0
+
     # --- observability ---
     METRICS_INTERVAL: float = 5.0             # role *Metrics emit period
 
@@ -184,6 +212,16 @@ class Knobs:
     # this while the cluster is limited gets its own clamp (tag
     # throttling) instead of dragging the global rate down
     TAG_THROTTLE_DEMAND_SHARE: float = 0.5
+    # heat-armed tag throttling (ISSUE 7): when ONE shard's write-byte
+    # rate alone would fill TARGET_STORAGE_QUEUE_BYTES within
+    # RATEKEEPER_HEAT_WEDGE_S (and its write op rate clears the floor
+    # below), the dominant demand tag is clamped BEFORE the global
+    # falloff engages — GRV sheds the hot tenant, cold tenants never
+    # feel the storage queue wedge.  Arms only when a dominant tag
+    # exists, so untagged workloads see no behavior change.
+    RATEKEEPER_HEAT_THROTTLE: bool = True
+    RATEKEEPER_HOT_SHARD_WRITES_PER_SEC: float = 20_000.0
+    RATEKEEPER_HEAT_WEDGE_S: float = 30.0
 
     # --- simulation ---
     SIM_NETWORK_MIN_DELAY: float = 0.0005

@@ -61,6 +61,17 @@ def test_resolve_pipeline_smoke():
     perf_smoke.check_resolve(budget_s=perf_smoke.RESOLVE_BUDGET_S)
 
 
+def test_heat_admission_smoke():
+    """The shard-heat subsystem (ISSUE 7): under an in-process skewed
+    load the heat tracker must rank the hot shard first (with a real
+    margin and an interior split point for DD), the ratekeeper's heat
+    path must arm a tag throttle for the dominant tag, and the armed
+    clamp must shed — tagged admission queues on its bucket while
+    untagged work stays fast, all bounded by the standing hard wedge
+    deadline (measured ~5s against the 60s budget on a 2-cpu host)."""
+    perf_smoke.check_heat(budget_s=perf_smoke.HEAT_BUDGET_S)
+
+
 def test_apply_metrics_surface():
     """The apply path must publish its observability counters — a silent
     regression is the other half of the r5 incident."""

@@ -221,3 +221,17 @@ def test_sim_trace_reconstructs_cross_role_timeline(tmp_path):
     assert rd["enqueued"] >= rd["dispatches"]
     assert rd["poisoned"] == 0
     assert "device_reads" in doc["cluster"]
+    # shard-heat rollup (ISSUE 7): every storage role reports decayed
+    # heat rates, and the writes above must register on some shard
+    sh = doc["cluster"]["shard_heat"]
+    assert sh["tracked_servers"] >= 1
+    assert len(sh["top_shards"]) >= 1
+    assert sh["top_shards"][0]["rw_per_sec"] > 0.0, sh["top_shards"]
+    assert sh["top_shards"][0]["rw_per_sec"] >= \
+        sh["top_shards"][-1]["rw_per_sec"]
+    assert sh["heat_throttled_tags"] == {}      # untagged workload
+    assert sh["heat_throttle_activations"] == 0
+    # hot-move rollup: present and all-zero (no DD in this sim)
+    hm = doc["cluster"]["hot_moves"]
+    assert hm == {"splits": 0, "live_moves": 0, "heat_splits": 0,
+                  "heat_moves": 0, "last_heat_rw_per_sec": 0.0}

@@ -44,7 +44,19 @@ must hold a >= 2x throughput edge.  A dispatch-path regression (lost
 fusion, a sync sneaking onto the submit path, a parity break at an
 eviction edge) fails here at tier-1 cost, not at r-bench.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|all]
+Stage 6 (``heat``): the shard-heat subsystem (ISSUE 7) under an
+in-process skewed load — zipf-shaped writes+reads concentrated on ONE
+shard of four, tagged with a throttle tag.  The heat tracker must rank
+the hot shard FIRST (by decayed rw rate, with a real margin over the
+cold shards), the ratekeeper's heat path must ARM a tag throttle for
+the dominant tag (the shard's write-byte rate alone would wedge the
+storage queue target), the armed clamp must actually SHED (a tagged
+admission queues on its bucket, bounded by a hard deadline) while
+untagged admission stays fast.  A regression that silently stopped
+ranking heat, stopped arming, or wedged admission fails here at tier-1
+cost, not in a production hotspot.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -76,6 +88,11 @@ RESOLVE_BATCHES = 96
 RESOLVE_TXNS = 16           # per batch (RESOLVER_BATCH_TXNS for the run)
 RESOLVE_BUDGET_S = 150.0    # measured ~12s incl. jax compiles (2-cpu host)
 RESOLVE_AB_FLOOR = 2.0      # pipelined vs unpipelined txns/s
+HEAT_HOT_TXNS = 300         # tagged commits into the hot shard
+HEAT_COLD_TXNS = 60         # untagged commits spread over cold shards
+HEAT_READS = 600            # zipf-shaped point reads on the hot shard
+HEAT_BUDGET_S = 60.0        # measured ~5s on a loaded 2-cpu host
+HEAT_RANK_MARGIN = 3.0      # hot shard rw rate vs the next-hottest
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -648,13 +665,181 @@ def check_resolve(budget_s: float = RESOLVE_BUDGET_S,
     return elapsed
 
 
+def heat_path_seconds(deadline_s: float | None = None) -> tuple[float, dict]:
+    """The shard-heat smoke (ISSUE 7): skewed tagged load through the
+    full in-process commit pipeline, then three assertions in situ —
+    the heat tracker ranks the hot shard first, the ratekeeper's heat
+    path arms a tag throttle for the dominant tag, and the armed clamp
+    sheds (tagged admission queues, untagged stays fast, both bounded
+    by the deadline)."""
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    knobs = Knobs().override(
+        # fast-converging rates for a seconds-long smoke
+        SHARD_HEAT_HALFLIFE=2.0,
+        # arm aggressively: >= 10 writes/s on one shard whose write-byte
+        # rate would fill a (deliberately tiny) 2KB queue target within
+        # 5s — the smoke's hot load clears both by orders of magnitude,
+        # and the computed budget bottoms out at RATEKEEPER_MIN_TPS so
+        # the shed measurement below is deterministic
+        RATEKEEPER_HEAT_THROTTLE=True,
+        RATEKEEPER_HOT_SHARD_WRITES_PER_SEC=10.0,
+        RATEKEEPER_HEAT_WEDGE_S=5.0,
+        TARGET_STORAGE_QUEUE_BYTES=2_000,
+        # floor high enough that the clamp arming MID-LOAD (it does —
+        # that's the subsystem working) drains the remaining tagged
+        # commits in seconds, not minutes, on a loaded CI box
+        RATEKEEPER_MIN_TPS=25.0)
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        knobs = knobs.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin, generous budget
+        pass
+
+    def hot_key(i: int) -> bytes:
+        # zipf-shaped: multiplicative-hash squared index concentrates
+        # most probes on a small prefix of the 512-key hot set
+        return b"hot%05d" % (((i * 2654435761) % 512) ** 2 % 512)
+
+    async def main() -> tuple[float, dict]:
+        cluster = Cluster(ClusterConfig(storage_servers=4), knobs)
+        cluster.start()
+        t_all = time.perf_counter()
+        issued_hot = iter(range(HEAT_HOT_TXNS))
+        issued_cold = iter(range(HEAT_COLD_TXNS))
+
+        async def hot_writer(cid: int) -> None:
+            tr = Transaction(cluster)
+            tr.throttle_tag = "hot"
+            for i in issued_hot:
+                while True:
+                    try:
+                        tr.set(hot_key(i), b"v" * 64)
+                        tr.set(hot_key(i + 7), b"w" * 64)
+                        await tr.commit()
+                        tr.reset()
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+
+        async def cold_writer(cid: int) -> None:
+            tr = Transaction(cluster)
+            for i in issued_cold:
+                while True:
+                    try:
+                        tr.set(b"\x20cold%06d" % i, b"v" * 64)
+                        await tr.commit()
+                        tr.reset()
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+
+        async def hot_reader(rid: int) -> None:
+            tr = Transaction(cluster)
+            await tr.get_read_version()
+            for j in range(HEAT_READS // 8):
+                await tr.get(hot_key(rid * 131 + j), snapshot=True)
+
+        await asyncio.gather(*(hot_writer(c) for c in range(12)),
+                             *(cold_writer(c) for c in range(2)),
+                             *(hot_reader(r) for r in range(8)))
+
+        # --- 1. the tracker ranks the hot shard first ---
+        sms = [await ss.shard_metrics() for ss in cluster.storage_servers]
+        ranked = sorted(sms, key=lambda m: -m["rw_per_sec"])
+        hot_sm = ranked[0]
+        assert hot_sm["shard_begin"] <= b"hot" < hot_sm["shard_end"], (
+            "heat tracker ranked the WRONG shard first: "
+            + repr([(m["tag"], m["rw_per_sec"]) for m in ranked]))
+        rank_margin = hot_sm["rw_per_sec"] \
+            / max(ranked[1]["rw_per_sec"], 1e-9)
+        assert rank_margin >= HEAT_RANK_MARGIN, (
+            f"hot shard only {rank_margin:.1f}x the next-hottest "
+            f"(floor {HEAT_RANK_MARGIN:.0f}x) — the skew signal washed out")
+        # and the reservoir computed an interior split point for DD
+        assert hot_sm["heat_split_key"] is not None
+        assert bytes(hot_sm["heat_split_key"]).startswith(b"hot")
+
+        # --- 2. the heat path armed a tag throttle for the hot tag ---
+        rk = cluster.ratekeeper
+        await rk._recompute()
+        assert "hot" in rk.heat_tag_rates, (
+            f"heat throttle never armed: tag_rates={rk.tag_rates} "
+            f"reason={rk.limiting_reason} hot_shards={rk.hot_shards}")
+        assert rk.heat_throttle_activations >= 1
+        budget = rk.tag_rates["hot"]
+        # freeze the clamp for the shed measurement: the update loop
+        # would re-run _recompute mid-drain and lift it as rates decay
+        await rk.stop()
+
+        # --- 3. the armed clamp sheds; untagged work stays fast ---
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await rk.admit(50)
+        untagged_s = loop.time() - t0
+        assert untagged_s < 1.0, (
+            f"untagged admission took {untagged_s:.2f}s under a TAG "
+            f"clamp — cold tenants are paying for the hot one")
+        t0 = loop.time()
+        # the tag bucket starts full (one budget of tokens): 2.5
+        # budgets must drain >= 1.5 budgets from refill ≈ 1.5s
+        await rk.admit(int(2.5 * budget), tags={"hot": int(2.5 * budget)})
+        tagged_s = loop.time() - t0
+        assert tagged_s >= 0.5, (
+            f"tagged admission of 2.5x the clamp budget returned in "
+            f"{tagged_s:.2f}s — the throttle armed but did not shed")
+        stats = {
+            "hot_rw_per_sec": hot_sm["rw_per_sec"],
+            "rank_margin": rank_margin,
+            "heat_rank": [(m["tag"], m["rw_per_sec"]) for m in ranked],
+            "armed_budget_tps": budget,
+            "heat_throttle_activations": rk.heat_throttle_activations,
+            "untagged_admit_s": untagged_s,
+            "tagged_admit_s": tagged_s,
+        }
+        elapsed = time.perf_counter() - t_all
+        await cluster.stop()
+        return elapsed, stats
+
+    async def bounded():
+        return await asyncio.wait_for(main(), deadline_s)
+
+    try:
+        return asyncio.run(bounded())
+    except asyncio.TimeoutError:
+        raise AssertionError(
+            f"heat smoke wedged: the {deadline_s:.0f}s deadline hit — "
+            f"admission never completed under the armed clamp (the "
+            f"standing hard wedge deadline), not just slowness") from None
+
+
+def check_heat(budget_s: float = HEAT_BUDGET_S, quiet: bool = False) -> float:
+    """Run the shard-heat smoke; raises AssertionError when the tracker
+    mis-ranks the hot shard, the heat throttle fails to arm or shed, or
+    the wedge deadline hits."""
+    elapsed, stats = heat_path_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] heat: hot shard {stats['hot_rw_per_sec']:.0f} "
+              f"rw/s ({stats['rank_margin']:.1f}x margin), tag budget "
+              f"{stats['armed_budget_tps']:.0f} tps, tagged admit "
+              f"{stats['tagged_admit_s']:.2f}s vs untagged "
+              f"{stats['untagged_admit_s']:.2f}s")
+    assert elapsed < budget_s, (
+        f"heat smoke took {elapsed:.1f}s (budget {budget_s:.0f}s)")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
     ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
     ap.add_argument("--stage",
                     choices=("apply", "pipeline", "feed", "read",
-                             "resolve", "all"),
+                             "resolve", "heat", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -662,6 +847,7 @@ def main() -> int:
     ap.add_argument("--read-budget", type=float, default=READ_BUDGET_S)
     ap.add_argument("--resolve-budget", type=float,
                     default=RESOLVE_BUDGET_S)
+    ap.add_argument("--heat-budget", type=float, default=HEAT_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -673,6 +859,8 @@ def main() -> int:
         check_read(budget_s=args.read_budget)
     if args.stage in ("resolve", "all"):
         check_resolve(budget_s=args.resolve_budget)
+    if args.stage in ("heat", "all"):
+        check_heat(budget_s=args.heat_budget)
     return 0
 
 
