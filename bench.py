@@ -1038,6 +1038,232 @@ def run_hot_shard_phase(quiet: bool) -> dict:
     return r
 
 
+def run_backup_restore_phase(quiet: bool) -> dict:
+    """Feed-native backup/restore stage (ISSUE 8): back up a LIVE
+    cluster under continuous writes — packed snapshot + whole-db feed
+    tail into a real-disk BackupContainer — then restore to a
+    MID-STREAM version on a fresh cluster and verify byte-identity.
+    Emits the operator-facing numbers: backup log lag (delivery wall
+    time behind the committed frontier), snapshot and restore
+    throughput, and restore_verified."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from foundationdb_tpu.backup.agent import BackupAgent
+    from foundationdb_tpu.backup.container import keyspace_digest as digest
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.core.data import SYSTEM_PREFIX
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.files import RealFileSystem
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    n_rows, n_writers, write_s = 40_000, 8, 6.0
+    knobs = Knobs().override(BACKUP_LOG_FLUSH_INTERVAL=0.1)
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        knobs = knobs.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin is fine for this shape
+        pass
+
+    async def read_all(cluster, at_version=None):
+        tr = Transaction(cluster)
+        while True:
+            try:
+                if at_version is not None:
+                    tr.set_read_version(at_version)
+                return await tr.get_range(b"", SYSTEM_PREFIX, limit=0,
+                                          snapshot=True)
+            except FdbError as e:
+                await tr.on_error(e)
+
+    tmp = tempfile.mkdtemp(prefix="bench-backup-")
+
+    async def main() -> dict:
+        fs = RealFileSystem(tmp)
+        src = Cluster(ClusterConfig(storage_servers=2), knobs)
+        src.start()
+        db = Database(src)
+
+        async def loader(lo: int, hi: int) -> None:
+            tr = Transaction(src)
+            for start in range(lo, hi, 500):
+                while True:
+                    for i in range(start, min(start + 500, hi)):
+                        tr.set(b"bk%08d" % i, b"v" * 100)
+                    try:
+                        await tr.commit()
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+                tr.reset()
+
+        span = (n_rows + 15) // 16
+        await asyncio.gather(*(loader(j * span, min((j + 1) * span, n_rows))
+                               for j in range(16)))
+
+        agent = BackupAgent(db, fs, "bk")
+        await agent.start_continuous()
+        # snapshot under live writes
+        stop = asyncio.Event()
+        written = [0]
+
+        async def writer(wid: int) -> None:
+            tr = Transaction(src)
+            i = 0
+            while not stop.is_set():
+                while True:
+                    try:
+                        tr.set(b"bk%08d" % ((wid * 131 + i * 37) % n_rows),
+                               b"w" * 100)
+                        await tr.commit()
+                        tr.reset()
+                        written[0] += 1
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+                i += 1
+                await asyncio.sleep(0.002)
+
+        lags: list[float] = []
+
+        async def lag_sampler() -> None:
+            vps = knobs.VERSIONS_PER_SECOND
+            while not stop.is_set():
+                lag = src.sequencer.committed_version - agent.log_through
+                lags.append(max(0.0, lag / vps * 1e3))
+                await asyncio.sleep(0.2)
+
+        writers = [asyncio.ensure_future(writer(w))
+                   for w in range(n_writers)]
+        sampler = asyncio.ensure_future(lag_sampler())
+        t0 = time.perf_counter()
+        snap = await agent.backup()
+        snap_s = time.perf_counter() - t0
+        snap_mb = sum(
+            fs.open(f"bk/{n}").size() for n in snap.range_files) / 1e6
+
+        # the restore target: a mid-stream marker while writes continue
+        await asyncio.sleep(write_s / 2)
+        tr = Transaction(src)
+        while True:
+            try:
+                tr.set(b"bk-marker", b"mid-stream")
+                vt = await tr.commit()
+                break
+            except FdbError as e:
+                await tr.on_error(e)
+        expected = await read_all(src, at_version=vt)
+        await asyncio.sleep(write_s / 2)
+        stop.set()
+        await asyncio.gather(*writers)
+        sampler.cancel()
+        await agent.stop_continuous(drain_timeout=60.0)
+        mlog = await agent.container.load_log_manifest()
+        await src.stop()
+
+        dst = Cluster(ClusterConfig(storage_servers=2), knobs)
+        dst.start()
+        agent2 = BackupAgent(Database(dst), fs, "bk")
+        t0 = time.perf_counter()
+        await agent2.restore(to_version=vt)
+        restore_s = time.perf_counter() - t0
+        got = await read_all(dst)
+        await dst.stop()
+        verified = digest(got) == digest(expected)
+        restored_mb = sum(len(k) + len(v) for k, v in got) / 1e6
+        lags.sort()
+        return {
+            "backup_log_lag_ms_p50":
+                round(lags[len(lags) // 2], 2) if lags else None,
+            "backup_log_lag_ms_p99":
+                round(lags[min(len(lags) - 1, int(len(lags) * 0.99))], 2)
+                if lags else None,
+            "snapshot_mb_per_s": round(snap_mb / snap_s, 2) if snap_s
+            else None,
+            "restore_mb_per_s": round(restored_mb / restore_s, 2)
+            if restore_s else None,
+            "restore_verified": verified,
+            "backup_snapshot_rows": snap.rows,
+            "backup_snapshot_mb": round(snap_mb, 2),
+            "backup_log_files": len(mlog["files"]),
+            "backup_log_mb": round(mlog.get("bytes", 0) / 1e6, 2),
+            "backup_writes_during": written[0],
+            "backup_restore_rows": len(got),
+            "backup_restore_s": round(restore_s, 2),
+        }
+
+    try:
+        r = asyncio.run(main())
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if not quiet:
+        print(f"[bench] backup restore: {r}", file=sys.stderr)
+    return r
+
+
+def run_tpcc_district_phase(quiet: bool) -> dict:
+    """TPC-C district admission stage (ISSUE 8 satellite; PR 7 follow-up
+    (d)): the district hotspot is WRITE-contention on single keys —
+    splits cannot help it, only admission can.  Hot-district NewOrders
+    carry a GRV throttle tag; the stage measures the heat clamp's
+    abort-rate effect by running the identical tagged workload with the
+    clamp disarmed vs armed (aggressive arm knobs — the same shape
+    perf_smoke's heat stage guards)."""
+    import asyncio
+
+    from foundationdb_tpu.bench.tpcc import run_tpcc_neworder
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    base = Knobs()
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        base = base.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin is fine for this shape
+        pass
+    armed = base.override(
+        RATEKEEPER_HEAT_THROTTLE=True,
+        RATEKEEPER_HOT_SHARD_WRITES_PER_SEC=10.0,
+        RATEKEEPER_HEAT_WEDGE_S=5.0,
+        TARGET_STORAGE_QUEUE_BYTES=50_000,
+        RATEKEEPER_MIN_TPS=25.0,
+        SHARD_HEAT_HALFLIFE=2.0)
+    disarmed = base.override(RATEKEEPER_HEAT_THROTTLE=False)
+
+    off = asyncio.run(run_tpcc_neworder(
+        disarmed, duration_s=8.0, n_clients=32, warmup_s=1.0,
+        hot_district_frac=0.6, district_tag="district"))
+    on = asyncio.run(run_tpcc_neworder(
+        armed, duration_s=8.0, n_clients=32, warmup_s=1.0,
+        hot_district_frac=0.6, district_tag="district"))
+
+    def rnd(x, n=4):
+        return None if x is None else round(x, n)
+
+    r = {
+        "tpcc_district_throttle_activations":
+            on["heat_throttle_activations"],
+        "tpcc_district_throttle_tags": on["heat_throttled_tags"],
+        "tpcc_district_throttle_abort_rate_off": rnd(off["abort_rate"]),
+        "tpcc_district_throttle_abort_rate_on": rnd(on["abort_rate"]),
+        "tpcc_district_throttle_abort_delta":
+            rnd(off["abort_rate"] - on["abort_rate"]),
+        "tpcc_district_throttle_tpmC_off":
+            rnd(off["tpmC"], 1) if off["tpmC"] is not None else None,
+        "tpcc_district_throttle_tpmC_on":
+            rnd(on["tpmC"], 1) if on["tpmC"] is not None else None,
+        "tpcc_district_throttle_p99_ms_off": off.get("p99_ms"),
+        "tpcc_district_throttle_p99_ms_on": on.get("p99_ms"),
+    }
+    if not quiet:
+        print(f"[bench] tpcc district throttle: {r}", file=sys.stderr)
+    return r
+
+
 def project_local_attach(out: dict, e2e: dict) -> dict:
     """Locally-attached projection (VERDICT r4 1c): what the tpu e2e
     number becomes with the tunnel RTT removed, computed from MEASURED
@@ -1291,6 +1517,26 @@ def main() -> int:
                 args.stage_timeout, out)
             if hs is not None:
                 out.update(hs)
+
+            # feed-native backup/restore (ISSUE 8): live-cluster backup
+            # under continuous writes, restore to a mid-stream version,
+            # byte-identity verified in-stage
+            br = call_bounded(
+                "backup_restore",
+                lambda: run_backup_restore_phase(args.quiet),
+                args.stage_timeout, out)
+            if br is not None:
+                out.update(br)
+
+            # TPC-C district admission (ISSUE 8 satellite; PR 7 (d)):
+            # the heat clamp's abort-rate effect on the single-key
+            # write hotspot, clamp off vs on
+            td = call_bounded(
+                "tpcc_district",
+                lambda: run_tpcc_district_phase(args.quiet),
+                args.stage_timeout, out)
+            if td is not None:
+                out.update(td)
 
             def abort_parity():
                 # the abort-parity gate (BASELINE.md config-2): encoded

@@ -1,5 +1,7 @@
 """Backup and restore agents."""
 
-from .agent import BackupAgent, RestoreError
+from .agent import BackupAgent, BackupManifest, RestoreError
+from .container import BackupContainer, ContainerError
 
-__all__ = ["BackupAgent", "RestoreError"]
+__all__ = ["BackupAgent", "BackupManifest", "RestoreError",
+           "BackupContainer", "ContainerError"]

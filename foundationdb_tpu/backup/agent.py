@@ -1,40 +1,61 @@
-"""Backup/restore — snapshot backups + continuous mutation log (PITR).
+"""Feed-native backup/restore — whole-database change feeds + packed
+snapshot containers + point-in-time restore-to-version (ISSUE 8).
 
 Reference: REF:fdbclient/FileBackupAgent.actor.cpp +
-REF:fdbbackup/backup.actor.cpp — the file-based backup writes range files
-(a consistent key-value cut) plus mutation-log files; restore streams the
-snapshot back and replays the logs to a target version.
+REF:fdbbackup/backup.actor.cpp — the file backup writes range files (a
+consistent cut) plus mutation-log files; restore streams the newest
+snapshot at or below the target back in and replays the log window
+above it.
 
-Two layers:
+This agent is built on the change-feed subsystem (ISSUE 4), NOT on a
+proxy-pushed backup tag:
 
-1. **Snapshot** (`backup()`): every range page read at ONE pinned version
-   — a strictly consistent cut.
-2. **Continuous mutation log** (`start_continuous()`): a state
-   transaction sets ``\\xff/backup/tag``, after which every commit proxy
-   pushes the full ordered mutation stream under the backup tag too (the
-   reference's backup mutation tags); this agent pulls that tag from the
-   TLogs like a storage server would, writes versioned ``.mlog`` files,
-   and pops what it has made durable.  ``restore(to_version=...)`` then
-   replays logs in ``(snapshot_version, to_version]`` over the restored
-   snapshot — point-in-time restore to any covered version.
+1. **Snapshot** (``backup()``): every range page read at ONE pinned
+   version — a strictly consistent cut — written as packed columnar
+   ``.kvr`` files through :class:`BackupContainer`.
+2. **Continuous mutation log** (``start_continuous()``): the agent
+   registers a WHOLE-DATABASE change feed (``[b"", b"\\xff")`` — system
+   writes are excluded at capture) and tails it through
+   ``ChangeFeedCursor``.  The cursor inherits everything the feed
+   subsystem proved under chaos: the known-committed heartbeat clamp
+   (a frontier can never expose applied-but-unacked versions a recovery
+   might roll back), exactly-once resume across failovers and DD
+   splits/moves, and DiskQueue spill on durable servers.  Entries land
+   in crc-framed ``.mlog`` files; the ``logs.manifest`` ``through``
+   frontier advances only past fsync'd files and IS the complete resume
+   token — a killed agent resumes exactly-once from ``through + 1``
+   (``resume_continuous``) with no proxy-side backup tag at all.
+   Feed retention is released by popping the feed to the durable
+   frontier, so the cluster never holds what the container already has.
+3. **Restore-to-version** (``restore(to_version=...)``): newest snapshot
+   at or below the target streamed back through normal batched commits,
+   then the ``.mlog`` window ``(snapshot_version, target]`` replayed in
+   version order.  Feed entries carry RESOLVED atomics (the storage
+   apply path captures the effective set/clear), so replay is plain
+   sets/clears — deterministic bytes, no atomic re-evaluation.  Every
+   chunk is fenced by a restore-progress key: a retry after an
+   ambiguous commit skips, and a CRASHED restore re-run with
+   ``resume=True`` skips completed chunks idempotently.
 """
 
 from __future__ import annotations
 
 import asyncio
-import dataclasses
 
 from ..client.database import Database
-from ..core.data import MAX_VERSION, MutationType, SYSTEM_PREFIX, Version
-from ..core.system_data import BACKUP_PREFIX
-from ..rpc.wire import decode, encode
-from ..runtime.errors import FdbError
+from ..core.data import SYSTEM_PREFIX, Version
+from ..core.system_data import BACKUP_PREFIX, backup_progress_key
+from ..rpc.wire import encode
+from ..runtime import span as _span
+from ..runtime.errors import (ChangeFeedNotRegistered, ChangeFeedPopped,
+                              FdbError)
+from ..runtime.knobs import KNOBS
 from ..runtime.trace import TraceEvent
+from .container import BackupContainer
 
-# well-known mutation-log tag, far above any storage tag DataDistribution
-# will ever allocate (DD uses max(existing storage tag)+1)
-BACKUP_TAG = 1 << 20
 RESTORE_PROGRESS_KEY = BACKUP_PREFIX + b"restore_progress"
+# whole-database feed range: the entire user keyspace, \xff-exclusive
+WHOLE_DB_BEGIN, WHOLE_DB_END = b"", b"\xff"
 
 
 class RestoreError(FdbError):
@@ -42,68 +63,178 @@ class RestoreError(FdbError):
     name = "restore_error"
 
 
-@dataclasses.dataclass
+def _knobs_of(db):
+    k = getattr(getattr(db, "cluster", None), "knobs", None)
+    if k is None:
+        k = getattr(getattr(db, "view", None), "knobs", None)
+    return k or KNOBS
+
+
 class BackupManifest:
-    version: int                    # the snapshot's read version
-    range_files: list[str]
-    rows: int
-    bytes: int
-    format: int = 1                 # bump when mutation logs land
+    """One snapshot's description (kept for API/CLI compatibility)."""
 
-    def to_wire(self) -> dict:
-        return dataclasses.asdict(self)
-
-    @classmethod
-    def from_wire(cls, d: dict) -> "BackupManifest":
-        return cls(version=d["version"],
-                   range_files=[str(f) for f in d["range_files"]],
-                   rows=d["rows"], bytes=d["bytes"],
-                   format=d.get("format", 1))
+    def __init__(self, version: int, range_files: list[str], rows: int,
+                 bytes: int, format: int = 2) -> None:  # noqa: A002
+        self.version = version
+        self.range_files = range_files
+        self.rows = rows
+        self.bytes = bytes
+        self.format = format
 
 
 class BackupAgent:
-    """Snapshot backup/restore over a Database handle + an async fs."""
+    """Feed-native backup/restore over a Database handle + an async fs."""
 
     def __init__(self, db: Database, fs, directory: str,
-                 rows_per_file: int = 1000) -> None:
+                 rows_per_file: int | None = None) -> None:
         self.db = db
         self.fs = fs
         self.dir = directory.rstrip("/")
-        self.rows_per_file = rows_per_file
+        self.name = self.dir.rsplit("/", 1)[-1]
+        self.knobs = _knobs_of(db)
+        self.rows_per_file = rows_per_file or self.knobs.BACKUP_SNAPSHOT_ROWS
+        self.container = BackupContainer(fs, self.dir)
+        self.feed_id = b"backup:" + self.name.encode()
         self._pull_task: asyncio.Task | None = None
+        # mutation-log state (mirrors logs.manifest)
+        self._log_begin: Version | None = None   # feed registration version
+        self.log_through: Version = 0            # durable frontier (inclusive)
         self._log_files: list[tuple[Version, Version, str]] = []
-        self._log_begin: Version | None = None
-        self._pulled_through: Version = 0
-        self._stream = None             # TagStream while pulling
+        self._file_seq = 0
+        self._log_stopped = False
+        self.bytes_logged = 0
+        self.bytes_snapshotted = 0
+        self.last_snapshot_version: Version | None = None
+        # span roots for the snapshot/log writers (PR 2 follow-up (c)):
+        # backup agents never run inside a sampled transaction, so they
+        # root their own deterministic counter-based server spans
+        self.spans = _span.SpanSink("BackupAgent")
+        self._sampler = _span.ServerSampler(namespace=4)
 
-    # --- continuous mutation log (REF: backup mutation tags) ---
+    # --- plumbing ---
+
+    async def _grv(self) -> Version:
+        tr = self.db.create_transaction()
+        tr.lock_aware = True
+        while True:
+            try:
+                v = await tr.get_read_version()
+                tr.reset()
+                return v
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:  # noqa: BLE001 — retry via on_error
+                await tr.on_error(e)
+
+    async def _save_log_manifest(self) -> None:
+        await self.container.save_log_manifest({
+            "feed": self.feed_id, "begin": self._log_begin,
+            "through": self.log_through,
+            "files": [[f, l, n] for f, l, n in self._log_files],
+            "bytes": self.bytes_logged, "stopped": self._log_stopped})
+
+    def _load_log_state(self, meta: dict) -> None:
+        self._log_begin = meta["begin"]
+        self.log_through = meta["through"]
+        self._log_files = [(f, l, str(n)) for f, l, n in meta["files"]]
+        self._file_seq = len(self._log_files)
+        self.bytes_logged = meta.get("bytes", 0)
+
+    # --- continuous mutation log (the whole-db feed tail) ---
 
     async def start_continuous(self) -> Version:
-        """Activate the backup tag on every commit proxy (via the
-        ``\\xff/backup/tag`` state transaction) and start pulling the
-        mutation stream.  Returns the activation version: every mutation
-        strictly after it is captured."""
+        """Register the whole-database feed and start tailing it.
+        Returns the registration version: every mutation strictly above
+        it is captured.  A fresh activation starts a fresh file set (the
+        prior activation's files stay on disk but leave the manifest)."""
         if self._pull_task is not None and not self._pull_task.done():
             raise RestoreError("continuous backup already running")
-        vb = await self._commit_tag(encode(BACKUP_TAG))
+        await self.container.init()
+        # destroy any prior incarnation so the re-registration commits a
+        # FRESH registration version (re-registering an existing feed is
+        # an idempotent no-op server-side — its commit version would NOT
+        # be the capture floor)
+        await self.db.destroy_change_feed(self.feed_id)
+        vb = await self.db.create_change_feed(self.feed_id, WHOLE_DB_BEGIN,
+                                              WHOLE_DB_END)
         self._log_begin = vb
-        self._log_files = []        # a fresh activation: fresh file set
-        self._pulled_through = vb
+        self.log_through = vb
+        self._log_files = []
+        self._file_seq = 0
+        self._log_stopped = False
+        self.bytes_logged = 0
         await self._save_log_manifest()
         self._pull_task = asyncio.get_running_loop().create_task(
-            self._pull_loop(vb + 1), name="backup-pull")
-        TraceEvent("BackupContinuousStarted").detail("Version", vb).log()
+            self._pull_loop(), name="backup-feed-tail")
+        TraceEvent("BackupContinuousStarted").detail("Version", vb) \
+            .detail("Feed", self.feed_id).log()
         return vb
 
-    async def stop_continuous(self, drain_timeout: float = 10.0) -> None:
-        """Deactivate the tag, drain the stream through the deactivation
-        version, and release the TLogs."""
-        ve = await self._commit_tag(None)
+    async def resume_continuous(self) -> Version:
+        """Resume a killed agent from the container's durable frontier:
+        ``logs.manifest``'s ``through`` is the complete resume token —
+        the cursor re-reads nothing at or below it and skips nothing
+        above it (the feed's exactly-once contract)."""
+        if self._pull_task is not None and not self._pull_task.done():
+            raise RestoreError("continuous backup already running")
+        meta = await self.container.load_log_manifest()
+        if meta is None:
+            raise RestoreError("no mutation log to resume in container")
+        if meta.get("stopped"):
+            raise RestoreError(
+                "mutation log was cleanly stopped (its feed is destroyed); "
+                "start a fresh backup instead")
+        self._load_log_state(meta)
+        self.feed_id = bytes(meta["feed"])
+        self._log_stopped = False
+        # the feed must still exist on THIS cluster: a pull loop started
+        # against a missing feed would die with only a trace event while
+        # the caller believes capture resumed — the log would grow an
+        # uncoverable hole.  Fail loudly instead.
+        from ..client.change_feed import _feed_range
         try:
-            await asyncio.wait_for(self._drained(ve), timeout=drain_timeout)
-        except asyncio.TimeoutError:
-            TraceEvent("BackupDrainTimeout", severity=30) \
-                .detail("Through", self._pulled_through).log()
+            await _feed_range(self.db, self.feed_id)
+        except ChangeFeedNotRegistered:
+            raise RestoreError(
+                f"cannot resume: feed {self.feed_id!r} is not registered "
+                f"on this cluster (container from another cluster, or the "
+                f"feed was destroyed externally) — the mutation log has a "
+                f"hole; start a fresh backup") from None
+        self._pull_task = asyncio.get_running_loop().create_task(
+            self._pull_loop(), name="backup-feed-tail")
+        TraceEvent("BackupContinuousResumed") \
+            .detail("Through", self.log_through) \
+            .detail("Feed", self.feed_id).log()
+        return self.log_through
+
+    async def stop_continuous(self, drain_timeout: float = 10.0) -> Version:
+        """Drain the log through a fresh read version (every commit at
+        or below it is then durably in the container), stop the tail,
+        and destroy the feed so the cluster releases its retention.
+        Returns the drained frontier.
+
+        If the drain TIMES OUT the feed is NOT destroyed and the
+        manifest stays resumable: destroying it would irrecoverably
+        discard the undrained window ``(log_through, target]`` — the
+        caller can compare the returned frontier against its target and
+        ``resume_continuous`` to finish, or destroy the feed itself."""
+        if self._log_begin is None and self._pull_task is None:
+            # never started/resumed on this object: nothing to stop,
+            # and saving the manifest here would CLOBBER a crashed
+            # incarnation's resumable log state with empty defaults
+            return self.log_through
+        target = await self._grv()
+        deadline = asyncio.get_running_loop().time() + drain_timeout
+        drained = True
+        while self.log_through < target:
+            if self._pull_task is None or self._pull_task.done() \
+                    or asyncio.get_running_loop().time() > deadline:
+                drained = False
+                TraceEvent("BackupDrainTimeout", severity=30) \
+                    .detail("Through", self.log_through) \
+                    .detail("Target", target).log()
+                break
+            await asyncio.sleep(0.05)
         if self._pull_task is not None:
             self._pull_task.cancel()
             try:
@@ -111,252 +242,372 @@ class BackupAgent:
             except asyncio.CancelledError:
                 pass
             self._pull_task = None
-        if self._stream is not None:
-            # release the drained span AND the disarm version — popping
-            # past the tag's last pushed version retires it (TLog.pop's
-            # tag-tip retirement) so nothing pins the disk queue, while
-            # NOT un-pinning to MAX_VERSION, which would let a later
-            # re-activation's unpulled frames be discarded unread.
-            self._stream.pop(max(self._pulled_through, ve))
-        # persist the drained frontier: restore's coverage check reads it
+        if drained:
+            try:
+                await self.db.destroy_change_feed(self.feed_id)
+            except Exception as e:  # noqa: BLE001 — cluster may be dying
+                TraceEvent("BackupFeedDestroyFailed", severity=30) \
+                    .detail("Error", repr(e)[:200]).log()
+            self._log_stopped = True
         await self._save_log_manifest()
-        TraceEvent("BackupContinuousStopped").detail("Version", ve) \
-            .detail("PulledThrough", self._pulled_through).log()
+        await self._publish_progress(stopped=drained)
+        TraceEvent("BackupContinuousStopped") \
+            .detail("Through", self.log_through) \
+            .detail("Drained", drained).log()
+        return self.log_through
 
-    async def _drained(self, version: Version) -> None:
-        while self._pulled_through < version:
-            await asyncio.sleep(0.1)
+    async def _pull_loop(self) -> None:
+        """Tail the whole-db feed; flush entries to crc-framed .mlog
+        files; advance + persist the resume frontier only past durable
+        files; pop the feed behind the frontier.
 
-    async def _commit_tag(self, value: bytes | None) -> Version:
-        from .stream import commit_tag
-        return await commit_tag(self.db, "", value)   # "" = legacy slot
-
-    async def _pull_loop(self, begin: Version) -> None:
-        """Pull the tag through an ack-safe TagStream (never writes a
-        version a recovery could roll back) and persist it to .mlog
-        files; the stream frontier advances only past durable files
-        (rewind on a write failure)."""
-        from .stream import TagStream
-        idx = 0
-        self._stream = TagStream(self.db, BACKUP_TAG, begin)
+        Failure discipline: any error (fs write, feed poll) discards the
+        unwritten buffer and REBUILDS the cursor from ``log_through + 1``
+        — the feed re-delivers exactly the unpersisted span, so a write
+        failure can never skip or double a mutation."""
+        k = self.knobs
+        loop = asyncio.get_running_loop()
+        buf: list[tuple[Version, object]] = []
+        last_flush = loop.time()
+        last_pub = 0.0
+        cur = self.db.read_change_feed(self.feed_id,
+                                       begin_version=self.log_through + 1)
         while True:
-            entries, end = await self._stream.next()
-            if entries:
-                first, last = entries[0][0], entries[-1][0]
-                # the activation version in the name keeps re-activated
-                # backups from truncating a previous run's files out from
-                # under their manifest entries
-                name = f"{self.dir}/log-{self._log_begin}-{idx:06d}.mlog"
-                idx += 1
-                try:
-                    f = self.fs.open(name)
-                    await f.truncate(0)
-                    await f.write(0, encode([[v, list(muts)]
-                                             for v, muts in entries]))
-                    await f.sync()
-                    self._log_files.append((first, last, name))
+            try:
+                entries = await cur.next()
+                buf.extend(entries)
+                frontier = cur.version - 1
+                now = loop.time()
+                if buf and (len(buf) >= k.BACKUP_LOG_FLUSH_ENTRIES
+                            or not entries
+                            or now - last_flush
+                            >= k.BACKUP_LOG_FLUSH_INTERVAL):
+                    await self._flush(buf, frontier)
+                    buf = []
+                    last_flush = now
+                elif not buf and frontier - self.log_through \
+                        >= k.BACKUP_HEARTBEAT_VERSIONS:
+                    # quiet feed: persist the proven-empty frontier so a
+                    # resumed agent re-scans a bounded window
+                    self.log_through = frontier
                     await self._save_log_manifest()
+                    await self.db.pop_change_feed(self.feed_id,
+                                                  self.log_through)
+                if k.BACKUP_PROGRESS_PUBLISH \
+                        and now - last_pub >= k.BACKUP_PROGRESS_INTERVAL:
+                    last_pub = now
+                    await self._publish_progress()
+            except asyncio.CancelledError:
+                raise
+            except (ChangeFeedNotRegistered, ChangeFeedPopped) as e:
+                # the feed is gone (destroyed externally) or the cluster
+                # popped past our frontier — either way this tail cannot
+                # continue exactly-once; fail loudly and stop
+                TraceEvent("BackupFeedLost", severity=40) \
+                    .detail("Error", type(e).__name__) \
+                    .detail("Through", self.log_through).log()
+                return
+            except Exception as e:  # noqa: BLE001 — fs/cluster trouble:
+                # re-pull the unpersisted span through a fresh cursor
+                TraceEvent("BackupPullError", severity=30) \
+                    .detail("Error", repr(e)[:200]) \
+                    .detail("Through", self.log_through).log()
+                buf = []
+                await asyncio.sleep(0.25)
+                cur = self.db.read_change_feed(
+                    self.feed_id, begin_version=self.log_through + 1)
+
+    async def _flush(self, buf: list, frontier: Version) -> None:
+        """One durable .mlog flush: file fsync'd FIRST, then the manifest
+        (with the advanced frontier) fsync'd, then the feed popped —
+        crash between any two steps re-delivers, never loses."""
+        first, last = buf[0][0], buf[-1][0]
+        ctx = self._sampler.root(self.knobs.SERVER_SPAN_SAMPLE)
+        self.spans.event("TransactionDebug", ctx,
+                         "BackupAgent.logFile.Before",
+                         First=first, Last=last, Entries=len(buf))
+        try:
+            name, nbytes = await self.container.write_log_file(
+                first, last, self._file_seq, buf)
+            self._file_seq += 1
+            self._log_files.append((first, last, name))
+            self.bytes_logged += nbytes
+            self.log_through = max(self.log_through, frontier)
+            await self._save_log_manifest()
+            with _span.child_scope(ctx):
+                await self.db.pop_change_feed(self.feed_id, self.log_through)
+        except BaseException as e:
+            self.spans.event("TransactionDebug", ctx,
+                             "BackupAgent.logFile.Error",
+                             Error=type(e).__name__)
+            raise
+        self.spans.event("TransactionDebug", ctx,
+                         "BackupAgent.logFile.After",
+                         Through=self.log_through, Bytes=nbytes)
+
+    async def _publish_progress(self, stopped: bool = False) -> None:
+        """``\\xff/backup/progress/<name>`` state transaction: the status
+        aggregator's cluster.backup rollup reads these (frontiers, bytes,
+        liveness via at_version vs the read version)."""
+        tr = self.db.create_transaction()
+        tr.lock_aware = True
+        while True:
+            try:
+                tr.set(backup_progress_key(self.name), encode({
+                    "log_through": self.log_through,
+                    "log_begin": self._log_begin,
+                    "snapshot_version": self.last_snapshot_version,
+                    "bytes_logged": self.bytes_logged,
+                    "bytes_snapshotted": self.bytes_snapshotted,
+                    "stopped": stopped}))
+                await tr.commit()
+                return
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:  # noqa: BLE001 — retry via on_error
+                try:
+                    await tr.on_error(e)
                 except asyncio.CancelledError:
+                    # the pull task is being cancelled mid-backoff: the
+                    # cancellation is delivered ONCE — swallowing it here
+                    # would leave stop_continuous awaiting the task forever
                     raise
-                except Exception as e:  # noqa: BLE001 — fs error: retry pull
-                    TraceEvent("BackupWriteError", severity=30) \
-                        .detail("Error", repr(e)[:200]).detail("File", name) \
-                        .log()
-                    # roll back bookkeeping and the stream: the next pull
-                    # regenerates this span (replay dedupes by version if
-                    # the half-written file survived)
-                    if self._log_files and self._log_files[-1][2] == name:
-                        self._log_files.pop()
-                    self._stream.rewind(self._pulled_through)
-                    await asyncio.sleep(0.25)
-                    continue
-            # durable (or empty): the TLogs may discard what we hold
-            self._pulled_through = max(self._pulled_through, end - 1)
-            self._stream.pop(self._pulled_through)
+                except BaseException:
+                    return          # progress is best-effort observability
 
-    async def _save_log_manifest(self) -> None:
-        mf = self.fs.open(f"{self.dir}/logs.manifest")
-        await mf.truncate(0)
-        await mf.write(0, encode({
-            "begin": self._log_begin,
-            "through": self._pulled_through,
-            "files": [[b, e, n] for b, e, n in self._log_files]}))
-        await mf.sync()
-
-    # --- backup ---
+    # --- snapshot backup ---
 
     async def backup(self, begin: bytes = b"",
                      end: bytes = SYSTEM_PREFIX) -> BackupManifest:
-        """Write a consistent snapshot of [begin, end) and its manifest.
-
-        Every page is read at ONE read version (grabbed from the first
-        transaction and pinned with set_read_version on the rest), so the
-        backup is a strict cut — a transaction is either entirely in the
-        backup or entirely absent."""
+        """Write one consistent packed snapshot of [begin, end) into the
+        container (files first, manifest last).  Every page is read at
+        ONE read version, so a transaction is either entirely in the
+        snapshot or entirely absent.  A container holds many snapshots;
+        restore picks the newest at or below its target."""
         from .stream import paged_snapshot
+        await self.container.init()
         version: int | None = None
-        range_files: list[str] = []
+        files: list[str] = []
         rows = nbytes = 0
-        file_idx = 0
+        idx = 0
+        ctx = self._sampler.root(self.knobs.SERVER_SPAN_SAMPLE)
         async for page, version in paged_snapshot(self.db, begin, end,
                                                   self.rows_per_file):
             if not page:
                 break
-            name = f"{self.dir}/range-{file_idx:06d}.kv"
-            file_idx += 1
-            f = self.fs.open(name)
-            await f.truncate(0)
-            await f.write(0, encode([[bytes(k), bytes(v)] for k, v in page]))
-            await f.sync()
-            range_files.append(name)
+            self.spans.event("TransactionDebug", ctx,
+                             "BackupAgent.snapshotFile.Before",
+                             Version=version, Index=idx, Rows=len(page))
+            try:
+                name, n = await self.container.write_snapshot_page(
+                    version, idx, page)
+            except BaseException as e:
+                self.spans.event("TransactionDebug", ctx,
+                                 "BackupAgent.snapshotFile.Error",
+                                 Error=type(e).__name__)
+                raise
+            self.spans.event("TransactionDebug", ctx,
+                             "BackupAgent.snapshotFile.After",
+                             Index=idx, Bytes=n)
+            idx += 1
+            files.append(name)
             rows += len(page)
-            nbytes += sum(len(k) + len(v) for k, v in page)
-        manifest = BackupManifest(version=version or 0,
-                                  range_files=range_files, rows=rows,
-                                  bytes=nbytes)
-        mf = self.fs.open(f"{self.dir}/manifest")
-        await mf.truncate(0)
-        await mf.write(0, encode(manifest.to_wire()))
-        await mf.sync()
-        TraceEvent("BackupComplete").detail("Version", manifest.version) \
-            .detail("Rows", rows).detail("Files", len(range_files)).log()
-        return manifest
+            nbytes += n
+        await self.container.finish_snapshot(version or 0, files, rows,
+                                             nbytes)
+        self.last_snapshot_version = version or 0
+        self.bytes_snapshotted += nbytes
+        TraceEvent("BackupComplete").detail("Version", version or 0) \
+            .detail("Rows", rows).detail("Files", len(files)).log()
+        return BackupManifest(version or 0, files, rows, nbytes)
 
-    # --- restore ---
+    # --- restore-to-version ---
 
     async def restore(self, clear_first: bool = True,
                       begin: bytes = b"",
                       end: bytes = SYSTEM_PREFIX,
-                      to_version: Version | None = None) -> BackupManifest:
-        """Load the manifest and stream every range file back in through
-        transactions (idempotent sets — safe to retry).  With a mutation
-        log present, the stream in ``(snapshot_version, to_version]`` is
-        replayed on top — point-in-time restore."""
-        mf = self.fs.open(f"{self.dir}/manifest")
-        raw = await mf.read(0, mf.size())
-        if not raw:
-            raise RestoreError("no manifest in backup directory")
-        manifest = BackupManifest.from_wire(decode(raw))
-        if clear_first:
-            async def wipe(tr):
-                tr.clear_range(begin, end)
-            await self.db.run(wipe)
-        restored = 0
-        for name in manifest.range_files:
-            f = self.fs.open(name)
-            data = await f.read(0, f.size())
-            try:
-                page = decode(data)
-            except Exception as e:
-                raise RestoreError(f"corrupt range file {name}") from e
-            for start in range(0, len(page), 200):
-                chunk = page[start:start + 200]
+                      to_version: Version | None = None,
+                      resume: bool = False) -> BackupManifest:
+        """Point-in-time restore: the newest snapshot at or below the
+        target streamed in through batched commits, then the .mlog
+        window ``(snapshot_version, target]`` replayed in version order.
+        With ``to_version`` None the target is the log's drained
+        frontier (or the newest snapshot when no log exists).
 
-                async def put(tr, chunk=chunk):
-                    for k, v in chunk:
-                        tr.set(bytes(k), bytes(v))
-                await self.db.run(put)
-                restored += len(chunk)
-        if restored != manifest.rows:
-            raise RestoreError(
-                f"manifest promises {manifest.rows} rows, restored {restored}")
-        replayed = await self._replay_logs(manifest.version, to_version)
-        TraceEvent("RestoreComplete").detail("Rows", restored) \
-            .detail("Replayed", replayed).detail("ToVersion", to_version).log()
-        return manifest
-
-    # --- mutation-log replay (the PITR half of restore) ---
-
-    async def _replay_logs(self, snapshot_version: Version,
-                           to_version: Version | None) -> int:
-        """Replay logged mutations in (snapshot_version, to_version] in
-        version order.  Atomic ops re-evaluate against the restored base
-        state — the same inputs in the same order as the original
-        cluster, so the results are identical.  Each chunk's transaction
-        is guarded by a progress key: a retry after an ambiguous commit
-        sees the progress and skips, so non-idempotent atomics never
-        double-apply."""
-        mf = self.fs.open(f"{self.dir}/logs.manifest")
-        raw = await mf.read(0, mf.size())
-        if not raw:
-            if to_version is not None:
-                raise RestoreError("to_version given but no mutation log")
-            return 0
-        meta = decode(raw)
-        vt = to_version if to_version is not None else MAX_VERSION
-        if to_version is not None and meta.get("through", 0) < to_version:
-            raise RestoreError(
-                f"log covers through {meta.get('through')}, "
-                f"wanted {to_version}")
-        # lower-bound coverage: the log stream starts strictly after its
-        # activation version; if the tag was armed AFTER the snapshot was
-        # cut (or re-armed, resetting the file set), mutations in
-        # (snapshot, begin] are simply not in any file — replaying would
-        # silently produce a wrong database
-        log_begin = meta.get("begin")
-        if log_begin is None or log_begin > snapshot_version:
-            if to_version is not None:
+        Idempotent resume: every chunk (the wipe included) is fenced by
+        a restore-progress key.  A fresh call clears stale progress
+        first; ``resume=True`` instead SKIPS chunks a crashed earlier
+        run already committed — the chunk plan is deterministic from the
+        container contents, so the fence indices line up."""
+        snaps = await self.container.list_snapshots()
+        if not snaps:
+            raise RestoreError("no snapshot manifest in backup container")
+        log = await self.container.load_log_manifest()
+        if to_version is None:
+            snap = snaps[-1]
+            vt = max(snap["version"], log["through"] if log else 0)
+        else:
+            vt = to_version
+            snap = await self.container.latest_snapshot_at_or_below(vt)
+            if snap is None:
                 raise RestoreError(
-                    f"log begins at {log_begin}, after snapshot "
-                    f"{snapshot_version}: coverage hole "
-                    f"({snapshot_version}, {log_begin}]")
-            TraceEvent("RestoreLogsSkipped", severity=30) \
-                .detail("LogBegin", log_begin) \
-                .detail("SnapshotVersion", snapshot_version).log()
-            return 0
-        # a progress key left by a CRASHED earlier restore must not make
-        # this one skip chunks — clear it before replay starts
-        async def pre(tr):
-            tr.clear(RESTORE_PROGRESS_KEY)
-        await self.db.run(pre)
-        # keyed by version so a file re-written after a mid-write pull
-        # retry can overlap a predecessor without double-applying atomics
-        # (a version's mutation list is deterministic, so last-wins is
-        # also first-wins)
-        by_version: dict[int, list] = {}
-        for first, last, name in meta["files"]:
-            if last <= snapshot_version or first > vt:
-                continue
-            f = self.fs.open(name)
-            entries = decode(await f.read(0, f.size()))
-            for v, muts in entries:
-                if v <= snapshot_version or v > vt:
-                    continue
-                by_version[v] = muts
-        chunks: list[list] = [[]]
-        for v in sorted(by_version):
-            chunks[-1].extend(by_version[v])
-            if len(chunks[-1]) >= 500:
-                chunks.append([])
-        replayed = 0
-        for idx, chunk in enumerate(c for c in chunks if c):
-            async def apply(tr, idx=idx, chunk=chunk):
-                cur = await tr.get(RESTORE_PROGRESS_KEY)
-                if cur is not None and int(cur) >= idx:
-                    return
-                for m in chunk:
-                    self._replay_one(tr, m)
-                tr.set(RESTORE_PROGRESS_KEY, b"%d" % idx)
-            await self.db.run(apply)
-            replayed += len(chunk)
-        async def done(tr):
-            tr.clear(RESTORE_PROGRESS_KEY)
-        await self.db.run(done)
-        return replayed
+                    f"no snapshot at or below target {vt} "
+                    f"(earliest is {snaps[0]['version']})")
+        snap_v = snap["version"]
+        replay = vt > snap_v
+        if replay:
+            if log is None:
+                raise RestoreError("to_version given but no mutation log")
+            if log["begin"] > snap_v:
+                if to_version is not None:
+                    raise RestoreError(
+                        f"log begins at {log['begin']}, after snapshot "
+                        f"{snap_v}: coverage hole ({snap_v}, "
+                        f"{log['begin']}]")
+                TraceEvent("RestoreLogsSkipped", severity=30) \
+                    .detail("LogBegin", log["begin"]) \
+                    .detail("SnapshotVersion", snap_v).log()
+                replay = False
+                vt = snap_v
+            elif log["through"] < vt:
+                raise RestoreError(
+                    f"log covers through {log['through']}, wanted {vt}")
+
+        # the chunk plan's identity: a stored progress index is only
+        # meaningful under the SAME deterministic plan (same snapshot,
+        # same target, same wipe, same file list).  A resume against a
+        # different plan — a new to_version, a snapshot that landed
+        # since — would otherwise skip chunks whose content was never
+        # applied, silently.
+        import hashlib as _hashlib
+        plan_id = _hashlib.sha256(repr(
+            (snap_v, vt, bool(clear_first), begin, end,
+             [str(n) for n in snap["files"]])).encode()).hexdigest()[:16]
+        plan_tag = plan_id.encode()
+
+        ctx = self._sampler.root(self.knobs.SERVER_SPAN_SAMPLE)
+        self.spans.event("TransactionDebug", ctx,
+                         "BackupAgent.restore.Before",
+                         SnapshotVersion=snap_v, ToVersion=vt,
+                         Resume=resume)
+        token = _span.activate(ctx) if ctx is not None else None
+        try:
+            def parse_progress(raw) -> int:
+                """-1 unless ``raw`` carries THIS plan's fence."""
+                if raw is None:
+                    return -1
+                tag, _, n = bytes(raw).partition(b":")
+                return int(n) if tag == plan_tag and n else -1
+
+            done_idx = -1
+            if resume:
+                done_idx = parse_progress(
+                    await self.db.get(RESTORE_PROGRESS_KEY))
+            else:
+                async def pre(tr):
+                    tr.clear(RESTORE_PROGRESS_KEY)
+                await self.db.run(pre)
+            idx = 0
+
+            async def fence_run(idx, apply_ops):
+                """One fenced chunk transaction (skips when a crashed
+                run, or an ambiguous-commit retry, already did it —
+                under the same plan; a stale fence from a DIFFERENT
+                plan never skips)."""
+                async def go(tr):
+                    cur = parse_progress(
+                        await tr.get(RESTORE_PROGRESS_KEY))
+                    if cur >= idx:
+                        return
+                    apply_ops(tr)
+                    tr.set(RESTORE_PROGRESS_KEY,
+                           plan_tag + b":%d" % idx)
+                await self.db.run(go)
+
+            # chunk 0: the wipe (fenced too — a resumed restore must
+            # never re-wipe rows already restored)
+            if clear_first:
+                if idx > done_idx:
+                    await fence_run(idx, lambda tr:
+                                    tr.clear_range(begin, end))
+                idx += 1
+
+            # snapshot chunks, one page file at a time
+            restored = 0
+            for name in snap["files"]:
+                _v, rows = await self.container.read_snapshot_page(name)
+                restored += len(rows)
+                for start in range(0, len(rows), 200):
+                    chunk = rows[start:start + 200]
+                    if idx > done_idx:
+                        def put(tr, chunk=chunk):
+                            for kk, vv in chunk:
+                                tr.set(kk, vv)
+                        await fence_run(idx, put)
+                    idx += 1
+            if restored != snap["rows"]:
+                raise RestoreError(
+                    f"snapshot manifest promises {snap['rows']} rows, "
+                    f"container holds {restored}")
+
+            # mutation-log replay window (snap_v, vt]
+            replayed = 0
+            if replay:
+                by_version: dict[int, list] = {}
+                for first, last, name in log["files"]:
+                    if last <= snap_v or first > vt:
+                        continue
+                    for v, mb in await self.container.read_log_file(
+                            str(name)):
+                        if snap_v < v <= vt:
+                            # a version's shards may arrive as several
+                            # disjoint batches: CONCATENATE, never
+                            # replace
+                            by_version.setdefault(v, []).extend(
+                                mb.iter_ops())
+                chunks: list[list] = [[]]
+                for v in sorted(by_version):
+                    chunks[-1].extend(by_version[v])
+                    if len(chunks[-1]) >= 500:
+                        chunks.append([])
+                for chunk in (c for c in chunks if c):
+                    if idx > done_idx:
+                        def apply_muts(tr, chunk=chunk):
+                            for t, p1, p2 in chunk:
+                                self._replay_op(tr, t, p1, p2)
+                        await fence_run(idx, apply_muts)
+                    replayed += len(chunk)
+                    idx += 1
+
+            async def done(tr):
+                tr.clear(RESTORE_PROGRESS_KEY)
+            await self.db.run(done)
+        except BaseException as e:
+            self.spans.event("TransactionDebug", ctx,
+                             "BackupAgent.restore.Error",
+                             Error=type(e).__name__)
+            if token is not None:
+                _span.deactivate(token)
+                token = None
+            raise
+        if token is not None:
+            _span.deactivate(token)
+        self.spans.event("TransactionDebug", ctx,
+                         "BackupAgent.restore.After",
+                         Rows=restored, Replayed=replayed)
+        TraceEvent("RestoreComplete").detail("Rows", restored) \
+            .detail("Replayed", replayed).detail("ToVersion", vt) \
+            .detail("SnapshotVersion", snap_v).log()
+        return BackupManifest(snap_v, [str(n) for n in snap["files"]],
+                              snap["rows"], snap["bytes"])
 
     @staticmethod
-    def _replay_one(tr, m) -> None:
-        t = MutationType(m.type)
-        if t == MutationType.PRIVATE_DROP_SHARD:
-            return
-        if t == MutationType.CLEAR_RANGE:
-            e = min(m.param2, SYSTEM_PREFIX)
-            if m.param1 < e:
-                tr.clear_range(m.param1, e)
-            return
-        if m.param1 >= SYSTEM_PREFIX:
-            return          # the old cluster's metadata must not replay
-        if t == MutationType.SET_VALUE:
-            tr.set(m.param1, m.param2)
-        else:
-            tr.atomic_op(t, m.param1, m.param2)
+    def _replay_op(tr, t: int, p1: bytes, p2: bytes) -> None:
+        """Feed entries hold only resolved SET/CLEAR ops, clipped to the
+        user keyspace at capture; the clips here are defense in depth."""
+        if t == 1:                               # CLEAR_RANGE
+            e = min(p2, SYSTEM_PREFIX)
+            if p1 < e:
+                tr.clear_range(p1, e)
+        elif t == 0 and p1 < SYSTEM_PREFIX:      # SET_VALUE
+            tr.set(p1, p2)

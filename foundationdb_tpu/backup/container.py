@@ -1,0 +1,252 @@
+"""BackupContainer — the versioned on-disk layout of a feed-native backup.
+
+Reference: REF:fdbclient/BackupContainer.actor.cpp — a backup is a
+directory of *range files* (a consistent key-value cut, each file read
+at one pinned version) plus *mutation-log files*, described by
+manifests; restore chooses the newest snapshot at or below the target
+version and replays the log window above it.
+
+Layout (format 2, the feed-native container):
+
+- ``snap-<version>-<idx>.kvr`` — one packed snapshot page: rows stored
+  COLUMNAR as a sorted key blob + little-endian cumulative u32 bounds
+  and a value blob + bounds (the ``MutationBatch``/``GetValuesReply``
+  shape), never a per-row tuple list;
+- ``snap-<version>.manifest`` — one snapshot's file list + row/byte
+  counts (a container holds MANY snapshots; periodic backups append);
+- ``log-<first>-<last>-<seq>.mlog`` — one flush of whole-db change-feed
+  entries: ``[(version, types, bounds, blob), ...]`` packed triples,
+  exactly the retained ``MutationBatch`` columns;
+- ``logs.manifest`` — the mutation log's state: the feed id, ``begin``
+  (the feed registration version — mutations strictly above it are
+  captured), ``through`` (the durably-logged frontier, THE agent resume
+  token), and the file list;
+- ``container.manifest`` — the layout format version.
+
+Every file is a crc32-stamped frame (u32 length + u32 crc + payload):
+a torn write from a killed agent fails the checksum instead of decoding
+into garbage rows.  Manifests are written AFTER the files they name are
+synced, so a manifest never names a file whose bytes could be lost.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from array import array as _array
+
+from ..core.data import MutationBatch, Version
+from ..rpc.wire import decode, encode
+from ..runtime.errors import FdbError
+
+__all__ = ["BackupContainer", "ContainerError", "pack_rows", "unpack_rows",
+           "keyspace_digest"]
+
+CONTAINER_FORMAT = 2
+_FRAME_HDR = struct.Struct("<II")      # payload length, crc32(payload)
+
+
+class ContainerError(FdbError):
+    code = 2382
+    name = "backup_container_error"
+
+
+def _bounds_wire(bounds: "_array") -> bytes:
+    """Cumulative u32 end offsets, little-endian on disk (the
+    MutationBatch.bounds discipline)."""
+    if struct.pack("<I", 1) != struct.pack("=I", 1):
+        bounds = _array("I", bounds)
+        bounds.byteswap()
+    return bounds.tobytes()
+
+
+def keyspace_digest(rows) -> str:
+    """Canonical sha256 of a keyspace — THE byte-identity definition the
+    restore-to-version acceptance keys on, shared by the tests, the
+    bench's backup_restore stage, and the perf smoke so they can never
+    verify three different identities: length-prefixed key and value
+    bytes in key order."""
+    import hashlib
+    h = hashlib.sha256()
+    for k, v in sorted((bytes(k), bytes(v)) for k, v in rows):
+        h.update(len(k).to_bytes(4, "little") + k)
+        h.update(len(v).to_bytes(4, "little") + v)
+    return h.hexdigest()
+
+
+def pack_rows(rows: list) -> tuple[bytes, bytes, bytes, bytes]:
+    """[(key, value), ...] (sorted by key — snapshot pages arrive sorted
+    from the range read) -> (key_bounds, key_blob, val_bounds, val_blob)."""
+    kb: list[bytes] = []
+    vb: list[bytes] = []
+    ko = _array("I")
+    vo = _array("I")
+    kpos = vpos = 0
+    for k, v in rows:
+        k, v = bytes(k), bytes(v)
+        kb.append(k)
+        vb.append(v)
+        kpos += len(k)
+        vpos += len(v)
+        ko.append(kpos)
+        vo.append(vpos)
+    return _bounds_wire(ko), b"".join(kb), _bounds_wire(vo), b"".join(vb)
+
+
+def unpack_rows(ko: bytes, kblob: bytes, vo: bytes,
+                vblob: bytes) -> list[tuple[bytes, bytes]]:
+    kof = _array("I")
+    kof.frombytes(ko)
+    vof = _array("I")
+    vof.frombytes(vo)
+    if struct.pack("<I", 1) != struct.pack("=I", 1):
+        kof.byteswap()
+        vof.byteswap()
+    out: list[tuple[bytes, bytes]] = []
+    kp = vp = 0
+    for ke, ve in zip(kof, vof):
+        out.append((kblob[kp:ke], vblob[vp:ve]))
+        kp, vp = ke, ve
+    return out
+
+
+class BackupContainer:
+    """One backup directory over an async filesystem (Sim or Real)."""
+
+    def __init__(self, fs, directory: str) -> None:
+        self.fs = fs
+        self.dir = directory.rstrip("/")
+
+    def _path(self, name: str) -> str:
+        return f"{self.dir}/{name}"
+
+    # --- crc-framed file IO ---
+
+    async def _write_file(self, name: str, payload: bytes) -> int:
+        """Truncate-write one frame and fsync; returns bytes written."""
+        f = self.fs.open(self._path(name))
+        await f.truncate(0)
+        frame = _FRAME_HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        await f.write(0, frame)
+        await f.sync()
+        return len(frame)
+
+    async def _read_file(self, name: str) -> bytes:
+        f = self.fs.open(self._path(name))
+        raw = await f.read(0, f.size())
+        if len(raw) < _FRAME_HDR.size:
+            raise ContainerError(f"truncated frame in {name}")
+        length, crc = _FRAME_HDR.unpack_from(raw)
+        payload = raw[_FRAME_HDR.size:_FRAME_HDR.size + length]
+        if len(payload) != length or zlib.crc32(payload) != crc:
+            raise ContainerError(f"crc mismatch in {name}")
+        return payload
+
+    async def init(self) -> None:
+        """Stamp the container's layout format (idempotent)."""
+        f = self.fs.open(self._path("container.manifest"))
+        if f.size() > 0:
+            meta = decode(await self._read_file("container.manifest"))
+            if meta.get("format", 0) > CONTAINER_FORMAT:
+                raise ContainerError(
+                    f"container format {meta['format']} is newer than "
+                    f"this binary's {CONTAINER_FORMAT}")
+            return
+        await self._write_file("container.manifest",
+                               encode({"format": CONTAINER_FORMAT}))
+
+    # --- snapshots ---
+
+    async def write_snapshot_page(self, version: Version, idx: int,
+                                  rows: list) -> tuple[str, int]:
+        """One pinned-version page as a packed columnar file; returns
+        (file name, payload bytes)."""
+        ko, kb, vo, vb = pack_rows(rows)
+        name = f"snap-{version:020d}-{idx:06d}.kvr"
+        n = await self._write_file(name, encode(
+            {"v": int(version), "n": len(rows),
+             "ko": ko, "kb": kb, "vo": vo, "vb": vb}))
+        return name, n
+
+    async def read_snapshot_page(self, name: str
+                                 ) -> tuple[Version, list]:
+        rec = decode(await self._read_file(name))
+        rows = unpack_rows(bytes(rec["ko"]), bytes(rec["kb"]),
+                           bytes(rec["vo"]), bytes(rec["vb"]))
+        if len(rows) != rec["n"]:
+            raise ContainerError(f"row count mismatch in {name}")
+        return rec["v"], rows
+
+    async def finish_snapshot(self, version: Version, files: list[str],
+                              rows: int, nbytes: int) -> dict:
+        """Write the snapshot's manifest (the snapshot becomes visible to
+        restore only now — files first, manifest last)."""
+        meta = {"version": int(version), "files": list(files),
+                "rows": int(rows), "bytes": int(nbytes)}
+        await self._write_file(f"snap-{version:020d}.manifest", encode(meta))
+        return meta
+
+    async def list_snapshots(self) -> list[dict]:
+        """Every completed snapshot's manifest, oldest first."""
+        out: list[dict] = []
+        prefix = self._path("snap-")
+        for p in self.fs.listdir(prefix):
+            if not p.endswith(".manifest"):
+                continue
+            name = p[len(self.dir) + 1:]
+            try:
+                out.append(decode(await self._read_file(name)))
+            except Exception:  # noqa: BLE001 — torn manifest: not a snapshot
+                continue
+        out.sort(key=lambda m: m["version"])
+        return out
+
+    async def latest_snapshot_at_or_below(self, target: Version
+                                          ) -> dict | None:
+        best = None
+        for m in await self.list_snapshots():
+            if m["version"] <= target:
+                best = m
+        return best
+
+    # --- mutation log ---
+
+    async def write_log_file(self, first: Version, last: Version, seq: int,
+                             entries: list) -> tuple[str, int]:
+        """One flush of cursor entries [(version, MutationBatch)] as one
+        crc frame of packed triples; returns (name, payload bytes)."""
+        name = f"log-{first:020d}-{last:020d}-{seq:06d}.mlog"
+        n = await self._write_file(name, encode(
+            {"e": [(int(v), b.types, b.bounds, b.blob)
+                   for v, b in entries]}))
+        return name, n
+
+    async def read_log_file(self, name: str
+                            ) -> list[tuple[Version, MutationBatch]]:
+        rec = decode(await self._read_file(name))
+        return [(v, MutationBatch(bytes(t), bytes(bo), bytes(bl)))
+                for v, t, bo, bl in rec["e"]]
+
+    async def save_log_manifest(self, meta: dict) -> None:
+        await self._write_file("logs.manifest", encode(meta))
+
+    async def load_log_manifest(self) -> dict | None:
+        if self.fs.open(self._path("logs.manifest")).size() == 0:
+            return None             # absent: no mutation log
+        return decode(await self._read_file("logs.manifest"))
+
+    # --- observability / tools ---
+
+    async def describe(self) -> dict:
+        snaps = await self.list_snapshots()
+        log = await self.load_log_manifest()
+        return {
+            "format": CONTAINER_FORMAT,
+            "snapshots": [{"version": m["version"], "rows": m["rows"],
+                           "bytes": m["bytes"], "files": len(m["files"])}
+                          for m in snaps],
+            "log_begin": log and log.get("begin"),
+            "log_through": log and log.get("through"),
+            "log_files": len(log["files"]) if log else 0,
+            "log_bytes": (log or {}).get("bytes", 0),
+        }

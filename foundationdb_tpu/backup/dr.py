@@ -27,12 +27,14 @@ from ..core.data import SYSTEM_PREFIX, Version
 from ..rpc.wire import encode
 from ..runtime.errors import FdbError
 from ..runtime.trace import TraceEvent
-from .agent import BACKUP_TAG, BackupAgent
 from .stream import TagStream
 
-# the DR feed's well-known tag, distinct from the file-backup tag so both
-# streams run concurrently
-DR_TAG = BACKUP_TAG + 1
+# the DR feed's well-known mutation-log tag, far above any storage tag
+# DataDistribution will ever allocate (DD uses max(existing tag)+1).
+# Offset +1 preserves the historical numbering from when the file backup
+# owned 1<<20 — the feed-native backup (agent.py) no longer uses a
+# proxy-side tag at all, so DR is the raw tag stream's only client.
+DR_TAG = (1 << 20) + 1
 APPLIED_KEY = b"\xff/dr/applied"        # on the DESTINATION
 DRAIN_KEY = b"\xff/dr/marker"           # on the SOURCE
 
@@ -40,6 +42,28 @@ DRAIN_KEY = b"\xff/dr/marker"           # on the SOURCE
 class DrError(FdbError):
     code = 2381
     name = "dr_error"
+
+
+def _replay_mutation(tr, m) -> None:
+    """Replay one RAW tag-stream mutation on the destination: atomics
+    re-evaluate against the destination's state — same inputs in the
+    same order as the source, so the results are identical.  Private
+    markers and the source's system metadata never replay."""
+    from ..core.data import PRIVATE_TYPES, MutationType
+    t = MutationType(m.type)
+    if t in PRIVATE_TYPES:
+        return
+    if t == MutationType.CLEAR_RANGE:
+        e = min(m.param2, SYSTEM_PREFIX)
+        if m.param1 < e:
+            tr.clear_range(m.param1, e)
+        return
+    if m.param1 >= SYSTEM_PREFIX:
+        return
+    if t == MutationType.SET_VALUE:
+        tr.set(m.param1, m.param2)
+    else:
+        tr.atomic_op(t, m.param1, m.param2)
 
 
 class DRAgent:
@@ -257,7 +281,7 @@ class DRAgent:
                 if v <= applied:
                     continue
                 for m in muts:
-                    BackupAgent._replay_one(tr, m)
+                    _replay_mutation(tr, m)
             tr.set(APPLIED_KEY, b"%d" % last)
         await self.dest.run(apply)
 

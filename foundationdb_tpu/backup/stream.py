@@ -35,9 +35,11 @@ consumer persisting it as "applied through" can never skip real commits
 that land numerically below a rolled-back peek tip.
 
 Used by the DR agent and the LogRouter (so every router consumer
-inherits safety).  The file-backup agent keeps its own *file* bookkeeping
-but pulls through TagStream too (``rewind`` covers its
-no-advance-on-write-failure semantics).  The arm/disarm state transaction
+inherits safety).  The file-backup agent no longer pulls a tag at all —
+since ISSUE 8 it tails a whole-database CHANGE FEED whose cursor
+provides the same ack-safety through the known-committed heartbeat
+clamp (see backup/agent.py); TagStream remains the raw-tag path for
+cluster-to-cluster DR.  The arm/disarm state transaction
 (`commit_tag`) is shared by every tag producer.
 """
 

@@ -32,10 +32,20 @@ async def run_tpcc_neworder(knobs: Knobs, n_warehouses: int = 2,
                             districts_per_wh: int = 10, n_items: int = 1000,
                             duration_s: float = 3.0, n_clients: int = 32,
                             hot_district_frac: float = 0.5, device=None,
-                            seed: int = 23, warmup_s: float = 2.0) -> dict:
+                            seed: int = 23, warmup_s: float = 2.0,
+                            district_tag: str | None = None) -> dict:
     """Load a small TPC-C schema, then run concurrent NewOrder loops.
     ``hot_district_frac`` of transactions target district (1,1) — the
-    hotspot the baseline calls for."""
+    hotspot the baseline calls for.
+
+    ``district_tag`` (ISSUE 8 satellite; PR 7 follow-up (d)): tag every
+    hot-district NewOrder with a GRV throttle tag.  The district
+    hotspot is WRITE-CONTENTION on a single key (next_o_id) — heat
+    splits cannot help it (same key, same resolver conflict); only
+    admission can, and the ratekeeper's heat clamp needs a dominant tag
+    to shed.  The reply then carries the ratekeeper's
+    heat-throttle activation count so the bench can record the clamp's
+    abort-rate effect."""
     cluster = Cluster(ClusterConfig(), knobs, device=device)
     cluster.start()
     rng = DeterministicRandom(seed)
@@ -74,6 +84,9 @@ async def run_tpcc_neworder(knobs: Knobs, n_warehouses: int = 2,
             else:
                 w = lr.random_int(1, n_warehouses)
                 d = lr.random_int(1, districts_per_wh)
+            # the hot tenant self-identifies at GRV admission; cold
+            # districts ride the untagged default lane
+            tr.throttle_tag = district_tag if (w, d) == (1, 1) else None
             n_lines = lr.random_int(5, 15)
             items = [lr.random_int(1, n_items) for _ in range(n_lines)]
             t0 = time.perf_counter()
@@ -124,6 +137,9 @@ async def run_tpcc_neworder(knobs: Knobs, n_warehouses: int = 2,
     await asyncio.gather(*(client(i) for i in range(n_clients)))
     t0 = await timer
     elapsed = time.perf_counter() - t0
+    rk = getattr(cluster, "ratekeeper", None)
+    heat_activations = getattr(rk, "heat_throttle_activations", 0)
+    heat_tags = sorted(getattr(rk, "heat_tag_rates", {}) or {})
     await cluster.stop()
     abort_rate = aborts / max(1, done + aborts)
     # livelock detection: when nearly every NewOrder aborts, "tpmC" is an
@@ -143,6 +159,9 @@ async def run_tpcc_neworder(knobs: Knobs, n_warehouses: int = 2,
         **latency_ms(latencies, (50, 99)),
         "elapsed_s": elapsed,
         "n_clients": n_clients,
+        "district_tag": district_tag,
+        "heat_throttle_activations": heat_activations,
+        "heat_throttled_tags": heat_tags,
     }
 
 

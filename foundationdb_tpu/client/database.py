@@ -8,6 +8,7 @@ transaction, commit, and loop through ``on_error`` on retryable failures.
 
 from __future__ import annotations
 
+import asyncio
 from typing import Any, Awaitable, Callable
 
 from ..core.cluster import Cluster
@@ -67,10 +68,12 @@ class Database:
 
     # --- change feeds (ISSUE 4; see client/change_feed.py) ---
 
-    async def create_change_feed(self, feed_id: bytes, begin: bytes,
-                                 end: bytes) -> Version:
+    async def create_change_feed(self, feed_id: bytes, begin: bytes = b"",
+                                 end: bytes = b"\xff") -> Version:
         """Register a feed over [begin, end); returns the registration's
-        commit version (mutations strictly above it flow in)."""
+        commit version (mutations strictly above it flow in).  The
+        default range is the WHOLE user keyspace, \\xff-exclusive
+        (ISSUE 8): system writes never enter a feed."""
         from .change_feed import create_change_feed
         return await create_change_feed(self, feed_id, begin, end)
 
@@ -90,3 +93,71 @@ class Database:
         already-processed versions; pass 0 to start from registration)."""
         from .change_feed import ChangeFeedCursor
         return ChangeFeedCursor(self, feed_id, begin_version, begin, end)
+
+    # --- feed-native backup / point-in-time restore (ISSUE 8) ---
+
+    def _backup_agents(self) -> dict:
+        agents = getattr(self, "_backup_agents_by_dir", None)
+        if agents is None:
+            agents = self._backup_agents_by_dir = {}
+        return agents
+
+    async def start_backup(self, fs, directory: str,
+                           snapshot: bool = True):
+        """Start a feed-native backup into ``directory`` on ``fs``: arm
+        the whole-database change-feed tail (the continuous mutation
+        log) and, with ``snapshot``, write an initial consistent
+        snapshot under it.  Returns the BackupAgent (kept on this
+        handle for stop_backup).  A container holding a prior agent's
+        mutation log is RESUMED exactly-once from its durable frontier
+        instead of restarted."""
+        from ..backup.agent import BackupAgent
+        agent = BackupAgent(self, fs, directory)
+        meta = await agent.container.load_log_manifest()
+        if meta is not None and not meta.get("stopped", False):
+            await agent.resume_continuous()
+        else:
+            await agent.start_continuous()
+        # registered BEFORE the snapshot so a failed snapshot never
+        # leaves a running tail the API cannot reach
+        self._backup_agents()[agent.dir] = agent
+        if snapshot:
+            try:
+                await agent.backup()
+            except BaseException:
+                # unwind the tail WITHOUT destroying the feed or the
+                # manifest: the container stays resumable (a retry of
+                # start_backup resumes it exactly-once) and the feed's
+                # retention is released then — destroying here would
+                # hole a resumed log irrecoverably
+                if agent._pull_task is not None:
+                    agent._pull_task.cancel()
+                    try:
+                        await agent._pull_task
+                    except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                        pass
+                    agent._pull_task = None
+                self._backup_agents().pop(agent.dir, None)
+                raise
+        return agent
+
+    async def stop_backup(self, directory: str,
+                          drain_timeout: float = 10.0) -> Version:
+        """Drain and stop the backup running into ``directory``;
+        returns the drained log frontier (every commit at or below it
+        is durably in the container)."""
+        agent = self._backup_agents().get(directory.rstrip("/"))
+        if agent is None:
+            from ..backup.agent import RestoreError
+            raise RestoreError(f"no backup running into {directory!r}")
+        return await agent.stop_continuous(drain_timeout=drain_timeout)
+
+    async def restore(self, fs, directory: str,
+                      to_version: Version | None = None,
+                      resume: bool = False):
+        """Point-in-time restore from the container in ``directory``:
+        the newest snapshot at or below ``to_version`` plus the .mlog
+        replay window above it (see BackupAgent.restore)."""
+        from ..backup.agent import BackupAgent
+        agent = BackupAgent(self, fs, directory)
+        return await agent.restore(to_version=to_version, resume=resume)

@@ -34,10 +34,21 @@ from __future__ import annotations
 import bisect
 import dataclasses
 
-from .data import KeyRange, MutationBatch, Version
+from .data import SYSTEM_PREFIX, KeyRange, MutationBatch, Version
 
 __all__ = ["ChangeFeedStreamRequest", "ChangeFeedStreamReply",
-           "FeedState", "ChangeFeedStore"]
+           "FeedState", "ChangeFeedStore",
+           "WHOLE_DB_BEGIN", "WHOLE_DB_END"]
+
+# Whole-database feeds (ISSUE 8): a feed may cover the ENTIRE user
+# keyspace, \xff-exclusively — the backbone of the feed-native backup.
+# System writes are excluded at capture (every feed range ends at or
+# below \xff and capture clips to it), registration/pop/destroy markers
+# route to ALL current owners through the proxy's tags_for_range over
+# the live shard map, and DD splits/moves keep routing via the
+# fetch_feed_state handoff exactly as for ranged feeds.
+WHOLE_DB_BEGIN: bytes = b""
+WHOLE_DB_END: bytes = SYSTEM_PREFIX
 
 
 @dataclasses.dataclass
@@ -239,8 +250,14 @@ class ChangeFeedStore:
 
     def register(self, feed_id: bytes, begin: bytes, end: bytes,
                  version: Version) -> None:
-        """Idempotent: a re-delivered marker (recovery replay) is a no-op."""
+        """Idempotent: a re-delivered marker (recovery replay) is a no-op.
+        The range is clamped \\xff-exclusive — system writes must never
+        enter a feed even if a forged/corrupt registration names them
+        (the client and proxy already enforce this; defense in depth)."""
         if feed_id in self.feeds:
+            return
+        end = min(end, SYSTEM_PREFIX)
+        if begin >= end:
             return
         self.feeds[feed_id] = FeedState(feed_id, begin, end, version)
 

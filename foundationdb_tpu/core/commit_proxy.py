@@ -209,6 +209,12 @@ class CommitProxy:
                     TraceEvent("ProxyBadFeed", severity=30) \
                         .detail("Error", repr(e)[:100]).log()
                     continue
+                # clamp \xff-exclusive (whole-db feeds cover exactly the
+                # user keyspace; a forged registration must not make a
+                # feed observe system writes)
+                fe = min(fe, SYSTEM_PREFIX)
+                if fb >= fe:
+                    continue
                 if fid not in self._feeds:  # re-register is idempotent
                     self._feeds[fid] = (fb, fe)
                     for t in self._maps[-1][1].tags_for_range(fb, fe):

@@ -93,6 +93,14 @@ class DataDistributor:
         # operator/workload-requested relocations (RandomMoveKeys): shard
         # indices to move onto fresh teams, drained one per round
         self._move_requests: list[int] = []
+        # relocation spans (PR 2 follow-up (c)): DD never runs inside a
+        # sampled transaction, so each relocation roots its own
+        # deterministic server-side span — trace_tool then shows a slow
+        # move as one DataDistributor.relocate span bracketing the
+        # destinations' fetchKeys spans
+        from ..runtime import span as span_mod
+        self.spans = span_mod.SpanSink("DataDistributor")
+        self._span_sampler = span_mod.ServerSampler(namespace=3)
         # heat-driven relocation state (ISSUE 7): consecutive-hot-round
         # streaks per shard range (hysteresis), a post-relocation
         # cooldown deadline, and the counters the dd_stats publish
@@ -392,6 +400,33 @@ class DataDistributor:
                         next_tag: int, split_key: bytes | None = None,
                         engine: str | None = None,
                         heat: str | None = None) -> None:
+        """Span wrapper around the relocation protocol: paired
+        Before/After (or .Error) events plus the activated context, so
+        the destinations' fetchKeys and the move's state transactions
+        group into one timeline in the trace file."""
+        from ..runtime import span as span_mod
+        ctx = self._span_sampler.root(self.knobs.SERVER_SPAN_SAMPLE)
+        before = self.live_moves_done
+        self.spans.event("TransactionDebug", ctx,
+                         "DataDistributor.relocate.Before",
+                         Shard=idx, SplitKey=split_key, Heat=heat)
+        try:
+            with span_mod.child_scope(ctx):
+                await self._relocate_inner(state, layout, idx, next_tag,
+                                           split_key, engine, heat)
+        except BaseException as e:
+            self.spans.event("TransactionDebug", ctx,
+                             "DataDistributor.relocate.Error",
+                             Shard=idx, Error=type(e).__name__)
+            raise
+        self.spans.event("TransactionDebug", ctx,
+                         "DataDistributor.relocate.After",
+                         Shard=idx, Moved=self.live_moves_done > before)
+
+    async def _relocate_inner(self, state: dict, layout: dict, idx: int,
+                              next_tag: int, split_key: bytes | None = None,
+                              engine: str | None = None,
+                              heat: str | None = None) -> None:
         """Live-relocate shard ``idx``: with ``split_key`` the suffix
         [split_key, end) moves to a fresh team (a split); without, the
         WHOLE shard moves (manual move / engine migration).  ``engine``

@@ -252,6 +252,53 @@ async def cluster_status(knobs: Knobs, transport: Transport,
         "last_heat_rw_per_sec": dd_stats.get("last_heat_rw_per_sec", 0.0),
     }
 
+    # backup rollup (ISSUE 8): each running feed-native backup agent
+    # publishes \xff/backup/progress/<name> state transactions; read
+    # them back through an ordinary snapshot transaction so status
+    # reports snapshot/log frontiers, lag vs the committed version
+    # (the GRV the read itself pinned), bytes written, and liveness —
+    # without the agents needing an RPC surface.  Best-effort: a
+    # cluster that cannot serve reads degrades to an empty rollup.
+    backup_rollup: dict = {"agents": [], "active": 0}
+    try:
+        from ..rpc.wire import decode as _decode
+        from .cluster_client import RecoveredClusterView, RefreshingDatabase
+        from .system_data import BACKUP_PROGRESS_PREFIX
+        view = RecoveredClusterView(knobs, transport, state)
+        bdb = RefreshingDatabase(view, coordinators)
+        tr = bdb.create_transaction()
+        tr.lock_aware = True
+        now_version = await asyncio.wait_for(tr.get_read_version(),
+                                             timeout=t)
+        rows = await asyncio.wait_for(
+            tr.get_range(BACKUP_PROGRESS_PREFIX,
+                         BACKUP_PROGRESS_PREFIX + b"\xff",
+                         limit=100, snapshot=True), timeout=t)
+        agents = []
+        for k, v in rows:
+            try:
+                rec = _decode(bytes(v))
+            except Exception:  # noqa: BLE001 — torn progress blob
+                continue
+            name = bytes(k)[len(BACKUP_PROGRESS_PREFIX):].decode(
+                errors="replace")
+            through = rec.get("log_through") or 0
+            agents.append({
+                "name": name,
+                "snapshot_version": rec.get("snapshot_version"),
+                "log_through": through,
+                "lag_versions": max(0, now_version - through),
+                "bytes_logged": rec.get("bytes_logged", 0),
+                "bytes_snapshotted": rec.get("bytes_snapshotted", 0),
+                "stopped": bool(rec.get("stopped", False)),
+            })
+        backup_rollup = {
+            "agents": agents,
+            "active": sum(1 for a in agents if not a["stopped"]),
+        }
+    except Exception:   # noqa: BLE001 — partial status beats none
+        pass
+
     # distributed-tracing rollup (ISSUE 2): every metric-bearing role
     # reports its span counters; sampled_txns comes from the GRV proxies
     # (where every sampled root first crosses the wire).  SERVER-side
@@ -283,6 +330,7 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             "device_reads": device_reads_rollup,
             "shard_heat": shard_heat_rollup,
             "hot_moves": hot_moves_rollup,
+            "backup": backup_rollup,
             "tracing": tracing_rollup,
         },
         "roles": roles,

@@ -19,7 +19,7 @@ from ..runtime.errors import FutureVersion, TransactionTooOld
 from ..runtime.knobs import Knobs
 from ..runtime.latency_probe import StageStats
 from ..runtime.profiler import RateMeter
-from ..runtime.span import SpanSink, current_span
+from ..runtime.span import SpanSink, child_scope, current_span
 from ..runtime.trace import Severity, TraceEvent, get_trace_log
 from ..storage.kv_store import OP_CLEAR, OP_SET
 from ..storage.packed_ops import DurabilityRing
@@ -319,58 +319,83 @@ class StorageServer:
         from ..runtime.trace import TraceEvent
         b, e, v = self.shard.begin, self.shard.end, self._fetch_version
         rows_total = 0
-        while True:
-            try:
-                kvs, more = await self._fetch_src.get_key_values(
-                    b, e, v, 1000)
-            except FdbError as err:
-                from ..runtime.errors import TransactionTooOld as _TooOld
-                if isinstance(err, _TooOld):
-                    # the snapshot version aged out of the source's MVCC
-                    # window before the fetch finished: this destination
-                    # cannot be completed exactly — fail the fetch and let
-                    # the data distributor abort the move and retry with a
-                    # fresh destination (the reference instead restarts
-                    # fetchKeys at a newer version; our moves are
-                    # all-or-nothing per attempt)
-                    self._fetch_failed = True
-                    TraceEvent("FetchKeysTooOld", severity=30) \
-                        .detail("Tag", self.tag).detail("Version", v).log()
-                    return
-                if err.retryable:
-                    await asyncio.sleep(0.1)
-                    continue
-                raise
-            page: list[tuple[Version, int, bytes, bytes]] = []
-            for k, val in kvs:
-                k, val = bytes(k), bytes(val)
-                page.append((v, OP_SET, k, val))
-                self.logical_bytes += len(k) + len(val)
-                if self.engine is not None:
-                    self._dbuf.append(v, OP_SET, k, val)
-            self.vmap.apply_batch(page)    # one index merge per page
-            rows_total += len(kvs)
-            if not more or not kvs:
-                break
-            b = bytes(kvs[-1][0]) + b"\x00"
-        # change-feed handoff rides fetchKeys (ISSUE 4): the source
-        # exports every overlapping feed's registration + retained
-        # window at the fetch version; entries above it arrive through
-        # this server's own tag pull, which is still gated on
-        # _fetch_done — so registration lands before any capture could
-        # miss.  Same retry discipline as the row pages.
-        while True:
-            try:
-                exported = await self._fetch_src.fetch_feed_state(
-                    self.shard.begin, self.shard.end, v)
-            except FdbError as err:
-                if err.retryable:
-                    await asyncio.sleep(0.1)
-                    continue
-                raise
-            self.feeds.install(exported)
-            break
+        # span the whole move-destination fetch (PR 2 follow-up (c)): a
+        # slow restore/relocation shows up as one fetchKeys span per
+        # destination in the trace file, paired Before/After(.Error),
+        # with the source page reads riding the activated context
+        span_ctx = self._server_sampler.root(self.knobs.SERVER_SPAN_SAMPLE)
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.fetchKeys.Before",
+                         Tag=self.tag, Begin=b, End=e, Version=v)
+        try:
+            with child_scope(span_ctx):
+                while True:
+                    try:
+                        kvs, more = await self._fetch_src.get_key_values(
+                            b, e, v, 1000)
+                    except FdbError as err:
+                        from ..runtime.errors import \
+                            TransactionTooOld as _TooOld
+                        if isinstance(err, _TooOld):
+                            # the snapshot version aged out of the source's
+                            # MVCC window before the fetch finished: this
+                            # destination cannot be completed exactly —
+                            # fail the fetch and let the data distributor
+                            # abort the move and retry with a fresh
+                            # destination (the reference instead restarts
+                            # fetchKeys at a newer version; our moves are
+                            # all-or-nothing per attempt)
+                            self._fetch_failed = True
+                            TraceEvent("FetchKeysTooOld", severity=30) \
+                                .detail("Tag", self.tag) \
+                                .detail("Version", v).log()
+                            self.spans.event(
+                                "TransactionDebug", span_ctx,
+                                "StorageServer.fetchKeys.Error",
+                                Tag=self.tag, Error="TransactionTooOld")
+                            return
+                        if err.retryable:
+                            await asyncio.sleep(0.1)
+                            continue
+                        raise
+                    page: list[tuple[Version, int, bytes, bytes]] = []
+                    for k, val in kvs:
+                        k, val = bytes(k), bytes(val)
+                        page.append((v, OP_SET, k, val))
+                        self.logical_bytes += len(k) + len(val)
+                        if self.engine is not None:
+                            self._dbuf.append(v, OP_SET, k, val)
+                    self.vmap.apply_batch(page)  # one index merge per page
+                    rows_total += len(kvs)
+                    if not more or not kvs:
+                        break
+                    b = bytes(kvs[-1][0]) + b"\x00"
+                # change-feed handoff rides fetchKeys (ISSUE 4): the source
+                # exports every overlapping feed's registration + retained
+                # window at the fetch version; entries above it arrive
+                # through this server's own tag pull, which is still gated
+                # on _fetch_done — so registration lands before any capture
+                # could miss.  Same retry discipline as the row pages.
+                while True:
+                    try:
+                        exported = await self._fetch_src.fetch_feed_state(
+                            self.shard.begin, self.shard.end, v)
+                    except FdbError as err:
+                        if err.retryable:
+                            await asyncio.sleep(0.1)
+                            continue
+                        raise
+                    self.feeds.install(exported)
+                    break
+        except BaseException as err:
+            self.spans.event("TransactionDebug", span_ctx,
+                             "StorageServer.fetchKeys.Error",
+                             Tag=self.tag, Error=type(err).__name__)
+            raise
         self._fetch_done.set()
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.fetchKeys.After",
+                         Tag=self.tag, Rows=rows_total, Version=v)
         TraceEvent("FetchKeysComplete").detail("Tag", self.tag) \
             .detail("Rows", rows_total).detail("Version", v).log()
 

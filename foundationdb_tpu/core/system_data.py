@@ -58,6 +58,18 @@ def backup_tag_key(name: str) -> bytes:
         else BACKUP_TAGS_PREFIX + name.encode()
 
 
+# feed-native backup progress (ISSUE 8): each running backup agent
+# periodically writes \xff/backup/progress/<name> ->
+# encode({snapshot_version, log_through, bytes, at_version, stopped}) so
+# ``cluster.backup`` in status can report snapshot/log frontiers, lag vs
+# the committed version, and agent liveness without an agent RPC surface
+BACKUP_PROGRESS_PREFIX = BACKUP_PREFIX + b"progress/"
+
+
+def backup_progress_key(name: str) -> bytes:
+    return BACKUP_PROGRESS_PREFIX + name.encode()
+
+
 def decode_backup_tags(rows: list[tuple[bytes, bytes]]) -> dict[str, int]:
     """All armed mutation-log tags from a \\xff range read."""
     from ..rpc.wire import decode

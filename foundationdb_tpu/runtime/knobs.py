@@ -144,6 +144,23 @@ class Knobs:
     CLIENT_RANGE_CHUNK_ROWS: int = 128
     CLIENT_RANGE_CHUNK_BYTES: int = 1 << 20
 
+    # --- backup / point-in-time restore (ISSUE 8) ---
+    # feed-native backup: the agent tails a WHOLE-DATABASE change feed
+    # through ChangeFeedCursor (begin_version is the complete resume
+    # token) and persists packed .mlog files into a BackupContainer.
+    # None of these change cluster behavior unless an agent is running.
+    BACKUP_LOG_FLUSH_ENTRIES: int = 2048      # feed entries per .mlog flush
+    BACKUP_LOG_FLUSH_INTERVAL: float = 0.25   # max seconds entries sit unflushed
+    # a quiet feed still advances the durable resume frontier once the
+    # heartbeat has proven this many versions empty (bounds the resume
+    # re-scan after an agent crash on an idle database)
+    BACKUP_HEARTBEAT_VERSIONS: int = 1_000_000
+    # periodic \xff/backup/progress/<name> state transactions so status
+    # (cluster.backup) sees snapshot/log frontiers + agent liveness
+    BACKUP_PROGRESS_PUBLISH: bool = True
+    BACKUP_PROGRESS_INTERVAL: float = 1.0
+    BACKUP_SNAPSHOT_ROWS: int = 1000          # rows per packed snapshot file
+
     # --- transaction limits (REF:fdbclient/ClientKnobs, Limits in docs) ---
     KEY_SIZE_LIMIT: int = 10_000
     VALUE_SIZE_LIMIT: int = 100_000

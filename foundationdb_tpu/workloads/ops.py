@@ -58,15 +58,12 @@ class BackupUnderAttritionWorkload(TestWorkload):
         if self.agent is None:
             return True
         from ..core.data import SYSTEM_PREFIX
-        from ..rpc.wire import decode
         await self.agent.stop_continuous()
         manifest = await self.agent.backup()     # final quiescent snapshot
         rows = []
         for name in manifest.range_files:
-            f = self.agent.fs.open(name)
-            rows.extend((bytes(k), bytes(v))
-                        for k, v in decode(await f.read(0, f.size())))
-            await f.close()
+            _v, page = await self.agent.container.read_snapshot_page(name)
+            rows.extend((bytes(k), bytes(v)) for k, v in page)
         tr = self.db.create_transaction()
         while True:
             try:

@@ -254,6 +254,108 @@ def test_client_cannot_forge_private_markers():
     run_simulation(main())
 
 
+# --- whole-database feeds (ISSUE 8) ---
+
+def test_proxy_routes_whole_db_feed_to_all_tags():
+    """A whole-db registration (the backup feed's shape) routes its
+    register/pop/destroy markers to EVERY current owner — and keeps
+    routing to the post-split owners after a layout change."""
+    from foundationdb_tpu.core.system_data import (LAYOUT_KEY,
+                                                   change_feed_pop_key)
+    from foundationdb_tpu.rpc.wire import encode
+    p = _proxy()
+    markers = p._apply_metadata(10, [_reg_mut(b"whole", b"", b"\xff")])
+    assert sorted(m[0] for m in markers) == [0, 1, 2, 3]
+    assert all(m[1] == int(MutationType.PRIVATE_FEED_REGISTER)
+               for m in markers)
+    # split shard 0; the pop must reach the NEW owner too
+    layout = {"boundaries": [b"\x20", b"\x40", b"\x80", b"\xc0"],
+              "teams": [[0], [9], [1], [2], [3]]}
+    p._apply_metadata(11, [Mutation.set(LAYOUT_KEY, encode(layout))])
+    markers = p._apply_metadata(12, [Mutation.set(
+        change_feed_pop_key(b"whole"), encode(11))])
+    assert sorted(m[0] for m in markers) == [0, 1, 2, 3, 9]
+
+
+def test_proxy_clamps_forged_feed_range_to_user_keyspace():
+    """A forged registration spanning past \\xff must clamp
+    \\xff-exclusive (feeds may never observe system writes), and one
+    living entirely in system space registers nothing."""
+    p = _proxy()
+    markers = p._apply_metadata(10, [_reg_mut(b"forged", b"",
+                                              b"\xff\xff\xff")])
+    assert p._feeds[b"forged"] == (b"", b"\xff")
+    assert markers
+    assert p._apply_metadata(11, [_reg_mut(b"sys", b"\xff/a",
+                                           b"\xff/b")]) == []
+    assert b"sys" not in p._feeds
+
+
+def test_whole_db_capture_excludes_system_writes():
+    """A storage server owning the system range still captures ONLY
+    user keys into a whole-db feed — system writes are excluded at
+    capture, and a clear spanning into \\xff space is clipped."""
+    async def main():
+        st = ChangeFeedStore()
+        st.register(b"w", b"", b"\xff", 0)
+        st.capture(5, batch(Mutation.set(b"user1", b"u"),
+                            Mutation.set(b"\xff/conf/x", b"sys"),
+                            Mutation.set(b"\xff\xff/status", b"sys2")),
+                   shard=KeyRange(b"", b"\xff\xff\xff"))
+        st.capture(6, batch(Mutation.clear_range(b"zz", b"\xff\xff")),
+                   shard=KeyRange(b"", b"\xff\xff\xff"))
+        entries, _ = await st.read(b"w", 1, 0, 100)
+        flat = [(v, m.type, m.param1, m.param2)
+                for v, b in entries for m in b]
+        assert flat == [
+            (5, MutationType.SET_VALUE, b"user1", b"u"),
+            (6, MutationType.CLEAR_RANGE, b"zz", b"\xff"),
+        ]
+        # a forged over-wide registration clamps at the store too
+        st2 = ChangeFeedStore()
+        st2.register(b"forged", b"", b"\xff\xff\xff", 0)
+        assert st2.feeds[b"forged"].range.end == b"\xff"
+        st2.register(b"sys", b"\xff/a", b"\xff/b", 0)
+        assert b"sys" not in st2.feeds
+    asyncio.run(main())
+
+
+def test_whole_db_feed_end_to_end_with_system_traffic():
+    """A whole-db cursor over a live cluster sees every user mutation
+    exactly once and NO system keys, even while system writes (feed
+    lifecycle, layout-ish state transactions) flow concurrently."""
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs()) as cluster:
+            db = Database(cluster)
+            v0 = await db.create_change_feed(b"wdb")   # whole-db default
+            committed = []
+            for i in range(5):
+                tr = db.create_transaction()
+                while True:
+                    try:
+                        tr.set(b"u%02d" % i, b"v%d" % i)
+                        committed.append((b"u%02d" % i, await tr.commit()))
+                        break
+                    except BaseException as e:
+                        await tr.on_error(e)
+                # interleave a system write (another feed's lifecycle)
+                await db.create_change_feed(b"other%d" % i, b"q", b"r")
+            tip = max(v for _k, v in committed)
+            cur = db.read_change_feed(b"wdb")
+            loop = asyncio.get_running_loop()
+            entries = await cur.drain_through(tip,
+                                              deadline=loop.time() + 60)
+            got = [(m.param1, v) for v, b in entries for m in b]
+            assert sorted(got) == sorted(committed)
+            assert all(v > v0 for _k, v in got)
+            assert all(not k.startswith(b"\xff") for k, _v in got)
+    run_simulation(main())
+
+
 # --- storage apply path: effective capture + rollback ---
 
 def _register_marker(feed_id: bytes, begin: bytes, end: bytes) -> Mutation:

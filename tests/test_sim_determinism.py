@@ -49,10 +49,16 @@ def _child(path: str) -> None:
     # what this test proves) the trace must stay bit-identical; the
     # tracker itself always runs, so its accounting being deterministic
     # is part of what the same-seed comparison now covers
+    # ISSUE 8: the backup knobs are pinned OFF explicitly (the PR 7
+    # pattern) — this sim runs no backup agent, but a future default
+    # flip arming anything cluster-side (progress state transactions,
+    # an auto-started tail) must not silently change what the
+    # bit-identical acceptance proves
     knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
                              RESOLVER_DEVICE_PIPELINE=True,
                              DD_SHARD_HEAT_SPLITS=False,
-                             CLIENT_READ_LOAD_BALANCE="score")
+                             CLIENT_READ_LOAD_BALANCE="score",
+                             BACKUP_PROGRESS_PUBLISH=False)
 
     async def main():
         sim = SimulatedCluster(knobs, n_machines=_N_MACHINES,

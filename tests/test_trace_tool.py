@@ -235,3 +235,104 @@ def test_sim_trace_reconstructs_cross_role_timeline(tmp_path):
     hm = doc["cluster"]["hot_moves"]
     assert hm == {"splits": 0, "live_moves": 0, "heat_splits": 0,
                   "heat_moves": 0, "last_heat_rw_per_sec": 0.0}
+
+
+# --- backup + fetchKeys span threading (ISSUE 8 satellite; PR 2 (c)) ---
+
+def test_backup_restore_and_fetchkeys_spans_pair(tmp_path):
+    """A slow restore must be reconstructable from the trace file alone:
+    the backup agent's snapshot/log writers, the restore chunks, DD's
+    relocations and the move destinations' fetchKeys all emit PAIRED
+    Before/After(.Error) span events trace_tool can group.  The sim
+    forces a live DD split under writes while a whole-db backup tails,
+    then restores to a version on a fresh in-process cluster."""
+    from foundationdb_tpu.backup.agent import BackupAgent
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    path = os.path.join(str(tmp_path), "trace.jsonl")
+    log = TraceLog(path=path)
+    prev = get_trace_log()
+    set_trace_log(log)
+    span_mod.reset_totals()
+    knobs = Knobs().override(SERVER_SPAN_SAMPLE=1.0, DD_ENABLED=True,
+                             DD_INTERVAL=1.0, DD_SHARD_SPLIT_BYTES=6_000,
+                             BACKUP_LOG_FLUSH_INTERVAL=0.1)
+
+    async def main():
+        sim = SimulatedCluster(knobs, n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        n_shards = len(state1["shard_teams"])
+        db = await sim.database()
+        fs = SimFileSystem()
+        agent = BackupAgent(db, fs, "bk-spans")
+        await agent.start_continuous()
+        committed = []
+
+        async def write(i: int) -> None:
+            tr = db.create_transaction()
+            while True:
+                try:
+                    tr.set(b"sp%05d" % i, b"v" * 60)
+                    committed.append(await tr.commit())
+                    break
+                except BaseException as e:
+                    from foundationdb_tpu.runtime.errors import \
+                        CommitUnknownResult
+                    if isinstance(e, CommitUnknownResult):
+                        break
+                    await tr.on_error(e)
+
+        for i in range(40):
+            await write(i)
+        await agent.backup()     # a non-empty snapshot: pages emit spans
+        for i in range(40, 120):
+            await write(i)
+        # wait for DD to split the grown shard (fetchKeys + relocate)
+        await sim.wait_state(lambda s: s.get("seq", 0) > 0
+                             and len(s["shard_teams"]) > n_shards)
+        vt = max(committed)
+        deadline = asyncio.get_running_loop().time() + 120
+        while agent.log_through < vt:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.25)
+        await agent.stop_continuous()
+        async with Cluster(ClusterConfig(), Knobs().override(
+                SERVER_SPAN_SAMPLE=1.0)) as fresh:
+            fdb = Database(fresh)
+            agent2 = BackupAgent(fdb, fs, "bk-spans")
+            await agent2.restore(to_version=vt)
+        await sim.stop()
+
+    run_simulation(main(), seed=23)
+    set_trace_log(prev)
+    log.close()
+
+    events = trace_tool.load_events(trace_tool.rolled_paths(path))
+
+    def pairing(prefix: str) -> tuple[int, int]:
+        fam = [e for e in events
+               if str(e.get("Location", "")).startswith(prefix)]
+        befores = sum(1 for e in fam
+                      if e["Location"].endswith(".Before"))
+        closes = sum(1 for e in fam
+                     if e["Location"].endswith((".After", ".Error")))
+        return befores, closes
+
+    for prefix in ("BackupAgent.snapshotFile", "BackupAgent.logFile",
+                   "BackupAgent.restore", "StorageServer.fetchKeys",
+                   "DataDistributor.relocate"):
+        b, c = pairing(prefix)
+        assert b > 0, f"no {prefix} span events reached the trace file"
+        assert b == c, f"unpaired {prefix} events: {b} Before vs {c} closes"
+    # every span event carries a trace id and the analyzer groups them
+    backup_events = [e for e in events
+                     if str(e.get("Location", "")).startswith("BackupAgent.")]
+    assert all(e.get("TraceID") for e in backup_events)
+    assert trace_tool.reconstruct(backup_events)

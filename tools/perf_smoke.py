@@ -56,7 +56,17 @@ untagged admission stays fast.  A regression that silently stopped
 ranking heat, stopped arming, or wedged admission fails here at tier-1
 cost, not in a production hotspot.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|all]
+Stage 7 (``backup``): the feed-native backup/restore round trip
+(ISSUE 8) — an in-process cluster loaded through real commits, a
+whole-db feed tail + packed snapshot into a BackupContainer, more
+writes (including clears), then restore-to-version into a FRESH
+in-process cluster with the result asserted sha256-byte-identical to
+the source at the target version.  A regression that made capture,
+the .mlog flush path, or the chunked restore quadratic — or that
+silently lost/duplicated a mutation — fails here at tier-1 cost,
+under the standing hard wedge deadline.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -93,6 +103,9 @@ HEAT_COLD_TXNS = 60         # untagged commits spread over cold shards
 HEAT_READS = 600            # zipf-shaped point reads on the hot shard
 HEAT_BUDGET_S = 60.0        # measured ~5s on a loaded 2-cpu host
 HEAT_RANK_MARGIN = 3.0      # hot shard rw rate vs the next-hottest
+BACKUP_TXNS = 150           # commits per phase (pre-snapshot / post)
+BACKUP_CLIENTS = 8
+BACKUP_BUDGET_S = 90.0      # measured ~5s on a loaded 2-cpu host
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -833,13 +846,156 @@ def check_heat(budget_s: float = HEAT_BUDGET_S, quiet: bool = False) -> float:
     return elapsed
 
 
+def backup_restore_seconds(n_txns: int = BACKUP_TXNS,
+                           n_clients: int = BACKUP_CLIENTS,
+                           deadline_s: float | None = None
+                           ) -> tuple[float, dict]:
+    """Wall seconds for the feed-native backup/restore round trip
+    (ISSUE 8): rows loaded through real commits, a whole-db feed tail +
+    packed snapshot, a second write phase (sets + clears), then
+    restore-to-version into a FRESH in-process cluster — with the
+    restored user keyspace asserted sha256-byte-identical to the source
+    at the target version IN SITU (a silently lossy backup is worse
+    than a slow one)."""
+    from foundationdb_tpu.backup.agent import BackupAgent
+    from foundationdb_tpu.backup.container import keyspace_digest as digest
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.core.data import SYSTEM_PREFIX
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    knobs = Knobs().override(BACKUP_LOG_FLUSH_INTERVAL=0.1)
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        knobs = knobs.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin, generous budget
+        pass
+
+    async def read_all(cluster, at_version=None):
+        tr = Transaction(cluster)
+        while True:
+            try:
+                if at_version is not None:
+                    tr.set_read_version(at_version)
+                return await tr.get_range(b"", SYSTEM_PREFIX, limit=0,
+                                          snapshot=True)
+            except FdbError as e:
+                await tr.on_error(e)
+
+    async def phase(cluster, prefix: bytes, lo: int, hi: int) -> int:
+        issued = iter(range(lo, hi))
+        tip = 0
+
+        async def client(cid: int) -> None:
+            nonlocal tip
+            tr = Transaction(cluster)
+            for i in issued:
+                while True:
+                    try:
+                        tr.set(prefix + b"%06d" % i, b"v" * 64)
+                        if i % 17 == 0 and i > lo:
+                            # clears ride the feed too
+                            tr.clear(prefix + b"%06d" % (i - 7))
+                        tip = max(tip, await tr.commit())
+                        tr.reset()
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+
+        await asyncio.gather(*(client(c) for c in range(n_clients)))
+        return tip
+
+    async def main() -> tuple[float, dict]:
+        fs = SimFileSystem()
+        t_all = time.perf_counter()
+        src = Cluster(ClusterConfig(storage_servers=2), knobs)
+        src.start()
+        db = Database(src)
+        await phase(src, b"bk", 0, n_txns)
+        agent = BackupAgent(db, fs, "smoke-bk")
+        t0 = time.perf_counter()
+        await agent.start_continuous()
+        snap = await agent.backup()
+        t_snap = time.perf_counter() - t0
+        vt = await phase(src, b"bk", n_txns, 2 * n_txns)
+        # drain the feed tail through the target, then capture truth
+        while agent.log_through < vt:
+            await asyncio.sleep(0.05)
+        expected = await read_all(src, at_version=vt)
+        t0 = time.perf_counter()
+        await agent.stop_continuous(drain_timeout=30.0)
+        t_drain = time.perf_counter() - t0
+        await src.stop()
+
+        dst = Cluster(ClusterConfig(storage_servers=2), knobs)
+        dst.start()
+        t0 = time.perf_counter()
+        agent2 = BackupAgent(Database(dst), fs, "smoke-bk")
+        await agent2.restore(to_version=vt)
+        t_restore = time.perf_counter() - t0
+        got = await read_all(dst)
+        await dst.stop()
+        assert digest(got) == digest(expected), (
+            f"restore-to-version diverged from the source at {vt}: "
+            f"{len(got)} restored rows vs {len(expected)} expected — a "
+            f"lost or duplicated mutation, not slowness")
+        mlog = await agent2.container.load_log_manifest()
+        stats = {
+            "rows": len(expected),
+            "snapshot_rows": snap.rows,
+            "snapshot_s": t_snap,
+            "log_files": len(mlog["files"]),
+            "log_bytes": mlog.get("bytes", 0),
+            "drain_s": t_drain,
+            "restore_s": t_restore,
+            "restore_rows_per_sec":
+                len(got) / t_restore if t_restore else 0.0,
+            "verified": True,
+        }
+        return time.perf_counter() - t_all, stats
+
+    async def bounded():
+        return await asyncio.wait_for(main(), deadline_s)
+
+    try:
+        return asyncio.run(bounded())
+    except asyncio.TimeoutError:
+        raise AssertionError(
+            f"backup smoke wedged: the {deadline_s:.0f}s deadline hit — "
+            f"a stalled feed tail, drain, or restore chunk, not just "
+            f"slowness") from None
+
+
+def check_backup(budget_s: float = BACKUP_BUDGET_S,
+                 quiet: bool = False) -> float:
+    """Run the backup/restore smoke; raises AssertionError on a
+    byte-identity failure, past the budget, or at the wedge deadline."""
+    elapsed, stats = backup_restore_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] backup: {stats['rows']} rows round-tripped "
+              f"(snapshot {stats['snapshot_rows']} rows in "
+              f"{stats['snapshot_s']:.2f}s, {stats['log_files']} mlog "
+              f"files, restore {stats['restore_rows_per_sec']:.0f} "
+              f"rows/s), verified={stats['verified']}")
+    assert stats["verified"]
+    assert elapsed < budget_s, (
+        f"backup smoke took {elapsed:.1f}s (budget {budget_s:.0f}s) — "
+        f"capture, the .mlog flush path, or the chunked restore grew a "
+        f"quadratic shape")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
     ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
     ap.add_argument("--stage",
                     choices=("apply", "pipeline", "feed", "read",
-                             "resolve", "heat", "all"),
+                             "resolve", "heat", "backup", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -848,6 +1004,7 @@ def main() -> int:
     ap.add_argument("--resolve-budget", type=float,
                     default=RESOLVE_BUDGET_S)
     ap.add_argument("--heat-budget", type=float, default=HEAT_BUDGET_S)
+    ap.add_argument("--backup-budget", type=float, default=BACKUP_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -861,6 +1018,8 @@ def main() -> int:
         check_resolve(budget_s=args.resolve_budget)
     if args.stage in ("heat", "all"):
         check_heat(budget_s=args.heat_budget)
+    if args.stage in ("backup", "all"):
+        check_backup(budget_s=args.backup_budget)
     return 0
 
 
