@@ -845,6 +845,146 @@ def run_read_point_phase(quiet: bool) -> dict:
     return r
 
 
+def run_scan_phase(quiet: bool) -> dict:
+    """Scan stage (ISSUE 9) — the YCSB-E shape joins the bench
+    trajectory: rows loaded through real commits, then (a) zipfian
+    SHORT scans (zipf-0.99 start key, uniform 1..100 row length — the
+    workload-E getRange mix) with client-boundary latency, and (b)
+    full-table sweeps.  Both ride the packed range-read path (the
+    default); ``scan_chunk_mean`` is rows per packed reply, counted at
+    the replica-group boundary."""
+    import asyncio
+
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    n_rows, scan_clients, duration_s, sweeps = 100_000, 32, 5.0, 3
+    knobs = Knobs()
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        knobs = knobs.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin is fine for this shape
+        pass
+
+    def key(i: int) -> bytes:
+        return b"sc%08d" % (i % n_rows)
+
+    async def main() -> dict:
+        cluster = Cluster(ClusterConfig(storage_servers=2), knobs)
+        cluster.start()
+
+        async def loader(lo: int, hi: int) -> None:
+            tr = Transaction(cluster)
+            for start in range(lo, hi, 500):
+                while True:
+                    for i in range(start, min(start + 500, hi)):
+                        tr.set(key(i), b"v" * 100)
+                    try:
+                        await tr.commit()
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+                tr.reset()
+
+        span = (n_rows + 15) // 16
+        await asyncio.gather(*(loader(j * span, min((j + 1) * span, n_rows))
+                               for j in range(16)))
+
+        # count packed replies + rows at the replica-group boundary
+        chunk_calls = chunk_rows = 0
+        for g in cluster._replica_groups:
+            inner = g.get_key_values_packed
+
+            async def spy(req, inner=inner):
+                nonlocal chunk_calls, chunk_rows
+                rep = await inner(req)
+                chunk_calls += 1
+                chunk_rows += len(rep)
+                return rep
+
+            g.get_key_values_packed = spy
+
+        from foundationdb_tpu.bench.workload import ZipfianGenerator
+        zipf = ZipfianGenerator(n_rows, 0.99, 29)
+        import random as _random
+        lrng = _random.Random(31)
+
+        # --- (a) zipfian short scans, client-boundary latency ---
+        short_rows = 0
+        short_scans = 0
+        lat: list[float] = []
+        stop_at = time.perf_counter() + duration_s
+
+        async def short_scanner(cid: int) -> None:
+            nonlocal short_rows, short_scans
+            tr = Transaction(cluster)
+            await tr.get_read_version()
+            while time.perf_counter() < stop_at:
+                start = int(zipf.sample(1)[0])
+                length = lrng.randrange(1, 101)
+                t0 = time.perf_counter()
+                try:
+                    rows = await tr.get_range(key(start), b"sd",
+                                              limit=length, snapshot=True)
+                except FdbError as e:
+                    # the held read version aged out of the MVCC window
+                    # mid-stage: standard retry, fresh snapshot
+                    await tr.on_error(e)
+                    continue
+                lat.append(time.perf_counter() - t0)
+                assert rows, "short scan returned no rows"
+                short_rows += len(rows)
+                short_scans += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(short_scanner(c)
+                               for c in range(scan_clients)))
+        short_elapsed = time.perf_counter() - t0
+
+        # --- (b) full-table sweeps ---
+        sweep_rows = 0
+        tr = Transaction(cluster)
+        t0 = time.perf_counter()
+        for _ in range(sweeps):
+            while True:
+                try:
+                    rows = await tr.get_range(b"sc", b"sd", snapshot=True)
+                    break
+                except FdbError as e:
+                    await tr.on_error(e)
+            assert len(rows) == n_rows
+            sweep_rows += len(rows)
+            tr.reset()
+        sweep_elapsed = time.perf_counter() - t0
+        await cluster.stop()
+        lat.sort()
+        return {
+            "scan_rows_per_sec": round(sweep_rows / sweep_elapsed, 1),
+            "scan_short_rows_per_sec":
+                round(short_rows / short_elapsed, 1),
+            "scan_short_scans_per_sec":
+                round(short_scans / short_elapsed, 1),
+            "scan_p50_ms":
+                round(lat[len(lat) // 2] * 1e3, 3) if lat else None,
+            "scan_p99_ms":
+                round(lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 3)
+                if lat else None,
+            "scan_n_samples": len(lat),
+            "scan_chunk_mean":
+                round(chunk_rows / chunk_calls, 1) if chunk_calls else None,
+            "scan_len_mean":
+                round(short_rows / short_scans, 1) if short_scans else None,
+        }
+
+    r = asyncio.run(main())
+    if not quiet:
+        print(f"[bench] scan: {r}", file=sys.stderr)
+    return r
+
+
 def run_hot_shard_phase(quiet: bool) -> dict:
     """Hot-shard stage (ISSUE 7): sustained zipf-0.99 write+read skew
     against a LIVE cluster — the 6-machine simulated fleet running on
@@ -1508,6 +1648,14 @@ def main() -> int:
                 args.stage_timeout, out)
             if rp is not None:
                 out.update(rp)
+
+            # columnar range reads (ISSUE 9): YCSB-E style zipfian
+            # short scans + full-table sweeps on the packed path
+            sc = call_bounded(
+                "scan", lambda: run_scan_phase(args.quiet),
+                args.stage_timeout, out)
+            if sc is not None:
+                out.update(sc)
 
             # hot-shard economics (ISSUE 7): a live heat split under
             # sustained zipf skew, with before/after read p99 and the

@@ -102,6 +102,7 @@ class BackupAgent:
         self._log_files: list[tuple[Version, Version, str]] = []
         self._file_seq = 0
         self._log_stopped = False
+        self._expired_before: Version | None = None
         self.bytes_logged = 0
         self.bytes_snapshotted = 0
         self.last_snapshot_version: Version | None = None
@@ -127,11 +128,17 @@ class BackupAgent:
                 await tr.on_error(e)
 
     async def _save_log_manifest(self) -> None:
-        await self.container.save_log_manifest({
+        meta = {
             "feed": self.feed_id, "begin": self._log_begin,
             "through": self.log_through,
             "files": [[f, l, n] for f, l, n in self._log_files],
-            "bytes": self.bytes_logged, "stopped": self._log_stopped})
+            "bytes": self.bytes_logged, "stopped": self._log_stopped}
+        if self._expired_before is not None:
+            # the GC marker survives every rewrite: this agent is the
+            # manifest's only writer while tailing, so dropping it here
+            # would erase the container's record of the expire cut
+            meta["expired_before"] = self._expired_before
+        await self.container.save_log_manifest(meta)
 
     def _load_log_state(self, meta: dict) -> None:
         self._log_begin = meta["begin"]
@@ -139,6 +146,22 @@ class BackupAgent:
         self._log_files = [(f, l, str(n)) for f, l, n in meta["files"]]
         self._file_seq = len(self._log_files)
         self.bytes_logged = meta.get("bytes", 0)
+        self._expired_before = meta.get("expired_before")
+
+    async def expire_data_before(self, version: Version) -> dict:
+        """GC the container (``BackupContainer.expire_data_before``) AND
+        prune this agent's in-memory file mirror to match — THE expire
+        surface while a continuous backup is live.  The agent is the
+        manifest's only writer while tailing: a container-level expire
+        alone would be silently undone by the next flush, which
+        serializes ``_log_files`` from memory and would re-name the
+        deleted ``.mlog`` bytes."""
+        r = await self.container.expire_data_before(version)
+        cut = r["kept_snapshot"]
+        self._log_files = [(f, l, n) for f, l, n in self._log_files
+                           if l > cut]
+        self._expired_before = cut
+        return r
 
     # --- continuous mutation log (the whole-db feed tail) ---
 
@@ -162,6 +185,7 @@ class BackupAgent:
         self._log_files = []
         self._file_seq = 0
         self._log_stopped = False
+        self._expired_before = None
         self.bytes_logged = 0
         await self._save_log_manifest()
         self._pull_task = asyncio.get_running_loop().create_task(
@@ -391,8 +415,12 @@ class BackupAgent:
         rows = nbytes = 0
         idx = 0
         ctx = self._sampler.root(self.knobs.SERVER_SPAN_SAMPLE)
+        # columns=True: pages arrive as the packed range replies'
+        # columns and reach the .kvr frame with no tuple-list round
+        # trip (ISSUE 9; byte-identical files, tested)
         async for page, version in paged_snapshot(self.db, begin, end,
-                                                  self.rows_per_file):
+                                                  self.rows_per_file,
+                                                  columns=True):
             if not page:
                 break
             self.spans.event("TransactionDebug", ctx,

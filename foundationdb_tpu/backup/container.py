@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import struct
 import zlib
-from array import array as _array
 
 from ..core.data import MutationBatch, Version
 from ..rpc.wire import decode, encode
@@ -51,15 +50,6 @@ class ContainerError(FdbError):
     name = "backup_container_error"
 
 
-def _bounds_wire(bounds: "_array") -> bytes:
-    """Cumulative u32 end offsets, little-endian on disk (the
-    MutationBatch.bounds discipline)."""
-    if struct.pack("<I", 1) != struct.pack("=I", 1):
-        bounds = _array("I", bounds)
-        bounds.byteswap()
-    return bounds.tobytes()
-
-
 def keyspace_digest(rows) -> str:
     """Canonical sha256 of a keyspace — THE byte-identity definition the
     restore-to-version acceptance keys on, shared by the tests, the
@@ -74,40 +64,30 @@ def keyspace_digest(rows) -> str:
     return h.hexdigest()
 
 
-def pack_rows(rows: list) -> tuple[bytes, bytes, bytes, bytes]:
+def pack_rows(rows) -> tuple[bytes, bytes, bytes, bytes]:
     """[(key, value), ...] (sorted by key — snapshot pages arrive sorted
-    from the range read) -> (key_bounds, key_blob, val_bounds, val_blob)."""
-    kb: list[bytes] = []
-    vb: list[bytes] = []
-    ko = _array("I")
-    vo = _array("I")
-    kpos = vpos = 0
-    for k, v in rows:
-        k, v = bytes(k), bytes(v)
-        kb.append(k)
-        vb.append(v)
-        kpos += len(k)
-        vpos += len(v)
-        ko.append(kpos)
-        vo.append(vpos)
-    return _bounds_wire(ko), b"".join(kb), _bounds_wire(vo), b"".join(vb)
+    from the range read) -> (key_bounds, key_blob, val_bounds, val_blob).
+
+    A ``PackedRows`` page (the packed range replies' columns, ISSUE 9)
+    passes its columns through VERBATIM — the zero-copy path the backup
+    snapshot writer rides; a tuple list packs here (PackedRows.from_rows
+    is the ONE home of the column layout, so the two paths can never
+    produce different bytes)."""
+    from ..core.data import PackedRows
+    if isinstance(rows, PackedRows):
+        return (rows.key_bounds, rows.key_blob,
+                rows.val_bounds, rows.val_blob)
+    p = PackedRows.from_rows(rows)
+    return p.key_bounds, p.key_blob, p.val_bounds, p.val_blob
 
 
 def unpack_rows(ko: bytes, kblob: bytes, vo: bytes,
                 vblob: bytes) -> list[tuple[bytes, bytes]]:
-    kof = _array("I")
-    kof.frombytes(ko)
-    vof = _array("I")
-    vof.frombytes(vo)
-    if struct.pack("<I", 1) != struct.pack("=I", 1):
-        kof.byteswap()
-        vof.byteswap()
-    out: list[tuple[bytes, bytes]] = []
-    kp = vp = 0
-    for ke, ve in zip(kof, vof):
-        out.append((kblob[kp:ke], vblob[vp:ve]))
-        kp, vp = ke, ve
-    return out
+    """Inverse of ``pack_rows`` — PackedRows owns BOTH halves of the
+    column layout, so the .kvr reader can never diverge from the
+    writer."""
+    from ..core.data import PackedRows
+    return PackedRows(ko, kblob, vo, vblob).rows()
 
 
 class BackupContainer:
@@ -234,6 +214,80 @@ class BackupContainer:
         if self.fs.open(self._path("logs.manifest")).size() == 0:
             return None             # absent: no mutation log
         return decode(await self._read_file("logs.manifest"))
+
+    # --- expiration / GC (ISSUE 9; the expireData discipline of
+    # REF:fdbclient/BackupContainer.actor.cpp) ---
+
+    async def expire_data_before(self, version: Version) -> dict:
+        """Drop snapshots and mutation-log file prefixes that NO restore
+        target at or after ``version`` can need, and rewrite the
+        manifests so nothing ever names a deleted file.
+
+        A target ``t >= version`` restores from the newest snapshot at
+        or below ``t`` and replays ``(snapshot, t]`` — so the newest
+        snapshot at or below ``version`` (the KEEP snapshot) is the
+        oldest state any such target can touch: every older snapshot,
+        and every ``.mlog`` file whose span ends at or below the keep
+        version, is garbage.  Later snapshots and the log's resume
+        token (``through``) are untouched, so a live continuous backup
+        keeps resuming exactly-once.
+
+        REFUSES (ContainerError, nothing deleted) when no snapshot
+        exists at or below ``version``: there is then no restore point
+        anchoring the log window, and cutting the log prefix anyway
+        would orphan the container's only resumable frontier — the
+        caller believes targets >= ``version`` are safe while nothing
+        below the NEXT snapshot (which may never come) could ever be
+        restored again.
+
+        Deletion order mirrors the write discipline in reverse:
+        manifests stop naming the files FIRST (snapshot manifests
+        removed, logs.manifest rewritten), then the data files go — a
+        crash in between leaves unreferenced files (harmless orphans),
+        never a manifest pointing at missing bytes.
+
+        While a continuous backup is LIVE, expire through
+        ``BackupAgent.expire_data_before`` — the agent is the
+        manifest's only writer while tailing and serializes its
+        in-memory file list on every flush, so a container-level
+        expire alone would be undone by the next flush re-naming the
+        deleted files."""
+        snaps = await self.list_snapshots()
+        keep = None
+        for m in snaps:
+            if m["version"] <= version:
+                keep = m
+        if keep is None:
+            raise ContainerError(
+                f"refusing to expire before {version}: no snapshot at or "
+                f"below it — dropping the log prefix would orphan the "
+                f"container's only resumable frontier")
+        keep_v = keep["version"]
+        dead_snaps = [m for m in snaps if m["version"] < keep_v]
+        log = await self.load_log_manifest()
+        dead_logs: list[tuple] = []
+        if log is not None:
+            kept_files = []
+            for first, last, name in log["files"]:
+                (dead_logs if last <= keep_v else kept_files).append(
+                    (first, last, name))
+            if dead_logs:
+                log["files"] = [[f, l, n] for f, l, n in kept_files]
+                log["expired_before"] = int(keep_v)
+                await self.save_log_manifest(log)
+        # manifests no longer name anything below: delete the bytes
+        for m in dead_snaps:
+            self.fs.remove(self._path(f"snap-{m['version']:020d}.manifest"))
+            for name in m["files"]:
+                self.fs.remove(self._path(str(name)))
+        for _f, _l, name in dead_logs:
+            self.fs.remove(self._path(str(name)))
+        return {
+            "expired_before": int(version),
+            "kept_snapshot": int(keep_v),
+            "dropped_snapshots": len(dead_snaps),
+            "dropped_log_files": len(dead_logs),
+        }
 
     # --- observability / tools ---
 

@@ -66,13 +66,18 @@ async def log_view(db):
 
 
 async def paged_snapshot(db, begin: bytes, end: bytes,
-                         page_size: int = 1000):
+                         page_size: int = 1000, columns: bool = False):
     """Async generator of (page, version): every page of [begin, end)
     read at ONE pinned read version (grabbed from the first transaction,
     pinned with set_read_version on the rest) — a strict cut; a
     transaction is either entirely in the snapshot or entirely absent.
     Shared by BackupAgent.backup (writes files) and DRAgent's initial
-    copy (writes the destination)."""
+    copy (writes the destination).
+
+    ``columns=True`` yields each page as a ``PackedRows`` — the packed
+    range replies' columns concatenated, never a tuple list (ISSUE 9);
+    the rows are byte-identical either way and the page keeps the
+    ``len``/``[-1][0]`` row surface the cursor advance uses."""
     from ..runtime.errors import FdbError
     version: Version | None = None
     cursor = begin
@@ -83,8 +88,12 @@ async def paged_snapshot(db, begin: bytes, end: bytes,
             try:
                 if version is not None:
                     tr.set_read_version(version)
-                page = await tr.get_range(cursor, end, limit=page_size,
-                                          snapshot=True)
+                if columns:
+                    page = await tr.get_range_packed(cursor, end,
+                                                     limit=page_size)
+                else:
+                    page = await tr.get_range(cursor, end, limit=page_size,
+                                              snapshot=True)
                 if version is None:
                     version = await tr.get_read_version()
                 break

@@ -148,6 +148,21 @@ class ReplicaGroup:
         return await self._call("get_key_values", begin, end, version,
                                 limit, reverse, byte_limit)
 
+    async def get_key_values_packed(self, req):
+        """Packed range reads with the same replica failover as scalar
+        reads.  A refused chunk carries its status ON the reply instead
+        of raising (ISSUE 9), so the refusal classes the scalar path
+        fails over on — this replica lags (future_version) or compacted
+        past the read (too_old), and a relinquished range
+        (wrong_shard) — penalize and try the next replica here too;
+        only when every replica refuses does the client see the code
+        (the scalar path's all-replicas-raised shape)."""
+        async def attempt(storage):
+            reply = await storage.get_key_values_packed(req)
+            return reply.status == 0, reply
+
+        return await self._failover(attempt)
+
     async def watch_value(self, key: bytes, value, version: int):
         return await self._call("watch_value", key, value, version)
 

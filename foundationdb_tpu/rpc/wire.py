@@ -235,6 +235,7 @@ def _register_core_structs() -> None:
         sp.SpanEnvelope, d.MutationBatch,
         cf.ChangeFeedStreamRequest, cf.ChangeFeedStreamReply,
         d.GetValuesRequest, d.GetValuesReply,
+        d.GetRangeRequest, d.GetRangeReply,
     ]):
         register_struct(cls, sid=i)
 

@@ -86,7 +86,11 @@ class Knobs:
     # 714: batched multiget reads — GetValuesRequest/Reply (wire struct
     # ids 14/15) on the storage read surface; a 713 peer cannot decode
     # the struct ids, so the gate fences it
-    PROTOCOL_VERSION: int = 714
+    # 715: columnar range reads — GetRangeRequest/Reply (wire struct ids
+    # 16/17) on the storage read surface, rows as packed key/value blobs
+    # + cumulative u32 bounds with a per-chunk status byte; a 714 peer
+    # cannot decode the struct ids, so the gate fences it
+    PROTOCOL_VERSION: int = 715
     # --- change feeds ---
     # (sealed feed segments at or below the durable floor ALWAYS spill
     # to the DiskQueue side file on durable servers — a durability
@@ -143,6 +147,20 @@ class Knobs:
     # exceed CLIENT_RANGE_CHUNK_BYTES at the observed mean row size
     CLIENT_RANGE_CHUNK_ROWS: int = 128
     CLIENT_RANGE_CHUNK_BYTES: int = 1 << 20
+    # columnar range reads (ISSUE 9): CLIENT range fetches
+    # (Transaction.get_range's snapshot stream) ride the packed
+    # GetRangeRequest/Reply RPC (sorted key blob + cumulative u32
+    # bounds, per-chunk status byte), the engines extract whole
+    # block/leaf runs, and overlay-free scans bulk-extend reply pages
+    # client-side.  Off = get_range's scalar pre-715 tuple-list path,
+    # kept as the equivalence/A-B baseline (byte-identical results,
+    # tested).  The knob gates ONLY that client fetch choice: fetchKeys
+    # shard moves, Transaction.get_range_packed and the backup snapshot
+    # writer are packed-native by design — like mutations on
+    # MutationBatch, the packed struct IS their protocol (both peers
+    # speak 715 or the version gate fences them), so there is no scalar
+    # fallback to toggle.
+    CLIENT_PACKED_RANGE_READS: bool = True
 
     # --- backup / point-in-time restore (ISSUE 8) ---
     # feed-native backup: the agent tails a WHOLE-DATABASE change feed

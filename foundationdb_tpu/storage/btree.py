@@ -197,6 +197,37 @@ class BTreeKVStore:
             return
         yield from self._walk(self._root, begin, end, reverse)
 
+    def range_runs(self, begin: bytes,
+                   end: bytes) -> Iterator[list[tuple[bytes, bytes]]]:
+        """Forward scan of [begin, end) as whole LEAF runs — one run per
+        decoded leaf, the in-range slice extracted wholesale by two
+        bisects instead of a per-row yield (the columnar range-read
+        extraction, ISSUE 9).  Flattened output is byte-identical to
+        ``range``; a limit-bounded caller that stops consuming leaves
+        the remaining subtrees untouched."""
+        if self._root is None:
+            return
+        yield from self._walk_runs(self._root, begin, end)
+
+    def _walk_runs(self, ref, begin, end):
+        node = self._read_node(ref)
+        if node[0] == 0:
+            kids = node[1]
+            firsts = [bytes(c[0]) for c in kids]
+            lo = max(0, bisect.bisect_right(firsts, begin) - 1)
+            hi = min(bisect.bisect_left(firsts, end) + 1, len(kids))
+            for i in range(lo, hi):
+                yield from self._walk_runs((kids[i][1], kids[i][2]),
+                                           begin, end)
+        else:
+            entries = node[1]
+            keys = [bytes(e[0]) for e in entries]
+            lo = bisect.bisect_left(keys, begin)
+            hi = bisect.bisect_left(keys, end)
+            if lo < hi:
+                yield [(keys[i], bytes(entries[i][1]))
+                       for i in range(lo, hi)]
+
     def _walk(self, ref, begin, end, reverse):
         """In-order walk of [begin, end); ``end=None`` means unbounded —
         the whole-tree walk compaction relies on (a key range would

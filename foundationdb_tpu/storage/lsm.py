@@ -34,6 +34,7 @@ _TOMBSTONE = None          # value None in runs marks a deletion
 _BLOCK_BYTES = 1 << 16
 _MEMTABLE_BYTES = 1 << 22  # flush threshold (4MB)
 _MAX_RUNS = 6              # compact when exceeded
+_MEM_RUN_ROWS = 2048       # memtable rows per bulk run (range_runs)
 _CACHE_BLOCKS = 256        # LRU block cache entries (~16MB)
 _FOOTER = b"LSM1"
 
@@ -140,6 +141,37 @@ class _Run:
                 if k < begin or k >= end:
                     continue
                 yield k, (bytes(v) if v is not None else None)
+
+    def range_blocks(self, begin: bytes,
+                     end: bytes) -> Iterator[list]:
+        """Forward block RUNS of [begin, end): each touched block
+        decoded once, the boundary blocks trimmed by bisect, interior
+        blocks sliced wholesale — the searchsorted-over-sorted-index
+        discipline of ``get_batch_into`` generalized from point probes
+        to interval extraction (ISSUE 9).  Rows include tombstones
+        (value None): the engine-level newest-wins merge needs them."""
+        fk = self.first_keys
+        if not fk:
+            return
+        first = lambda e: e[0]  # noqa: E731 — bisect key
+        lo = max(0, bisect.bisect_right(fk, begin) - 1)
+        stop = max(bisect.bisect_left(fk, end), lo + 1)
+        for i in range(lo, stop):
+            # the decoder already hands back bytes keys/values, so rows
+            # pass through with NO per-row re-materialization: interior
+            # blocks yield the cached block list itself (read-only by
+            # contract), boundary blocks yield one slice
+            blk = self._block(i)
+            if i == lo or i == stop - 1:
+                s = (bisect.bisect_left(blk, begin, key=first)
+                     if i == lo else 0)
+                t = (bisect.bisect_left(blk, end, key=first)
+                     if i == stop - 1 else len(blk))
+                if s >= t:
+                    continue
+                yield blk[s:t] if (s or t < len(blk)) else blk
+            else:
+                yield blk
 
 
 class _BlockCache:
@@ -265,6 +297,102 @@ class LSMKVStore:
         sources.append(mem_iter())
         sources.extend(r.iter_range(begin, end, reverse) for r in self._runs)
         yield from _merge(sources, reverse)
+
+    def _mem_runs(self, begin: bytes, end: bytes) -> Iterator[list]:
+        """Memtable rows of [begin, end) as bulk runs, tombstones kept."""
+        lo = bisect.bisect_left(self._mem_index, begin)
+        hi = bisect.bisect_left(self._mem_index, end)
+        mem = self._mem
+        for i in range(lo, hi, _MEM_RUN_ROWS):
+            yield [(k, mem[k])
+                   for k in self._mem_index[i:min(i + _MEM_RUN_ROWS, hi)]]
+
+    def range_runs(self, begin: bytes,
+                   end: bytes) -> Iterator[list]:
+        """Forward scan of [begin, end) as bulk row RUNS: newest-wins
+        across memtable + sorted runs with tombstones elided, flattened
+        output byte-identical to ``range(..., reverse=False)``.  Rows
+        are (key, value) SEQUENCES — tuples or the block decoder's
+        2-item lists — and runs may alias cached block storage:
+        consumers index and slice, never mutate or type-match.
+
+        A range held by ONE source (the post-compaction common case)
+        streams its block runs straight through.  Overlapping sources
+        merge SEGMENT-wise: each round cuts at the smallest buffered
+        tail key — so no source decodes blocks past what the consumer
+        needs — and resolves the segment with one C-speed sort + linear
+        dedup (newest source first) instead of a per-row heap."""
+        sources = [self._mem_runs(begin, end)]
+        sources += [r.range_blocks(begin, end) for r in self._runs]
+        # newest first: position in ``bufs`` is the win priority on
+        # duplicate keys (memtable beats every run, newer runs beat
+        # older); filtering exhausted sources preserves relative order
+        bufs: list[list] = []
+        for src in sources:
+            rows = next(src, None)
+            if rows:
+                bufs.append([rows, src])
+        first = lambda r: r[0]  # noqa: E731 — bisect key
+        while bufs:
+            if len(bufs) == 1:
+                rows, src = bufs[0]
+                while rows is not None:
+                    live = [e for e in rows if e[1] is not None]
+                    if live:
+                        yield live
+                    rows = next(src, None)
+                return
+            pivot = min(rows[-1][0] for rows, _src in bufs)
+            seg: list[list] = []
+            for entry in bufs:
+                rows, src = entry
+                if rows[-1][0] <= pivot:
+                    part = rows
+                    entry[0] = next(src, None)
+                else:
+                    cut = bisect.bisect_right(rows, pivot, key=first)
+                    part = rows[:cut]
+                    entry[0] = rows[cut:]
+                if part:
+                    seg.append(part)
+            bufs = [entry for entry in bufs if entry[0]]
+            if not seg:
+                continue
+            if len(seg) > 1:
+                # span-disjoint parts (sequential flushes stripe the
+                # keyspace, so segments usually interleave WITHOUT
+                # overlapping) concatenate in span order — no sort, no
+                # per-row dedup
+                order = sorted(range(len(seg)), key=lambda i: seg[i][0][0])
+                if all(seg[order[i]][-1][0] < seg[order[i + 1]][0][0]
+                       for i in range(len(order) - 1)):
+                    for i in order:
+                        live = [e for e in seg[i] if e[1] is not None]
+                        if live:
+                            yield live
+                    continue
+                # overlapping parts: (key, priority, value) triples —
+                # one sort resolves order AND newest-wins (priority
+                # breaks key ties; a key appears at most once per
+                # source, so values are never compared)
+                merged: list[tuple] = []
+                for prio, part in enumerate(seg):
+                    merged += [(k, prio, v) for k, v in part]
+                merged.sort()
+                out: list[tuple[bytes, bytes]] = []
+                last = None
+                for k, _prio, v in merged:
+                    if k == last:
+                        continue
+                    last = k
+                    if v is not None:
+                        out.append((k, v))
+                if out:
+                    yield out
+                continue
+            live = [e for e in seg[0] if e[1] is not None]
+            if live:
+                yield live
 
     # --- writes ---
 

@@ -82,6 +82,17 @@ def test_backup_restore_smoke():
     perf_smoke.check_backup(budget_s=perf_smoke.BACKUP_BUDGET_S)
 
 
+def test_scan_path_smoke():
+    """The columnar range-read path (ISSUE 9): rows loaded through real
+    commits onto a durable lsm cluster (several sorted runs), then
+    full-table scans A/B'd — CLIENT_PACKED_RANGE_READS off vs on, every
+    reply round-tripped through the real wire codec — with results
+    asserted byte-identical in situ and a >= 3x packed rows/s floor at
+    chunk 512 (measured ~5x on a loaded 2-cpu host).  The budget
+    doubles as the standing hard wedge deadline."""
+    perf_smoke.check_scan(budget_s=perf_smoke.SCAN_BUDGET_S)
+
+
 def test_apply_metrics_surface():
     """The apply path must publish its observability counters — a silent
     regression is the other half of the r5 incident."""

@@ -53,7 +53,7 @@ def test_version_gate_fences_713_peer():
     from foundationdb_tpu.core.cluster_client import RecoveredClusterView
     from foundationdb_tpu.runtime.errors import ClusterVersionChanged
     new = Knobs()
-    assert new.PROTOCOL_VERSION == 714
+    assert new.PROTOCOL_VERSION >= 714   # 714 introduced the multiget structs
     old = new.override(PROTOCOL_VERSION=713)
     state = {"epoch": 1, "seq": 0, "protocol": new.PROTOCOL_VERSION}
     with pytest.raises(ClusterVersionChanged):
@@ -462,15 +462,13 @@ def test_snapshot_stream_adaptive_chunk():
         tr = Transaction(cluster)
         seen_limits: list[int] = []
         group = cluster.storage_for_key(b"r00000")
-        inner = group.get_key_values
+        inner = group.get_key_values_packed
 
-        async def spy(begin, end, version, limit=0, reverse=False,
-                      byte_limit=0):
-            seen_limits.append(limit)
-            return await inner(begin, end, version, limit, reverse,
-                               byte_limit)
+        async def spy(req):
+            seen_limits.append(req.limit)
+            return await inner(req)
 
-        group.get_key_values = spy
+        group.get_key_values_packed = spy
         got = await tr.get_range(b"r", b"s")
         assert got == sorted(rows.items())
         # the knob seeds the first fetch; later fetches doubled
@@ -485,15 +483,13 @@ def test_snapshot_stream_adaptive_chunk():
         tr2 = Transaction(c2)
         limits2: list[int] = []
         g2 = c2.storage_for_key(b"big000")
-        inner2 = g2.get_key_values
+        inner2 = g2.get_key_values_packed
 
-        async def spy2(begin, end, version, limit=0, reverse=False,
-                       byte_limit=0):
-            limits2.append(limit)
-            return await inner2(begin, end, version, limit, reverse,
-                                byte_limit)
+        async def spy2(req):
+            limits2.append(req.limit)
+            return await inner2(req)
 
-        g2.get_key_values = spy2
+        g2.get_key_values_packed = spy2
         got2 = await tr2.get_range(b"big", b"bih")
         assert len(got2) == 40
         assert max(limits2) <= 4000 // 900, \

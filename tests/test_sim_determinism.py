@@ -54,11 +54,16 @@ def _child(path: str) -> None:
     # flip arming anything cluster-side (progress state transactions,
     # an auto-started tail) must not silently change what the
     # bit-identical acceptance proves
+    # ISSUE 9: the packed range-read path is pinned ON explicitly (its
+    # default) — the bit-identical acceptance must cover the columnar
+    # read path, and a future default flip must not silently change
+    # what this test proves
     knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
                              RESOLVER_DEVICE_PIPELINE=True,
                              DD_SHARD_HEAT_SPLITS=False,
                              CLIENT_READ_LOAD_BALANCE="score",
-                             BACKUP_PROGRESS_PUBLISH=False)
+                             BACKUP_PROGRESS_PUBLISH=False,
+                             CLIENT_PACKED_RANGE_READS=True)
 
     async def main():
         sim = SimulatedCluster(knobs, n_machines=_N_MACHINES,
@@ -72,6 +77,13 @@ def _child(path: str) -> None:
                 await tr.get(b"det-k%d" % i)
                 tr.set(b"det-k%d" % i, b"v%d" % i)
             await db.run(body)
+
+        # one packed range scan (ISSUE 9): the columnar read path's
+        # events are part of what must stay bit-identical
+        async def scan(tr):
+            rows = await tr.get_range(b"det-", b"det.", snapshot=True)
+            assert len(rows) == 6, rows
+        await db.run(scan)
         # let the async halves drain: storage pull/apply and the
         # pipeline's verdict readbacks both emit trace events
         await asyncio.sleep(1.5)

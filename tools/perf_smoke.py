@@ -56,6 +56,18 @@ untagged admission stays fast.  A regression that silently stopped
 ranking heat, stopped arming, or wedged admission fails here at tier-1
 cost, not in a production hotspot.
 
+Stage 8 (``scan``): the columnar range-read path (ISSUE 9) — rows
+loaded through real commits onto a DURABLE lsm-engine cluster (small
+MVCC window + fast durability ticks push them into sorted-run files,
+the shape the block-run extraction exists for), then full-table scans
+measured with CLIENT_PACKED_RANGE_READS off (the legacy per-row
+tuple-list path) vs on (packed GetRangeReply + run-wise merge + bulk
+client assembly) at a pinned 512-row chunk.  Results are asserted
+BYTE-IDENTICAL in situ and the packed side must hold a >= 3x rows/s
+edge.  A regression that made the engine run extraction per-row again,
+broke the overlay merge, or stalled the continuation cursor fails here
+at tier-1 cost, not at r-bench.
+
 Stage 7 (``backup``): the feed-native backup/restore round trip
 (ISSUE 8) — an in-process cluster loaded through real commits, a
 whole-db feed tail + packed snapshot into a BackupContainer, more
@@ -66,7 +78,7 @@ the .mlog flush path, or the chunked restore quadratic — or that
 silently lost/duplicated a mutation — fails here at tier-1 cost,
 under the standing hard wedge deadline.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|all]
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -106,6 +118,11 @@ HEAT_RANK_MARGIN = 3.0      # hot shard rw rate vs the next-hottest
 BACKUP_TXNS = 150           # commits per phase (pre-snapshot / post)
 BACKUP_CLIENTS = 8
 BACKUP_BUDGET_S = 90.0      # measured ~5s on a loaded 2-cpu host
+SCAN_ROWS = 24_000          # rows loaded through real commits
+SCAN_CHUNK = 512            # per-fetch row limit, pinned via the byte budget
+SCAN_SWEEPS = 3             # full-table sweeps per side of the A/B
+SCAN_BUDGET_S = 90.0        # doubles as the hard wedge deadline
+SCAN_SPEEDUP_FLOOR = 3.0    # packed rows/s vs legacy rows/s
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -989,13 +1006,213 @@ def check_backup(budget_s: float = BACKUP_BUDGET_S,
     return elapsed
 
 
+def scan_path_seconds(n_rows: int = SCAN_ROWS, chunk: int = SCAN_CHUNK,
+                      sweeps: int = SCAN_SWEEPS,
+                      deadline_s: float | None = None
+                      ) -> tuple[float, dict]:
+    """Wall seconds for the columnar range-read smoke (ISSUE 9):
+    ``n_rows`` loaded through real commits onto a DURABLE lsm cluster,
+    the MVCC window shrunk so durability pushes them into sorted-run
+    files, then ``sweeps`` full-table scans per side of the in-run A/B
+    — CLIENT_PACKED_RANGE_READS off (legacy tuple-list path) vs on
+    (packed replies + run-wise merge) — with results asserted
+    BYTE-IDENTICAL in situ.  The chunk is pinned at ``chunk`` rows by
+    sizing CLIENT_RANGE_CHUNK_BYTES to exactly chunk * row_bytes, so
+    both sides pay the identical continuation-cursor schedule."""
+    import foundationdb_tpu.storage.lsm as lsm_mod
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    val = b"v" * 64
+    row_bytes = 13 + len(val)                   # 1 + len("scan%08d"), exact
+    knobs = Knobs().override(
+        STORAGE_ENGINE="lsm",
+        # push the loaded rows into the engine fast: a 1k-version MVCC
+        # window ages out within a couple of 50ms durability ticks
+        STORAGE_VERSION_WINDOW=1_000,
+        STORAGE_DURABILITY_LAG=0.05,
+        CLIENT_RANGE_CHUNK_ROWS=chunk,
+        CLIENT_RANGE_CHUNK_BYTES=chunk * row_bytes)
+    try:
+        from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
+        CppConflictSet()
+        knobs = knobs.override(RESOLVER_CONFLICT_BACKEND="cpp")
+    except Exception:  # noqa: BLE001 — numpy twin, generous budget
+        pass
+
+    def key(i: int) -> bytes:
+        # half below / half above the 2-shard split at \x80: the scan
+        # fans out across shards like a real full-table sweep
+        prefix = b"\x20" if i < n_rows // 2 else b"\xa0"
+        return prefix + b"scan%08d" % i
+
+    async def main() -> tuple[float, dict]:
+        # small lsm thresholds: the load flushes into SEVERAL sorted-run
+        # files (compaction deferred), so the A/B measures the block-run
+        # extraction + multi-run merge — the shape a scan-heavy workload
+        # sees after sustained write traffic — not a pure-memtable scan
+        saved = (lsm_mod._MEMTABLE_BYTES, lsm_mod._BLOCK_BYTES,
+                 lsm_mod._MAX_RUNS)
+        lsm_mod._MEMTABLE_BYTES = 128 << 10
+        lsm_mod._BLOCK_BYTES = 16 << 10
+        lsm_mod._MAX_RUNS = 16
+        try:
+            t_all = time.perf_counter()
+            cluster = await Cluster.create(
+                ClusterConfig(storage_servers=2), knobs,
+                fs=SimFileSystem(), data_dir="scan-db")
+            cluster.start()
+
+            async def loader(idxs: list[int]) -> None:
+                tr = Transaction(cluster)
+                for start in range(0, len(idxs), 512):
+                    while True:
+                        for i in idxs[start:start + 512]:
+                            tr.set(key(i), val)
+                        try:
+                            await tr.commit()
+                            break
+                        except FdbError as e:
+                            await tr.on_error(e)
+                    tr.reset()
+
+            async def drain_to_engine() -> None:
+                # wait for the durability floor to pass the load: rows
+                # must live in the ENGINE (sorted runs), not the MVCC
+                # overlay — proxies keep empty version batches flowing,
+                # so the floor advances without more commits
+                tip = cluster.sequencer.committed_version
+                while any(s.durable_version < tip
+                          for s in cluster.storage_servers):
+                    await asyncio.sleep(0.05)
+
+            # THREE sequential waves — striped i % waves so every wave
+            # exceeds the memtable threshold on BOTH shards — each
+            # drained into the engine before the next: every wave
+            # forces >= 1 sorted-run flush per shard DETERMINISTICALLY.
+            # On a starved box a single durability tick otherwise
+            # carries the whole load as one giant slice and the A/B
+            # would measure a 1-run scan.
+            waves = 3
+            for w in range(waves):
+                idxs = list(range(w, n_rows, waves))
+                span = (len(idxs) + 7) // 8
+                await asyncio.gather(
+                    *(loader(idxs[j * span:(j + 1) * span])
+                      for j in range(8)))
+                await drain_to_engine()
+            runs = [len(getattr(s.engine, "_runs", []))
+                    for s in cluster.storage_servers]
+            assert all(r >= 3 for r in runs), (
+                f"load never reached the sorted runs (runs={runs}) — "
+                f"the A/B would measure a memtable scan")
+
+            # every range reply crosses the REAL wire codec, exactly as
+            # TcpTransport serializes it in production (the in-process
+            # shortcut passes tuple lists by reference, which hides the
+            # per-row encode/decode the packed columns exist to delete —
+            # the A/B must charge both sides their true wire cost)
+            from foundationdb_tpu.rpc.wire import decode, encode
+            for g in cluster._replica_groups:
+                inner_l = g.get_key_values
+                inner_p = g.get_key_values_packed
+
+                async def legacy_wire(b, e, v, limit=0, rev=False, bl=0,
+                                      inner=inner_l):
+                    args = decode(encode([b, e, v, limit, rev, bl]))
+                    return decode(encode(await inner(*args)))
+
+                async def packed_wire(req, inner=inner_p):
+                    return decode(encode(await inner(decode(encode(req)))))
+
+                g.get_key_values = legacy_wire
+                g.get_key_values_packed = packed_wire
+
+            async def sweep(packed: bool) -> tuple[list, float]:
+                cluster.knobs = base_knobs.override(
+                    CLIENT_PACKED_RANGE_READS=packed)
+                tr = Transaction(cluster)
+                t0 = time.perf_counter()
+                rows = await tr.get_range(b"\x20", b"\xa1", snapshot=True)
+                assert len(rows) == n_rows, len(rows)
+                return rows, time.perf_counter() - t0
+
+            # interleaved A/B, best-of-N per side: host-load noise on a
+            # shared CI box must not flip the ratio assertion
+            base_knobs = cluster.knobs
+            await sweep(False)          # warm caches on both paths
+            await sweep(True)
+            legacy_s = packed_s = float("inf")
+            legacy_rows = packed_rows = None
+            for _ in range(sweeps):
+                rows, t = await sweep(False)
+                legacy_rows, legacy_s = rows, min(legacy_s, t)
+                rows, t = await sweep(True)
+                packed_rows, packed_s = rows, min(packed_s, t)
+            assert packed_rows == legacy_rows, (
+                "packed scan diverged from the legacy tuple path — a "
+                "wrong row is worse than a slow one")
+            stats = {
+                "rows": n_rows,
+                "engine_runs": runs,
+                "legacy_rows_per_sec":
+                    n_rows / legacy_s if legacy_s else 0.0,
+                "packed_rows_per_sec":
+                    n_rows / packed_s if packed_s else 0.0,
+                "speedup": legacy_s / packed_s if packed_s else 0.0,
+                "chunk": chunk,
+            }
+            elapsed = time.perf_counter() - t_all
+            await cluster.stop()
+            return elapsed, stats
+        finally:
+            (lsm_mod._MEMTABLE_BYTES, lsm_mod._BLOCK_BYTES,
+             lsm_mod._MAX_RUNS) = saved
+
+    async def bounded():
+        return await asyncio.wait_for(main(), deadline_s)
+
+    try:
+        return asyncio.run(bounded())
+    except asyncio.TimeoutError:
+        raise AssertionError(
+            f"scan smoke wedged: the {deadline_s:.0f}s deadline hit — a "
+            f"stalled continuation cursor or an engine merge that never "
+            f"terminated, not just slowness") from None
+
+
+def check_scan(budget_s: float = SCAN_BUDGET_S, quiet: bool = False
+               ) -> float:
+    """Run the columnar range-read smoke; raises AssertionError on a
+    byte-identity failure, below the packed-vs-legacy rows/s floor,
+    past the budget, or at the wedge deadline."""
+    elapsed, stats = scan_path_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] scan: {stats['rows']} rows x {SCAN_SWEEPS} "
+              f"sweeps, legacy {stats['legacy_rows_per_sec']:.0f} rows/s "
+              f"vs packed {stats['packed_rows_per_sec']:.0f} rows/s "
+              f"({stats['speedup']:.1f}x) at chunk {stats['chunk']}, "
+              f"engine runs={stats['engine_runs']}")
+    assert elapsed < budget_s, (
+        f"scan smoke took {elapsed:.1f}s (budget {budget_s:.0f}s) — the "
+        f"range path grew a per-row or per-chunk quadratic shape")
+    assert stats["speedup"] >= SCAN_SPEEDUP_FLOOR, (
+        f"packed scan speedup {stats['speedup']:.2f}x under the "
+        f"{SCAN_SPEEDUP_FLOOR:.0f}x floor vs the legacy tuple-list path "
+        f"at chunk {SCAN_CHUNK} — the columnar range path lost its edge")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
     ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
     ap.add_argument("--stage",
                     choices=("apply", "pipeline", "feed", "read",
-                             "resolve", "heat", "backup", "all"),
+                             "resolve", "heat", "backup", "scan", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -1005,6 +1222,7 @@ def main() -> int:
                     default=RESOLVE_BUDGET_S)
     ap.add_argument("--heat-budget", type=float, default=HEAT_BUDGET_S)
     ap.add_argument("--backup-budget", type=float, default=BACKUP_BUDGET_S)
+    ap.add_argument("--scan-budget", type=float, default=SCAN_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -1020,6 +1238,8 @@ def main() -> int:
         check_heat(budget_s=args.heat_budget)
     if args.stage in ("backup", "all"):
         check_backup(budget_s=args.backup_budget)
+    if args.stage in ("scan", "all"):
+        check_scan(budget_s=args.scan_budget)
     return 0
 
 
