@@ -985,6 +985,76 @@ def run_scan_phase(quiet: bool) -> dict:
     return r
 
 
+def run_bigkeys_phase(quiet: bool) -> dict:
+    """Bigkeys operating point (ISSUE 11): the read_point and scan
+    stages' shapes at a ≥2M-row keyspace, so the trajectory files show
+    SCALE, not just rate.  The keyspace is applied through real packed
+    commit batches at the storage boundary (the TLog-pull apply shape —
+    a 2M-row load through the full client pipeline would be a
+    20-minute stage on this box), then point/multiget/scan rates are
+    measured server-side off the columnar index, plus the index's
+    resident bytes/key."""
+    import asyncio
+
+    from foundationdb_tpu.core.data import GetValuesRequest, KeyRange
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    # the workload shape lives in ONE home (tools/perf_smoke.py): the
+    # bigkeys tier-1 smoke and this stage must measure the same thing
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import perf_smoke
+
+    n_rows = 2_000_000
+    key = perf_smoke.bigkeys_key_fn(n_rows)
+
+    async def main() -> dict:
+        knobs = Knobs().override(STORAGE_VERSION_WINDOW=1 << 60)
+        ss = StorageServer(knobs, 0, KeyRange(b"", b"\xff"), TLog(knobs))
+        version, apply_s = await perf_smoke.apply_bigkeys(ss, n_rows, key)
+
+        from foundationdb_tpu.bench.workload import ZipfianGenerator
+        zipf = ZipfianGenerator(n_rows, 0.99, 23)
+        # zipfian point reads via the packed multiget RPC shape
+        n_point = 16_384
+        t0 = time.perf_counter()
+        got = 0
+        for _ in range(n_point // 64):
+            ks = sorted({key(int(i)) for i in zipf.sample(64)})
+            rep = await ss.get_values(
+                GetValuesRequest.from_keys(ks, version))
+            got += len(ks)
+            assert all(c <= 1 for c in rep.codes)
+        point_s = time.perf_counter() - t0
+        # packed chunked scan over a 500k-row interval
+        scan_rows = 500_000
+        t0 = time.perf_counter()
+        seen = len(await perf_smoke.packed_scan(
+            ss, b"big%012d" % 0, b"big%012d" % scan_rows, version))
+        scan_s = time.perf_counter() - t0
+        assert seen == scan_rows, seen
+        idx = ss.vmap.index_stats()
+        return {
+            "bigkeys_rows": n_rows,
+            "bigkeys_apply_keys_per_sec": round(n_rows / apply_s, 1),
+            "bigkeys_point_keys_per_sec":
+                round(got / point_s, 1) if point_s else 0.0,
+            "bigkeys_scan_rows_per_sec":
+                round(seen / scan_s, 1) if scan_s else 0.0,
+            "bigkeys_index_bytes_per_key":
+                (round(idx["base_bytes"] / n_rows, 2)
+                 if idx.get("base_bytes") else None),
+            "bigkeys_index_merges": idx["merges"],
+        }
+
+    r = asyncio.run(main())
+    if not quiet:
+        print(f"[bench] bigkeys: {r}", file=sys.stderr)
+    return r
+
+
 def run_hot_shard_phase(quiet: bool) -> dict:
     """Hot-shard stage (ISSUE 7): sustained zipf-0.99 write+read skew
     against a LIVE cluster — the 6-machine simulated fleet running on
@@ -1656,6 +1726,15 @@ def main() -> int:
                 args.stage_timeout, out)
             if sc is not None:
                 out.update(sc)
+
+            # bigkeys operating point (ISSUE 11): the read_point/scan
+            # shapes at a ≥2M-row keyspace off the columnar index, so
+            # the trajectory shows scale, not just rate
+            bk = call_bounded(
+                "bigkeys", lambda: run_bigkeys_phase(args.quiet),
+                args.stage_timeout, out)
+            if bk is not None:
+                out.update(bk)
 
             # hot-shard economics (ISSUE 7): a live heat split under
             # sustained zipf skew, with before/after read p99 and the

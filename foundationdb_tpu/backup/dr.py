@@ -210,15 +210,21 @@ class DRAgent:
             tr.clear_range(b"", SYSTEM_PREFIX)
         await self.dest.run(wipe)
         version: Version | None = None
+        # columns mode (ROADMAP item 2 follow-up (d)): pages arrive as
+        # PackedRows — the packed range replies' columns concatenated,
+        # never a tuple list — and each destination chunk is one
+        # bounds-rebased slice; rows materialize only at tr.set, where
+        # a Mutation needs real bytes anyway
         async for page, version in paged_snapshot(self.src, b"",
-                                                  SYSTEM_PREFIX):
+                                                  SYSTEM_PREFIX,
+                                                  columns=True):
             for start in range(0, len(page), self.rows_per_txn):
-                chunk = page[start:start + self.rows_per_txn]
+                chunk = page.slice(start, start + self.rows_per_txn)
 
                 async def put(tr, chunk=chunk):
                     tr.lock_aware = True
                     for k, v in chunk:
-                        tr.set(bytes(k), bytes(v))
+                        tr.set(k, v)
                 await self.dest.run(put)
         return version if version is not None else 0
 

@@ -125,6 +125,13 @@ class Cluster:
                     + [e.meta.get("durable_version", 0)
                        for e in engines.values()] + [0]) + 1
         cluster = cls(config, knobs, epoch, tlogs=tlogs, engines=engines)
+        # durability-ring spill side files (ISSUE 11): one fresh queue
+        # per storage server — truncated, never recovered (the ring
+        # replays from the TLog; the invariant lives in
+        # StorageServer.attach_fresh_dbuf_queue)
+        for ss in cluster.storage_servers:
+            await ss.attach_fresh_dbuf_queue(
+                fs, f"{data_dir}/storage-{ss.tag}")
         # the sequencer hands out prev_version == epoch on its first batch;
         # the recovered TLogs (built before cls()) must have their chain
         # tips bumped to it or the first push would wait forever (the

@@ -682,6 +682,42 @@ class GetRangeReply:
         return cls(status, False)
 
 
+@dataclasses.dataclass
+class GetKeyRequest:
+    """Packed selector-resolve request (PROTOCOL_VERSION 716, ISSUE 11)
+    — the getKeyQ shape (REF:fdbserver/storageserver.actor.cpp getKeyQ).
+    Asks one storage server for the ``offset``-th LIVE row of its clip
+    of [begin, end) at ``version`` (counting from the end when
+    ``reverse``).  The client walks shards with the residual offset, so
+    a cross-shard selector costs one tiny reply per shard instead of
+    shipping ``offset`` full rows through the range path — the last
+    per-row client surface gone columnar (ROADMAP item 2 follow-up
+    (b))."""
+
+    begin: bytes = b""
+    end: bytes = b""
+    version: Version = 0
+    offset: int = 1
+    reverse: bool = False
+
+
+@dataclasses.dataclass
+class GetKeyReply:
+    """Reply to GetKeyRequest: ONE key instead of ``offset`` rows.
+
+    ``status`` reuses the GV_* codes (0 = ok) with the GetRangeReply
+    wholesale-refusal discipline (a lagging/compacted replica refuses,
+    the client's replica failover tries a teammate).  ``count`` is how
+    many live rows the clip actually held (capped at the requested
+    offset); when ``count == offset``, ``key`` is the resolved key —
+    otherwise the client carries ``offset - count`` into the next
+    shard."""
+
+    status: int = 0
+    count: int = 0
+    key: bytes = b""
+
+
 class MutationBatchBuilder:
     """Append-only MutationBatch assembly (one blob join at finish)."""
 

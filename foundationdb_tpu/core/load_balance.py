@@ -163,6 +163,18 @@ class ReplicaGroup:
 
         return await self._failover(attempt)
 
+    async def get_key(self, req):
+        """Packed selector resolution with the same replica failover as
+        the other packed reads: a refused reply (lagging replica,
+        compacted floor, relinquished range) penalizes and tries the
+        next teammate; only when every replica refuses does the client
+        see the status code."""
+        async def attempt(storage):
+            reply = await storage.get_key(req)
+            return reply.status == 0, reply
+
+        return await self._failover(attempt)
+
     async def watch_value(self, key: bytes, value, version: int):
         return await self._call("watch_value", key, value, version)
 

@@ -66,8 +66,14 @@ class StorageServer:
         self.vmap.latest_version = v0
         # pending-durable ops, packed (a ring of MutationBatch segments
         # with a bisect version cursor — each durability tick commits a
-        # slice instead of rebuilding a tuple list, ROADMAP PR 1 (c))
-        self._dbuf = DurabilityRing()
+        # slice instead of rebuilding a tuple list, ROADMAP PR 1 (c)).
+        # On durable deployments a DiskQueue side file attaches
+        # (attach_dbuf_queue) so a throttled engine commit spills the
+        # retained window to disk instead of growing RSS without bound
+        # (ISSUE 11; the TLog keeps every replay copy, so the side file
+        # carries no recovery obligation)
+        self._dbuf = DurabilityRing(
+            spill_bytes=knobs.STORAGE_DBUF_SPILL_BYTES)
         self._version_waiters: dict[Version, list[asyncio.Future]] = {}
         # feed streams parked until the COMMITTED frontier (not the raw
         # applied tip) reaches their cursor: (target, future)
@@ -148,6 +154,44 @@ class StorageServer:
             if srv.active:
                 self._device_reads = srv
 
+    def attach_dbuf_queue(self, queue) -> None:
+        """Arm the durability ring's disk spill with a per-server
+        DiskQueue side file (ISSUE 11).  Callers hand a FRESH (truncated)
+        queue: ring contents above the durable floor replay from the
+        TLog after any reboot, so stale side-file bytes must never be
+        adopted — prefer ``attach_fresh_dbuf_queue``, which owns that
+        invariant."""
+        self._dbuf.queue = queue
+
+    async def attach_fresh_dbuf_queue(self, fs, base: str) -> None:
+        """THE one home of the spill side-file lifecycle (worker reboot
+        adoption, recruits, Cluster.create): truncate
+        ``<base>.dbuf.dq`` — never recover it — then open and attach.
+        Stale bytes must never be adopted: everything the ring ever
+        holds is above the durable floor and replays from the TLog."""
+        from ..storage.disk_queue import DiskQueue
+        f = fs.open(base + ".dbuf.dq")
+        await f.truncate(0)
+        await f.sync()
+        queue, _ = await DiskQueue.open(f)
+        self.attach_dbuf_queue(queue)
+
+    async def _maybe_spill_dbuf(self) -> None:
+        """Best-effort spill pass (pull/durability loop hook): failures
+        keep the memory copy — losing buffered ops to a side-file error
+        would be data loss, growing RSS is not."""
+        try:
+            spilled = await self._dbuf.maybe_spill()
+        except Exception as e:  # noqa: BLE001 — retry on a later pass
+            TraceEvent("StorageDbufSpillError", severity=30) \
+                .detail("Tag", self.tag).error(e).log()
+            return
+        if spilled:
+            TraceEvent("StorageDbufSpill").detail("Tag", self.tag) \
+                .detail("Bytes", spilled) \
+                .detail("MemBytes", self._dbuf.mem_bytes) \
+                .detail("SpilledBytes", self._dbuf.spilled_bytes).log()
+
     async def metrics(self) -> dict:
         """Queue/lag sample for the Ratekeeper (StorageQueuingMetrics
         analog, REF:fdbserver/storageserver.actor.cpp)."""
@@ -185,6 +229,7 @@ class StorageServer:
             "shard_writes_per_sec": round(heat_w, 3),
             "shard_write_bytes_per_sec": round(heat_wb, 3),
             "shard_rw_per_sec": round(heat_r + heat_w, 3),
+            **self._dbuf.stats(),
             **self.feeds.metrics(),
             **self.spans.counters(),
             **(self._device_reads.metrics()
@@ -499,6 +544,13 @@ class StorageServer:
                 self._apply_batch(chunk)
                 if i < len(entries):
                     await asyncio.sleep(0)
+            # the memory-wall valve (ISSUE 11): a durability tick whose
+            # engine commit drags (throttled disk) cannot spill from
+            # inside its own await, so the PULL side sheds the retained
+            # window to the side file whenever the budget is exceeded —
+            # RSS stays bounded even while a commit is in flight
+            if self._dbuf.needs_spill:
+                await self._maybe_spill_dbuf()
             if reply.end_version - 1 > self.version:
                 self._bump_version(reply.end_version - 1)
             if self.engine is None:
@@ -518,13 +570,23 @@ class StorageServer:
         from ..runtime.trace import TraceEvent
         while True:
             await asyncio.sleep(self.knobs.STORAGE_DURABILITY_LAG)
+            if self._dbuf.needs_spill:
+                await self._maybe_spill_dbuf()
             floor = self.version - self.knobs.STORAGE_VERSION_WINDOW
             if floor > self.durable_version:
                 # O(slice): the packed ring bisects its version cursor;
                 # nothing else in the buffer is touched.  The cursor only
                 # advances AFTER the engine committed, so a failed tick
-                # retries the identical slice.
-                ops = self._dbuf.peek_through(floor)
+                # retries the identical slice.  Spilled frames at or
+                # below the floor read back transparently (and a crc
+                # failure raises into the retry below rather than
+                # silently committing a short slice).
+                try:
+                    ops = await self._dbuf.peek_through(floor)
+                except Exception as e:  # noqa: BLE001 — trace + retry
+                    TraceEvent("StorageDurabilityError", severity=40).detail(
+                        "Tag", self.tag).error(e).log()
+                    continue
                 try:
                     await self.engine.commit(ops, {
                         "durable_version": floor,
@@ -542,7 +604,18 @@ class StorageServer:
                     TraceEvent("StorageDurabilityError", severity=40).detail(
                         "Tag", self.tag).error(e).log()
                     continue
-                self._dbuf.pop_through(floor)
+                # the pop does side-file I/O since ISSUE 11 (releasing
+                # the spilled frames' dead prefix): disk trouble there
+                # must not kill the task any more than in engine.commit
+                # — the cursor didn't move, so the next tick re-peeks
+                # and re-commits the identical slice (the documented
+                # retry contract; engine re-commits are idempotent)
+                try:
+                    await self._dbuf.pop_through(floor)
+                except Exception as e:  # noqa: BLE001 — retry next tick
+                    TraceEvent("StorageDurabilityError", severity=40).detail(
+                        "Tag", self.tag).error(e).log()
+                    continue
                 self.bytes_durable += ops.nbytes
                 self.durable_version = floor
                 self.oldest_version = floor
@@ -1311,6 +1384,73 @@ class StorageServer:
             if found and wv is not None:
                 push([(wk, wv)])
         return out, hit
+
+    async def get_key(self, req) -> "GetKeyReply":
+        """Packed selector resolution — the getKeyQ shape (ISSUE 11,
+        PROTOCOL_VERSION 716): find the ``req.offset``-th live row of
+        this server's clip of [begin, end) at ``req.version`` (from the
+        end when ``req.reverse``) and reply with ONE key plus the live
+        count, instead of shipping ``offset`` full rows through the
+        range path.  Rows are located by the same merged extraction the
+        packed range read uses (engine block runs + lazy MVCC overlay
+        forward; the row-wise reverse merge backward), so the resolved
+        key is byte-identical to what a range row-probe returned.
+        Refusals ride the GV_* status byte wholesale, the GetRangeReply
+        discipline."""
+        from ..runtime.errors import WrongShardServer
+        from .data import (GV_FUTURE_VERSION, GV_TOO_OLD, GV_WRONG_SHARD,
+                           GetKeyReply)
+        span_ctx = current_span()
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.getKey.Before",
+                         Version=req.version, Tag=self.tag)
+        status = 0
+        try:
+            await self._wait_fetched()
+            await self._wait_for_version(req.version)
+        except FutureVersion:
+            status = GV_FUTURE_VERSION
+        except BaseException as e:
+            self.spans.event("TransactionDebug", span_ctx,
+                             "StorageServer.getKey.Error",
+                             Version=req.version, Tag=self.tag,
+                             Error=type(e).__name__)
+            raise
+        if not status and req.version < self.oldest_version:
+            status = GV_TOO_OLD
+        if not status:
+            try:
+                self._check_dropped(req.version, req.begin, req.end)
+            except WrongShardServer:
+                status = GV_WRONG_SHARD
+        if status:
+            self.spans.event("TransactionDebug", span_ctx,
+                             "StorageServer.getKey.After",
+                             Version=req.version, Tag=self.tag,
+                             Status=status)
+            return GetKeyReply(status, 0, b"")
+        self.total_reads += 1
+        self.heat.record_reads(1, max(req.begin, self.shard.begin))
+        b = max(req.begin, self.shard.begin)
+        e = min(req.end, self.shard.end)
+        n = max(1, req.offset)
+        if b >= e:
+            rows: list = []
+        elif req.reverse:
+            rows = (self.vmap.range_read(b, e, req.version, n, True, 0)
+                    if self.engine is None else
+                    self._merged_range_read(b, e, req.version, n,
+                                            True, 0))[0]
+        elif self.engine is None:
+            rows = self.vmap.range_rows(b, e, req.version, n, 0)[0]
+        else:
+            rows = self._merged_range_packed(b, e, req.version, n, 0)[0]
+        count = len(rows)
+        key = bytes(rows[-1][0]) if count >= n else b""
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.getKey.After",
+                         Version=req.version, Tag=self.tag, Count=count)
+        return GetKeyReply(0, count, key)
 
     # --- change feeds (REF: storageserver.actor.cpp changeFeedStreamQ) ---
 

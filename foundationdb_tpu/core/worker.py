@@ -258,10 +258,14 @@ class Worker:
         return token
 
     async def _attach_feed_store(self, ss: StorageServer, base: str) -> None:
-        """Swap a DiskQueue-backed ChangeFeedStore into a durable storage
-        server: registrations come from the engine meta, spilled
-        retention segments re-index from the side queue's surviving
-        frames (ISSUE 4 retention spill/recovery)."""
+        """Attach the durable side queues to a storage server: a
+        DiskQueue-backed ChangeFeedStore (registrations come from the
+        engine meta, spilled retention segments re-index from the side
+        queue's surviving frames — ISSUE 4), and the durability ring's
+        spill file (ISSUE 11).  The ring's file is truncated FRESH:
+        everything it ever holds is above the durable floor and replays
+        from the TLog after a reboot, so stale bytes must never be
+        adopted."""
         from ..storage.disk_queue import DiskQueue
         from .change_feed import ChangeFeedStore
         queue, frames = await DiskQueue.open(self.fs.open(base + ".feeds.dq"))
@@ -269,6 +273,7 @@ class Worker:
         meta = ss.engine.meta.get("feeds") if ss.engine is not None else None
         store.restore(meta or [], frames, queue.front_offset)
         ss.feeds = store
+        await ss.attach_fresh_dbuf_queue(self.fs, base)
 
     async def stop_role(self, token: int, destroy: bool = False) -> bool:
         """Stop a hosted role.  ``destroy=True`` additionally deletes the

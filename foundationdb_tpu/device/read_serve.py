@@ -30,6 +30,19 @@ Results are BYTE-IDENTICAL to ``engine.get_batch`` by construction: the
 device only locates candidate bands; membership is decided by the same
 bisect refinement the host index uses, and values come from the same
 engine storage.
+
+Two engine shapes share the mirror (ISSUE 11).  ``membership`` mode
+(MemoryKVStore): the mirrored run is the engine's full key index — the
+device locates the key's band, the host decides membership and gathers
+the value.  ``blocks`` mode (LSMKVStore): the mirrored run is the
+engine's MERGED SPARSE INDEX (every sorted run's block first-keys in one
+sorted KeyRun, ``lsm.LsmSparseIndex``) — the one device searchsorted
+locates the candidate data block in EVERY run at once (the prefix-max
+table turns the merged position into per-run block indices), and the
+host finishes with ``engine.get_batch_located`` (memtable first, block
+decode + bisect, newest-run-wins).  This is where the vectorized gather
+finally replaces a real per-run sorted-probe descent (ROADMAP item 1
+(e)) instead of racing a dict lookup.
 """
 
 from __future__ import annotations
@@ -60,6 +73,7 @@ class DeviceKeyDirectory:
         self._device = device
         self._pfx_dev = None
         self._gen = -1          # index.gen the mirror was built at
+        self._jfn = None        # jitted fused searchsorted pair
         self.uploads = 0
         self.uploaded_keys = 0
 
@@ -82,13 +96,32 @@ class DeviceKeyDirectory:
 
     def lookup(self, keys: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
         """One device dispatch for the whole batch: (lo, hi) candidate
-        bands over the base run per key.  Caller must hold ``fresh``."""
-        import jax.numpy as jnp
+        bands over the base run per key.  Caller must hold ``fresh``.
+
+        The searchsorted pair runs as ONE jitted call with the probe
+        vector padded to a power-of-two bucket: eager per-op dispatch
+        costs ~1-3ms per call on a host-CPU backend (measured — it
+        inverted the multiget edge on the lsm read smoke), while the
+        fused jit is ~100µs with one compile per (mirror length,
+        bucket) pair; padding probes with u64-max keeps varying batch
+        sizes on a handful of compiled shapes, the resolver's bucket
+        discipline."""
+        import jax
         from ..ops.keycode import encode_prefix_u64
+        if self._jfn is None:
+            import jax.numpy as jnp
+            self._jfn = jax.jit(lambda pfx, probes: (
+                jnp.searchsorted(pfx, probes, side="left"),
+                jnp.searchsorted(pfx, probes, side="right")))
         probes = encode_prefix_u64(keys)
-        los = jnp.searchsorted(self._pfx_dev, probes, side="left")
-        his = jnp.searchsorted(self._pfx_dev, probes, side="right")
-        return np.asarray(los), np.asarray(his)
+        n = len(probes)
+        bucket = 1 << max(0, (n - 1).bit_length())
+        if bucket > n:
+            probes = np.concatenate(
+                [probes, np.full(bucket - n, np.uint64(0xFFFFFFFFFFFFFFFF),
+                                 dtype=np.uint64)])
+        los, his = self._jfn(self._pfx_dev, probes)
+        return np.asarray(los)[:n], np.asarray(his)[:n]
 
 
 class DeviceReadServer:
@@ -103,6 +136,10 @@ class DeviceReadServer:
         self.knobs = knobs
         self.min_batch = max(1, knobs.STORAGE_DEVICE_READ_MIN_BATCH)
         index = getattr(engine, "packed_index", None)
+        # how the host finishes a device-located batch: "membership"
+        # (full key index + engine.get) or "blocks" (merged sparse
+        # directory + engine.get_batch_located) — see module docstring
+        self._mode = getattr(index, "device_mode", "membership")
         self._dir = None
         if index is not None and knobs.STORAGE_DEVICE_READ_SERVE \
                 and _jax_ready():
@@ -129,21 +166,34 @@ class DeviceReadServer:
             self.fallbacks += 1
             self._dir.refresh()
             return None
-        los, his = self._dir.lookup(keys)
         base = index.base_run()
-        pending = index.pending_run()
-        get = self.engine.get
-        out: list[bytes | None] = []
-        for k, lo, hi in zip(keys, los, his):
-            lo, hi = int(lo), int(hi)
-            present = False
-            if lo < hi:
-                i = bisect.bisect_left(base, k, lo, hi)
-                present = i < hi and base[i] == k
-            if not present and pending:
-                j = bisect.bisect_left(pending, k)
-                present = j < len(pending) and pending[j] == k
-            out.append(get(k) if present else None)
+        if not len(base):
+            # nothing mirrored yet (empty index / no sorted runs):
+            # the engine path answers without a device dispatch
+            self.fallbacks += 1
+            return None
+        los, his = self._dir.lookup(keys)
+        if self._mode == "blocks":
+            # merged sparse directory: the band refines to the exact
+            # bisect_right position, whose prefix-max row names the
+            # candidate block in every run; the engine finishes host-side
+            pos = [base.bisect_right(k, int(lo), int(hi))
+                   for k, lo, hi in zip(keys, los, his)]
+            out = self.engine.get_batch_located(keys, pos)
+        else:
+            pending = index.pending_run()
+            get = self.engine.get
+            out = []
+            for k, lo, hi in zip(keys, los, his):
+                lo, hi = int(lo), int(hi)
+                present = False
+                if lo < hi:
+                    i = bisect.bisect_left(base, k, lo, hi)
+                    present = i < hi and base[i] == k
+                if not present and pending:
+                    j = bisect.bisect_left(pending, k)
+                    present = j < len(pending) and pending[j] == k
+                out.append(get(k) if present else None)
         self.served_batches += 1
         self.served_keys += len(keys)
         return out

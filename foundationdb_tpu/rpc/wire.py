@@ -236,6 +236,7 @@ def _register_core_structs() -> None:
         cf.ChangeFeedStreamRequest, cf.ChangeFeedStreamReply,
         d.GetValuesRequest, d.GetValuesReply,
         d.GetRangeRequest, d.GetRangeReply,
+        d.GetKeyRequest, d.GetKeyReply,
     ]):
         register_struct(cls, sid=i)
 

@@ -90,7 +90,11 @@ class Knobs:
     # 16/17) on the storage read surface, rows as packed key/value blobs
     # + cumulative u32 bounds with a per-chunk status byte; a 714 peer
     # cannot decode the struct ids, so the gate fences it
-    PROTOCOL_VERSION: int = 715
+    # 716: packed selector resolution — GetKeyRequest/Reply (wire struct
+    # ids 18/19): key selectors resolve to ONE key per shard reply
+    # instead of row-probing ``offset`` rows through the range path; a
+    # 715 peer cannot decode the struct ids, so the gate fences it
+    PROTOCOL_VERSION: int = 716
     # --- change feeds ---
     # (sealed feed segments at or below the durable floor ALWAYS spill
     # to the DiskQueue side file on durable servers — a durability
@@ -110,6 +114,19 @@ class Knobs:
     STORAGE_DURABILITY_LAG: float = 0.25      # seconds between making versions durable
     STORAGE_FUTURE_VERSION_WAIT: float = 1.0  # read wait before future_version
     FETCH_KEYS_BYTES_PER_BATCH: int = 1 << 20
+    # durability-ring disk spill (ISSUE 11, the second memory wall): a
+    # storage server whose ENGINE commits lag its ingest retains the
+    # whole pending-durable window in the DurabilityRing — RSS grew
+    # without bound under a throttled disk.  When retained bytes exceed
+    # this budget, sealed segments spill (oldest first, fsync before
+    # the memory drop) to a per-server DiskQueue side file
+    # (storage-<tag>.dbuf.dq) and the per-tick commit slice reads them
+    # back transparently.  The side file carries no recovery
+    # obligation — the TLog is popped only after the engine commit, so
+    # a reboot replays the ring from the TLog and the side file is
+    # truncated at attach.  0 disables.  Memory-only servers (no
+    # engine) never buffer durably and are unaffected.
+    STORAGE_DBUF_SPILL_BYTES: int = 128 << 20
     # max mutations one synchronous _apply_batch slice may hold: a bulk
     # load's pull reply can carry 100k+ mutations, and applying them in
     # one event-loop turn is a ~100-500ms stall (SlowTask); the pull

@@ -9,30 +9,36 @@ YCSB-at-1M-rows collapse (BENCH_r05.json: ~900ms SlowTask stalls all in
 
 The replacement is two sorted runs merged lazily:
 
-- ``_base``   — the big immutable-ish sorted run (a plain list).
-- ``_pending``— a small sorted overlay absorbing inserts.
+- ``_base``   — the big immutable sorted run.  COLUMNAR by default
+  (ISSUE 11): a ``storage/key_runs.py`` ``KeyRun`` — one contiguous key
+  blob + cumulative int64 bounds with the keycode-u64 prefixes cached
+  alongside — so per-key memory is ~key_len + 8 instead of the ~50-100
+  bytes of PyObject overhead a ``list[bytes]`` pays (10M keys: tens of
+  MB instead of ~1GB).  ``columnar=False`` keeps the plain-list base —
+  the genuinely-old layout, retained as the equivalence/RSS A/B
+  baseline (tools/perf_smoke.py --stage bigkeys measures both).
+- ``_pending``— a small sorted ``list[bytes]`` overlay absorbing
+  inserts (always tiny relative to the base; object overhead is noise).
 
 Inserts go to the overlay (cheap memmove while it is small); when the
 overlay outgrows ``max(_PENDING_MIN, len(base) >> _MERGE_SHIFT)`` the two
-runs are merged in ONE pass (list concat + Timsort, which detects the
-two pre-sorted runs and gallops — O(n+m) comparisons, C speed).  Because
-the merge threshold scales with the base, a key insert costs amortized
-O(log n) memmove work overall — the same cost class as the PTree.
+runs are merged in ONE pass — columnar: a vectorized ``np.insert`` over
+the bounds + an O(m)-segment blob stitch; list: concat + Timsort's
+galloping two-run merge.  Because the merge threshold scales with the
+base, a key insert costs amortized O(log n) work overall in either mode
+— the same cost class as the PTree.
 
-Batch inserts (``add_many``) skip the per-key overlay memmove entirely:
-the fresh keys are sorted once and appended to the overlay in one go.
-Batch removals (``discard_many``) are one filtered pass instead of the
-seed's per-key bisect+del (the same quadratic shape on the compaction
-side).
+Batch inserts (``add_many``) skip the per-key overlay memmove entirely;
+batch removals (``discard_many``) are one located pass.
 
 Bound queries (range scans, clear_range) binary-search both runs.  For
 BATCHES of ranges (``ranges_keys``, fed by a run of consecutive clears
-in ``VersionedMap.apply_batch``) a numpy ``searchsorted`` over
-keycode-packed uint64 prefixes (lanes 0-1 of ops/keycode.py's encoding
-fused) resolves every bound in one vectorized call, with a Python
-bisect refining inside the equal-prefix band — the same
+in ``VersionedMap.apply_batch``) a numpy ``searchsorted`` over the
+cached keycode-u64 prefixes resolves every bound in one vectorized
+call, with a bisect refining inside the equal-prefix band — the same
 pack-keys-into-lane-arrays idiom the TPU resolver uses, applied to the
-storage role.
+storage role.  The prefix cache now lives on the ``KeyRun`` itself, the
+ONE home the lsm sparse index and the device read mirror share.
 """
 
 from __future__ import annotations
@@ -42,6 +48,8 @@ import time
 
 import numpy as np
 
+from .key_runs import KeyRun
+
 _PENDING_MIN = 1024     # overlay always allowed to reach this size
 _MERGE_SHIFT = 3        # ...or base/8, whichever is larger
 _ADD_PENDING_CAP = 8192  # single-key adds merge earlier: insort's memmove
@@ -49,16 +57,18 @@ _ADD_PENDING_CAP = 8192  # single-key adds merge earlier: insort's memmove
 #                          quadratic across a long run of lone set() calls
 _NP_MIN = 1 << 14       # numpy prefix fast path needs a base this large...
 _NP_BOUNDS_MIN = 16     # ...and this many bounds to amortize call overhead
-_SMALL_DISCARD = 32     # below this, per-key del beats a full filter pass
+_SMALL_DISCARD = 32     # list mode: below this, per-key del beats a filter
 
 
 class PackedKeyIndex:
-    __slots__ = ("_base", "_pending", "_pfx", "merges", "merge_s", "gen")
+    __slots__ = ("_base", "_pending", "_list_pfx", "merges", "merge_s",
+                 "gen", "columnar")
 
-    def __init__(self) -> None:
-        self._base: list[bytes] = []
+    def __init__(self, columnar: bool = True) -> None:
+        self.columnar = columnar
+        self._base: KeyRun | list[bytes] = KeyRun() if columnar else []
+        self._list_pfx: np.ndarray | None = None   # list-mode prefix cache
         self._pending: list[bytes] = []     # sorted overlay
-        self._pfx: np.ndarray | None = None  # lazy uint64 prefixes of _base
         self.merges = 0                      # observability: merge count
         self.merge_s = 0.0                   # ...and total merge seconds
         # base-run generation: bumped whenever _base mutates (merge,
@@ -73,12 +83,21 @@ class PackedKeyIndex:
     def __iter__(self):
         yield from self._merged(self._base, self._pending)
 
+    def _base_bisect(self, key: bytes, lo: int = 0,
+                     hi: int | None = None) -> int:
+        base = self._base
+        if self.columnar:
+            return base.bisect_left(key, lo, hi)
+        return bisect.bisect_left(base, key, lo,
+                                  len(base) if hi is None else hi)
+
     def __contains__(self, key: bytes) -> bool:
-        for run in (self._pending, self._base):
-            i = bisect.bisect_left(run, key)
-            if i < len(run) and run[i] == key:
-                return True
-        return False
+        i = bisect.bisect_left(self._pending, key)
+        if i < len(self._pending) and self._pending[i] == key:
+            return True
+        base = self._base
+        i = self._base_bisect(key)
+        return i < len(base) and base[i] == key
 
     def to_list(self) -> list[bytes]:
         return list(self)
@@ -114,12 +133,16 @@ class PackedKeyIndex:
 
     def _merge(self) -> None:
         t0 = time.perf_counter()
-        # two sorted runs back to back: Timsort's run detection makes
-        # this a single galloping merge, O(n+m)
-        self._base += self._pending
-        self._base.sort()
+        if self.columnar:
+            # one vectorized bounds insert + O(overlay) blob stitch
+            self._base = self._base.merge_sorted(self._pending)
+        else:
+            # two sorted runs back to back: Timsort's run detection makes
+            # this a single galloping merge, O(n+m)
+            self._base = self._base + self._pending
+            self._base.sort()
         self._pending = []
-        self._pfx = None
+        self._list_pfx = None
         self.merges += 1
         self.gen += 1
         self.merge_s += time.perf_counter() - t0
@@ -128,7 +151,7 @@ class PackedKeyIndex:
 
     def discard_many(self, keys: list[bytes]) -> None:
         """Remove keys (each assumed present in at most one run) in one
-        filtered pass per run — never a per-key bisect+del over the base."""
+        located pass per run — never a per-key bisect+del over the base."""
         if not keys:
             return
         dead = set(keys)
@@ -139,6 +162,11 @@ class PackedKeyIndex:
                 self._pending = kept
                 if removed == len(dead):
                     return
+        if self.columnar:
+            self._base, removed = self._base.delete_keys(list(dead))
+            if removed:
+                self.gen += 1
+            return
         base = self._base
         if len(dead) <= _SMALL_DISCARD:
             hit = False
@@ -148,46 +176,52 @@ class PackedKeyIndex:
                     del base[i]
                     hit = True
             if hit:
-                self._pfx = None
+                self._list_pfx = None
                 self.gen += 1
         else:
             nb = len(base)
             self._base = [k for k in base if k not in dead]
             if len(self._base) != nb:
-                self._pfx = None
+                self._list_pfx = None
                 self.gen += 1
 
     # --- bound queries ---
     #
     # A LONE bound query stays on bisect: measured at 1M keys, plain
-    # bisect_left is ~0.8µs while a scalar np.searchsorted costs ~5µs of
-    # numpy call overhead (and >4ms if the probe is a Python int — the
-    # uint64 array silently promotes to float64 per call).  The numpy
-    # prefix path only wins BATCHED, where one vectorized searchsorted
-    # over all 2N bounds amortizes the call overhead — see ranges_keys.
+    # bisect_left is ~0.8µs (list) / a few µs of per-step key slicing
+    # (columnar) while a scalar np.searchsorted costs ~5µs of numpy call
+    # overhead per probe.  The numpy prefix path only wins BATCHED,
+    # where one vectorized searchsorted over all 2N bounds amortizes the
+    # call overhead — see ranges_keys.
 
     def keys_in_range(self, begin: bytes, end: bytes) -> list[bytes]:
         """Sorted keys in [begin, end) across both runs."""
-        return self._slice(bisect.bisect_left(self._base, begin),
-                           bisect.bisect_left(self._base, end),
+        return self._slice(self._base_bisect(begin),
+                           self._base_bisect(end),
                            begin, end)
+
+    def _base_slice(self, lo: int, hi: int):
+        base = self._base
+        return base.slice_keys(lo, hi) if self.columnar else base[lo:hi]
 
     def _slice(self, blo: int, bhi: int,
                begin: bytes, end: bytes) -> list[bytes]:
         plo = bisect.bisect_left(self._pending, begin)
         phi = bisect.bisect_left(self._pending, end)
         if plo == phi:
-            return self._base[blo:bhi]
+            return self._base_slice(blo, bhi)
         if blo == bhi:
             return self._pending[plo:phi]
-        return list(self._merged(self._base[blo:bhi],
+        return list(self._merged(self._base_slice(blo, bhi),
                                  self._pending[plo:phi]))
 
     def _prefixes(self) -> np.ndarray:
-        if self._pfx is None:
+        if self.columnar:
+            return self._base.prefixes()
+        if self._list_pfx is None:
             from ..ops.keycode import encode_prefix_u64
-            self._pfx = encode_prefix_u64(self._base)
-        return self._pfx
+            self._list_pfx = encode_prefix_u64(self._base)
+        return self._list_pfx
 
     def ranges_keys(self,
                     ranges: list[tuple[bytes, bytes]]) -> list[list[bytes]]:
@@ -206,19 +240,19 @@ class PackedKeyIndex:
         probes = encode_prefix_u64(flat)
         los = np.searchsorted(pfx, probes, side="left")
         his = np.searchsorted(pfx, probes, side="right")
-        base = self._base
         out = []
         for i, (begin, end) in enumerate(ranges):
-            blo = bisect.bisect_left(base, begin,
-                                     int(los[2 * i]), int(his[2 * i]))
-            bhi = bisect.bisect_left(base, end,
-                                     int(los[2 * i + 1]), int(his[2 * i + 1]))
+            blo = self._base_bisect(begin,
+                                    int(los[2 * i]), int(his[2 * i]))
+            bhi = self._base_bisect(end,
+                                    int(los[2 * i + 1]), int(his[2 * i + 1]))
             out.append(self._slice(blo, bhi, begin, end))
         return out
 
     @staticmethod
-    def _merged(a: list[bytes], b: list[bytes]):
-        """Two-run sorted merge (both runs hold distinct keys)."""
+    def _merged(a, b):
+        """Two-run sorted merge (both runs hold distinct keys; either
+        may be a list or a KeyRun — only indexing/iteration is used)."""
         if not b:
             yield from a
             return
@@ -234,12 +268,19 @@ class PackedKeyIndex:
             else:
                 yield b[j]
                 j += 1
-        yield from a[i:] if i < na else b[j:]
+        if i < na:
+            yield from (a.slice_keys(i, na) if isinstance(a, KeyRun)
+                        else a[i:])
+        else:
+            yield from (b.slice_keys(j, nb) if isinstance(b, KeyRun)
+                        else b[j:])
 
     # --- device-mirror accessors (device/read_serve.py) ---
 
-    def base_run(self) -> list[bytes]:
-        """The sorted base run itself (NOT a copy — read-only callers)."""
+    def base_run(self):
+        """The sorted base run itself (NOT a copy — read-only callers).
+        A ``KeyRun`` in columnar mode, a plain list otherwise; both
+        support len/index/bisect."""
         return self._base
 
     def pending_run(self) -> list[bytes]:
@@ -259,4 +300,6 @@ class PackedKeyIndex:
             "pending": len(self._pending),
             "merges": self.merges,
             "merge_ms": round(self.merge_s * 1e3, 3),
+            "base_bytes": (self._base.nbytes if self.columnar else None),
+            "columnar": self.columnar,
         }

@@ -1,0 +1,320 @@
+"""Columnar sorted key runs — the shared home of the big-run layout.
+
+Reference: the memory walls of ROADMAP item 5.  Two structures in this
+repo keep a large sorted run of keys: ``PackedKeyIndex._base`` (the
+MVCC/engine key index, storage/key_index.py) and the lsm engine's
+per-run sparse index (the block first-keys, storage/lsm.py).  Both were
+plain Python ``list[bytes]`` — ~50-100 bytes of PyObject overhead per
+key, so 10M keys burned ~1GB before storing a single value, and both
+had independently grown the same keycode-u64-prefix ``searchsorted``
+fast path.
+
+``KeyRun`` is that run gone columnar (the ``PackedRows`` discipline
+applied to keys): ONE contiguous blob of concatenated keys plus a
+cumulative int64 end-offset column, with the keycode-packed uint64
+prefixes cached alongside.  Per-key memory drops to ~key_len + 8 (+8
+once the prefixes are built); merges become one vectorized
+``np.insert`` over the length column + an O(overlay)-segment blob
+stitch; probes bisect straight over blob slices.
+
+Two probe disciplines compose:
+
+- the u64-prefix ``searchsorted`` narrows a batch to equal-prefix bands
+  in one vectorized call (the PackedKeyIndex/lsm idiom, now one home);
+- the exact bisect runs over LOCAL blob/bounds variables (the bounds
+  column is a stdlib ``array('q')`` precisely so scalar indexing stays
+  a ~50ns Python int, not a numpy scalar box), and batched bisects over
+  SORTED probes carry a monotone lower bound — key i's insertion point
+  floors key i+1's search — which matters exactly when a keyspace
+  shares its first 8 bytes and the prefix bands collapse to the whole
+  run.
+
+The run is IMMUTABLE: mutation surfaces (``merge_sorted``,
+``delete_keys``) return a new run sharing no state with the old one, so
+readers holding a reference (a device mirror mid-upload, a spilled
+segment) can never observe a half-built state.  The sequence protocol
+(``__len__``/``__getitem__``/``__iter__``) makes a run a drop-in for
+the sorted ``list[bytes]`` it replaces wherever callers only index,
+slice, and bisect.
+"""
+
+from __future__ import annotations
+
+from array import array as _array
+
+import numpy as np
+
+__all__ = ["KeyRun"]
+
+_ITER_CHUNK = 4096      # keys materialized per __iter__ slab
+
+# batched probes below this fall back to a per-key bisect: one scalar
+# np.searchsorted costs ~5µs of call overhead where bisect is ~1µs (the
+# PackedKeyIndex threshold reasoning, kept at the shared home)
+_BATCH_MIN = 16
+
+
+class KeyRun:
+    """One immutable columnar sorted run of byte keys."""
+
+    __slots__ = ("blob", "bounds", "_pfx")
+
+    def __init__(self, blob: bytes = b"",
+                 bounds: _array | None = None) -> None:
+        self.blob = blob
+        self.bounds = bounds if bounds is not None else _array("q")
+        self._pfx: np.ndarray | None = None
+
+    # --- construction ---
+
+    @classmethod
+    def from_keys(cls, keys: list[bytes]) -> "KeyRun":
+        """Pack an already-sorted key list (duplicates permitted for
+        directory uses; the index contract keeps them distinct)."""
+        if not keys:
+            return cls()
+        from itertools import accumulate
+        return cls(b"".join(keys), _array("q", accumulate(map(len, keys))))
+
+    def _np_bounds(self) -> np.ndarray:
+        """Zero-copy numpy view of the bounds column (vector ops only —
+        scalar access stays on the stdlib array)."""
+        return np.frombuffer(self.bounds, dtype=np.int64)
+
+    # --- sequence protocol (drop-in for the sorted list it replaces) ---
+
+    def __len__(self) -> int:
+        return len(self.bounds)
+
+    def __bool__(self) -> bool:
+        return len(self.bounds) > 0
+
+    def key(self, i: int) -> bytes:
+        b = self.bounds
+        return self.blob[(b[i - 1] if i else 0):b[i]]
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            lo, hi, step = i.indices(len(self.bounds))
+            keys = self.slice_keys(lo, hi)
+            return keys if step == 1 else keys[::step]
+        n = len(self.bounds)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        return self.key(i)
+
+    def __iter__(self):
+        n = len(self.bounds)
+        for lo in range(0, n, _ITER_CHUNK):
+            yield from self.slice_keys(lo, min(lo + _ITER_CHUNK, n))
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, KeyRun):
+            return self.blob == other.blob and self.bounds == other.bounds
+        if isinstance(other, (list, tuple)):
+            return len(self) == len(other) and self.to_list() == list(other)
+        return NotImplemented
+
+    __hash__ = None  # type: ignore[assignment] — mutable-adjacent semantics
+
+    def slice_keys(self, lo: int, hi: int) -> list[bytes]:
+        """Rows [lo, hi) materialized as ``list[bytes]`` — the bounds
+        unpack is C-speed map-of-slices (the PackedRows.rows idiom),
+        never a per-key Python loop."""
+        n = len(self.bounds)
+        lo, hi = max(0, lo), min(hi, n)
+        if lo >= hi:
+            return []
+        from itertools import starmap
+        ends = self.bounds[lo:hi].tolist()
+        starts = [self.bounds[lo - 1] if lo else 0] + ends[:-1]
+        return list(map(self.blob.__getitem__,
+                        starmap(slice, zip(starts, ends))))
+
+    def to_list(self) -> list[bytes]:
+        return self.slice_keys(0, len(self.bounds))
+
+    @property
+    def nbytes(self) -> int:
+        """Resident bytes of the columnar storage (blob + bounds +
+        prefixes when built) — what the memory-wall accounting reports."""
+        n = len(self.blob) + len(self.bounds) * self.bounds.itemsize
+        if self._pfx is not None:
+            n += self._pfx.nbytes
+        return n
+
+    # --- prefixes (the vectorized-searchsorted operand) ---
+
+    def prefixes(self) -> np.ndarray:
+        """keycode-u64 prefixes of every key (cached) — computed straight
+        off the columns, byte-identical to
+        ``keycode.encode_prefix_u64(self.to_list())`` without the join."""
+        if self._pfx is None:
+            n = len(self.bounds)
+            if n == 0:
+                self._pfx = np.zeros(0, dtype=np.uint64)
+                return self._pfx
+            flat = np.frombuffer(self.blob, dtype=np.uint8)
+            ends = self._np_bounds()
+            starts = np.empty(n, dtype=np.int64)
+            starts[0] = 0
+            starts[1:] = ends[:-1]
+            plens = np.minimum(ends - starts, 8)
+            buf = np.zeros((n, 8), dtype=np.uint8)
+            cols = np.arange(8)[None, :]
+            mask = cols < plens[:, None]
+            src = np.minimum(starts[:, None] + cols, max(len(flat) - 1, 0))
+            buf[mask] = flat[src[mask]]
+            self._pfx = buf.view(">u8").ravel().astype(np.uint64)
+        return self._pfx
+
+    # --- point probes ---
+    #
+    # Hand-rolled bisects over LOCAL blob/bounds: the inner loop is a
+    # python-int index, one blob slice and one compare per step —
+    # bisect.bisect_left(self, ...) would pay __getitem__ dispatch,
+    # bounds checks and len() per step, measured ~6x slower at 2M keys.
+
+    def bisect_left(self, key: bytes, lo: int = 0, hi: int | None = None
+                    ) -> int:
+        bounds = self.bounds
+        blob = self.blob
+        if hi is None:
+            hi = len(bounds)
+        while lo < hi:
+            mid = (lo + hi) >> 1
+            if blob[(bounds[mid - 1] if mid else 0):bounds[mid]] < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    def bisect_right(self, key: bytes, lo: int = 0, hi: int | None = None
+                     ) -> int:
+        bounds = self.bounds
+        blob = self.blob
+        if hi is None:
+            hi = len(bounds)
+        while lo < hi:
+            mid = (lo + hi) >> 1
+            if key < blob[(bounds[mid - 1] if mid else 0):bounds[mid]]:
+                hi = mid
+            else:
+                lo = mid + 1
+        return lo
+
+    def __contains__(self, key: bytes) -> bool:
+        i = self.bisect_left(key)
+        return i < len(self.bounds) and self.key(i) == key
+
+    # --- batched probes (ONE vectorized searchsorted for the batch) ---
+
+    def search_bands(self, keys: list[bytes]
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        """(lo, hi) equal-prefix candidate bands per probe key: one
+        vectorized searchsorted pair over the cached prefixes.  An exact
+        bound is then ``bisect_left/right(key, lo, hi)`` — the band is
+        usually empty or single-element (but can be the whole run when
+        the keyspace shares its first 8 bytes; batch_bisect's monotone
+        floor covers that shape)."""
+        from ..ops.keycode import encode_prefix_u64
+        pfx = self.prefixes()
+        probes = encode_prefix_u64(keys)
+        return (np.searchsorted(pfx, probes, side="left"),
+                np.searchsorted(pfx, probes, side="right"))
+
+    def batch_bisect(self, keys: list[bytes], side: str = "left",
+                     sorted_keys: bool = False) -> list[int]:
+        """Exact insertion points for many keys — prefix searchsorted +
+        per-key bisect refinement, with a plain-bisect fallback below
+        the amortization threshold.  ``sorted_keys=True`` (the merge /
+        delete path) additionally floors each refinement at the
+        previous result, so a shared-prefix keyspace whose bands
+        collapse still refines in O(m log(n/m)) total, not m full
+        bisects."""
+        point = self.bisect_left if side == "left" else self.bisect_right
+        if len(keys) < _BATCH_MIN or len(self.bounds) < _BATCH_MIN:
+            if not sorted_keys:
+                return [point(k) for k in keys]
+            out: list[int] = []
+            prev = 0
+            for k in keys:
+                prev = point(k, prev)
+                out.append(prev)
+            return out
+        los, his = self.search_bands(keys)
+        out = []
+        prev = 0
+        for k, lo, hi in zip(keys, los.tolist(), his.tolist()):
+            if sorted_keys and prev > lo:
+                lo = prev
+            if hi < lo:
+                hi = lo
+            prev = point(k, lo, hi)
+            out.append(prev)
+        return out
+
+    # --- mutation (immutable: each returns a NEW run) ---
+
+    def merge_sorted(self, new_keys: list[bytes]) -> "KeyRun":
+        """Merge a sorted list of distinct keys NOT already present:
+        insertion points resolve in one monotone batched pass, the new
+        bounds build as one ``np.insert`` + cumsum, and the blob
+        stitches from O(m) segment slices — never a per-key pass over
+        the base."""
+        if not new_keys:
+            return self
+        if not len(self.bounds):
+            return KeyRun.from_keys(new_keys)
+        pos = self.batch_bisect(new_keys, "left", sorted_keys=True)
+        ends = self.bounds
+        np_ends = self._np_bounds()
+        base_lens = np.diff(np_ends, prepend=0)
+        new_lens = np.fromiter(map(len, new_keys), dtype=np.int64,
+                               count=len(new_keys))
+        merged = np.insert(base_lens, pos, new_lens)
+        bounds = _array("q")
+        bounds.frombytes(np.cumsum(merged).tobytes())
+        parts: list[bytes] = []
+        blob = self.blob
+        prev = 0
+        for p, k in zip(pos, new_keys):
+            boff = ends[p - 1] if p else 0
+            if boff > prev:
+                parts.append(blob[prev:boff])
+                prev = boff
+            parts.append(k)
+        if prev < len(blob):
+            parts.append(blob[prev:])
+        return KeyRun(b"".join(parts), bounds)
+
+    def delete_keys(self, dead: list[bytes]) -> tuple["KeyRun", int]:
+        """Remove every present key of ``dead``; returns (new run,
+        number removed).  Locations resolve in one monotone batched
+        pass; the survivor columns build from O(d) segment slices."""
+        if not dead or not len(self.bounds):
+            return self, 0
+        dead_sorted = sorted(set(dead))
+        pos = self.batch_bisect(dead_sorted, "left", sorted_keys=True)
+        n = len(self.bounds)
+        hit = [p for p, k in zip(pos, dead_sorted)
+               if p < n and self.key(p) == k]
+        if not hit:
+            return self, 0
+        ends = self.bounds
+        lens = np.diff(self._np_bounds(), prepend=0)
+        bounds = _array("q")
+        bounds.frombytes(np.cumsum(np.delete(lens, hit)).tobytes())
+        parts: list[bytes] = []
+        blob = self.blob
+        prev = 0
+        for p in hit:
+            start = ends[p - 1] if p else 0
+            if start > prev:
+                parts.append(blob[prev:start])
+            prev = ends[p]
+        if prev < len(blob):
+            parts.append(blob[prev:])
+        return KeyRun(b"".join(parts), bounds), len(hit)

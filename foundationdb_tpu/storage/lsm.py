@@ -28,6 +28,7 @@ from typing import Iterator
 
 from ..rpc.wire import decode, encode
 from .disk_queue import DiskQueue
+from .key_runs import KeyRun
 from .kv_store import OP_CLEAR, OP_SET
 
 _TOMBSTONE = None          # value None in runs marks a deletion
@@ -52,11 +53,13 @@ class _Run:
         assert foot[8:] == _FOOTER, f"bad run footer in {path}"
         idx_off = int.from_bytes(foot[:8], "little")
         self.index = decode(self._f.read_sync(idx_off, size - 12 - idx_off))
-        # index: list of [first_key, offset, length]
-        self.first_keys = [bytes(e[0]) for e in self.index]
-        # lazy keycode-packed u64 prefixes of first_keys: the batched
-        # probe's vectorized searchsorted operand (get_batch_into)
-        self._fk_pfx = None
+        # index: list of [first_key, offset, length].  The sparse index
+        # (block first keys) is a COLUMNAR KeyRun (storage/key_runs.py,
+        # ISSUE 11): one blob + bounds + cached u64 prefixes — the same
+        # layout PackedKeyIndex's base run uses, deduplicating the
+        # searchsorted-over-prefixes discipline this file had grown its
+        # own copy of (the old first_keys list + _fk_pfx pair)
+        self.first_keys = KeyRun.from_keys([bytes(e[0]) for e in self.index])
 
     def _block(self, i: int) -> list:
         key = (self.path, i)
@@ -69,7 +72,7 @@ class _Run:
 
     def get(self, key: bytes) -> tuple[bool, bytes | None]:
         """(found, value-or-tombstone)."""
-        i = bisect.bisect_right(self.first_keys, key) - 1
+        i = self.first_keys.bisect_right(key) - 1
         if i < 0:
             return False, None
         blk = self._block(i)
@@ -86,26 +89,17 @@ class _Run:
         keys) against this run, writing hits — including tombstones —
         into ``out``; returns the still-unresolved indices for the next
         (older) run.  The block per probe resolves in ONE vectorized
-        ``searchsorted`` over keycode-packed u64 prefixes of the sparse
-        index (the PackedKeyIndex bound-batch discipline), a bisect
-        refining inside the equal-prefix band; each touched block is
-        then decoded exactly once per batch."""
+        ``searchsorted`` over the sparse index's cached u64 prefixes
+        (``KeyRun.batch_bisect`` — the shared home of the
+        PackedKeyIndex bound-batch discipline), a bisect refining
+        inside the equal-prefix band; each touched block is then
+        decoded exactly once per batch."""
         fk = self.first_keys
         if not fk:
             return idxs
-        if len(idxs) >= 16 and len(fk) >= 16:
-            import numpy as np
-
-            from ..ops.keycode import encode_prefix_u64
-            if self._fk_pfx is None:
-                self._fk_pfx = encode_prefix_u64(fk)
-            probes = encode_prefix_u64([keys[i] for i in idxs])
-            los = np.searchsorted(self._fk_pfx, probes, side="left")
-            his = np.searchsorted(self._fk_pfx, probes, side="right")
-            blocks = [bisect.bisect_right(fk, keys[i], int(lo), int(hi)) - 1
-                      for i, lo, hi in zip(idxs, los, his)]
-        else:
-            blocks = [bisect.bisect_right(fk, keys[i]) - 1 for i in idxs]
+        blocks = [b - 1 for b in
+                  fk.batch_bisect([keys[i] for i in idxs], side="right",
+                                  sorted_keys=True)]
         remaining: list[int] = []
         cur = -1
         bkeys: list[bytes] = []
@@ -128,8 +122,8 @@ class _Run:
 
     def iter_range(self, begin: bytes, end: bytes,
                    reverse: bool = False) -> Iterator[tuple[bytes, bytes | None]]:
-        lo = max(0, bisect.bisect_right(self.first_keys, begin) - 1)
-        hi = bisect.bisect_left(self.first_keys, end)
+        lo = max(0, self.first_keys.bisect_right(begin) - 1)
+        hi = self.first_keys.bisect_left(end)
         blocks = range(lo, min(hi + 1, len(self.index)))
         if reverse:
             blocks = reversed(blocks)
@@ -154,8 +148,8 @@ class _Run:
         if not fk:
             return
         first = lambda e: e[0]  # noqa: E731 — bisect key
-        lo = max(0, bisect.bisect_right(fk, begin) - 1)
-        stop = max(bisect.bisect_left(fk, end), lo + 1)
+        lo = max(0, fk.bisect_right(begin) - 1)
+        stop = max(fk.bisect_left(end), lo + 1)
         for i in range(lo, stop):
             # the decoder already hands back bytes keys/values, so rows
             # pass through with NO per-row re-materialization: interior
@@ -196,6 +190,83 @@ class _BlockCache:
             del self._d[k]
 
 
+class LsmSparseIndex:
+    """Merged block directory over every sorted run — the lsm engine's
+    ``packed_index`` (ISSUE 11, ROADMAP item 1 (e)).
+
+    The per-run sparse indexes (block first keys) merge into ONE sorted
+    ``KeyRun`` with parallel (run, block) back-pointer columns and a
+    per-run prefix-max table, so a probe key's candidate block in EVERY
+    run falls out of a single sorted-array bound:
+
+        pos = bisect_right(merged, key)
+        candidate block of run r = blockmax[pos][r]
+          (== bisect_right(run_r.first_keys, key) - 1, by construction)
+
+    That single sorted u64-prefix array is exactly the shape the device
+    read mirror consumes (device/read_serve.py): one vectorized
+    ``searchsorted`` per ``get_values`` batch locates the candidate
+    block in every run at once, replacing the per-run host searchsorted
+    descent — the surface where the device gather finally sits over a
+    real probe structure instead of MemoryKVStore's O(1) dict.
+
+    ``gen`` bumps whenever the run SET changes (open/flush/compact);
+    memtable writes never stale it — the memtable is probed host-side
+    by ``get_batch_located``, the lsm twin of the pending-overlay
+    contract the PackedKeyIndex mirror already has."""
+
+    device_mode = "blocks"      # host refinement the device mirror needs
+
+    __slots__ = ("_store", "gen", "_cache")
+
+    def __init__(self, store: "LSMKVStore") -> None:
+        self._store = store
+        self.gen = 0
+        self._cache: tuple | None = None    # (merged KeyRun, blockmax)
+
+    def bump(self) -> None:
+        self.gen += 1
+        self._cache = None
+
+    def _ensure(self) -> tuple:
+        if self._cache is None:
+            import numpy as np
+            runs = self._store._runs
+            entries: list[tuple[bytes, int, int]] = []
+            for r_i, run in enumerate(runs):
+                fk = run.first_keys
+                entries.extend((fk.key(b_i), r_i, b_i)
+                               for b_i in range(len(fk)))
+            entries.sort()
+            merged = KeyRun.from_keys([e[0] for e in entries])
+            n, nr = len(entries), len(runs)
+            blockmax = np.full((n + 1, max(nr, 1)), -1, dtype=np.int64)
+            if n and nr:
+                run_of = np.fromiter((e[1] for e in entries),
+                                     dtype=np.int64, count=n)
+                block_of = np.fromiter((e[2] for e in entries),
+                                       dtype=np.int64, count=n)
+                for r in range(nr):
+                    col = np.where(run_of == r, block_of, -1)
+                    # blocks within a run appear in ascending order, so
+                    # the running max IS the newest block at-or-before
+                    np.maximum.accumulate(col, out=col)
+                    blockmax[1:, r] = col
+            self._cache = (merged, blockmax)
+        return self._cache
+
+    # --- the device-mirror surface (DeviceKeyDirectory contract) ---
+
+    def base_run(self) -> KeyRun:
+        return self._ensure()[0]
+
+    def pending_run(self) -> list[bytes]:
+        return []               # the memtable is handled host-side
+
+    def base_prefixes(self):
+        return self._ensure()[0].prefixes()
+
+
 class LSMKVStore:
     """IKeyValueStore-compatible LSM engine (see kv_store.MemoryKVStore
     for the surface contract)."""
@@ -209,6 +280,7 @@ class LSMKVStore:
         self._mem_bytes = 0
         self._runs: list[_Run] = []                 # newest first
         self._cache = _BlockCache(_CACHE_BLOCKS)
+        self._sparse = LsmSparseIndex(self)
         self._wal: DiskQueue | None = None
         self._wal_file = None
         self._gen = 0
@@ -229,6 +301,7 @@ class LSMKVStore:
             kv._wal_gen = man.get("wal_gen", 0)
             for path in man["runs"]:
                 kv._runs.append(_Run(fs, str(path), kv._cache))
+            kv._sparse.bump()
         kv._wal_file = fs.open(prefix + ".wal")
         kv._wal, frames = await DiskQueue.open(kv._wal_file)
         for frame, _end in frames:
@@ -251,6 +324,12 @@ class LSMKVStore:
         return n
 
     # --- reads ---
+
+    @property
+    def packed_index(self) -> LsmSparseIndex:
+        """The merged sparse-index directory — the capability probe the
+        device read path keys on (device/read_serve.py, ISSUE 11)."""
+        return self._sparse
 
     def get(self, key: bytes) -> bytes | None:
         if key in self._mem:
@@ -278,6 +357,44 @@ class LSMKVStore:
             if not pending:
                 break
             pending = run.get_batch_into(keys, pending, out)
+        return out
+
+    def get_batch_located(self, keys: list[bytes],
+                          pos: list[int]) -> list[bytes | None]:
+        """Finish a device-located batch (ISSUE 11): ``pos[i]`` is the
+        bisect_right of ``keys[i]`` over the merged sparse directory
+        (``packed_index.base_run()``) — computed by the device mirror's
+        vectorized searchsorted.  The host half probes the memtable
+        first, then each run's candidate block newest→oldest, resolving
+        tombstones newest-wins — result identical to ``get_batch`` on
+        the same keys by construction (the directory's prefix-max table
+        reproduces exactly each run's ``bisect_right(first_keys) - 1``
+        block choice), and tested."""
+        _merged, blockmax = self._sparse._ensure()
+        out: list[bytes | None] = [None] * len(keys)
+        mem = self._mem
+        runs = self._runs
+        bkeys_cache: dict[tuple[int, int], list[bytes]] = {}
+        for i, k in enumerate(keys):
+            if k in mem:
+                out[i] = mem[k]
+                continue
+            row = blockmax[pos[i]]
+            for r_i in range(len(runs)):
+                b = int(row[r_i])
+                if b < 0:
+                    continue
+                ck = (r_i, b)
+                bkeys = bkeys_cache.get(ck)
+                blk = runs[r_i]._block(b)
+                if bkeys is None:
+                    bkeys = [bytes(e[0]) for e in blk]
+                    bkeys_cache[ck] = bkeys
+                j = bisect.bisect_left(bkeys, k)
+                if j < len(bkeys) and bkeys[j] == k:
+                    v = blk[j][1]
+                    out[i] = bytes(v) if v is not None else None
+                    break
         return out
 
     def range(self, begin: bytes, end: bytes,
@@ -490,6 +607,7 @@ class LSMKVStore:
         path = await self._write_run(items(), drop_tombstones=not self._runs)
         if path is not None:
             self._runs.insert(0, _Run(self.fs, path, self._cache))
+            self._sparse.bump()
         # WAL records below the new gen are folded into the run
         self._wal_gen = self._gen
         await self._write_manifest()
@@ -505,6 +623,7 @@ class LSMKVStore:
                          for r in old], reverse=False, keep_tombstones=False)
         path = await self._write_run(merged, drop_tombstones=True)
         self._runs = [_Run(self.fs, path, self._cache)] if path else []
+        self._sparse.bump()
         await self._write_manifest()
         for r in old:
             self._cache.drop_file(r.path)

@@ -14,6 +14,12 @@ version chains* over one sorted key index:
   fresh-key insert costs amortized O(log n) instead of the seed's O(n)
   ``bisect.insort`` memmove (the r5 YCSB-at-1M-rows bench collapse:
   O(n²) across a bulk load, ~900ms event-loop stalls per SlowTask).
+  Since ISSUE 11 the base run is COLUMNAR (storage/key_runs.py: one
+  key blob + cumulative bounds, ~key_len+8 bytes/key instead of
+  ~50-100 of PyObject overhead), which is what lets the window's index
+  track millions of keys; the chains dict itself stays per-key and is
+  the next wall when the MVCC window holds a huge hot set (ROADMAP
+  item 5 follow-up (b)).
 
 Reads at version V binary-search each chain for the newest entry <= V.
 Clears append tombstones to every covered live key — O(keys cleared),

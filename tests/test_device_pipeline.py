@@ -504,3 +504,57 @@ def test_storage_server_wires_device_reads():
         assert bare._device_reads is None
         assert "device_read_active" not in await bare.metrics()
     asyncio.run(main())
+
+
+def test_device_read_server_lsm_blocks_mode(monkeypatch):
+    """The device gather under the lsm engine (ISSUE 11, ROADMAP item 1
+    (e)): the mirror is the MERGED sparse directory, one searchsorted
+    locates the candidate block in every run, and the host finish
+    (``get_batch_located``) returns exactly what ``engine.get_batch``
+    would — including tombstones resolved newest-wins and memtable-only
+    keys probed host-side."""
+    import foundationdb_tpu.storage.lsm as lsm_mod
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.runtime.simloop import run_simulation
+    from foundationdb_tpu.storage.lsm import LSMKVStore
+    monkeypatch.setattr(lsm_mod, "_MEMTABLE_BYTES", 1500)
+    monkeypatch.setattr(lsm_mod, "_BLOCK_BYTES", 200)
+    monkeypatch.setattr(lsm_mod, "_MAX_RUNS", 8)
+
+    async def main():
+        import random
+        fs = SimFileSystem()
+        kv = await LSMKVStore.open(fs, "db/lsm")
+        rng = random.Random(9)
+        for round_ in range(8):
+            ops = [(0, b"dk%04d" % rng.randrange(1200),
+                    b"v%06d" % rng.randrange(10 ** 6)) for _ in range(60)]
+            ops.append((1, b"dk0100", b"dk0140"))
+            await kv.commit(ops, {"durable_version": round_})
+        assert len(kv._runs) >= 2
+        assert kv.packed_index.device_mode == "blocks"
+        knobs = Knobs().override(STORAGE_DEVICE_READ_MIN_BATCH=4)
+        srv = DeviceReadServer(kv, knobs)
+        assert srv.active
+        probes = sorted({b"dk%04d" % rng.randrange(1400)
+                         for _ in range(150)})
+        assert srv.get_batch(probes) is None    # cold start primes mirror
+        got = srv.get_batch(probes)
+        assert got is not None
+        assert got == kv.get_batch(probes)
+        # a memtable-only key (no flush since) rides the host-side probe
+        await kv.commit([(0, b"zz-memkey", b"mv")], {"durable_version": 99})
+        qs = sorted(probes + [b"zz-memkey"])
+        got2 = srv.get_batch(qs)
+        if got2 is None:            # a flush bumped gen: refresh + retry
+            got2 = srv.get_batch(qs)
+        assert got2 == kv.get_batch(qs)
+        # a flush (run-set change) stales the mirror exactly once
+        big = [(0, b"fl%04d" % i, b"w" * 40) for i in range(50)]
+        await kv.commit(big, {"durable_version": 100})
+        g0 = kv.packed_index.gen
+        assert srv.get_batch(probes) is None    # stale: engine serves
+        assert kv.packed_index.gen == g0
+        assert srv.get_batch(probes) == kv.get_batch(probes)
+
+    run_simulation(main())

@@ -224,19 +224,19 @@ def test_durability_ring_slices_and_rollback():
         [Mutation.set(b"b", b"2"), Mutation.clear_range(b"c", b"d")]))
     ring.append(3, 0, b"e", b"3")
     assert len(ring) == 4
-    ops = ring.peek_through(2)
+    ops = ring.peek_memory_through(2)
     assert [(op, p1, p2) for op, p1, p2 in ops] == [
         (0, b"a", b"1"), (0, b"b", b"2"), (1, b"c", b"d")]
     assert ops.nbytes == 6
     # peek is non-destructive (failed engine commit retries the slice)
-    assert [(op, p1, p2) for op, p1, p2 in ring.peek_through(2)] == \
+    assert [(op, p1, p2) for op, p1, p2 in ring.peek_memory_through(2)] == \
         [(0, b"a", b"1"), (0, b"b", b"2"), (1, b"c", b"d")]
-    ring.pop_through(2)
-    assert [(op, p1, p2) for op, p1, p2 in ring.peek_through(99)] == \
+    ring.pop_memory_through(2)
+    assert [(op, p1, p2) for op, p1, p2 in ring.peek_memory_through(99)] == \
         [(0, b"e", b"3")]
     ring.append(4, 0, b"f", b"4")
     ring.rollback_after(3)
-    assert [(op, p1, p2) for op, p1, p2 in ring.peek_through(99)] == \
+    assert [(op, p1, p2) for op, p1, p2 in ring.peek_memory_through(99)] == \
         [(0, b"e", b"3")]
 
 
@@ -303,7 +303,7 @@ def test_kv_store_recovers_old_and_new_wal_frames():
         for op, p1, p2 in ops:
             ring.append(7, op, p1, p2)
         new = await MemoryKVStore.open(fs, "new")
-        await new.commit(ring.peek_through(7), {"dv": 7})
+        await new.commit(await ring.peek_through(7), {"dv": 7})
         new2 = await MemoryKVStore.open(fs, "new")   # replay packed frame
         for kv in (old, new, new2):
             assert kv.get(b"k1") is None

@@ -67,8 +67,7 @@ def test_packed_rows_surface():
 def test_version_gate_fences_714_peer():
     from foundationdb_tpu.core.cluster_client import RecoveredClusterView
     from foundationdb_tpu.runtime.errors import ClusterVersionChanged
-    new = Knobs()
-    assert new.PROTOCOL_VERSION == 715
+    new = Knobs().override(PROTOCOL_VERSION=715)   # the ISSUE 9 gate
     old = new.override(PROTOCOL_VERSION=714)
     state = {"epoch": 1, "seq": 0, "protocol": new.PROTOCOL_VERSION}
     with pytest.raises(ClusterVersionChanged):
@@ -797,5 +796,155 @@ def test_expire_then_restore_still_byte_identical():
         with pytest.raises(RestoreError):
             await agent2.restore(to_version=snap1.version)
         await dst.stop()
+
+    asyncio.run(main())
+
+
+# --- packed get_key selector resolution (ISSUE 11, PROTOCOL_VERSION 716) ---
+
+def test_get_key_wire_roundtrip_and_716_fence():
+    from foundationdb_tpu.core.cluster_client import RecoveredClusterView
+    from foundationdb_tpu.core.data import GetKeyReply, GetKeyRequest
+    from foundationdb_tpu.rpc.wire import decode, encode
+    from foundationdb_tpu.runtime.errors import ClusterVersionChanged
+    req = GetKeyRequest(b"a", b"zz", 42, 7, True)
+    assert decode(encode(req)) == req
+    rep = GetKeyReply(0, 7, b"found-key")
+    assert decode(encode(rep)) == rep
+    ref = decode(encode(GetKeyReply(GV_TOO_OLD, 0, b"")))
+    assert ref.status == GV_TOO_OLD and ref.count == 0
+    new = Knobs()
+    assert new.PROTOCOL_VERSION == 716
+    old = new.override(PROTOCOL_VERSION=715)
+    state = {"epoch": 1, "seq": 0, "protocol": new.PROTOCOL_VERSION}
+    with pytest.raises(ClusterVersionChanged):
+        RecoveredClusterView(old, None, state)
+
+
+def test_get_key_selector_equivalence_randomized():
+    """Packed selector resolution vs a reference computed from the full
+    sorted keyspace: every selector family, offsets walking across the
+    3-shard split, off-both-ends clamps — and the RYW fallback (buffered
+    writes/clears visible, exactly the legacy merge's answers)."""
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.data import KeySelector, key_after
+
+    async def main():
+        cluster = _seed_cluster(shards=3)
+        cluster.start()
+        rng = random.Random(77)
+        keys = sorted({krand(rng) for _ in range(350)})
+        await _load(cluster, {k: b"v" for k in keys})
+
+        def ref(sel, ks):
+            k, oe, off = sel.key, sel.or_equal, sel.offset
+            if off > 0:
+                start = key_after(k) if oe else k
+                import bisect as _b
+                i = _b.bisect_left(ks, start) + off - 1
+                return ks[i] if i < len(ks) else b"\xff"
+            stop = key_after(k) if oe else k
+            import bisect as _b
+            n = 1 - off
+            i = _b.bisect_left(ks, stop) - n
+            return ks[i] if i >= 0 else b""
+
+        tr = Transaction(cluster)
+        sels = [KeySelector.first_greater_or_equal(b""),
+                KeySelector.last_less_than(b"\xfe"),
+                KeySelector.first_greater_than(keys[-1]),
+                KeySelector.last_less_or_equal(keys[0]) - 1,
+                KeySelector.first_greater_or_equal(keys[0]) + len(keys)]
+        for _ in range(140):
+            anchor = rng.choice([rng.choice(keys), krand(rng), b"",
+                                 b"k03"])
+            sels.append(KeySelector(anchor, rng.random() < 0.5,
+                                    rng.randrange(-250, 251)))
+        for sel in sels:
+            got = await tr.get_key(sel, snapshot=True)
+            assert got == ref(sel, keys), sel
+
+        # RYW fallback: buffered writes force the legacy merge
+        tr2 = Transaction(cluster)
+        tr2.set(b"zz-after-everything", b"w")
+        got = await tr2.get_key(KeySelector.last_less_than(b"\xfe"),
+                                snapshot=True)
+        assert got == b"zz-after-everything"
+        tr2.clear_range(keys[0], key_after(keys[2]))
+        model = sorted((set(keys) - set(keys[:3]))
+                       | {b"zz-after-everything"})
+        for sel in sels[:40]:
+            got = await tr2.get_key(sel, snapshot=True)
+            assert got == ref(sel, model), sel
+        await cluster.stop()
+
+    asyncio.run(main())
+
+
+def test_get_key_replica_failover_on_refusal():
+    from foundationdb_tpu.core.data import GetKeyReply, GetKeyRequest
+    from foundationdb_tpu.core.load_balance import ReplicaGroup
+
+    class _Stub:
+        tag = 0
+
+        def __init__(self, reply):
+            self._r = reply
+
+        async def get_key(self, req):
+            return self._r
+
+    async def main():
+        good = GetKeyReply(0, 3, b"resolved")
+        for bad_code in (GV_TOO_OLD, GV_FUTURE_VERSION, GV_WRONG_SHARD):
+            bad = GetKeyReply(bad_code, 0, b"")
+            shard = KeyRange(b"", b"\xff")
+            g = ReplicaGroup(shard, [_Stub(bad), _Stub(good)])
+            rep = await g.get_key(GetKeyRequest(b"", b"\xff", 10, 3))
+            assert rep.status == 0 and rep.key == b"resolved"
+            g2 = ReplicaGroup(shard, [_Stub(bad), _Stub(bad)])
+            rep2 = await g2.get_key(GetKeyRequest(b"", b"\xff", 10, 3))
+            assert rep2.status == bad_code
+
+    asyncio.run(main())
+
+
+def test_get_key_storage_counts_and_fences():
+    """The storage get_key: exact n-th-live-row counts under an MVCC
+    overlay with tombstones, residual counts when the clip runs dry,
+    and the wholesale too-old refusal."""
+    from foundationdb_tpu.core.data import GetKeyReply, GetKeyRequest
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+
+    async def main():
+        knobs = Knobs()
+        ss = StorageServer(knobs, 0, KeyRange(b"", b"\xff"), TLog(knobs))
+        ss._apply_batch([(1, __import__(
+            "foundationdb_tpu.core.data", fromlist=["MutationBatch"]
+        ).MutationBatch.from_mutations(
+            [Mutation.set(b"g%03d" % i, b"v") for i in range(20)]))])
+        ss._apply_batch([(2, __import__(
+            "foundationdb_tpu.core.data", fromlist=["MutationBatch"]
+        ).MutationBatch.from_mutations(
+            [Mutation.clear_range(b"g005", b"g010")]))])
+        live = [b"g%03d" % i for i in range(20) if not 5 <= i < 10]
+        # forward: n-th live row
+        rep = await ss.get_key(GetKeyRequest(b"", b"\xff", 2, 3, False))
+        assert isinstance(rep, GetKeyReply)
+        assert (rep.status, rep.count, rep.key) == (0, 3, live[2])
+        # reading BELOW the clear still sees the old rows
+        rep = await ss.get_key(GetKeyRequest(b"", b"\xff", 1, 7, False))
+        assert (rep.count, rep.key) == (7, b"g006")
+        # reverse: n-th from the end
+        rep = await ss.get_key(GetKeyRequest(b"", b"\xff", 2, 2, True))
+        assert (rep.count, rep.key) == (2, live[-2])
+        # clip runs dry: count reports the residual, no key
+        rep = await ss.get_key(GetKeyRequest(b"g012", b"\xff", 2, 99, False))
+        assert (rep.status, rep.count, rep.key) == (0, 8, b"")
+        # wholesale too-old refusal
+        ss.oldest_version = 10
+        rep = await ss.get_key(GetKeyRequest(b"", b"\xff", 2, 1, False))
+        assert rep.status == GV_TOO_OLD
 
     asyncio.run(main())

@@ -30,7 +30,7 @@ _SEED = 4321
 _N_MACHINES = 5
 
 
-def _child(path: str) -> None:
+def _child(path: str, mode: str = "default") -> None:
     import asyncio
 
     sys.path.insert(0, _REPO)
@@ -58,15 +58,29 @@ def _child(path: str) -> None:
     # default) — the bit-identical acceptance must cover the columnar
     # read path, and a future default flip must not silently change
     # what this test proves
+    # ISSUE 11: the durability-ring spill budget is pinned at its
+    # default (large enough that this sim never spills); the "spill"
+    # mode instead forces a 1-byte budget on DURABLE storage so every
+    # durability tick spills+reads back — the bit-identical acceptance
+    # then covers the spill path itself (spill decisions are byte- and
+    # version-driven, no RNG, so same-seed traces must still match)
     knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
                              RESOLVER_DEVICE_PIPELINE=True,
                              DD_SHARD_HEAT_SPLITS=False,
                              CLIENT_READ_LOAD_BALANCE="score",
                              BACKUP_PROGRESS_PUBLISH=False,
-                             CLIENT_PACKED_RANGE_READS=True)
+                             CLIENT_PACKED_RANGE_READS=True,
+                             STORAGE_DBUF_SPILL_BYTES=128 << 20)
+    durable = False
+    if mode == "spill":
+        knobs = knobs.override(STORAGE_DBUF_SPILL_BYTES=1,
+                               STORAGE_VERSION_WINDOW=1_000,
+                               STORAGE_DURABILITY_LAG=0.1)
+        durable = True
 
     async def main():
         sim = SimulatedCluster(knobs, n_machines=_N_MACHINES,
+                               durable_storage=durable,
                                spec=ClusterConfigSpec(min_workers=_N_MACHINES,
                                                       replication=2))
         await sim.start()
@@ -95,6 +109,7 @@ def _child(path: str) -> None:
     h = hashlib.sha256()
     n = 0
     pipeline_events = 0
+    spill_events = 0
     base = os.path.basename(path)
     d = os.path.dirname(path)
     rolled = sorted(
@@ -107,23 +122,26 @@ def _child(path: str) -> None:
         h.update(data)
         n += data.count(b"\n")
         pipeline_events += data.count(b"ResolverDevice.")
-    print("%s %d %d" % (h.hexdigest(), n, pipeline_events))
+        spill_events += data.count(b"StorageDbufSpill")
+    print("%s %d %d %d" % (h.hexdigest(), n, pipeline_events, spill_events))
 
 
-def _run_child(tmp_path, tag: str) -> tuple[str, int, int]:
+def _run_child(tmp_path, tag: str,
+               mode: str = "default") -> tuple[str, int, int, int]:
     path = os.path.join(str(tmp_path), f"trace-{tag}.jsonl")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    p = subprocess.run([sys.executable, _THIS, "--child", path],
+    p = subprocess.run([sys.executable, _THIS, "--child", path, mode],
                        cwd=_REPO, env=env, capture_output=True, text=True,
                        timeout=300)
     assert p.returncode == 0, f"child {tag} failed: {p.stderr[-2000:]}"
-    digest, n_events, n_pipeline = p.stdout.strip().splitlines()[-1].split()
-    return digest, int(n_events), int(n_pipeline)
+    digest, n_events, n_pipeline, n_spill = \
+        p.stdout.strip().splitlines()[-1].split()
+    return digest, int(n_events), int(n_pipeline), int(n_spill)
 
 
 def test_same_seed_sim_trace_bit_identical_with_pipeline(tmp_path):
-    d1, n1, p1 = _run_child(tmp_path, "a")
-    d2, n2, p2 = _run_child(tmp_path, "b")
+    d1, n1, p1, _s1 = _run_child(tmp_path, "a")
+    d2, n2, p2, _s2 = _run_child(tmp_path, "b")
     assert n1 > 100, f"trace suspiciously small ({n1} events)"
     assert p1 > 0, (
         "no ResolverDevice span events in the trace — the device "
@@ -135,8 +153,27 @@ def test_same_seed_sim_trace_bit_identical_with_pipeline(tmp_path):
         f"observable events")
 
 
+def test_same_seed_sim_trace_bit_identical_with_spill_forced_on(tmp_path):
+    """ISSUE 11 acceptance: a durable same-seed sim with the durability
+    ring's spill budget forced to 1 byte (every tick spills sealed
+    segments to the side file and reads them back through the commit
+    slice) must still produce a BIT-IDENTICAL trace — the spill path
+    adds disk hops, never nondeterminism."""
+    d1, n1, _p1, s1 = _run_child(tmp_path, "sa", mode="spill")
+    d2, n2, _p2, s2 = _run_child(tmp_path, "sb", mode="spill")
+    assert n1 > 100, f"trace suspiciously small ({n1} events)"
+    assert s1 > 0, (
+        "no StorageDbufSpill events in the trace — the forced-on spill "
+        "path did not run, so this test proved nothing")
+    assert (d1, n1, s1) == (d2, n2, s2), (
+        f"same-seed sim trace diverged with the ring spill forced ON: "
+        f"run a = {d1} ({n1} events, {s1} spills), run b = {d2} "
+        f"({n2} events, {s2} spills)")
+
+
 if __name__ == "__main__":
-    if len(sys.argv) == 3 and sys.argv[1] == "--child":
-        _child(sys.argv[2])
+    if len(sys.argv) in (3, 4) and sys.argv[1] == "--child":
+        _child(sys.argv[2], sys.argv[3] if len(sys.argv) == 4 else "default")
     else:
-        raise SystemExit("usage: test_sim_determinism.py --child <path>")
+        raise SystemExit(
+            "usage: test_sim_determinism.py --child <path> [mode]")

@@ -78,7 +78,17 @@ the .mlog flush path, or the chunked restore quadratic — or that
 silently lost/duplicated a mutation — fails here at tier-1 cost,
 under the standing hard wedge deadline.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|all]
+Stage 9 (``bigkeys``): the memory walls (ISSUE 11) — a 2M-key keyspace
+built on the columnar ``PackedKeyIndex`` vs the legacy list twin with
+an in-situ RSS-per-key ceiling (≤40 B/key over raw key bytes; the list
+path must measure ≥2× that), then the keyspace applied through real
+packed commit batches and served: point/multiget/scan byte-identical
+columnar-vs-legacy, all under the standing hard wedge deadline.  A
+regression that reintroduced per-object key storage — or made the
+columnar merge quadratic — fails here at tier-1 cost, not at a
+10M-key production keyspace.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -123,6 +133,11 @@ SCAN_CHUNK = 512            # per-fetch row limit, pinned via the byte budget
 SCAN_SWEEPS = 3             # full-table sweeps per side of the A/B
 SCAN_BUDGET_S = 90.0        # doubles as the hard wedge deadline
 SCAN_SPEEDUP_FLOOR = 3.0    # packed rows/s vs legacy rows/s
+BIG_KEYS = 2_000_000        # the 10M-key memory wall, scaled to tier-1 cost
+BIG_BUDGET_S = 420.0        # doubles as the hard wedge deadline
+BIG_RSS_PER_KEY = 40.0      # columnar index RSS overhead ceiling, B/key
+BIG_READ_KEYS = 4096        # point/multiget probes over the big keyspace
+BIG_SCAN_ROWS = 200_000     # packed-vs-legacy scan subrange
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -389,20 +404,41 @@ def check_feed(n_txns: int = FEED_TXNS, n_clients: int = FEED_CLIENTS,
 def read_path_seconds(n_rows: int = READ_ROWS, n_ops: int = READ_OPS,
                       batch: int = READ_BATCH,
                       n_readers: int = READ_READERS,
-                      deadline_s: float | None = None
+                      deadline_s: float | None = None,
+                      storage_engine: str | None = None
                       ) -> tuple[float, dict]:
     """Wall seconds for the read-path smoke: ``n_rows`` loaded through
     real commits, one reader measuring a scalar ``get()`` loop vs
     ``get_multi`` at ``batch`` over the SAME keys (byte-identical
     results asserted in situ), then ``n_readers`` concurrent clients
     mixing coalesced point reads with multigets.  Returns (total
-    elapsed, stats incl. the batched-vs-scalar speedup)."""
+    elapsed, stats incl. the batched-vs-scalar speedup).
+
+    ``storage_engine`` (e.g. "lsm", ISSUE 11): run on a DURABLE cluster
+    with a shrunk MVCC window so the loaded rows age into the engine
+    before the measurement — the multiget misses then resolve through
+    the engine's sparse index, with the device gather active when jax
+    is usable (``device_read_batches`` in the stats proves it served)."""
     from foundationdb_tpu.client.transaction import Transaction
     from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
     from foundationdb_tpu.runtime.errors import FdbError
     from foundationdb_tpu.runtime.knobs import Knobs
 
     knobs = Knobs()
+    if storage_engine:
+        try:
+            import jax
+            jax.config.update("jax_enable_x64", True)   # mirror wants u64
+        except Exception:  # noqa: BLE001 — engine path still measures
+            pass
+        knobs = knobs.override(STORAGE_ENGINE=storage_engine,
+                               STORAGE_VERSION_WINDOW=1_000,
+                               STORAGE_DURABILITY_LAG=0.05,
+                               # a 64-key client multiget splits across
+                               # the 2 shards: ~32 missing keys per
+                               # server-side batch must still clear the
+                               # device threshold
+                               STORAGE_DEVICE_READ_MIN_BATCH=16)
     try:
         from foundationdb_tpu.ops.conflict_cpp import CppConflictSet
         CppConflictSet()
@@ -413,8 +449,27 @@ def read_path_seconds(n_rows: int = READ_ROWS, n_ops: int = READ_OPS,
     def key(i: int) -> bytes:
         return b"read%08d" % (i % n_rows)
 
+    if storage_engine:
+        # small lsm thresholds (the scan-smoke discipline): the load
+        # must flush into SORTED RUNS — a pure-memtable engine has no
+        # sparse index and the device mirror would sit idle
+        import foundationdb_tpu.storage.lsm as lsm_mod
+        saved = (lsm_mod._MEMTABLE_BYTES, lsm_mod._BLOCK_BYTES,
+                 lsm_mod._MAX_RUNS)
+        lsm_mod._MEMTABLE_BYTES = 8 << 10
+        lsm_mod._BLOCK_BYTES = 2 << 10
+        lsm_mod._MAX_RUNS = 16
+    else:
+        saved = None
+
     async def main() -> tuple[float, dict]:
-        cluster = Cluster(ClusterConfig(storage_servers=2), knobs)
+        if storage_engine:
+            from foundationdb_tpu.runtime.files import SimFileSystem
+            cluster = await Cluster.create(
+                ClusterConfig(storage_servers=2), knobs,
+                fs=SimFileSystem(), data_dir="read-db")
+        else:
+            cluster = Cluster(ClusterConfig(storage_servers=2), knobs)
         cluster.start()
         t_all = time.perf_counter()
 
@@ -434,10 +489,31 @@ def read_path_seconds(n_rows: int = READ_ROWS, n_ops: int = READ_OPS,
         span = (n_rows + 7) // 8
         await asyncio.gather(*(loader(j * span, min((j + 1) * span, n_rows))
                                for j in range(8)))
+        if storage_engine:
+            # rows must live in the ENGINE before the measurement (the
+            # sparse-index probe is the point); proxies keep empty
+            # version batches flowing, so the floor advances on its own
+            tip = cluster.sequencer.committed_version
+            while any(s.durable_version < tip
+                      for s in cluster.storage_servers):
+                await asyncio.sleep(0.05)
+            # the tiny window drove the drain; the measurement must not
+            # race the still-advancing floor (versions track the wall
+            # clock, so a 1k window is milliseconds wide) — widen it
+            # back on the SHARED knobs object every role holds
+            knobs.STORAGE_VERSION_WINDOW = Knobs().STORAGE_VERSION_WINDOW
 
         # --- scalar vs multiget, one reader, identical key stream ---
         tr = Transaction(cluster)
         probe = [key(i * 2654435761) for i in range(n_ops)]
+        if storage_engine:
+            # warm the device mirror + its jitted searchsorted (the
+            # resolve stage's warmup discipline): the first batch pays a
+            # one-time upload + compile that is not the steady state the
+            # A/B measures
+            for _ in range(3):
+                await tr.get_multi(sorted(set(probe[:batch])),
+                                   snapshot=True)
         t0 = time.perf_counter()
         scalar = []
         for k in probe:
@@ -455,15 +531,24 @@ def read_path_seconds(n_rows: int = READ_ROWS, n_ops: int = READ_OPS,
 
         # --- concurrent readers: coalesced points + multigets ---
         async def reader(rid: int) -> int:
+            from foundationdb_tpu.runtime.errors import FdbError
             tr = Transaction(cluster)
             seen = 0
             for round_ in range(6):
                 ks = [key((rid * 131 + round_ * 977 + j * 37) * 2654435761)
                       for j in range(batch)]
-                got = await tr.get_multi(sorted(set(ks)), snapshot=True)
+                while True:
+                    try:
+                        got = await tr.get_multi(sorted(set(ks)),
+                                                 snapshot=True)
+                        pts = await asyncio.gather(
+                            *(tr.get(k, snapshot=True) for k in ks[:16]))
+                        break
+                    except FdbError as e:
+                        # a shrunk MVCC window (the lsm pass) can age the
+                        # held version out mid-round: standard retry
+                        await tr.on_error(e)
                 seen += len(got)
-                pts = await asyncio.gather(
-                    *(tr.get(k, snapshot=True) for k in ks[:16]))
                 assert all(v is not None for v in pts)
                 seen += len(pts)
             return seen
@@ -473,12 +558,16 @@ def read_path_seconds(n_rows: int = READ_ROWS, n_ops: int = READ_OPS,
                                           for r in range(n_readers))))
         t_conc = time.perf_counter() - t0
         co = getattr(cluster, "_read_coalescer", None)
+        devs = [s._device_reads for s in cluster.storage_servers
+                if s._device_reads is not None]
         stats = {
             "scalar_reads_per_sec": n_ops / t_scalar if t_scalar else 0.0,
             "multiget_keys_per_sec": n_ops / t_multi if t_multi else 0.0,
             "speedup": (t_scalar / t_multi) if t_multi else 0.0,
             "concurrent_reads": seen,
             "concurrent_s": t_conc,
+            "device_read_active": bool(devs),
+            "device_read_batches": sum(d.served_batches for d in devs),
             **(co.stats() if co is not None else {}),
         }
         elapsed = time.perf_counter() - t_all
@@ -495,6 +584,10 @@ def read_path_seconds(n_rows: int = READ_ROWS, n_ops: int = READ_OPS,
             f"read smoke wedged: the {deadline_s:.0f}s deadline hit — "
             f"a stalled coalescer flush or batched probe, not just "
             f"slowness") from None
+    finally:
+        if saved is not None:
+            (lsm_mod._MEMTABLE_BYTES, lsm_mod._BLOCK_BYTES,
+             lsm_mod._MAX_RUNS) = saved
 
 
 def check_read(budget_s: float = READ_BUDGET_S, quiet: bool = False
@@ -517,6 +610,29 @@ def check_read(budget_s: float = READ_BUDGET_S, quiet: bool = False
         f"multiget speedup {stats['speedup']:.2f}x under the "
         f"{READ_SPEEDUP_FLOOR:.0f}x floor vs the scalar get() loop at "
         f"batch {READ_BATCH} — the batched read path lost its edge")
+    # the same shape on a DURABLE lsm cluster (ISSUE 11 acceptance): the
+    # multiget misses resolve through the columnar sparse index with
+    # the device gather active, and the batched edge must hold there too
+    elapsed2, s2 = read_path_seconds(deadline_s=budget_s,
+                                     storage_engine="lsm")
+    if not quiet:
+        print(f"[perf_smoke] read path (lsm): scalar "
+              f"{s2['scalar_reads_per_sec']:.0f} keys/s, multiget "
+              f"{s2['multiget_keys_per_sec']:.0f} keys/s "
+              f"({s2['speedup']:.1f}x), device batches "
+              f"{s2['device_read_batches']} "
+              f"(active={s2['device_read_active']})")
+    assert elapsed2 < budget_s, (
+        f"lsm read pass took {elapsed2:.1f}s (budget {budget_s:.0f}s)")
+    assert s2["speedup"] >= READ_SPEEDUP_FLOOR, (
+        f"multiget speedup {s2['speedup']:.2f}x under the "
+        f"{READ_SPEEDUP_FLOOR:.0f}x floor on the lsm engine — the "
+        f"sparse-index/device read path lost the batched edge")
+    import importlib.util
+    if importlib.util.find_spec("jax") is not None:
+        assert s2["device_read_active"] and s2["device_read_batches"] > 0, (
+            "DeviceReadServer never served a batch on the lsm cluster — "
+            "the device gather failed to activate over the sparse index")
     return elapsed
 
 
@@ -1206,13 +1322,262 @@ def check_scan(budget_s: float = SCAN_BUDGET_S, quiet: bool = False
     return elapsed
 
 
+def _rss_bytes() -> int | None:
+    """Current resident set size (Linux /proc; None when unavailable —
+    the RSS assertions then skip rather than fake a number).  glibc's
+    free heap is trimmed first: repeated multi-MB blob alloc/free
+    cycles raise its dynamic mmap threshold, and without the trim the
+    retained-but-free heap (measured ~65 B/key of pure allocator slop
+    at 2M keys) would swamp the per-key delta this measures."""
+    try:
+        import ctypes
+        ctypes.CDLL("libc.so.6").malloc_trim(0)
+    except Exception:   # noqa: BLE001 — non-glibc: slack rides the number
+        pass
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:   # noqa: BLE001 — non-Linux host
+        return None
+
+
+def bigkeys_key_fn(n_keys: int):
+    """The bigkeys keyspace: a hash-permuted arrival order over n_keys
+    distinct keys (the multiplier is odd and coprime to the row counts
+    both consumers use, so i -> key is a bijection).  Shared with
+    bench.py's `bigkeys` operating point — one definition of the
+    workload shape."""
+    mul = 1_315_423_911
+
+    def key(i: int) -> bytes:
+        return b"big%012d" % ((i * mul) % n_keys)
+
+    return key
+
+
+async def apply_bigkeys(ss, n_keys: int, key, value=b"v%08d"
+                        ) -> tuple[int, float]:
+    """Apply ``n_keys`` fresh keys through real packed commit batches
+    (the TLog-pull apply shape) onto ``ss``; returns (final version,
+    apply seconds).  Shared by the bigkeys smoke and bench stage."""
+    from foundationdb_tpu.core.data import MutationBatchBuilder
+    t0 = time.perf_counter()
+    version = 0
+    for start in range(0, n_keys, 4096):
+        version += 1
+        mb = MutationBatchBuilder()
+        for i in range(start, min(start + 4096, n_keys)):
+            mb.add(0, key(i), value % i)
+        ss._apply_batch([(version, mb.finish())])
+        if (start // 4096) % 16 == 0:
+            await asyncio.sleep(0)
+    return version, time.perf_counter() - t0
+
+
+async def packed_scan(ss, begin: bytes, end: bytes, version: int,
+                      chunk: int = 4096) -> list:
+    """Full packed chunked-continuation scan of [begin, end) — the
+    client continuation discipline at the storage boundary."""
+    from foundationdb_tpu.core.data import GetRangeRequest
+    rows: list = []
+    b = begin
+    while True:
+        rep = await ss.get_key_values_packed(
+            GetRangeRequest(b, end, version, chunk))
+        assert rep.status == 0, rep.status
+        rows.extend(rep.rows())
+        if not rep.more or not len(rep):
+            break
+        b = rows[-1][0] + b"\x00"
+    return rows
+
+
+def bigkeys_seconds(n_keys: int = BIG_KEYS,
+                    deadline_s: float | None = None) -> tuple[float, dict]:
+    """The memory-wall smoke (ISSUE 11): a ≥2M-key keyspace built and
+    served at tier-1 cost.
+
+    Part 1 — the columnar index A/B: the SAME 2M-key insertion stream
+    (hash-permuted arrival order, chunked ``add_many`` — the apply
+    path's shape) builds a columnar ``PackedKeyIndex`` and the legacy
+    list-mode twin, RSS measured around each.  The columnar index must
+    hold ≤ ``BIG_RSS_PER_KEY`` bytes/key of overhead beyond the raw key
+    bytes (one int64 bound per key + blob slack; the list path pays
+    ~30-50B of PyObject header + pointer per key — asserted ≥2× the
+    columnar overhead), and spot-checked range queries must agree.
+
+    Part 2 — the keyspace SERVED: the 2M keys applied through real
+    packed commit batches on a storage server (the TLog-pull apply
+    shape), then point reads (scalar vs multiget) and a
+    ``BIG_SCAN_ROWS`` packed-vs-legacy chunked scan, all byte-identical
+    — the columnar index is what locates every range row.  The whole
+    run sits under the standing hard wedge deadline."""
+    import gc
+
+    from foundationdb_tpu.storage.key_index import PackedKeyIndex
+
+    key = bigkeys_key_fn(n_keys)
+    klen = len(key(0))
+    raw_bytes = klen * n_keys
+
+    async def main() -> tuple[float, dict]:
+        t_all = time.perf_counter()
+        chunk = 65536
+        overhead: dict[bool, float | None] = {}
+        build_s: dict[bool, float] = {}
+        indexes: dict[bool, PackedKeyIndex] = {}
+        for mode in (True, False):      # columnar first, then the twin
+            gc.collect()
+            r0 = _rss_bytes()
+            t0 = time.perf_counter()
+            idx = PackedKeyIndex(columnar=mode)
+            for start in range(0, n_keys, chunk):
+                idx.add_many([key(i) for i in
+                              range(start, min(start + chunk, n_keys))])
+                await asyncio.sleep(0)      # keep the wedge deadline armed
+            if idx.pending_run():
+                idx._merge()                # measure the settled base run
+            build_s[mode] = time.perf_counter() - t0
+            gc.collect()
+            r1 = _rss_bytes()
+            overhead[mode] = ((r1 - r0 - raw_bytes) / n_keys
+                              if r0 is not None and r1 is not None else None)
+            indexes[mode] = idx
+        col, lst = indexes[True], indexes[False]
+        assert len(col) == len(lst) == n_keys, "index lost keys"
+        ranges = [(b"big%012d" % (j * 971), b"big%012d" % (j * 971 + 40))
+                  for j in range(0, 2000, 13)]
+        assert col.ranges_keys(ranges) == lst.ranges_keys(ranges), \
+            "columnar index diverged from the list twin on range queries"
+        del lst
+        indexes.clear()
+        gc.collect()
+
+        # --- part 2: the keyspace applied through real commit batches ---
+        from foundationdb_tpu.core.data import GetValuesRequest, KeyRange
+        from foundationdb_tpu.core.storage_server import StorageServer
+        from foundationdb_tpu.core.tlog import TLog
+        from foundationdb_tpu.runtime.knobs import Knobs
+
+        knobs = Knobs().override(STORAGE_VERSION_WINDOW=1 << 60)
+        ss = StorageServer(knobs, 0, KeyRange(b"", b"\xff"), TLog(knobs))
+        version, apply_s = await apply_bigkeys(ss, n_keys, key)
+        assert len(ss.vmap) == n_keys, "apply lost keys"
+
+        # point reads: scalar vs multiget, byte-identical
+        probes = sorted({key((i * 2654435761) % n_keys)
+                         for i in range(BIG_READ_KEYS)})
+        t0 = time.perf_counter()
+        scalar = [await ss.get_value(k, version) for k in probes]
+        scalar_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        multi: list = []
+        for s in range(0, len(probes), 64):
+            part = probes[s:s + 64]
+            rep = await ss.get_values(
+                GetValuesRequest.from_keys(part, version))
+            multi.extend(rep.unpack(i)[1] for i in range(len(part)))
+        multi_s = time.perf_counter() - t0
+        assert multi == scalar, "multiget diverged from scalar gets"
+        assert all(v is not None for v in scalar), "probe lost rows"
+
+        # scan: packed chunked continuation vs the legacy row path
+        b0 = b"big%012d" % 0
+        e0 = b"big%012d" % BIG_SCAN_ROWS
+        t0 = time.perf_counter()
+        packed_rows = await packed_scan(ss, b0, e0, version)
+        packed_s = time.perf_counter() - t0
+        legacy_rows: list = []
+        b = b0
+        while True:
+            rows, more = await ss.get_key_values(b, e0, version, 4096)
+            legacy_rows.extend(rows)
+            if not more or not rows:
+                break
+            b = rows[-1][0] + b"\x00"
+        assert packed_rows == legacy_rows, \
+            "packed scan diverged from the legacy path at 2M keys"
+        assert len(packed_rows) == BIG_SCAN_ROWS, len(packed_rows)
+
+        stats = {
+            "keys": n_keys,
+            "columnar_overhead_b_per_key":
+                round(overhead[True], 2) if overhead[True] is not None
+                else None,
+            "list_overhead_b_per_key":
+                round(overhead[False], 2) if overhead[False] is not None
+                else None,
+            "columnar_build_s": round(build_s[True], 2),
+            "list_build_s": round(build_s[False], 2),
+            "index_base_bytes": col.stats()["base_bytes"],
+            "apply_keys_per_sec": round(n_keys / apply_s, 1),
+            "scalar_reads_per_sec":
+                round(len(probes) / scalar_s, 1) if scalar_s else 0.0,
+            "multiget_keys_per_sec":
+                round(len(probes) / multi_s, 1) if multi_s else 0.0,
+            "scan_rows_per_sec":
+                round(BIG_SCAN_ROWS / packed_s, 1) if packed_s else 0.0,
+        }
+        return time.perf_counter() - t_all, stats
+
+    async def bounded():
+        return await asyncio.wait_for(main(), deadline_s)
+
+    try:
+        return asyncio.run(bounded())
+    except asyncio.TimeoutError:
+        raise AssertionError(
+            f"bigkeys smoke wedged: the {deadline_s:.0f}s deadline hit — "
+            f"an index merge, apply slice, or scan continuation that "
+            f"stopped making progress, not just slowness") from None
+
+
+def check_bigkeys(n_keys: int = BIG_KEYS, budget_s: float = BIG_BUDGET_S,
+                  quiet: bool = False) -> float:
+    """Run the memory-wall smoke; raises AssertionError past the RSS
+    ceiling, on columnar-vs-legacy divergence, past the budget, or at
+    the wedge deadline."""
+    elapsed, stats = bigkeys_seconds(n_keys, deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] bigkeys: {stats['keys']} keys — columnar "
+              f"{stats['columnar_overhead_b_per_key']} B/key overhead vs "
+              f"list {stats['list_overhead_b_per_key']} B/key (builds "
+              f"{stats['columnar_build_s']}s/{stats['list_build_s']}s), "
+              f"apply {stats['apply_keys_per_sec']:.0f} keys/s, multiget "
+              f"{stats['multiget_keys_per_sec']:.0f} keys/s, scan "
+              f"{stats['scan_rows_per_sec']:.0f} rows/s")
+    assert elapsed < budget_s, (
+        f"bigkeys smoke took {elapsed:.1f}s (budget {budget_s:.0f}s) — "
+        f"the columnar index or the big-keyspace read path grew a "
+        f"quadratic shape")
+    co = stats["columnar_overhead_b_per_key"]
+    lo = stats["list_overhead_b_per_key"]
+    if co is not None:
+        assert co <= BIG_RSS_PER_KEY, (
+            f"columnar index RSS overhead {co:.1f} B/key exceeds the "
+            f"{BIG_RSS_PER_KEY:.0f} B/key ceiling over raw key bytes — "
+            f"the memory wall is back")
+        if n_keys >= 1_000_000:
+            # the ratio needs the full scale: below ~1M keys the deltas
+            # sit inside the allocator's noise floor (measured 8.9 vs
+            # 40.6 B/key at 2M; a 200k quick run can read 22 vs 30)
+            assert lo >= 2 * co, (
+                f"list-mode overhead {lo:.1f} B/key is under 2x the "
+                f"columnar {co:.1f} B/key — either the columnar run "
+                f"regressed toward per-object storage or the "
+                f"measurement is broken")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
     ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
     ap.add_argument("--stage",
                     choices=("apply", "pipeline", "feed", "read",
-                             "resolve", "heat", "backup", "scan", "all"),
+                             "resolve", "heat", "backup", "scan",
+                             "bigkeys", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -1223,6 +1588,8 @@ def main() -> int:
     ap.add_argument("--heat-budget", type=float, default=HEAT_BUDGET_S)
     ap.add_argument("--backup-budget", type=float, default=BACKUP_BUDGET_S)
     ap.add_argument("--scan-budget", type=float, default=SCAN_BUDGET_S)
+    ap.add_argument("--big-keys", type=int, default=BIG_KEYS)
+    ap.add_argument("--big-budget", type=float, default=BIG_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -1240,6 +1607,8 @@ def main() -> int:
         check_backup(budget_s=args.backup_budget)
     if args.stage in ("scan", "all"):
         check_scan(budget_s=args.scan_budget)
+    if args.stage in ("bigkeys", "all"):
+        check_bigkeys(args.big_keys, budget_s=args.big_budget)
     return 0
 
 
