@@ -96,6 +96,7 @@ class BackupContainer:
     def __init__(self, fs, directory: str) -> None:
         self.fs = fs
         self.dir = directory.rstrip("/")
+        self._log_seq: int | None = None    # lazily loaded slot sequence
 
     def _path(self, name: str) -> str:
         return f"{self.dir}/{name}"
@@ -208,12 +209,61 @@ class BackupContainer:
                 for v, t, bo, bl in rec["e"]]
 
     async def save_log_manifest(self, meta: dict) -> None:
-        await self._write_file("logs.manifest", encode(meta))
+        """THE resume token write.  Alternating crc-framed slots
+        (ISSUE 12): the manifest used to be rewritten in place, so an
+        agent killed mid-write tore the ONLY copy and the container
+        became unresumable after a legitimate crash.  The slot not being
+        written always holds the previous valid manifest."""
+        if self._log_seq is None:
+            prev = await self._load_log_manifest_any()
+            self._log_seq = prev.get("seq", 0) if prev else 0
+        # seq advances only after the write+sync: a failed (retried)
+        # save must re-target the SAME slot, never the freshest one
+        seq = self._log_seq + 1
+        meta = dict(meta)
+        meta["seq"] = seq
+        slot = "logs.manifest.a" if seq % 2 else "logs.manifest.b"
+        await self._write_file(slot, encode(meta))
+        self._log_seq = seq
+
+    async def _load_log_manifest_any(self) -> dict | None:
+        """Newest valid slot (or the legacy single file); raises
+        ContainerError when slots exist but NONE decodes — a completed
+        save always leaves the older slot intact through any kill, so
+        that state is corruption of the committed resume token, and
+        guessing a frontier would break exactly-once."""
+        best = None
+        found = 0
+        for name in ("logs.manifest.a", "logs.manifest.b"):
+            if self.fs.open(self._path(name)).size() == 0:
+                continue
+            found += 1
+            try:
+                meta = decode(await self._read_file(name))
+            except Exception:  # noqa: BLE001 — torn slot: other one wins
+                continue
+            if best is None or meta.get("seq", 0) > best.get("seq", 0):
+                best = meta
+        if best is not None:
+            return best
+        if self.fs.open(self._path("logs.manifest")).size() > 0:
+            found += 1
+            try:
+                return decode(await self._read_file("logs.manifest"))
+            except ContainerError:
+                pass
+        if found:
+            raise ContainerError(
+                f"no readable logs.manifest among {found} slots in "
+                f"{self.dir} — the mutation log's resume token is "
+                f"damaged; refusing to guess a frontier")
+        return None
 
     async def load_log_manifest(self) -> dict | None:
-        if self.fs.open(self._path("logs.manifest")).size() == 0:
-            return None             # absent: no mutation log
-        return decode(await self._read_file("logs.manifest"))
+        meta = await self._load_log_manifest_any()
+        if meta is not None and self._log_seq is None:
+            self._log_seq = meta.get("seq", 0)
+        return meta
 
     # --- expiration / GC (ISSUE 9; the expireData discipline of
     # REF:fdbclient/BackupContainer.actor.cpp) ---

@@ -17,10 +17,11 @@ from ..core.data import (CommitTransactionRequest, KeySelector, MutationType,
                          Version, key_after)
 from ..runtime import span as _span
 from ..runtime.errors import (CommitUnknownResult, FdbError, InvalidOption,
-                              KeyOutsideLegalRange, KeyTooLarge,
-                              RequestMaybeDelivered, TransactionCancelled,
-                              TransactionTooLarge, TransactionReadOnly,
-                              UsedDuringCommit, ValueTooLarge)
+                              IoError as _IoError, KeyOutsideLegalRange,
+                              KeyTooLarge, RequestMaybeDelivered,
+                              TransactionCancelled, TransactionTooLarge,
+                              TransactionReadOnly, UsedDuringCommit,
+                              ValueTooLarge)
 from ..runtime.rng import deterministic_random
 from .writemap import WriteMap
 
@@ -68,10 +69,88 @@ class Transaction:
         self.lock_aware = False
         self.priority = "default"
         self.throttle_tag: str | None = None
+        # the C API's bounded-failure trio (ISSUE 12;
+        # REF:fdbclient/NativeAPI.actor.cpp TransactionOptions TIMEOUT /
+        # RETRY_LIMIT / MAX_RETRY_DELAY): enforced in on_error and on
+        # the blocking surfaces, so a degraded cluster surfaces a
+        # bounded transaction_timed_out instead of an unbounded hang.
+        # Persistent across reset/on_error like lock_aware; the timeout
+        # covers the transaction INCLUDING retries (upstream semantics).
+        self.timeout = self._knobs.DEFAULT_TIMEOUT          # seconds; 0 off
+        self.retry_limit = self._knobs.DEFAULT_RETRY_LIMIT  # -1 unlimited
+        self.max_retry_delay = self._knobs.DEFAULT_MAX_RETRY_DELAY
+        self._deadline: float | None = None
         # SPECIAL_KEY_SPACE_ENABLE_WRITES (REF: the transaction option
         # gating management writes through \xff\xff)
         self.special_key_space_enable_writes = False
         self.reset()
+
+    # --- bounded-failure options (the C API trio) ---
+
+    def set_timeout(self, seconds: float) -> None:
+        """Whole-transaction deadline, retries included; 0 disables.
+        Validated BEFORE mutating: a rejected value must leave any
+        previously armed deadline untouched."""
+        seconds = float(seconds)
+        if seconds < 0:
+            raise InvalidOption("timeout must be >= 0")
+        self.timeout = seconds
+        self._deadline = None
+        if self.timeout > 0:
+            try:
+                self._deadline = asyncio.get_running_loop().time() \
+                    + self.timeout
+            except RuntimeError:
+                pass            # armed lazily at first use
+
+    def set_retry_limit(self, limit: int) -> None:
+        """on_error retries allowed before the error is re-raised;
+        -1 = unlimited, 0 = never retry."""
+        self.retry_limit = int(limit)
+
+    def set_max_retry_delay(self, seconds: float) -> None:
+        if seconds <= 0:
+            raise InvalidOption("max_retry_delay must be > 0")
+        self.max_retry_delay = float(seconds)
+
+    def _remaining(self) -> float | None:
+        """Seconds until the deadline (None = no timeout armed)."""
+        if self.timeout <= 0:
+            return None
+        loop = asyncio.get_running_loop()
+        if self._deadline is None:
+            self._deadline = loop.time() + self.timeout
+        return self._deadline - loop.time()
+
+    def _check_deadline(self) -> None:
+        """Cheap entry-point check: an op issued past the deadline fails
+        NOW with transaction_timed_out instead of dialing the cluster
+        (the blocking awaits themselves are raced via ``_bounded``)."""
+        if self.timeout > 0:
+            rem = self._remaining()
+            if rem is not None and rem <= 0:
+                from ..runtime.errors import TransactionTimedOut
+                raise TransactionTimedOut()
+
+    async def _bounded(self, coro):
+        """Race one blocking await against the transaction deadline —
+        what turns a wedged read/commit on a degraded cluster into a
+        bounded transaction_timed_out."""
+        rem = self._remaining()
+        if rem is None:
+            return await coro
+        if rem <= 0:
+            if asyncio.iscoroutine(coro):
+                coro.close()
+            else:                   # a Future (e.g. the shielded GRV)
+                coro.cancel()
+            from ..runtime.errors import TransactionTimedOut
+            raise TransactionTimedOut()
+        try:
+            return await asyncio.wait_for(coro, rem)
+        except asyncio.TimeoutError:
+            from ..runtime.errors import TransactionTimedOut
+            raise TransactionTimedOut() from None
 
     # --- lifecycle ---
 
@@ -119,7 +198,9 @@ class Transaction:
         if self._grv_task is None:
             self._grv_task = asyncio.get_running_loop().create_task(
                 self._fetch_read_version(), name="txn-grv")
-        return await asyncio.shield(self._grv_task)
+        # the shield keeps the shared GRV fetch alive when the deadline
+        # cancels this waiter (a sibling read may still be inside it)
+        return await self._bounded(asyncio.shield(self._grv_task))
 
     async def _fetch_read_version(self) -> Version:
         # TraceBatch latency probe (REF:flow/Trace.h TraceBatch): a
@@ -152,6 +233,7 @@ class Transaction:
 
     async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
         self._check_mutable()
+        self._check_deadline()
         if key.startswith(b"\xff\xff"):
             return await self._special_key(key)
         self._check_key(key)
@@ -166,7 +248,7 @@ class Transaction:
         if not snapshot:
             self._read_conflicts.append((key, key_after(key)))
         with _hop(self._span, "TransactionDebug", "NativeAPI.get") as h:
-            base = await self._storage_read(key, version)
+            base = await self._bounded(self._storage_read(key, version))
             _SPANS.event("TransactionDebug", h, "NativeAPI.get.After")
         if kind == "stack":
             return WriteMap.fold_with_base(payload, base)
@@ -201,6 +283,7 @@ class Transaction:
         as one packed multiget per owning shard, fanned out and
         reassembled in key order."""
         self._check_mutable()
+        self._check_deadline()
         results: list[bytes | None] = [None] * len(keys)
         fetch: list[tuple[int, bytes, str, object]] = []
         for i, key in enumerate(keys):
@@ -237,10 +320,10 @@ class Transaction:
         reqs = [(g, sorted(set(ks))) for g, ks in per_shard.items()]
         with _hop(self._span, "TransactionDebug", "NativeAPI.getValues",
                   Keys=len(waits), Shards=len(reqs)) as h:
-            replies = await asyncio.gather(
+            replies = await self._bounded(asyncio.gather(
                 *(g.get_values(GetValuesRequest.from_keys(sk, version))
                   for g, sk in reqs),
-                return_exceptions=True)
+                return_exceptions=True))
             err = next((r for r in replies if isinstance(r, BaseException)),
                        None)
             if err is not None:
@@ -282,6 +365,7 @@ class Transaction:
                         ) -> list[tuple[bytes, bytes]]:
         """begin/end: bytes or KeySelector.  Returns up to ``limit`` pairs."""
         self._check_mutable()
+        self._check_deadline()
         if isinstance(begin, bytes) and begin.startswith(b"\xff\xff"):
             # special-key range read: module-backed, may span modules
             from .special_keys import SPECIAL_KEY_SPACE
@@ -299,7 +383,11 @@ class Transaction:
         if begin >= end:
             return []
         with _hop(self._span, "TransactionDebug", "NativeAPI.getRange") as h:
-            out = await self._merged_range(begin, end, limit, reverse)
+            # deadline-bounded (ISSUE 12): a wedged shard fetch on a
+            # degraded cluster surfaces transaction_timed_out instead
+            # of hanging the scan unboundedly
+            out = await self._bounded(
+                self._merged_range(begin, end, limit, reverse))
             _SPANS.event("TransactionDebug", h, "NativeAPI.getRange.After",
                          Rows=len(out))
         if not snapshot:
@@ -475,6 +563,7 @@ class Transaction:
         snapshot writer is the canonical consumer — its pages reach the
         ``.kvr`` frame byte-identical to the tuple path (tested)."""
         self._check_mutable()
+        self._check_deadline()
         if self._writes.written_keys_in(begin, end) \
                 or self._writes.clears_in(begin, end):
             from ..runtime.errors import ClientInvalidOperation
@@ -514,6 +603,7 @@ class Transaction:
         writes falls back to the legacy merge, which already handles
         them."""
         self._check_mutable()
+        self._check_deadline()
         k, oe, off = selector.key, selector.or_equal, selector.offset
         if off > 0:
             # firstGreaterOrEqual(k)+n / firstGreaterThan(k)+n
@@ -691,6 +781,7 @@ class Transaction:
 
     async def commit(self) -> Version:
         self._check_mutable()
+        self._check_deadline()
         if not self._writes and not self._write_conflicts:
             # read-only txn commits trivially at its read version
             self._committed_version = self._read_version if self._read_version is not None else 0
@@ -723,12 +814,19 @@ class Transaction:
             proxy = deterministic_random().choice(self._cluster.commit_proxies)
             with _hop(self._span, "CommitDebug", "NativeAPI.commit",
                       Mutations=len(req.mutations)) as h:
-                result = await proxy.commit(req)
+                # deadline-bounded (ISSUE 12): a commit cut off by the
+                # transaction timeout surfaces transaction_timed_out —
+                # like an unknown result, the commit MAY have landed;
+                # on_error refuses to spin past the deadline either way
+                result = await self._bounded(proxy.commit(req))
                 _SPANS.event("CommitDebug", h, "NativeAPI.commit.After",
                              Version=result.version)
-        except RequestMaybeDelivered:
-            # the commit reached the proxy but its reply was lost: the
-            # outcome is unknown and retrying blindly could double-commit
+        except (RequestMaybeDelivered, _IoError):
+            # the commit reached the proxy but its reply was lost — or a
+            # server-side disk error surfaced AFTER the batch may have
+            # landed on some logs (ISSUE 12: io_error is retryable for
+            # idempotent ops, but a commit is not one): the outcome is
+            # unknown and retrying blindly could double-commit
             if self._probe_id is not None and tb is not None:
                 tb.event(self._probe_id, "commit_done")
                 tb.flush(self._probe_id, "unknown_result")
@@ -791,11 +889,27 @@ class Transaction:
     # --- error handling / retry (REF: Transaction::onError) ---
 
     async def on_error(self, e: BaseException) -> None:
+        # a NON-retryable error re-raises unchanged even past the
+        # deadline: it carries a definite outcome (e.g. a too-large
+        # commit provably never landed), and replacing it with
+        # transaction_timed_out — which is maybe-committed — would
+        # inflate a known result into ambiguity
         if not isinstance(e, FdbError) or not e.retryable:
             raise e
+        # bounded failure (ISSUE 12, the C API trio): a transaction past
+        # its deadline never RETRIES — the caller gets
+        # transaction_timed_out now instead of an unbounded retry loop
+        # against a degraded cluster
+        rem = self._remaining() if self.timeout > 0 else None
+        if rem is not None and rem <= 0:
+            from ..runtime.errors import TransactionTimedOut
+            raise TransactionTimedOut() from \
+                (e if not isinstance(e, TransactionTimedOut) else None)
         self._retry_count += 1
+        if self.retry_limit >= 0 and self._retry_count > self.retry_limit:
+            raise e
         backoff = min(0.001 * (2 ** min(self._retry_count, 10)),
-                      self._knobs.DEFAULT_MAX_RETRY_DELAY)
+                      self.max_retry_delay)
         await asyncio.sleep(backoff * (0.5 + deterministic_random().random() * 0.5))
         retry_count = self._retry_count
         self.reset()

@@ -425,6 +425,12 @@ class ChangeFeedStore:
         for v, st, en, nb in f.spilled[lo:]:
             if v > through_version:
                 return out, None
+            # a corrupt spilled frame raises DiskCorrupt from read_frames
+            # (ISSUE 12): the stream RPC fails LOUDLY instead of the old
+            # behavior of silently skipping the version — a consumer
+            # must never be heartbeated past data it was never handed.
+            # An empty result only means the frame range was released by
+            # a concurrent pop, which IS silently skippable.
             frames = await self.queue.read_frames(st, en)
             if not frames:
                 continue        # released concurrently by a pop

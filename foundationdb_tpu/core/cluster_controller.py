@@ -155,6 +155,20 @@ class ClusterController:
                 .detail("Index", i).detail("Satellite", satellite) \
                 .detail("Addr", str(res[0])).log()
 
+    def order_for_recruitment(self, live: list) -> list:
+        """Stable-partition (addr, worker) pairs: healthy disks first,
+        degraded last (ISSUE 12).  Order within each class is preserved
+        so same-seed recoveries with no degraded machine are
+        pick-identical to the pre-gray-failure behavior."""
+        degraded = [aw for aw in live if self.fm.is_degraded(aw[0])]
+        if not degraded or len(degraded) == len(live):
+            return live
+        healthy = [aw for aw in live if not self.fm.is_degraded(aw[0])]
+        TraceEvent("RecruitAvoidDegraded") \
+            .detail("Degraded", [str(a) for a, _ in degraded]) \
+            .detail("Healthy", len(healthy)).log()
+        return healthy + degraded
+
     async def _stop_attempt_recruits(self) -> None:
         """Tear down a FAILED recovery attempt's recruits.  Orphaned
         pipelines are not just waste: an orphan sequencer+proxy pair keeps
@@ -361,6 +375,13 @@ class ClusterController:
                 .detail("Primary", primary_region["id"]) \
                 .detail("SatelliteWorkers", len(sat_workers)) \
                 .detail("RemoteDcs", remote_dcs).log()
+
+        # deprioritize gray-failed machines (ISSUE 12): workers whose
+        # disk the health poll marked degraded sort LAST, so the
+        # round-robin pick() lands txn roles on them only when the
+        # healthy pool is exhausted — never refuse outright (a small
+        # fleet must still recover on a slow disk)
+        txn_live = self.order_for_recruitment(txn_live)
 
         def pick(i: int) -> NetworkAddress:
             return txn_live[i % len(txn_live)][0]
@@ -898,6 +919,10 @@ class ClusterController:
             # we idle must be noticed (the mover may have died right
             # after phase 1; the CC is then the one who completes it)
             waiters.append(asyncio.ensure_future(self._watch_quorum_change()))
+            # disk-health poll (ISSUE 12): feeds worker disk latency
+            # into the FailureMonitor's degraded state; never completes,
+            # so it can never trigger a recovery by itself
+            waiters.append(asyncio.ensure_future(self._watch_disk_health()))
             try:
                 done, pending = await asyncio.wait(
                     waiters, return_when=asyncio.FIRST_COMPLETED)
@@ -942,6 +967,29 @@ class ClusterController:
                     e.moving_to = r["__moving_to__"]
                     e.inner_value = r.get("__value__")
                     raise e
+
+    async def _watch_disk_health(self) -> None:
+        """Poll every live worker's disk_health and maintain the
+        FailureMonitor's degraded set (ISSUE 12 gray-failure
+        detection).  Per-worker failures are skipped — a machine whose
+        health RPC fails is the BINARY monitor's problem; this loop
+        only tracks the slow-but-alive case."""
+        interval = self.knobs.CC_DISK_HEALTH_INTERVAL
+        if interval <= 0:
+            await asyncio.Event().wait()    # disabled; park forever
+        while True:
+            await asyncio.sleep(interval)
+            for addr, w in self._live_workers():
+                try:
+                    h = await asyncio.wait_for(
+                        w.disk_health(),
+                        timeout=self.knobs.FAILURE_TIMEOUT)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — binary monitor's job
+                    continue
+                self.fm.set_degraded(addr, bool(h.get("disk_degraded")),
+                                     float(h.get("disk_latency_ms", 0.0)))
 
     async def _probe_roles(self, state: dict) -> None:
         """Ping each recruited txn role's block-level liveness slot

@@ -146,9 +146,26 @@ class ClusterHost:
     # --- the main loop: elect, lead or follow, repeat ---
 
     async def run(self) -> None:
-        from ..runtime.errors import CoordinatorsChanged
+        from ..runtime.errors import CoordinatorsChanged, IoError
         k = self.knobs
-        await self.worker.open_resident()
+        # reboot adoption retries transient disk errors (the sim's
+        # injected IoError, a real EIO) like a respawning fdbserver —
+        # anything else (DiskCorrupt included) still fails the host
+        # loudly (ISSUE 12)
+        attempt = 0
+        while True:
+            try:
+                await self.worker.open_resident()
+                break
+            except IoError as e:
+                attempt += 1
+                if attempt >= 20:
+                    raise
+                from ..runtime.trace import TraceEvent
+                TraceEvent("ResidentOpenRetry", severity=30) \
+                    .detail("Host", self.id).detail("Attempt", attempt) \
+                    .error(e).log()
+                await asyncio.sleep(0.25)
         me = [self.address.ip, self.address.port]
         while not self._stopped:
             try:

@@ -33,10 +33,16 @@ from ..runtime.errors import FdbError, _err
 from ..runtime.knobs import Knobs
 from ..runtime.trace import TraceEvent
 
-NotLatestGeneration = _err(2903, "not_latest_generation",
+# 2910/2911: these used to claim 2903/2904, COLLIDING with the
+# change-feed errors in runtime/errors.py — error_from_code resolved
+# whichever registered last, so a feed stream's change_feed_not_
+# registered surfaced client-side as not_latest_generation and escaped
+# the cursor's handling (found by the ISSUE 12 hostile-disk farm at
+# seed 4: io-error-induced stream failovers hit the mistyped path)
+NotLatestGeneration = _err(2910, "not_latest_generation",
                            "A newer generation has been seen by this coordinator")
 CoordinatorsUnreachable = _err(
-    2904, "coordinators_unreachable",
+    2911, "coordinators_unreachable",
     "No majority of coordinators reachable")
 
 
@@ -81,33 +87,93 @@ class Coordinator:
 
     @classmethod
     async def open(cls, knobs: Knobs, fs, path: str) -> "Coordinator":
-        from ..rpc.wire import decode
+        """Recover from the newest valid of two alternating crc-framed
+        slots (ISSUE 12).  The state used to be truncate-rewritten in
+        place, so a kill tearing the write (truncate persisted, data
+        dropped) silently reset this coordinator to GEN_ZERO — a
+        split-brain seed the hostile-disk sim surfaces immediately.  The
+        un-written slot always holds the previous synced state; the
+        legacy single file is still read for pre-slot disks."""
+        from ..rpc.wire import decode, unframe
         co = cls(knobs, fs, path)
-        f = fs.open(path)
-        data = await f.read(0, f.size())
-        if data:
+        best = None
+        found = 0
+        slots_seen = 0
+        for suffix in (".a", ".b"):
+            f = fs.open(path + suffix)
+            data = await f.read(0, f.size())
+            if not data:
+                continue
+            found += 1
+            slots_seen += 1
             try:
-                st = decode(data)
-                co.max_read_gen = tuple(st["r"])
-                co.write_gen = tuple(st["w"])
-                co.value = st["v"]
-                co.moved_to = st.get("m")
-            except Exception:
-                TraceEvent("CoordStateCorrupt", severity=30).detail(
-                    "Path", path).log()
+                st = decode(unframe(data))
+            except Exception:  # noqa: BLE001 — torn slot: other one wins
+                continue
+            if best is None or st.get("seq", 0) > best.get("seq", 0):
+                best = st
+        if best is None and slots_seen >= 2:
+            # both slots populated yet neither decodes: a crash always
+            # leaves the previously-synced slot intact (the write
+            # alternates), so this is corruption of COMMITTED quorum
+            # state — silently resetting to GEN_ZERO would let a stale
+            # leader win a quorum it already lost (the split-brain seed
+            # the dual slots exist to prevent; ISSUE 12)
+            from ..runtime.errors import DiskCorrupt
+            raise DiskCorrupt(
+                f"both coordinator state slots of {path} are damaged — "
+                f"refusing to silently reset the quorum state")
+        if best is None:
+            f = fs.open(path)
+            data = await f.read(0, f.size())
+            if data:
+                found += 1
+                try:
+                    best = decode(data)
+                except Exception:  # noqa: BLE001 — legacy torn write
+                    pass
+        if best is not None:
+            co.max_read_gen = tuple(best["r"])
+            co.write_gen = tuple(best["w"])
+            co.value = best["v"]
+            co.moved_to = best.get("m")
+            co._persist_seq = best.get("seq", 0)
+        elif found:
+            TraceEvent("CoordStateCorrupt", severity=30).detail(
+                "Path", path).detail("Slots", found).log()
         return co
+
+    _persist_seq = 0
+    _persist_lock = None
 
     async def _persist(self) -> None:
         if self._fs is None:
             return
-        from ..rpc.wire import encode
-        f = self._fs.open(self._path)
-        await f.truncate(0)
-        await f.write(0, encode({"r": list(self.max_read_gen),
+        from ..rpc.wire import encode, frame
+        # serialized: concurrent RPC handlers must never have BOTH slots
+        # dirty at once (a kill could then tear both, and the recovery
+        # invariant "one synced slot always survives" would not hold),
+        # nor write their seqs out of order
+        if self._persist_lock is None:
+            import asyncio
+            self._persist_lock = asyncio.Lock()
+        async with self._persist_lock:
+            # seq advances only after the sync: a failed write must NOT
+            # burn the slot turn, or the retry would land on the slot
+            # holding the freshest synced state (the DiskQueue
+            # _write_header discipline)
+            seq = self._persist_seq + 1
+            slot = ".a" if seq % 2 else ".b"
+            f = self._fs.open(self._path + slot)
+            blob = frame(encode({"seq": seq,
+                                 "r": list(self.max_read_gen),
                                  "w": list(self.write_gen),
                                  "v": self.value,
                                  "m": self.moved_to}))
-        await f.sync()
+            await f.write(0, blob)
+            await f.truncate(len(blob))
+            await f.sync()
+            self._persist_seq = seq
 
     # --- quorum migration (MovableCoordinatedState,
     #     REF:fdbserver/Coordination.actor.cpp) ---

@@ -110,6 +110,9 @@ class DataDistributor:
         self.heat_splits_done = 0
         self.heat_moves_done = 0
         self.last_heat_rw_per_sec = 0.0
+        # gray-failure avoidance (ISSUE 12): destination picks that
+        # skipped a disk-degraded worker
+        self.degraded_avoided = 0
 
     def stats(self) -> dict:
         """Relocation counters (published with every flip; see
@@ -118,7 +121,8 @@ class DataDistributor:
                 "live_moves": self.live_moves_done,
                 "heat_splits": self.heat_splits_done,
                 "heat_moves": self.heat_moves_done,
-                "last_heat_rw_per_sec": self.last_heat_rw_per_sec}
+                "last_heat_rw_per_sec": self.last_heat_rw_per_sec,
+                "degraded_avoided": self.degraded_avoided}
 
     def request_relocation(self, shard_idx: int) -> None:
         """Queue a manual live move of shard ``shard_idx`` onto a fresh
@@ -711,6 +715,19 @@ class DataDistributor:
                     if (self.cc.locality.get(a) or {}).get("dcid") == dcid]
             if not live:
                 raise MoveAborted(f"no live workers in dc {dcid}")
+        # gray-failure avoidance (ISSUE 12): never pick a machine whose
+        # disk the health poll marked degraded as a MOVE DESTINATION
+        # while a healthy alternative exists — fetchKeys onto a stalling
+        # disk drags the move AND the shard's post-move tail latency.
+        # Falls back to the full pool when everything is degraded.
+        healthy = [a for a in live if not self.cc.fm.is_degraded(a)]
+        if healthy and len(healthy) < len(live):
+            self.degraded_avoided += 1
+            TraceEvent("DDAvoidDegraded") \
+                .detail("Degraded",
+                        [str(a) for a in live if a not in healthy]) \
+                .detail("Healthy", len(healthy)).log()
+            live = healthy
         preferred = [a for a in live if not avoid or a.ip not in avoid]
         pool = preferred or live
         if not pool:

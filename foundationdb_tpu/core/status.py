@@ -299,6 +299,32 @@ async def cluster_status(knobs: Knobs, transport: Transport,
     except Exception:   # noqa: BLE001 — partial status beats none
         pass
 
+    # disk-degradation rollup (ISSUE 12, the gray-failure surface): any
+    # disk-bearing role (durable storage, durable TLogs) publishes its
+    # machine's decayed per-op disk latency + degraded flag through the
+    # metrics it already serves; group by machine IP (one disk per sim
+    # machine) taking the worst latency seen.  A slow-but-alive disk
+    # shows up HERE — with its latency — long before it becomes a tail
+    # -latency incident, and `count` > 0 is the one-glance cluster
+    # health bit.
+    by_ip: dict[str, dict] = {}
+    for r in roles:
+        m = r.get("metrics") or {}
+        if "disk_latency_ms" not in m:
+            continue
+        ip = r["addr"][0]
+        e = by_ip.setdefault(ip, {"ip": ip, "latency_ms": 0.0,
+                                  "degraded": False, "roles": []})
+        e["latency_ms"] = max(e["latency_ms"], m["disk_latency_ms"])
+        e["degraded"] = e["degraded"] or bool(m.get("disk_degraded"))
+        if r["role"] not in e["roles"]:
+            e["roles"].append(r["role"])
+    disks = sorted(by_ip.values(), key=lambda e: -e["latency_ms"])
+    degraded_rollup = {
+        "disks": disks,
+        "count": sum(1 for e in disks if e["degraded"]),
+    }
+
     # distributed-tracing rollup (ISSUE 2): every metric-bearing role
     # reports its span counters; sampled_txns comes from the GRV proxies
     # (where every sampled root first crosses the wire).  SERVER-side
@@ -331,6 +357,7 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             "shard_heat": shard_heat_rollup,
             "hot_moves": hot_moves_rollup,
             "backup": backup_rollup,
+            "degraded": degraded_rollup,
             "tracing": tracing_rollup,
         },
         "roles": roles,

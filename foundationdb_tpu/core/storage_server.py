@@ -230,6 +230,14 @@ class StorageServer:
             "shard_write_bytes_per_sec": round(heat_wb, 3),
             "shard_rw_per_sec": round(heat_r + heat_w, 3),
             **self._dbuf.stats(),
+            # disk health (ISSUE 12): durable servers publish their
+            # filesystem's decayed per-op latency + degraded flag — the
+            # gray-failure signal status and the CC's FailureMonitor
+            # poll consume
+            **(self.engine.fs.health.snapshot()
+               if self.engine is not None
+               and getattr(self.engine, "fs", None) is not None
+               and hasattr(self.engine.fs, "health") else {}),
             **self.feeds.metrics(),
             **self.spans.counters(),
             **(self._device_reads.metrics()

@@ -185,12 +185,17 @@ class TLog:
         return sum(st.mem_bytes for st in self._log.values())
 
     async def metrics(self) -> dict:
-        """Queue sample for the Ratekeeper (TLogQueuingMetrics analog)."""
+        """Queue sample for the Ratekeeper (TLogQueuingMetrics analog).
+        Durable logs also publish their disk's decayed latency +
+        degraded flag (ISSUE 12 gray-failure signal — the TLog fsyncs
+        on every commit, so a stalling disk shows up here first)."""
+        health = getattr(getattr(self.queue, "file", None), "health", None)
         return {
             "queue_bytes": self.queue.bytes_used if self.queue is not None else 0,
             "mem_bytes": self.mem_bytes,
             "version": self.version,
             "locked": self.locked,
+            **(health.snapshot() if health is not None else {}),
             **self.spans.counters(),
         }
 
@@ -281,17 +286,41 @@ class TLog:
                                          req.version)
                 self.total_bytes += nbytes
         if self.queue is not None:
-            if messages:
-                from ..rpc.wire import encode
-                end = await self.queue.push(encode({"v": req.version,
-                                                    "m": messages}))
-                self._frame_ends.append((req.version, end))
-            # the fsync that makes commits durable; the tip rides the
-            # header so a reopened log still reports it after pops AND
-            # after idle periods of frameless (empty-batch) versions —
-            # either way a reboot must never report a tip below what
-            # storage has durably applied
-            await self.queue.commit(meta=req.version)
+            # transient disk errors (the sim's injected IoError, a real
+            # EIO) retry in place with backoff instead of failing the
+            # push RPC per glitch (ISSUE 12) — the push is tracked so a
+            # commit-side retry can never append the frame twice (a
+            # duplicate frame would replay the version twice after a
+            # reboot).  DiskCorrupt is NOT retried: committed-data
+            # damage must surface, not spin.
+            from ..runtime.errors import IoError
+            pushed = not messages
+            attempt = 0
+            while True:
+                try:
+                    if not pushed:
+                        from ..rpc.wire import encode
+                        end = await self.queue.push(
+                            encode({"v": req.version, "m": messages}))
+                        self._frame_ends.append((req.version, end))
+                        pushed = True
+                    # the fsync that makes commits durable; the tip
+                    # rides the header so a reopened log still reports
+                    # it after pops AND after idle periods of frameless
+                    # (empty-batch) versions — either way a reboot must
+                    # never report a tip below what storage has durably
+                    # applied
+                    await self.queue.commit(meta=req.version)
+                    break
+                except IoError as e:
+                    attempt += 1
+                    if attempt >= 8:
+                        raise
+                    from ..runtime.trace import TraceEvent
+                    TraceEvent("TLogDiskError", severity=30) \
+                        .detail("Version", req.version) \
+                        .detail("Attempt", attempt).error(e).log()
+                    await asyncio.sleep(0.01 * attempt)
             if self.locked:
                 # lock() captured the tip while we were waiting on disk: the
                 # recovery version excludes this push, so acking it would

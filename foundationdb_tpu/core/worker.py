@@ -115,6 +115,9 @@ class Worker:
             if tag.isdigit():
                 tags.add(int(tag))
         for tag in sorted(tags):
+            if tag in self.resident:
+                continue    # a retried adoption pass (transient IoError
+                #             mid-open) must not serve the tag twice
             eng_name = None
             marker = f"{self.data_dir}/storage-{tag}.engine"
             if marker in self.fs.listdir(marker):
@@ -157,6 +160,8 @@ class Worker:
                 key = (parts[0], parts[1], None)
             else:
                 continue
+            if key in self.resident_tlogs:
+                continue    # already adopted by an earlier retry pass
             tlog = await TLog.open(self.knobs, self.fs, path)
             tlog.locked = True
             token = self._alloc_block()
@@ -329,6 +334,18 @@ class Worker:
 
     async def list_roles(self) -> list[tuple[int, str]]:
         return sorted((tok, role) for tok, (role, _) in self.roles.items())
+
+    async def disk_health(self) -> dict:
+        """This machine's decayed disk latency + degraded flag (ISSUE 12
+        gray-failure detection): the CC polls every live worker and
+        feeds the answer into its FailureMonitor's degraded state so
+        recruitment and DD move destinations can route around a
+        slow-but-alive disk.  Diskless workers report healthy."""
+        health = getattr(self.fs, "health", None) if self.fs is not None \
+            else None
+        if health is None:
+            return {"disk_latency_ms": 0.0, "disk_degraded": False}
+        return health.snapshot()
 
     # --- shutdown (machine kill) ---
 

@@ -33,7 +33,17 @@ class FailureStatus:
 
 
 class FailureMonitor:
-    """One per process; monitors any address it is asked about."""
+    """One per process; monitors any address it is asked about.
+
+    Besides the binary alive/dead state, the monitor tracks a DEGRADED
+    state (ISSUE 12; the gray-failure signal of Huang et al. HotOS'17 —
+    FDB 7.x's degraded-peer detection): a machine whose disk is
+    slow-but-alive answers every ping, so the binary state never flips,
+    yet recruiting on it or moving data to it drags cluster p99.
+    Degradation is REPORTED into the monitor (the CC polls worker disk
+    health) rather than detected by pinging — the signal lives where
+    the latency is measured, the policy (recruitment/move
+    deprioritization) lives with the consumers."""
 
     def __init__(self, transport: Transport, knobs: Knobs) -> None:
         self.transport = transport
@@ -41,6 +51,7 @@ class FailureMonitor:
         self._status: dict[NetworkAddress, FailureStatus] = {}
         self._tasks: dict[NetworkAddress, asyncio.Task] = {}
         self._change_waiters: dict[NetworkAddress, list[asyncio.Future]] = {}
+        self._degraded: dict[NetworkAddress, float] = {}  # addr -> since
         self._closed = False
 
     # --- queries (IFailureMonitor surface) ---
@@ -60,6 +71,34 @@ class FailureMonitor:
     async def wait_for_recovery(self, addr: NetworkAddress) -> None:
         while self.get_state(addr).failed:
             await self._on_change(addr)
+
+    # --- degraded (gray failure) state ---
+
+    def set_degraded(self, addr: NetworkAddress, degraded: bool,
+                     latency_ms: float = 0.0) -> None:
+        """Record a disk-health report for ``addr``.  Transitions emit
+        a ``DiskDegraded`` trace event either way, so a chaos run's
+        degradation timeline reconstructs from the trace alone."""
+        was = addr in self._degraded
+        if degraded == was:
+            return
+        if degraded:
+            try:
+                now = asyncio.get_running_loop().time()
+            except RuntimeError:
+                now = 0.0
+            self._degraded[addr] = now
+        else:
+            self._degraded.pop(addr, None)
+        TraceEvent("DiskDegraded").detail("Address", str(addr)) \
+            .detail("Degraded", degraded) \
+            .detail("LatencyMs", round(latency_ms, 3)).log()
+
+    def is_degraded(self, addr: NetworkAddress) -> bool:
+        return addr in self._degraded
+
+    def degraded_addresses(self) -> list[NetworkAddress]:
+        return sorted(self._degraded)
 
     # --- lifecycle ---
 

@@ -48,8 +48,11 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
                     ("withdraw", False), ("leader_heartbeat", False),
                     ("open_database", False), ("read_leader", False),
                     ("move", False), ("get_forward", False)],
+    # disk_health appended LAST (ISSUE 12): token layout is base+index,
+    # so new methods must never reorder existing slots
     "worker": [("recruit", False), ("stop_role", False),
-               ("rejoin_storage", False), ("list_roles", False)],
+               ("rejoin_storage", False), ("list_roles", False),
+               ("disk_health", False)],
     "cluster_controller": [("register_worker", False),
                            ("get_cluster_state", False)],
     "log_router": [("peek", False), ("pop", True), ("metrics", False)],

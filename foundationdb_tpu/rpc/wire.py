@@ -212,6 +212,31 @@ def _dec(buf: memoryview, pos: int) -> tuple[Any, int]:
     raise ValueError(f"bad tag {tag} at {pos - 1}")
 
 
+_FRAME_HDR = _struct.Struct("<II")      # payload length, crc32(payload)
+
+
+def frame(payload: bytes) -> bytes:
+    """crc32-stamp one payload: [u32 len][u32 crc][payload] — the shared
+    torn-write detector for single-blob durable files (engine snapshots,
+    manifests, commit headers; ISSUE 12).  A torn or corrupted write
+    fails ``unframe`` instead of decoding into garbage."""
+    import zlib
+    return _FRAME_HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def unframe(raw: bytes) -> bytes:
+    """Inverse of ``frame``; raises ValueError on a short or corrupt
+    frame (callers map that to their torn-vs-corrupt policy)."""
+    import zlib
+    if len(raw) < _FRAME_HDR.size:
+        raise ValueError("short frame")
+    length, crc = _FRAME_HDR.unpack_from(raw)
+    payload = raw[_FRAME_HDR.size:_FRAME_HDR.size + length]
+    if len(payload) != length or zlib.crc32(payload) != crc:
+        raise ValueError("frame crc mismatch")
+    return payload
+
+
 def decode(data: bytes) -> Any:
     obj, pos = _dec(memoryview(data), 0)
     if pos != len(data):

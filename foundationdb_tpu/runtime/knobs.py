@@ -94,7 +94,13 @@ class Knobs:
     # ids 18/19): key selectors resolve to ONE key per shard reply
     # instead of row-probing ``offset`` rows through the range path; a
     # 715 peer cannot decode the struct ids, so the gate fences it
-    PROTOCOL_VERSION: int = 716
+    # 717: error codes 2903/2904 renumbered (ISSUE 12) — they were
+    # DOUBLE-registered (coordination's not_latest_generation/
+    # coordinators_unreachable vs the change-feed errors), so which
+    # class a wire error decoded to depended on import order; the
+    # coordination pair moved to 2910/2911.  Error codes cross the wire
+    # numerically, so a 716 peer would mistype them — the gate fences it
+    PROTOCOL_VERSION: int = 717
     # --- change feeds ---
     # (sealed feed segments at or below the durable floor ALWAYS spill
     # to the DiskQueue side file on durable servers — a durability
@@ -280,6 +286,29 @@ class Knobs:
     SIM_NETWORK_MAX_DELAY: float = 0.005
     SIM_CONNECT_DELAY: float = 0.01
     BUGGIFY_ENABLED: bool = False
+    # --- simulated disk faults (ISSUE 12, the AsyncFileNonDurable
+    # model): OFF by default so same-seed traces with faults off stay
+    # bit-identical — arming draws the profile's seed from the sim rng.
+    # DiskFaultWorkload arms per-machine profiles mid-run regardless of
+    # the master knob; SIM_DISK_FAULTS=True arms every machine at boot.
+    SIM_DISK_FAULTS: bool = False
+    SIM_DISK_IO_ERROR_P: float = 0.01     # per-op IoError probability
+    SIM_DISK_STALL_P: float = 0.02        # per-op random stall probability
+    SIM_DISK_STALL_MAX_S: float = 0.05    # random stall upper bound
+    SIM_DISK_TORN_P: float = 0.75         # per-kill torn-write probability
+    SIM_DISK_CORRUPT_P: float = 0.25      # per-surviving-sector corruption
+    SIM_DISK_SECTOR: int = 512            # tear granularity, bytes
+
+    # --- gray-failure detection (ISSUE 12): decayed per-op disk latency
+    # per machine; a sustained mean above the threshold marks the disk
+    # degraded — published via role metrics, polled into the
+    # FailureMonitor by the CC, deprioritized by recruitment and DD
+    # move-destination picking.  Detection is passive arithmetic (no
+    # RNG); the CC poll is its own RPC loop, gated by the interval knob
+    # (0 disables).
+    DISK_DEGRADED_LATENCY_MS: float = 25.0
+    DISK_HEALTH_HALFLIFE_S: float = 5.0
+    CC_DISK_HEALTH_INTERVAL: float = 1.0
 
     def override(self, **kv: Any) -> "Knobs":
         return dataclasses.replace(self, **kv)

@@ -28,7 +28,7 @@ from ..rpc.stubs import CoordinatorClient, serve_role
 from ..rpc.transport import (NetworkAddress, WLTOKEN_COORDINATOR,
                              WLTOKEN_FIRST_AVAILABLE)
 from ..runtime.errors import FdbError
-from ..runtime.files import SimFileSystem
+from ..runtime.files import DiskFaultProfile, SimFileSystem
 from ..runtime.knobs import Knobs
 from ..runtime.trace import TraceEvent
 
@@ -45,7 +45,19 @@ class SimMachine:
         self.index = index
         self.ip = f"10.1.0.{index + 1}"
         self.is_coordinator = coordinator
-        self.fs = SimFileSystem()
+        # hostile-disk model (ISSUE 12): every machine carries a
+        # DiskFaultProfile — disarmed by default (zero rng draws, so
+        # same-seed traces with faults off stay bit-identical).  Knob
+        # SIM_DISK_FAULTS arms it at boot from a per-machine split of
+        # the sim rng; DiskFaultWorkload arms it mid-run.
+        self.fault_profile = DiskFaultProfile()
+        self.fs = SimFileSystem(profile=self.fault_profile)
+        self.fs.health.configure(sim.knobs.DISK_HEALTH_HALFLIFE_S,
+                                 sim.knobs.DISK_DEGRADED_LATENCY_MS)
+        if sim.knobs.SIM_DISK_FAULTS:
+            from ..runtime.rng import deterministic_random
+            self.fault_profile.arm_from_knobs(
+                sim.knobs, deterministic_random().split())
         self.addr = NetworkAddress(self.ip, SERVER_PORT)
         self.host: ClusterHost | None = None
         self.coordinator: Coordinator | None = None
@@ -58,7 +70,28 @@ class SimMachine:
                             NetworkAddress(self.ip, next(self._ports)))
 
     async def start(self) -> None:
-        """Boot (or reboot) the machine's process."""
+        """Boot (or reboot) the machine's process.  With a fault profile
+        armed, boot-time disk reads can fail (injected IoError) — the
+        supervisor loop retries like a respawning fdbserver would,
+        bounded so real corruption (DiskCorrupt) still fails the boot
+        loudly after a few attempts."""
+        attempt = 0
+        while True:
+            try:
+                return await self._start_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — supervisor respawn
+                attempt += 1
+                from ..runtime.errors import DiskCorrupt
+                if isinstance(e, DiskCorrupt) or attempt >= 20:
+                    raise
+                TraceEvent("SimMachineBootError", severity=30) \
+                    .detail("IP", self.ip).detail("Attempt", attempt) \
+                    .detail("Error", repr(e)[:120]).log()
+                await asyncio.sleep(0.25)
+
+    async def _start_once(self) -> None:
         self.sim.net.reboot_ip(self.ip)
         transport = SimTransport(self.sim.net, self.addr)  # replaces listener
         # EVERY machine serves a coordination register (idle unless its

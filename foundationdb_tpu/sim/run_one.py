@@ -20,10 +20,24 @@ from ..workloads.workload import run_workloads_on
 from .cluster_sim import SimulatedCluster
 
 
-async def simulate(seed: int, kills: int, buggify: bool) -> dict:
+async def simulate(seed: int, kills: int, buggify: bool,
+                   faults: str | None = None) -> dict:
     knobs = Knobs().override(BUGGIFY_ENABLED=buggify, DD_ENABLED=True)
+    durable = False
+    if faults == "disk":
+        # hostile-disk profile (ISSUE 12): every machine's fault profile
+        # armed from boot AND durable storage so torn/corrupt kills bite
+        # every durable surface (engines, WALs, TLog queues, spill side
+        # files) — the seed farm's `--faults disk` profile.  The MVCC
+        # window stays at its default: tightening it (200k versions)
+        # trips a PRE-EXISTING ambiguous-commit resurrection under the
+        # durable chaos mix (seed 3 reproduces on the pre-fault tree
+        # with zero injection — ROADMAP item 6 follow-up (e)), which is
+        # a real bug this profile surfaced but not one this PR fixes.
+        knobs = knobs.override(SIM_DISK_FAULTS=True)
+        durable = True
     enable_buggify(buggify)
-    sim = SimulatedCluster(knobs, n_machines=7,
+    sim = SimulatedCluster(knobs, n_machines=7, durable_storage=durable,
                            spec=ClusterConfigSpec(min_workers=7,
                                                   replication=2))
     await sim.start()
@@ -66,6 +80,13 @@ async def simulate(seed: int, kills: int, buggify: bool) -> dict:
         {"testName": "Swizzle", "sim": sim, "rounds": 1,
          "secondsBefore": 6.0},
         {"testName": "RandomClogging", "sim": sim, "testDuration": 8.0},
+        # hostile disks ride the default chaos mix (ISSUE 12): live-op
+        # IO errors + stalls for the first stretch, kill-time torn/
+        # corrupt writes for every attrition/swizzle kill — so every
+        # future PR's durable code faces torn and corrupt disks by
+        # default (the coordinator state files in this mix; every
+        # engine/WAL/side-file too under --faults disk)
+        {"testName": "DiskFault", "sim": sim, "testDuration": 10.0},
         {"testName": "ConsistencyCheck"},
     ]
     results = await run_workloads_on(db, specs, client_count=2)
@@ -78,6 +99,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--kills", type=int, default=2)
     ap.add_argument("--no-buggify", action="store_true")
+    ap.add_argument("--faults", choices=("disk",),
+                    help="arm a fault profile: 'disk' = hostile disks "
+                    "from boot on a DURABLE cluster (torn/corrupt/"
+                    "erroring/slow; ISSUE 12)")
     ap.add_argument("--spec", help="run a TOML test spec (tests/specs/*) "
                     "instead of the built-in chaos mix")
     args = ap.parse_args(argv)
@@ -91,7 +116,8 @@ def main(argv=None) -> int:
                 seed=args.seed)
         else:
             results = run_simulation(
-                simulate(args.seed, args.kills, not args.no_buggify),
+                simulate(args.seed, args.kills, not args.no_buggify,
+                         faults=args.faults),
                 seed=args.seed)
     except BaseException as e:  # noqa: BLE001 — the signature IS the output
         print(json.dumps({"seed": args.seed, "ok": False,

@@ -39,7 +39,7 @@ from __future__ import annotations
 import bisect
 from typing import Iterator
 
-from ..rpc.wire import decode, encode
+from ..rpc.wire import decode, encode, frame, unframe
 from .kv_store import OP_CLEAR, OP_SET
 from .lsm import _BlockCache
 
@@ -86,7 +86,14 @@ class BTreeKVStore:
             if not blob:
                 continue
             try:
-                head = decode(blob)
+                # crc-framed since ISSUE 12 so a torn header write FAILS
+                # the checksum instead of possibly decoding into garbage
+                # (pre-frame headers decode raw for compatibility)
+                try:
+                    payload = unframe(blob)
+                except ValueError:
+                    payload = blob
+                head = decode(payload)
                 gen = int(head["gen"])
             except Exception:   # torn header: the other slot has the commit
                 continue
@@ -396,7 +403,7 @@ class BTreeKVStore:
                 "end": self._end, "count": self._count,
                 "live": self._live_size, "meta": self.meta}
         hf = self._heads[self._gen % 2]
-        blob = encode(head)
+        blob = frame(encode(head))
         await hf.write(0, blob)
         await hf.truncate(len(blob))
         await hf.sync()

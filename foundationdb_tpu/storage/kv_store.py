@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Iterator
 
 from ..core.data import MutationBatch
-from ..rpc.wire import decode, encode
+from ..rpc.wire import decode, encode, frame as _frame, unframe as _unframe
 from .disk_queue import DiskQueue
 from .key_index import PackedKeyIndex
 from .packed_ops import PackedOps
@@ -57,26 +57,51 @@ class MemoryKVStore:
         # "storage-1" never picks up "storage-10"'s snapshots
         snap_paths = [p for p in fs.listdir(prefix)
                       if p.startswith(prefix + ".snap.")]
+        loaded = None
         for path in sorted(snap_paths, reverse=True):
             f = fs.open(path)
             try:
                 blob = await f.read(0, f.size())
                 if not blob:
                     continue
-                snap = decode(blob)
+                try:
+                    payload = _unframe(blob)
+                except ValueError:
+                    payload = blob      # pre-frame snapshot: raw decode
+                snap = decode(payload)
                 kv._data = dict(snap["data"])
                 kv.meta = snap["meta"]
                 kv._snap_gen = snap["gen"]
+                loaded = path
                 break
             except Exception:
                 continue    # torn snapshot: fall back to an older one
             finally:
                 await f.close()
-        kv._index.add_many(sorted(kv._data))
         kv._wal_file = fs.open(prefix + ".wal")
         kv._wal, frames = await DiskQueue.open(kv._wal_file)
-        for frame, _end in frames:
-            rec = decode(frame)
+        recs = [decode(frame) for frame, _end in frames]
+        if snap_paths and loaded is None:
+            # snapshot files exist but NONE decodes.  A kill tearing the
+            # FIRST-ever snapshot write is a legitimate crash: the WAL
+            # was not yet popped against it, so its surviving frames
+            # carry generations BELOW the torn file's and rebuild the
+            # whole state.  But frames at or past the newest snapshot
+            # generation — or no frames at all — prove a snapshot once
+            # synced and was popped against: recovering over an empty
+            # map would silently resurrect a partial ancient state
+            # (ISSUE 12; the lsm _load_manifest discipline)
+            newest = max(int(p.rsplit(".", 1)[1]) for p in snap_paths)
+            gens = [r["gen"] for r in recs]
+            if not gens or min(gens) >= newest:
+                from ..runtime.errors import DiskCorrupt
+                raise DiskCorrupt(
+                    f"no readable snapshot among {len(snap_paths)} "
+                    f"on-disk snapshot files for {prefix} while the WAL "
+                    f"references one — committed engine state is "
+                    f"damaged, refusing to recover silently")
+        kv._index.add_many(sorted(kv._data))
+        for rec in recs:
             if rec["gen"] < kv._snap_gen:
                 continue    # already folded into the snapshot
             if "pk" in rec:
@@ -203,8 +228,11 @@ class MemoryKVStore:
         self._snap_gen += 1
         path = f"{self.prefix}.snap.{self._snap_gen:08d}"
         f = self.fs.open(path)
-        blob = encode({"gen": self._snap_gen, "data": self._data,
-                       "meta": self.meta})
+        # crc-framed so a torn write from a kill FAILS the frame check
+        # instead of decoding into garbage rows (the BackupContainer
+        # frame discipline; ISSUE 12)
+        blob = _frame(encode({"gen": self._snap_gen, "data": self._data,
+                              "meta": self.meta}))
         await f.write(0, blob)
         await f.truncate(len(blob))
         await f.sync()

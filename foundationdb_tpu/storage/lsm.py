@@ -50,7 +50,13 @@ class _Run:
         self._cache = cache
         size = self._f.size()
         foot = self._f.read_sync(size - 12, 12)
-        assert foot[8:] == _FOOTER, f"bad run footer in {path}"
+        if foot[8:] != _FOOTER:
+            # runs are named by a manifest written only AFTER the run
+            # file synced, so a bad footer is never a torn flush — it is
+            # corruption of committed data, raised loudly (ISSUE 12)
+            from ..runtime.errors import DiskCorrupt
+            raise DiskCorrupt(f"bad sorted-run footer in committed run "
+                              f"{path}")
         idx_off = int.from_bytes(foot[:8], "little")
         self.index = decode(self._f.read_sync(idx_off, size - 12 - idx_off))
         # index: list of [first_key, offset, length].  The sparse index
@@ -285,27 +291,80 @@ class LSMKVStore:
         self._wal_file = None
         self._gen = 0
         self._wal_gen = 0
+        self._man_seq = 0
 
     # --- lifecycle ---
 
     @classmethod
+    async def _load_manifest(cls, fs, prefix: str) -> tuple[dict | None, int]:
+        """Newest valid manifest among the two crc-framed slots (plus
+        the pre-ISSUE-12 single unframed file): (manifest, slots seen).
+        Manifests were rewritten in place before the dual-slot
+        discipline, so a kill tearing the write destroyed the previous
+        manifest with it — losing the committed run set to a legitimate
+        crash."""
+        from ..rpc.wire import unframe
+        best = None
+        found = 0
+        for suffix in (".MANIFEST.a", ".MANIFEST.b"):
+            f = fs.open(prefix + suffix)
+            blob = await f.read(0, f.size())
+            await f.close()
+            if not blob:
+                continue
+            found += 1
+            try:
+                man = decode(unframe(blob))
+            except Exception:  # noqa: BLE001 — torn slot: other one wins
+                continue
+            if best is None or man.get("seq", 0) > best.get("seq", 0):
+                best = man
+        if best is not None:
+            return best, found
+        legacy = fs.open(prefix + ".MANIFEST")
+        blob = await legacy.read(0, legacy.size())
+        await legacy.close()
+        if blob:
+            found += 1
+            try:
+                return decode(blob), found
+            except Exception:  # noqa: BLE001 — caller decides torn/corrupt
+                pass
+        return None, found
+
+    @classmethod
     async def open(cls, fs, prefix: str) -> "LSMKVStore":
         kv = cls(fs, prefix)
-        mf = fs.open(prefix + ".MANIFEST")
-        blob = await mf.read(0, mf.size())
-        await mf.close()
-        if blob:
-            man = decode(blob)
+        man, slots_seen = await cls._load_manifest(fs, prefix)
+        if man is not None:
             kv.meta = man["meta"]
             kv._gen = man["gen"]
             kv._wal_gen = man.get("wal_gen", 0)
+            kv._man_seq = man.get("seq", 0)
             for path in man["runs"]:
                 kv._runs.append(_Run(fs, str(path), kv._cache))
             kv._sparse.bump()
         kv._wal_file = fs.open(prefix + ".wal")
         kv._wal, frames = await DiskQueue.open(kv._wal_file)
-        for frame, _end in frames:
-            rec = decode(frame)
+        recs = [decode(frame) for frame, _end in frames]
+        if man is None and slots_seen:
+            # manifest slots exist but none decodes.  A kill tearing the
+            # FIRST-ever manifest write is legitimate (the WAL was not
+            # yet popped, so gen-0 frames rebuild everything); but WAL
+            # frames at gen > 0 — or committed runs with no WAL at all —
+            # prove a synced manifest once existed and was popped
+            # against: recovering without it would silently resurrect a
+            # partial ancient state (ISSUE 12)
+            gens = [r["gen"] for r in recs]
+            has_runs = bool(fs.listdir(prefix + ".run."))
+            if (gens and min(gens) > 0) or (has_runs and not gens):
+                from ..runtime.errors import DiskCorrupt
+                raise DiskCorrupt(
+                    f"no readable MANIFEST among {slots_seen} slots for "
+                    f"{prefix} while committed runs/WAL generations "
+                    f"reference one — the committed run set is damaged, "
+                    f"refusing silent recovery")
+        for rec in recs:
             if rec["gen"] < kv._wal_gen:
                 continue        # folded into a flushed run already
             kv._apply_mem(rec["ops"])
@@ -590,14 +649,23 @@ class LSMKVStore:
         return path
 
     async def _write_manifest(self) -> None:
-        mf = self.fs.open(self.prefix + ".MANIFEST")
-        blob = encode({"gen": self._gen, "wal_gen": self._wal_gen,
-                       "meta": self.meta,
-                       "runs": [r.path for r in self._runs]})
+        """Alternating crc-framed slots (ISSUE 12): the slot not being
+        written always holds the previous valid manifest, so a kill
+        tearing this write can never lose the committed run set."""
+        from ..rpc.wire import frame
+        # seq advances only after the sync: a failed (retried) write
+        # must re-target the SAME slot, never the freshest synced one
+        seq = self._man_seq + 1
+        slot = ".MANIFEST.a" if seq % 2 else ".MANIFEST.b"
+        mf = self.fs.open(self.prefix + slot)
+        blob = frame(encode({"seq": seq, "gen": self._gen,
+                             "wal_gen": self._wal_gen, "meta": self.meta,
+                             "runs": [r.path for r in self._runs]}))
         await mf.write(0, blob)
         await mf.truncate(len(blob))
         await mf.sync()
         await mf.close()
+        self._man_seq = seq
 
     async def _flush(self) -> None:
         def items():

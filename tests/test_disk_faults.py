@@ -1,0 +1,417 @@
+"""Disk-fault chaos end-to-end (ISSUE 12).
+
+The hostile-disk layer (runtime/files.py DiskFaultProfile — torn
+writes, kill-time corruption, IO errors, stalls), the LOUD-failure
+discipline of every durable consumer (DiskQueue committed-region crc),
+and the gray-failure response (degraded detection + DD/CC avoidance).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import pytest
+
+from foundationdb_tpu.runtime.errors import DiskCorrupt
+from foundationdb_tpu.runtime.files import DiskFaultProfile, SimFileSystem
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.rng import DeterministicRandom
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.storage.disk_queue import DiskQueue
+
+
+# --- unit: the tear model itself ---
+
+def test_torn_kill_never_touches_synced_bytes():
+    """Synced content survives every torn/corrupt kill byte-identical;
+    only sectors dirtied by unsynced ops may change."""
+    async def main():
+        prof = DiskFaultProfile()
+        prof.arm(DeterministicRandom(7), torn_p=1.0, corrupt_p=1.0,
+                 sector=64)
+        fs = SimFileSystem(profile=prof)
+        f = fs.open("t")
+        synced = bytes(range(256)) * 8      # 2KB synced baseline
+        await f.write(0, synced)
+        await f.sync()
+        # dirty a sector in the middle + append past the end
+        await f.write(512, b"\xAA" * 64)
+        await f.write(2048, b"\xBB" * 300)
+        fs.kill_unsynced()
+        after = bytes(fs.disks["t"])
+        assert prof.torn_kills == 1
+        # every byte outside the dirtied regions is untouched
+        assert after[:512] == synced[:512]
+        assert after[576:2048] == synced[576:2048]
+        # the dirty sector either dropped (old), persisted (new), or
+        # corrupted — never anything else
+        mid = after[512:576]
+        assert mid == synced[512:576] or mid == b"\xAA" * 64 \
+            or len(mid) == 64
+    run_simulation(main())
+
+
+def test_disarmed_profile_is_all_or_nothing_drop():
+    async def main():
+        fs = SimFileSystem()                # no profile: legacy semantics
+        f = fs.open("t")
+        await f.write(0, b"synced")
+        await f.sync()
+        await f.write(0, b"UNSYNC")
+        fs.kill_unsynced()
+        assert bytes(fs.disks["t"]) == b"synced"
+    run_simulation(main())
+
+
+def test_io_error_and_stall_injection():
+    async def main():
+        prof = DiskFaultProfile()
+        prof.arm(DeterministicRandom(3), io_error_p=0.5, stall_p=0.5,
+                 stall_max_s=0.01)
+        fs = SimFileSystem(profile=prof)
+        f = fs.open("t")
+        from foundationdb_tpu.runtime.errors import IoError
+        errors = 0
+        for i in range(64):
+            try:
+                await f.write(i, b"x")
+            except IoError:
+                errors += 1
+        assert errors > 0 and prof.io_errors == errors
+        assert prof.stalls > 0
+        # stalls feed the health tracker: decayed latency is non-zero
+        assert fs.health.latency_ms() > 0.0
+        # quiesce stops live injection but keeps kill semantics armed
+        prof.quiesce()
+        before = prof.io_errors
+        for i in range(32):
+            await f.write(i, b"y")
+        assert prof.io_errors == before
+        assert prof.armed      # kill-time semantics stay armed
+    run_simulation(main())
+
+
+# --- DiskQueue: torn tail vs corrupt committed region (the satellite
+#     recovery bugfix) ---
+
+def test_disk_queue_mid_file_corruption_raises_loudly():
+    """Bad crc BEFORE the durable frontier must raise DiskCorrupt, not
+    silently truncate committed frames (the pre-ISSUE-12 behavior
+    treated any bad crc as a torn tail)."""
+    async def main():
+        fs = SimFileSystem()
+        q, _ = await DiskQueue.open(fs.open("q"))
+        ends = []
+        for i in range(4):
+            ends.append(await q.push(b"payload-%d" % i * 20))
+            await q.commit()
+        await q.commit()        # records the durable frontier at the end
+        # corrupt one byte in the SECOND committed frame
+        disk = fs.disks["q"]
+        mid = (ends[0] + ends[1]) // 2
+        disk[mid] ^= 0xFF
+        with pytest.raises(DiskCorrupt):
+            await DiskQueue.open(fs.open("q"))
+    run_simulation(main())
+
+
+def test_disk_queue_torn_tail_still_discards_silently():
+    """Bad crc AT/PAST the frontier is a crash's torn tail — recovered
+    around exactly as before."""
+    async def main():
+        fs = SimFileSystem()
+        q, _ = await DiskQueue.open(fs.open("q"))
+        await q.push(b"one")
+        await q.commit()
+        await q.commit()                    # frontier covers frame one
+        await q.push(b"never-synced")       # torn by the kill
+        fs.kill_unsynced()
+        q2, frames = await DiskQueue.open(fs.open("q"))
+        assert [p for p, _ in frames] == [b"one"]
+        # ...and garbage appended past the frontier is discarded too
+        fs.disks["q"].extend(b"\x99" * 40)
+        _, frames2 = await DiskQueue.open(fs.open("q"))
+        assert [p for p, _ in frames2] == [b"one"]
+    run_simulation(main())
+
+
+def test_disk_queue_read_frames_raises_on_corrupt_live_frame():
+    async def main():
+        fs = SimFileSystem()
+        q, _ = await DiskQueue.open(fs.open("q"))
+        end1 = await q.push(b"a" * 100)
+        await q.push(b"b" * 100)
+        await q.commit()
+        fs.disks["q"][end1 + 20] ^= 0x55    # corrupt frame b in place
+        with pytest.raises(DiskCorrupt):
+            await q.read_frames(end1)
+    run_simulation(main())
+
+
+def test_disk_queue_survives_torn_header_write():
+    """A kill tearing the in-flight header write must fall back to the
+    other slot — never lose front/meta to a legitimate crash."""
+    async def main():
+        prof = DiskFaultProfile()
+        prof.arm(DeterministicRandom(11), torn_p=1.0, corrupt_p=1.0,
+                 sector=512)
+        fs = SimFileSystem(profile=prof)
+        q, _ = await DiskQueue.open(fs.open("q"))
+        await q.push(b"keep-me")
+        await q.commit(meta=42)
+        await q.commit()
+        # a new meta header staged but never synced; the kill may tear
+        # or corrupt exactly that slot — the synced slot must win
+        await q._write_header()
+        fs.kill_unsynced()
+        q2, frames = await DiskQueue.open(fs.open("q"))
+        assert [p for p, _ in frames] == [b"keep-me"]
+        assert q2.meta == 42
+    run_simulation(main())
+
+
+# --- engine recovery under a torn-disk kill ---
+
+@pytest.mark.parametrize("engine_name", ["memory", "lsm", "btree"])
+def test_engine_recovers_committed_state_through_torn_kill(engine_name):
+    """Every IKeyValueStore engine recovers its COMMITTED state
+    byte-identically through a kill whose unsynced writes tear and
+    corrupt (sector granularity)."""
+    from foundationdb_tpu.storage import engine_class
+    from foundationdb_tpu.storage.kv_store import OP_SET
+
+    async def main():
+        prof = DiskFaultProfile()
+        prof.arm(DeterministicRandom(29), torn_p=1.0, corrupt_p=0.5,
+                 sector=128)
+        fs = SimFileSystem(profile=prof)
+        cls = engine_class(engine_name)
+        kv = await cls.open(fs, "e/kv")
+        committed = {}
+        for batch in range(6):
+            ops = []
+            for i in range(40):
+                k = b"k%02d-%03d" % (batch, i)
+                v = (b"v%d" % batch) * 20
+                ops.append((OP_SET, k, v))
+                committed[k] = v
+            await kv.commit(ops, {"durable_version": batch + 1})
+        # stage unsynced garbage ops (never committed), then tear
+        import contextlib
+        with contextlib.suppress(Exception):
+            # best-effort: some engines do all their IO inside commit
+            f = fs.open("e/kv.wal")
+            await f.write(f.size(), b"\xEE" * 700)
+        fs.kill_unsynced()
+        kv2 = await cls.open(fs, "e/kv")
+        got = dict(kv2.range(b"", b"\xff\xff\xff\xff"))
+        assert got == committed, (
+            f"{engine_name}: {len(got)} rows recovered vs "
+            f"{len(committed)} committed")
+        assert kv2.meta["durable_version"] == 6
+        await kv2.close()
+    run_simulation(main())
+
+
+# --- acceptance: chaos sim with hostile disks on a durable cluster ---
+
+def _digest(rows) -> str:
+    h = hashlib.sha256()
+    for k, v in sorted(rows):
+        h.update(len(k).to_bytes(4, "little") + bytes(k))
+        h.update(len(v).to_bytes(4, "little") + bytes(v))
+    return h.hexdigest()
+
+
+def test_chaos_durable_cluster_with_hostile_disks():
+    """The ISSUE 12 acceptance: buggify + attrition kills + the full
+    disk-fault profile (torn writes, corruption, IO errors, stalls) on
+    a durable 5-machine cluster under live writes — zero acked-write
+    loss and the recovered keyspace sha256-byte-identical to the acked
+    oracle (ambiguous commit_unknown_result keys resolved against the
+    surviving state: old or new, never garbage)."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.runtime.buggify import enable_buggify
+    from foundationdb_tpu.runtime.errors import (CommitUnknownResult,
+                                                 FdbError)
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    knobs = Knobs().override(BUGGIFY_ENABLED=True,
+                             STORAGE_VERSION_WINDOW=200_000,
+                             STORAGE_DURABILITY_LAG=0.1)
+    enable_buggify(True)
+
+    async def main():
+        sim = SimulatedCluster(knobs, n_machines=5, durable_storage=True,
+                               spec=ClusterConfigSpec(min_workers=5,
+                                                      replication=2))
+        await sim.start()
+        await sim.wait_epoch(1)
+        db = await sim.database()
+        # arm every machine's hostile-disk profile
+        for i, m in enumerate(sim.machines):
+            m.fault_profile.arm(DeterministicRandom(1000 + i),
+                                io_error_p=0.01, stall_p=0.02,
+                                stall_max_s=0.02, torn_p=1.0,
+                                corrupt_p=0.3)
+
+        acked: dict[bytes, bytes] = {}
+        ambiguous: dict[bytes, tuple[bytes | None, bytes]] = {}
+
+        async def writer(wid: int, lo: int, hi: int) -> None:
+            for i in range(lo, hi):
+                key = b"chaos%05d" % i
+                val = b"w%d-" % wid + b"v" * 40
+                tr = db.create_transaction()
+                while True:
+                    try:
+                        tr.set(key, val)
+                        await tr.commit()
+                        acked[key] = val
+                        break
+                    except CommitUnknownResult:
+                        ambiguous[key] = (acked.get(key), val)
+                        break
+                    except BaseException as e:
+                        try:
+                            await tr.on_error(e)
+                        except FdbError:
+                            ambiguous[key] = (acked.get(key), val)
+                            break
+                # paced so the kills land UNDER live writes
+                await asyncio.sleep(0.15)
+
+        async def chaos() -> None:
+            # kill + reboot two non-coordinator machines mid-write: the
+            # kill tears their unsynced writes, the reboot re-adopts
+            # the surviving durable state.  No epoch-bump wait: a
+            # machine hosting only storage replicas dies without an
+            # epoch recovery (its team's survivor keeps serving), and
+            # its rejoin-on-reboot requests one itself.
+            for m in (sim.machines[3], sim.machines[4]):
+                await asyncio.sleep(2.0)
+                await m.kill()
+                await asyncio.sleep(1.5)
+                await m.reboot()
+                await asyncio.sleep(1.0)
+
+        await asyncio.gather(
+            writer(0, 0, 40), writer(1, 40, 80), chaos())
+        # wind down live injection; kills are over — the final read
+        # runs on quiet disks (the DiskFaultWorkload discipline)
+        injected = 0
+        for m in sim.machines:
+            s = m.fault_profile.stats()
+            injected += s["io_errors"] + s["stalls"] + s["torn_kills"]
+            m.fault_profile.quiesce()
+        assert injected > 0, \
+            "no fault ever fired — this chaos run proved nothing"
+
+        async def read_all():
+            tr = db.create_transaction()
+            while True:
+                try:
+                    return await tr.get_range(b"chaos", b"chaot",
+                                              snapshot=True)
+                except BaseException as e:
+                    await tr.on_error(e)
+
+        rows = await read_all()
+        got = {bytes(k): bytes(v) for k, v in rows}
+        # zero acked-write loss, byte-identical to the oracle: every
+        # acked key must hold exactly its acked value; an ambiguous key
+        # resolves to either side of its race but never to garbage
+        expected = dict(acked)
+        for key, (old, new) in ambiguous.items():
+            if key in expected:     # a later acked write overwrote it
+                continue
+            cur = got.get(key)
+            assert cur in (old, new), (
+                f"ambiguous key {key!r} holds {cur!r}, neither the "
+                f"prior value {old!r} nor the attempted {new!r}")
+            if cur is None:
+                continue
+            expected[key] = cur
+        assert _digest(got.items()) == _digest(expected.items()), (
+            f"recovered keyspace diverged from the acked oracle: "
+            f"{len(got)} rows vs {len(expected)} expected")
+        assert len(acked) >= 60, f"only {len(acked)} acked commits"
+        await sim.stop()
+
+    run_simulation(main(), seed=1212)
+
+
+# --- gray failure: a slow-but-alive disk is detected and avoided ---
+
+def test_gray_failure_detection_and_avoidance():
+    """One machine's disk stalled through the latency profile must be
+    (a) marked degraded in the CC's FailureMonitor via the disk-health
+    poll, (b) avoided by DD destination picking (dd_stats counts it),
+    and (c) surfaced in the cluster.degraded status rollup with its
+    latency."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.core.status import cluster_status
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    knobs = Knobs().override(DD_ENABLED=True, DD_INTERVAL=1.0,
+                             CC_DISK_HEALTH_INTERVAL=0.25,
+                             DISK_DEGRADED_LATENCY_MS=5.0,
+                             STORAGE_VERSION_WINDOW=50_000,
+                             STORAGE_DURABILITY_LAG=0.1)
+
+    async def main():
+        sim = SimulatedCluster(knobs, n_machines=6, durable_storage=True,
+                               spec=ClusterConfigSpec(min_workers=6,
+                                                      replication=2))
+        await sim.start()
+        state = await sim.wait_epoch(1)
+        db = await sim.database()
+        # stall a machine that hosts a storage replica (durable ticks
+        # guarantee a steady stream of disk ops to measure)
+        storage_ips = {s["worker"][0] for s in state["storage"]}
+        victim = next(m for m in sim.machines if m.ip in storage_ips)
+        victim.fault_profile.arm(DeterministicRandom(5),
+                                 stall_floor_s=0.02)
+
+        async def writers() -> None:
+            for i in range(60):
+                await db.set(b"gray%04d" % i, b"v" * 64)
+                await asyncio.sleep(0.05)
+
+        async def wait_degraded() -> None:
+            cc = sim.leader_cc()
+            deadline = asyncio.get_running_loop().time() + 60
+            while not cc.fm.is_degraded(victim.addr):
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "degraded disk never detected"
+                await asyncio.sleep(0.25)
+
+        await asyncio.gather(writers(), wait_degraded())
+        cc = sim.leader_cc()
+        assert victim.addr in cc.fm.degraded_addresses()
+        # recruitment ordering: the degraded machine sorts last
+        live = cc._live_workers()
+        ordered = cc.order_for_recruitment(live)
+        assert ordered[-1][0] == victim.addr
+        assert len(ordered) == len(live)
+        # DD destination picking skips it while healthy workers exist
+        dd = sim.leader_dd()
+        picks = {dd._pick_worker() for _ in range(12)}
+        assert victim.addr not in picks, picks
+        assert dd.degraded_avoided > 0
+        assert "degraded_avoided" in dd.stats()
+        # status rollup: the slowed disk shows up with latency + flag
+        ct = sim.client_transport()
+        doc = await cluster_status(sim.knobs, ct,
+                                   sim.coordinator_stubs(ct))
+        deg = doc["cluster"]["degraded"]
+        assert deg["count"] >= 1, deg
+        entry = next(e for e in deg["disks"] if e["ip"] == victim.ip)
+        assert entry["degraded"] and entry["latency_ms"] >= 5.0, entry
+        # healthy machines are NOT flagged
+        assert all(not e["degraded"] for e in deg["disks"]
+                   if e["ip"] != victim.ip), deg
+        await sim.stop()
+
+    run_simulation(main(), seed=77)

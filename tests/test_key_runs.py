@@ -350,11 +350,15 @@ def test_ring_spill_rollback_and_torn_frames():
         ring.extend_packed(13, _batch(13))
         got = [p1 for _op, p1, _p2 in await ring.peek_through(99)]
         assert got == [b"rk%06d" % v for v in range(1, 14)]
-        # now corrupt a LIVE frame: peek must raise, not short-serve
+        # now corrupt a LIVE frame: peek must raise, not short-serve —
+        # since ISSUE 12 the DiskQueue itself raises disk_corrupt from
+        # read_frames (loud committed-region discipline), upgrading the
+        # ring's old IOError-on-empty fallback
+        from foundationdb_tpu.runtime.errors import DiskCorrupt
         st, en, = ring._spilled[3][1], ring._spilled[3][2]
         for off in range(st + 8, min(st + 12, len(disk))):
             disk[off] ^= 0xFF
-        with pytest.raises(IOError):
+        with pytest.raises((IOError, DiskCorrupt)):
             await ring.peek_through(99)
 
     run_simulation(main())

@@ -104,6 +104,16 @@ def test_bigkeys_memory_wall_smoke():
     perf_smoke.check_bigkeys(budget_s=perf_smoke.BIG_BUDGET_S)
 
 
+def test_recover_torn_disk_smoke():
+    """The torn-disk recovery smoke (ISSUE 12): acked commits onto a
+    durable in-process cluster, a power loss with the hostile-disk
+    profile armed (unsynced writes tear at sector granularity, some
+    surviving sectors corrupt), then recovery over the damaged disk —
+    the user keyspace asserted sha256-byte-identical to the acked
+    pre-kill state, under the standing hard wedge deadline."""
+    perf_smoke.check_recover(budget_s=perf_smoke.RECOVER_BUDGET_S)
+
+
 def test_apply_metrics_surface():
     """The apply path must publish its observability counters — a silent
     regression is the other half of the r5 incident."""

@@ -64,17 +64,38 @@ def _child(path: str, mode: str = "default") -> None:
     # durability tick spills+reads back — the bit-identical acceptance
     # then covers the spill path itself (spill decisions are byte- and
     # version-driven, no RNG, so same-seed traces must still match)
+    # ISSUE 12: the disk-fault knobs are pinned at their defaults (OFF)
+    # explicitly — the standing bit-identical children must keep proving
+    # the fault-free path, and a future default flip arming injection
+    # (or changing the CC health-poll cadence) must not silently change
+    # what they prove.  The "faults" mode instead forces injection ON
+    # (stalls + IO errors on a durable cluster), asserting
+    # DiskFaultInjected events are present, the acked writes all
+    # survive, and the trace is STILL bit-identical — every fault draw
+    # comes from per-machine seeded streams, so hostile disks add
+    # chaos, never nondeterminism.
     knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
                              RESOLVER_DEVICE_PIPELINE=True,
                              DD_SHARD_HEAT_SPLITS=False,
                              CLIENT_READ_LOAD_BALANCE="score",
                              BACKUP_PROGRESS_PUBLISH=False,
                              CLIENT_PACKED_RANGE_READS=True,
-                             STORAGE_DBUF_SPILL_BYTES=128 << 20)
+                             STORAGE_DBUF_SPILL_BYTES=128 << 20,
+                             SIM_DISK_FAULTS=False,
+                             CC_DISK_HEALTH_INTERVAL=1.0,
+                             DISK_DEGRADED_LATENCY_MS=25.0)
     durable = False
     if mode == "spill":
         knobs = knobs.override(STORAGE_DBUF_SPILL_BYTES=1,
                                STORAGE_VERSION_WINDOW=1_000,
+                               STORAGE_DURABILITY_LAG=0.1)
+        durable = True
+    elif mode == "faults":
+        knobs = knobs.override(SIM_DISK_FAULTS=True,
+                               SIM_DISK_IO_ERROR_P=0.02,
+                               SIM_DISK_STALL_P=0.3,
+                               SIM_DISK_STALL_MAX_S=0.01,
+                               STORAGE_VERSION_WINDOW=100_000,
                                STORAGE_DURABILITY_LAG=0.1)
         durable = True
 
@@ -110,6 +131,7 @@ def _child(path: str, mode: str = "default") -> None:
     n = 0
     pipeline_events = 0
     spill_events = 0
+    fault_events = 0
     base = os.path.basename(path)
     d = os.path.dirname(path)
     rolled = sorted(
@@ -123,25 +145,28 @@ def _child(path: str, mode: str = "default") -> None:
         n += data.count(b"\n")
         pipeline_events += data.count(b"ResolverDevice.")
         spill_events += data.count(b"StorageDbufSpill")
-    print("%s %d %d %d" % (h.hexdigest(), n, pipeline_events, spill_events))
+        fault_events += data.count(b"DiskFaultInjected")
+    print("%s %d %d %d %d" % (h.hexdigest(), n, pipeline_events,
+                              spill_events, fault_events))
 
 
 def _run_child(tmp_path, tag: str,
-               mode: str = "default") -> tuple[str, int, int, int]:
+               mode: str = "default") -> tuple[str, int, int, int, int]:
     path = os.path.join(str(tmp_path), f"trace-{tag}.jsonl")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     p = subprocess.run([sys.executable, _THIS, "--child", path, mode],
                        cwd=_REPO, env=env, capture_output=True, text=True,
                        timeout=300)
     assert p.returncode == 0, f"child {tag} failed: {p.stderr[-2000:]}"
-    digest, n_events, n_pipeline, n_spill = \
+    digest, n_events, n_pipeline, n_spill, n_fault = \
         p.stdout.strip().splitlines()[-1].split()
-    return digest, int(n_events), int(n_pipeline), int(n_spill)
+    return digest, int(n_events), int(n_pipeline), int(n_spill), \
+        int(n_fault)
 
 
 def test_same_seed_sim_trace_bit_identical_with_pipeline(tmp_path):
-    d1, n1, p1, _s1 = _run_child(tmp_path, "a")
-    d2, n2, p2, _s2 = _run_child(tmp_path, "b")
+    d1, n1, p1, _s1, _f1 = _run_child(tmp_path, "a")
+    d2, n2, p2, _s2, _f2 = _run_child(tmp_path, "b")
     assert n1 > 100, f"trace suspiciously small ({n1} events)"
     assert p1 > 0, (
         "no ResolverDevice span events in the trace — the device "
@@ -159,8 +184,8 @@ def test_same_seed_sim_trace_bit_identical_with_spill_forced_on(tmp_path):
     segments to the side file and reads them back through the commit
     slice) must still produce a BIT-IDENTICAL trace — the spill path
     adds disk hops, never nondeterminism."""
-    d1, n1, _p1, s1 = _run_child(tmp_path, "sa", mode="spill")
-    d2, n2, _p2, s2 = _run_child(tmp_path, "sb", mode="spill")
+    d1, n1, _p1, s1, _f1 = _run_child(tmp_path, "sa", mode="spill")
+    d2, n2, _p2, s2, _f2 = _run_child(tmp_path, "sb", mode="spill")
     assert n1 > 100, f"trace suspiciously small ({n1} events)"
     assert s1 > 0, (
         "no StorageDbufSpill events in the trace — the forced-on spill "
@@ -169,6 +194,26 @@ def test_same_seed_sim_trace_bit_identical_with_spill_forced_on(tmp_path):
         f"same-seed sim trace diverged with the ring spill forced ON: "
         f"run a = {d1} ({n1} events, {s1} spills), run b = {d2} "
         f"({n2} events, {s2} spills)")
+
+
+def test_same_seed_sim_trace_bit_identical_with_disk_faults_on(tmp_path):
+    """ISSUE 12 acceptance: a durable same-seed sim with the hostile-
+    disk profile forced ON (per-op stalls + IO errors from boot) must
+    STILL produce a bit-identical trace — fault draws come from
+    per-machine seeded streams, so injection adds chaos, never
+    nondeterminism — with DiskFaultInjected events present and all
+    acked writes surviving (the child asserts its scan sees every row,
+    so a passing run IS zero acked-write loss)."""
+    d1, n1, _p1, _s1, f1 = _run_child(tmp_path, "fa", mode="faults")
+    d2, n2, _p2, _s2, f2 = _run_child(tmp_path, "fb", mode="faults")
+    assert n1 > 100, f"trace suspiciously small ({n1} events)"
+    assert f1 > 0, (
+        "no DiskFaultInjected events in the trace — the forced-on "
+        "fault profile did not run, so this test proved nothing")
+    assert (d1, n1, f1) == (d2, n2, f2), (
+        f"same-seed sim trace diverged with disk faults forced ON: "
+        f"run a = {d1} ({n1} events, {f1} faults), run b = {d2} "
+        f"({n2} events, {f2} faults)")
 
 
 if __name__ == "__main__":

@@ -167,29 +167,44 @@ def test_sim_trace_reconstructs_cross_role_timeline(tmp_path):
     prev_log = get_trace_log()
     set_trace_log(log)
     span_mod.reset_totals()
-    knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0)
+    # DURABLE since ISSUE 12: the cluster.degraded rollup needs
+    # disk-bearing roles (engines + durable TLogs publish disk health),
+    # and the small MVCC window keeps durability ticks flowing so the
+    # deliberately slowed disk accumulates measurable latency
+    knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
+                             STORAGE_VERSION_WINDOW=100_000,
+                             STORAGE_DURABILITY_LAG=0.1,
+                             DISK_DEGRADED_LATENCY_MS=5.0)
 
     async def main():
-        sim = SimulatedCluster(knobs, n_machines=5,
+        from foundationdb_tpu.runtime.rng import DeterministicRandom
+        sim = SimulatedCluster(knobs, n_machines=5, durable_storage=True,
                                spec=ClusterConfigSpec(min_workers=5,
                                                       replication=2))
         await sim.start()
-        await sim.wait_epoch(1)
+        state = await sim.wait_epoch(1)
         db = await sim.database()
+        # deliberately slow ONE storage machine's disk (the gray
+        # failure): every op stalls 20ms, far past the 5ms threshold
+        storage_ips = {s["worker"][0] for s in state["storage"]}
+        slow = next(m for m in sim.machines if m.ip in storage_ips)
+        slow.fault_profile.arm(DeterministicRandom(9),
+                               stall_floor_s=0.02)
         for i in range(4):
             async def body(tr, i=i):
                 await tr.get(b"trace-k%d" % i)     # storage read span
                 tr.set(b"trace-k%d" % i, b"v%d" % i)
             await db.run(body)
         # let the storage pull loops apply the commits (the async half
-        # the analyzer joins by version range)
+        # the analyzer joins by version range) and the durability ticks
+        # hit the slowed disk
         await asyncio.sleep(1.5)
         ct = sim.client_transport()
         doc = await cluster_status(sim.knobs, ct, sim.coordinator_stubs(ct))
         await sim.stop()
-        return doc
+        return doc, slow.ip
 
-    doc = run_simulation(main(), seed=1234)
+    doc, slow_ip = run_simulation(main(), seed=1234)
     set_trace_log(prev_log)
     log.close()
 
@@ -235,6 +250,16 @@ def test_sim_trace_reconstructs_cross_role_timeline(tmp_path):
     hm = doc["cluster"]["hot_moves"]
     assert hm == {"splits": 0, "live_moves": 0, "heat_splits": 0,
                   "heat_moves": 0, "last_heat_rw_per_sec": 0.0}
+    # cluster.degraded rollup (ISSUE 12): the deliberately slowed disk
+    # shows up with its latency and degraded flag; healthy machines do
+    # not — the gray failure is observable from `status` alone
+    deg = doc["cluster"]["degraded"]
+    assert deg["count"] >= 1, deg
+    slow_entry = next(e for e in deg["disks"] if e["ip"] == slow_ip)
+    assert slow_entry["degraded"], deg
+    assert slow_entry["latency_ms"] >= 5.0, slow_entry
+    assert all(not e["degraded"] for e in deg["disks"]
+               if e["ip"] != slow_ip), deg
 
 
 # --- backup + fetchKeys span threading (ISSUE 8 satellite; PR 2 (c)) ---

@@ -1,0 +1,115 @@
+"""Client graceful degradation (ISSUE 12 satellite): the C API's
+timeout / retry_limit / max_retry_delay TransactionOptions trio,
+enforced in the on_error retry loop and on the blocking surfaces — a
+degraded cluster surfaces BOUNDED errors instead of unbounded hangs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.client import Database
+from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+from foundationdb_tpu.runtime.errors import (NotCommitted,
+                                             TransactionTimedOut)
+from foundationdb_tpu.runtime.simloop import run_simulation
+
+
+def test_retry_limit_bounds_on_error():
+    """retry_limit=N allows exactly N on_error retries, then re-raises
+    the ORIGINAL error; -1 (the default) stays unbounded."""
+    async def main():
+        cluster = Cluster(ClusterConfig())
+        tr = cluster and Database(cluster).create_transaction()
+        tr.set_retry_limit(2)
+        await tr.on_error(NotCommitted())       # retry 1
+        await tr.on_error(NotCommitted())       # retry 2
+        with pytest.raises(NotCommitted):
+            await tr.on_error(NotCommitted())   # limit exceeded
+        # a fresh transaction with limit 0 never retries
+        tr2 = Database(cluster).create_transaction()
+        tr2.set_retry_limit(0)
+        with pytest.raises(NotCommitted):
+            await tr2.on_error(NotCommitted())
+    run_simulation(main())
+
+
+def test_max_retry_delay_caps_backoff():
+    """Backoff grows exponentially but never past max_retry_delay —
+    measured on the virtual clock, where sleeps are exact."""
+    async def main():
+        cluster = Cluster(ClusterConfig())
+        tr = Database(cluster).create_transaction()
+        tr.set_max_retry_delay(0.05)
+        loop = asyncio.get_running_loop()
+        # drive the retry count high enough that uncapped backoff would
+        # be ~1s per retry; every individual delay must stay <= the cap
+        for _ in range(12):
+            t0 = loop.time()
+            await tr.on_error(NotCommitted())
+            assert loop.time() - t0 <= 0.05 + 1e-9
+    run_simulation(main())
+
+
+def test_timeout_bounds_the_retry_loop():
+    """A transaction past its deadline refuses to retry: on_error raises
+    transaction_timed_out instead of sleeping again — the bounded-error
+    contract a degraded cluster depends on."""
+    async def main():
+        cluster = Cluster(ClusterConfig())
+        tr = Database(cluster).create_transaction()
+        tr.set_timeout(0.5)
+        with pytest.raises(TransactionTimedOut):
+            # retryable errors loop until the virtual clock crosses the
+            # deadline, then the loop MUST terminate
+            for _ in range(10_000):
+                await tr.on_error(NotCommitted())
+    run_simulation(main())
+
+
+def test_timeout_bounds_blocking_reads():
+    """An armed deadline bounds the blocking surfaces themselves: a
+    read issued after the deadline fails immediately with
+    transaction_timed_out rather than dialing the cluster."""
+    async def main():
+        cluster = Cluster(ClusterConfig())
+        cluster.start()
+        try:
+            db = Database(cluster)
+            tr = db.create_transaction()
+            tr.set_timeout(0.2)
+            # within the deadline: works normally
+            assert await tr.get(b"opt-k") is None
+            await asyncio.sleep(0.3)            # virtual: crosses it
+            with pytest.raises(TransactionTimedOut):
+                await tr.get(b"opt-k2")
+            # commit past the deadline is refused the same way
+            tr2 = db.create_transaction()
+            tr2.set_timeout(0.1)
+            tr2.set(b"opt-k3", b"v")
+            await asyncio.sleep(0.2)
+            with pytest.raises(TransactionTimedOut):
+                await tr2.commit()
+            # options persist across reset (upstream: the retry loop
+            # holds TransactionOptions across resets)
+            tr2.reset()
+            assert tr2.timeout == 0.1
+        finally:
+            await cluster.stop()
+    run_simulation(main())
+
+
+def test_timeout_zero_means_unbounded():
+    async def main():
+        cluster = Cluster(ClusterConfig())
+        cluster.start()
+        try:
+            tr = Database(cluster).create_transaction()
+            assert tr.timeout == 0.0            # knob default: disabled
+            await asyncio.sleep(1.0)
+            assert await tr.get(b"nope") is None    # no deadline armed
+        finally:
+            await cluster.stop()
+    run_simulation(main())

@@ -88,7 +88,17 @@ regression that reintroduced per-object key storage — or made the
 columnar merge quadratic — fails here at tier-1 cost, not at a
 10M-key production keyspace.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|all]
+Stage 10 (``recover``): the torn-disk recovery round trip (ISSUE 12) —
+rows loaded through real acked commits onto a durable in-process
+cluster, a power loss with the hostile-disk profile armed (unsynced
+writes tear at sector granularity, surviving sectors corrupt), then
+recovery over the damaged disk with the user keyspace asserted
+sha256-byte-identical to the acked pre-kill state.  A recovery that
+silently drops or resurrects an acked write — or a consumer that
+mistakes a torn tail for committed data — fails here at tier-1 cost,
+under the standing hard wedge deadline.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -128,6 +138,9 @@ HEAT_RANK_MARGIN = 3.0      # hot shard rw rate vs the next-hottest
 BACKUP_TXNS = 150           # commits per phase (pre-snapshot / post)
 BACKUP_CLIENTS = 8
 BACKUP_BUDGET_S = 90.0      # measured ~5s on a loaded 2-cpu host
+RECOVER_TXNS = 150          # acked commits before the torn-disk kill
+RECOVER_CLIENTS = 8
+RECOVER_BUDGET_S = 90.0     # doubles as the hard wedge deadline
 SCAN_ROWS = 24_000          # rows loaded through real commits
 SCAN_CHUNK = 512            # per-fetch row limit, pinned via the byte budget
 SCAN_SWEEPS = 3             # full-table sweeps per side of the A/B
@@ -1122,6 +1135,132 @@ def check_backup(budget_s: float = BACKUP_BUDGET_S,
     return elapsed
 
 
+def recover_path_seconds(n_txns: int = RECOVER_TXNS,
+                         n_clients: int = RECOVER_CLIENTS,
+                         deadline_s: float | None = None
+                         ) -> tuple[float, dict]:
+    """Wall seconds for the torn-disk recovery round trip (ISSUE 12):
+    rows loaded through real acked commits onto a DURABLE in-process
+    cluster, then a POWER LOSS with the hostile-disk profile armed —
+    every file's unsynced writes tear at sector granularity with bit
+    corruption of the surviving sectors — then a fresh Cluster.create
+    over the damaged disk, with the recovered user keyspace asserted
+    sha256-byte-identical to the pre-kill acked state IN SITU.  A
+    recovery that silently drops or resurrects an acked write fails the
+    digest, a wedged one hits the deadline."""
+    from foundationdb_tpu.backup.container import keyspace_digest as digest
+    from foundationdb_tpu.client.transaction import Transaction
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+    from foundationdb_tpu.core.data import SYSTEM_PREFIX
+    from foundationdb_tpu.runtime.errors import FdbError
+    from foundationdb_tpu.runtime.files import (DiskFaultProfile,
+                                                SimFileSystem)
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.runtime.rng import DeterministicRandom
+
+    # small window + fast ticks: the engines absorb real durability
+    # traffic (WAL frames, headers, side files) before the kill, so the
+    # tear has committed surfaces to chew on
+    knobs = Knobs().override(STORAGE_VERSION_WINDOW=100_000,
+                             STORAGE_DURABILITY_LAG=0.05)
+    cfg = ClusterConfig(storage_servers=2, logs=2)
+
+    async def read_all(cluster):
+        tr = Transaction(cluster)
+        while True:
+            try:
+                return await tr.get_range(b"", SYSTEM_PREFIX, limit=0,
+                                          snapshot=True)
+            except FdbError as e:
+                await tr.on_error(e)
+
+    async def main() -> tuple[float, dict]:
+        t_all = time.perf_counter()
+        fs = SimFileSystem()
+        src = await Cluster.create(cfg, knobs, fs=fs, data_dir="rec")
+        src.start()
+        issued = iter(range(n_txns))
+
+        async def client(cid: int) -> None:
+            tr = Transaction(src)
+            for i in issued:
+                while True:
+                    try:
+                        tr.set(b"rc%06d" % i, b"v" * 64)
+                        if i % 17 == 0 and i > 0:
+                            tr.clear(b"rc%06d" % (i - 7))
+                        await tr.commit()
+                        tr.reset()
+                        break
+                    except FdbError as e:
+                        await tr.on_error(e)
+
+        await asyncio.gather(*(client(c) for c in range(n_clients)))
+        # one durability tick lands part of the window in the engines
+        # (the rest stays TLog-only — recovery must replay BOTH shapes)
+        await asyncio.sleep(0.2)
+        expected = await read_all(src)
+        await src.stop()
+        # power loss with hostile-disk kill semantics: every dirty
+        # sector independently persists, drops, or turns to garbage
+        prof = DiskFaultProfile()
+        prof.arm(DeterministicRandom(0xD15C), torn_p=1.0, corrupt_p=0.3)
+        fs.profile = prof
+        fs.kill_unsynced()
+        t0 = time.perf_counter()
+        dst = await Cluster.create(cfg, knobs, fs=fs, data_dir="rec")
+        dst.start()
+        got = await read_all(dst)       # retries until replay catches up
+        t_recover = time.perf_counter() - t0
+        await dst.stop()
+        assert digest(got) == digest(expected), (
+            f"post-recovery keyspace diverged from the acked pre-kill "
+            f"state: {len(got)} recovered rows vs {len(expected)} "
+            f"expected — a torn/corrupt unsynced region leaked into "
+            f"committed data, not slowness")
+        stats = {
+            "rows": len(expected),
+            "torn_files": prof.torn_kills,
+            "dropped_sectors": prof.dropped_sectors,
+            "corrupt_sectors": prof.corrupt_sectors,
+            "recover_s": t_recover,
+            "verified": True,
+        }
+        return time.perf_counter() - t_all, stats
+
+    async def bounded():
+        return await asyncio.wait_for(main(), deadline_s)
+
+    try:
+        return asyncio.run(bounded())
+    except asyncio.TimeoutError:
+        raise AssertionError(
+            f"recover smoke wedged: the {deadline_s:.0f}s deadline hit — "
+            f"recovery against a torn disk stopped making progress, not "
+            f"just slowness") from None
+
+
+def check_recover(budget_s: float = RECOVER_BUDGET_S,
+                  quiet: bool = False) -> float:
+    """Run the torn-disk recovery smoke; raises AssertionError on a
+    byte-identity failure, past the budget, or at the wedge deadline."""
+    elapsed, stats = recover_path_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] recover: {stats['rows']} rows survived a "
+              f"torn-disk kill ({stats['torn_files']} files torn, "
+              f"{stats['dropped_sectors']} sectors dropped, "
+              f"{stats['corrupt_sectors']} corrupted) — recovery "
+              f"{stats['recover_s']:.2f}s, verified={stats['verified']}")
+    assert stats["verified"]
+    assert stats["torn_files"] > 0, (
+        "the kill tore no file — the hostile-disk profile did not run, "
+        "so this stage proved nothing")
+    assert elapsed < budget_s, (
+        f"recover smoke took {elapsed:.1f}s (budget {budget_s:.0f}s) — "
+        f"recovery replay or the read catch-up grew a quadratic shape")
+    return elapsed
+
+
 def scan_path_seconds(n_rows: int = SCAN_ROWS, chunk: int = SCAN_CHUNK,
                       sweeps: int = SCAN_SWEEPS,
                       deadline_s: float | None = None
@@ -1577,7 +1716,7 @@ def main() -> int:
     ap.add_argument("--stage",
                     choices=("apply", "pipeline", "feed", "read",
                              "resolve", "heat", "backup", "scan",
-                             "bigkeys", "all"),
+                             "bigkeys", "recover", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -1590,6 +1729,8 @@ def main() -> int:
     ap.add_argument("--scan-budget", type=float, default=SCAN_BUDGET_S)
     ap.add_argument("--big-keys", type=int, default=BIG_KEYS)
     ap.add_argument("--big-budget", type=float, default=BIG_BUDGET_S)
+    ap.add_argument("--recover-budget", type=float,
+                    default=RECOVER_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -1609,6 +1750,8 @@ def main() -> int:
         check_scan(budget_s=args.scan_budget)
     if args.stage in ("bigkeys", "all"):
         check_bigkeys(args.big_keys, budget_s=args.big_budget)
+    if args.stage in ("recover", "all"):
+        check_recover(budget_s=args.recover_budget)
     return 0
 
 

@@ -24,7 +24,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_seed(seed: int, timeout: float, spec: str | None = None) -> dict:
+def run_seed(seed: int, timeout: float, spec: str | None = None,
+             faults: str | None = None) -> dict:
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     t0 = time.time()
     cmd = [sys.executable, "-m", "foundationdb_tpu.sim.run_one",
@@ -33,6 +34,8 @@ def run_seed(seed: int, timeout: float, spec: str | None = None) -> dict:
         # children run with cwd=REPO; a caller-relative path must not
         # silently resolve against the wrong directory
         cmd += ["--spec", os.path.abspath(spec)]
+    if faults:
+        cmd += ["--faults", faults]
     try:
         p = subprocess.run(
             cmd, cwd=REPO, env=env, capture_output=True, text=True,
@@ -58,13 +61,18 @@ def main() -> int:
     ap.add_argument("--timeout", type=float, default=180.0)
     ap.add_argument("--spec", help="run a TOML spec (tests/specs/*) at "
                     "every seed instead of the default chaos mix")
+    ap.add_argument("--faults", choices=("disk",),
+                    help="fault profile forwarded to every child: "
+                    "'disk' = hostile disks from boot on a durable "
+                    "cluster (ISSUE 12)")
     args = ap.parse_args()
 
     buckets: dict[str, list[int]] = collections.defaultdict(list)
     ok = 0
     t0 = time.time()
     with concurrent.futures.ThreadPoolExecutor(args.jobs) as ex:
-        futs = {ex.submit(run_seed, s, args.timeout, args.spec): s
+        futs = {ex.submit(run_seed, s, args.timeout, args.spec,
+                          args.faults): s
                 for s in range(args.start, args.start + args.seeds)}
         for fut in concurrent.futures.as_completed(futs):
             r = fut.result()
