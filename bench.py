@@ -1047,6 +1047,15 @@ def run_bigkeys_phase(quiet: bool) -> dict:
                 (round(idx["base_bytes"] / n_rows, 2)
                  if idx.get("base_bytes") else None),
             "bigkeys_index_merges": idx["merges"],
+            # whole-window resident bytes per key (ISSUE 13): the
+            # columnar MVCC window's full columnar footprint — key
+            # blob + bounds + version/value columns + prefix caches —
+            # for the hot set held in the window (None under the
+            # legacy dict-of-chains twin, which has no columns to sum)
+            "bigkeys_mvcc_bytes_per_key":
+                (round(idx["resident_bytes"] / n_rows, 2)
+                 if idx.get("resident_bytes") else None),
+            "bigkeys_mvcc_segments": idx.get("segments"),
         }
 
     r = asyncio.run(main())

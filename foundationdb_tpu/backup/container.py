@@ -96,7 +96,7 @@ class BackupContainer:
     def __init__(self, fs, directory: str) -> None:
         self.fs = fs
         self.dir = directory.rstrip("/")
-        self._log_seq: int | None = None    # lazily loaded slot sequence
+        self._log_sb = None     # lazily-armed SlottedBlob (resume token)
 
     def _path(self, name: str) -> str:
         return f"{self.dir}/{name}"
@@ -208,36 +208,44 @@ class BackupContainer:
         return [(v, MutationBatch(bytes(t), bytes(bo), bytes(bl)))
                 for v, t, bo, bl in rec["e"]]
 
+    def _log_slots(self):
+        """The resume token's dual-slot persist — the shared
+        rpc/wire.py ``SlottedBlob`` helper (ISSUE 13, ROADMAP 6 (f)),
+        built lazily so a read-only container never arms a writer."""
+        from ..rpc.wire import SlottedBlob
+        if self._log_sb is None:
+            self._log_sb = SlottedBlob(self.fs, self._path("logs.manifest"))
+        return self._log_sb
+
     async def save_log_manifest(self, meta: dict) -> None:
-        """THE resume token write.  Alternating crc-framed slots
-        (ISSUE 12): the manifest used to be rewritten in place, so an
-        agent killed mid-write tore the ONLY copy and the container
-        became unresumable after a legitimate crash.  The slot not being
-        written always holds the previous valid manifest."""
-        if self._log_seq is None:
-            prev = await self._load_log_manifest_any()
-            self._log_seq = prev.get("seq", 0) if prev else 0
-        # seq advances only after the write+sync: a failed (retried)
-        # save must re-target the SAME slot, never the freshest one
-        seq = self._log_seq + 1
-        meta = dict(meta)
-        meta["seq"] = seq
-        slot = "logs.manifest.a" if seq % 2 else "logs.manifest.b"
-        await self._write_file(slot, encode(meta))
-        self._log_seq = seq
+        """THE resume token write.  Alternating crc-framed slots: the
+        manifest used to be rewritten in place, so an agent killed
+        mid-write tore the ONLY copy and the container became
+        unresumable after a legitimate crash.  The slot-turn / seq
+        discipline is the shared SlottedBlob's."""
+        sb = self._log_slots()
+        if sb._seq is None:
+            # arm the alternation from whatever format is on disk
+            # (load always leaves _seq armed, legacy slots included —
+            # _load_log_manifest_any seeds it from their embedded seq)
+            await self._load_log_manifest_any()
+        await sb.save(encode(dict(meta)))
 
     async def _load_log_manifest_any(self) -> dict | None:
-        """Newest valid slot (or the legacy single file); raises
+        """Newest valid slot (or a pre-helper format); raises
         ContainerError when slots exist but NONE decodes — a completed
         save always leaves the older slot intact through any kill, so
         that state is corruption of the committed resume token, and
         guessing a frontier would break exactly-once."""
+        sb = self._log_slots()
+        payload, found = await sb.load()
+        if payload is not None:
+            return decode(payload)
         best = None
-        found = 0
         for name in ("logs.manifest.a", "logs.manifest.b"):
+            # pre-helper slot format: crc-framed dict with embedded seq
             if self.fs.open(self._path(name)).size() == 0:
                 continue
-            found += 1
             try:
                 meta = decode(await self._read_file(name))
             except Exception:  # noqa: BLE001 — torn slot: other one wins
@@ -245,6 +253,9 @@ class BackupContainer:
             if best is None or meta.get("seq", 0) > best.get("seq", 0):
                 best = meta
         if best is not None:
+            # keep the alternation continuous across the envelope
+            # migration (never clobber the only valid slot)
+            sb.seed(best.get("seq", 0))
             return best
         if self.fs.open(self._path("logs.manifest")).size() > 0:
             found += 1
@@ -260,10 +271,7 @@ class BackupContainer:
         return None
 
     async def load_log_manifest(self) -> dict | None:
-        meta = await self._load_log_manifest_any()
-        if meta is not None and self._log_seq is None:
-            self._log_seq = meta.get("seq", 0)
-        return meta
+        return await self._load_log_manifest_any()
 
     # --- expiration / GC (ISSUE 9; the expireData discipline of
     # REF:fdbclient/BackupContainer.actor.cpp) ---

@@ -73,10 +73,17 @@ class RecoveredClusterView:
         self.grv_proxies = [
             GrvProxyClient(t, addr(p["addr"]), p["token"])
             for p in state["grv_proxies"]]
-        self.storage_clients = [
-            StorageClient(t, addr(s["addr"]), s["token"], s["tag"],
-                          KeyRange(s["begin"], s["end"]))
-            for s in state["storage"]]
+        # degraded machines (the CC's disk-health poll republishes the
+        # set on change, ISSUE 13): stamp each storage stub so
+        # ReplicaGroup ranks its replicas last for reads — gray-failure
+        # avoidance for the READ path, not just recruitment/DD
+        degraded = {tuple(a) for a in state.get("degraded", [])}
+        self.storage_clients = []
+        for s in state["storage"]:
+            sc = StorageClient(t, addr(s["addr"]), s["token"], s["tag"],
+                               KeyRange(s["begin"], s["end"]))
+            sc.degraded = tuple(s.get("worker", ())) in degraded
+            self.storage_clients.append(sc)
         self.shard_map = ShardMap(state["shard_boundaries"],
                                   state["shard_teams"])
         by_tag = {sc.tag: sc for sc in self.storage_clients}

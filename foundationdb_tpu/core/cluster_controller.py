@@ -973,12 +973,27 @@ class ClusterController:
         FailureMonitor's degraded set (ISSUE 12 gray-failure
         detection).  Per-worker failures are skipped — a machine whose
         health RPC fails is the BINARY monitor's problem; this loop
-        only tracks the slow-but-alive case."""
+        only tracks the slow-but-alive case.
+
+        UN-degrading dwells (ISSUE 13, ROADMAP 6 (b); the
+        ``_watch_region_preference`` hysteresis shape): the flag clears
+        only after ``CC_DISK_UNDEGRADE_DWELL_S`` of consecutively
+        healthy reports — a disk whose decayed latency oscillates
+        around the threshold would otherwise thrash recruitment
+        ordering and DD destination picking on every poll.  Degrading
+        stays immediate (reacting late to a sick disk costs p99).
+
+        When the degraded SET changes, the cluster state republishes
+        with a ``degraded`` worker-address list (a seq bump, the live
+        shard-move discipline) so CLIENTS can rank degraded replicas
+        last for reads too (ROADMAP 6 (a))."""
         interval = self.knobs.CC_DISK_HEALTH_INTERVAL
         if interval <= 0:
             await asyncio.Event().wait()    # disabled; park forever
+        healthy_since: dict = {}        # addr -> loop time of 1st healthy
         while True:
             await asyncio.sleep(interval)
+            now = asyncio.get_running_loop().time()
             for addr, w in self._live_workers():
                 try:
                     h = await asyncio.wait_for(
@@ -988,8 +1003,28 @@ class ClusterController:
                     raise
                 except Exception:  # noqa: BLE001 — binary monitor's job
                     continue
-                self.fm.set_degraded(addr, bool(h.get("disk_degraded")),
-                                     float(h.get("disk_latency_ms", 0.0)))
+                bad = bool(h.get("disk_degraded"))
+                lat = float(h.get("disk_latency_ms", 0.0))
+                if bad:
+                    healthy_since.pop(addr, None)
+                    self.fm.set_degraded(addr, True, lat)
+                elif self.fm.is_degraded(addr):
+                    since = healthy_since.setdefault(addr, now)
+                    if now - since >= self.knobs.CC_DISK_UNDEGRADE_DWELL_S:
+                        healthy_since.pop(addr, None)
+                        self.fm.set_degraded(addr, False, lat)
+                else:
+                    healthy_since.pop(addr, None)
+            degraded = sorted((a.ip, a.port)
+                              for a in self.fm.degraded_addresses())
+            if self.last_state is not None and \
+                    degraded != self.last_state.get("degraded", []):
+                try:
+                    await self.publish_state(
+                        lambda s: {**s, "degraded": degraded})
+                except Exception:  # noqa: BLE001 — deposed/unreachable:
+                    # the next epoch's CC owns the signal
+                    pass
 
     async def _probe_roles(self, state: dict) -> None:
         """Ping each recruited txn role's block-level liveness slot

@@ -94,24 +94,32 @@ class Coordinator:
         split-brain seed the hostile-disk sim surfaces immediately.  The
         un-written slot always holds the previous synced state; the
         legacy single file is still read for pre-slot disks."""
-        from ..rpc.wire import decode, unframe
+        from ..rpc.wire import SlottedBlob, decode, unframe
         co = cls(knobs, fs, path)
+        co._slots = SlottedBlob(fs, path)
         best = None
-        found = 0
-        slots_seen = 0
-        for suffix in (".a", ".b"):
-            f = fs.open(path + suffix)
-            data = await f.read(0, f.size())
-            if not data:
-                continue
-            found += 1
-            slots_seen += 1
-            try:
-                st = decode(unframe(data))
-            except Exception:  # noqa: BLE001 — torn slot: other one wins
-                continue
-            if best is None or st.get("seq", 0) > best.get("seq", 0):
-                best = st
+        payload, slots_seen = await co._slots.load()
+        found = slots_seen
+        if payload is not None:
+            best = decode(payload)
+        if best is None:
+            # pre-helper slot format (ISSUE 12): crc-framed dict with
+            # its own embedded seq
+            for suffix in (".a", ".b"):
+                f = fs.open(path + suffix)
+                data = await f.read(0, f.size())
+                if not data:
+                    continue
+                try:
+                    st = decode(unframe(data))
+                except Exception:  # noqa: BLE001 — torn slot: other wins
+                    continue
+                if best is None or st.get("seq", 0) > best.get("seq", 0):
+                    best = st
+            if best is not None:
+                # keep alternation continuous across the envelope
+                # migration (never clobber the only valid slot)
+                co._slots.seed(best.get("seq", 0))
         if best is None and slots_seen >= 2:
             # both slots populated yet neither decodes: a crash always
             # leaves the previously-synced slot intact (the write
@@ -137,43 +145,34 @@ class Coordinator:
             co.write_gen = tuple(best["w"])
             co.value = best["v"]
             co.moved_to = best.get("m")
-            co._persist_seq = best.get("seq", 0)
         elif found:
             TraceEvent("CoordStateCorrupt", severity=30).detail(
                 "Path", path).detail("Slots", found).log()
         return co
 
-    _persist_seq = 0
+    _slots = None
     _persist_lock = None
 
     async def _persist(self) -> None:
         if self._fs is None:
             return
-        from ..rpc.wire import encode, frame
+        from ..rpc.wire import SlottedBlob, encode
         # serialized: concurrent RPC handlers must never have BOTH slots
         # dirty at once (a kill could then tear both, and the recovery
         # invariant "one synced slot always survives" would not hold),
-        # nor write their seqs out of order
+        # nor write their seqs out of order.  The seq/slot-turn
+        # discipline lives in the shared SlottedBlob (ISSUE 13).
         if self._persist_lock is None:
             import asyncio
             self._persist_lock = asyncio.Lock()
         async with self._persist_lock:
-            # seq advances only after the sync: a failed write must NOT
-            # burn the slot turn, or the retry would land on the slot
-            # holding the freshest synced state (the DiskQueue
-            # _write_header discipline)
-            seq = self._persist_seq + 1
-            slot = ".a" if seq % 2 else ".b"
-            f = self._fs.open(self._path + slot)
-            blob = frame(encode({"seq": seq,
-                                 "r": list(self.max_read_gen),
-                                 "w": list(self.write_gen),
-                                 "v": self.value,
-                                 "m": self.moved_to}))
-            await f.write(0, blob)
-            await f.truncate(len(blob))
-            await f.sync()
-            self._persist_seq = seq
+            if self._slots is None:
+                self._slots = SlottedBlob(self._fs, self._path)
+            await self._slots.save(encode({
+                "r": list(self.max_read_gen),
+                "w": list(self.write_gen),
+                "v": self.value,
+                "m": self.moved_to}))
 
     # --- quorum migration (MovableCoordinatedState,
     #     REF:fdbserver/Coordination.actor.cpp) ---

@@ -56,7 +56,20 @@ class ReplicaGroup:
         """Reads served per replica, in team order (spread diagnostics)."""
         return [m.served for m in self._models]
 
+    @staticmethod
+    def _degraded(m) -> bool:
+        """FailureMonitor-degraded rank (ISSUE 13, ROADMAP 6 (a)): the
+        CC publishes the degraded machine set with the cluster state and
+        cluster_client stamps each storage stub — a gray-failing disk
+        should be the LAST read choice, not just avoided by recruitment.
+        In-process roles carry no stamp and rank healthy (sims with the
+        poll idle are bit-identical)."""
+        return bool(getattr(m.storage, "degraded", False))
+
     def _order(self, now: float) -> list:
+        # degraded replicas sort last under EVERY policy (the stable
+        # sort composes with the per-policy order below, exactly like
+        # the penalty class)
         if self.policy == "rotate" and len(self._models) > 1:
             # round-robin the healthy replicas (zipfian read fan-out);
             # the stable sort keeps rotation order within each penalty
@@ -64,14 +77,16 @@ class ReplicaGroup:
             start = self._rr % len(self._models)
             self._rr += 1
             rot = self._models[start:] + self._models[:start]
-            return sorted(rot, key=lambda m: m.score(now)[0])
+            return sorted(rot, key=lambda m: (self._degraded(m),
+                                              m.score(now)[0]))
         if self.policy == "least":
             # deterministic least-outstanding (stable index tiebreak)
-            return sorted(self._models, key=lambda m: m.score(now))
+            return sorted(self._models,
+                          key=lambda m: (self._degraded(m), m.score(now)))
         # "score": the pre-heat policy — least-outstanding with a
         # random tiebreak among equals
         return sorted(self._models,
-                      key=lambda m: (m.score(now),
+                      key=lambda m: (self._degraded(m), m.score(now),
                                      deterministic_random().random()))
 
     async def _failover(self, attempt):

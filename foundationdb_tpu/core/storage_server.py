@@ -46,7 +46,15 @@ class StorageServer:
                                           epoch_begin_version)
         self.log_system = log_system
         self.engine = engine            # IKeyValueStore when durable
-        self.vmap = VersionedMap()
+        # MVCC window (ISSUE 13): columnar generational store by
+        # default — all-SET packed TLog batches seal straight into
+        # immutable segments, drop_before retires whole segments.  The
+        # knob-off twin is the legacy dict-of-chains window.
+        self.vmap = VersionedMap(
+            columnar=knobs.STORAGE_MVCC_COLUMNAR,
+            seal_ops=knobs.STORAGE_MVCC_SEAL_OPS,
+            seal_bytes=knobs.STORAGE_MVCC_SEAL_BYTES,
+            seal_versions=knobs.STORAGE_MVCC_SEAL_VERSIONS)
         if engine is not None:
             # resume from the engine's durable version (0 for a fresh
             # engine — everything newer replays from the TLog)
@@ -211,6 +219,9 @@ class StorageServer:
             "index_keys": idx["keys"],
             "index_merges": idx["merges"],
             "index_merge_ms": idx["merge_ms"],
+            # columnar window shape (ISSUE 13; 0 under the legacy twin)
+            "mvcc_segments": idx.get("segments", 0),
+            "mvcc_resident_bytes": idx.get("resident_bytes", 0),
             "durable_engine": self.engine is not None,
             "queue_bytes": self.bytes_input - self.bytes_durable,
             "version": self.version,

@@ -244,6 +244,111 @@ def decode(data: bytes) -> Any:
     return obj
 
 
+class SlottedBlob:
+    """Dual-slot crc-framed single-blob persistence — THE one audited
+    corruption policy for small durable state files (ISSUE 13, ROADMAP
+    6 (f)): the lsm MANIFEST, the coordinator state, and the backup
+    ``logs.manifest`` each hand-rolled the same discipline three times.
+
+    Invariants (the DiskQueue ``_write_header`` discipline):
+
+    - writes ALTERNATE between two slot files, so the slot not being
+      written always holds the previous synced payload — a kill tearing
+      one write can never destroy the committed state;
+    - the sequence number advances only AFTER the write+sync, so a
+      failed (retried) save re-targets the SAME slot, never the one
+      holding the freshest synced state;
+    - ``load`` returns the highest-seq slot that passes its crc frame; a
+      torn slot silently loses to the intact one.  What to do when
+      slots exist but NONE decodes is the CALLER's policy (each site
+      raises its own corruption class with its own evidence rule) —
+      ``slots_seen`` carries the evidence.
+
+    The payload travels as ``frame(MAGIC + seq_u64_le + payload)``, so
+    the seq lives inside the crc envelope and sites no longer embed
+    their own copy.  The magic makes the envelope self-identifying: a
+    pre-helper slot (``frame(encode(dict))`` — encode output always
+    leads with a type tag < 14, never an ASCII 'S') also passes
+    ``unframe``, and without the magic its first 8 content bytes would
+    parse as a garbage seq and the mis-sliced remainder would be
+    returned as a "valid" payload — crashing every caller's decode AND
+    making their legacy-format fallbacks unreachable.  Callers own
+    serialization of concurrent saves (two in-flight saves could
+    otherwise dirty BOTH slots at once)."""
+
+    MAGIC = b"SBv1"
+
+    def __init__(self, fs, base: str,
+                 suffixes: tuple[str, str] = (".a", ".b")) -> None:
+        self.fs = fs
+        self.base = base
+        self.suffixes = suffixes
+        self._seq: int | None = None    # lazily learned from load
+
+    def _slot(self, seq: int) -> str:
+        return self.base + self.suffixes[0 if seq % 2 else 1]
+
+    def seed(self, seq: int) -> None:
+        """Arm the save sequence from a LEGACY-format slot's embedded
+        seq (the envelope-migration path): keeps the alternation parity
+        continuous so the next save never targets the only valid
+        old-format slot."""
+        self._seq = seq
+
+    async def load(self) -> tuple[bytes | None, int]:
+        """(newest valid payload or None, slot files seen).  Also arms
+        the save sequence, so load-before-first-save is the expected
+        lifecycle (a never-loaded save starts at seq 1)."""
+        best: bytes | None = None
+        best_seq = 0
+        seen = 0
+        for suffix in self.suffixes:
+            f = self.fs.open(self.base + suffix)
+            try:
+                raw = await f.read(0, f.size())
+            finally:
+                await f.close()
+            if not raw:
+                continue
+            seen += 1
+            try:
+                payload = unframe(raw)
+                if not payload.startswith(self.MAGIC):
+                    # a pre-helper-format slot (or foreign frame): not
+                    # ours to parse — the caller's legacy fallback owns
+                    # it, and it still counts as evidence in ``seen``
+                    continue
+                m = len(self.MAGIC)
+                seq = int.from_bytes(payload[m:m + 8], "little")
+                body = payload[m + 8:]
+            except Exception:   # noqa: BLE001 — torn slot: other one wins
+                continue
+            if best is None or seq > best_seq:
+                best, best_seq = body, seq
+        if self._seq is None or best_seq > self._seq:
+            self._seq = best_seq
+        return best, seen
+
+    async def save(self, payload: bytes) -> int:
+        """Write ``payload`` into the next slot; returns the new seq."""
+        if self._seq is None:
+            await self.load()
+        seq = (self._seq or 0) + 1
+        f = self.fs.open(self._slot(seq))
+        blob = frame(self.MAGIC + seq.to_bytes(8, "little") + payload)
+        try:
+            # a faulted disk op must not leak the handle — persist
+            # retries on a sick disk (PR 11's erroring-disk chaos)
+            # would otherwise exhaust fds one per attempt
+            await f.write(0, blob)
+            await f.truncate(len(blob))
+            await f.sync()
+        finally:
+            await f.close()
+        self._seq = seq
+        return seq
+
+
 def _register_core_structs() -> None:
     """Register the shared RPC structs in one canonical order."""
     from ..core import change_feed as cf

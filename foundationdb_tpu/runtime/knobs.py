@@ -138,6 +138,30 @@ class Knobs:
     # one event-loop turn is a ~100-500ms stall (SlowTask); the pull
     # loop yields between slices, never splitting a version
     STORAGE_APPLY_CHUNK_MUTATIONS: int = 32768
+    # --- columnar MVCC window (ISSUE 13, ROADMAP item 5 (b)) ---
+    # the storage server's in-memory version window as a generational
+    # columnar store: a small mutable tip (per-key chains above the last
+    # seal) plus immutable sealed segments (distinct-key KeyRun + int64
+    # version column + value blob/bounds + tombstone bits).  All-SET
+    # packed TLog batches seal directly off the MutationBatch columns;
+    # drop_before retires whole segments in O(segments).  Off = the
+    # legacy dict-of-per-key-chains window, retained as the
+    # equivalence / RSS A/B twin (tools/perf_smoke.py --stage mvcc
+    # measures both; bit-identical serving asserted in situ).
+    STORAGE_MVCC_COLUMNAR: bool = True
+    # seal budgets: the tip freezes into a segment when it holds this
+    # many entries / this many key+value bytes / a version span this
+    # wide (whichever trips first).  Smaller budgets mean more, smaller
+    # segments (more probe layers before compaction); larger budgets
+    # mean more per-key dict state in the tip.  The version span sits
+    # just under the MVCC window so a low-rate trickle (sim traffic, a
+    # quiet shard) lives its whole windowed life in the tip — point
+    # reads stay one dict probe — while sustained batch traffic seals
+    # on the ops/bytes budgets and bulk all-SET batches seal DIRECTLY
+    # regardless.
+    STORAGE_MVCC_SEAL_OPS: int = 8192
+    STORAGE_MVCC_SEAL_BYTES: int = 4 << 20
+    STORAGE_MVCC_SEAL_VERSIONS: int = 4_000_000
 
     # --- device read serving (ISSUE 6) ---
     # serve get_values' missing-key pass (the keys the MVCC window does
@@ -309,6 +333,13 @@ class Knobs:
     DISK_DEGRADED_LATENCY_MS: float = 25.0
     DISK_HEALTH_HALFLIFE_S: float = 5.0
     CC_DISK_HEALTH_INTERVAL: float = 1.0
+    # un-degrade dwell (ROADMAP 6 (b), the _watch_region_preference
+    # hysteresis shape): the CC clears a machine's degraded flag only
+    # after its reports have stayed healthy for this long — a flapping
+    # disk (decayed mean oscillating around the threshold) can no
+    # longer thrash recruitment ordering / DD destination picking each
+    # poll.  Degrading remains immediate.  0 restores flip-on-sample.
+    CC_DISK_UNDEGRADE_DWELL_S: float = 5.0
 
     def override(self, **kv: Any) -> "Knobs":
         return dataclasses.replace(self, **kv)

@@ -57,13 +57,15 @@ _BATCH_MIN = 16
 class KeyRun:
     """One immutable columnar sorted run of byte keys."""
 
-    __slots__ = ("blob", "bounds", "_pfx")
+    __slots__ = ("blob", "bounds", "_pfx", "_pfx2", "_lens")
 
     def __init__(self, blob: bytes = b"",
                  bounds: _array | None = None) -> None:
         self.blob = blob
         self.bounds = bounds if bounds is not None else _array("q")
         self._pfx: np.ndarray | None = None
+        self._pfx2: np.ndarray | None = None
+        self._lens: np.ndarray | None = None
 
     # --- construction ---
 
@@ -147,28 +149,50 @@ class KeyRun:
 
     # --- prefixes (the vectorized-searchsorted operand) ---
 
+    def _pfx_from(self, skip: int) -> np.ndarray:
+        """u64 of key bytes [skip, skip+8) per key, zero-padded —
+        computed straight off the columns."""
+        n = len(self.bounds)
+        if n == 0:
+            return np.zeros(0, dtype=np.uint64)
+        flat = np.frombuffer(self.blob, dtype=np.uint8)
+        ends = self._np_bounds()
+        starts = np.empty(n, dtype=np.int64)
+        starts[0] = 0
+        starts[1:] = ends[:-1]
+        starts = starts + skip
+        plens = np.minimum(np.maximum(ends - starts, 0), 8)
+        buf = np.zeros((n, 8), dtype=np.uint8)
+        cols = np.arange(8)[None, :]
+        mask = cols < plens[:, None]
+        src = np.minimum(starts[:, None] + cols, max(len(flat) - 1, 0))
+        buf[mask] = flat[src[mask]]
+        return buf.view(">u8").ravel().astype(np.uint64)
+
     def prefixes(self) -> np.ndarray:
         """keycode-u64 prefixes of every key (cached) — computed straight
         off the columns, byte-identical to
         ``keycode.encode_prefix_u64(self.to_list())`` without the join."""
         if self._pfx is None:
-            n = len(self.bounds)
-            if n == 0:
-                self._pfx = np.zeros(0, dtype=np.uint64)
-                return self._pfx
-            flat = np.frombuffer(self.blob, dtype=np.uint8)
-            ends = self._np_bounds()
-            starts = np.empty(n, dtype=np.int64)
-            starts[0] = 0
-            starts[1:] = ends[:-1]
-            plens = np.minimum(ends - starts, 8)
-            buf = np.zeros((n, 8), dtype=np.uint8)
-            cols = np.arange(8)[None, :]
-            mask = cols < plens[:, None]
-            src = np.minimum(starts[:, None] + cols, max(len(flat) - 1, 0))
-            buf[mask] = flat[src[mask]]
-            self._pfx = buf.view(">u8").ravel().astype(np.uint64)
+            self._pfx = self._pfx_from(0)
         return self._pfx
+
+    def lens(self) -> np.ndarray:
+        """Per-key byte lengths (cached) — run_positions' tie-breaker."""
+        if self._lens is None:
+            self._lens = np.diff(self._np_bounds(), prepend=0)
+        return self._lens
+
+    def prefixes2(self) -> np.ndarray:
+        """SECOND-word prefixes (key bytes [8, 16), cached): the rescue
+        level for keyspaces sharing their first 8 bytes, where the
+        primary bands collapse to the whole run (the ISSUE 11 band-
+        collapse shape).  Within an equal-``prefixes()`` band, keys sort
+        by this word, so a second searchsorted restricted to the band
+        is exact up to 16 bytes."""
+        if self._pfx2 is None:
+            self._pfx2 = self._pfx_from(8)
+        return self._pfx2
 
     # --- point probes ---
     #
@@ -211,31 +235,20 @@ class KeyRun:
 
     # --- batched probes (ONE vectorized searchsorted for the batch) ---
 
-    def search_bands(self, keys: list[bytes]
-                     ) -> tuple[np.ndarray, np.ndarray]:
-        """(lo, hi) equal-prefix candidate bands per probe key: one
-        vectorized searchsorted pair over the cached prefixes.  An exact
-        bound is then ``bisect_left/right(key, lo, hi)`` — the band is
-        usually empty or single-element (but can be the whole run when
-        the keyspace shares its first 8 bytes; batch_bisect's monotone
-        floor covers that shape)."""
-        from ..ops.keycode import encode_prefix_u64
-        pfx = self.prefixes()
-        probes = encode_prefix_u64(keys)
-        return (np.searchsorted(pfx, probes, side="left"),
-                np.searchsorted(pfx, probes, side="right"))
-
     def batch_bisect(self, keys: list[bytes], side: str = "left",
                      sorted_keys: bool = False) -> list[int]:
         """Exact insertion points for many keys — prefix searchsorted +
         per-key bisect refinement, with a plain-bisect fallback below
         the amortization threshold.  ``sorted_keys=True`` (the merge /
         delete path) additionally floors each refinement at the
-        previous result, so a shared-prefix keyspace whose bands
-        collapse still refines in O(m log(n/m)) total, not m full
-        bisects."""
+        previous result, and COLLAPSED bands (a keyspace sharing its
+        first 8 bytes maps every probe to the whole run — the ISSUE 11
+        band-collapse shape) re-narrow through one second-word
+        searchsorted per distinct band (``prefixes2``), so the
+        refinement never degenerates to m full-run bisects."""
         point = self.bisect_left if side == "left" else self.bisect_right
-        if len(keys) < _BATCH_MIN or len(self.bounds) < _BATCH_MIN:
+        m = len(keys)
+        if m < _BATCH_MIN or len(self.bounds) < _BATCH_MIN:
             if not sorted_keys:
                 return [point(k) for k in keys]
             out: list[int] = []
@@ -244,17 +257,146 @@ class KeyRun:
                 prev = point(k, prev)
                 out.append(prev)
             return out
-        los, his = self.search_bands(keys)
-        out = []
+        from ..ops.keycode import encode_prefix_u64
+        pfx = self.prefixes()
+        probes = encode_prefix_u64(keys)
+        los = np.searchsorted(pfx, probes, side="left").tolist()
+        his = np.searchsorted(pfx, probes, side="right").tolist()
+        out = [0] * m
         prev = 0
-        for k, lo, hi in zip(keys, los.tolist(), his.tolist()):
-            if sorted_keys and prev > lo:
-                lo = prev
-            if hi < lo:
-                hi = lo
-            prev = point(k, lo, hi)
-            out.append(prev)
+        i = 0
+        while i < m:
+            lo, hi = los[i], his[i]
+            j = i + 1
+            while j < m and los[j] == lo and his[j] == hi:
+                j += 1
+            if hi - lo > 32 and (hi - lo) > 2 * (j - i):
+                # collapsed band shared by probes [i, j): one restricted
+                # second-word searchsorted re-narrows them all
+                pfx2 = self.prefixes2()
+                p2 = encode_prefix_u64([k[8:16] for k in keys[i:j]])
+                l2 = (lo + np.searchsorted(pfx2[lo:hi], p2,
+                                           side="left")).tolist()
+                h2 = (lo + np.searchsorted(pfx2[lo:hi], p2,
+                                           side="right")).tolist()
+                for p in range(i, j):
+                    blo, bhi = l2[p - i], h2[p - i]
+                    if sorted_keys and prev > blo:
+                        blo = prev
+                    if bhi < blo:
+                        bhi = blo
+                    prev = point(keys[p], blo, bhi)
+                    out[p] = prev
+            else:
+                for p in range(i, j):
+                    blo, bhi = lo, hi
+                    if sorted_keys and prev > blo:
+                        blo = prev
+                    if bhi < blo:
+                        bhi = blo
+                    prev = point(keys[p], blo, bhi)
+                    out[p] = prev
+            i = j
         return out
+
+    def adopt_prefixes(self, pfx: np.ndarray | None,
+                       pfx2: np.ndarray | None,
+                       lens: np.ndarray | None = None) -> "KeyRun":
+        """Install precomputed prefix (and optionally length) caches
+        (the segment-merge path: prefixes are position-independent, so
+        a merge can np.insert the parents' cached arrays instead of
+        re-encoding the whole run)."""
+        self._pfx = pfx
+        self._pfx2 = pfx2
+        if lens is not None:
+            self._lens = lens
+        return self
+
+    def run_positions(self, other: "KeyRun"
+                      ) -> tuple[np.ndarray, np.ndarray]:
+        """(left insertion positions, exact-match mask) of another
+        SORTED run's keys in this run — the columnar MVCC merge/probe
+        primitive (ISSUE 13), fully vectorized: one searchsorted pair
+        over the first-word prefixes, one per collapsed band over the
+        second word, and a LENGTH compare settles order and equality
+        for prefix-tied keys of <= 16 bytes (a shorter key is a strict
+        prefix of the longer, so it sorts first; equal length means
+        equal key).  Only ties past 16 bytes fall back to byte-level
+        bisects."""
+        m = len(other)
+        nA = len(self.bounds)
+        if m == 0:
+            return (np.zeros(0, dtype=np.int64),
+                    np.zeros(0, dtype=bool))
+        if nA == 0:
+            return (np.zeros(m, dtype=np.int64),
+                    np.zeros(m, dtype=bool))
+        pa = self.prefixes()
+        pb = other.prefixes()
+        lo = np.searchsorted(pa, pb, side="left").astype(np.int64)
+        hi = np.searchsorted(pa, pb, side="right")
+        pos = lo.copy()
+        dup = np.zeros(m, dtype=bool)
+        amb = hi > lo
+        if not amb.any():
+            return pos, dup
+        pa2 = self.prefixes2()
+        pb2 = other.prefixes2()
+        lenA = self.lens()
+        lenB = other.lens()
+        ai = np.nonzero(amb)[0]
+        # ``other`` is sorted, so equal-prefix probes (hence equal
+        # bands) are contiguous in ai; group by the band's lo value
+        band_lo = lo[ai]
+        cuts = np.nonzero(np.diff(band_lo))[0] + 1
+        group_starts = np.concatenate([[0], cuts, [len(ai)]])
+        hard: list[int] = []
+        for g in range(len(group_starts) - 1):
+            gi = ai[group_starts[g]:group_starts[g + 1]]
+            blo = int(lo[gi[0]])
+            bhi = int(hi[gi[0]])
+            sub2 = pa2[blo:bhi]
+            p2 = pb2[gi]
+            l2 = blo + np.searchsorted(sub2, p2, side="left")
+            h2 = blo + np.searchsorted(sub2, p2, side="right")
+            pos[gi] = l2
+            sz = h2 - l2
+            one = sz == 1
+            if one.any():
+                ii = gi[one]
+                p1 = l2[one]
+                la = lenA[p1]
+                lb = lenB[ii]
+                easy = (la <= 16) & (lb <= 16)
+                dup[ii[easy & (la == lb)]] = True
+                pos[ii[easy & (la < lb)]] += 1
+                hard.extend(ii[~easy].tolist())
+            multi = sz > 1
+            if multi.any():
+                hard.extend(gi[multi].tolist())
+        if hard:
+            okey = other.key
+            n = nA
+            for i in hard:
+                k = okey(i)
+                p = self.bisect_left(k, int(lo[i]), int(hi[i]))
+                pos[i] = p
+                dup[i] = p < n and self.key(p) == k
+        return pos, dup
+
+    def batch_find(self, keys: list[bytes],
+                   assume_sorted: bool = False) -> list[int]:
+        """Exact positions of ``keys`` (or -1 where absent) — the
+        columnar MVCC window's per-segment probe (ISSUE 13): the
+        two-level ``batch_bisect`` banding plus one membership slice
+        compare per probe."""
+        n = len(self.bounds)
+        if not keys or n == 0:
+            return [-1] * len(keys)
+        pos = self.batch_bisect(keys, "left", sorted_keys=assume_sorted)
+        key_at = self.key
+        return [p if p < n and key_at(p) == k else -1
+                for p, k in zip(pos, keys)]
 
     # --- mutation (immutable: each returns a NEW run) ---
 
@@ -269,6 +411,60 @@ class KeyRun:
         if not len(self.bounds):
             return KeyRun.from_keys(new_keys)
         pos = self.batch_bisect(new_keys, "left", sorted_keys=True)
+        return self.insert_at(pos, new_keys)
+
+    def insert_run_at(self, pos: np.ndarray, other: "KeyRun",
+                      mask: np.ndarray) -> "KeyRun":
+        """Stitch ``other``'s rows selected by ``mask`` in at ascending
+        insertion points ``pos`` (one per selected row) — the columnar
+        MVCC segment merge's key build (ISSUE 13).  Fully vectorized:
+        the merged blob assembles through ONE byte-level gather over the
+        two source blobs, and the prefix/length caches merge by
+        ``np.insert`` instead of re-encoding (prefixes are
+        position-independent)."""
+        m = int(mask.sum())
+        if m == 0:
+            return self
+        if not len(self.bounds):
+            if m == len(other.bounds):
+                return other
+            # partial adoption of another run: fall back to the list path
+            from itertools import compress
+            return KeyRun.from_keys(
+                list(compress(other.to_list(), mask.tolist())))
+        lenA = self.lens()
+        lenBall = other.lens()
+        lenB = lenBall[mask]
+        endsB = other._np_bounds()
+        startsB = (endsB - lenBall)[mask] + len(self.blob)
+        endsA = self._np_bounds()
+        startsA = endsA - lenA
+        flat = np.frombuffer(self.blob + other.blob, dtype=np.uint8)
+        mstarts = np.insert(startsA, pos, startsB)
+        mlens = np.insert(lenA, pos, lenB)
+        tot = int(mlens.sum())
+        row_off = np.concatenate([np.zeros(1, dtype=np.int64),
+                                  np.cumsum(mlens)[:-1]])
+        gidx = np.repeat(mstarts - row_off, mlens) \
+            + np.arange(tot, dtype=np.int64)
+        bounds = _array("q")
+        bounds.frombytes(np.cumsum(mlens).tobytes())
+        out = KeyRun(flat[gidx].tobytes(), bounds)
+        if self._pfx is not None and other._pfx is not None:
+            out._pfx = np.insert(self._pfx, pos, other._pfx[mask])
+        if self._pfx2 is not None and other._pfx2 is not None:
+            out._pfx2 = np.insert(self._pfx2, pos, other._pfx2[mask])
+        out._lens = mlens
+        return out
+
+    def insert_at(self, pos: list[int], new_keys: list[bytes]) -> "KeyRun":
+        """Stitch ``new_keys`` in at precomputed ascending insertion
+        points (the merge_sorted build with the bisect pass already
+        paid — the columnar MVCC segment merge's shape, ISSUE 13)."""
+        if not new_keys:
+            return self
+        if not len(self.bounds):
+            return KeyRun.from_keys(new_keys)
         ends = self.bounds
         np_ends = self._np_bounds()
         base_lens = np.diff(np_ends, prepend=0)
@@ -303,6 +499,12 @@ class KeyRun:
                if p < n and self.key(p) == k]
         if not hit:
             return self, 0
+        return self.delete_at(hit), len(hit)
+
+    def delete_at(self, hit: list[int]) -> "KeyRun":
+        """Remove the keys at the given ascending positions (the
+        located half of ``delete_keys``; the columnar MVCC segment
+        prune's shape, ISSUE 13)."""
         ends = self.bounds
         lens = np.diff(self._np_bounds(), prepend=0)
         bounds = _array("q")
@@ -317,4 +519,4 @@ class KeyRun:
             prev = ends[p]
         if prev < len(blob):
             parts.append(blob[prev:])
-        return KeyRun(b"".join(parts), bounds), len(hit)
+        return KeyRun(b"".join(parts), bounds)

@@ -291,28 +291,36 @@ class LSMKVStore:
         self._wal_file = None
         self._gen = 0
         self._wal_gen = 0
-        self._man_seq = 0
+        # the dual-slot manifest persist (rpc/wire.SlottedBlob); open()
+        # replaces it with the loaded/armed instance
+        from ..rpc.wire import SlottedBlob
+        self._man_sb = SlottedBlob(fs, prefix,
+                                   (".MANIFEST.a", ".MANIFEST.b"))
 
     # --- lifecycle ---
 
     @classmethod
-    async def _load_manifest(cls, fs, prefix: str) -> tuple[dict | None, int]:
-        """Newest valid manifest among the two crc-framed slots (plus
-        the pre-ISSUE-12 single unframed file): (manifest, slots seen).
-        Manifests were rewritten in place before the dual-slot
-        discipline, so a kill tearing the write destroyed the previous
-        manifest with it — losing the committed run set to a legitimate
-        crash."""
-        from ..rpc.wire import unframe
+    async def _load_manifest(cls, fs, prefix: str
+                             ) -> tuple[dict | None, int, "SlottedBlob"]:
+        """Newest valid manifest from the shared dual-slot helper
+        (rpc/wire.py ``SlottedBlob`` — ONE audited corruption policy,
+        ISSUE 13 / ROADMAP 6 (f)), falling back to the two pre-helper
+        slot formats: the ISSUE-12 crc-framed dict-with-seq slots, and
+        the original rewritten-in-place single file (which a torn kill
+        could destroy outright).  Returns (manifest, slots seen, the
+        armed helper for subsequent saves)."""
+        from ..rpc.wire import SlottedBlob, unframe
+        sb = SlottedBlob(fs, prefix, (".MANIFEST.a", ".MANIFEST.b"))
+        payload, found = await sb.load()
+        if payload is not None:
+            return decode(payload), found, sb
         best = None
-        found = 0
         for suffix in (".MANIFEST.a", ".MANIFEST.b"):
             f = fs.open(prefix + suffix)
             blob = await f.read(0, f.size())
             await f.close()
             if not blob:
                 continue
-            found += 1
             try:
                 man = decode(unframe(blob))
             except Exception:  # noqa: BLE001 — torn slot: other one wins
@@ -320,27 +328,30 @@ class LSMKVStore:
             if best is None or man.get("seq", 0) > best.get("seq", 0):
                 best = man
         if best is not None:
-            return best, found
+            # keep the slot alternation continuous across the envelope
+            # migration: the next save must NOT target the only valid
+            # old-format slot
+            sb.seed(best.get("seq", 0))
+            return best, found, sb
         legacy = fs.open(prefix + ".MANIFEST")
         blob = await legacy.read(0, legacy.size())
         await legacy.close()
         if blob:
             found += 1
             try:
-                return decode(blob), found
+                return decode(blob), found, sb
             except Exception:  # noqa: BLE001 — caller decides torn/corrupt
                 pass
-        return None, found
+        return None, found, sb
 
     @classmethod
     async def open(cls, fs, prefix: str) -> "LSMKVStore":
         kv = cls(fs, prefix)
-        man, slots_seen = await cls._load_manifest(fs, prefix)
+        man, slots_seen, kv._man_sb = await cls._load_manifest(fs, prefix)
         if man is not None:
             kv.meta = man["meta"]
             kv._gen = man["gen"]
             kv._wal_gen = man.get("wal_gen", 0)
-            kv._man_seq = man.get("seq", 0)
             for path in man["runs"]:
                 kv._runs.append(_Run(fs, str(path), kv._cache))
             kv._sparse.bump()
@@ -649,23 +660,13 @@ class LSMKVStore:
         return path
 
     async def _write_manifest(self) -> None:
-        """Alternating crc-framed slots (ISSUE 12): the slot not being
-        written always holds the previous valid manifest, so a kill
-        tearing this write can never lose the committed run set."""
-        from ..rpc.wire import frame
-        # seq advances only after the sync: a failed (retried) write
-        # must re-target the SAME slot, never the freshest synced one
-        seq = self._man_seq + 1
-        slot = ".MANIFEST.a" if seq % 2 else ".MANIFEST.b"
-        mf = self.fs.open(self.prefix + slot)
-        blob = frame(encode({"seq": seq, "gen": self._gen,
-                             "wal_gen": self._wal_gen, "meta": self.meta,
-                             "runs": [r.path for r in self._runs]}))
-        await mf.write(0, blob)
-        await mf.truncate(len(blob))
-        await mf.sync()
-        await mf.close()
-        self._man_seq = seq
+        """One save through the shared dual-slot helper (ISSUE 13): the
+        slot not being written always holds the previous valid manifest,
+        so a kill tearing this write can never lose the committed run
+        set, and a failed (retried) write re-targets the same slot."""
+        await self._man_sb.save(encode({
+            "gen": self._gen, "wal_gen": self._wal_gen, "meta": self.meta,
+            "runs": [r.path for r in self._runs]}))
 
     async def _flush(self) -> None:
         def items():

@@ -1,0 +1,411 @@
+"""Columnar MVCC window (ISSUE 13) — segment lifecycle units plus the
+randomized columnar-vs-legacy equivalence that makes ``columnar=False``
+a real A/B twin: identical observable state under interleaved packed
+applies, clears, atomics (through the storage role), compaction floors,
+rollbacks, and a durable reopen.
+
+The legality envelope matches the role contract: one floor consumer per
+map (engine-less -> forget_before, engine-backed -> drop_before) and
+rollback targets at or above the readable floor — the storage server
+never rolls back below the MVCC window (the rollback target is always a
+recovered version inside it).  Outside that envelope the legacy twin
+itself has divergent quirks (see test_versioned_map's model notes)."""
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.core.data import MutationBatchBuilder
+from foundationdb_tpu.runtime.rng import DeterministicRandom
+from foundationdb_tpu.storage.versioned_map import (
+    ColumnarVersionedMap, LegacyVersionedMap, OP_CLEAR, OP_SET,
+    VersionedMap)
+
+
+def _keys():
+    return [b"k%02d" % i for i in range(14)]
+
+
+def _check(col, leg, keys, version, ctx):
+    assert col.keys() == leg.keys(), (ctx, col.keys(), leg.keys())
+    for probe in range(max(col.oldest_version, 0), version + 2):
+        for k in keys:
+            assert col.get2(k, probe) == leg.get2(k, probe), \
+                (ctx, k, probe, col.get2(k, probe), leg.get2(k, probe))
+    assert col.get2_batch(keys, version) == \
+        [leg.get2(k, version) for k in keys], ctx
+    assert [col.get_latest(k) for k in keys] == \
+        [leg.get_latest(k) for k in keys], ctx
+    assert col.range_rows(b"", b"z", version) == \
+        leg.range_rows(b"", b"z", version), ctx
+    assert col.range_read(b"", b"z", version, limit=4, reverse=True) == \
+        leg.range_read(b"", b"z", version, limit=4, reverse=True), ctx
+
+
+@pytest.mark.parametrize("consumer", ["forget", "drop", "mixed_rollback"])
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_columnar_vs_legacy_randomized(seed, consumer):
+    """The A/B equivalence: tiny seal budget so a 250-step workload
+    exercises direct seals, tip seals, tiered compaction, folds,
+    whole-segment drops, dead markers and rollback truncation — every
+    observable (point reads at every live version, batched probes,
+    forward/reverse ranges, keys(), get_latest) must match the legacy
+    twin exactly."""
+    import foundationdb_tpu.storage.versioned_map as vmod
+    old_min = vmod._DIRECT_SEAL_MIN
+    vmod._DIRECT_SEAL_MIN = 6       # exercise direct seals at toy sizes
+    try:
+        rng = DeterministicRandom(seed)
+        col = ColumnarVersionedMap(seal_ops=9, seal_bytes=1 << 30,
+                                   seal_versions=1 << 40)
+        leg = LegacyVersionedMap()
+        keys = _keys()
+        version = 0
+        for step in range(250):
+            version += rng.random_int(1, 4)
+            mode = rng.random_int(0, 10)
+            if mode < 4:
+                b = MutationBatchBuilder()
+                for _ in range(rng.random_int(1, 12)):
+                    b.add(0, keys[rng.random_int(0, len(keys))],
+                          b"v%d" % rng.random_int(0, 1000))
+                mb = b.finish()
+                col.apply_packed(version, mb)
+                leg.apply_packed(version, mb)
+            elif mode < 6:
+                b = MutationBatchBuilder()
+                for _ in range(rng.random_int(1, 8)):
+                    if rng.random_int(0, 4) == 0:
+                        lo = rng.random_int(0, len(keys))
+                        hi = rng.random_int(lo, len(keys) + 1)
+                        b.add(1, keys[lo] if lo < len(keys) else b"z",
+                              keys[hi] if hi < len(keys) else b"z")
+                    else:
+                        b.add(0, keys[rng.random_int(0, len(keys))],
+                              b"v%d" % step)
+                mb = b.finish()
+                col.apply_packed(version, mb)
+                leg.apply_packed(version, mb)
+            elif mode < 8:
+                ops = []
+                v = version
+                for _ in range(rng.random_int(1, 10)):
+                    if rng.random_int(0, 4) == 0:
+                        lo = rng.random_int(0, len(keys))
+                        hi = rng.random_int(lo, len(keys) + 1)
+                        ops.append((v, OP_CLEAR,
+                                    keys[lo] if lo < len(keys) else b"z",
+                                    keys[hi] if hi < len(keys) else b"z"))
+                    else:
+                        ops.append((v, OP_SET,
+                                    keys[rng.random_int(0, len(keys))],
+                                    b"v%d" % step))
+                    v += rng.random_int(0, 2)
+                version = v
+                col.apply_batch(ops)
+                leg.apply_batch(ops)
+            elif mode == 8:
+                t = version - rng.random_int(0, 10)
+                if consumer == "forget" or (consumer == "mixed_rollback"
+                                            and rng.random_int(0, 2)):
+                    col.forget_before(t)
+                    leg.forget_before(t)
+                elif consumer == "drop":
+                    col.drop_before(t)
+                    leg.drop_before(t)
+                else:
+                    back = max(version - rng.random_int(0, 5),
+                               col.oldest_version)
+                    col.rollback_after(back)
+                    leg.rollback_after(back)
+                    version = max(version - 5, leg.latest_version)
+            else:
+                k = keys[rng.random_int(0, len(keys))]
+                col.set(version, k, b"s%d" % step)
+                leg.set(version, k, b"s%d" % step)
+            _check(col, leg, keys, version, (seed, consumer, step))
+        if consumer == "drop":
+            col.drop_before(version)
+            leg.drop_before(version)
+        else:
+            col.forget_before(version)
+            leg.forget_before(version)
+        _check(col, leg, keys, version, (seed, consumer, "final"))
+    finally:
+        vmod._DIRECT_SEAL_MIN = old_min
+
+
+def _mb(*ops):
+    b = MutationBatchBuilder()
+    for t, p1, p2 in ops:
+        b.add(t, p1, p2)
+    return b.finish()
+
+
+def test_direct_seal_zero_copy_and_budgets():
+    """An all-SET packed batch above the direct-seal threshold becomes
+    ONE segment whose value blob IS the batch blob (near-zero-copy);
+    the tip seals on each of its three budgets."""
+    vm = ColumnarVersionedMap(seal_ops=4, seal_bytes=1 << 30,
+                              seal_versions=1 << 40)
+    big = _mb(*[(0, b"d%04d" % i, b"v%d" % i) for i in range(600)])
+    vm.apply_packed(10, big)
+    assert len(vm._segments) == 1 and not vm._tip
+    assert vm._segments[0].vblob is big.blob        # zero value copies
+    assert vm.get2(b"d0001", 10) == (True, b"v1")
+    assert vm.get2(b"d0001", 9) == (False, None)
+    # ops budget: 4 tip entries seal
+    vm.set(11, b"a", b"1")
+    vm.set(12, b"b", b"2")
+    vm.set(13, b"c", b"3")
+    assert vm._tip
+    vm.set(14, b"d", b"4")
+    assert not vm._tip              # sealed on the ops budget
+    # byte budget
+    vm2 = ColumnarVersionedMap(seal_ops=1 << 30, seal_bytes=64,
+                               seal_versions=1 << 40)
+    vm2.set(1, b"x", b"y" * 100)
+    assert not vm2._tip
+    # version-span budget
+    vm3 = ColumnarVersionedMap(seal_ops=1 << 30, seal_bytes=1 << 30,
+                               seal_versions=50)
+    vm3.set(1, b"x", b"y")
+    assert vm3._tip
+    vm3.set(60, b"x", b"z")
+    assert not vm3._tip
+
+
+def test_drop_before_retires_whole_segments():
+    """drop_before is O(segments): layers wholly at-or-below the floor
+    vanish outright, a straddler stays (its sub-floor entries turn
+    invisible via the drop-floor read rule)."""
+    vm = ColumnarVersionedMap(seal_ops=2, seal_bytes=1 << 30,
+                              seal_versions=1 << 40)
+    # a big old layer first so the tiered compaction leaves the small
+    # later seals as their own segments (2 * small < big)
+    vm.apply_packed(10, _mb(*[(0, b"s%04d" % i, b"v") for i in range(400)]))
+    for i in range(4):
+        vm.set(20 * (i + 1) + 10, b"t%d" % i, b"v")
+        vm.set(20 * (i + 1) + 11, b"u%d" % i, b"v")
+    assert len(vm._segments) >= 2
+    before = [s for s in vm._segments]
+    vm.drop_before(51)
+    # layers wholly at-or-below the floor vanished; survivors are the
+    # IDENTICAL objects (no rebuild — the O(segments) claim)
+    assert all(s.max_version > 51 for s in vm._segments)
+    assert all(any(s is b for b in before) for s in vm._segments)
+    assert vm.get2(b"s0001", 60) == (False, None)   # dropped
+    assert vm.get2(b"t0", 31) == (False, None)      # dropped
+    assert vm.get2(b"t3", 91) == (True, b"v")       # still windowed
+    # everything below: the window empties completely
+    vm.drop_before(200)
+    assert not vm._segments
+    assert vm.keys() == []
+
+
+def test_rollback_truncates_tip_and_suffix_segments():
+    vm = ColumnarVersionedMap(seal_ops=2, seal_bytes=1 << 30,
+                              seal_versions=1 << 40)
+    vm.apply_packed(10, _mb(*[(0, b"a%d" % i, b"1") for i in range(300)]))
+    vm.apply_packed(20, _mb(*[(0, b"b%d" % i, b"2") for i in range(300)]))
+    vm.set(30, b"tip", b"3")
+    vm.rollback_after(15)
+    assert vm.latest_version == 15
+    assert vm.get2(b"a1", 20) == (True, b"1")
+    assert vm.get2(b"b1", 25) == (False, None)      # layer rolled back
+    assert vm.get2(b"tip", 30) == (False, None)     # tip entry rolled back
+    assert all(s.max_version <= 15 for s in vm._segments)
+
+
+def test_rollback_below_drop_floor_serves_new_generation():
+    """Rolling back below the drop floor (the legacy full-walk net —
+    never legal from the role layer, kept as defense in depth) must
+    void the stale floors: without that, every new-generation write at
+    or below the old floor read found=False (engine-dropped) while the
+    legacy twin served it — a rejoin silently losing writes until
+    versions climbed back past the old floor."""
+    vm = ColumnarVersionedMap(seal_ops=2, seal_bytes=1 << 30,
+                              seal_versions=1 << 40)
+    leg = LegacyVersionedMap()
+    for m in (vm, leg):
+        m.apply_batch([(40, OP_SET, b"a", b"1"),
+                       (90, OP_SET, b"a", b"2")])
+        m.drop_before(100)
+        m.rollback_after(50)
+        m.apply_batch([(60, OP_SET, b"a", b"3")])
+    assert vm.get2(b"a", 60) == leg.get2(b"a", 60) == (True, b"3")
+    assert vm.get2(b"a", 59) == leg.get2(b"a", 59)      # (False, None):
+    #                                     the 40-entry was dropped to
+    #                                     the engine before the rollback
+    # the floors keep functioning for the new generation
+    for m in (vm, leg):
+        m.drop_before(60)
+    assert vm.get2(b"a", 60) == leg.get2(b"a", 60) == (False, None)
+
+
+def test_dead_marker_survives_reset_and_retires():
+    """The temporal dead rule: a key whose lone tombstone the floor
+    crossed stays dead (found=False) even after lingering older values
+    would otherwise resurface, and the marker retires once no layer
+    reaches that far back."""
+    vm = ColumnarVersionedMap(seal_ops=2, seal_bytes=1 << 30,
+                              seal_versions=1 << 40)
+    leg = LegacyVersionedMap()
+    for m in (vm, leg):
+        m.apply_batch([(10, OP_SET, b"k", b"v1"),
+                       (20, OP_CLEAR, b"k", b"k\x00")])
+        m.forget_before(25)         # judged dead here
+    assert vm._dead and vm.get2(b"k", 25) == leg.get2(b"k", 25) \
+        == (False, None)
+    for m in (vm, leg):
+        m.apply_batch([(30, OP_SET, b"k", b"v2")])  # re-set after death
+    for probe in (25, 29, 30):
+        assert vm.get2(b"k", probe) == leg.get2(b"k", probe), probe
+    for m in (vm, leg):
+        m.forget_before(40)
+    # the fold prunes the marked entries; the marker retires once every
+    # layer's oldest entry is newer than it
+    assert vm.get2(b"k", 40) == leg.get2(b"k", 40) == (True, b"v2")
+    assert not vm._dead or all(v >= min(s.min_version
+                                        for s in vm._segments)
+                               for v in vm._dead.values())
+
+
+def test_storage_server_ab_with_atomics_and_clears():
+    """Role-level A/B: two engine-less storage servers fed the SAME
+    mutation stream — plain sets, range clears, and atomics (which the
+    role resolves against get_latest before the window sees them) —
+    must serve byte-identical point/batched/range reads under both
+    window implementations."""
+    from foundationdb_tpu.core.data import (GetValuesRequest, KeyRange,
+                                            Mutation, MutationType)
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    async def main():
+        rng = DeterministicRandom(11)
+        servers = []
+        for columnar in (True, False):
+            k = Knobs().override(STORAGE_MVCC_COLUMNAR=columnar,
+                                 STORAGE_MVCC_SEAL_OPS=16)
+            ss = StorageServer(k, 1, KeyRange(b"", b"\xff"), TLog(k))
+            servers.append(ss)
+        keys = _keys()
+        version = 0
+        for step in range(120):
+            version += rng.random_int(1, 3)
+            muts = []
+            for _ in range(rng.random_int(1, 6)):
+                r = rng.random_int(0, 10)
+                key = keys[rng.random_int(0, len(keys))]
+                if r < 5:
+                    muts.append(Mutation(MutationType.SET_VALUE, key,
+                                         b"v%d" % step))
+                elif r < 7:
+                    lo = rng.random_int(0, len(keys))
+                    hi = rng.random_int(lo, len(keys) + 1)
+                    muts.append(Mutation(
+                        MutationType.CLEAR_RANGE,
+                        keys[lo] if lo < len(keys) else b"z",
+                        keys[hi] if hi < len(keys) else b"z"))
+                elif r < 9:
+                    muts.append(Mutation(MutationType.ADD, key,
+                                         (step % 250).to_bytes(1, "little")))
+                else:
+                    muts.append(Mutation(MutationType.BYTE_MAX, key,
+                                         b"m%d" % step))
+            b = MutationBatchBuilder()
+            for m in muts:
+                b.add(m.type.value, m.param1, m.param2)
+            mb = b.finish()
+            for ss in servers:
+                ss._apply_batch([(version, mb)])
+            if rng.random_int(0, 5) == 0:
+                floor = version - rng.random_int(0, 8)
+                for ss in servers:
+                    ss.oldest_version = max(ss.oldest_version, floor)
+                    ss.vmap.forget_before(floor)
+            # byte-identical serving, in situ
+            col, leg = servers
+            for k2 in keys:
+                assert await col.get_value(k2, version) == \
+                    await leg.get_value(k2, version), (step, k2)
+            req = GetValuesRequest.from_keys(keys, version)
+            rc = await col.get_values(req)
+            rl = await leg.get_values(req)
+            assert [rc.unpack(i) for i in range(len(keys))] == \
+                [rl.unpack(i) for i in range(len(keys))], step
+            assert await col.get_key_values(b"", b"z", version) == \
+                await leg.get_key_values(b"", b"z", version), step
+        await asyncio.gather(*(s.stop() for s in servers))
+
+    asyncio.run(main())
+
+
+def test_durable_reopen_replays_into_columnar_window():
+    """kv_store/WAL replay touch: a durable engine-backed server in
+    columnar mode — applies drop below the floor into the engine, a
+    reopen replays the WAL, and reads above/below the floor stay
+    byte-identical to the legacy-window twin through the whole cycle."""
+    from foundationdb_tpu.core.data import KeyRange
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.storage.kv_store import MemoryKVStore
+
+    async def main():
+        results = {}
+        for columnar in (True, False):
+            fs = SimFileSystem()
+            k = Knobs().override(STORAGE_MVCC_COLUMNAR=columnar,
+                                 STORAGE_MVCC_SEAL_OPS=8)
+            eng = await MemoryKVStore.open(fs, "s0")
+            ss = StorageServer(k, 1, KeyRange(b"", b"\xff"), TLog(k),
+                               engine=eng)
+            b = MutationBatchBuilder()
+            for i in range(400):
+                b.add(0, b"r%04d" % i, b"v%d" % i)
+            ss._apply_batch([(100, b.finish())])
+            c = MutationBatchBuilder()
+            c.add(1, b"r0000", b"r0100")
+            ss._apply_batch([(200, c.finish())])
+            # migrate <=150 into the engine (drops the window below)
+            ops = await ss._dbuf.peek_through(150)
+            await eng.commit(ops, {"durable_version": 150, "tag": 1,
+                                   "shard": (b"", b"\xff"), "feeds": []})
+            await ss._dbuf.pop_through(150)
+            ss.durable_version = 150
+            ss.oldest_version = 150
+            ss.vmap.drop_before(150)
+            rows_live = await ss.get_key_values(b"", b"z", 200)
+            rows_old = await ss.get_key_values(b"", b"z", 150)
+            await eng.close()
+            # reopen: WAL replay rebuilds the engine byte-identically
+            eng2 = await MemoryKVStore.open(fs, "s0")
+            assert eng2.meta["durable_version"] == 150
+            assert eng2.get(b"r0001") == b"v1"
+            results[columnar] = (rows_live, rows_old)
+            await eng2.close()
+            await ss.stop()
+        assert results[True] == results[False]
+        rows_live, rows_old = results[True]
+        assert len(rows_live[0]) == 300     # the clear landed
+        assert len(rows_old[0]) == 400      # history below still serves
+
+    asyncio.run(main())
+
+
+def test_factory_and_stats_surfaces():
+    assert isinstance(VersionedMap(), ColumnarVersionedMap)
+    assert isinstance(VersionedMap(columnar=False), LegacyVersionedMap)
+    vm = VersionedMap(seal_ops=4)
+    vm.apply_packed(5, _mb(*[(0, b"k%d" % i, b"v") for i in range(8)]))
+    st = vm.index_stats()
+    for field in ("keys", "merges", "merge_ms", "segments", "entries",
+                  "resident_bytes", "seals"):
+        assert field in st, field
+    assert st["columnar"] is True
+    assert st["entries"] == 8
+    assert vm.nbytes > 0

@@ -114,6 +114,16 @@ def test_recover_torn_disk_smoke():
     perf_smoke.check_recover(budget_s=perf_smoke.RECOVER_BUDGET_S)
 
 
+def test_mvcc_window_smoke():
+    """The MVCC-window smoke (ISSUE 13): a 2M-key hot set HELD IN THE
+    WINDOW under both implementations in one process — byte-identical
+    get2_batch/range serving asserted in situ, the columnar
+    generational window at ≤50% of the legacy dict-of-chains RSS
+    overhead, and the combined apply_packed+get2_batch pipeline ≥2x
+    the legacy twin, under the standing hard wedge deadline."""
+    perf_smoke.check_mvcc(budget_s=perf_smoke.MVCC_BUDGET_S)
+
+
 def test_apply_metrics_surface():
     """The apply path must publish its observability counters — a silent
     regression is the other half of the r5 incident."""

@@ -210,6 +210,31 @@ def test_rotate_failover_skips_penalized_replica():
     run_simulation(main())
 
 
+def test_degraded_replicas_rank_last_under_every_policy():
+    """ISSUE 13 / ROADMAP 6 (a): a FailureMonitor-degraded replica (the
+    CC-published machine flag, stamped onto storage stubs by
+    cluster_client) is the LAST read choice under every spread policy —
+    a stable partition composing with rotate/least/score, exactly like
+    the penalty class — yet still serves when every healthy teammate
+    fails."""
+    async def main():
+        for policy in ("score", "rotate", "least"):
+            g, _log = _group(policy)
+            g.replicas[0].degraded = True
+            for i in range(12):
+                await g.get_value(b"k%d" % i, 1)
+            counts = g.spread_counts()
+            assert counts[0] == 0, (policy, counts)
+            assert counts[1] + counts[2] == 12, (policy, counts)
+            # the degraded replica is deprioritized, not excluded: with
+            # every healthy teammate failing it still serves the read
+            g.replicas[1].fail = True
+            g.replicas[2].fail = True
+            assert await g.get_value(b"k", 1) == b"v-k"
+            assert g.spread_counts()[0] == 1, policy
+    run_simulation(main())
+
+
 def test_least_policy_is_deterministic():
     async def main():
         g, log = _group("least")

@@ -74,6 +74,13 @@ def _child(path: str, mode: str = "default") -> None:
     # survive, and the trace is STILL bit-identical — every fault draw
     # comes from per-machine seeded streams, so hostile disks add
     # chaos, never nondeterminism.
+    # ISSUE 13: the columnar MVCC window is pinned at its default (ON)
+    # explicitly — the standing bit-identical children cover the
+    # columnar window serving every read; the "mvcc_on"/"mvcc_off"
+    # modes instead force the knob each way on DURABLE storage with a
+    # tiny seal budget and a tight version window, so seals, tiered
+    # compaction and whole-segment drops all run inside the
+    # bit-identical proof for BOTH implementations
     knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
                              RESOLVER_DEVICE_PIPELINE=True,
                              DD_SHARD_HEAT_SPLITS=False,
@@ -83,7 +90,8 @@ def _child(path: str, mode: str = "default") -> None:
                              STORAGE_DBUF_SPILL_BYTES=128 << 20,
                              SIM_DISK_FAULTS=False,
                              CC_DISK_HEALTH_INTERVAL=1.0,
-                             DISK_DEGRADED_LATENCY_MS=25.0)
+                             DISK_DEGRADED_LATENCY_MS=25.0,
+                             STORAGE_MVCC_COLUMNAR=True)
     durable = False
     if mode == "spill":
         knobs = knobs.override(STORAGE_DBUF_SPILL_BYTES=1,
@@ -96,6 +104,12 @@ def _child(path: str, mode: str = "default") -> None:
                                SIM_DISK_STALL_P=0.3,
                                SIM_DISK_STALL_MAX_S=0.01,
                                STORAGE_VERSION_WINDOW=100_000,
+                               STORAGE_DURABILITY_LAG=0.1)
+        durable = True
+    elif mode in ("mvcc_on", "mvcc_off"):
+        knobs = knobs.override(STORAGE_MVCC_COLUMNAR=(mode == "mvcc_on"),
+                               STORAGE_MVCC_SEAL_OPS=8,
+                               STORAGE_VERSION_WINDOW=1_000,
                                STORAGE_DURABILITY_LAG=0.1)
         durable = True
 
@@ -214,6 +228,27 @@ def test_same_seed_sim_trace_bit_identical_with_disk_faults_on(tmp_path):
         f"same-seed sim trace diverged with disk faults forced ON: "
         f"run a = {d1} ({n1} events, {f1} faults), run b = {d2} "
         f"({n2} events, {f2} faults)")
+
+
+def test_same_seed_sim_trace_bit_identical_mvcc_knob_both_ways(tmp_path):
+    """ISSUE 13 acceptance: a durable same-seed sim with the columnar
+    MVCC window forced ON (tiny seal budget — seals, tiered compaction
+    and whole-segment drops all run) must be bit-identical across fresh
+    processes, AND the same sim with the knob forced OFF (the legacy
+    dict-of-chains twin) must be too — the knob selects the
+    implementation outright, so each pair proves its own path."""
+    d1, n1, *_ = _run_child(tmp_path, "ma", mode="mvcc_on")
+    d2, n2, *_ = _run_child(tmp_path, "mb", mode="mvcc_on")
+    assert n1 > 100, f"trace suspiciously small ({n1} events)"
+    assert (d1, n1) == (d2, n2), (
+        f"same-seed sim trace diverged with the columnar MVCC window "
+        f"forced ON: run a = {d1} ({n1} events), run b = {d2} ({n2})")
+    d3, n3, *_ = _run_child(tmp_path, "mc", mode="mvcc_off")
+    d4, n4, *_ = _run_child(tmp_path, "md", mode="mvcc_off")
+    assert n3 > 100, f"trace suspiciously small ({n3} events)"
+    assert (d3, n3) == (d4, n4), (
+        f"same-seed sim trace diverged with the legacy MVCC window "
+        f"forced: run a = {d3} ({n3} events), run b = {d4} ({n4})")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,193 @@
+"""SlottedBlob (rpc/wire.py) — the shared dual-slot crc-framed persist
+(ISSUE 13, ROADMAP 6 (f)): the one audited corruption-policy mechanism
+the lsm MANIFEST, coordinator state and backup logs.manifest now ride.
+Site-level recovery behavior stays covered by their own suites
+(test_lsm / test_coordination / test_backup_feed / test_disk_faults);
+this file pins the helper's own invariants."""
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.rpc.wire import SlottedBlob
+from foundationdb_tpu.runtime.files import SimFileSystem
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_round_trip_and_alternation():
+    async def main():
+        fs = SimFileSystem()
+        sb = SlottedBlob(fs, "state")
+        payload, seen = await sb.load()
+        assert payload is None and seen == 0
+        await sb.save(b"one")
+        await sb.save(b"two")
+        await sb.save(b"three")
+        # both slot files populated: writes alternate
+        assert fs.open("state.a").size() > 0
+        assert fs.open("state.b").size() > 0
+        # a fresh reader sees the newest
+        sb2 = SlottedBlob(fs, "state")
+        payload, seen = await sb2.load()
+        assert payload == b"three" and seen == 2
+        # ...and continues the alternation (seq learned from load)
+        await sb2.save(b"four")
+        sb3 = SlottedBlob(fs, "state")
+        payload, _ = await sb3.load()
+        assert payload == b"four"
+
+    _run(main())
+
+
+def test_torn_slot_loses_to_intact_one():
+    async def main():
+        fs = SimFileSystem()
+        sb = SlottedBlob(fs, "state")
+        await sb.save(b"committed")
+        await sb.save(b"newer")
+        # find the slot holding "newer" and tear it (garbage bytes)
+        for suffix in (".a", ".b"):
+            f = fs.open("state" + suffix)
+            raw = await f.read(0, f.size())
+            try:
+                from foundationdb_tpu.rpc.wire import unframe
+                if unframe(raw)[len(SlottedBlob.MAGIC) + 8:] == b"newer":
+                    await f.write(0, b"\x00garbage\xff" * 4)
+                    await f.truncate(36)
+                    await f.sync()
+            finally:
+                await f.close()
+        payload, seen = await SlottedBlob(fs, "state").load()
+        assert payload == b"committed"      # the older intact slot wins
+        assert seen == 2                    # ...and the caller can see
+        #                                     both slots existed (its
+        #                                     none-decodes policy input)
+
+    _run(main())
+
+
+def test_both_slots_torn_reports_none_with_evidence():
+    async def main():
+        fs = SimFileSystem()
+        sb = SlottedBlob(fs, "state")
+        await sb.save(b"x")
+        await sb.save(b"y")
+        for suffix in (".a", ".b"):
+            f = fs.open("state" + suffix)
+            await f.write(0, b"junkjunkjunkjunk")
+            await f.truncate(16)
+            await f.sync()
+            await f.close()
+        payload, seen = await SlottedBlob(fs, "state").load()
+        # the helper NEVER guesses: payload None + slots_seen 2 is the
+        # evidence each site's corruption policy keys on
+        assert payload is None and seen == 2
+
+    _run(main())
+
+
+def test_failed_save_retries_same_slot():
+    """seq advances only after the sync: a save that dies mid-write
+    must re-target the SAME slot on retry, never the slot holding the
+    freshest synced state (the DiskQueue _write_header discipline)."""
+    async def main():
+        fs = SimFileSystem()
+        sb = SlottedBlob(fs, "state")
+        await sb.save(b"good")              # lands in one slot
+        good_slot = sb._slot(sb._seq)
+        victim = sb._slot(sb._seq + 1)      # where the next save goes
+
+        class Boom(Exception):
+            pass
+
+        real_open = fs.open
+        calls = {"n": 0}
+
+        def failing_open(path):
+            f = real_open(path)
+            if path == victim and calls["n"] == 0:
+                calls["n"] += 1
+
+                async def bad_write(off, data):
+                    raise Boom()
+                f.write = bad_write
+            return f
+
+        fs.open = failing_open
+        with pytest.raises(Boom):
+            await sb.save(b"torn")
+        fs.open = real_open
+        # the retry targets the SAME slot; the good slot is untouched
+        assert sb._slot(sb._seq + 1) == victim
+        await sb.save(b"retried")
+        payload, _ = await SlottedBlob(fs, "state").load()
+        assert payload == b"retried"
+        f = real_open(good_slot)
+        from foundationdb_tpu.rpc.wire import unframe
+        raw = unframe(await f.read(0, f.size()))
+        assert raw[len(SlottedBlob.MAGIC) + 8:] == b"good"
+        await f.close()
+
+    _run(main())
+
+
+def test_pre_helper_slot_format_is_not_misparsed():
+    """Migration guard: an ISSUE-12-era slot is ``frame(encode(dict))``
+    — it passes ``unframe``, and without the envelope magic its first 8
+    content bytes would parse as a garbage seq (~2.5e17) and the
+    mis-sliced remainder would come back as a "valid" payload, crashing
+    every caller's decode and making their legacy fallbacks
+    unreachable.  The helper must return None (with the slot counted in
+    the evidence) and leave the save seq unpoisoned."""
+    async def main():
+        from foundationdb_tpu.rpc.wire import encode, frame
+        fs = SimFileSystem()
+        old = frame(encode({"seq": 3, "r": [1, 1], "w": [2, 2],
+                            "v": b"state", "m": None}))
+        f = fs.open("state.a")
+        await f.write(0, old)
+        await f.sync()
+        await f.close()
+        sb = SlottedBlob(fs, "state")
+        payload, seen = await sb.load()
+        assert payload is None          # not ours to parse
+        assert seen == 1                # ...but it IS evidence
+        assert sb._seq == 0             # garbage seq must not poison
+        #                                 the alternation parity
+        # the caller's migration seeding (sb._seq = legacy seq) then
+        # steers the next save AWAY from the only valid old slot
+        sb._seq = 3
+        await sb.save(b"migrated")      # seq 4 -> slot .b
+        f = fs.open("state.a")
+        assert await f.read(0, f.size()) == old     # untouched
+        await f.close()
+        payload, _ = await SlottedBlob(fs, "state").load()
+        assert payload == b"migrated"
+
+    _run(main())
+
+
+def test_coordinator_recovers_pre_helper_slot():
+    """End-to-end migration: a coordinator restarting on a disk written
+    by the ISSUE-12-era dual-slot code must recover its committed
+    quorum state through the legacy fallback, not crash-loop on it."""
+    async def main():
+        from foundationdb_tpu.core.coordination import Coordinator
+        from foundationdb_tpu.rpc.wire import encode, frame
+        from foundationdb_tpu.runtime.knobs import Knobs
+        fs = SimFileSystem()
+        old = frame(encode({"seq": 3, "r": [1, 1], "w": [2, 2],
+                            "v": b"quorum-state", "m": None}))
+        f = fs.open("coord.a")
+        await f.write(0, old)
+        await f.sync()
+        await f.close()
+        co = await Coordinator.open(Knobs(), fs, "coord")
+        assert co.value == b"quorum-state"
+        assert co.write_gen == (2, 2)
+        assert co.max_read_gen == (1, 1)
+
+    _run(main())

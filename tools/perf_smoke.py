@@ -98,7 +98,14 @@ silently drops or resurrects an acked write — or a consumer that
 mistakes a torn tail for committed data — fails here at tier-1 cost,
 under the standing hard wedge deadline.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|all]
+ELEVENTH stage (``--stage mvcc``, ISSUE 13): the MVCC window itself at
+a 2M-key hot set — the columnar generational window (tip + sealed
+segments) against the legacy dict-of-chains twin in one process:
+byte-identical probe/range serving asserted in situ, the columnar
+window at <=50% of the legacy window's RSS overhead, and the combined
+apply_packed+get2_batch pipeline at >=2x.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -106,6 +113,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import math
 import os
 import sys
 import time
@@ -151,6 +159,13 @@ BIG_BUDGET_S = 420.0        # doubles as the hard wedge deadline
 BIG_RSS_PER_KEY = 40.0      # columnar index RSS overhead ceiling, B/key
 BIG_READ_KEYS = 4096        # point/multiget probes over the big keyspace
 BIG_SCAN_ROWS = 200_000     # packed-vs-legacy scan subrange
+MVCC_KEYS = 2_000_000       # hot set held in the MVCC window (ISSUE 13)
+MVCC_BUDGET_S = 300.0       # doubles as the hard wedge deadline
+MVCC_PIPELINE_FLOOR = 2.0   # columnar vs legacy apply+probe pipeline
+MVCC_RSS_RATIO_CEIL = 0.5   # columnar window RSS overhead vs legacy
+MVCC_PROBE_KEYS = 65_536    # get2_batch probes per side of the A/B
+MVCC_PROBE_BATCH = 1024     # probe batch size (the vectorized shape)
+MVCC_SCAN_ROWS = 100_000    # byte-identity range sweep
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -1483,11 +1498,16 @@ def _rss_bytes() -> int | None:
 
 def bigkeys_key_fn(n_keys: int):
     """The bigkeys keyspace: a hash-permuted arrival order over n_keys
-    distinct keys (the multiplier is odd and coprime to the row counts
-    both consumers use, so i -> key is a bijection).  Shared with
-    bench.py's `bigkeys` operating point — one definition of the
-    workload shape."""
+    distinct keys.  i -> key must be a BIJECTION, which needs the
+    multiplier coprime to n_keys — the base constant is divisible by 3,
+    so a user-supplied ``--*-keys`` divisible by 3 would silently
+    collapse the keyspace to n/3 distinct keys and fail the sweeps with
+    a misleading count assertion; bump to the next coprime odd instead
+    (a no-op for the default 2M counts).  Shared with bench.py's
+    `bigkeys` operating point — one definition of the workload shape."""
     mul = 1_315_423_911
+    while n_keys > 1 and math.gcd(mul, n_keys) != 1:
+        mul += 2
 
     def key(i: int) -> bytes:
         return b"big%012d" % ((i * mul) % n_keys)
@@ -1709,6 +1729,172 @@ def check_bigkeys(n_keys: int = BIG_KEYS, budget_s: float = BIG_BUDGET_S,
     return elapsed
 
 
+def mvcc_seconds(n_keys: int = MVCC_KEYS,
+                 deadline_s: float | None = None) -> tuple[float, dict]:
+    """The MVCC-window memory-wall smoke (ISSUE 13): a 2M-key hot set
+    HELD IN THE WINDOW (the engine-less forget shape — nothing ever
+    drops to an engine), built and probed under both window
+    implementations in one process.
+
+    Per side of the A/B: the same hash-permuted keyspace applied
+    through real packed ``MutationBatch`` batches (``apply_packed`` —
+    the TLog-pull fast path) with the engine-less compaction floor
+    ticking behind the applied tip (so columnar seals, tiered merges
+    and folds all run), RSS measured around the build, then
+    ``get2_batch`` probes at the batched-read shape.  Asserted in situ:
+    byte-identical probe results AND a range sweep, the columnar window
+    at <= ``MVCC_RSS_RATIO_CEIL`` of the legacy window's RSS overhead,
+    and the combined apply+probe pipeline at >=
+    ``MVCC_PIPELINE_FLOOR``x legacy.  The budget doubles as the hard
+    wedge deadline."""
+    import gc
+
+    from foundationdb_tpu.core.data import MutationBatchBuilder
+    from foundationdb_tpu.storage.versioned_map import VersionedMap
+
+    key = bigkeys_key_fn(n_keys)
+    raw_bytes = (len(key(0)) + 9) * n_keys      # key + b"v%08d" value
+
+    async def main() -> tuple[float, dict]:
+        t_all = time.perf_counter()
+        overhead: dict[bool, float | None] = {}
+        apply_s: dict[bool, float] = {}
+        probe_s: dict[bool, float] = {}
+        probe_results: dict[bool, list] = {}
+        sweep: dict[bool, tuple] = {}
+        stats_c: dict = {}
+        probes = sorted({key((i * 2654435761) % n_keys)
+                         for i in range(MVCC_PROBE_KEYS)})
+        for mode in (True, False):      # columnar first, then the twin
+            gc.collect()
+            r0 = _rss_bytes()
+            vm = VersionedMap(columnar=mode)
+            apply_s[mode] = 0.0
+            version = 0
+            for start in range(0, n_keys, 4096):
+                version += 1000
+                # batch assembly is untimed: both sides pay the same
+                # builder cost, and leaving it in the measurement only
+                # dilutes the window-vs-window ratio toward 1
+                mb = MutationBatchBuilder()
+                for i in range(start, min(start + 4096, n_keys)):
+                    mb.add(0, key(i), b"v%08d" % i)
+                batch = mb.finish()
+                t0 = time.perf_counter()
+                vm.apply_packed(version, batch)
+                if (start // 4096) % 64 == 63:
+                    # the engine-less floor trails the tip (forget
+                    # consumers tick every pull iteration)
+                    vm.forget_before(version - 500)
+                    apply_s[mode] += time.perf_counter() - t0
+                    await asyncio.sleep(0)  # keep the wedge deadline armed
+                else:
+                    apply_s[mode] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            vm.forget_before(version)
+            apply_s[mode] += time.perf_counter() - t0
+            gc.collect()
+            r1 = _rss_bytes()
+            overhead[mode] = ((r1 - r0 - raw_bytes) / n_keys
+                              if r0 is not None and r1 is not None
+                              else None)
+            t0 = time.perf_counter()
+            got: list = []
+            for s in range(0, len(probes), MVCC_PROBE_BATCH):
+                got.extend(vm.get2_batch(probes[s:s + MVCC_PROBE_BATCH],
+                                         version))
+            probe_s[mode] = time.perf_counter() - t0
+            probe_results[mode] = got
+            sweep[mode] = vm.range_rows(b"big%012d" % 0,
+                                        b"big%012d" % MVCC_SCAN_ROWS,
+                                        version)
+            if mode:
+                stats_c = vm.index_stats()
+            del vm
+            await asyncio.sleep(0)
+        assert probe_results[True] == probe_results[False], (
+            "columnar window probe results diverged from the legacy "
+            "twin — the A/B is not serving byte-identical data")
+        assert all(r[0] for r in probe_results[True]), "probe lost rows"
+        assert sweep[True] == sweep[False], (
+            "columnar range sweep diverged from the legacy twin")
+        assert len(sweep[True][0]) == MVCC_SCAN_ROWS
+        pipeline_c = apply_s[True] + probe_s[True]
+        pipeline_l = apply_s[False] + probe_s[False]
+        stats = {
+            "keys": n_keys,
+            "columnar_window_b_per_key":
+                round(overhead[True], 2) if overhead[True] is not None
+                else None,
+            "legacy_window_b_per_key":
+                round(overhead[False], 2) if overhead[False] is not None
+                else None,
+            "columnar_apply_keys_per_sec":
+                round(n_keys / apply_s[True], 1),
+            "legacy_apply_keys_per_sec":
+                round(n_keys / apply_s[False], 1),
+            "columnar_probe_keys_per_sec":
+                round(len(probes) / probe_s[True], 1),
+            "legacy_probe_keys_per_sec":
+                round(len(probes) / probe_s[False], 1),
+            "pipeline_ratio": round(pipeline_l / pipeline_c, 2),
+            "segments": stats_c.get("segments"),
+            "seals": stats_c.get("seals"),
+            "folds": stats_c.get("folds"),
+            "resident_bytes_per_key":
+                round(stats_c.get("resident_bytes", 0) / n_keys, 1),
+        }
+        return time.perf_counter() - t_all, stats
+
+    async def bounded():
+        return await asyncio.wait_for(main(), deadline_s)
+
+    try:
+        return asyncio.run(bounded())
+    except asyncio.TimeoutError:
+        raise AssertionError(
+            f"mvcc smoke wedged: the {deadline_s:.0f}s deadline hit — a "
+            f"seal, segment merge, fold, or probe that stopped making "
+            f"progress, not just slowness") from None
+
+
+def check_mvcc(n_keys: int = MVCC_KEYS, budget_s: float = MVCC_BUDGET_S,
+               quiet: bool = False) -> float:
+    """Run the MVCC-window smoke; raises AssertionError on divergence
+    from the legacy twin, past the RSS ratio ceiling, under the
+    pipeline floor, past the budget, or at the wedge deadline."""
+    elapsed, stats = mvcc_seconds(n_keys, deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] mvcc: {stats['keys']} keys — window "
+              f"{stats['columnar_window_b_per_key']} B/key vs legacy "
+              f"{stats['legacy_window_b_per_key']} B/key, apply "
+              f"{stats['columnar_apply_keys_per_sec']:.0f} vs "
+              f"{stats['legacy_apply_keys_per_sec']:.0f} keys/s, probe "
+              f"{stats['columnar_probe_keys_per_sec']:.0f} vs "
+              f"{stats['legacy_probe_keys_per_sec']:.0f} keys/s, "
+              f"pipeline {stats['pipeline_ratio']:.2f}x, "
+              f"{stats['segments']} segments / {stats['seals']} seals / "
+              f"{stats['folds']} folds")
+    assert elapsed < budget_s, (
+        f"mvcc smoke took {elapsed:.1f}s (budget {budget_s:.0f}s) — the "
+        f"columnar window grew a quadratic seal/merge/probe shape")
+    co = stats["columnar_window_b_per_key"]
+    lo = stats["legacy_window_b_per_key"]
+    if co is not None and n_keys >= 1_000_000:
+        # the ratio needs the full scale: below ~1M keys the deltas sit
+        # inside the allocator's noise floor (the bigkeys discipline)
+        assert co <= MVCC_RSS_RATIO_CEIL * lo, (
+            f"columnar window RSS overhead {co:.1f} B/key exceeds "
+            f"{MVCC_RSS_RATIO_CEIL:.0%} of the legacy window's "
+            f"{lo:.1f} B/key — the MVCC memory wall is back")
+    assert stats["pipeline_ratio"] >= MVCC_PIPELINE_FLOOR, (
+        f"columnar apply+probe pipeline only "
+        f"{stats['pipeline_ratio']:.2f}x the legacy window (floor "
+        f"{MVCC_PIPELINE_FLOOR:.0f}x) — the direct-seal apply path or "
+        f"the vectorized batched probe lost its edge")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
@@ -1716,7 +1902,7 @@ def main() -> int:
     ap.add_argument("--stage",
                     choices=("apply", "pipeline", "feed", "read",
                              "resolve", "heat", "backup", "scan",
-                             "bigkeys", "recover", "all"),
+                             "bigkeys", "recover", "mvcc", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -1731,6 +1917,8 @@ def main() -> int:
     ap.add_argument("--big-budget", type=float, default=BIG_BUDGET_S)
     ap.add_argument("--recover-budget", type=float,
                     default=RECOVER_BUDGET_S)
+    ap.add_argument("--mvcc-keys", type=int, default=MVCC_KEYS)
+    ap.add_argument("--mvcc-budget", type=float, default=MVCC_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -1752,6 +1940,8 @@ def main() -> int:
         check_bigkeys(args.big_keys, budget_s=args.big_budget)
     if args.stage in ("recover", "all"):
         check_recover(budget_s=args.recover_budget)
+    if args.stage in ("mvcc", "all"):
+        check_mvcc(args.mvcc_keys, budget_s=args.mvcc_budget)
     return 0
 
 
