@@ -1064,6 +1064,69 @@ def run_bigkeys_phase(quiet: bool) -> dict:
     return r
 
 
+def run_lsm_ingest_phase(quiet: bool) -> dict:
+    """LSM sustained-ingest operating point (ISSUE 14): the perf_smoke
+    ``--stage compact`` workload at bench scale, run on BOTH compaction
+    disciplines — leveled background (the default) vs monolithic
+    merge-all (the pre-ISSUE-14 twin) — with serving byte-identity
+    asserted in-stage.  Reports sustained ingest keys/s, write
+    amplification (compacted bytes / flushed bytes), the commit-path
+    p99/max, and read p99 DURING compaction (point probes interleaved
+    with the ingest, the latency a reader sees while the compactor
+    holds debt)."""
+    import asyncio
+
+    import foundationdb_tpu.storage.lsm as lsm_mod
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import perf_smoke
+
+    n_commits = 4000
+    commits, probes = perf_smoke.lsm_compact_commits(
+        n_commits, perf_smoke.COMPACT_KEYS_PER, 300_000)
+    probes = probes[:512]
+    saved = perf_smoke._lsm_compact_geometry(lsm_mod)
+
+    async def main() -> dict:
+        lev = await perf_smoke.lsm_ingest_side(True, commits, probes,
+                                               probe_every=100)
+        mono = await perf_smoke.lsm_ingest_side(False, commits, probes,
+                                                probe_every=100)
+        assert lev["got"] == mono["got"], (
+            "leveled point serving diverged from the monolithic twin")
+        assert lev["rows_sha"] == mono["rows_sha"], (
+            "leveled range serving diverged from the monolithic twin")
+        n_keys = n_commits * perf_smoke.COMPACT_KEYS_PER
+        return {
+            "lsm_ingest_commits": n_commits,
+            "lsm_ingest_rows": lev["n_rows"],
+            "lsm_ingest_keys_per_sec":
+                round(n_keys / lev["ingest_wall_s"], 1),
+            "lsm_ingest_keys_per_sec_monolithic":
+                round(n_keys / mono["ingest_wall_s"], 1),
+            "lsm_write_amp": lev["write_amp"],
+            "lsm_write_amp_monolithic": mono["write_amp"],
+            "lsm_commit_p99_ms": lev["commit_p99_ms"],
+            "lsm_commit_max_ms": lev["commit_max_ms"],
+            "lsm_commit_max_ms_monolithic": mono["commit_max_ms"],
+            "lsm_read_p99_ms_during_compaction": lev["read_p99_ms"],
+            "lsm_read_p99_ms_during_compaction_monolithic":
+                mono["read_p99_ms"],
+            "lsm_compactions": lev["compactions"],
+            "lsm_levels": lev["levels"],
+        }
+
+    try:
+        r = asyncio.run(main())
+    finally:
+        (lsm_mod._MEMTABLE_BYTES, lsm_mod._BLOCK_BYTES,
+         lsm_mod._MAX_RUNS) = saved
+    if not quiet:
+        print(f"[bench] lsm_ingest: {r}", file=sys.stderr)
+    return r
+
+
 def run_hot_shard_phase(quiet: bool) -> dict:
     """Hot-shard stage (ISSUE 7): sustained zipf-0.99 write+read skew
     against a LIVE cluster — the 6-machine simulated fleet running on
@@ -1744,6 +1807,15 @@ def main() -> int:
                 args.stage_timeout, out)
             if bk is not None:
                 out.update(bk)
+
+            # lsm sustained ingest (ISSUE 14): leveled-vs-monolithic
+            # compaction A/B at bench scale — write amp, commit-path
+            # tail, read p99 during compaction
+            li = call_bounded(
+                "lsm_ingest", lambda: run_lsm_ingest_phase(args.quiet),
+                args.stage_timeout, out)
+            if li is not None:
+                out.update(li)
 
             # hot-shard economics (ISSUE 7): a live heat split under
             # sustained zipf skew, with before/after read p99 and the

@@ -120,7 +120,7 @@ class Cluster:
             for r in range(rf):
                 tag = s * rf + r
                 engines[tag] = await engine_cls.open(
-                    fs, f"{data_dir}/storage-{tag}")
+                    fs, f"{data_dir}/storage-{tag}", knobs=knobs)
         epoch = max([t.version for t in tlogs]
                     + [e.meta.get("durable_version", 0)
                        for e in engines.values()] + [0]) + 1

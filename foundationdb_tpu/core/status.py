@@ -130,6 +130,30 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             default=0.0),
     }
 
+    # lsm compaction rollup (ISSUE 14): write amplification, compaction
+    # debt and commit-path stalls across the durable lsm engines — a
+    # compactor falling behind shows up as rising debt bytes, a merge
+    # leaking onto the commit path as a rising stall max, write amp
+    # regressing toward the monolithic O(keyspace) shape as a rising
+    # ratio — before any of them becomes a latency incident
+    lsm_metrics = [m for m in storage_metrics if "lsm_runs" in m]
+    ingest = sum(m.get("lsm_ingest_bytes", 0) for m in lsm_metrics)
+    compacted = sum(m.get("lsm_compact_bytes", 0) for m in lsm_metrics)
+    lsm_rollup = {
+        "engines": len(lsm_metrics),
+        "runs": sum(m.get("lsm_runs", 0) for m in lsm_metrics),
+        "compactions": sum(m.get("lsm_compactions", 0)
+                           for m in lsm_metrics),
+        "ingest_bytes": ingest,
+        "compact_bytes": compacted,
+        "write_amp": round(compacted / max(1, ingest), 3),
+        "compact_debt_bytes": sum(m.get("lsm_compact_debt_bytes", 0)
+                                  for m in lsm_metrics),
+        "compact_stall_ms": max(
+            (m.get("lsm_compact_stall_ms", 0.0) for m in lsm_metrics),
+            default=0.0),
+    }
+
     # change-feed rollup (ISSUE 4): the storage roles' feed retention +
     # stream counters, so a stuck consumer shows up as rising
     # feed_mem/spilled bytes and a dead one as a flat streams count —
@@ -351,6 +375,7 @@ async def cluster_status(knobs: Knobs, transport: Transport,
                 {"role": r["role"], "addr": r["addr"]}
                 for r in roles if not r["reachable"]],
             "storage_apply": apply_rollup,
+            "lsm_compaction": lsm_rollup,
             "change_feeds": feed_rollup,
             "resolver_device": resolver_device_rollup,
             "device_reads": device_reads_rollup,

@@ -249,6 +249,12 @@ class StorageServer:
                if self.engine is not None
                and getattr(self.engine, "fs", None) is not None
                and hasattr(self.engine.fs, "health") else {}),
+            # engine-side compaction observability (ISSUE 14): the lsm
+            # engine publishes write_amp / compact debt / commit-stall
+            # counters; other engines carry no metrics() surface
+            **(self.engine.metrics()
+               if self.engine is not None
+               and hasattr(self.engine, "metrics") else {}),
             **self.feeds.metrics(),
             **self.spans.counters(),
             **(self._device_reads.metrics()
@@ -308,6 +314,12 @@ class StorageServer:
                 except asyncio.CancelledError:
                     pass
                 setattr(self, attr, None)
+        if self.engine is not None:
+            # the engine may own a background task of its own (the lsm
+            # leveled compactor, ISSUE 14): a stopped role must not
+            # leave it writing to — or resurrecting — the role's files
+            # (stop_role(destroy=True) removes them right after)
+            await self.engine.close()
 
     # --- recovery (REF: storageserver.actor.cpp rollback + rejoin) ---
 

@@ -127,10 +127,15 @@ class Worker:
                 if blob:
                     eng_name = blob.decode(errors="replace")
             engine = await self._engine_cls(eng_name).open(
-                self.fs, f"{self.data_dir}/storage-{tag}")
+                self.fs, f"{self.data_dir}/storage-{tag}",
+                knobs=self.knobs)
             meta = engine.meta
             if "shard" not in meta:
-                continue     # never completed a durability tick: useless
+                # never completed a durability tick: useless — close it
+                # (the WAL handle, and any engine-owned background task)
+                # rather than abandoning it open every reboot
+                await engine.close()
+                continue
             shard = KeyRange(bytes(meta["shard"][0]), bytes(meta["shard"][1]))
             ls = LogSystem([LogGeneration(epoch=0, begin_version=0,
                                           tlogs=[], replication=1)])
@@ -239,7 +244,8 @@ class Worker:
             await mf.sync()
             await mf.close()
             obj.engine = await self._engine_cls(eng_name).open(
-                self.fs, f"{self.data_dir}/storage-{params['tag']}")
+                self.fs, f"{self.data_dir}/storage-{params['tag']}",
+                knobs=self.knobs)
             # durable change-feed side queue (spilled retention segments
             # survive reboots; a fresh recruit starts empty — the
             # leftover cleanup above removed any stale .feeds.dq)

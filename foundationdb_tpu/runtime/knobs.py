@@ -163,6 +163,32 @@ class Knobs:
     STORAGE_MVCC_SEAL_BYTES: int = 4 << 20
     STORAGE_MVCC_SEAL_VERSIONS: int = 4_000_000
 
+    # --- leveled lsm compaction (ISSUE 14, ROADMAP item 5 (d)) ---
+    # the lsm engine's compaction as a leveled, partitioned, budget-
+    # sliced BACKGROUND subsystem: L0 holds overlapping flush runs; L1+
+    # hold key-range-disjoint partitioned runs, so one compaction
+    # rewrites only the selected runs plus the OVERLAPPING next-level
+    # partitions — write amplification drops from O(keyspace) per cycle
+    # to O(overlap), and commit() never awaits a merge (it only nudges
+    # the background compactor).  Off = the pre-ISSUE-14 monolithic
+    # merge-every-run-into-one, awaited inline from commit(), kept
+    # verbatim as the equivalence / write-amp A/B twin (the
+    # STORAGE_MVCC_COLUMNAR pattern).  Both modes serve byte-identical
+    # data (tests/test_lsm_leveled.py proves it on randomized op
+    # streams) and either mode opens the other's MANIFEST.
+    LSM_LEVELED_COMPACTION: bool = True
+    # input bytes one compaction slice processes before yielding the
+    # event loop (the budget that keeps a background merge from
+    # stalling commits sharing the loop).  Sized for single-digit-ms
+    # slices at Python merge speed: a commit awaiting the WAL between
+    # two slices waits at most one slice, so this IS the compaction
+    # tail the commit path can see (perf_smoke --stage compact bounds
+    # it at ≤20% of the monolithic twin's worst inline merge)
+    LSM_COMPACT_SLICE_BYTES: int = 128 << 10
+    # level capacity multiplier: level i >= 1 holds FANOUT**(i-1) x the
+    # L0-equivalent byte budget before its fullness scores a compaction
+    LSM_LEVEL_FANOUT: int = 8
+
     # --- device read serving (ISSUE 6) ---
     # serve get_values' missing-key pass (the keys the MVCC window does
     # not resolve) through a device-resident mirror of the engine's

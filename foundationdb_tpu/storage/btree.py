@@ -76,7 +76,9 @@ class BTreeKVStore:
         return f"{self.prefix}.head{slot}"
 
     @classmethod
-    async def open(cls, fs, prefix: str) -> "BTreeKVStore":
+    async def open(cls, fs, prefix: str, knobs=None) -> "BTreeKVStore":
+        # ``knobs`` accepted for engine-factory uniformity (the lsm
+        # engine keys its compaction mode on it); unused here
         kv = cls(fs, prefix)
         best = None
         for slot in (0, 1):

@@ -104,7 +104,16 @@ class DiskQueue:
         q = cls(file)
         size = file.size()
         durable = _HEADER_SIZE
-        if size >= _HEADER_SIZE:
+        if size > 0:
+            # the header slots are read whenever ANY bytes exist — not
+            # only past the full header page.  A file shorter than the
+            # header page whose surviving slot records a durable
+            # frontier is a LENGTH regression of the header page itself
+            # (truncation of committed state, which a torn kill can
+            # never produce: synced bytes are untouchable) — the frame
+            # scan below then finds the frontier unreachable and raises
+            # DiskCorrupt instead of silently re-initializing the queue
+            # (ROADMAP 6 (d))
             best = cls._read_best_header(await file.read(0, 2 * _SLOT))
             if best is not None:
                 gen, front, meta, synced = best

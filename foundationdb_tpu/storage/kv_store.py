@@ -51,7 +51,9 @@ class MemoryKVStore:
     # --- lifecycle ---
 
     @classmethod
-    async def open(cls, fs, prefix: str) -> "MemoryKVStore":
+    async def open(cls, fs, prefix: str, knobs=None) -> "MemoryKVStore":
+        # ``knobs`` accepted for engine-factory uniformity (the lsm
+        # engine keys its compaction mode on it); unused here
         kv = cls(fs, prefix)
         # newest complete snapshot wins; exact "<prefix>.snap." match so
         # "storage-1" never picks up "storage-10"'s snapshots

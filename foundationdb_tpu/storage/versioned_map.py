@@ -93,6 +93,12 @@ _SEG_CAP = 12              # live segments before adjacent pairs merge
 _FOLD_MIN_SEGS = 2         # wholly-below-floor segments before a fold
 _BATCH_MIN = 16            # below this, batched probes fall back to bisect
 _RANGE_WINDOW = 4096       # candidate keys per layer per range-walk step
+_SMALL_PROBE_BATCH = 64    # point-probe batches at or under this ride the
+#                            per-key recent-hit cache (ISSUE 14 satellite,
+#                            ROADMAP 5 (e)) instead of the per-segment
+#                            vectorized probe — the transient-KeyRun setup
+#                            cost only amortizes at larger batches
+_PROBE_CACHE_CAP = 1 << 17  # recent-hit cache entries before a reset
 
 
 def VersionedMap(columnar: bool = True, seal_ops: int = SEAL_OPS,
@@ -774,6 +780,20 @@ class ColumnarVersionedMap:
         # ties at layer boundaries resolved by layer)
         self._segments: list[_Segment] = []
         self._sealed_through: Version = 0
+        # recent-hit probe cache (ISSUE 14 satellite, ROADMAP 5 (e)):
+        # key -> (version, value, found) — the key's NEWEST sealed
+        # entry (or found=False for a key certified to live in no
+        # segment) — so a repeat point probe against a multi-segment
+        # window resolves at the legacy dict-hit shape: tip miss,
+        # cache hit, done.  Entries are recorded only from walks that
+        # skipped NO newer segment (a version-filtered walk cannot
+        # certify the newest entry), answer only bounds at-or-above
+        # the cached version, and the whole cache clears on ANY
+        # segment-list change (seal/merge/fold/drop/rollback); newer
+        # TIP writes need no invalidation — the tip probe runs first
+        # and shadows the cache exactly when it should.
+        self._probe_cache: dict[bytes, tuple[Version, bytes | None,
+                                             bool]] = {}
         # observability
         self.seals = 0
         self.compactions = 0
@@ -866,15 +886,56 @@ class ColumnarVersionedMap:
         r = self._resolve_tip(key, version)
         if r is not None:
             return self._finish(key, r[0], r[1])
+        return self._get2_sealed(key, version)
+
+    def _get2_sealed(self, key: bytes, version: Version
+                     ) -> tuple[bool, bytes | None]:
+        """``get2`` below the tip (probe cache, then the segment walk)
+        — the entry point for callers that already know the tip missed
+        (the small-batch fast path), so the tip dict probe is not paid
+        twice per key."""
+        hint = self._probe_cache.get(key)
+        if hint is not None:
+            ver, val, found = hint
+            if not found:
+                # clean-walk-certified: the key lives in NO segment
+                return False, None
+            if version >= ver:
+                # the cached entry is the key's newest sealed entry and
+                # the bound clears it: the answer, at dict-hit cost
+                # (_finish re-applies the CURRENT floor/dead rules —
+                # they move without touching the segment list)
+                return self._finish(key, ver, val)
+            # bound below the newest sealed entry: rare — full walk
+        clean = True        # no newer segment skipped or unresolved yet
         for seg in self._segments:
             if seg.min_version > version:
+                clean = False   # a version-filtered walk cannot certify
+                #                 the newest sealed entry for any key
                 continue
             j = seg.find(key)
             if j < 0:
                 continue
             r = seg.resolve(j, version)
             if r is not None:
+                if clean:
+                    nv, nval = seg.newest(j)
+                    if r[0] == nv:
+                        # resolved the newest entry of the key's newest
+                        # holding segment == its newest sealed entry
+                        if len(self._probe_cache) >= _PROBE_CACHE_CAP:
+                            self._probe_cache.clear()
+                        self._probe_cache[key] = (nv, nval, True)
                 return self._finish(key, r[0], r[1])
+            clean = False   # the band sits wholly above the bound: an
+            #                 older layer may answer, but not with the
+            #                 key's newest sealed entry
+        if clean:
+            # a clean full walk proves the key is in NO segment: cache
+            # the negative so repeat misses skip the walk outright
+            if len(self._probe_cache) >= _PROBE_CACHE_CAP:
+                self._probe_cache.clear()
+            self._probe_cache[key] = (0, None, False)
         return False, None
 
     def get2_batch(self, keys: list[bytes],
@@ -905,6 +966,35 @@ class ColumnarVersionedMap:
         if not pending or not self._segments:
             for i in pending:
                 out[i] = (False, None)
+            return out  # type: ignore[return-value]
+        if n <= _SMALL_PROBE_BATCH:
+            # small point-probe batches (ISSUE 14 satellite, ROADMAP
+            # 5 (e)): the per-segment vectorized probe's transient-
+            # KeyRun setup swamps ≤64-key batches — ride the per-key
+            # recent-hit cache instead.  The hit path is inlined (one
+            # cache dict get + the floor rules), so a warm repeat
+            # probe resolves at the legacy dict-hit shape; only cache
+            # misses and below-newest version bounds pay a walk.
+            cache = self._probe_cache
+            drop = self._drop_floor
+            dead = self._dead
+            for i in pending:
+                key = keys[i]
+                hint = cache.get(key)
+                if hint is None:
+                    # pending ⇒ the tip already missed: walk below it
+                    out[i] = self._get2_sealed(key, version)
+                    continue
+                ver, val, found = hint
+                if not found or ver <= drop:
+                    out[i] = (False, None)
+                elif version < ver:
+                    out[i] = self._get2_sealed(key, version)
+                elif dead and (d := dead.get(key)) is not None \
+                        and ver <= d:
+                    out[i] = (False, None)
+                else:
+                    out[i] = (True, val)
             return out  # type: ignore[return-value]
         # a sorted probe list (the wire contract of the multiget path)
         # unlocks the fully-vectorized run-vs-run probe: the probe keys
@@ -1486,6 +1576,7 @@ class ColumnarVersionedMap:
         seg = _Segment(KeyRun.from_keys(dkeys), counts, versions,
                        vstarts, vends, blob, version, version)
         self._segments.insert(0, seg)
+        self._probe_cache.clear()
         self._sealed_through = version
         self.latest_version = version
         self.seals += 1
@@ -1516,6 +1607,7 @@ class ColumnarVersionedMap:
         seg = b.finish()
         if seg is not None:
             self._segments.insert(0, seg)
+            self._probe_cache.clear()
             self._sealed_through = max(self._sealed_through,
                                        seg.max_version)
         self._tip = {}
@@ -1662,6 +1754,7 @@ class ColumnarVersionedMap:
             segs[bi:bi + 2] = [merged] if merged is not None else []
             did += 1
         if did:
+            self._probe_cache.clear()
             self.compactions += did
             self.seal_s += time.perf_counter() - t0
 
@@ -1712,6 +1805,7 @@ class ColumnarVersionedMap:
                 if acc is not None:
                     keep.append(acc)
                 self._segments = keep
+                self._probe_cache.clear()
                 self.folds += 1
                 self.seal_s += time.perf_counter() - t0
         self._retire_markers()
@@ -1753,8 +1847,10 @@ class ColumnarVersionedMap:
         if self._tip and self._tip_min is not None \
                 and version >= self._tip_min:
             self._seal_tip()
-        self._segments = [s for s in self._segments
-                          if s.max_version > version]
+        keep = [s for s in self._segments if s.max_version > version]
+        if len(keep) != len(self._segments):
+            self._segments = keep
+            self._probe_cache.clear()
 
     def rollback_after(self, version: Version) -> None:
         """Discard every entry newer than ``version`` (storage rejoin):
@@ -1822,5 +1918,6 @@ class ColumnarVersionedMap:
             else:
                 segs.append(s)
         self._segments = segs
+        self._probe_cache.clear()
         if self._sealed_through > version:
             self._sealed_through = version

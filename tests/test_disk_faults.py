@@ -136,6 +136,47 @@ def test_disk_queue_torn_tail_still_discards_silently():
     run_simulation(main())
 
 
+def test_disk_queue_truncated_header_page_raises_loudly():
+    """ROADMAP 6 (d): a LENGTH regression of the header page itself —
+    the file cut below the 4KB header page while a surviving header
+    slot records committed frames — must raise DiskCorrupt, never
+    silently re-init the queue.  A torn kill can never shorten synced
+    bytes, so this shape is always external damage."""
+    async def main():
+        fs = SimFileSystem()
+        q, _ = await DiskQueue.open(fs.open("q"))
+        for i in range(3):
+            await q.push(b"committed-%d" % i * 10)
+            await q.commit()
+        await q.commit()            # frontier covers every frame
+        # cut the file to 600 bytes: both 512B-strided header slots
+        # survive (44B each at offsets 0 and 512) but every committed
+        # frame past the header page is gone
+        del fs.disks["q"][600:]
+        with pytest.raises(DiskCorrupt):
+            await DiskQueue.open(fs.open("q"))
+    run_simulation(main())
+
+
+def test_disk_queue_short_fresh_file_still_reinits():
+    """The length-regression check must NOT fire on a legitimately
+    short file: a kill tearing the very first header write (no durable
+    frontier ever recorded) still recovers as an empty queue."""
+    async def main():
+        fs = SimFileSystem()
+        q, _ = await DiskQueue.open(fs.open("q"))
+        del q
+        # never synced: the kill may shorten or destroy the header page
+        fs.kill_unsynced()
+        q2, frames = await DiskQueue.open(fs.open("q"))
+        assert frames == []
+        await q2.push(b"fresh")
+        await q2.commit()
+        _, frames2 = await DiskQueue.open(fs.open("q"))
+        assert [p for p, _ in frames2] == [b"fresh"]
+    run_simulation(main())
+
+
 def test_disk_queue_read_frames_raises_on_corrupt_live_frame():
     async def main():
         fs = SimFileSystem()

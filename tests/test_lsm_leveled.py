@@ -1,0 +1,398 @@
+"""Leveled, budgeted, background LSM compaction (ISSUE 14).
+
+The lsm engine's compaction rebuilt as a leveled, partitioned,
+budget-sliced background subsystem behind knob
+``LSM_LEVELED_COMPACTION`` (ROADMAP item 5 (d)): L0 holds overlapping
+flush runs, L1+ hold key-range-disjoint partitions, one compaction
+rewrites only its slice plus the OVERLAPPING next-level partitions, and
+``commit()`` never awaits a merge.  What this file proves:
+
+- randomized leveled-vs-monolithic EQUIVALENCE: the same seeded op
+  stream (sets, range clears, re-sets — tombstones crossing levels)
+  serves byte-identically on both twins via ``get``/``get_batch``/
+  ``range_runs``, DURING compaction, after a full drain, and after a
+  reopen;
+- the L1+ level invariants hold after every drain (span-disjoint,
+  span-sorted partitions);
+- crash-mid-compaction under ``DiskFaultProfile`` torn+corrupt kills
+  swept across the compaction timeline (between run write, manifest,
+  and input removal) recovers to a valid run set serving exactly the
+  acked data — in either crash direction — and the orphan sweep leaves
+  no unnamed run files behind;
+- a PRE-leveled MANIFEST (no per-run levels) opens as all-L0, serves,
+  and compacts in place — a pre-PR disk upgrades transparently;
+- a reopened store with inherited run debt starts compacting without
+  waiting for the next memtable overflow (the decoupled trigger).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from foundationdb_tpu.rpc.wire import decode, encode
+from foundationdb_tpu.runtime.files import DiskFaultProfile, SimFileSystem
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.rng import DeterministicRandom
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.storage.lsm import LSMKVStore
+
+import foundationdb_tpu.storage.lsm as lsm_mod
+
+
+@pytest.fixture(autouse=True)
+def small_lsm(monkeypatch):
+    """Tier-1-sized geometry: tiny memtable/blocks so flushes, leveled
+    merges, trivial moves and multi-level spill all run in seconds."""
+    monkeypatch.setattr(lsm_mod, "_MEMTABLE_BYTES", 6000)
+    monkeypatch.setattr(lsm_mod, "_BLOCK_BYTES", 1024)
+    monkeypatch.setattr(lsm_mod, "_MAX_RUNS", 3)
+
+
+def _knobs(leveled: bool) -> Knobs:
+    # a small slice budget so merges actually hit their yield points
+    return Knobs().override(LSM_LEVELED_COMPACTION=leveled,
+                            LSM_COMPACT_SLICE_BYTES=4096,
+                            LSM_LEVEL_FANOUT=4)
+
+
+def _op_stream(seed: int, n_commits: int, keyspace: int):
+    """Seeded commit batches: sets with varied value sizes, ~5% range
+    clears (tombstones that must cross levels correctly), re-sets of
+    cleared keys."""
+    rng = random.Random(seed)
+    commits = []
+    for _ in range(n_commits):
+        batch = []
+        for _ in range(rng.randrange(8, 40)):
+            if rng.random() < 0.05:
+                lo = rng.randrange(keyspace)
+                hi = min(keyspace, lo + rng.randrange(1, keyspace // 8))
+                batch.append((1, b"k%06d" % lo, b"k%06d" % hi))
+            else:
+                k = b"k%06d" % rng.randrange(keyspace)
+                batch.append((0, k, bytes([rng.randrange(256)])
+                              * rng.randrange(1, 80)))
+        commits.append(batch)
+    return commits
+
+
+def _probes(keyspace: int, fmt: bytes = b"k%06d") -> list[bytes]:
+    return sorted(fmt % i for i in range(0, keyspace, 7))
+
+
+def _snapshot(kv, keyspace: int, fmt: bytes = b"k%06d"):
+    """The full serving surface: batched points + flattened range runs
+    (bytes-normalized so block-aliasing differences can't mask or fake
+    a divergence)."""
+    got = kv.get_batch(_probes(keyspace, fmt))
+    assert any(g is not None for g in got), (
+        "every point probe missed — the probe format does not match "
+        "the keys this test writes")
+    rows = [(bytes(k), bytes(v))
+            for run in kv.range_runs(b"", b"\xff\xff")
+            for k, v in run]
+    return got, rows
+
+
+def _check_level_invariants(kv) -> None:
+    """L0 is anything; every deeper level must be span-sorted and
+    span-disjoint — the property that lets a compaction select only
+    the overlapping partitions."""
+    for lvl, runs in enumerate(kv._levels[1:], start=1):
+        for a, b in zip(runs, runs[1:]):
+            assert a.first_key() <= b.first_key(), \
+                f"L{lvl} partitions out of span order"
+            assert a.last_key() < b.first_key(), \
+                f"L{lvl} partitions overlap: {a.path} vs {b.path}"
+        for r in runs:
+            assert r.level == lvl
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_leveled_vs_monolithic_equivalence_randomized(seed):
+    """Same op stream → byte-identical get/get_batch/range_runs on both
+    twins: sampled DURING compaction (mid-stream, debt outstanding),
+    after a drain, and after a reopen."""
+    keyspace = 3000
+    commits = _op_stream(seed, n_commits=120, keyspace=keyspace)
+
+    async def ingest(leveled: bool):
+        fs = SimFileSystem()
+        kv = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(leveled))
+        mid = []
+        for i, batch in enumerate(commits):
+            await kv.commit(batch, {"durable_version": i + 1})
+            if i % 37 == 36:
+                # serving must be correct WHILE the background
+                # compactor holds debt — no drain before sampling
+                mid.append(_snapshot(kv, keyspace))
+        if leveled:
+            await kv.wait_compaction_idle()
+            _check_level_invariants(kv)
+        final = _snapshot(kv, keyspace)
+        metrics = kv.metrics()      # before close: reopen resets counters
+        await kv.close()
+        kv2 = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(leveled))
+        reopened = _snapshot(kv2, keyspace)
+        await kv2.close()
+        return mid, final, reopened, metrics
+
+    async def main():
+        mid_l, fin_l, re_l, m_l = await ingest(True)
+        mid_m, fin_m, re_m, m_m = await ingest(False)
+        assert mid_l == mid_m, "mid-ingest serving diverged"
+        assert fin_l == fin_m, "post-drain serving diverged"
+        assert re_l == re_m, "post-reopen serving diverged"
+        assert fin_l == re_l, "reopen changed the leveled twin's data"
+        assert m_l["lsm_leveled"] and not m_m["lsm_leveled"]
+        assert m_l["lsm_compactions"] > 0, (
+            "the leveled compactor never ran — this test proved nothing")
+
+    run_simulation(main(), seed=seed)
+
+
+def test_tombstones_crossing_levels_and_bottom_drop():
+    """A key set, pushed to a deep level, then cleared: the tombstone
+    must shadow it from every read while deeper levels still hold the
+    value, survive a reopen, and drop only once it reaches the deepest
+    level."""
+    async def main():
+        fs = SimFileSystem()
+        kv = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(True))
+        v = 0
+        # phase 1: build a multi-level keyspace holding victim keys
+        for i in range(40):
+            v += 1
+            await kv.commit(
+                [(0, b"t%05d" % (j % 600), b"old" * 10)
+                 for j in range(i * 17, i * 17 + 25)],
+                {"durable_version": v})
+        await kv.wait_compaction_idle()
+        assert len(kv._levels) > 1, "keyspace never left L0"
+        assert kv.get(b"t%05d" % 5) is not None
+        # phase 2: clear a band, then re-set part of it
+        v += 1
+        await kv.commit([(1, b"t%05d" % 100, b"t%05d" % 300)],
+                        {"durable_version": v})
+        for k in range(100, 300):
+            assert kv.get(b"t%05d" % k) is None, "tombstone not serving"
+        v += 1
+        await kv.commit([(0, b"t%05d" % 150, b"resurrected")],
+                        {"durable_version": v})
+        # push the tombstones down through the levels
+        for i in range(40):
+            v += 1
+            await kv.commit(
+                [(0, b"u%05d" % j, b"pad" * 10)
+                 for j in range(i * 25, i * 25 + 25)],
+                {"durable_version": v})
+        await kv.wait_compaction_idle()
+        def check(kv):
+            for k in range(100, 300):
+                want = b"resurrected" if k == 150 else None
+                assert kv.get(b"t%05d" % k) == want
+            rows = {bytes(r[0]) for run in kv.range_runs(b"t", b"u")
+                    for r in run}
+            assert b"t%05d" % 99 in rows
+            assert b"t%05d" % 150 in rows
+            assert b"t%05d" % 200 not in rows
+        check(kv)
+        await kv.close()
+        kv2 = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(True))
+        check(kv2)
+        await kv2.close()
+    run_simulation(main())
+
+
+@pytest.mark.parametrize("kill_yields", [1, 3, 7, 15, 40, 1000])
+def test_crash_mid_compaction_recovers(kill_yields):
+    """Torn+corrupt kills swept across the compaction timeline: the
+    budget-sliced compactor yields the loop every few KB of merged
+    input, so killing after N loop yields cuts it mid-run-write,
+    around a manifest install, or (N large) after a full drain.  At
+    every cut a fresh open serves exactly the acked data, then drains
+    the inherited debt and STILL serves it (the decoupled reopen
+    trigger), with no unnamed run files left behind."""
+    async def main():
+        prof = DiskFaultProfile()
+        prof.arm(DeterministicRandom(kill_yields), torn_p=1.0,
+                 corrupt_p=1.0, sector=512)
+        fs = SimFileSystem(profile=prof)
+        kv = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(True))
+        expected: dict[bytes, bytes] = {}
+        rng = random.Random(99)
+        v = 0
+        for i in range(60):
+            v += 1
+            batch = []
+            for _ in range(20):
+                k = b"c%05d" % rng.randrange(800)
+                val = bytes([rng.randrange(256)]) * rng.randrange(1, 60)
+                batch.append((0, k, val))
+                expected[k] = val
+            await kv.commit(batch, {"durable_version": v})
+        # the compactor is mid-flight (commit() only nudges): each
+        # sleep(0) hands it one slice-budget of progress, then the
+        # plug gets pulled.  Tear the unsynced bytes FIRST and copy
+        # the dead disk before anything else runs — the abandoned
+        # task's cancellation cleanup then touches only the old
+        # (post-mortem-irrelevant) filesystem, the way a real crash
+        # gives a dying process no say over the surviving platter.
+        for _ in range(kill_yields):
+            await asyncio.sleep(0)
+        fs.kill_unsynced()              # torn + corrupted unsynced bytes
+        fs2 = SimFileSystem()
+        fs2.disks = {p: bytearray(b) for p, b in fs.disks.items()}
+        kv._closed = True
+        t = kv._compact_task
+        if t is not None and not t.done():
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+        kv2 = await LSMKVStore.open(fs2, "db/lsm", knobs=_knobs(True))
+        keys = sorted(expected)
+        def check(kv_):
+            got = kv_.get_batch(keys)
+            for k, g in zip(keys, got):
+                assert g == expected[k], f"lost/garbled acked key {k!r}"
+            rows = [(bytes(r[0]), bytes(r[1]))
+                    for run in kv_.range_runs(b"", b"\xff")
+                    for r in run]
+            assert rows == [(k, expected[k]) for k in keys]
+        check(kv2)
+        # the orphan sweep reclaimed every file the manifest does not
+        # name — in BOTH crash directions
+        live = {r.path for r in kv2._runs}
+        assert set(fs2.listdir("db/lsm.run.")) == live
+        # inherited debt drains without any new commit arriving
+        await kv2.wait_compaction_idle()
+        check(kv2)
+        _check_level_invariants(kv2)
+        await kv2.close()
+    run_simulation(main(), seed=11)
+
+
+def test_orphan_run_files_swept_at_open():
+    """The kill-between-manifest-and-input-removal direction, staged
+    exactly: run files the manifest does not name (a compaction's
+    inputs the dying process never removed, or outputs it never named)
+    are swept at open and never affect serving."""
+    async def main():
+        fs = SimFileSystem()
+        kv = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(True))
+        rng = random.Random(3)
+        v = 0
+        for i in range(30):
+            v += 1
+            await kv.commit(
+                [(0, b"o%05d" % rng.randrange(400), b"y" * 45)
+                 for _ in range(20)],
+                {"durable_version": v})
+        await kv.wait_compaction_idle()
+        want = _snapshot(kv, 400, b"o%05d")
+        await kv.close()
+        # plant orphans: a stale duplicate of a live run under an
+        # unnamed path (removal never ran) and a torn garbage file
+        # (output never named)
+        live = fs.listdir("db/lsm.run.")
+        fs.disks["db/lsm.run.99999990"] = bytearray(fs.disks[live[0]])
+        fs.disks["db/lsm.run.99999991"] = bytearray(b"\x00" * 64)
+        kv2 = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(True))
+        assert _snapshot(kv2, 400, b"o%05d") == want
+        assert set(fs.listdir("db/lsm.run.")) == \
+            {r.path for r in kv2._runs}, "orphans not swept"
+        await kv2.close()
+    run_simulation(main())
+
+
+def test_pre_leveled_manifest_opens_serves_and_compacts():
+    """A MANIFEST written before ISSUE 14 carries no per-run levels:
+    it must open with every run in L0 (the monolithic twin's shape),
+    serve byte-identically, and compact in place from there."""
+    async def main():
+        fs = SimFileSystem()
+        # build real multi-run state with the MONOLITHIC twin — the
+        # trigger parked sky-high so enough runs persist that the
+        # leveled open inherits REAL debt — then strip the manifest
+        # down to the pre-PR schema
+        lsm_mod._MAX_RUNS = 99
+        try:
+            kv = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(False))
+            rng = random.Random(5)
+            v = 0
+            for i in range(60):
+                v += 1
+                await kv.commit(
+                    [(0, b"m%05d" % rng.randrange(2000),
+                      bytes([rng.randrange(256)]) * 40)
+                     for _ in range(20)],
+                    {"durable_version": v})
+        finally:
+            lsm_mod._MAX_RUNS = 3
+        want, rows = _snapshot(kv, 2000, b"m%05d")
+        n_runs = len(kv._runs)
+        assert n_runs > 1, "need a multi-run manifest for this test"
+        payload, _found = await kv._man_sb.load()
+        man = decode(payload)
+        assert "levels" in man
+        del man["levels"]               # the pre-ISSUE-14 schema
+        await kv._man_sb.save(encode(man))
+        await kv.close()
+
+        kv2 = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(True))
+        assert [r.level for r in kv2._runs] == [0] * n_runs, (
+            "pre-leveled manifest did not load as all-L0")
+        assert _snapshot(kv2, 2000, b"m%05d") == (want, rows)
+        # the all-L0 debt is picked up by the open() nudge and
+        # partitions into the leveled shape in place
+        await kv2.wait_compaction_idle()
+        assert _snapshot(kv2, 2000, b"m%05d") == (want, rows)
+        _check_level_invariants(kv2)
+        assert kv2.metrics()["lsm_compactions"] > 0
+        await kv2.close()
+        # ...and the upgraded manifest round-trips back into either mode
+        kv3 = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(False))
+        assert _snapshot(kv3, 2000, b"m%05d") == (want, rows)
+        await kv3.close()
+    run_simulation(main())
+
+
+def test_reopened_store_with_inherited_debt_compacts_without_commit():
+    """The decoupled trigger (ISSUE 14 satellite): run debt inherited
+    through a reopen starts draining from open() itself — no commit,
+    no memtable overflow needed."""
+    async def main():
+        fs = SimFileSystem()
+        # build run debt with the compaction trigger parked sky-high,
+        # so > _MAX_RUNS flush runs reach the manifest uncompacted
+        lsm_mod._MAX_RUNS = 99
+        try:
+            kv = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(False))
+            v = 0
+            for i in range(40):
+                v += 1
+                await kv.commit(
+                    [(0, b"d%05d" % ((i * 37 + j) % 400), b"x" * 50)
+                     for j in range(25)],
+                    {"durable_version": v})
+            await kv.close()
+        finally:
+            lsm_mod._MAX_RUNS = 3
+        # reopen in LEVELED mode with > _MAX_RUNS runs on disk
+        kv2 = await LSMKVStore.open(fs, "db/lsm", knobs=_knobs(True))
+        assert len(kv2._levels[0]) > lsm_mod._MAX_RUNS, (
+            "build phase left no inherited debt — the test is void")
+        assert kv2._compact_task is not None, (
+            "open() did not nudge the compactor despite inherited "
+            "L0 debt")
+        await kv2.wait_compaction_idle()
+        assert kv2._debt_bytes() == 0
+        _check_level_invariants(kv2)
+        await kv2.close()
+    run_simulation(main())

@@ -112,6 +112,23 @@ def _child(path: str, mode: str = "default") -> None:
                                STORAGE_VERSION_WINDOW=1_000,
                                STORAGE_DURABILITY_LAG=0.1)
         durable = True
+    elif mode in ("lsm_on", "lsm_off"):
+        # ISSUE 14: durable lsm storage with a tiny memtable/trigger so
+        # flushes AND compactions run inside the sim — leveled
+        # background compaction forced ON (its default) or OFF (the
+        # monolithic inline twin).  The background compactor's task
+        # scheduling, slice yields and manifest installs are all part
+        # of what each pair must replay bit-identically.
+        import foundationdb_tpu.storage.lsm as lsm_mod
+        lsm_mod._MEMTABLE_BYTES = 1200
+        lsm_mod._MAX_RUNS = 2
+        lsm_mod._BLOCK_BYTES = 512
+        knobs = knobs.override(STORAGE_ENGINE="lsm",
+                               LSM_LEVELED_COMPACTION=(mode == "lsm_on"),
+                               LSM_COMPACT_SLICE_BYTES=2048,
+                               STORAGE_VERSION_WINDOW=1_000,
+                               STORAGE_DURABILITY_LAG=0.1)
+        durable = True
 
     async def main():
         sim = SimulatedCluster(knobs, n_machines=_N_MACHINES,
@@ -133,6 +150,16 @@ def _child(path: str, mode: str = "default") -> None:
             rows = await tr.get_range(b"det-", b"det.", snapshot=True)
             assert len(rows) == 6, rows
         await db.run(scan)
+        if mode in ("lsm_on", "lsm_off"):
+            # ISSUE 14: push enough per-replica volume through the
+            # tiny-memtable lsm engine that flushes AND compactions
+            # (background leveled merges / inline monolithic ones)
+            # run inside the bit-identical proof
+            for w in range(14):
+                async def wave(tr, w=w):
+                    for j in range(6):
+                        tr.set(b"lsm-%02d-%02d" % (w, j), b"x" * 120)
+                await db.run(wave)
         # let the async halves drain: storage pull/apply and the
         # pipeline's verdict readbacks both emit trace events
         await asyncio.sleep(1.5)
@@ -146,6 +173,7 @@ def _child(path: str, mode: str = "default") -> None:
     pipeline_events = 0
     spill_events = 0
     fault_events = 0
+    compact_events = 0
     base = os.path.basename(path)
     d = os.path.dirname(path)
     rolled = sorted(
@@ -160,27 +188,29 @@ def _child(path: str, mode: str = "default") -> None:
         pipeline_events += data.count(b"ResolverDevice.")
         spill_events += data.count(b"StorageDbufSpill")
         fault_events += data.count(b"DiskFaultInjected")
-    print("%s %d %d %d %d" % (h.hexdigest(), n, pipeline_events,
-                              spill_events, fault_events))
+        compact_events += data.count(b"LsmCompact")
+    print("%s %d %d %d %d %d" % (h.hexdigest(), n, pipeline_events,
+                                 spill_events, fault_events,
+                                 compact_events))
 
 
-def _run_child(tmp_path, tag: str,
-               mode: str = "default") -> tuple[str, int, int, int, int]:
+def _run_child(tmp_path, tag: str, mode: str = "default"
+               ) -> tuple[str, int, int, int, int, int]:
     path = os.path.join(str(tmp_path), f"trace-{tag}.jsonl")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     p = subprocess.run([sys.executable, _THIS, "--child", path, mode],
                        cwd=_REPO, env=env, capture_output=True, text=True,
                        timeout=300)
     assert p.returncode == 0, f"child {tag} failed: {p.stderr[-2000:]}"
-    digest, n_events, n_pipeline, n_spill, n_fault = \
+    digest, n_events, n_pipeline, n_spill, n_fault, n_compact = \
         p.stdout.strip().splitlines()[-1].split()
     return digest, int(n_events), int(n_pipeline), int(n_spill), \
-        int(n_fault)
+        int(n_fault), int(n_compact)
 
 
 def test_same_seed_sim_trace_bit_identical_with_pipeline(tmp_path):
-    d1, n1, p1, _s1, _f1 = _run_child(tmp_path, "a")
-    d2, n2, p2, _s2, _f2 = _run_child(tmp_path, "b")
+    d1, n1, p1, *_ = _run_child(tmp_path, "a")
+    d2, n2, p2, *_ = _run_child(tmp_path, "b")
     assert n1 > 100, f"trace suspiciously small ({n1} events)"
     assert p1 > 0, (
         "no ResolverDevice span events in the trace — the device "
@@ -198,8 +228,8 @@ def test_same_seed_sim_trace_bit_identical_with_spill_forced_on(tmp_path):
     segments to the side file and reads them back through the commit
     slice) must still produce a BIT-IDENTICAL trace — the spill path
     adds disk hops, never nondeterminism."""
-    d1, n1, _p1, s1, _f1 = _run_child(tmp_path, "sa", mode="spill")
-    d2, n2, _p2, s2, _f2 = _run_child(tmp_path, "sb", mode="spill")
+    d1, n1, _p1, s1, *_ = _run_child(tmp_path, "sa", mode="spill")
+    d2, n2, _p2, s2, *_ = _run_child(tmp_path, "sb", mode="spill")
     assert n1 > 100, f"trace suspiciously small ({n1} events)"
     assert s1 > 0, (
         "no StorageDbufSpill events in the trace — the forced-on spill "
@@ -218,8 +248,8 @@ def test_same_seed_sim_trace_bit_identical_with_disk_faults_on(tmp_path):
     nondeterminism — with DiskFaultInjected events present and all
     acked writes surviving (the child asserts its scan sees every row,
     so a passing run IS zero acked-write loss)."""
-    d1, n1, _p1, _s1, f1 = _run_child(tmp_path, "fa", mode="faults")
-    d2, n2, _p2, _s2, f2 = _run_child(tmp_path, "fb", mode="faults")
+    d1, n1, _p1, _s1, f1, _c1 = _run_child(tmp_path, "fa", mode="faults")
+    d2, n2, _p2, _s2, f2, _c2 = _run_child(tmp_path, "fb", mode="faults")
     assert n1 > 100, f"trace suspiciously small ({n1} events)"
     assert f1 > 0, (
         "no DiskFaultInjected events in the trace — the forced-on "
@@ -249,6 +279,34 @@ def test_same_seed_sim_trace_bit_identical_mvcc_knob_both_ways(tmp_path):
     assert (d3, n3) == (d4, n4), (
         f"same-seed sim trace diverged with the legacy MVCC window "
         f"forced: run a = {d3} ({n3} events), run b = {d4} ({n4})")
+
+
+def test_same_seed_sim_trace_bit_identical_lsm_knob_both_ways(tmp_path):
+    """ISSUE 14 acceptance: a durable same-seed sim on the LSM engine
+    with leveled background compaction forced ON (tiny memtable +
+    trigger, so flushes, background merges, slice yields and manifest
+    installs all run) must be bit-identical across fresh processes,
+    AND the same sim with the knob forced OFF (the monolithic inline
+    twin) must be too — the knob selects the compaction discipline
+    outright, so each pair proves its own path."""
+    d1, n1, _p1, _s1, _f1, c1 = _run_child(tmp_path, "la", mode="lsm_on")
+    d2, n2, _p2, _s2, _f2, c2 = _run_child(tmp_path, "lb", mode="lsm_on")
+    assert n1 > 100, f"trace suspiciously small ({n1} events)"
+    assert c1 > 0, (
+        "no LsmCompact events in the trace — the leveled background "
+        "compactor never ran, so this test proved nothing")
+    assert (d1, n1, c1) == (d2, n2, c2), (
+        f"same-seed sim trace diverged with leveled lsm compaction "
+        f"forced ON: run a = {d1} ({n1} events, {c1} compactions), "
+        f"run b = {d2} ({n2} events, {c2}) — the background compactor "
+        f"reordered observable events")
+    d3, n3, *_ = _run_child(tmp_path, "lc", mode="lsm_off")
+    d4, n4, *_ = _run_child(tmp_path, "ld", mode="lsm_off")
+    assert n3 > 100, f"trace suspiciously small ({n3} events)"
+    assert (d3, n3) == (d4, n4), (
+        f"same-seed sim trace diverged with the monolithic lsm "
+        f"compaction twin forced: run a = {d3} ({n3} events), "
+        f"run b = {d4} ({n4})")
 
 
 if __name__ == "__main__":

@@ -105,7 +105,15 @@ byte-identical probe/range serving asserted in situ, the columnar
 window at <=50% of the legacy window's RSS overhead, and the combined
 apply_packed+get2_batch pipeline at >=2x.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|all]
+TWELFTH stage (``--stage compact``, ISSUE 14): lsm compaction itself —
+a sustained multi-flush ingest replayed on BOTH compaction disciplines
+in one process (leveled background vs the monolithic merge-all twin):
+byte-identical point + range serving asserted in situ, leveled write
+amplification at <=50% of the monolithic twin's, leveled commit p99 at
+<=20% of the monolithic twin's worst commit (no commit ever awaits a
+full-keyspace merge), the budget doubling as the wedge deadline.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|compact|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -113,6 +121,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import hashlib
 import math
 import os
 import sys
@@ -166,6 +175,23 @@ MVCC_RSS_RATIO_CEIL = 0.5   # columnar window RSS overhead vs legacy
 MVCC_PROBE_KEYS = 65_536    # get2_batch probes per side of the A/B
 MVCC_PROBE_BATCH = 1024     # probe batch size (the vectorized shape)
 MVCC_SCAN_ROWS = 100_000    # byte-identity range sweep
+MVCC_SMALL_BATCH = 64       # engine-less point-probe batch (ISSUE 14
+#                             satellite: the recent-hit cache shape)
+MVCC_SMALL_PROBE_FLOOR = 0.6  # columnar vs legacy small-batch probe
+#                             keys/s — the recent-hit cache must keep
+#                             ≤64-key probes from losing to the legacy
+#                             dict hit (pre-cache this measured ~0.01×;
+#                             with it ~1.5× on this box)
+COMPACT_COMMITS = 3200      # sustained-ingest commits per twin (ISSUE 14)
+COMPACT_KEYS_PER = 40       # ops per commit
+COMPACT_KEYSPACE = 200_000  # mostly-fresh keyspace: the dataset GROWS,
+#                             so each monolithic merge-all rewrites an
+#                             ever-larger whole (the 10M-key wall shape)
+COMPACT_PROBE_KEYS = 2048   # byte-identity point probes per twin
+COMPACT_BUDGET_S = 240.0    # doubles as the hard wedge deadline
+COMPACT_WRITE_AMP_CEIL = 0.5  # leveled write amp vs the monolithic twin
+COMPACT_STALL_RATIO_CEIL = 0.2  # leveled commit p99 vs monolithic max
+COMPACT_STALL_FLOOR_MS = 25.0   # absolute noise floor for that bound
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -1761,6 +1787,8 @@ def mvcc_seconds(n_keys: int = MVCC_KEYS,
         apply_s: dict[bool, float] = {}
         probe_s: dict[bool, float] = {}
         probe_results: dict[bool, list] = {}
+        small_s: dict[bool, float] = {}
+        small_results: dict[bool, list] = {}
         sweep: dict[bool, tuple] = {}
         stats_c: dict = {}
         probes = sorted({key((i * 2654435761) % n_keys)
@@ -1805,6 +1833,24 @@ def mvcc_seconds(n_keys: int = MVCC_KEYS,
                                          version))
             probe_s[mode] = time.perf_counter() - t0
             probe_results[mode] = got
+            # small-batch point probes (ISSUE 14 satellite, ROADMAP
+            # 5 (e)): ≤64-key engine-less batches against the
+            # multi-segment window — one warm pass (populates the
+            # columnar recent-hit cache, a cost the steady state
+            # amortizes away), then the timed repeats both sides pay
+            # identically
+            small = [probes[s:s + MVCC_SMALL_BATCH]
+                     for s in range(0, MVCC_PROBE_KEYS // 4,
+                                    MVCC_SMALL_BATCH)]
+            sgot: list = []
+            for b in small:
+                sgot.extend(vm.get2_batch(b, version))
+            small_results[mode] = sgot
+            t0 = time.perf_counter()
+            for _ in range(2):
+                for b in small:
+                    vm.get2_batch(b, version)
+            small_s[mode] = time.perf_counter() - t0
             sweep[mode] = vm.range_rows(b"big%012d" % 0,
                                         b"big%012d" % MVCC_SCAN_ROWS,
                                         version)
@@ -1816,6 +1862,9 @@ def mvcc_seconds(n_keys: int = MVCC_KEYS,
             "columnar window probe results diverged from the legacy "
             "twin — the A/B is not serving byte-identical data")
         assert all(r[0] for r in probe_results[True]), "probe lost rows"
+        assert small_results[True] == small_results[False], (
+            "small-batch probe results diverged from the legacy twin — "
+            "the recent-hit cache is serving stale entries")
         assert sweep[True] == sweep[False], (
             "columnar range sweep diverged from the legacy twin")
         assert len(sweep[True][0]) == MVCC_SCAN_ROWS
@@ -1837,6 +1886,8 @@ def mvcc_seconds(n_keys: int = MVCC_KEYS,
                 round(len(probes) / probe_s[True], 1),
             "legacy_probe_keys_per_sec":
                 round(len(probes) / probe_s[False], 1),
+            "small_probe_ratio": round(small_s[False]
+                                       / max(1e-9, small_s[True]), 2),
             "pipeline_ratio": round(pipeline_l / pipeline_c, 2),
             "segments": stats_c.get("segments"),
             "seals": stats_c.get("seals"),
@@ -1872,7 +1923,8 @@ def check_mvcc(n_keys: int = MVCC_KEYS, budget_s: float = MVCC_BUDGET_S,
               f"{stats['legacy_apply_keys_per_sec']:.0f} keys/s, probe "
               f"{stats['columnar_probe_keys_per_sec']:.0f} vs "
               f"{stats['legacy_probe_keys_per_sec']:.0f} keys/s, "
-              f"pipeline {stats['pipeline_ratio']:.2f}x, "
+              f"pipeline {stats['pipeline_ratio']:.2f}x, small-batch "
+              f"probe {stats['small_probe_ratio']:.2f}x, "
               f"{stats['segments']} segments / {stats['seals']} seals / "
               f"{stats['folds']} folds")
     assert elapsed < budget_s, (
@@ -1892,6 +1944,221 @@ def check_mvcc(n_keys: int = MVCC_KEYS, budget_s: float = MVCC_BUDGET_S,
         f"{stats['pipeline_ratio']:.2f}x the legacy window (floor "
         f"{MVCC_PIPELINE_FLOOR:.0f}x) — the direct-seal apply path or "
         f"the vectorized batched probe lost its edge")
+    assert stats["small_probe_ratio"] >= MVCC_SMALL_PROBE_FLOOR, (
+        f"columnar small-batch ({MVCC_SMALL_BATCH}-key) point probes "
+        f"only {stats['small_probe_ratio']:.2f}x the legacy dict hit "
+        f"(floor {MVCC_SMALL_PROBE_FLOOR:.1f}x) — the recent-hit cache "
+        f"(ISSUE 14 satellite) lost its edge")
+    return elapsed
+
+
+def _lsm_compact_geometry(lsm_mod):
+    """Tier-1-sized lsm geometry for the compaction A/B: small enough
+    that dozens of flushes and many compaction cycles run in seconds,
+    large enough that a monolithic merge-all visibly rewrites the
+    keyspace.  Returns the saved constants for restore."""
+    saved = (lsm_mod._MEMTABLE_BYTES, lsm_mod._BLOCK_BYTES,
+             lsm_mod._MAX_RUNS)
+    lsm_mod._MEMTABLE_BYTES = 24 << 10
+    lsm_mod._BLOCK_BYTES = 4 << 10
+    lsm_mod._MAX_RUNS = 4
+    return saved
+
+
+async def lsm_ingest_side(leveled: bool, commits: list,
+                          probes: list[bytes],
+                          probe_every: int = 0) -> dict:
+    """One side of the compaction A/B: ingest the prepared commit
+    batches into a fresh lsm store (leveled background compaction vs
+    the monolithic inline twin), drain, snapshot the serving surface.
+    ``probe_every`` > 0 interleaves a timed get_batch every N commits —
+    the read-latency-DURING-compaction sample the bench stage reports.
+    Shared by perf_smoke ``--stage compact`` and bench ``lsm_ingest``."""
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.storage.lsm import LSMKVStore
+
+    knobs = Knobs().override(LSM_LEVELED_COMPACTION=leveled,
+                             LSM_COMPACT_SLICE_BYTES=32 << 10,
+                             LSM_LEVEL_FANOUT=8)
+    fs = SimFileSystem()
+    kv = await LSMKVStore.open(fs, "db/lsm", knobs=knobs)
+    commit_s: list[float] = []
+    probe_s: list[float] = []
+    t_all = time.perf_counter()
+    for i, batch in enumerate(commits):
+        t0 = time.perf_counter()
+        await kv.commit(batch, {"durable_version": i + 1})
+        commit_s.append(time.perf_counter() - t0)
+        if probe_every and i % probe_every == probe_every - 1:
+            t0 = time.perf_counter()
+            kv.get_batch(probes)
+            probe_s.append(time.perf_counter() - t0)
+    if leveled:
+        await kv.wait_compaction_idle()
+    ingest_wall = time.perf_counter() - t_all
+    got = kv.get_batch(probes)
+    rows_sha = hashlib.sha256()
+    n_rows = 0
+    for run in kv.range_runs(b"", b"\xff\xff"):
+        for k, v in run:
+            rows_sha.update(bytes(k))
+            rows_sha.update(bytes(v))
+            n_rows += 1
+    m = kv.metrics()
+    await kv.close()
+    commit_s.sort()
+    p99 = commit_s[int(len(commit_s) * 0.99)] if commit_s else 0.0
+    probe_s.sort()
+    return {
+        "ingest_wall_s": ingest_wall,
+        "commit_p99_ms": round(p99 * 1e3, 3),
+        "commit_max_ms": round(commit_s[-1] * 1e3, 3) if commit_s else 0,
+        "read_p99_ms": (round(probe_s[int(len(probe_s) * 0.99)] * 1e3, 3)
+                        if probe_s else None),
+        "write_amp": m["lsm_write_amp"],
+        "compactions": m["lsm_compactions"],
+        "runs": m["lsm_runs"],
+        "levels": m["lsm_levels"],
+        "stall_max_ms": m["lsm_compact_stall_ms"],
+        "got": got,
+        "rows_sha": rows_sha.hexdigest(),
+        "n_rows": n_rows,
+    }
+
+
+def lsm_compact_commits(n_commits: int, keys_per: int,
+                        keyspace: int) -> tuple[list, list[bytes]]:
+    """The seeded sustained-ingest op stream both twins replay: uniform
+    random writes over a keyspace large enough that the live dataset
+    GROWS through the run — every flush run spans the keyspace (the
+    overlap-heavy shape) and each monolithic merge-all rewrites the
+    ever-larger whole, the exact 10M-key wall ROADMAP 5 (d) names —
+    plus a trickle of narrow range clears (tombstones crossing levels),
+    and the sorted probe list."""
+    import random
+    rng = random.Random(20240814)
+    commits = []
+    for _ in range(n_commits):
+        batch = []
+        for _ in range(keys_per):
+            if rng.random() < 0.02:
+                lo = rng.randrange(keyspace)
+                hi = min(keyspace, lo + rng.randrange(1, 4))
+                batch.append((1, b"ck%08d" % lo, b"ck%08d" % hi))
+            else:
+                batch.append((0, b"ck%08d" % rng.randrange(keyspace),
+                              bytes([rng.randrange(256)])
+                              * rng.randrange(16, 72)))
+        commits.append(batch)
+    probes = sorted({b"ck%08d" % rng.randrange(keyspace)
+                     for _ in range(COMPACT_PROBE_KEYS)})
+    return commits, probes
+
+
+def compact_seconds(n_commits: int = COMPACT_COMMITS,
+                    deadline_s: float | None = None) -> tuple[float, dict]:
+    """The lsm compaction smoke (ISSUE 14): sustained multi-flush
+    ingest run on BOTH compaction disciplines in one process — leveled
+    background (knob default) vs monolithic merge-all (the verbatim
+    pre-ISSUE-14 twin).  Asserted in situ: byte-identical serving
+    (batched points + full range sha), leveled write amplification at
+    ≤ ``COMPACT_WRITE_AMP_CEIL`` of the monolithic twin's, and the
+    leveled commit-path p99 at ≤ ``COMPACT_STALL_RATIO_CEIL`` of the
+    monolithic twin's worst commit (no commit ever awaits a
+    full-keyspace merge).  The budget doubles as the wedge deadline —
+    a compactor that stops draining debt hangs wait_compaction_idle
+    and trips it."""
+    import foundationdb_tpu.storage.lsm as lsm_mod
+
+    commits, probes = lsm_compact_commits(n_commits, COMPACT_KEYS_PER,
+                                          COMPACT_KEYSPACE)
+
+    async def main() -> tuple[float, dict]:
+        t_all = time.perf_counter()
+        lev = await lsm_ingest_side(True, commits, probes)
+        mono = await lsm_ingest_side(False, commits, probes)
+        assert lev["got"] == mono["got"], (
+            "leveled point serving diverged from the monolithic twin")
+        assert (lev["rows_sha"], lev["n_rows"]) == \
+            (mono["rows_sha"], mono["n_rows"]), (
+            "leveled range serving diverged from the monolithic twin")
+        assert lev["compactions"] > 0, (
+            "the leveled compactor never ran — this smoke proved "
+            "nothing")
+        stats = {
+            "commits": len(commits),
+            "keys_per_commit": COMPACT_KEYS_PER,
+            "leveled_ingest_keys_per_sec":
+                round(len(commits) * COMPACT_KEYS_PER
+                      / lev["ingest_wall_s"], 1),
+            "monolithic_ingest_keys_per_sec":
+                round(len(commits) * COMPACT_KEYS_PER
+                      / mono["ingest_wall_s"], 1),
+            "leveled_write_amp": lev["write_amp"],
+            "monolithic_write_amp": mono["write_amp"],
+            "write_amp_ratio": round(lev["write_amp"]
+                                     / max(1e-9, mono["write_amp"]), 3),
+            "leveled_commit_p99_ms": lev["commit_p99_ms"],
+            "leveled_commit_max_ms": lev["commit_max_ms"],
+            "monolithic_commit_max_ms": mono["commit_max_ms"],
+            "leveled_compactions": lev["compactions"],
+            "leveled_levels": lev["levels"],
+            "leveled_stall_max_ms": lev["stall_max_ms"],
+            "monolithic_stall_max_ms": mono["stall_max_ms"],
+        }
+        return time.perf_counter() - t_all, stats
+
+    saved = _lsm_compact_geometry(lsm_mod)
+    try:
+        async def bounded():
+            return await asyncio.wait_for(main(), deadline_s)
+        return asyncio.run(bounded())
+    except asyncio.TimeoutError:
+        raise AssertionError(
+            f"compact smoke wedged: the {deadline_s:.0f}s deadline hit "
+            f"— a compaction that stopped draining debt (the background "
+            f"task died or the debt score stopped converging), not just "
+            f"slowness") from None
+    finally:
+        (lsm_mod._MEMTABLE_BYTES, lsm_mod._BLOCK_BYTES,
+         lsm_mod._MAX_RUNS) = saved
+
+
+def check_compact(budget_s: float = COMPACT_BUDGET_S,
+                  quiet: bool = False) -> float:
+    """Run the compaction smoke; raises AssertionError on serving
+    divergence, write amplification past the ceiling, a commit stall
+    past the bound, the budget, or the wedge deadline."""
+    elapsed, stats = compact_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] compact: {stats['commits']} commits x "
+              f"{stats['keys_per_commit']} keys — write amp "
+              f"{stats['leveled_write_amp']} vs "
+              f"{stats['monolithic_write_amp']} "
+              f"({stats['write_amp_ratio']:.2f}x), commit p99 "
+              f"{stats['leveled_commit_p99_ms']:.1f}ms / max "
+              f"{stats['leveled_commit_max_ms']:.1f}ms vs monolithic "
+              f"max {stats['monolithic_commit_max_ms']:.1f}ms, "
+              f"{stats['leveled_compactions']} compactions, levels "
+              f"{stats['leveled_levels']}")
+    assert elapsed < budget_s, (
+        f"compact smoke took {elapsed:.1f}s (budget {budget_s:.0f}s) — "
+        f"a compaction discipline grew a quadratic shape")
+    assert stats["write_amp_ratio"] <= COMPACT_WRITE_AMP_CEIL, (
+        f"leveled write amplification {stats['leveled_write_amp']} is "
+        f"{stats['write_amp_ratio']:.2f}x the monolithic twin's "
+        f"{stats['monolithic_write_amp']} (ceiling "
+        f"{COMPACT_WRITE_AMP_CEIL:.0%}) — the O(overlap) slice "
+        f"selection lost its edge over merge-all")
+    stall_ceil = max(COMPACT_STALL_FLOOR_MS,
+                     COMPACT_STALL_RATIO_CEIL
+                     * stats["monolithic_commit_max_ms"])
+    assert stats["leveled_commit_p99_ms"] <= stall_ceil, (
+        f"leveled commit p99 {stats['leveled_commit_p99_ms']:.1f}ms "
+        f"exceeds {stall_ceil:.1f}ms (the "
+        f"{COMPACT_STALL_RATIO_CEIL:.0%}-of-monolithic-max bound) — a "
+        f"commit is awaiting a merge again")
     return elapsed
 
 
@@ -1902,7 +2169,8 @@ def main() -> int:
     ap.add_argument("--stage",
                     choices=("apply", "pipeline", "feed", "read",
                              "resolve", "heat", "backup", "scan",
-                             "bigkeys", "recover", "mvcc", "all"),
+                             "bigkeys", "recover", "mvcc", "compact",
+                             "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -1919,6 +2187,8 @@ def main() -> int:
                     default=RECOVER_BUDGET_S)
     ap.add_argument("--mvcc-keys", type=int, default=MVCC_KEYS)
     ap.add_argument("--mvcc-budget", type=float, default=MVCC_BUDGET_S)
+    ap.add_argument("--compact-budget", type=float,
+                    default=COMPACT_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -1942,6 +2212,8 @@ def main() -> int:
         check_recover(budget_s=args.recover_budget)
     if args.stage in ("mvcc", "all"):
         check_mvcc(args.mvcc_keys, budget_s=args.mvcc_budget)
+    if args.stage in ("compact", "all"):
+        check_compact(budget_s=args.compact_budget)
     return 0
 
 
