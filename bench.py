@@ -144,6 +144,19 @@ def stage_trace_end(token, out: dict, name: str, top: int = 5) -> None:
                  "slow_tasks": s["slow_tasks"]}
                 for s in rep["slowest"]],
         }
+        # the flight-recorder summary (ISSUE 15): per-series emission
+        # counts + the worst recorded durability lag ride the artifact,
+        # so a bench regression's version-frontier history is one field
+        # away instead of a separate trace-file excavation
+        import metrics_tool
+        msum = metrics_tool.summarize(events)
+        mlag = metrics_tool.lag_report(events)
+        out[f"metrics_{name}"] = {
+            "metrics_events": msum["metrics_events"],
+            "series": {k: v["n"] for k, v in msum["series"].items()},
+            "worst_durability_lag": mlag["worst_lag"],
+            "recoveries": len(metrics_tool.recovery_report(events)),
+        }
     except Exception as e:  # noqa: BLE001 — report the gap, keep the bench
         out[f"trace_{name}_error"] = repr(e)[:200]
 

@@ -97,6 +97,25 @@ class Cluster:
         self.trace_batch = TraceBatch(k.CLIENT_LATENCY_PROBE_SAMPLE)
         self._profiler = None
         self._started = False
+        # the metrics plane (ISSUE 15): the in-process cluster is one
+        # "worker" — every role registers in one registry, one emitter
+        # drains it.  Registration order (role construction order) is
+        # the deterministic emission order.
+        from ..runtime.metrics import MetricsRegistry
+        self.metrics_registry = MetricsRegistry()
+        reg = self.metrics_registry
+        reg.add_role(self.sequencer)
+        for i, t in enumerate(self.tlogs):
+            reg.add_role(t, default_id=str(i))
+        for i, r in enumerate(self.resolvers):
+            reg.add_role(r, default_id=str(i))
+        for ss in self.storage_servers:
+            reg.add_role(ss)
+        reg.add_role(self.ratekeeper)
+        for i, p in enumerate(self.grv_proxies):
+            reg.add_role(p, default_id=str(i))
+        for i, p in enumerate(self.commit_proxies):
+            reg.add_role(p, default_id=str(i))
 
     @classmethod
     async def create(cls, config: ClusterConfig | None = None,
@@ -152,12 +171,15 @@ class Cluster:
         # the virtual-time simulator, watchdog thread on a real loop
         from ..runtime.profiler import SlowTaskProfiler
         self._profiler = SlowTaskProfiler(self.knobs).start()
+        if self.knobs.METRICS_EMITTER:
+            self.metrics_registry.start_emitter(self.knobs.METRICS_INTERVAL)
         self._started = True
 
     async def stop(self) -> None:
         if self._profiler is not None:
             self._profiler.stop()
             self._profiler = None
+        await self.metrics_registry.stop_emitter()
         await self.ratekeeper.stop()
         for cp in self.commit_proxies:
             await cp.stop()

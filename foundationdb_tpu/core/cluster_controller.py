@@ -34,7 +34,7 @@ from ..rpc.stubs import TLogClient, WorkerClient
 from ..rpc.transport import NetworkAddress, Transport
 from ..runtime.errors import FdbError, LogDataLoss
 from ..runtime.knobs import Knobs
-from ..runtime.trace import TraceEvent
+from ..runtime.trace import Severity, TraceEvent
 from .coordination import CoordinatedState
 from .shard_map import ShardMap
 
@@ -104,12 +104,47 @@ class ClusterController:
         self._recovery_requested: asyncio.Event = asyncio.Event()
         self._attempt_recruits: list[tuple[NetworkAddress, int]] = []
         self._stopped = False
+        self._audit_epoch = 0
+        self._msource = None
+
+    def metrics_source(self):
+        """The controller's registration in the hosting worker's
+        MetricsRegistry (ISSUE 15): epoch + recovery state machine
+        position + fleet liveness, recorded every interval — the
+        recovery half of the flight record (the RecoveryState audit
+        events carry the per-step detail)."""
+        if self._msource is None:
+            from ..runtime.metrics import MetricsSource
+            s = MetricsSource("ClusterController")
+            s.gauge("Epoch", lambda: self.epoch)
+            s.gauge("RecoveryState", lambda: self.recovery_state)
+            s.gauge("LiveWorkers", lambda: len(self._live_workers()))
+            s.gauge("DegradedMachines",
+                    lambda: sum(1 for a in self.workers
+                                if self.fm.is_degraded(a)))
+            self._msource = s
+        return self._msource
 
     def request_recovery(self, reason: str = "") -> None:
         """Ask the run() loop for a new epoch without a role failure —
         how DataDistribution applies a new shard layout."""
         TraceEvent("RecoveryRequested").detail("Reason", reason).log()
         self._recovery_requested.set()
+
+    @staticmethod
+    def _audit(step: str, epoch: int, **details) -> None:
+        """One structured ``RecoveryState`` event per recovery step —
+        the audit trail ROADMAP 6 (e) is blocked on (epoch, version
+        cuts, knownCommitted, durable TLog copy adoption, all over
+        TIME).  Severity-pinned at WARN_ALWAYS so no min_severity
+        configuration hides a recovery from the flight record;
+        ``metrics_tool recovery`` replays the full cut sequence from
+        the trace file alone."""
+        ev = TraceEvent("RecoveryState", severity=Severity.WARN_ALWAYS) \
+            .detail("Step", step).detail("Epoch", epoch)
+        for k, v in details.items():
+            ev.detail(k, v)
+        ev.log()
 
     # --- helpers ---
 
@@ -154,6 +189,10 @@ class ClusterController:
             TraceEvent(event).detail("Epoch", g.get("epoch")) \
                 .detail("Index", i).detail("Satellite", satellite) \
                 .detail("Addr", str(res[0])).log()
+            self._audit("durable_copy_adopted", self._audit_epoch,
+                        SourceEpoch=g.get("epoch"), Index=i,
+                        Satellite=satellite, Addr=str(res[0]),
+                        OldGeneration=True)
 
     def order_for_recruitment(self, live: list) -> list:
         """Stable-partition (addr, worker) pairs: healthy disks first,
@@ -199,7 +238,10 @@ class ClusterController:
         new_epoch = (prev_state["epoch"] + 1) if prev_state else 1
         self.recovery_state = "LOCKING_CSTATE"
         self._attempt_recruits = []
+        self._audit_epoch = new_epoch   # adoption audits group under it
         TraceEvent("RecoveryStarted").detail("Epoch", new_epoch).log()
+        self._audit("locking_cstate", new_epoch,
+                    PrevEpoch=prev_state["epoch"] if prev_state else 0)
 
         # ---- lock the previous generation, compute recovery version ----
         recovery_version = 0
@@ -266,6 +308,10 @@ class ClusterController:
                             .detail("Epoch", cur.get("epoch")) \
                             .detail("Index", i) \
                             .detail("Addr", str(addr_c)).log()
+                        self._audit("durable_copy_adopted", new_epoch,
+                                    SourceEpoch=cur.get("epoch"), Index=i,
+                                    Satellite=False, Addr=str(addr_c),
+                                    Tip=tips[-1])
                     locked = True
                     break
                 if not locked and i not in dead:
@@ -296,6 +342,10 @@ class ClusterController:
                         TraceEvent("SatelliteTLogAdopted") \
                             .detail("Epoch", cur.get("epoch")) \
                             .detail("Index", i).log()
+                        self._audit("durable_copy_adopted", new_epoch,
+                                    SourceEpoch=cur.get("epoch"), Index=i,
+                                    Satellite=True, Addr=str(addr_c),
+                                    Tip=tips[-1])
                     locked = True
                     break
                 if not locked and i not in sat_dead:
@@ -322,6 +372,17 @@ class ClusterController:
             cur["end"] = recovery_version
             cur["dead"] = sorted(dead)
             cur["sat_dead"] = sorted(sat_dead)
+            # THE version cut: the previous generation ends at the
+            # minimum locked tip; every acked commit above it on any
+            # single log is clamped out (the 6 (e) suspect territory —
+            # record the full tip vector, not just the min)
+            self._audit("locked_tlogs", new_epoch,
+                        PrevEpoch=cur.get("epoch"),
+                        Tips=list(tips),
+                        RecoveryVersion=recovery_version,
+                        GenerationEnd=cur["end"],
+                        DeadLogs=sorted(dead),
+                        DeadSatellites=sorted(sat_dead))
         self.epoch = new_epoch
 
         # ---- materialize the database's own metadata (txnStateStore
@@ -331,11 +392,23 @@ class ClusterController:
         spec, layout, excluded, backup_tags, locked = \
             await self._read_system_state(prev_state, spec,
                                           recovery_version)
+        self._audit("read_system_state", new_epoch,
+                    RecoveryVersion=recovery_version,
+                    Locked=locked is not None,
+                    BackupTags=sorted(backup_tags or {}),
+                    HasLayout=layout is not None)
 
         # ---- recruit the new transaction subsystem ----
         self.recovery_state = "RECRUITING"
         live = [(a, w) for a, w in self._live_workers()
                 if f"{a.ip}:{a.port}" not in excluded]
+        self._audit("recruiting", new_epoch,
+                    LiveWorkers=len(live),
+                    Degraded=sum(1 for a, _ in live
+                                 if self.fm.is_degraded(a)),
+                    Logs=spec.logs, Resolvers=spec.resolvers,
+                    CommitProxies=spec.commit_proxies,
+                    GrvProxies=spec.grv_proxies)
         # min_workers gates only the INITIAL cluster creation (so recruits
         # spread over the fleet instead of piling onto the first
         # registrant); later epochs recover with whoever survives
@@ -454,6 +527,7 @@ class ClusterController:
         # recovery version from a surviving source replica; mutations above
         # it arrive via its new tag.  REF:fdbserver/MoveKeys.actor.cpp. ----
         self.recovery_state = "REJOINING"
+        self._audit("rejoining", new_epoch, RecoveryVersion=rv)
         wire_log_cfg = [self._wire_gen(g) for g in log_cfg]
 
         async def recruit_remote_routers(remote_tags: dict[int, str]):
@@ -554,6 +628,8 @@ class ClusterController:
                             TraceEvent("StorageAdopted") \
                                 .detail("Tag", tag) \
                                 .detail("Worker", str(res[0])).log()
+                            self._audit("storage_adopted", new_epoch,
+                                        Tag=tag, Addr=str(res[0]))
                         if wa in hosted and s["token"] not in hosted[wa] \
                                 and self.resident.get(tag) is None:
                             # the registered worker disowns the token and
@@ -697,6 +773,13 @@ class ClusterController:
 
         # ---- commit the new epoch ----
         self.recovery_state = "WRITING_CSTATE"
+        self._audit("writing_cstate", new_epoch,
+                    RecoveryVersion=rv,
+                    NewGenerationBegin=new_gen["begin"],
+                    TLogs=len(tlog_addrs),
+                    Satellites=len(sat_addrs),
+                    StorageTags=sorted(s["tag"] for s in storage_meta),
+                    RejoinPlanned=len(rejoin_plan))
         state = {
             "epoch": new_epoch,
             "seq": 0,
@@ -752,6 +835,9 @@ class ClusterController:
         self.recovery_state = "ACCEPTING_COMMITS"
         TraceEvent("RecoveryComplete").detail("Epoch", new_epoch) \
             .detail("RecoveryVersion", rv).log()
+        self._audit("accepting_commits", new_epoch,
+                    RecoveryVersion=rv,
+                    ActiveTags=sorted(active_tags))
         return state
 
     async def publish_state(self, mutate) -> dict:

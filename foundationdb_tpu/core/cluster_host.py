@@ -166,6 +166,10 @@ class ClusterHost:
                     .detail("Host", self.id).detail("Attempt", attempt) \
                     .error(e).log()
                 await asyncio.sleep(0.25)
+        # the metrics plane's per-worker emitter (ISSUE 15): armed here
+        # so even a host with no recruited roles records its Worker
+        # gauges (disk health, SlowTask stalls)
+        self.worker._ensure_emitter()
         me = [self.address.ip, self.address.port]
         while not self._stopped:
             try:
@@ -277,6 +281,10 @@ class ClusterHost:
         self.cc.resident_tlogs = self._resident_tlog_map
         if self.locality:
             self.cc.locality[self.address] = dict(self.locality)
+        # the CC is a metrics source only while THIS host leads
+        # (ISSUE 15); registered into the worker's registry so the one
+        # per-process emitter carries it
+        cc_src = self.worker.metrics_registry.add_role(self.cc)
         self._leading = True
         cc_task = asyncio.get_running_loop().create_task(
             self._run_cc(), name=f"cc-{self.id}")
@@ -295,6 +303,7 @@ class ClusterHost:
                 db = RefreshingDatabase(view, self.coordinators)
                 d = DataDistributor(k, t, self.cc, db)
                 d.start()
+                self.worker.metrics_registry.add_role(d)
                 self.dd = d     # reachable for manual moves (RandomMoveKeys)
                 return d
 
@@ -328,6 +337,7 @@ class ClusterHost:
                     return
         finally:
             self._leading = False
+            self.worker.metrics_registry.unregister(cc_src)
             self.dd = None
             if k.DD_ENABLED:
                 dd_task.cancel()
@@ -336,6 +346,8 @@ class ClusterHost:
                 except BaseException:
                     dd = None
                 if dd is not None:
+                    self.worker.metrics_registry.unregister(
+                        dd.metrics_source())
                     await dd.stop()
             cc_task.cancel()
             await asyncio.gather(cc_task, return_exceptions=True)

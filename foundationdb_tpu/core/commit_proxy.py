@@ -132,7 +132,7 @@ class CommitProxy:
         # CommitDebug span events for sampled txns: queued / batch
         # milestones / reply, keyed by the wire-propagated trace id
         self.spans = _span.SpanSink("CommitProxy")
-        self._metrics_task = None
+        self._msource = None
         # fail-stop (see _repair_chain): once set, new commits are refused
         # and the role-liveness ping probes dead, driving an epoch recovery
         self._failed: BaseException | None = None
@@ -318,23 +318,29 @@ class CommitProxy:
         loop = asyncio.get_running_loop()
         self._batcher_task = loop.create_task(
             self._batcher_loop(), name="commit-proxy-batcher")
-        self._metrics_task = loop.create_task(
-            self._metrics_loop(), name="commit-proxy-metrics")
 
-    async def _metrics_loop(self) -> None:
-        while True:
-            await asyncio.sleep(self.knobs.METRICS_INTERVAL)
-            self.counters.log_metrics()
-            self.latency_hist.log_metrics()
+    def metrics_source(self):
+        """This role's registration in the per-worker MetricsRegistry
+        (ISSUE 15) — replaces the ad-hoc per-role metrics sleep loop.
+        Gauges: the proxy's acked frontier + metadata frontier and the
+        commit-path queue/in-flight depths (rising queue depth with flat
+        KnownCommitted is a wedged version chain at one glance)."""
+        if self._msource is None:
+            from ..runtime.metrics import MetricsSource
+            s = MetricsSource("ProxyCommit", counters=self.counters)
+            s.histogram(self.latency_hist)
+            s.gauge("KnownCommitted", lambda: self._known_committed)
+            s.gauge("StateAppliedVersion", lambda: self.state_applied_version)
+            s.gauge("QueueDepth", lambda: self._queue.qsize())
+            s.gauge("InflightBatches", lambda: len(self._inflight))
+            self._msource = s
+        return self._msource
 
     async def stop(self) -> None:
         tasks = list(self._inflight)
         if self._batcher_task is not None:
             tasks.append(self._batcher_task)
             self._batcher_task = None
-        if self._metrics_task is not None:
-            tasks.append(self._metrics_task)
-            self._metrics_task = None
         for t in tasks:
             t.cancel()
         await asyncio.gather(*tasks, return_exceptions=True)
@@ -351,11 +357,14 @@ class CommitProxy:
 
     async def metrics(self) -> dict:
         """Role counters for status (span rollup + commit load)."""
+        from ..runtime.profiler import stall_metrics
         return {
             "total_batches": self.total_batches,
             "total_committed": self.total_committed,
             "total_conflicts": self.total_conflicts,
+            "known_committed": self._known_committed,
             **self.spans.counters(),
+            **stall_metrics(),
         }
 
     async def commit(self, req: CommitTransactionRequest) -> CommitResult:

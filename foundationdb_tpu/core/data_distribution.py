@@ -113,6 +113,23 @@ class DataDistributor:
         # gray-failure avoidance (ISSUE 12): destination picks that
         # skipped a disk-degraded worker
         self.degraded_avoided = 0
+        self._msource = None
+
+    def metrics_source(self):
+        """DD's registration in the hosting worker's MetricsRegistry
+        (ISSUE 15): relocation counters over time — a split/move burst
+        is visible in the flight record even after the dd_stats publish
+        that carried it is superseded."""
+        if self._msource is None:
+            from ..runtime.metrics import MetricsSource
+            s = MetricsSource("DataDistribution")
+            s.gauge("Splits", lambda: self.splits_done)
+            s.gauge("LiveMoves", lambda: self.live_moves_done)
+            s.gauge("HeatSplits", lambda: self.heat_splits_done)
+            s.gauge("HeatMoves", lambda: self.heat_moves_done)
+            s.gauge("DegradedAvoided", lambda: self.degraded_avoided)
+            self._msource = s
+        return self._msource
 
     def stats(self) -> dict:
         """Relocation counters (published with every flip; see

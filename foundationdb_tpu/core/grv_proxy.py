@@ -39,13 +39,29 @@ class GrvProxy:
         # old bindings, sidecar probes — still shows up in the trace
         # file with GrvProxyServer.queued/reply timelines
         self._server_sampler = ServerSampler(namespace=1)
+        self._msource = None
+
+    def metrics_source(self):
+        """This role's registration in the per-worker MetricsRegistry
+        (ISSUE 15): GRV throughput plus the waiter queue depth (a
+        rising depth with flat TotalGrvs is admission wedging reads)."""
+        if self._msource is None:
+            from ..runtime.metrics import MetricsSource
+            s = MetricsSource("GrvProxy")
+            s.gauge("TotalGrvs", lambda: self.total_grvs)
+            s.gauge("SampledTxns", lambda: self.sampled_txns)
+            s.gauge("WaiterDepth", lambda: len(self._waiters))
+            self._msource = s
+        return self._msource
 
     async def metrics(self) -> dict:
         """Role counters for status (span rollup + GRV load)."""
+        from ..runtime.profiler import stall_metrics
         return {
             "total_grvs": self.total_grvs,
             "sampled_txns": self.sampled_txns,
             **self.spans.counters(),
+            **stall_metrics(),
         }
 
     async def get_read_version(self, lock_aware: bool = False,

@@ -67,6 +67,35 @@ class Ratekeeper:
         self._heat_armed: set[str] = set()
         self._last_heat_budgets: dict[str, float] = {}   # blind-tick hold
         self.hot_shards: list[dict] = []      # per-shard heat rank (status)
+        self._msource = None
+
+    async def metrics(self) -> dict:
+        """Admission picture for status pollers that speak the uniform
+        metrics surface (get_throttle remains the richer legacy RPC)."""
+        return {
+            "tps_limit": self.rate_tps,
+            "batch_tps_limit": self.batch_rate_tps,
+            "throttled_tags": len(self.tag_rates),
+            "heat_throttle_activations": self.heat_throttle_activations,
+            "reason": self.limiting_reason,
+        }
+
+    def metrics_source(self):
+        """This role's registration in the per-worker MetricsRegistry
+        (ISSUE 15): the admission budget over time — a falling TPSLimit
+        series with its LimitingReason IS the incident narrative the
+        point-in-time status poll could never show."""
+        if self._msource is None:
+            from ..runtime.metrics import MetricsSource
+            s = MetricsSource("Ratekeeper")
+            s.gauge("TPSLimit", lambda: round(self.rate_tps, 1))
+            s.gauge("BatchTPSLimit", lambda: round(self.batch_rate_tps, 1))
+            s.gauge("ThrottledTags", lambda: len(self.tag_rates))
+            s.gauge("HeatThrottleActivations",
+                    lambda: self.heat_throttle_activations)
+            s.gauge("LimitingReason", lambda: self.limiting_reason)
+            self._msource = s
+        return self._msource
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(

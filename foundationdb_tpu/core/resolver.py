@@ -77,6 +77,7 @@ class Resolver:
         self.stages = StageStats("Resolver")
         # CommitDebug span events for sampled batches (wire-propagated)
         self.spans = SpanSink("Resolver")
+        self._msource = None
         self._poisoned: BaseException | None = None
         # committed state transactions this epoch, in version order.  Kept
         # whole: state txns are rare (shard moves, config changes) and the
@@ -117,9 +118,33 @@ class Resolver:
                 # resolver's group_sizes regardless of which path ran
                 self.group_sizes = self._pipeline.group_sizes
 
+    def metrics_source(self):
+        """This role's registration in the per-worker MetricsRegistry
+        (ISSUE 15): the resolve frontier (the version chain's progress
+        through THIS resolver), batch/conflict totals, and the device
+        pipeline's queue/in-flight depth — the backlog half of the
+        ResolverDevice span events, now a continuous series."""
+        if self._msource is None:
+            from ..runtime.metrics import MetricsSource
+            s = MetricsSource("Resolver")
+            s.gauge("Version", lambda: self.version)
+            s.gauge("TotalBatches", lambda: self.total_batches)
+            s.gauge("TotalTxns", lambda: self.total_txns)
+            s.gauge("TotalConflicts", lambda: self.total_conflicts)
+            s.gauge("PendingBatches", lambda: len(self._pending))
+            s.gauge("DeviceQueueDepth",
+                    lambda: (len(self._pipeline._pending)
+                             if self._pipeline is not None else 0))
+            s.gauge("DeviceInflight",
+                    lambda: (len(self._pipeline._inflight)
+                             if self._pipeline is not None else 0))
+            self._msource = s
+        return self._msource
+
     async def metrics(self) -> dict:
         """Role counters for status (span rollup + resolve load +
         device-pipeline queue/in-flight depth — cluster.resolver_device)."""
+        from ..runtime.profiler import stall_metrics
         return {
             "total_batches": self.total_batches,
             "total_txns": self.total_txns,
@@ -127,6 +152,7 @@ class Resolver:
             **self.spans.counters(),
             **(self._pipeline.metrics() if self._pipeline is not None
                else {}),
+            **stall_metrics(),
         }
 
     async def close(self, discard: bool = False) -> None:

@@ -37,6 +37,31 @@ class Sequencer:
         # `locked` on GetReadVersionReply the same way).  Seeded from the
         # recovery's \xff read; versioned so stale reports can't regress.
         self._db_lock: tuple[Version, bytes | None] = (-1, db_lock_uid)
+        self._msource = None
+
+    async def metrics(self) -> dict:
+        """Version-authority frontiers for status and the cluster.lag
+        rollup (ISSUE 15): the assigned and committed frontiers are the
+        top of every lag computation — storage durability lag is
+        measured against the committed tip this role owns."""
+        return {
+            "last_assigned": self._last_assigned,
+            "committed": self._committed,
+            "locked": self.locked,
+        }
+
+    def metrics_source(self):
+        """This role's registration in the per-worker MetricsRegistry
+        (ISSUE 15): the version clock itself, recorded every interval —
+        the reference frontier every other role's lag is read against."""
+        if self._msource is None:
+            from ..runtime.metrics import MetricsSource
+            s = MetricsSource("Sequencer")
+            s.gauge("LastAssigned", lambda: self._last_assigned)
+            s.gauge("Committed", lambda: self._committed)
+            s.gauge("Locked", lambda: int(self.locked))
+            self._msource = s
+        return self._msource
 
     # --- epoch fencing ---
 

@@ -30,6 +30,93 @@ async def _probe(transport: Transport, addr: NetworkAddress,
         return False
 
 
+def lag_rollup(roles: list[dict], knobs: Knobs) -> dict:
+    """``cluster.lag`` (ISSUE 15): the version-frontier picture across
+    every role, computed from the SAME metrics() surfaces the RPC
+    pollers serve — and from the same gauges the MetricsRegistry
+    records every interval, so a live status poll and a post-hoc
+    ``metrics_tool lag`` replay of the trace file agree by
+    construction.
+
+    - ``worst_durability_lag_versions``: max(applied - durable) across
+      durable storage — the ratekeeper's falloff input, now visible.
+    - ``worst_storage_queue_bytes`` / ``worst_tlog_queue_bytes``: the
+      depth halves of the same falloff.
+    - ``window_occupancy``: worst (applied - oldest) / MVCC window —
+      at ~1.0 reads at the window floor start dying TransactionTooOld.
+    - ``frontier_skew_versions``: spread of applied tips across storage
+      (one replica falling behind its peers — the gray-failure shape).
+    - ``committed_minus_applied``: sequencer committed tip vs the
+      laggiest storage applied tip (end-to-end pipeline lag).
+    """
+    sm = [r.get("metrics") for r in roles
+          if r["role"] == "storage" and r.get("metrics")]
+    tm = [r.get("metrics") for r in roles
+          if r["role"] == "log" and r.get("metrics")]
+    seq = next((r.get("metrics") for r in roles
+                if r["role"] == "sequencer" and r.get("metrics")), None)
+    durable = [m for m in sm if m.get("durable_engine")]
+    versions = [m["version"] for m in sm if "version" in m]
+    worst_lag = max((m["version"] - m["durable_version"]
+                     for m in durable), default=0)
+    occ = max(((m["version"] - m.get("oldest_version", m["version"]))
+               / max(1, knobs.STORAGE_VERSION_WINDOW) for m in sm),
+              default=0.0)
+    committed = seq.get("committed") if seq else None
+    if committed is None:
+        committed = max((m.get("known_committed", 0) for m in tm),
+                        default=0) or None
+    return {
+        "worst_durability_lag_versions": worst_lag,
+        "worst_durability_lag_tag": next(
+            (m["tag"] for m in durable
+             if m["version"] - m["durable_version"] == worst_lag), None)
+        if durable and worst_lag else None,
+        "worst_storage_queue_bytes": max(
+            (m.get("queue_bytes", 0) for m in sm), default=0),
+        "worst_tlog_queue_bytes": max(
+            (m.get("queue_bytes", 0) for m in tm), default=0),
+        "window_occupancy": round(occ, 4),
+        "frontier_skew_versions":
+            (max(versions) - min(versions)) if versions else 0,
+        "committed_version": committed,
+        "committed_minus_applied":
+            (committed - min(versions)) if committed is not None and versions
+            else 0,
+        "tlog_tip_minus_popped": max(
+            (m["version"] - m.get("popped", 0) for m in tm
+             if m.get("popped", 0) > 0), default=0),
+        "storage_durable_floor": min(
+            (m["durable_version"] for m in durable), default=0),
+    }
+
+
+def slow_task_rollup(roles: list[dict]) -> dict:
+    """Event-loop stall rollup (ISSUE 15 satellite): every role's
+    metrics() splats its hosting process's SlowTaskProfiler counters,
+    grouped here by machine IP (one process per sim machine) — the
+    r5-class loop-occupancy incident at one glance instead of a grep
+    for SlowTask events."""
+    by_ip: dict[str, dict] = {}
+    for r in roles:
+        m = r.get("metrics") or {}
+        if "slow_task_stalls" not in m:
+            continue
+        ip = r["addr"][0]
+        e = by_ip.setdefault(ip, {"ip": ip, "stalls": 0,
+                                  "last_stall_ms": 0.0})
+        e["stalls"] = max(e["stalls"], m["slow_task_stalls"])
+        e["last_stall_ms"] = max(e["last_stall_ms"],
+                                 m.get("slow_task_last_stall_ms", 0.0))
+    procs = sorted(by_ip.values(), key=lambda e: -e["stalls"])
+    return {
+        "processes": procs,
+        "total_stalls": sum(e["stalls"] for e in procs),
+        "worst_stall_ms": max((e["last_stall_ms"] for e in procs),
+                              default=0.0),
+    }
+
+
 async def cluster_status(knobs: Knobs, transport: Transport,
                          coordinators: list) -> dict:
     """Build the status document from the latest published cluster state."""
@@ -40,7 +127,8 @@ async def cluster_status(knobs: Knobs, transport: Transport,
         return NetworkAddress(a[0], a[1])
 
     roles: list[dict] = []
-    roles.append({"role": "sequencer", "addr": list(state["sequencer"]["addr"])})
+    roles.append({"role": "sequencer", "addr": list(state["sequencer"]["addr"]),
+                  "token": state["sequencer"]["token"]})
     gen = state["log_cfg"][-1]
     for i, a in enumerate(gen["tlogs"]):
         roles.append({"role": "log", "addr": list(a),
@@ -72,8 +160,12 @@ async def cluster_status(knobs: Knobs, transport: Transport,
 
     # pull metrics from reachable metric-bearing roles
     async def enrich(r: dict) -> None:
+        from ..rpc.stubs import SequencerClient
         try:
-            if r["role"] == "storage":
+            if r["role"] == "sequencer":
+                sq = SequencerClient(transport, addr(r["addr"]), r["token"])
+                r["metrics"] = await asyncio.wait_for(sq.metrics(), timeout=t)
+            elif r["role"] == "storage":
                 sc = StorageClient(transport, addr(r["addr"]), r["token"],
                                    r["tag"], KeyRange(r["begin"], r["end"]))
                 r["metrics"] = await asyncio.wait_for(sc.metrics(), timeout=t)
@@ -384,6 +476,11 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             "backup": backup_rollup,
             "degraded": degraded_rollup,
             "tracing": tracing_rollup,
+            # the version-frontier picture (ISSUE 15): computed from the
+            # same registry-backed metrics the trace file records every
+            # interval, so status-now and metrics_tool-replay agree
+            "lag": lag_rollup(roles, knobs),
+            "slow_tasks": slow_task_rollup(roles),
         },
         "roles": roles,
         "shards": {

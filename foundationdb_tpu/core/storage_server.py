@@ -19,6 +19,7 @@ from ..runtime.errors import FutureVersion, TransactionTooOld
 from ..runtime.knobs import Knobs
 from ..runtime.latency_probe import StageStats
 from ..runtime.profiler import RateMeter
+from ..runtime.profiler import stall_metrics as _stall_metrics
 from ..runtime.span import SpanSink, child_scope, current_span
 from ..runtime.trace import Severity, TraceEvent, get_trace_log
 from ..storage.kv_store import OP_CLEAR, OP_SET
@@ -120,8 +121,8 @@ class StorageServer:
         from .shard_load import ShardHeatTracker
         self.heat = ShardHeatTracker(knobs, tag)
         from ..runtime.trace import CounterCollection
-        self.counters = CounterCollection("StorageMetrics", str(tag))
-        self._metrics_task = None
+        self.counters = CounterCollection("Storage", str(tag))
+        self._msource = None
         # apply-path observability (the r5 bench collapse was invisible
         # until a SlowTask fired; these make the next regression a
         # metric, not a timeout): per-batch apply timing + batch sizes
@@ -226,6 +227,8 @@ class StorageServer:
             "queue_bytes": self.bytes_input - self.bytes_durable,
             "version": self.version,
             "durable_version": self.durable_version,
+            "oldest_version": self.oldest_version,
+            "known_committed": self.known_committed,
             "bytes_input": self.bytes_input,
             "logical_bytes": self.logical_bytes,
             "shard_begin": self.shard.begin,
@@ -259,6 +262,9 @@ class StorageServer:
             **self.spans.counters(),
             **(self._device_reads.metrics()
                if self._device_reads is not None else {}),
+            # slow-task stalls of the hosting process (ISSUE 15
+            # satellite): empty under sim / when no profiler is armed
+            **_stall_metrics(),
         }
 
     async def shard_metrics(self) -> dict:
@@ -288,23 +294,61 @@ class StorageServer:
         if self.engine is not None:
             self._durability_task = loop.create_task(
                 self._durability_loop(), name=f"storage-{self.tag}-durability")
-        self._metrics_task = loop.create_task(
-            self._metrics_loop(), name=f"storage-{self.tag}-metrics")
 
-    async def _metrics_loop(self) -> None:
-        while True:
-            await asyncio.sleep(self.knobs.METRICS_INTERVAL)
-            c = self.counters
-            c.counter("BytesInput").value = self.bytes_input
-            c.counter("BytesDurable").value = self.bytes_durable
-            c.counter("FinishedQueries").value = self.total_reads
-            c.counter("Version").value = self.version
-            c.counter("MutationsApplied").value = self.apply_meter.count
-            c.counter("IndexMerges").value = self.vmap.index_stats()["merges"]
-            c.log_metrics()
+    def metrics_source(self):
+        """This role's registration in the per-worker MetricsRegistry
+        (ISSUE 15) — replaces the ad-hoc per-role metrics sleep loop.
+        The version frontiers the ratekeeper reads every interval
+        (applied/durable/popped-floor/known-committed) are now RECORDED
+        every interval, so a durability-lag incident can be replayed
+        from the trace file (metrics_tool lag) instead of reproduced
+        under a live status poll.  MVCC window occupancy, the
+        durability-ring spill state and the lsm compaction debt ride
+        the same series."""
+        if self._msource is None:
+            from ..runtime.metrics import MetricsSource
+            s = MetricsSource("Storage", counters=self.counters)
+            s.meter(self.apply_meter)
+            # engine-less servers never run the durability loop, so
+            # their DurableVersion freezes at v0 — the marker lets lag
+            # tooling skip them exactly like the ratekeeper does
+            s.gauge("DurableEngine", lambda: int(self.engine is not None))
+            s.gauge("Version", lambda: self.version)
+            s.gauge("DurableVersion", lambda: self.durable_version)
+            s.gauge("OldestVersion", lambda: self.oldest_version)
+            s.gauge("KnownCommitted", lambda: self.known_committed)
+            s.gauge("QueueBytes",
+                    lambda: self.bytes_input - self.bytes_durable)
+            s.gauge("BytesInput", lambda: self.bytes_input)
+            s.gauge("BytesDurable", lambda: self.bytes_durable)
+            s.gauge("FinishedQueries", lambda: self.total_reads)
+            s.gauge("LogicalBytes", lambda: self.logical_bytes)
+            s.gauge("IndexMerges",
+                    lambda: self.vmap.index_stats()["merges"])
+            # window occupancy: versions resident in the MVCC window +
+            # the columnar shape (0 segments under the legacy twin)
+            s.gauge("WindowVersions",
+                    lambda: self.version - self.oldest_version)
+            s.gauge("MvccSegments",
+                    lambda: self.vmap.index_stats().get("segments", 0))
+            s.gauge("MvccResidentBytes",
+                    lambda: self.vmap.index_stats().get("resident_bytes", 0))
+            s.gauge("DbufMemBytes", lambda: self._dbuf.mem_bytes)
+            s.gauge("DbufSpilledBytes", lambda: self._dbuf.spilled_bytes)
+            # engine-side compaction debt (lsm only; 0 elsewhere).
+            # NOT named "LsmCompact*": the determinism children count
+            # b"LsmCompact" to prove the background compactor ran, and
+            # a gauge matching the substring would count as compactions
+            s.gauge("CompactDebtBytes",
+                    lambda: (self.engine.metrics().get(
+                        "lsm_compact_debt_bytes", 0)
+                        if self.engine is not None
+                        and hasattr(self.engine, "metrics") else 0))
+            self._msource = s
+        return self._msource
 
     async def stop(self) -> None:
-        for attr in ("_pull_task", "_durability_task", "_metrics_task",
+        for attr in ("_pull_task", "_durability_task",
                      "_fetch_task"):
             t = getattr(self, attr)
             if t is not None:

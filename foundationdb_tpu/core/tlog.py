@@ -138,6 +138,7 @@ class TLog:
         self.total_bytes = 0
         # CommitDebug span events for sampled pushes (wire-propagated)
         self.spans = SpanSink("TLog")
+        self._msource = None
 
     @classmethod
     async def open(cls, knobs: Knobs, fs, path: str,
@@ -184,20 +185,54 @@ class TLog:
     def mem_bytes(self) -> int:
         return sum(st.mem_bytes for st in self._log.values())
 
+    def _popped_frontier(self) -> Version:
+        """The slowest hosted tag's pop floor — how far behind durability
+        the laggiest storage consumer of this log runs (0 until every
+        hosted tag has popped at least once)."""
+        if not self._hosted:
+            return 0
+        return min(self._poppable.get(t, 0) for t in self._hosted)
+
     async def metrics(self) -> dict:
         """Queue sample for the Ratekeeper (TLogQueuingMetrics analog).
         Durable logs also publish their disk's decayed latency +
         degraded flag (ISSUE 12 gray-failure signal — the TLog fsyncs
         on every commit, so a stalling disk shows up here first)."""
+        from ..runtime.profiler import stall_metrics
         health = getattr(getattr(self.queue, "file", None), "health", None)
         return {
             "queue_bytes": self.queue.bytes_used if self.queue is not None else 0,
             "mem_bytes": self.mem_bytes,
             "version": self.version,
+            "known_committed": self.known_committed,
+            "popped": self._popped_frontier(),
             "locked": self.locked,
             **(health.snapshot() if health is not None else {}),
             **self.spans.counters(),
+            **stall_metrics(),
         }
+
+    def metrics_source(self):
+        """This role's registration in the per-worker MetricsRegistry
+        (ISSUE 15): the log's version frontiers (tip / known-committed /
+        popped floor) and queue depths, recorded every interval — the
+        TLog half of the durability-lag flight record (a growing
+        tip-minus-popped gap IS a storage consumer falling behind)."""
+        if self._msource is None:
+            from ..runtime.metrics import MetricsSource
+            s = MetricsSource("TLog")
+            s.gauge("Version", lambda: self.version)
+            s.gauge("KnownCommitted", lambda: self.known_committed)
+            s.gauge("Popped", lambda: self._popped_frontier())
+            s.gauge("QueueBytes",
+                    lambda: self.queue.bytes_used
+                    if self.queue is not None else 0)
+            s.gauge("MemBytes", lambda: self.mem_bytes)
+            s.gauge("TotalPushes", lambda: self.total_pushes)
+            s.gauge("TotalBytes", lambda: self.total_bytes)
+            s.gauge("Locked", lambda: int(self.locked))
+            self._msource = s
+        return self._msource
 
     async def _wait_for_version(self, prev_version: Version) -> None:
         if self.version >= prev_version:

@@ -93,7 +93,42 @@ class Worker:
         # committed generation's copy of the same (epoch, index) — same
         # versions, different content ⇒ replica divergence if adopted.
         self.resident_tlogs: dict[tuple[int, int, int | None], int] = {}
+        # the metrics plane (ISSUE 15): every hosted role registers its
+        # MetricsSource here; ONE emitter actor per worker drains the
+        # registry every METRICS_INTERVAL (started lazily — recruit and
+        # reboot adoption are the first async entry points).  The worker
+        # itself is a source: hosted-role count, disk health and the
+        # process's SlowTask stalls.
+        from ..runtime.metrics import MetricsRegistry, MetricsSource
+        self.metrics_registry = MetricsRegistry()
+        ws = MetricsSource("Worker", str(worker_id))
+        ws.gauge("HostedRoles", lambda: len(self.roles))
+        ws.gauge("SlowTaskStalls", self._profiler_stalls)
+        ws.gauge("DiskLatencyMs", self._disk_latency_ms)
+        self.metrics_registry.register(ws)
+        self._role_sources: dict[int, object] = {}    # token -> MetricsSource
         serve_role(transport, "worker", self, base_token)
+
+    @staticmethod
+    def _profiler_stalls() -> int:
+        from ..runtime.profiler import active_profiler
+        p = active_profiler()
+        return p.stalls if p is not None else 0
+
+    def _disk_latency_ms(self) -> float:
+        health = getattr(self.fs, "health", None) if self.fs is not None \
+            else None
+        return health.snapshot()["disk_latency_ms"] if health is not None \
+            else 0.0
+
+    def _ensure_emitter(self) -> None:
+        if self.knobs.METRICS_EMITTER:
+            self.metrics_registry.start_emitter(self.knobs.METRICS_INTERVAL)
+
+    def _register_role_metrics(self, token: int, obj) -> None:
+        src = self.metrics_registry.add_role(obj, default_id=str(token))
+        if src is not None:
+            self._role_sources[token] = src
 
     def _engine_cls(self, name: str | None = None):
         from ..storage import engine_class
@@ -114,6 +149,7 @@ class Worker:
             tag = rest.split(".", 1)[0]
             if tag.isdigit():
                 tags.add(int(tag))
+        self._ensure_emitter()
         for tag in sorted(tags):
             if tag in self.resident:
                 continue    # a retried adoption pass (transient IoError
@@ -145,6 +181,7 @@ class Worker:
             serve_role(self.transport, "storage", ss, token)
             self.roles[token] = ("storage", ss)
             self.resident[tag] = token
+            self._register_role_metrics(token, ss)
             TraceEvent("WorkerResidentStorage").detail("Worker", self.id) \
                 .detail("Tag", tag).detail("Token", token).log()
         # durable TLogs: reopen each generation copy LOCKED (old
@@ -173,6 +210,7 @@ class Worker:
             serve_role(self.transport, "tlog", tlog, token)
             self.roles[token] = ("tlog", tlog)
             self.resident_tlogs[key] = token
+            self._register_role_metrics(token, tlog)
             TraceEvent("WorkerResidentTLog").detail("Worker", self.id) \
                 .detail("Epoch", key[0]).detail("Index", key[1]) \
                 .detail("Tip", tlog.version).detail("Token", token).log()
@@ -262,6 +300,8 @@ class Worker:
             self.resident[params["tag"]] = token
         serve_role(self.transport, role, obj, token)
         self.roles[token] = (role, obj)
+        self._register_role_metrics(token, obj)
+        self._ensure_emitter()
         if hasattr(obj, "start"):
             obj.start()
         TraceEvent("WorkerRecruited").detail("Worker", self.id) \
@@ -297,6 +337,7 @@ class Worker:
         if entry is None:
             return False
         role, obj = entry
+        self.metrics_registry.unregister(self._role_sources.pop(token, None))
         for i in range(TOKEN_BLOCK):
             self.transport.dispatcher.unregister(token + i)
         if role == "storage":
@@ -347,15 +388,21 @@ class Worker:
         feeds the answer into its FailureMonitor's degraded state so
         recruitment and DD move destinations can route around a
         slow-but-alive disk.  Diskless workers report healthy."""
+        from ..runtime.profiler import stall_metrics
         health = getattr(self.fs, "health", None) if self.fs is not None \
             else None
-        if health is None:
-            return {"disk_latency_ms": 0.0, "disk_degraded": False}
-        return health.snapshot()
+        base = {"disk_latency_ms": 0.0, "disk_degraded": False} \
+            if health is None else health.snapshot()
+        # piggyback the process's SlowTask stalls (ISSUE 15 satellite):
+        # the CC's health poll is the one place every worker is already
+        # interrogated, so event-loop occupancy incidents reach the
+        # controller without a new RPC surface
+        return {**base, **stall_metrics()}
 
     # --- shutdown (machine kill) ---
 
     async def shutdown(self) -> None:
+        await self.metrics_registry.stop_emitter()
         for token in list(self.roles):
             await self.stop_role(token)
 

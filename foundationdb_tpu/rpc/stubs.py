@@ -20,10 +20,12 @@ from .transport import Endpoint, NetworkAddress, Transport
 
 # method table per role: (name, oneway?)
 ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
+    # metrics appended LAST (ISSUE 15): token layout is base+index, so
+    # new methods must never reorder existing slots
     "sequencer": [("get_commit_version", False),
                   ("get_live_committed_version", False),
                   ("report_committed", True), ("lock", False),
-                  ("report_lock", True)],
+                  ("report_lock", True), ("metrics", False)],
     "resolver": [("resolve", False), ("metrics", False)],
     "tlog": [("push", False), ("peek", False), ("pop", True),
              ("lock", False), ("metrics", False)],
@@ -41,8 +43,10 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
     # must never reorder existing slots
     "commit_proxy": [("commit", False), ("metrics", False)],
     "grv_proxy": [("get_read_version", False), ("metrics", False)],
+    # metrics appended LAST (ISSUE 15)
     "ratekeeper": [("admit", False), ("get_rate", False),
-                   ("get_throttle", False), ("set_tag_throttle", False)],
+                   ("get_throttle", False), ("set_tag_throttle", False),
+                   ("metrics", False)],
     "coordinator": [("read", False), ("write", False),
                     ("nominate", False), ("confirm", False),
                     ("withdraw", False), ("leader_heartbeat", False),

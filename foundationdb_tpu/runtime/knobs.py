@@ -308,6 +308,14 @@ class Knobs:
 
     # --- observability ---
     METRICS_INTERVAL: float = 5.0             # role *Metrics emit period
+    # the continuous metrics plane (ISSUE 15): every role registers its
+    # counters/histograms/gauges in the hosting process's
+    # MetricsRegistry, and ONE per-worker emitter actor drains them
+    # every METRICS_INTERVAL on the loop clock (sim-deterministic).
+    # Off = registry still populated (status snapshots work) but no
+    # periodic *Metrics emission — the A/B twin the observe smoke and
+    # the determinism children measure against.
+    METRICS_EMITTER: bool = True
 
     # --- ratekeeper ---
     RATEKEEPER_UPDATE_INTERVAL: float = 0.25

@@ -28,9 +28,24 @@ from .knobs import Knobs
 from .trace import TraceEvent
 
 
+def _meter_clock() -> float:
+    """The running event loop's clock when one exists, else monotonic.
+
+    The ``_default_clock`` pattern from trace.py: on a real asyncio loop
+    ``loop.time()`` IS the monotonic clock, so behavior is unchanged —
+    but under ``SimEventLoop`` it is the virtual clock, so a RateMeter's
+    ``per_sec`` measures virtual-time work against virtual time instead
+    of clocking wall seconds against instantly-advancing sim work
+    (which made every sim-run rate gauge nonsense)."""
+    try:
+        return asyncio.get_running_loop().time()
+    except RuntimeError:
+        return time.monotonic()
+
+
 class RateMeter:
     """Hot-path throughput counter: total count, batch count, and
-    wall-clock rate — no locks, no per-event timestamps, safe to bump
+    clock rate — no locks, no per-event timestamps, safe to bump
     from the apply path at millions of events/sec.  The storage role
     uses one for ``mutations_applied`` so an apply-throughput regression
     (the r5 O(n²) index collapse) shows up as a falling rate in status
@@ -38,13 +53,14 @@ class RateMeter:
 
     _WINDOW_S = 5.0
 
-    __slots__ = ("name", "count", "batches", "_t0", "_m0", "_m1")
+    __slots__ = ("name", "count", "batches", "_t0", "_m0", "_m1", "_clock")
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str, clock=None) -> None:
         self.name = name
         self.count = 0
         self.batches = 0
-        self._t0 = time.monotonic()
+        self._clock = clock or _meter_clock
+        self._t0 = self._clock()
         # rolling window marks (time, count): per_sec is measured against
         # a 5-10s trailing mark, NOT a per-reader delta — multiple pollers
         # (ratekeeper, status) would otherwise shrink each other's window
@@ -58,21 +74,57 @@ class RateMeter:
         self.batches += 1
 
     def snapshot(self) -> dict:
-        now = time.monotonic()
+        now = self._clock()
+        if now < self._t0:
+            # clock base changed under us: constructed before a virtual-
+            # time loop existed (monotonic anchor), sampled inside it
+            # (virtual now).  Re-anchor instead of dividing the whole
+            # count by the 1e-9 clamp — rates read 0 for one interval,
+            # then measure virtual time like everything else.
+            self._t0 = now
+            self._m0 = (now, self.count)
+            self._m1 = (now, self.count)
         if now - self._m1[0] >= self._WINDOW_S:
             self._m0 = self._m1
             self._m1 = (now, self.count)
         t0, c0 = self._m0
-        recent = (self.count - c0) / max(now - t0, 1e-9)
+        dt_recent = now - t0
+        dt_life = now - self._t0
+        recent = (self.count - c0) / dt_recent if dt_recent > 1e-9 else 0.0
         return {
             "count": self.count,
             "batches": self.batches,
             "per_sec": round(recent, 1),
             "per_sec_lifetime":
-                round(self.count / max(now - self._t0, 1e-9), 1),
+                round(self.count / dt_life, 1) if dt_life > 1e-9 else 0.0,
             "mean_batch": round(self.count / self.batches, 1)
             if self.batches else 0.0,
         }
+
+
+# the process's live profiler (set by start(), cleared by stop()): roles
+# splat stall_metrics() into their metrics() replies so the r5-class
+# event-loop-occupancy incident reaches the status rollup at one glance
+# instead of living only in the SlowTask trace events
+_ACTIVE: "SlowTaskProfiler | None" = None
+
+
+def active_profiler() -> "SlowTaskProfiler | None":
+    return _ACTIVE
+
+
+def stall_metrics() -> dict:
+    """The process's slow-task counters for role metrics() surfaces:
+    empty when no profiler is armed (sim runs — virtual time never
+    stalls), so knob-default sim metrics stay byte-identical."""
+    p = _ACTIVE
+    if p is None or p._watchdog is None:
+        return {}
+    return {
+        "slow_task_stalls": p.stalls,
+        "slow_task_last_stall_ms":
+            round((p.last_stall_s or 0.0) * 1e3, 1),
+    }
 
 
 class SlowTaskProfiler:
@@ -100,6 +152,7 @@ class SlowTaskProfiler:
             await asyncio.sleep(self.interval)
 
     def start(self) -> "SlowTaskProfiler":
+        global _ACTIVE
         from .simloop import SimEventLoop
         loop = asyncio.get_running_loop()
         if isinstance(loop, SimEventLoop):
@@ -111,13 +164,17 @@ class SlowTaskProfiler:
         self._watchdog = threading.Thread(
             target=self._watch, daemon=True, name="slow-task-watchdog")
         self._watchdog.start()
+        _ACTIVE = self
         return self
 
     def stop(self) -> None:
+        global _ACTIVE
         self._stop.set()
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             self._heartbeat_task = None
+        if _ACTIVE is self:
+            _ACTIVE = None
 
     # --- watchdog thread ---
 

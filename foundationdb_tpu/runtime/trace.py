@@ -217,11 +217,16 @@ class Histogram:
         self.total = 0.0
         self.min = self.max = None
 
-    def log_metrics(self, log: Optional[TraceLog] = None) -> None:
+    def log_metrics(self, log: Optional[TraceLog] = None,
+                    id_: str = "") -> None:
         if self.count == 0:
             return
-        TraceEvent(f"Histogram{self.group}{self.op}", log=log or _GLOBAL) \
-            .detail("Unit", self.unit).detail("Count", self.count) \
+        ev = TraceEvent(f"Histogram{self.group}{self.op}", log=log or _GLOBAL)
+        if id_:
+            # instance id (the metrics plane passes its source id) so two
+            # proxies' latency series don't merge in trace tooling
+            ev.detail("ID", id_)
+        ev.detail("Unit", self.unit).detail("Count", self.count) \
             .detail("Min", round(self.min or 0, 1)) \
             .detail("Max", round(self.max or 0, 1)) \
             .detail("Mean", round(self.total / self.count, 1)) \
@@ -245,7 +250,11 @@ class CounterCollection:
             c = self.counters[name] = Counter(name)
         return c
 
-    def log_metrics(self, log: Optional[TraceLog] = None) -> None:
+    def log_metrics(self, log: Optional[TraceLog] = None,
+                    extra: Optional[dict] = None) -> None:
+        """Emit one ``<Name>Metrics`` event: counter values + per-interval
+        rates, plus ``extra`` details (the metrics plane folds gauge and
+        meter samples in here so one series carries the whole source)."""
         lg = log or _GLOBAL
         now = lg.clock()
         ev = TraceEvent(f"{self.name}Metrics", log=lg).detail("ID", self.id)
@@ -256,4 +265,6 @@ class CounterCollection:
                 ev.detail(f"{n}Rate", round((c.value - self._last_values.get(n, 0)) / dt, 3))
             self._last_values[n] = c.value
         self._last_time = now
+        for k, v in (extra or {}).items():
+            ev.detail(k, v)
         ev.log()

@@ -1,0 +1,287 @@
+"""The metrics plane's primitives (ISSUE 15 satellite): the until-now-
+untested trace.py stats instruments — Histogram bucket/percentile edges,
+CounterCollection rate computation across emits — plus MetricsRegistry
+emission determinism under the sim clock and the RateMeter virtual-time
+fix."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.metrics import MetricsRegistry, MetricsSource
+from foundationdb_tpu.runtime.profiler import RateMeter
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.runtime.trace import (CounterCollection, Histogram,
+                                            TraceLog)
+
+
+def _sink_log(events: list, clock=None) -> TraceLog:
+    log = TraceLog(clock=clock or (lambda: 0.0))
+    log.sink = events.append
+    return log
+
+
+# --- Histogram edges ---
+
+
+def test_histogram_bucket_edges():
+    h = Histogram("T", "Op")
+    # sub-1 samples land in bucket 0 (the [1, 2) bucket's floor clamp)
+    h.sample(0.25)
+    assert h.buckets[0] == 1
+    h.sample(1.0)       # [1, 2)
+    assert h.buckets[0] == 2
+    h.sample(2.0)       # [2, 4) -> bucket 1
+    assert h.buckets[1] == 1
+    h.sample(3.9)
+    assert h.buckets[1] == 2
+    # a huge sample clamps into the last bucket instead of overflowing
+    h.sample(float(1 << 40))
+    assert h.buckets[31] == 1
+    assert h.count == 5
+    assert h.min == 0.25 and h.max == float(1 << 40)
+
+
+def test_histogram_percentile_edges():
+    h = Histogram("T", "Op")
+    assert h.percentile(0.5) == 0.0         # empty: 0, not a crash
+    for _ in range(99):
+        h.sample(1.0)                       # bucket 0, upper bound 2
+    h.sample(100.0)                         # bucket 6, upper bound 128
+    assert h.percentile(0.5) == 2.0
+    assert h.percentile(0.99) == 2.0
+    assert h.percentile(1.0) == 128.0       # the tail sample's bucket
+
+
+def test_histogram_clear_on_log():
+    events: list[dict] = []
+    log = _sink_log(events)
+    h = Histogram("Grp", "Lat")
+    h.log_metrics(log)
+    assert events == []                     # empty histogram: no event
+    h.sample(10.0)
+    h.sample(20.0)
+    h.log_metrics(log)
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["Type"] == "HistogramGrpLat" and ev["Count"] == 2
+    assert ev["Min"] == 10.0 and ev["Max"] == 20.0
+    # the emission cleared the interval: counts, extremes, buckets
+    assert h.count == 0 and h.min is None and h.max is None
+    assert sum(h.buckets) == 0
+    h.log_metrics(log)
+    assert len(events) == 1                 # nothing to re-emit
+
+
+# --- CounterCollection rates ---
+
+
+def test_counter_collection_rates_across_emits():
+    events: list[dict] = []
+    t = {"now": 0.0}
+    log = _sink_log(events, clock=lambda: t["now"])
+    cc = CounterCollection("Probe", "7")
+    cc.counter("Ops").add(10)
+    cc.log_metrics(log)
+    # first emit: absolute values only — no interval exists yet
+    assert events[0]["Ops"] == 10 and "OpsRate" not in events[0]
+    cc.counter("Ops").add(30)
+    t["now"] = 2.0
+    cc.log_metrics(log)
+    assert events[1]["Ops"] == 40
+    assert events[1]["OpsRate"] == 15.0     # 30 more over 2 seconds
+    # a counter created between emits rates against the full interval
+    cc.counter("Late").add(8)
+    t["now"] = 6.0
+    cc.log_metrics(log)
+    assert events[2]["LateRate"] == 2.0     # 8 over 4 seconds
+    assert events[2]["OpsRate"] == 0.0
+    # extra details (the registry's gauge fold) ride the same event
+    t["now"] = 7.0
+    cc.log_metrics(log, extra={"Gauge": 42})
+    assert events[3]["Gauge"] == 42 and events[3]["ID"] == "7"
+
+
+# --- MetricsRegistry ---
+
+
+def test_registry_emission_order_and_gauges():
+    events: list[dict] = []
+    log = _sink_log(events)
+    reg = MetricsRegistry()
+    a = MetricsSource("Alpha", "1").gauge("V", lambda: 11)
+    b = MetricsSource("Beta", "2").gauge("V", lambda: 22)
+    boom = MetricsSource("Gamma", "3") \
+        .gauge("Bad", lambda: 1 / 0).gauge("Good", lambda: 33)
+    reg.register(a)
+    reg.register(b)
+    reg.register(boom)
+    reg.emit_all(log)
+    # registration order IS emission order (the determinism contract)
+    assert [e["Type"] for e in events] == \
+        ["AlphaMetrics", "BetaMetrics", "GammaMetrics"]
+    assert events[0]["V"] == 11 and events[1]["V"] == 22
+    # a raising gauge is skipped, its siblings survive
+    assert "Bad" not in events[2] and events[2]["Good"] == 33
+    # unregister removes the series
+    events.clear()
+    reg.unregister(b)
+    reg.emit_all(log)
+    assert [e["Type"] for e in events] == ["AlphaMetrics", "GammaMetrics"]
+    snap = reg.snapshot()
+    assert snap["Alpha/1"]["V"] == 11
+
+
+def _registry_sim_run(seed: int) -> list[str]:
+    """One seeded sim run of an emitter over two sources; returns the
+    JSON-serialized event stream."""
+    from foundationdb_tpu.runtime import trace as trace_mod
+
+    events: list[dict] = []
+    prev = trace_mod.get_trace_log()
+    log = TraceLog()                # loop-clock default under the sim
+    log.sink = events.append
+    trace_mod.set_trace_log(log)
+    try:
+        async def main():
+            reg = MetricsRegistry()
+            state = {"n": 0}
+            reg.register(MetricsSource("RoleA", "0")
+                         .gauge("N", lambda: state["n"]))
+            reg.register(MetricsSource("RoleB", "1")
+                         .gauge("Twice", lambda: 2 * state["n"]))
+            reg.start_emitter(0.5)
+            for _ in range(20):
+                state["n"] += 1
+                await asyncio.sleep(0.2)
+            await reg.stop_emitter()
+
+        run_simulation(main(), seed=seed)
+    finally:
+        trace_mod.set_trace_log(prev)
+    return [json.dumps(e, sort_keys=True) for e in events]
+
+
+def test_registry_emission_deterministic_under_sim_clock():
+    """Same seed → byte-identical *Metrics streams (ISSUE 15: the plane
+    must never perturb the standing bit-identical discipline)."""
+    a = _registry_sim_run(42)
+    b = _registry_sim_run(42)
+    assert a and a == b
+
+
+def test_registry_emitter_runs_on_virtual_cadence():
+    """The emitter's sleep rides the sim clock: 10 virtual seconds at a
+    1s interval is exactly 10 passes, in wall milliseconds."""
+    from foundationdb_tpu.runtime import trace as trace_mod
+
+    events: list[dict] = []
+    prev = trace_mod.get_trace_log()
+    log = TraceLog()
+    log.sink = events.append
+    trace_mod.set_trace_log(log)
+    try:
+        async def main():
+            reg = MetricsRegistry()
+            reg.register(MetricsSource("Tick", "0").gauge("One", lambda: 1))
+            reg.start_emitter(1.0)
+            await asyncio.sleep(10.05)
+            await reg.stop_emitter()
+            return reg.emissions
+
+        emissions = run_simulation(main())
+    finally:
+        trace_mod.set_trace_log(prev)
+    assert emissions == 10
+    ticks = [e for e in events if e["Type"] == "TickMetrics"]
+    assert len(ticks) == 10
+    times = [e["Time"] for e in ticks]
+    assert times == [round(float(i), 6) for i in range(1, 11)]
+
+
+# --- RateMeter under the sim clock (ISSUE 15 satellite) ---
+
+
+def test_rate_meter_uses_virtual_time_under_sim():
+    """Before the clock injection a sim-run meter divided virtual-time
+    work by ~zero wall seconds (nonsense rates); now per_sec is the
+    virtual-time rate."""
+    async def main():
+        m = RateMeter("probe")
+        for _ in range(10):
+            m.add(100)
+            await asyncio.sleep(1.0)
+        return m.snapshot()
+
+    snap = run_simulation(main())
+    assert snap["count"] == 1000
+    # 1000 events over 10 virtual seconds: the lifetime rate is exactly
+    # 100/s, and the windowed rate is in the same decade (its trailing
+    # mark rotates on the 5s window)
+    assert snap["per_sec_lifetime"] == 100.0
+    assert 50.0 <= snap["per_sec"] <= 250.0
+
+
+def test_rate_meter_wall_clock_outside_loop():
+    m = RateMeter("probe")
+    m.add(5)
+    snap = m.snapshot()
+    assert snap["count"] == 5 and snap["batches"] == 1
+    assert snap["mean_batch"] == 5.0
+
+
+# --- the worker-level stall surface (ISSUE 15 satellite) ---
+
+
+def test_stall_metrics_surface_empty_without_profiler():
+    from foundationdb_tpu.runtime.profiler import stall_metrics
+    assert stall_metrics() == {}
+
+
+def test_stall_metrics_surface_with_profiler():
+    import time as _time
+
+    from foundationdb_tpu.runtime.profiler import (SlowTaskProfiler,
+                                                   stall_metrics)
+
+    async def main():
+        prof = SlowTaskProfiler(threshold=0.05).start()
+        await asyncio.sleep(0.12)
+        _time.sleep(0.2)            # the stall
+        await asyncio.sleep(0.12)
+        m = stall_metrics()
+        prof.stop()
+        return m, prof
+
+    loop = asyncio.new_event_loop()
+    try:
+        m, prof = loop.run_until_complete(main())
+    finally:
+        loop.close()
+    assert m["slow_task_stalls"] >= 1
+    assert m["slow_task_last_stall_ms"] >= 50.0
+    # stop() retires the active profiler: the surface empties again
+    assert stall_metrics() == {}
+
+
+def test_cluster_registers_every_role_kind():
+    """The in-process Cluster wires every role into one registry in a
+    deterministic order."""
+    from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+
+    async def main():
+        c = Cluster(ClusterConfig(commit_proxies=2, grv_proxies=1,
+                                  resolvers=2, logs=2, storage_servers=2),
+                    Knobs())
+        names = [s.name for s in c.metrics_registry.sources()]
+        assert names == ["Sequencer", "TLog", "TLog", "Resolver",
+                         "Resolver", "Storage", "Storage", "Ratekeeper",
+                         "GrvProxy", "ProxyCommit", "ProxyCommit"]
+        # ids disambiguate instances of one kind
+        tlogs = [s.id for s in c.metrics_registry.sources()
+                 if s.name == "TLog"]
+        assert tlogs == ["0", "1"]
+
+    run_simulation(main())

@@ -1,0 +1,131 @@
+"""metrics_tool acceptance (ISSUE 15): from a sim run's trace FILE
+alone, ``lag`` reconstructs the per-tag durability-lag time-series,
+``recovery`` shows the full version-cut audit of an INDUCED recovery
+(epoch 1's initial recovery and the requested epoch 2), ``summary``
+lists every role's series, and ``diff`` of a run against itself is
+clean."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import metrics_tool  # noqa: E402
+
+from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.runtime.trace import (Severity, TraceLog,
+                                            get_trace_log, set_trace_log)
+from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+
+def _record_sim(path: str) -> None:
+    """A durable 4-machine sim: commits, several metric intervals, one
+    INDUCED recovery (request_recovery → epoch 2), more intervals —
+    all recorded to the trace file at ``path``."""
+    log = TraceLog(path=path, min_severity=Severity.INFO)
+    prev = get_trace_log()
+    set_trace_log(log)
+    try:
+        knobs = Knobs().override(METRICS_INTERVAL=0.5,
+                                 METRICS_EMITTER=True,
+                                 STORAGE_DURABILITY_LAG=0.1)
+
+        async def main():
+            sim = SimulatedCluster(knobs, n_machines=4,
+                                   durable_storage=True,
+                                   spec=ClusterConfigSpec(min_workers=4,
+                                                          replication=2))
+            await sim.start()
+            await asyncio.wait_for(sim.wait_epoch(1), 120)
+            db = await sim.database()
+            for i in range(6):
+                async def body(tr, i=i):
+                    tr.set(b"mt%04d" % i, b"v" * 64)
+                await db.run(body)
+            await asyncio.sleep(2.0)
+            # the induced recovery the audit view must replay
+            sim.leader_cc().request_recovery("metrics_tool-acceptance")
+            await asyncio.wait_for(sim.wait_epoch(2), 120)
+            await asyncio.sleep(2.0)
+            await sim.stop()
+
+        run_simulation(main(), seed=1504)
+    finally:
+        set_trace_log(prev)
+        log.close()
+
+
+def test_metrics_tool_views_from_trace_file_alone(tmp_path):
+    path = os.path.join(str(tmp_path), "flight.jsonl")
+    _record_sim(path)
+
+    events = metrics_tool._load([path])
+    assert events, "the sim recorded nothing"
+
+    # --- summary: every core role kind has a series with a cadence ---
+    summary = metrics_tool.summarize(events)
+    kinds = {k.split("/")[0] for k in summary["series"]}
+    for kind in ("ProxyCommitMetrics", "GrvProxyMetrics",
+                 "ResolverMetrics", "TLogMetrics", "StorageMetrics",
+                 "SequencerMetrics", "RatekeeperMetrics",
+                 "WorkerMetrics", "ClusterControllerMetrics"):
+        assert kind in kinds, (kind, sorted(kinds))
+    storage_series = [v for k, v in summary["series"].items()
+                      if k.startswith("StorageMetrics/")]
+    assert storage_series and all(
+        v["cadence_mean_s"] is not None and v["cadence_mean_s"] <= 1.5
+        for v in storage_series if v["n"] >= 3)
+
+    # --- lag: the durability-lag time-series reconstructs per tag ---
+    rep = metrics_tool.lag_report(events)
+    assert rep["storage_series"], "no storage lag series reconstructed"
+    assert all(n >= 2 for n in rep["storage_series"].values())
+    series = rep["series"]["storage"]
+    # a durable cluster under load recorded a real nonzero lag sample
+    # somewhere (durability ticks lag applies by ~0.1s of versions)
+    assert any(r["lag_versions"] > 0
+               for rows in series.values() for r in rows), series
+    # and the samples carry the window/queue gauges alongside
+    assert all({"t", "lag_versions", "queue_bytes", "window_versions"}
+               <= set(r) for rows in series.values() for r in rows)
+
+    # --- recovery: both epochs' full audit, cuts included ---
+    recs = metrics_tool.recovery_report(events)
+    epochs = [r["epoch"] for r in recs]
+    assert 1 in epochs and 2 in epochs, epochs
+    by_epoch = {r["epoch"]: r for r in recs}
+    for e in (1, 2):
+        rec = by_epoch[e]
+        assert rec["completed"], rec
+        steps = [s["Step"] for s in rec["steps"]]
+        assert steps[0] == "locking_cstate"
+        assert "recruiting" in steps and "writing_cstate" in steps
+        assert steps[-1] == "accepting_commits"
+        assert rec["recovery_version"] is not None
+    # epoch 2 locked the previous generation: its cut must be recorded
+    locked = next(s for s in by_epoch[2]["steps"]
+                  if s["Step"] == "locked_tlogs")
+    assert locked["Tips"] and \
+        locked["RecoveryVersion"] == min(locked["Tips"])
+    assert locked["GenerationEnd"] == locked["RecoveryVersion"]
+    # epoch 2's rejoin adopted the durable storage replicas
+    assert by_epoch[2]["recovery_version"] > 0
+
+    # --- diff of a run against itself: no deltas, full overlap ---
+    d = metrics_tool.diff_report(events, events)
+    assert d["series_a"] == d["series_b"] > 0
+    assert all("only_in" not in r and r.get("max_rel", 0.0) == 0.0
+               for r in d["rows"])
+
+    # --- the CLI surfaces run end to end on the same file ---
+    for view in (["summary"], ["lag", "--series"], ["recovery"],
+                 ["diff", path, path]):
+        argv = [view[0]] + (view[1:] if view[0] == "diff"
+                            else [path] + view[1:])
+        assert metrics_tool.main(argv) == 0

@@ -137,6 +137,17 @@ def test_lsm_compact_smoke():
     perf_smoke.check_compact(budget_s=perf_smoke.COMPACT_BUDGET_S)
 
 
+def test_observe_metrics_plane_smoke():
+    """The metrics plane (ISSUE 15): every wired role kind emits
+    periodic *Metrics events on the sim-clock cadence through the one
+    per-worker registry emitter, the cluster.lag rollup served by the
+    real status path is sane under load, metrics_tool reconstructs the
+    durability-lag series and the epoch-1 RecoveryState audit from the
+    recorded events alone, and the plane-on vs plane-off apply-pipeline
+    overhead holds ≤10% (measured ~1.0x on a loaded 2-cpu host)."""
+    perf_smoke.check_observe(budget_s=perf_smoke.OBSERVE_BUDGET_S)
+
+
 def test_apply_metrics_surface():
     """The apply path must publish its observability counters — a silent
     regression is the other half of the r5 incident."""

@@ -81,6 +81,13 @@ def _child(path: str, mode: str = "default") -> None:
     # tiny seal budget and a tight version window, so seals, tiered
     # compaction and whole-segment drops all run inside the
     # bit-identical proof for BOTH implementations
+    # ISSUE 15: the metrics plane is pinned ON with a tight interval —
+    # every standing bit-identical child now proves the registry
+    # emitter's per-interval *Metrics streams replay exactly (emission
+    # order is registration order, cadence is the virtual clock); the
+    # "metrics_off" mode forces the emitter OFF so the plane-less twin
+    # keeps its own bit-identical proof and a future knob-default flip
+    # cannot silently change what either child demonstrates
     knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
                              RESOLVER_DEVICE_PIPELINE=True,
                              DD_SHARD_HEAT_SPLITS=False,
@@ -91,8 +98,12 @@ def _child(path: str, mode: str = "default") -> None:
                              SIM_DISK_FAULTS=False,
                              CC_DISK_HEALTH_INTERVAL=1.0,
                              DISK_DEGRADED_LATENCY_MS=25.0,
-                             STORAGE_MVCC_COLUMNAR=True)
+                             STORAGE_MVCC_COLUMNAR=True,
+                             METRICS_EMITTER=True,
+                             METRICS_INTERVAL=1.0)
     durable = False
+    if mode == "metrics_off":
+        knobs = knobs.override(METRICS_EMITTER=False)
     if mode == "spill":
         knobs = knobs.override(STORAGE_DBUF_SPILL_BYTES=1,
                                STORAGE_VERSION_WINDOW=1_000,
@@ -174,6 +185,7 @@ def _child(path: str, mode: str = "default") -> None:
     spill_events = 0
     fault_events = 0
     compact_events = 0
+    metrics_events = 0
     base = os.path.basename(path)
     d = os.path.dirname(path)
     rolled = sorted(
@@ -189,37 +201,59 @@ def _child(path: str, mode: str = "default") -> None:
         spill_events += data.count(b"StorageDbufSpill")
         fault_events += data.count(b"DiskFaultInjected")
         compact_events += data.count(b"LsmCompact")
-    print("%s %d %d %d %d %d" % (h.hexdigest(), n, pipeline_events,
-                                 spill_events, fault_events,
-                                 compact_events))
+        metrics_events += data.count(b"Metrics\",")
+    print("%s %d %d %d %d %d %d" % (h.hexdigest(), n, pipeline_events,
+                                    spill_events, fault_events,
+                                    compact_events, metrics_events))
 
 
 def _run_child(tmp_path, tag: str, mode: str = "default"
-               ) -> tuple[str, int, int, int, int, int]:
+               ) -> tuple[str, int, int, int, int, int, int]:
     path = os.path.join(str(tmp_path), f"trace-{tag}.jsonl")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     p = subprocess.run([sys.executable, _THIS, "--child", path, mode],
                        cwd=_REPO, env=env, capture_output=True, text=True,
                        timeout=300)
     assert p.returncode == 0, f"child {tag} failed: {p.stderr[-2000:]}"
-    digest, n_events, n_pipeline, n_spill, n_fault, n_compact = \
-        p.stdout.strip().splitlines()[-1].split()
+    (digest, n_events, n_pipeline, n_spill, n_fault, n_compact,
+     n_metrics) = p.stdout.strip().splitlines()[-1].split()
     return digest, int(n_events), int(n_pipeline), int(n_spill), \
-        int(n_fault), int(n_compact)
+        int(n_fault), int(n_compact), int(n_metrics)
 
 
 def test_same_seed_sim_trace_bit_identical_with_pipeline(tmp_path):
-    d1, n1, p1, *_ = _run_child(tmp_path, "a")
+    d1, n1, p1, _s1, _f1, _c1, m1 = _run_child(tmp_path, "a")
     d2, n2, p2, *_ = _run_child(tmp_path, "b")
     assert n1 > 100, f"trace suspiciously small ({n1} events)"
     assert p1 > 0, (
         "no ResolverDevice span events in the trace — the device "
         "pipeline path did not run, so this test proved nothing")
+    assert m1 > 0, (
+        "no *Metrics events in the trace — the metrics-plane emitter "
+        "(pinned ON) never fired, so the plane-on half of the ISSUE 15 "
+        "determinism acceptance proved nothing")
     assert (d1, n1, p1) == (d2, n2, p2), (
         f"same-seed sim trace diverged across fresh processes with the "
         f"device pipeline ON: run a = {d1} ({n1} events), "
         f"run b = {d2} ({n2} events) — async readback reordered "
         f"observable events")
+
+
+def test_same_seed_sim_trace_bit_identical_metrics_emitter_off(tmp_path):
+    """ISSUE 15 acceptance, the other way: the same seeded sim with the
+    registry emitter forced OFF must also replay bit-identically (and
+    actually emit no periodic *Metrics stream) — the knob selects the
+    plane outright, so each pair proves its own path."""
+    d1, n1, _p1, _s1, _f1, _c1, m1 = _run_child(tmp_path, "na",
+                                                mode="metrics_off")
+    d2, n2, *_ = _run_child(tmp_path, "nb", mode="metrics_off")
+    assert n1 > 100, f"trace suspiciously small ({n1} events)"
+    assert m1 == 0, (
+        f"{m1} *Metrics events with the emitter forced OFF — the knob "
+        f"no longer gates the plane")
+    assert (d1, n1) == (d2, n2), (
+        f"same-seed sim trace diverged with the metrics emitter OFF: "
+        f"run a = {d1} ({n1} events), run b = {d2} ({n2} events)")
 
 
 def test_same_seed_sim_trace_bit_identical_with_spill_forced_on(tmp_path):
@@ -248,8 +282,10 @@ def test_same_seed_sim_trace_bit_identical_with_disk_faults_on(tmp_path):
     nondeterminism — with DiskFaultInjected events present and all
     acked writes surviving (the child asserts its scan sees every row,
     so a passing run IS zero acked-write loss)."""
-    d1, n1, _p1, _s1, f1, _c1 = _run_child(tmp_path, "fa", mode="faults")
-    d2, n2, _p2, _s2, f2, _c2 = _run_child(tmp_path, "fb", mode="faults")
+    d1, n1, _p1, _s1, f1, _c1, _m1 = _run_child(tmp_path, "fa",
+                                                mode="faults")
+    d2, n2, _p2, _s2, f2, _c2, _m2 = _run_child(tmp_path, "fb",
+                                                mode="faults")
     assert n1 > 100, f"trace suspiciously small ({n1} events)"
     assert f1 > 0, (
         "no DiskFaultInjected events in the trace — the forced-on "
@@ -289,8 +325,10 @@ def test_same_seed_sim_trace_bit_identical_lsm_knob_both_ways(tmp_path):
     AND the same sim with the knob forced OFF (the monolithic inline
     twin) must be too — the knob selects the compaction discipline
     outright, so each pair proves its own path."""
-    d1, n1, _p1, _s1, _f1, c1 = _run_child(tmp_path, "la", mode="lsm_on")
-    d2, n2, _p2, _s2, _f2, c2 = _run_child(tmp_path, "lb", mode="lsm_on")
+    d1, n1, _p1, _s1, _f1, c1, _m1 = _run_child(tmp_path, "la",
+                                                mode="lsm_on")
+    d2, n2, _p2, _s2, _f2, c2, _m2 = _run_child(tmp_path, "lb",
+                                                mode="lsm_on")
     assert n1 > 100, f"trace suspiciously small ({n1} events)"
     assert c1 > 0, (
         "no LsmCompact events in the trace — the leveled background "

@@ -113,7 +113,17 @@ amplification at <=50% of the monolithic twin's, leveled commit p99 at
 <=20% of the monolithic twin's worst commit (no commit ever awaits a
 full-keyspace merge), the budget doubling as the wedge deadline.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|compact|all]
+THIRTEENTH stage (``--stage observe``, ISSUE 15): the metrics plane —
+a seeded recruited sim where every wired role kind (grv/commit
+proxies, resolver, tlog, storage, sequencer, ratekeeper, DD, CC,
+worker) must emit periodic *Metrics events on the virtual-clock
+cadence through the one per-worker registry emitter; the cluster.lag
+rollup served by the REAL status path sane under load; metrics_tool
+reconstructing the durability-lag series and the epoch-1
+RecoveryState audit from the recorded events alone; and a plane-on vs
+plane-off apply-pipeline overhead A/B holding <=10%.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|compact|observe|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -192,6 +202,15 @@ COMPACT_BUDGET_S = 240.0    # doubles as the hard wedge deadline
 COMPACT_WRITE_AMP_CEIL = 0.5  # leveled write amp vs the monolithic twin
 COMPACT_STALL_RATIO_CEIL = 0.2  # leveled commit p99 vs monolithic max
 COMPACT_STALL_FLOOR_MS = 25.0   # absolute noise floor for that bound
+OBSERVE_SIM_SECONDS = 8.0     # virtual seconds the cadence sim records
+OBSERVE_INTERVAL_S = 0.5      # METRICS_INTERVAL for the cadence sim
+OBSERVE_AB_KEYS = 60_000      # keys per side of the overhead A/B
+OBSERVE_AB_RUNS = 3           # alternating runs per side (min-of-N)
+OBSERVE_AB_INTERVAL_S = 0.02  # emitter cadence during the A/B (dozens of
+#                               emissions inside the measured window)
+OBSERVE_OVERHEAD_CEIL = 1.10  # plane-on / plane-off apply wall ratio
+OBSERVE_OVERHEAD_SLACK_S = 0.10  # absolute floor under the ratio (noise)
+OBSERVE_BUDGET_S = 180.0      # doubles as the hard wedge deadline
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -2162,6 +2181,211 @@ def check_compact(budget_s: float = COMPACT_BUDGET_S,
     return elapsed
 
 
+def observe_seconds(deadline_s: float | None = None) -> tuple[float, dict]:
+    """The metrics-plane smoke (ISSUE 15), two halves:
+
+    1. **Cadence + lag + audit under the seeded sim**: a 5-machine
+       recruited cluster with METRICS_INTERVAL pinned small — every
+       wired role kind (grv/commit proxies, resolver, tlog, storage,
+       sequencer, ratekeeper, DD, CC, worker) must emit periodic
+       ``*Metrics`` events on the virtual-clock cadence; the
+       ``cluster.lag`` rollup served by the real status path must be
+       sane under load; ``metrics_tool`` must reconstruct the
+       durability-lag series and the epoch-1 RecoveryState audit from
+       the recorded events alone.
+    2. **Overhead A/B on the real loop**: the batched apply pipeline
+       with the registry emitter ON at a deliberately hot cadence vs
+       OFF — plane-on wall time must hold within
+       ``OBSERVE_OVERHEAD_CEIL`` of plane-off (min-of-N per side, an
+       absolute slack floor under the ratio for box noise)."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.core.data import KeyRange, Mutation
+    from foundationdb_tpu.core.status import cluster_status
+    from foundationdb_tpu.core.storage_server import StorageServer
+    from foundationdb_tpu.core.tlog import TLog
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.runtime.metrics import MetricsRegistry
+    from foundationdb_tpu.runtime.simloop import run_simulation
+    from foundationdb_tpu.runtime.trace import (Severity, TraceLog,
+                                                get_trace_log, set_trace_log)
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import metrics_tool
+
+    t_all = time.perf_counter()
+    stats: dict = {}
+
+    # ---- half 1: cadence + lag + recovery audit (virtual time) ----
+    events: list[dict] = []
+    sink = TraceLog(min_severity=Severity.INFO)
+    sink.sink = events.append
+    prev_log = get_trace_log()
+    set_trace_log(sink)
+    status_doc: dict = {}
+
+    async def sim_main() -> None:
+        knobs = Knobs().override(METRICS_INTERVAL=OBSERVE_INTERVAL_S,
+                                 METRICS_EMITTER=True,
+                                 DD_ENABLED=True,
+                                 STORAGE_DURABILITY_LAG=0.1)
+        sim = SimulatedCluster(knobs, n_machines=5, durable_storage=True,
+                               spec=ClusterConfigSpec(min_workers=5,
+                                                      replication=2))
+        await sim.start()
+        await asyncio.wait_for(sim.wait_epoch(1), 120)
+        db = await sim.database()
+        for i in range(8):
+            async def body(tr, i=i):
+                tr.set(b"obs%04d" % i, b"v" * 64)
+            await db.run(body)
+        # let the plane record several intervals of the loaded cluster
+        await asyncio.sleep(OBSERVE_SIM_SECONDS)
+        nonlocal status_doc
+        t = sim.client_transport()
+        status_doc = await asyncio.wait_for(
+            cluster_status(knobs, t, sim.coordinator_stubs(t)), 60)
+        await sim.stop()
+
+    try:
+        run_simulation(sim_main(), seed=20250804)
+    finally:
+        set_trace_log(prev_log)
+
+    expected = ("ProxyCommitMetrics", "GrvProxyMetrics", "ResolverMetrics",
+                "TLogMetrics", "StorageMetrics", "SequencerMetrics",
+                "RatekeeperMetrics", "WorkerMetrics",
+                "ClusterControllerMetrics", "DataDistributionMetrics")
+    series = metrics_tool.extract_series(events)
+    cadences: dict[str, float] = {}
+    for kind in expected:
+        rows = [v for k, v in series.items()
+                if k == kind or k.startswith(kind + "/")]
+        n = sum(len(r) for r in rows)
+        assert rows and n >= 2, (
+            f"role kind {kind} emitted {n} *Metrics events — the "
+            f"registry never carried it, the plane has a hole")
+        # cadence: per-series emission gaps ride the virtual clock, so
+        # the emitter's sleep(interval) shows up as near-exact gaps
+        gaps = [b.get("Time", 0.0) - a.get("Time", 0.0)
+                for r in rows for a, b in zip(r, r[1:])]
+        if gaps:
+            mean = sum(gaps) / len(gaps)
+            cadences[kind] = round(mean, 3)
+            assert 0.4 * OBSERVE_INTERVAL_S <= mean <= 3 * OBSERVE_INTERVAL_S, (
+                f"{kind} emission cadence {mean:.3f}s is off the "
+                f"{OBSERVE_INTERVAL_S}s interval — the emitter is not "
+                f"driving this source on the sim clock")
+    stats["sim_metrics_events"] = sum(len(r) for r in series.values())
+    stats["cadence_mean_s"] = cadences
+
+    lag = status_doc["cluster"]["lag"]
+    assert lag["committed_version"] and lag["committed_version"] > 0, lag
+    assert lag["worst_durability_lag_versions"] >= 0, lag
+    assert 0.0 <= lag["window_occupancy"] <= 2.0, lag
+    assert lag["frontier_skew_versions"] >= 0, lag
+    assert "slow_tasks" in status_doc["cluster"]
+    stats["cluster_lag"] = {k: lag[k] for k in
+                            ("worst_durability_lag_versions",
+                             "window_occupancy", "frontier_skew_versions",
+                             "committed_minus_applied")}
+
+    # the tool chain over the recorded events: the durability-lag
+    # series reconstructs per tag, and epoch 1's audit is complete
+    ls = metrics_tool.lag_series(events)
+    assert ls["storage"] and all(len(v) >= 2 for v in ls["storage"].values()), (
+        "metrics_tool could not reconstruct a storage lag series from "
+        "the recorded events")
+    recs = metrics_tool.recovery_report(events)
+    assert recs and recs[0]["epoch"] == 1 and recs[0]["completed"], recs
+    assert recs[0]["recovery_version"] is not None
+    stats["lag_series_tags"] = len(ls["storage"])
+    stats["recovery_steps"] = len(recs[0]["steps"])
+
+    # ---- half 2: plane-on vs plane-off apply overhead (real loop) ----
+    def apply_side(emitter: bool) -> float:
+        async def run_once() -> float:
+            knobs = Knobs().override(METRICS_INTERVAL=OBSERVE_AB_INTERVAL_S,
+                                     METRICS_EMITTER=emitter)
+            ss = StorageServer(knobs, 0, KeyRange(b"", b"\xff"), TLog(knobs))
+            reg = MetricsRegistry()
+            reg.add_role(ss)
+            if emitter:
+                reg.start_emitter(OBSERVE_AB_INTERVAL_S)
+            keys = [b"obs%010d" % ((i * 2654435761) % (1 << 33))
+                    for i in range(OBSERVE_AB_KEYS)]
+            value = b"x" * 64
+            version = 0
+            t0 = time.perf_counter()
+            for start in range(0, OBSERVE_AB_KEYS, 2048):
+                version += 1
+                ss._apply_batch([(version, [Mutation.set(k, value) for k
+                                            in keys[start:start + 2048]])])
+                # the yield the emitter interleaves on — the plane's
+                # whole overhead story happens between these batches
+                await asyncio.sleep(0)
+            elapsed = time.perf_counter() - t0
+            await reg.stop_emitter()
+            if emitter:
+                assert reg.emissions > 0, (
+                    "the emitter never fired inside the measured window "
+                    "— the overhead A/B proved nothing")
+            return elapsed
+
+        return asyncio.run(run_once())
+
+    # swallow the A/B's *Metrics spam (a file-less TraceLog writes to
+    # stderr); alternating sides per round so box drift hits both
+    drop = TraceLog()
+    drop.sink = lambda ev: None
+    set_trace_log(drop)
+    try:
+        on_times, off_times = [], []
+        for _ in range(OBSERVE_AB_RUNS):
+            on_times.append(apply_side(True))
+            off_times.append(apply_side(False))
+    finally:
+        set_trace_log(prev_log)
+    on_s, off_s = min(on_times), min(off_times)
+    stats["apply_on_s"] = round(on_s, 3)
+    stats["apply_off_s"] = round(off_s, 3)
+    stats["overhead_ratio"] = round(on_s / max(off_s, 1e-9), 3)
+    assert on_s <= off_s * OBSERVE_OVERHEAD_CEIL + OBSERVE_OVERHEAD_SLACK_S, (
+        f"metrics plane overhead: apply with the emitter ON took "
+        f"{on_s:.3f}s vs {off_s:.3f}s off "
+        f"({stats['overhead_ratio']:.2f}x, ceiling "
+        f"{OBSERVE_OVERHEAD_CEIL:.2f}x) — a gauge grew a scan or the "
+        f"emitter stopped being O(sources) per tick")
+
+    elapsed = time.perf_counter() - t_all
+    if deadline_s is not None and elapsed > deadline_s:
+        raise AssertionError(
+            f"observe smoke overran its {deadline_s:.0f}s deadline "
+            f"({elapsed:.1f}s)")
+    return elapsed, stats
+
+
+def check_observe(budget_s: float = OBSERVE_BUDGET_S,
+                  quiet: bool = False) -> float:
+    """Run the observability smoke; raises AssertionError on a missing
+    role series, an off-cadence emitter, an insane lag rollup, a
+    tool-chain reconstruction failure, or plane overhead past the
+    ceiling."""
+    elapsed, stats = observe_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] observe: {stats['sim_metrics_events']} "
+              f"*Metrics events across "
+              f"{len(stats['cadence_mean_s'])} role kinds, "
+              f"{stats['lag_series_tags']} lag series, "
+              f"{stats['recovery_steps']} audit steps; overhead "
+              f"{stats['apply_on_s']:.3f}s on vs "
+              f"{stats['apply_off_s']:.3f}s off "
+              f"({stats['overhead_ratio']:.2f}x)")
+    assert elapsed < budget_s, (
+        f"observe smoke took {elapsed:.1f}s (budget {budget_s:.0f}s)")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
@@ -2170,7 +2394,7 @@ def main() -> int:
                     choices=("apply", "pipeline", "feed", "read",
                              "resolve", "heat", "backup", "scan",
                              "bigkeys", "recover", "mvcc", "compact",
-                             "all"),
+                             "observe", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -2189,6 +2413,8 @@ def main() -> int:
     ap.add_argument("--mvcc-budget", type=float, default=MVCC_BUDGET_S)
     ap.add_argument("--compact-budget", type=float,
                     default=COMPACT_BUDGET_S)
+    ap.add_argument("--observe-budget", type=float,
+                    default=OBSERVE_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -2214,6 +2440,8 @@ def main() -> int:
         check_mvcc(args.mvcc_keys, budget_s=args.mvcc_budget)
     if args.stage in ("compact", "all"):
         check_compact(budget_s=args.compact_budget)
+    if args.stage in ("observe", "all"):
+        check_observe(budget_s=args.observe_budget)
     return 0
 
 
