@@ -120,8 +120,9 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
     # device commit pipeline shape (ISSUE 6): depth, fusion width,
     # per-batch dispatch cost and transfer/kernel overlap — why the
     # resolver sync number moved, not just that it did
-    pipes = [r._pipeline.metrics() for r in cluster.resolvers
+    piped = [(r, r._pipeline.metrics()) for r in cluster.resolvers
              if r._pipeline is not None]
+    pipes = [p for _r, p in piped]
     if pipes:
         stages["resolver_device"] = {
             "pipeline_depth": pipes[0]["device_pipeline_depth"],
@@ -137,6 +138,24 @@ async def run_e2e(knobs: Knobs, duration_s: float = 3.0, n_clients: int = 64,
                 / len(pipes), 3),
             "queue_peak": max(p["device_queue_peak"] for p in pipes),
             "inflight_peak": max(p["device_inflight_peak"] for p in pipes),
+            # routed-mesh shape (ISSUE 16): under routed resolution the
+            # partitions diverge — the hot partition does the fusing
+            # while a cold one answers header-only version advances —
+            # so the aggregate above hides exactly what the mesh A/B
+            # needs to see.  One entry per recruited resolver partition,
+            # in key-range order.
+            "partitions": [{
+                "dispatches": p["device_dispatches"],
+                "group_mean": round(
+                    p["device_batches_dispatched"]
+                    / max(1, p["device_dispatches"]), 2),
+                "dispatch_us_per_batch": p["device_dispatch_us_per_batch"],
+                "overlap_ratio": p["device_overlap_ratio"],
+                "queue_peak": p["device_queue_peak"],
+                "inflight_peak": p["device_inflight_peak"],
+                "resolved_batches": r.total_batches,
+                "skipped_batches": r.total_header_batches,
+            } for r, p in piped],
         }
     await cluster.stop()
 

@@ -389,7 +389,7 @@ class ClusterController:
         # read): \xff/conf/ overrides the recruitment spec and
         # \xff/keyServers/layout carries DataDistribution's desired shard
         # layout, both written by ordinary transactions ----
-        spec, layout, excluded, backup_tags, locked = \
+        spec, layout, excluded, backup_tags, locked, res_bounds = \
             await self._read_system_state(prev_state, spec,
                                           recovery_version)
         self._audit("read_system_state", new_epoch,
@@ -512,6 +512,23 @@ class ClusterController:
         log_cfg = old_log_cfg + [new_gen]
 
         res_map = ShardMap.even(spec.resolvers)
+        # heat-driven resolver remap (ISSUE 16): DD wrote a desired
+        # boundary list; THIS epoch boundary is where it takes effect —
+        # the resolvers recruit on the new ranges and each partition's
+        # conflict window rebuilds from the tlogs like any recovery.
+        # Validated here (strictly increasing, interior, right count)
+        # so a stale blob from an older spec can never wedge recovery.
+        if self.knobs.RESOLVER_REBALANCE and res_bounds is not None \
+                and spec.resolvers > 1 \
+                and len(res_bounds) == spec.resolvers - 1 \
+                and all(res_bounds[i] < res_bounds[i + 1]
+                        for i in range(len(res_bounds) - 1)) \
+                and res_bounds[0] > b"" \
+                and res_bounds[-1] < res_map.keyspace_end:
+            res_map = ShardMap(res_bounds,
+                               [[i] for i in range(spec.resolvers)])
+            self._audit("resolver_rebalance", new_epoch,
+                        Boundaries=[b.hex() for b in res_bounds])
         resolver_info = []
         for i in range(spec.resolvers):
             r = res_map.shard_range(i)
@@ -871,10 +888,11 @@ class ClusterController:
         from ..rpc.wire import decode
         from .data import KeyRange, SYSTEM_PREFIX
         from .system_data import (KEY_SERVERS_PREFIX, LOCKED_KEY,
-                                  REGIONS_KEY, decode_backup_tags,
-                                  decode_conf, spec_with_conf)
+                                  REGIONS_KEY, RESOLVER_BOUNDARIES_KEY,
+                                  decode_backup_tags, decode_conf,
+                                  spec_with_conf)
         if not prev_state:
-            return spec, None, set(), {}, None
+            return spec, None, set(), {}, None, None
         sys_end = SYSTEM_PREFIX + b"\xfe"
         for s in prev_state.get("storage", []):
             if not (s["begin"] <= SYSTEM_PREFIX < s["end"]):
@@ -903,6 +921,7 @@ class ClusterController:
             excluded = decode_excluded(rows)
             layout = None
             locked = None
+            res_bounds = None
             backup_tags = decode_backup_tags(rows)
             for key, v in rows:
                 if key == KEY_SERVERS_PREFIX + b"layout":
@@ -910,6 +929,13 @@ class ClusterController:
                         layout = decode(v)
                     except Exception:  # noqa: BLE001 — bad layout ignored
                         layout = None
+                elif key == RESOLVER_BOUNDARIES_KEY:
+                    # DD's heat-driven resolver remap (ISSUE 16): applied
+                    # below at recruitment, validated there
+                    try:
+                        res_bounds = [bytes(b) for b in decode(v)]
+                    except Exception:  # noqa: BLE001 — bad blob ignored
+                        res_bounds = None
                 elif key == LOCKED_KEY:
                     locked = bytes(v)
                 elif key == REGIONS_KEY:
@@ -929,8 +955,8 @@ class ClusterController:
                     .detail("Locked", locked is not None) \
                     .detail("HasLayout", layout is not None).log()
             return (spec_with_conf(spec, conf), layout, excluded,
-                    backup_tags, locked)
-        return spec, None, set(), {}, None
+                    backup_tags, locked, res_bounds)
+        return spec, None, set(), {}, None, None
 
     @staticmethod
     def _wire_gen(g: dict) -> dict:

@@ -116,6 +116,13 @@ class CommitProxy:
         self.total_batches = 0
         self.total_committed = 0
         self.total_conflicts = 0
+        # routed-mesh accounting (ISSUE 16), one slot per resolver
+        # partition: how many sends went out, how many were header-only
+        # version advances (every txn clipped empty), and how many txns
+        # rode the sparse sub-batches.  The imbalance across slots is the
+        # signal the CC's heat-driven boundary rebalance consumes.
+        self.route_stats = [{"sends": 0, "header_only": 0, "txns_routed": 0}
+                            for _ in resolvers]
         # this proxy's fully-acked frontier: the newest version whose
         # push every hosting log acked.  Rides every later push (real
         # and empty) as TLogPushRequest.known_committed, giving
@@ -333,6 +340,12 @@ class CommitProxy:
             s.gauge("StateAppliedVersion", lambda: self.state_applied_version)
             s.gauge("QueueDepth", lambda: self._queue.qsize())
             s.gauge("InflightBatches", lambda: len(self._inflight))
+            # routed-mesh totals (ISSUE 16); the per-partition split rides
+            # each resolver's own SkippedBatches/RoutedBatches gauges
+            s.gauge("RoutedHeaderSends", lambda: sum(
+                r["header_only"] for r in self.route_stats))
+            s.gauge("RoutedTxnsSent", lambda: sum(
+                r["txns_routed"] for r in self.route_stats))
             self._msource = s
         return self._msource
 
@@ -363,6 +376,7 @@ class CommitProxy:
             "total_committed": self.total_committed,
             "total_conflicts": self.total_conflicts,
             "known_committed": self._known_committed,
+            "route_stats": [dict(r) for r in self.route_stats],
             **self.spans.counters(),
             **stall_metrics(),
         }
@@ -413,41 +427,65 @@ class CommitProxy:
             else:
                 first = await self._queue.get()
             last_real_commit = loop.time()
-            # state transactions (system-key writers) resolve ALONE in
-            # their batch: every resolver must compute the same verdict
-            # from the same (unclipped) view, which a singleton batch
-            # guarantees without any cross-resolver agreement protocol
-            state_item = None
-            if is_state_txn(first[0]):
-                batch, state_item = [], first
-                nbytes = 0
-            else:
-                batch = [first]
-                nbytes = first[0].expected_size()
-            deadline = asyncio.get_running_loop().time() + self.knobs.COMMIT_BATCH_INTERVAL
-            while (state_item is None
-                   and len(batch) < self.knobs.COMMIT_BATCH_COUNT_LIMIT
-                   and nbytes < self.knobs.COMMIT_BATCH_BYTE_LIMIT):
-                timeout = deadline - asyncio.get_running_loop().time()
-                if timeout <= 0:
-                    break
-                try:
-                    item = await asyncio.wait_for(self._queue.get(), timeout)
-                except asyncio.TimeoutError:
-                    break
-                if is_state_txn(item[0]):
-                    state_item = item      # flush batch, then this alone
-                    break
-                batch.append(item)
-                nbytes += item[0].expected_size()
-            # overlapped pipelining: run the batch as its own task; version
-            # ordering downstream comes from prev_version chaining
-            for b in ([batch] if batch else []) + \
-                    ([[state_item]] if state_item else []):
-                t = asyncio.get_running_loop().create_task(
-                    self._commit_batch(b), name="commit-batch")
-                self._inflight.add(t)
-                t.add_done_callback(self._inflight.discard)
+            while first is not None:
+                # state transactions (system-key writers) resolve ALONE in
+                # their batch: every resolver must compute the same verdict
+                # from the same (unclipped) view, which a singleton batch
+                # guarantees without any cross-resolver agreement protocol
+                state_item = None
+                if is_state_txn(first[0]):
+                    batch, state_item = [], first
+                    nbytes = 0
+                else:
+                    batch = [first]
+                    nbytes = first[0].expected_size()
+                first = None
+                deadline = loop.time() + self.knobs.COMMIT_BATCH_INTERVAL
+                while (state_item is None
+                       and len(batch) < self.knobs.COMMIT_BATCH_COUNT_LIMIT
+                       and nbytes < self.knobs.COMMIT_BATCH_BYTE_LIMIT):
+                    try:
+                        # drain the backlog WITHOUT yielding: a burst that
+                        # outgrew one batch must become consecutive
+                        # prev-chained batch tasks created in this same
+                        # loop turn, so they all submit to the resolver
+                        # before its pipeline pump runs — that back-to-back
+                        # submission is the fusion window that keeps >= 2
+                        # groups in flight on the live path (ISSUE 16; a
+                        # wait_for here yields per txn, which let the pump
+                        # drain after every single batch and pinned the
+                        # live fused group mean at 1.0)
+                        item = self._queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        timeout = deadline - loop.time()
+                        if timeout <= 0:
+                            break
+                        try:
+                            item = await asyncio.wait_for(self._queue.get(),
+                                                          timeout)
+                        except asyncio.TimeoutError:
+                            break
+                    if is_state_txn(item[0]):
+                        state_item = item      # flush batch, then this alone
+                        break
+                    batch.append(item)
+                    nbytes += item[0].expected_size()
+                # overlapped pipelining: run the batch as its own task;
+                # version ordering downstream comes from prev_version
+                # chaining
+                for b in ([batch] if batch else []) + \
+                        ([[state_item]] if state_item else []):
+                    t = loop.create_task(
+                        self._commit_batch(b), name="commit-batch")
+                    self._inflight.add(t)
+                    t.add_done_callback(self._inflight.discard)
+                # backlog remaining after a full batch: form the next one
+                # NOW (same turn), for the same fusion window
+                if state_item is None:
+                    try:
+                        first = self._queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        first = None
 
     async def _empty_batch(self) -> None:
         """Advance the version chain with no transactions."""
@@ -579,6 +617,18 @@ class CommitProxy:
                 state_txns = [(0, MutationBatch.from_mutations(
                     reqs[0].mutations))]
 
+            # Routed mesh (ISSUE 16): each resolver gets ONLY the txns
+            # whose clipped conflict ranges are non-empty on its
+            # partition (a sparse sub-batch — the index map stays here
+            # and the verdicts scatter back below), and a partition every
+            # txn clips empty against gets a header-only version advance
+            # (empty txns) it answers without touching its backend.
+            # State batches stay broadcast, unclipped and alone (the
+            # verdict-agreement invariant).  Knob off = the broadcast
+            # twin below, verbatim.
+            routed = self.knobs.RESOLVER_MESH_ROUTING and not is_state
+            final = [COMMITTED] * len(reqs)
+
             # broadcast to all resolvers, clipped to each partition
             async def ask(res: Resolver):
                 sent = txns if is_state else \
@@ -587,13 +637,51 @@ class CommitProxy:
                     ResolveBatchRequest(prev_version, version, sent,
                                         state_txns,
                                         self.state_applied_version))
+
+            async def ask_routed(res: Resolver, sub: list[TxnRequest]):
+                return await res.resolve(
+                    ResolveBatchRequest(prev_version, version, sub, None,
+                                        self.state_applied_version))
             t0 = loop.time()
             # the resolver hop inherits a child span via the contextvar:
             # gather's tasks copy the active context at creation, so the
             # (possibly remote) resolvers see the sampled trace
-            with _span.child_scope(batch_ctx):
-                replies = await asyncio.gather(
-                    *(ask(r) for r in self.resolvers))
+            if routed:
+                index_maps: list[list[int]] = []
+                subs: list[list[TxnRequest]] = []
+                for ri, res in enumerate(self.resolvers):
+                    sub, idx = [], []
+                    for i, t in enumerate(txns):
+                        ct = clip_txn_to_range(t, res.key_range)
+                        if ct.read_ranges or ct.write_ranges:
+                            sub.append(ct)
+                            idx.append(i)
+                    subs.append(sub)
+                    index_maps.append(idx)
+                    st = self.route_stats[ri]
+                    st["sends"] += 1
+                    st["txns_routed"] += len(sub)
+                    if not sub:
+                        st["header_only"] += 1
+                with _span.child_scope(batch_ctx):
+                    replies = await asyncio.gather(
+                        *(ask_routed(r, sub)
+                          for r, sub in zip(self.resolvers, subs)))
+                # scatter the sparse verdicts into the AND-join: a txn a
+                # partition never judged contributes COMMITTED there —
+                # identical to broadcasting its empty clip (no ranges,
+                # no conflict).  TOO_OLD dominates, then CONFLICT.
+                for reply, idx in zip(replies, index_maps):
+                    for j, v in zip(idx, reply.verdicts):
+                        final[j] = max(final[j], v)
+            else:
+                with _span.child_scope(batch_ctx):
+                    replies = await asyncio.gather(
+                        *(ask(r) for r in self.resolvers))
+                # AND the verdicts: TOO_OLD dominates, then CONFLICT
+                for reply in replies:
+                    for i, v in enumerate(reply.verdicts):
+                        final[i] = max(final[i], v)
             self.stages.record("resolve", loop.time() - t0)
             resolved = True
             for c in sampled:
@@ -601,16 +689,11 @@ class CommitProxy:
                                  "CommitProxyServer.commitBatch."
                                  "AfterResolution", Version=version)
 
-            # AND the verdicts: TOO_OLD dominates, then CONFLICT
-            final = [COMMITTED] * len(reqs)
-            for reply in replies:
-                for i, v in enumerate(reply.verdicts):
-                    final[i] = max(final[i], v)
-
             # apply the committed state stream (our own state batch AND
             # other proxies' — identical on every resolver, take the
-            # first's) BEFORE tagging, then tag with the map as of THIS
-            # batch's version
+            # first's; a header-only reply still carries the piggyback)
+            # BEFORE tagging, then tag with the map as of THIS batch's
+            # version
             my_markers = self._apply_state_entries(
                 replies[0].state_entries, own_version=version)
             shard_map = self.map_at(version)

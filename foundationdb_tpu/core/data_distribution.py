@@ -110,6 +110,12 @@ class DataDistributor:
         self.heat_splits_done = 0
         self.heat_moves_done = 0
         self.last_heat_rw_per_sec = 0.0
+        # resolver-mesh boundary rebalance state (ISSUE 16): sustain
+        # streak + cooldown mirror the shard-heat hysteresis; the counter
+        # counts desired-boundary writes (applied at the NEXT epoch)
+        self._res_streak = 0
+        self._res_cooldown_until = 0.0
+        self.resolver_rebalances = 0
         # gray-failure avoidance (ISSUE 12): destination picks that
         # skipped a disk-degraded worker
         self.degraded_avoided = 0
@@ -127,6 +133,7 @@ class DataDistributor:
             s.gauge("LiveMoves", lambda: self.live_moves_done)
             s.gauge("HeatSplits", lambda: self.heat_splits_done)
             s.gauge("HeatMoves", lambda: self.heat_moves_done)
+            s.gauge("ResolverRebalances", lambda: self.resolver_rebalances)
             s.gauge("DegradedAvoided", lambda: self.degraded_avoided)
             self._msource = s
         return self._msource
@@ -138,6 +145,7 @@ class DataDistributor:
                 "live_moves": self.live_moves_done,
                 "heat_splits": self.heat_splits_done,
                 "heat_moves": self.heat_moves_done,
+                "resolver_rebalances": self.resolver_rebalances,
                 "last_heat_rw_per_sec": self.last_heat_rw_per_sec,
                 "degraded_avoided": self.degraded_avoided}
 
@@ -273,6 +281,13 @@ class DataDistributor:
             await self._heat_round(state, layout, shard_map, by_tag,
                                    next_tag, desired)
 
+        # --- resolver-mesh boundary rebalance (ISSUE 16): roll the same
+        # shard-heat reservoirs up into the RESOLVER partitions and write
+        # a desired boundary list for the next epoch's recruitment ---
+        if self.knobs.RESOLVER_REBALANCE \
+                and self.knobs.RESOLVER_MESH_ROUTING:
+            await self._resolver_rebalance_round(state, shard_map, by_tag)
+
     # --- heat-driven relocation (ISSUE 7) ---
 
     async def _shard_heat(self, team: list[int], by_tag: dict) -> dict | None:
@@ -389,6 +404,61 @@ class DataDistributor:
                 asyncio.get_running_loop().time() + k.DD_HEAT_COOLDOWN_S
             # boundaries changed: every streak is stale
             self._heat_streak.clear()
+
+    # --- resolver-mesh boundary rebalance (ISSUE 16) ---
+
+    async def _resolver_rebalance_round(self, state: dict, shard_map,
+                                        by_tag: dict) -> None:
+        """Detect a resolver partition carrying a disproportionate share
+        of the routed load and write the remapped boundary list to
+        ``\\xff/keyServers/resolverBoundaries`` — an ordinary state-txn
+        system write.  The remap takes effect at the NEXT epoch
+        boundary: recruitment reads the key and recruits the resolvers
+        on the new ranges, each partition's conflict window rebuilding
+        from the tlogs exactly as any recovery rebuilds it.  Same
+        hysteresis shape as the shard-heat policy: a sustain streak
+        plus a post-write cooldown."""
+        k = self.knobs
+        res = state.get("resolvers") or []
+        if len(res) < 2:
+            return
+        now = asyncio.get_running_loop().time()
+        if now < self._res_cooldown_until:
+            return
+        heats = await asyncio.gather(
+            *(self._shard_heat(team, by_tag)
+              for _rng, team in shard_map.ranges()))
+        samples: list[tuple[bytes, float]] = []
+        for h in heats:
+            if h is not None:
+                samples.extend(h["samples"])
+        bounds = sorted(bytes(r["begin"]) for r in res if bytes(r["begin"]))
+        from .shard_load import rebalance_resolver_boundaries
+        new = rebalance_resolver_boundaries(
+            samples, bounds, ratio=k.RESOLVER_REBALANCE_RATIO)
+        if new is None:
+            self._res_streak = 0
+            return
+        self._res_streak += 1
+        if self._res_streak < k.RESOLVER_REBALANCE_SUSTAIN_ROUNDS:
+            return
+        from ..rpc.wire import encode
+        from .system_data import RESOLVER_BOUNDARIES_KEY
+        tr = self.db.create_transaction()
+        tr.lock_aware = True
+        while True:
+            try:
+                tr.set(RESOLVER_BOUNDARIES_KEY, encode(new))
+                await tr.commit()
+                break
+            except Exception as e:  # noqa: BLE001 — retry via on_error
+                await tr.on_error(e)
+        TraceEvent("DDResolverRebalance") \
+            .detail("OldBoundaries", bounds) \
+            .detail("NewBoundaries", new).log()
+        self.resolver_rebalances += 1
+        self._res_streak = 0
+        self._res_cooldown_until = now + k.DD_HEAT_COOLDOWN_S
 
     async def _desired_engine(self) -> str | None:
         from .system_data import conf_key

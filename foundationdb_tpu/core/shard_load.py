@@ -33,6 +33,7 @@ behavior):
 from __future__ import annotations
 
 import asyncio
+import bisect
 import math
 import random
 import time
@@ -171,6 +172,53 @@ def weighted_split_key(samples: list[tuple[bytes, float]], begin: bytes,
             return k
         acc += w
     return None
+
+
+def rebalance_resolver_boundaries(samples: list[tuple[bytes, float]],
+                                  boundaries: list[bytes], *,
+                                  ratio: float = 2.0,
+                                  keyspace_end: bytes = b"\xff\xff\xff",
+                                  ) -> list[bytes] | None:
+    """Partition-count-preserving rebalance of resolver boundaries
+    (ISSUE 16): given the cluster-wide weighted key samples (the storage
+    shard-heat reservoirs, concatenated) and the current interior
+    boundaries of N resolver partitions, return a NEW boundary list when
+    the hottest partition carries at least ``ratio`` x the mean heat:
+    the hot partition splits at its heat midpoint and the coldest
+    ADJACENT pair merges, so N stays fixed — resolver count is a
+    recruitment-spec constant, only the ranges move.  With N == 2 the
+    coldest pair is the whole keyspace and the net effect is simply
+    moving the single boundary to the hot side's heat midpoint.
+
+    Returns None when the mesh is balanced, the signal is too thin for
+    ``weighted_split_key``, or the result would not be a strictly
+    increasing interior boundary list distinct from the current one."""
+    n = len(boundaries) + 1
+    if n < 2 or not samples:
+        return None
+    samples = sorted(samples)
+    heat = [0.0] * n
+    for k, w in samples:
+        heat[bisect.bisect_right(boundaries, k)] += w
+    total = sum(heat)
+    if total <= 0:
+        return None
+    hot = max(range(n), key=lambda i: heat[i])
+    if heat[hot] * n < ratio * total:
+        return None                               # balanced enough
+    begin = boundaries[hot - 1] if hot > 0 else b""
+    end = boundaries[hot] if hot < n - 1 else keyspace_end
+    split = weighted_split_key(samples, begin, end)
+    if split is None:
+        return None
+    # merge the coldest adjacent pair: drop the interior boundary j
+    # between partitions j and j+1 (the split insertion restores N)
+    j = min(range(n - 1), key=lambda i: heat[i] + heat[i + 1])
+    new = sorted({b for i, b in enumerate(boundaries) if i != j} | {split})
+    if len(new) != n - 1 or new == boundaries \
+            or new[0] <= b"" or new[-1] >= keyspace_end:
+        return None
+    return new
 
 
 class ShardHeatTracker:

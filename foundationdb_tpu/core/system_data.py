@@ -20,6 +20,11 @@ import dataclasses
 CONF_PREFIX = b"\xff/conf/"
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 LAYOUT_KEY = KEY_SERVERS_PREFIX + b"layout"
+# desired resolver partition boundaries (ISSUE 16): encode(list[bytes])
+# written by DD's heat-driven rebalance; the NEXT epoch's recruitment
+# applies it (each partition's conflict window rebuilds from the tlogs,
+# exactly as any recovery rebuilds it)
+RESOLVER_BOUNDARIES_KEY = KEY_SERVERS_PREFIX + b"resolverBoundaries"
 BACKUP_PREFIX = b"\xff/backup/"
 # named mutation-log tags (\xff/backup/tags/<name> -> encode(tag)), so a
 # file backup and a DR feed can stream concurrently; the bare

@@ -50,6 +50,18 @@ class Knobs:
     # in-flight dispatch depth for the device pipeline (two-deep default:
     # one group on the device, one group's verdicts reading back)
     RESOLVER_PIPELINE_DEPTH: int = 2
+    # routed resolver mesh (ISSUE 16): the proxy sends each resolver ONLY
+    # the txns whose clipped conflict ranges are non-empty on its
+    # partition (a sparse sub-batch; the proxy keeps the index map and
+    # scatters the verdicts back into the AND-join), and when EVERY txn
+    # clips empty it sends a header-only version-advance request that the
+    # resolver answers without touching the conflict backend or the
+    # device pipeline.  Version-advance invariant: every resolver still
+    # sees every (prev_version, version) pair — skipping a resolver
+    # entirely would wedge its version chain and freeze its too-old
+    # window/frontier.  Off = the broadcast twin, kept verbatim for A/B
+    # (same wire shapes either way, so no protocol gate is needed).
+    RESOLVER_MESH_ROUTING: bool = True
 
     # --- commit pipeline ---
     COMMIT_BATCH_INTERVAL: float = 0.002      # proxy batching window seconds (REF: COMMIT_TRANSACTION_BATCH_INTERVAL_MIN)
@@ -305,6 +317,19 @@ class Knobs:
     DD_SHARD_HOT_RW_PER_SEC: float = 5000.0
     DD_HEAT_SUSTAIN_ROUNDS: int = 2
     DD_HEAT_COOLDOWN_S: float = 10.0
+    # heat-driven RESOLVER boundary rebalance (ISSUE 16): DD rolls the
+    # storage shard-heat reservoirs up into the resolver partitions;
+    # when the hottest partition sustains >= RATIO x the mean heat for
+    # SUSTAIN consecutive rounds, DD writes a desired boundary list
+    # (split the hot partition at its heat midpoint, merge the coldest
+    # adjacent pair — partition count preserved) to a system key that
+    # the NEXT epoch's recruitment applies: a state-txn remap, with
+    # each partition's conflict window rebuilt from the tlogs exactly
+    # as any recovery rebuilds it.  Gated separately from the heat
+    # split policy so sims can exercise one without the other.
+    RESOLVER_REBALANCE: bool = False
+    RESOLVER_REBALANCE_RATIO: float = 2.0
+    RESOLVER_REBALANCE_SUSTAIN_ROUNDS: int = 2
 
     # --- observability ---
     METRICS_INTERVAL: float = 5.0             # role *Metrics emit period

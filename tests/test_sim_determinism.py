@@ -88,6 +88,14 @@ def _child(path: str, mode: str = "default") -> None:
     # "metrics_off" mode forces the emitter OFF so the plane-less twin
     # keeps its own bit-identical proof and a future knob-default flip
     # cannot silently change what either child demonstrates
+    # ISSUE 16: routed mesh resolution is pinned ON (its default) and
+    # the heat-driven resolver rebalance OFF (its default) explicitly —
+    # the standing children prove the routed proxy path replays exactly;
+    # the "mesh_on"/"mesh_off" modes instead recruit a 2-resolver
+    # transaction subsystem and force the routing knob each way, so the
+    # empty-clip fast path + sparse sub-batch scatter (ON) and the
+    # verbatim broadcast twin (OFF) each carry their own bit-identical
+    # proof
     knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
                              RESOLVER_DEVICE_PIPELINE=True,
                              DD_SHARD_HEAT_SPLITS=False,
@@ -100,8 +108,11 @@ def _child(path: str, mode: str = "default") -> None:
                              DISK_DEGRADED_LATENCY_MS=25.0,
                              STORAGE_MVCC_COLUMNAR=True,
                              METRICS_EMITTER=True,
-                             METRICS_INTERVAL=1.0)
+                             METRICS_INTERVAL=1.0,
+                             RESOLVER_MESH_ROUTING=True,
+                             RESOLVER_REBALANCE=False)
     durable = False
+    n_resolvers = 1
     if mode == "metrics_off":
         knobs = knobs.override(METRICS_EMITTER=False)
     if mode == "spill":
@@ -123,6 +134,16 @@ def _child(path: str, mode: str = "default") -> None:
                                STORAGE_VERSION_WINDOW=1_000,
                                STORAGE_DURABILITY_LAG=0.1)
         durable = True
+    elif mode in ("mesh_on", "mesh_off"):
+        # ISSUE 16: a 2-resolver transaction subsystem so the routing
+        # knob actually selects between paths — the workload's det-k*
+        # keys all sit below the \x80 partition boundary, so routing ON
+        # exercises sparse sub-batches to partition 0 AND header-only
+        # version advances to partition 1, while routing OFF replays the
+        # verbatim clipped-broadcast twin
+        knobs = knobs.override(
+            RESOLVER_MESH_ROUTING=(mode == "mesh_on"))
+        n_resolvers = 2
     elif mode in ("lsm_on", "lsm_off"):
         # ISSUE 14: durable lsm storage with a tiny memtable/trigger so
         # flushes AND compactions run inside the sim — leveled
@@ -145,7 +166,8 @@ def _child(path: str, mode: str = "default") -> None:
         sim = SimulatedCluster(knobs, n_machines=_N_MACHINES,
                                durable_storage=durable,
                                spec=ClusterConfigSpec(min_workers=_N_MACHINES,
-                                                      replication=2))
+                                                      replication=2,
+                                                      resolvers=n_resolvers))
         await sim.start()
         await sim.wait_epoch(1)
         db = await sim.database()
@@ -345,6 +367,57 @@ def test_same_seed_sim_trace_bit_identical_lsm_knob_both_ways(tmp_path):
         f"same-seed sim trace diverged with the monolithic lsm "
         f"compaction twin forced: run a = {d3} ({n3} events), "
         f"run b = {d4} ({n4})")
+
+
+def _trace_bytes(tmp_path, tag: str) -> bytes:
+    """Concatenated (rolled) trace JSONL a child with this tag wrote."""
+    base = f"trace-{tag}.jsonl"
+    d = str(tmp_path)
+    out = b""
+    for name in sorted(e for e in os.listdir(d)
+                       if e == base or (e.startswith(base + ".")
+                                        and e[len(base) + 1:].isdigit())):
+        with open(os.path.join(d, name), "rb") as f:
+            out += f.read()
+    return out
+
+
+def test_same_seed_sim_trace_bit_identical_mesh_knob_both_ways(tmp_path):
+    """ISSUE 16 acceptance: a same-seed sim with a 2-resolver mesh and
+    routed resolution forced ON (sparse sub-batches to the partition
+    owning the keys, header-only version advances to the other) must be
+    bit-identical across fresh processes, AND the same sim with the knob
+    forced OFF (the verbatim clipped-broadcast twin) must be too — the
+    knob selects the proxy's send shape outright, so each pair proves
+    its own path.  The routed pair must also show the empty-clip fast
+    path actually firing (a nonzero per-partition SkippedBatches gauge
+    in the recorded ResolverMetrics stream) and the broadcast pair must
+    show it never firing."""
+    import re
+
+    d1, n1, *_ = _run_child(tmp_path, "xa", mode="mesh_on")
+    d2, n2, *_ = _run_child(tmp_path, "xb", mode="mesh_on")
+    assert n1 > 100, f"trace suspiciously small ({n1} events)"
+    skipped = [int(m) for m in re.findall(
+        rb'"SkippedBatches":(\d+)', _trace_bytes(tmp_path, "xa"))]
+    assert skipped and max(skipped) > 0, (
+        "no nonzero SkippedBatches gauge in the routed child's metrics "
+        "stream — the empty-clip fast path never fired, so the mesh_on "
+        "half of this test proved nothing")
+    assert (d1, n1) == (d2, n2), (
+        f"same-seed sim trace diverged with mesh routing forced ON: "
+        f"run a = {d1} ({n1} events), run b = {d2} ({n2})")
+    d3, n3, *_ = _run_child(tmp_path, "xc", mode="mesh_off")
+    d4, n4, *_ = _run_child(tmp_path, "xd", mode="mesh_off")
+    assert n3 > 100, f"trace suspiciously small ({n3} events)"
+    off_skipped = [int(m) for m in re.findall(
+        rb'"SkippedBatches":(\d+)', _trace_bytes(tmp_path, "xc"))]
+    assert not off_skipped or max(off_skipped) == 0, (
+        f"SkippedBatches {max(off_skipped)} with routing forced OFF — "
+        f"the broadcast twin is no longer verbatim")
+    assert (d3, n3) == (d4, n4), (
+        f"same-seed sim trace diverged with the broadcast twin forced: "
+        f"run a = {d3} ({n3} events), run b = {d4} ({n4})")
 
 
 if __name__ == "__main__":

@@ -123,7 +123,17 @@ reconstructing the durability-lag series and the epoch-1
 RecoveryState audit from the recorded events alone; and a plane-on vs
 plane-off apply-pipeline overhead A/B holding <=10%.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|compact|observe|all]
+FOURTEENTH stage (``--stage mesh``, ISSUE 16): the routed resolver
+mesh — a 2-resolver cluster running the REAL commit path (proxy →
+mesh → TLog → storage) on a partition-skewed workload, routed vs the
+verbatim broadcast twin: routed aggregate commit throughput must beat
+broadcast by a guarded ratio, the empty-clip fast path must carry
+>50% of the cold partition's sends (header-only version advances, no
+backend touch), and batch-group fusion must be observed engaging on
+the live path (fused group mean > 1 — the regression that motivated
+the issue was exactly group_mean=1.0 in situ).
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|compact|observe|mesh|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -211,6 +221,15 @@ OBSERVE_AB_INTERVAL_S = 0.02  # emitter cadence during the A/B (dozens of
 OBSERVE_OVERHEAD_CEIL = 1.10  # plane-on / plane-off apply wall ratio
 OBSERVE_OVERHEAD_SLACK_S = 0.10  # absolute floor under the ratio (noise)
 OBSERVE_BUDGET_S = 180.0      # doubles as the hard wedge deadline
+MESH_SECONDS = 1.5            # measured window per A/B side (real clock)
+MESH_WARMUP_S = 0.8           # per-side warmup before stats reset
+MESH_CLIENTS = 96
+MESH_RATIO_FLOOR = 1.1        # routed vs broadcast commit txns/s
+#                               (measured ~1.4x on this 2-cpu box; the
+#                               floor leaves room for CI noise)
+MESH_HEADER_FRAC_FLOOR = 0.5  # cold partition's header-only send share
+MESH_GROUP_MEAN_FLOOR = 1.5   # live-path fusion must actually engage
+MESH_BUDGET_S = 240.0         # doubles as the hard wedge deadline
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -2386,6 +2405,83 @@ def check_observe(budget_s: float = OBSERVE_BUDGET_S,
     return elapsed
 
 
+def mesh_seconds(deadline_s: float | None = None) -> tuple[float, dict]:
+    """The routed-mesh A/B (ISSUE 16): one 2-resolver cluster per side,
+    REAL commit path end to end, partition-skewed workload (every key in
+    the bottom partition's range).  Routed mode sends the hot partition
+    sparse sub-batches and the cold partition header-only version
+    advances; broadcast mode (the verbatim twin) makes both resolvers
+    scan every batch.  Asserts the throughput ratio, the fast-path share
+    on the cold partition, and live-path group fusion."""
+    from foundationdb_tpu.bench.multi_resolver import _mesh_cluster_run
+    from foundationdb_tpu.runtime.trace import (TraceLog, get_trace_log,
+                                                set_trace_log)
+
+    t_all = time.perf_counter()
+    # the clusters trace eagerly; a file-less TraceLog spams stderr
+    drop = TraceLog()
+    drop.sink = lambda ev: None
+    prev_log = get_trace_log()
+    set_trace_log(drop)
+    try:
+        routed = asyncio.run(_mesh_cluster_run(
+            2, True, seconds=MESH_SECONDS, warmup_s=MESH_WARMUP_S,
+            n_clients=MESH_CLIENTS, skewed=True))
+        bcast = asyncio.run(_mesh_cluster_run(
+            2, False, seconds=MESH_SECONDS, warmup_s=MESH_WARMUP_S,
+            n_clients=MESH_CLIENTS, skewed=True))
+    finally:
+        set_trace_log(prev_log)
+
+    ratio = routed["txns_per_sec"] / max(bcast["txns_per_sec"], 1e-9)
+    hot, cold = routed["partitions"][0], routed["partitions"][1]
+    stats = {"routed_tps": routed["txns_per_sec"],
+             "broadcast_tps": bcast["txns_per_sec"],
+             "ratio": round(ratio, 2),
+             "cold_header_frac": cold["header_only_frac"],
+             "cold_skipped": cold["skipped_batches"],
+             "hot_group_mean": hot["group_mean"]}
+    assert ratio >= MESH_RATIO_FLOOR, (
+        f"routed mesh {routed['txns_per_sec']:.0f} txns/s vs broadcast "
+        f"{bcast['txns_per_sec']:.0f} ({ratio:.2f}x, floor "
+        f"{MESH_RATIO_FLOOR}x) — routing stopped paying on the skewed "
+        f"workload")
+    assert cold["header_only_frac"] > MESH_HEADER_FRAC_FLOOR, (
+        f"cold partition answered only {cold['header_only_frac']:.0%} of "
+        f"its sends header-only (floor {MESH_HEADER_FRAC_FLOOR:.0%}) — "
+        f"the empty-clip fast path is not firing")
+    assert cold["skipped_batches"] > 0, \
+        "the cold partition never took the fast path"
+    assert hot["group_mean"] >= MESH_GROUP_MEAN_FLOOR, (
+        f"hot partition fused group mean {hot['group_mean']} (floor "
+        f"{MESH_GROUP_MEAN_FLOOR}) — group fusion is not engaging on "
+        f"the live commit path again")
+
+    elapsed = time.perf_counter() - t_all
+    if deadline_s is not None and elapsed > deadline_s:
+        raise AssertionError(
+            f"mesh smoke overran its {deadline_s:.0f}s deadline "
+            f"({elapsed:.1f}s)")
+    return elapsed, stats
+
+
+def check_mesh(budget_s: float = MESH_BUDGET_S, quiet: bool = False) -> float:
+    """Run the routed-mesh smoke; raises AssertionError when routing
+    stops beating broadcast, the fast path stops firing, or live-path
+    fusion disengages."""
+    elapsed, stats = mesh_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] mesh: routed {stats['routed_tps']:.0f} vs "
+              f"broadcast {stats['broadcast_tps']:.0f} txns/s "
+              f"({stats['ratio']:.2f}x); cold partition "
+              f"{stats['cold_header_frac']:.0%} header-only "
+              f"({stats['cold_skipped']} skipped), hot group mean "
+              f"{stats['hot_group_mean']}")
+    assert elapsed < budget_s, (
+        f"mesh smoke took {elapsed:.1f}s (budget {budget_s:.0f}s)")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
@@ -2394,7 +2490,7 @@ def main() -> int:
                     choices=("apply", "pipeline", "feed", "read",
                              "resolve", "heat", "backup", "scan",
                              "bigkeys", "recover", "mvcc", "compact",
-                             "observe", "all"),
+                             "observe", "mesh", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -2415,6 +2511,7 @@ def main() -> int:
                     default=COMPACT_BUDGET_S)
     ap.add_argument("--observe-budget", type=float,
                     default=OBSERVE_BUDGET_S)
+    ap.add_argument("--mesh-budget", type=float, default=MESH_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -2442,6 +2539,8 @@ def main() -> int:
         check_compact(budget_s=args.compact_budget)
     if args.stage in ("observe", "all"):
         check_observe(budget_s=args.observe_budget)
+    if args.stage in ("mesh", "all"):
+        check_mesh(budget_s=args.mesh_budget)
     return 0
 
 
