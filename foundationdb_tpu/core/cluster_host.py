@@ -67,6 +67,7 @@ class ClusterHost:
         self._leading = False
         self.cc: ClusterController | None = None
         self.dd = None          # live DataDistributor while leading
+        self.scrubber = None    # live ConsistencyScrubber while leading
         self._task: asyncio.Task | None = None
         self._stopped = False
         serve_role(transport, "cluster_controller", self,
@@ -309,6 +310,25 @@ class ClusterHost:
 
             dd_task = asyncio.get_running_loop().create_task(
                 start_dd(), name=f"dd-start-{self.id}")
+        if k.SCRUB_ENABLED:
+            from .scrubber import ConsistencyScrubber
+
+            async def start_scrub():
+                # the DD recruitment shape: wait for recovery to publish
+                # a state, then run the singleton with the leading CC
+                while self.cc is not None and self.cc.last_state is None:
+                    await asyncio.sleep(0.25)
+                if self.cc is None:
+                    return None
+                s = ConsistencyScrubber(k, self.make_client_transport(),
+                                        self.cc)
+                s.start()
+                self.worker.metrics_registry.add_role(s)
+                self.scrubber = s   # reachable for tests/status probes
+                return s
+
+            scrub_task = asyncio.get_running_loop().create_task(
+                start_scrub(), name=f"scrub-start-{self.id}")
         try:
             while True:
                 await asyncio.sleep(k.LEADER_HEARTBEAT_INTERVAL)
@@ -339,6 +359,7 @@ class ClusterHost:
             self._leading = False
             self.worker.metrics_registry.unregister(cc_src)
             self.dd = None
+            self.scrubber = None
             if k.DD_ENABLED:
                 dd_task.cancel()
                 try:
@@ -349,6 +370,17 @@ class ClusterHost:
                     self.worker.metrics_registry.unregister(
                         dd.metrics_source())
                     await dd.stop()
+            if k.SCRUB_ENABLED:
+                scrub_task.cancel()
+                try:
+                    scrub = scrub_task.result() if scrub_task.done() \
+                        else None
+                except BaseException:
+                    scrub = None
+                if scrub is not None:
+                    self.worker.metrics_registry.unregister(
+                        scrub.metrics_source())
+                    await scrub.stop()
             cc_task.cancel()
             await asyncio.gather(cc_task, return_exceptions=True)
             await self.cc.stop()

@@ -371,6 +371,7 @@ class CommitProxy:
     async def metrics(self) -> dict:
         """Role counters for status (span rollup + commit load)."""
         from ..runtime.profiler import stall_metrics
+        from ..runtime.span import process_counters
         return {
             "total_batches": self.total_batches,
             "total_committed": self.total_committed,
@@ -379,6 +380,7 @@ class CommitProxy:
             "route_stats": [dict(r) for r in self.route_stats],
             **self.spans.counters(),
             **stall_metrics(),
+            **process_counters(),
         }
 
     async def commit(self, req: CommitTransactionRequest) -> CommitResult:
@@ -663,10 +665,25 @@ class CommitProxy:
                     st["txns_routed"] += len(sub)
                     if not sub:
                         st["header_only"] += 1
+                    # per-partition scatter events (ISSUE 17 satellite):
+                    # a sampled txn's timeline shows WHICH partitions
+                    # resolved it — and which answered header-only —
+                    # instead of one opaque resolve hop
+                    for c in sampled:
+                        self.spans.event("CommitDebug", c,
+                                         "CommitProxyServer.commitBatch."
+                                         "RoutedScatter", Partition=ri,
+                                         Txns=len(sub),
+                                         HeaderOnly=int(not sub))
                 with _span.child_scope(batch_ctx):
                     replies = await asyncio.gather(
                         *(ask_routed(r, sub)
                           for r, sub in zip(self.resolvers, subs)))
+                for c in sampled:
+                    self.spans.event("CommitDebug", c,
+                                     "CommitProxyServer.commitBatch."
+                                     "RoutedGather", Version=version,
+                                     Partitions=len(self.resolvers))
                 # scatter the sparse verdicts into the AND-join: a txn a
                 # partition never judged contributes COMMITTED there —
                 # identical to broadcasting its empty clip (no ranges,

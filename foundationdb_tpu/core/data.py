@@ -718,6 +718,89 @@ class GetKeyReply:
     key: bytes = b""
 
 
+@dataclasses.dataclass
+class ScrubPageRequest:
+    """Paged shard-checksum request (PROTOCOL_VERSION 718, ISSUE 17) —
+    the consistency-scan read shape (REF:fdbserver/workloads/
+    ConsistencyCheck.actor.cpp checkDataConsistency, paged).  Asks one
+    storage server for per-page digests over its clip of [begin, end)
+    at a pinned ``version``: pages are cut every ``page_rows`` LIVE
+    rows (a LOGICAL boundary, so replicas running different engines —
+    or none — page identically over identical data), at most
+    ``max_pages`` pages per request.  The digest pass rides the run-
+    wise columnar extraction; no per-row tuples are materialized on
+    the server."""
+
+    begin: bytes = b""
+    end: bytes = b""
+    version: Version = 0
+    page_rows: int = 256
+    max_pages: int = 32
+
+
+@dataclasses.dataclass
+class ScrubPageReply:
+    """Reply to ScrubPageRequest: one (end_key, row_count, digest)
+    triple per page, columnar.
+
+    ``status`` reuses the GV_* codes with the GetRangeReply wholesale-
+    refusal discipline — a lagging/compacted/moved replica refuses the
+    WHOLE request and the scrubber re-pins or re-routes; a refusal is
+    never a mismatch (the zero-false-positive lever).  ``end_blob``
+    holds each page's LAST key concatenated with cumulative u32
+    ``end_bounds`` (the shared bounds discipline), ``counts`` one
+    little-endian u32 live-row count per page, ``digests`` 8 bytes of
+    blake2b per page.  ``more`` true means the range continues past
+    the last page's end key; the scrubber resumes from
+    ``key_after(last_end)``."""
+
+    status: int = 0
+    more: bool = False
+    end_bounds: bytes = b""
+    end_blob: bytes = b""
+    counts: bytes = b""
+    digests: bytes = b""
+
+    def __len__(self) -> int:
+        return len(self.counts) // 4
+
+    def pages(self) -> list[tuple[bytes, int, bytes]]:
+        """Decode to [(end_key, count, digest)] — comparison form."""
+        offs = _array("I")
+        offs.frombytes(self.end_bounds)
+        counts = _array("I")
+        counts.frombytes(self.counts)
+        if not _NATIVE_LE:
+            offs.byteswap()
+            counts.byteswap()
+        out = []
+        prev = 0
+        for i, e in enumerate(offs):
+            out.append((self.end_blob[prev:e], counts[i],
+                        self.digests[8 * i:8 * i + 8]))
+            prev = e
+        return out
+
+    @classmethod
+    def from_pages(cls, pages: list, more: bool) -> "ScrubPageReply":
+        """``pages`` is [(end_key, count, digest)] in scan order."""
+        bounds = _array("I")
+        counts = _array("I")
+        pos = 0
+        for end_key, count, _ in pages:
+            pos += len(end_key)
+            bounds.append(pos)
+            counts.append(count)
+        return cls(0, more, _bounds_to_wire(bounds),
+                   b"".join(p[0] for p in pages), _bounds_to_wire(counts),
+                   b"".join(p[2] for p in pages))
+
+    @classmethod
+    def refuse(cls, status: int) -> "ScrubPageReply":
+        """Whole-request refusal: no payload, just the GV_* code."""
+        return cls(status, False)
+
+
 class MutationBatchBuilder:
     """Append-only MutationBatch assembly (one blob join at finish)."""
 

@@ -57,11 +57,13 @@ class GrvProxy:
     async def metrics(self) -> dict:
         """Role counters for status (span rollup + GRV load)."""
         from ..runtime.profiler import stall_metrics
+        from ..runtime.span import process_counters
         return {
             "total_grvs": self.total_grvs,
             "sampled_txns": self.sampled_txns,
             **self.spans.counters(),
             **stall_metrics(),
+            **process_counters(),
         }
 
     async def get_read_version(self, lock_aware: bool = False,

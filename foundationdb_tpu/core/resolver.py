@@ -145,6 +145,7 @@ class Resolver:
             s.gauge("FusedGroupMean", lambda: round(
                 sum(self.group_sizes) / len(self.group_sizes), 2)
                 if self.group_sizes else 0.0)
+            s.gauge("WindowOccupancy", self.window_occupancy)
             s.gauge("PendingBatches", lambda: len(self._pending))
             s.gauge("DeviceQueueDepth",
                     lambda: (len(self._pipeline._pending)
@@ -155,19 +156,38 @@ class Resolver:
             self._msource = s
         return self._msource
 
+    def window_occupancy(self) -> float:
+        """Fraction of this partition's conflict-window ring in use
+        (ISSUE 17 satellite, the mesh's per-partition pressure gauge).
+        0.0 when the backend keeps no host-visible ring (the cpp
+        interval map, or a device pipeline owning the state outright)."""
+        cs = getattr(self.backend, "cs", None)
+        used = getattr(cs, "used", None)
+        cap = getattr(cs, "capacity", 0)
+        if used is None or not cap:
+            return 0.0
+        return round(used / cap, 4)
+
     async def metrics(self) -> dict:
         """Role counters for status (span rollup + resolve load +
         device-pipeline queue/in-flight depth — cluster.resolver_device)."""
         from ..runtime.profiler import stall_metrics
+        from ..runtime.span import process_counters
         return {
+            "version": self.version,
             "total_batches": self.total_batches,
             "total_txns": self.total_txns,
             "total_conflicts": self.total_conflicts,
             "total_header_batches": self.total_header_batches,
+            "fused_group_mean": round(
+                sum(self.group_sizes) / len(self.group_sizes), 2)
+            if self.group_sizes else 0.0,
+            "window_occupancy": self.window_occupancy(),
             **self.spans.counters(),
             **(self._pipeline.metrics() if self._pipeline is not None
                else {}),
             **stall_metrics(),
+            **process_counters(),
         }
 
     async def close(self, discard: bool = False) -> None:

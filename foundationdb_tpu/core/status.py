@@ -457,6 +457,86 @@ async def cluster_status(knobs: Knobs, transport: Transport,
         "sampled_txns": sum(
             m.get("sampled_txns", 0) for m in all_metrics),
     }
+    # process-wide trace-plane loss (ISSUE 17 satellite): every role
+    # splats its process's span TOTALS + probe-eviction counters, so
+    # dedupe by machine IP with max (one process per sim machine — the
+    # slow-task discipline) then sum across processes.  Nonzero
+    # ``probe_evictions``/``totals_spans_dropped`` is silent trace loss
+    # that previously had no surface at all.
+    by_proc: dict[str, dict] = {}
+    for r in roles:
+        m = r.get("metrics") or {}
+        if "probe_evictions" not in m:
+            continue
+        ip = r["addr"][0]
+        e = by_proc.setdefault(ip, {"probe_evictions": 0,
+                                    "totals_spans_emitted": 0,
+                                    "totals_spans_dropped": 0,
+                                    "totals_sampled_txns": 0})
+        e["probe_evictions"] = max(e["probe_evictions"],
+                                   m["probe_evictions"])
+        e["totals_spans_emitted"] = max(e["totals_spans_emitted"],
+                                        m.get("span_totals_emitted", 0))
+        e["totals_spans_dropped"] = max(e["totals_spans_dropped"],
+                                        m.get("span_totals_dropped", 0))
+        e["totals_sampled_txns"] = max(e["totals_sampled_txns"],
+                                       m.get("span_sampled_txns", 0))
+    for k in ("probe_evictions", "totals_spans_emitted",
+              "totals_spans_dropped", "totals_sampled_txns"):
+        tracing_rollup[k] = sum(e[k] for e in by_proc.values())
+
+    # routed-mesh rollup (ISSUE 16 counters, ISSUE 17 satellite): the
+    # per-partition routing shape on the LIVE plane — routed sends and
+    # empty-clip header-only replies summed over the commit proxies'
+    # route_stats, plus each partition's fusion depth and conflict-
+    # window occupancy off the resolvers' own metrics
+    proxy_metrics = [r.get("metrics") for r in roles
+                     if r["role"] == "commit_proxy" and r.get("metrics")]
+    n_parts = max((len(m.get("route_stats", []))
+                   for m in proxy_metrics), default=0)
+    routed = [{"sends": 0, "header_only": 0, "txns_routed": 0}
+              for _ in range(n_parts)]
+    for m in proxy_metrics:
+        for i, st in enumerate(m.get("route_stats", [])):
+            for k in routed[i]:
+                routed[i][k] += st.get(k, 0)
+    mesh_partitions = [{
+        "total_batches": m.get("total_batches", 0),
+        "header_batches": m.get("total_header_batches", 0),
+        "fused_group_mean": m.get("fused_group_mean", 0.0),
+        "window_occupancy": m.get("window_occupancy", 0.0),
+    } for m in resolver_metrics]
+    resolver_mesh_rollup = {
+        "partitions": len(resolver_metrics),
+        "routed_sends": sum(st["sends"] for st in routed),
+        "header_only_replies": sum(st["header_only"] for st in routed),
+        "txns_routed": sum(st["txns_routed"] for st in routed),
+        "per_partition_routing": routed,
+        "per_partition": mesh_partitions,
+    }
+
+    # consistency-scrub rollup (ISSUE 17): the scrubber publishes
+    # scrub_stats with the CC state at every pass end (the dd_stats
+    # discipline — no scrubber RPC surface needed); all-zero until the
+    # first full pass lands
+    scrub_stats = state.get("scrub_stats") or {}
+    scrub_rollup = {
+        "enabled": bool(getattr(knobs, "SCRUB_ENABLED", False)),
+        "pages_per_sec": scrub_stats.get("pages_per_sec", 0.0),
+        "pages_scrubbed": scrub_stats.get("pages_scrubbed", 0),
+        "rows_scrubbed": scrub_stats.get("rows_scrubbed", 0),
+        "passes_complete": scrub_stats.get("passes_complete", 0),
+        "last_pass_version": scrub_stats.get("last_pass_version", 0),
+        "last_pass_duration_s": scrub_stats.get("last_pass_duration_s",
+                                                0.0),
+        "mismatch_pages": scrub_stats.get("mismatch_pages", 0),
+        "mismatch_rows": scrub_stats.get("mismatch_rows", 0),
+        "refusals": scrub_stats.get("refusals", 0),
+        "ranges_skipped": scrub_stats.get("ranges_skipped", 0),
+        "invariant_checks": scrub_stats.get("invariant_checks", 0),
+        "invariant_violations": scrub_stats.get("invariant_violations",
+                                                0),
+    }
 
     return {
         "cluster": {
@@ -476,6 +556,8 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             "backup": backup_rollup,
             "degraded": degraded_rollup,
             "tracing": tracing_rollup,
+            "resolver_mesh": resolver_mesh_rollup,
+            "scrub": scrub_rollup,
             # the version-frontier picture (ISSUE 15): computed from the
             # same registry-backed metrics the trace file records every
             # interval, so status-now and metrics_tool-replay agree

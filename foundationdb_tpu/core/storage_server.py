@@ -21,6 +21,7 @@ from ..runtime.latency_probe import StageStats
 from ..runtime.profiler import RateMeter
 from ..runtime.profiler import stall_metrics as _stall_metrics
 from ..runtime.span import SpanSink, child_scope, current_span
+from ..runtime.span import process_counters as _process_trace_counters
 from ..runtime.trace import Severity, TraceEvent, get_trace_log
 from ..storage.kv_store import OP_CLEAR, OP_SET
 from ..storage.packed_ops import DurabilityRing
@@ -265,6 +266,9 @@ class StorageServer:
             # slow-task stalls of the hosting process (ISSUE 15
             # satellite): empty under sim / when no profiler is armed
             **_stall_metrics(),
+            # process-wide trace-plane loss counters (ISSUE 17
+            # satellite): status dedupes by address, like slow tasks
+            **_process_trace_counters(),
         }
 
     async def shard_metrics(self) -> dict:
@@ -1526,6 +1530,106 @@ class StorageServer:
                          "StorageServer.getKey.After",
                          Version=req.version, Tag=self.tag, Count=count)
         return GetKeyReply(0, count, key)
+
+    async def scrub_page(self, req) -> "ScrubPageReply":
+        """Paged shard checksums — the consistency-scan read shape
+        (ISSUE 17, PROTOCOL_VERSION 718): digest this server's clip of
+        [begin, end) at a pinned version, one 8-byte blake2b per
+        ``page_rows`` live rows, at most ``max_pages`` pages per call.
+
+        Rows come off the SAME extraction the packed range read uses
+        (engine block runs + lazy MVCC overlay forward merge), and each
+        page hashes in three bulk updates — length column, key blob,
+        value blob — so the digest pass never runs per-row Python
+        frames beyond the shared transpose.  Pages cut on LOGICAL row
+        count, so replicas running different engines (or none) page
+        identically over identical data; any replica-visible divergence
+        lands in some page's digest.  Refusals (too-old / future /
+        moved range) ride the GV_* status byte WHOLESALE — a refusal
+        tells the scrubber to re-pin or re-route, never that replicas
+        diverge.  Scrub reads deliberately skip the read counters and
+        the heat reservoir: the audit plane must not steer DD's heat
+        policy or the ratekeeper."""
+        import hashlib
+        from ..runtime.errors import WrongShardServer
+        from .data import (GV_FUTURE_VERSION, GV_TOO_OLD, GV_WRONG_SHARD,
+                           ScrubPageReply, _NATIVE_LE, _array)
+        span_ctx = current_span()
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.scrubPage.Before",
+                         Version=req.version, Tag=self.tag)
+        status = 0
+        try:
+            await self._wait_fetched()
+            await self._wait_for_version(req.version)
+        except FutureVersion:
+            status = GV_FUTURE_VERSION
+        except BaseException as e:
+            self.spans.event("TransactionDebug", span_ctx,
+                             "StorageServer.scrubPage.Error",
+                             Version=req.version, Tag=self.tag,
+                             Error=type(e).__name__)
+            raise
+        if not status and req.version < self.oldest_version:
+            status = GV_TOO_OLD
+        if not status:
+            try:
+                self._check_dropped(req.version, req.begin, req.end)
+            except WrongShardServer:
+                status = GV_WRONG_SHARD
+        if status:
+            self.spans.event("TransactionDebug", span_ctx,
+                             "StorageServer.scrubPage.After",
+                             Version=req.version, Tag=self.tag, Pages=0,
+                             Status=status)
+            return ScrubPageReply.refuse(status)
+        b = max(req.begin, self.shard.begin)
+        e = min(req.end, self.shard.end)
+        page_rows = max(1, req.page_rows)
+        limit = page_rows * max(1, req.max_pages)
+        if b >= e:
+            rows: list = []
+            more = False
+        elif self.engine is None:
+            rows, more = self.vmap.range_rows(b, e, req.version, limit, 0)
+        else:
+            rows, more = self._merged_range_packed(b, e, req.version,
+                                                   limit, 0)
+        pages: list[tuple[bytes, int, bytes]] = []
+        for i in range(0, len(rows), page_rows):
+            chunk = rows[i:i + page_rows]
+            if more and len(chunk) < page_rows:
+                # a partial page with rows beyond it cannot digest
+                # stably (the next call re-reads those rows into a
+                # differently-aligned page) — resume from the last FULL
+                # page instead.  Unreachable with byte_limit=0 (the row
+                # limit is a page multiple); kept as a contract guard.
+                break
+            ks = [r[0] for r in chunk]
+            vs = [r[1] for r in chunk]
+            h = hashlib.blake2b(digest_size=8)
+            lens = _array("I", map(len, ks))
+            lens.extend(map(len, vs))
+            if not _NATIVE_LE:
+                lens.byteswap()
+            h.update(lens.tobytes())
+            h.update(b"".join(ks))
+            h.update(b"".join(vs))
+            pages.append((bytes(chunk[-1][0]), len(chunk), h.digest()))
+        self.spans.event("TransactionDebug", span_ctx,
+                         "StorageServer.scrubPage.After",
+                         Version=req.version, Tag=self.tag,
+                         Pages=len(pages))
+        return ScrubPageReply.from_pages(pages, bool(more and pages))
+
+    def corrupt_for_test(self, key: bytes, value: bytes) -> None:
+        """TEST-ONLY bit-rot injection: apply a divergent row to THIS
+        replica alone, bypassing the log system — in-window at the
+        current version, so both the digest pass and the bisect read
+        observe the same wrong row.  Nothing in the product calls
+        this; the scrub tests and the perf_smoke scrub stage use it to
+        prove a single flipped row is caught key-exactly."""
+        self._apply(self.version, [Mutation.set(key, value)])
 
     # --- change feeds (REF: storageserver.actor.cpp changeFeedStreamQ) ---
 

@@ -199,6 +199,7 @@ class TLog:
         degraded flag (ISSUE 12 gray-failure signal — the TLog fsyncs
         on every commit, so a stalling disk shows up here first)."""
         from ..runtime.profiler import stall_metrics
+        from ..runtime.span import process_counters
         health = getattr(getattr(self.queue, "file", None), "health", None)
         return {
             "queue_bytes": self.queue.bytes_used if self.queue is not None else 0,
@@ -210,6 +211,7 @@ class TLog:
             **(health.snapshot() if health is not None else {}),
             **self.spans.counters(),
             **stall_metrics(),
+            **process_counters(),
         }
 
     def metrics_source(self):

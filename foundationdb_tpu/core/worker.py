@@ -105,6 +105,11 @@ class Worker:
         ws.gauge("HostedRoles", lambda: len(self.roles))
         ws.gauge("SlowTaskStalls", self._profiler_stalls)
         ws.gauge("DiskLatencyMs", self._disk_latency_ms)
+        # trace-plane loss counters (ISSUE 17 satellite): span drops
+        # and probe evictions are process-wide, so the worker — one per
+        # process — is their flight-record home
+        ws.gauge("ProbeEvictions", self._probe_evictions)
+        ws.gauge("SpanTotalsDropped", self._span_drops)
         self.metrics_registry.register(ws)
         self._role_sources: dict[int, object] = {}    # token -> MetricsSource
         serve_role(transport, "worker", self, base_token)
@@ -114,6 +119,16 @@ class Worker:
         from ..runtime.profiler import active_profiler
         p = active_profiler()
         return p.stalls if p is not None else 0
+
+    @staticmethod
+    def _probe_evictions() -> int:
+        from ..runtime.latency_probe import EVICTIONS_TOTAL
+        return EVICTIONS_TOTAL["probe_evictions"]
+
+    @staticmethod
+    def _span_drops() -> int:
+        from ..runtime.span import TOTALS
+        return TOTALS["dropped_spans"]
 
     def _disk_latency_ms(self) -> float:
         health = getattr(self.fs, "health", None) if self.fs is not None \

@@ -31,14 +31,16 @@ ROLE_METHODS: dict[str, list[tuple[str, bool]]] = {
              ("lock", False), ("metrics", False)],
     # change-feed methods appended at 713, get_values at 714,
     # shard_metrics with the shard-heat subsystem, get_key_values_packed
-    # at 715, get_key at 716 — always LAST: token layout is base+index,
-    # so new methods must never reorder existing slots
+    # at 715, get_key at 716, scrub_page at 718 — always LAST: token
+    # layout is base+index, so new methods must never reorder existing
+    # slots
     "storage": [("get_value", False), ("get_key_values", False),
                 ("watch_value", False), ("metrics", False),
                 ("get_latest_range", False), ("sample_split_key", False),
                 ("change_feed_stream", False), ("fetch_feed_state", False),
                 ("get_values", False), ("shard_metrics", False),
-                ("get_key_values_packed", False), ("get_key", False)],
+                ("get_key_values_packed", False), ("get_key", False),
+                ("scrub_page", False)],
     # metrics appended LAST: token layout is base+index, so new methods
     # must never reorder existing slots
     "commit_proxy": [("commit", False), ("metrics", False)],

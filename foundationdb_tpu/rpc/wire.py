@@ -367,6 +367,7 @@ def _register_core_structs() -> None:
         d.GetValuesRequest, d.GetValuesReply,
         d.GetRangeRequest, d.GetRangeReply,
         d.GetKeyRequest, d.GetKeyReply,
+        d.ScrubPageRequest, d.ScrubPageReply,
     ]):
         register_struct(cls, sid=i)
 

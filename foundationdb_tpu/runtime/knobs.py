@@ -112,7 +112,12 @@ class Knobs:
     # class a wire error decoded to depended on import order; the
     # coordination pair moved to 2910/2911.  Error codes cross the wire
     # numerically, so a 716 peer would mistype them — the gate fences it
-    PROTOCOL_VERSION: int = 717
+    # 718: online consistency scrub — ScrubPageRequest/Reply (wire
+    # struct ids 20/21) on the storage surface: per-page digests over a
+    # key range at a pinned read version, pages as packed end-key
+    # columns + u32 row counts + 8-byte blake2b digests; a 717 peer
+    # cannot decode the struct ids, so the gate fences it
+    PROTOCOL_VERSION: int = 718
     # --- change feeds ---
     # (sealed feed segments at or below the durable floor ALWAYS spill
     # to the DiskQueue side file on durable servers — a durability
@@ -330,6 +335,26 @@ class Knobs:
     RESOLVER_REBALANCE: bool = False
     RESOLVER_REBALANCE_RATIO: float = 2.0
     RESOLVER_REBALANCE_SUSTAIN_ROUNDS: int = 2
+
+    # --- consistency scrub (ISSUE 17) ---
+    # the online replica-audit plane: a singleton scrubber on the
+    # leading ClusterHost (the DD recruitment shape) continuously walks
+    # the shard map, pins a read version per chunk via GRV, fans a
+    # scrub_page digest request to EVERY replica in each shard's team
+    # (degraded included — auditing them is the point), and bisects any
+    # digest mismatch down to exact divergent rows via the packed range
+    # read path (severity-40 ScrubMismatch).  A frontier invariant
+    # watchdog rides the same role: per-tag version-order assertions
+    # off the live metrics plane (severity-40 ScrubInvariantViolation).
+    # Scrub reads are read-only and pacing rides the loop clock, so
+    # same-seed sim traces are bit-identical with the knob either way.
+    SCRUB_ENABLED: bool = False
+    SCRUB_PAGES_PER_SEC: float = 50.0         # pass pacing budget
+    SCRUB_PAGE_ROWS: int = 256                # rows per digest page
+    SCRUB_MAX_PAGES_PER_REQUEST: int = 32     # pages per scrub_page RPC
+    SCRUB_PASS_INTERVAL: float = 5.0          # idle between full passes
+    SCRUB_WATCHDOG_INTERVAL: float = 2.0      # invariant-check cadence
+    SCRUB_MAX_REPORTED_ROWS: int = 16         # ScrubMismatch events per page
 
     # --- observability ---
     METRICS_INTERVAL: float = 5.0             # role *Metrics emit period

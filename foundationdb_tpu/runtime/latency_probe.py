@@ -112,6 +112,14 @@ def merge_summaries(summaries: list[dict]) -> dict[str, dict[str, float]]:
     return out
 
 
+# process-wide probe-eviction rollup (ISSUE 17 satellite): per-instance
+# ``evictions`` counts die with their owning client object, so probe
+# loss under load was silent — role metrics() and the worker gauges
+# read THIS.  Reset with span.reset_totals() (same determinism contract:
+# a harness re-running a seeded sim in one process restarts the count).
+EVICTIONS_TOTAL = {"probe_evictions": 0}
+
+
 class TraceBatch:
     """Sampled per-transaction stage probes (one trace line per sampled
     txn).  ``attach()`` rolls the sampling dice; probes on unsampled ids
@@ -151,6 +159,7 @@ class TraceBatch:
             oldest = next(iter(self._live))
             del self._live[oldest]
             self.evictions += 1
+            EVICTIONS_TOTAL["probe_evictions"] += 1
         return True
 
     def event(self, txn_id: int, name: str) -> None:

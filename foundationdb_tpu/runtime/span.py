@@ -227,6 +227,23 @@ def reset_totals() -> None:
     for k in TOTALS:
         TOTALS[k] = 0
     _ids = itertools.count(1)
+    from .latency_probe import EVICTIONS_TOTAL
+    EVICTIONS_TOTAL["probe_evictions"] = 0
+
+
+def process_counters() -> dict:
+    """The process-wide trace-plane loss/volume counters under stable
+    metric names (ISSUE 17 satellite): span TOTALS plus the TraceBatch
+    probe-eviction rollup.  Splatted into every role's ``metrics()`` —
+    status dedupes per process by address, the slow-task discipline —
+    so silent probe/span loss under load finally shows up in the
+    tracing rollup.  Key names deliberately avoid the per-role
+    ``spans_emitted``/``spans_dropped`` of ``SpanSink.counters()``."""
+    from .latency_probe import EVICTIONS_TOTAL
+    return {"span_sampled_txns": TOTALS["sampled_txns"],
+            "span_totals_emitted": TOTALS["spans_emitted"],
+            "span_totals_dropped": TOTALS["dropped_spans"],
+            "probe_evictions": EVICTIONS_TOTAL["probe_evictions"]}
 
 
 class SpanSink:

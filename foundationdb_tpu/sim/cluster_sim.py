@@ -240,6 +240,26 @@ class SimulatedCluster:
                 return m.host.dd
         return None
 
+    def leader_scrubber(self):
+        """The live ConsistencyScrubber, if any machine currently
+        leads with SCRUB_ENABLED (ISSUE 17)."""
+        for m in self.machines:
+            if m.alive and m.host is not None \
+                    and getattr(m.host, "scrubber", None) is not None:
+                return m.host.scrubber
+        return None
+
+    def storage_objects(self) -> list:
+        """Every live in-process StorageServer object (scrub tests
+        reach these to inject test-only corruption on ONE replica)."""
+        out = []
+        for m in self.machines:
+            if m.alive and m.host is not None:
+                for role, obj in m.host.worker.roles.values():
+                    if role == "storage":
+                        out.append(obj)
+        return out
+
     async def txn_only_machines(self) -> list[SimMachine]:
         """Machines whose kill exercises recovery: hosting at least one
         txn-subsystem role, but no storage replica (re-replication needs

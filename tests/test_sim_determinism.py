@@ -96,6 +96,14 @@ def _child(path: str, mode: str = "default") -> None:
     # empty-clip fast path + sparse sub-batch scatter (ON) and the
     # verbatim broadcast twin (OFF) each carry their own bit-identical
     # proof
+    # ISSUE 17: the consistency scrubber is pinned OFF (its default)
+    # explicitly — the standing children keep proving the scrub-less
+    # trace, and a future default flip arming the always-on audit plane
+    # (its digest RPCs, GRV pins and watchdog rounds all emit traffic
+    # and events) must not silently change what they prove.  The
+    # "scrub_on"/"scrub_off" modes instead force the knob each way at a
+    # hot cadence, so the audit plane itself carries its own
+    # bit-identical proof.
     knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
                              RESOLVER_DEVICE_PIPELINE=True,
                              DD_SHARD_HEAT_SPLITS=False,
@@ -110,7 +118,8 @@ def _child(path: str, mode: str = "default") -> None:
                              METRICS_EMITTER=True,
                              METRICS_INTERVAL=1.0,
                              RESOLVER_MESH_ROUTING=True,
-                             RESOLVER_REBALANCE=False)
+                             RESOLVER_REBALANCE=False,
+                             SCRUB_ENABLED=False)
     durable = False
     n_resolvers = 1
     if mode == "metrics_off":
@@ -144,6 +153,18 @@ def _child(path: str, mode: str = "default") -> None:
         knobs = knobs.override(
             RESOLVER_MESH_ROUTING=(mode == "mesh_on"))
         n_resolvers = 2
+    elif mode in ("scrub_on", "scrub_off"):
+        # ISSUE 17: the always-on audit plane forced each way at a hot
+        # cadence — full replica-digest passes, mismatch-free triage
+        # arithmetic, watchdog rounds and scrub_stats publishes all run
+        # inside the bit-identical proof when ON; the OFF twin proves
+        # the knob gates the plane outright
+        knobs = knobs.override(SCRUB_ENABLED=(mode == "scrub_on"),
+                               SCRUB_PASS_INTERVAL=0.5,
+                               SCRUB_WATCHDOG_INTERVAL=0.5,
+                               SCRUB_PAGES_PER_SEC=500.0,
+                               SCRUB_PAGE_ROWS=8,
+                               SCRUB_MAX_PAGES_PER_REQUEST=4)
     elif mode in ("lsm_on", "lsm_off"):
         # ISSUE 14: durable lsm storage with a tiny memtable/trigger so
         # flushes AND compactions run inside the sim — leveled
@@ -196,6 +217,11 @@ def _child(path: str, mode: str = "default") -> None:
         # let the async halves drain: storage pull/apply and the
         # pipeline's verdict readbacks both emit trace events
         await asyncio.sleep(1.5)
+        if mode == "scrub_on":
+            # ISSUE 17: hold the sim open long enough that the scrubber
+            # (recruited after the first published state) completes at
+            # least one full keyspace pass inside the recorded trace
+            await asyncio.sleep(4.0)
         await sim.stop()
 
     run_simulation(main(), seed=_SEED)
@@ -417,6 +443,47 @@ def test_same_seed_sim_trace_bit_identical_mesh_knob_both_ways(tmp_path):
         f"the broadcast twin is no longer verbatim")
     assert (d3, n3) == (d4, n4), (
         f"same-seed sim trace diverged with the broadcast twin forced: "
+        f"run a = {d3} ({n3} events), run b = {d4} ({n4})")
+
+
+def test_same_seed_sim_trace_bit_identical_scrub_knob_both_ways(tmp_path):
+    """ISSUE 17 acceptance: a same-seed sim with the consistency
+    scrubber forced ON at a hot cadence (full replica-digest passes,
+    GRV pins, watchdog invariant rounds, scrub_stats publishes) must be
+    bit-identical across fresh processes, AND the same sim with the
+    knob forced OFF must be too — the knob selects the audit plane
+    outright, so each pair proves its own path.  The scrub-on pair
+    must show at least one completed pass and ZERO mismatches (an
+    honest cluster — the false-positive guard rides the determinism
+    proof); the scrub-off pair must show no scrub events at all."""
+    import re
+
+    d1, n1, *_ = _run_child(tmp_path, "ca", mode="scrub_on")
+    d2, n2, *_ = _run_child(tmp_path, "cb", mode="scrub_on")
+    assert n1 > 100, f"trace suspiciously small ({n1} events)"
+    on_trace = _trace_bytes(tmp_path, "ca")
+    passes = len(re.findall(rb'"Type":"ScrubPassComplete"', on_trace))
+    assert passes > 0, (
+        "no ScrubPassComplete in the scrub-on child's trace — the "
+        "scrubber never finished a pass, so this test proved nothing")
+    assert not re.search(rb'"Type":"ScrubMismatch"', on_trace), (
+        "ScrubMismatch on an honest cluster — a false positive inside "
+        "the determinism child")
+    assert not re.search(rb'"Type":"ScrubInvariantViolation"', on_trace), (
+        "watchdog violation on a healthy cluster inside the "
+        "determinism child")
+    assert (d1, n1) == (d2, n2), (
+        f"same-seed sim trace diverged with the scrubber forced ON: "
+        f"run a = {d1} ({n1} events), run b = {d2} ({n2}) — the audit "
+        f"plane added nondeterminism, not just chaos")
+    d3, n3, *_ = _run_child(tmp_path, "cc", mode="scrub_off")
+    d4, n4, *_ = _run_child(tmp_path, "cd", mode="scrub_off")
+    assert n3 > 100, f"trace suspiciously small ({n3} events)"
+    assert not re.search(rb'"Type":"Scrub', _trace_bytes(tmp_path, "cc")), (
+        "scrub events with the knob forced OFF — SCRUB_ENABLED no "
+        "longer gates the plane")
+    assert (d3, n3) == (d4, n4), (
+        f"same-seed sim trace diverged with the scrubber forced OFF: "
         f"run a = {d3} ({n3} events), run b = {d4} ({n4})")
 
 

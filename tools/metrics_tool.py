@@ -21,6 +21,10 @@ Views:
   file: per epoch, each RecoveryState step in order with its cuts,
   locked-tip vector and durable-copy adoptions (the ROADMAP 6 (e)
   suspects).
+- ``scrub``:    the consistency-scrub record (ISSUE 17): every completed
+  replica-audit pass with its pinned version and pace, every key-exact
+  ``ScrubMismatch``, every frontier ``ScrubInvariantViolation``, and the
+  ``ScrubMetrics`` progress series — ``cluster.scrub``, after the fact.
 - ``diff``:     two runs' series compared — emission counts and final
   numeric samples, largest relative deltas first (the plane-on/plane-off
   or before/after-regression A/B in one command).
@@ -29,6 +33,7 @@ Usage:
     python tools/metrics_tool.py summary  trace.jsonl [more.jsonl ...]
     python tools/metrics_tool.py lag      trace.jsonl [--series]
     python tools/metrics_tool.py recovery trace.jsonl
+    python tools/metrics_tool.py scrub    trace.jsonl
     python tools/metrics_tool.py diff     a.jsonl b.jsonl
     (any view: ``--json`` emits the full report as JSON; rolled ``.N``
     siblings of each path are included automatically)
@@ -150,6 +155,78 @@ def lag_report(events: list[dict]) -> dict:
     }
 
 
+# --- scrub: the replica-audit record (ISSUE 17) ---
+
+
+def scrub_report(events: list[dict]) -> dict:
+    """The consistency-scrub record from the trace alone: every full
+    pass (ScrubPassComplete), every key-exact divergence
+    (ScrubMismatch), every frontier-invariant violation
+    (ScrubInvariantViolation), and the ScrubMetrics progress series —
+    the same numbers ``cluster.scrub`` serves live, replayable after
+    the fact."""
+    passes, mismatches, violations, progress = [], [], [], []
+    for ev in events:
+        t = ev.get("Type")
+        if t == "ScrubPassComplete":
+            passes.append({
+                "t": ev.get("Time"),
+                "pass": ev.get("Pass"),
+                "version": ev.get("Version"),
+                "pages": ev.get("Pages", 0),
+                "rows": ev.get("Rows", 0),
+                "duration_s": ev.get("DurationS", 0.0),
+                "mismatch_rows": ev.get("MismatchRows", 0),
+                "refusals": ev.get("Refusals", 0),
+            })
+        elif t == "ScrubMismatch":
+            mismatches.append({
+                "t": ev.get("Time"),
+                "key": ev.get("Key"),
+                "version": ev.get("Version"),
+                "replicas": ev.get("Replicas"),
+                "values": ev.get("Values"),
+            })
+        elif t == "ScrubInvariantViolation":
+            violations.append({k: v for k, v in ev.items()
+                               if k != "Severity"})
+        elif t == "ScrubMetrics":
+            progress.append({
+                "t": ev.get("Time"),
+                "pages": ev.get("PagesScrubbed", 0),
+                "rows": ev.get("RowsScrubbed", 0),
+                "mismatch_rows": ev.get("MismatchRows", 0),
+                "refusals": ev.get("Refusals", 0),
+                "passes": ev.get("PassesComplete", 0),
+                "invariant_checks": ev.get("InvariantChecks", 0),
+                "invariant_violations": ev.get("InvariantViolations", 0),
+            })
+    for rows in (passes, mismatches, progress):
+        rows.sort(key=lambda r: r.get("t") or 0.0)
+    last = progress[-1] if progress else {}
+    last_pass = passes[-1] if passes else {}
+    return {
+        "passes": passes,
+        "mismatches": mismatches,
+        "violations": violations,
+        "progress_samples": len(progress),
+        "summary": {
+            "passes_complete": len(passes),
+            "last_pass_version": last_pass.get("version"),
+            "last_pass_duration_s": last_pass.get("duration_s"),
+            "pages_per_sec": round(
+                last_pass["pages"] / last_pass["duration_s"], 3)
+            if last_pass.get("duration_s") else 0.0,
+            "pages_scrubbed": last.get("pages",
+                                       last_pass.get("pages", 0)),
+            "mismatch_rows": max(last.get("mismatch_rows", 0),
+                                 last_pass.get("mismatch_rows", 0)),
+            "invariant_violations": last.get("invariant_violations",
+                                             len(violations)),
+        },
+    }
+
+
 # --- recovery: the version-cut audit trail ---
 
 
@@ -241,7 +318,8 @@ def _load(paths: list[str]) -> list[dict]:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("view", choices=("summary", "lag", "recovery", "diff"))
+    ap.add_argument("view", choices=("summary", "lag", "recovery", "scrub",
+                                     "diff"))
     ap.add_argument("paths", nargs="+",
                     help="trace JSONL file(s); diff takes exactly two")
     ap.add_argument("--json", action="store_true")
@@ -305,6 +383,31 @@ def main(argv=None) -> int:
                     print(f"    t={r['t']:<12} lag={r['lag_versions']:<10} "
                           f"queue={r['queue_bytes']:<10} "
                           f"window={r['window_versions']}")
+        return 0
+    if args.view == "scrub":
+        rep = scrub_report(events)
+        if args.json:
+            print(json.dumps(rep, indent=2, default=str))
+            return 0
+        s = rep["summary"]
+        print(f"passes={s['passes_complete']} "
+              f"last_version={s['last_pass_version']} "
+              f"last_duration_s={s['last_pass_duration_s']} "
+              f"pages_per_sec={s['pages_per_sec']}")
+        print(f"pages={s['pages_scrubbed']} "
+              f"mismatch_rows={s['mismatch_rows']} "
+              f"invariant_violations={s['invariant_violations']}")
+        for p in rep["passes"]:
+            print(f"  pass {p['pass']}  t={p['t']}  v={p['version']}  "
+                  f"pages={p['pages']} rows={p['rows']} "
+                  f"dur={p['duration_s']}s refusals={p['refusals']}")
+        for m in rep["mismatches"]:
+            print(f"  MISMATCH key={m['key']} v={m['version']} "
+                  f"replicas={m['replicas']}")
+        for v in rep["violations"]:
+            print(f"  VIOLATION {v.get('Invariant')}: "
+                  + " ".join(f"{k}={v[k]}" for k in sorted(v)
+                             if k not in ("Type", "Time", "Invariant")))
         return 0
     # recovery
     rep = recovery_report(events)

@@ -133,7 +133,19 @@ backend touch), and batch-group fusion must be observed engaging on
 the live path (fused group mean > 1 — the regression that motivated
 the issue was exactly group_mean=1.0 in situ).
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|compact|observe|mesh|all]
+FIFTEENTH stage (``--stage scrub``, ISSUE 17): the online consistency
+scrubber — a seeded recruited sim with the scrub plane ON: the first
+full replica-audit pass must complete CLEAN on an honest cluster
+(zero mismatches — the false-positive guard), then a single row
+corrupted on ONE replica via the test-only bit-rot hook must be
+caught within one pass as a key-exact ScrubMismatch (exact key hex,
+pinned version, both replica addresses), visible through all three
+consumer surfaces (cluster.scrub status rollup, metrics_tool scrub
+view, the raw trace); the frontier watchdog must have run with zero
+invariant violations; and a scrub-on vs scrub-off twin-sim overhead
+A/B must hold within a guarded wall-clock ratio.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|compact|observe|mesh|scrub|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -230,6 +242,17 @@ MESH_RATIO_FLOOR = 1.1        # routed vs broadcast commit txns/s
 MESH_HEADER_FRAC_FLOOR = 0.5  # cold partition's header-only send share
 MESH_GROUP_MEAN_FLOOR = 1.5   # live-path fusion must actually engage
 MESH_BUDGET_S = 240.0         # doubles as the hard wedge deadline
+SCRUB_KEYS = 48               # rows the detection sim seeds
+SCRUB_SIM_PAGE_ROWS = 8       # small pages so one shard spans many
+SCRUB_SIM_MAX_PAGES = 4       # ...and many chunks (the `more` path)
+SCRUB_WAIT_S = 120.0          # virtual-clock ceiling per wait phase
+SCRUB_AB_SECONDS = 6.0        # virtual seconds per overhead-twin side
+SCRUB_AB_KEYS = 64            # rows each overhead twin writes
+SCRUB_OVERHEAD_CEIL = 1.60    # scrub-on / scrub-off sim wall ratio
+SCRUB_OVERHEAD_SLACK_S = 5.0  # absolute floor under the ratio (the
+#                               twins are whole recruited sims; box
+#                               noise on a run that short is seconds)
+SCRUB_BUDGET_S = 240.0        # doubles as the hard wedge deadline
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -2482,6 +2505,243 @@ def check_mesh(budget_s: float = MESH_BUDGET_S, quiet: bool = False) -> float:
     return elapsed
 
 
+def scrub_seconds(deadline_s: float | None = None) -> tuple[float, dict]:
+    """The consistency-scrub smoke (ISSUE 17), two halves:
+
+    1. **Detection under the seeded sim**: a recruited double-replicated
+       cluster with the scrub plane ON and the pass cadence pinned hot.
+       The first full pass must complete CLEAN (zero mismatches on an
+       honest cluster — the false-positive guard), the watchdog must
+       have checked invariants with zero violations, and then a single
+       row corrupted on ONE replica via ``corrupt_for_test`` must be
+       caught within one pass as a key-exact ``ScrubMismatch`` — and
+       the catch must be visible through the status rollup
+       (``cluster.scrub``) and ``metrics_tool.scrub_report`` alike.
+    2. **Overhead A/B on twin sims**: the identical seeded
+       write-then-idle sim run scrub-on vs scrub-off; scrub-on wall
+       time must hold within ``SCRUB_OVERHEAD_CEIL`` of scrub-off (an
+       absolute slack floor under the ratio for box noise)."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.core.status import cluster_status
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.runtime.simloop import run_simulation
+    from foundationdb_tpu.runtime.trace import (Severity, TraceLog,
+                                                get_trace_log, set_trace_log)
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import metrics_tool
+
+    t_all = time.perf_counter()
+    stats: dict = {}
+
+    # ---- half 1: clean pass, then key-exact catch (virtual time) ----
+    events: list[dict] = []
+    sink = TraceLog(min_severity=Severity.INFO)
+    sink.sink = events.append
+    prev_log = get_trace_log()
+    set_trace_log(sink)
+    status_doc: dict = {}
+    bad_key = b""
+
+    scrub_knobs = dict(SCRUB_ENABLED=True,
+                       SCRUB_PASS_INTERVAL=0.5,
+                       SCRUB_WATCHDOG_INTERVAL=0.5,
+                       SCRUB_PAGES_PER_SEC=500.0,
+                       SCRUB_PAGE_ROWS=SCRUB_SIM_PAGE_ROWS,
+                       SCRUB_MAX_PAGES_PER_REQUEST=SCRUB_SIM_MAX_PAGES)
+
+    async def sim_main() -> None:
+        knobs = Knobs().override(METRICS_INTERVAL=1.0,
+                                 METRICS_EMITTER=True,
+                                 DD_ENABLED=True,
+                                 STORAGE_DURABILITY_LAG=0.1,
+                                 **scrub_knobs)
+        sim = SimulatedCluster(knobs, n_machines=5, durable_storage=True,
+                               spec=ClusterConfigSpec(min_workers=5,
+                                                      replication=2))
+        await sim.start()
+        await asyncio.wait_for(sim.wait_epoch(1), 120)
+        db = await sim.database()
+        keys = [b"scrub%04d" % i for i in range(SCRUB_KEYS)]
+        for k in keys:
+            async def body(tr, k=k):
+                tr.set(k, b"good-" + k)
+            await db.run(body)
+
+        async def wait_for(pred, what: str):
+            for _ in range(int(SCRUB_WAIT_S / 0.25)):
+                if pred():
+                    return
+                await asyncio.sleep(0.25)
+            raise AssertionError(
+                f"scrub smoke: {what} did not happen within "
+                f"{SCRUB_WAIT_S:.0f} virtual seconds")
+
+        # the scrubber is CC-recruited after the first published state;
+        # wait for it, then for the first CLEAN full pass
+        await wait_for(lambda: sim.leader_scrubber() is not None,
+                       "scrubber recruitment")
+        scr = sim.leader_scrubber()
+        await wait_for(lambda: scr.passes_complete >= 1,
+                       "the first full scrub pass")
+        assert scr.mismatch_rows == 0 and scr.mismatch_pages == 0, (
+            f"FALSE POSITIVE: the scrubber reported "
+            f"{scr.mismatch_rows} divergent rows on an honest cluster")
+        assert not [e for e in events
+                    if e.get("Type") == "ScrubMismatch"], (
+            "FALSE POSITIVE: a ScrubMismatch event on an honest cluster")
+        stats["clean_pass_pages"] = scr.last_pass_pages
+        stats["clean_pass_version"] = scr.last_pass_version
+
+        # bit-rot exactly one row on exactly one replica: pick a hosted
+        # (storage, key) pair so the divergence is real on that team
+        nonlocal bad_key
+        victim = None
+        for ss in sim.storage_objects():
+            for k in keys:
+                if ss.shard.begin <= k < ss.shard.end:
+                    victim, bad_key = ss, k
+                    break
+            if victim is not None:
+                break
+        assert victim is not None, \
+            "no storage object hosts any seeded key — sim shape changed"
+        victim.corrupt_for_test(bad_key, b"BITROT-" + bad_key)
+        pass_at_corrupt = scr.passes_complete
+        await wait_for(lambda: scr.mismatch_rows > 0,
+                       "detection of the injected corruption")
+        stats["passes_to_detect"] = scr.passes_complete + 1 - pass_at_corrupt
+        assert scr.invariant_checks > 0 and scr.invariant_violations == 0, (
+            f"watchdog: {scr.invariant_checks} checks, "
+            f"{scr.invariant_violations} violations on a healthy frontier")
+        # one more pass END so the scrub_stats publish carries the catch
+        settled = scr.passes_complete
+        await wait_for(lambda: scr.passes_complete > settled,
+                       "the post-detection publish pass")
+
+        nonlocal status_doc
+        t = sim.client_transport()
+        status_doc = await asyncio.wait_for(
+            cluster_status(knobs, t, sim.coordinator_stubs(t)), 60)
+        await sim.stop()
+
+    try:
+        run_simulation(sim_main(), seed=20250806)
+    finally:
+        set_trace_log(prev_log)
+
+    # the catch is key-exact in the raw trace: exact key hex, pinned
+    # version, and BOTH replica addresses named
+    hits = [e for e in events if e.get("Type") == "ScrubMismatch"]
+    assert hits, "corruption was counted but no ScrubMismatch was traced"
+    exact = [e for e in hits if e.get("Key") == bad_key.hex()]
+    assert exact, (
+        f"ScrubMismatch named keys {[e.get('Key') for e in hits]}, not "
+        f"the corrupted {bad_key.hex()!r} — triage is not key-exact")
+    ev = exact[0]
+    assert ev.get("Version", 0) > 0 and ev.get("Severity") == 40, ev
+    assert len(str(ev.get("Replicas", "")).split(",")) == 2, (
+        f"mismatch named {ev.get('Replicas')!r}, not both replicas")
+    stats["mismatch_events"] = len(hits)
+
+    scrub = status_doc["cluster"]["scrub"]
+    assert scrub["enabled"] and scrub["passes_complete"] >= 2, scrub
+    assert scrub["mismatch_rows"] >= 1 and scrub["last_pass_version"] > 0, \
+        scrub
+    assert scrub["pages_per_sec"] > 0 and scrub["invariant_checks"] > 0, \
+        scrub
+    assert scrub["invariant_violations"] == 0, scrub
+    stats["status_scrub"] = {k: scrub[k] for k in
+                             ("passes_complete", "pages_scrubbed",
+                              "mismatch_rows", "pages_per_sec",
+                              "invariant_checks")}
+
+    # the tool chain over the recorded events agrees with status
+    rep = metrics_tool.scrub_report(events)
+    assert rep["summary"]["passes_complete"] >= 2, rep["summary"]
+    assert any(m["key"] == bad_key.hex() for m in rep["mismatches"]), (
+        "metrics_tool scrub view lost the key-exact mismatch")
+    assert not rep["violations"], rep["violations"]
+    assert rep["progress_samples"] >= 2, (
+        "no ScrubMetrics progress series — the scrubber never joined "
+        "the worker's metrics registry")
+
+    # ---- half 2: scrub-on vs scrub-off twin-sim overhead (wall) ----
+    def twin(scrub_on: bool) -> float:
+        async def side() -> None:
+            kn = dict(scrub_knobs) if scrub_on else {"SCRUB_ENABLED": False}
+            knobs = Knobs().override(DD_ENABLED=True,
+                                     STORAGE_DURABILITY_LAG=0.1, **kn)
+            sim = SimulatedCluster(knobs, n_machines=5,
+                                   durable_storage=True,
+                                   spec=ClusterConfigSpec(min_workers=5,
+                                                          replication=2))
+            await sim.start()
+            await asyncio.wait_for(sim.wait_epoch(1), 120)
+            db = await sim.database()
+            for i in range(SCRUB_AB_KEYS):
+                async def body(tr, i=i):
+                    tr.set(b"ab%04d" % i, b"v" * 64)
+                await db.run(body)
+            await asyncio.sleep(SCRUB_AB_SECONDS)
+            if scrub_on:
+                scr = sim.leader_scrubber()
+                assert scr is not None and scr.passes_complete >= 1, (
+                    "the scrub-on twin never completed a pass — the "
+                    "overhead A/B proved nothing")
+            await sim.stop()
+
+        t0 = time.perf_counter()
+        run_simulation(side(), seed=20250807)
+        return time.perf_counter() - t0
+
+    drop = TraceLog()
+    drop.sink = lambda ev: None
+    set_trace_log(drop)
+    try:
+        on_s = twin(True)
+        off_s = twin(False)
+    finally:
+        set_trace_log(prev_log)
+    stats["sim_on_s"] = round(on_s, 3)
+    stats["sim_off_s"] = round(off_s, 3)
+    stats["overhead_ratio"] = round(on_s / max(off_s, 1e-9), 3)
+    assert on_s <= off_s * SCRUB_OVERHEAD_CEIL + SCRUB_OVERHEAD_SLACK_S, (
+        f"scrub overhead: the scrub-on twin took {on_s:.3f}s vs "
+        f"{off_s:.3f}s off ({stats['overhead_ratio']:.2f}x, ceiling "
+        f"{SCRUB_OVERHEAD_CEIL:.2f}x) — the audit plane stopped being "
+        f"a background whisper")
+
+    elapsed = time.perf_counter() - t_all
+    if deadline_s is not None and elapsed > deadline_s:
+        raise AssertionError(
+            f"scrub smoke overran its {deadline_s:.0f}s deadline "
+            f"({elapsed:.1f}s)")
+    return elapsed, stats
+
+
+def check_scrub(budget_s: float = SCRUB_BUDGET_S,
+                quiet: bool = False) -> float:
+    """Run the consistency-scrub smoke; raises AssertionError on a
+    false positive, a missed or key-inexact catch, a watchdog
+    violation on a healthy cluster, a broken consumer surface, or
+    scrub overhead past the ceiling."""
+    elapsed, stats = scrub_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] scrub: clean pass of "
+              f"{stats['clean_pass_pages']} pages, injected row caught "
+              f"in {stats['passes_to_detect']} pass(es) "
+              f"({stats['mismatch_events']} ScrubMismatch events); "
+              f"status {stats['status_scrub']}; overhead "
+              f"{stats['sim_on_s']:.1f}s on vs "
+              f"{stats['sim_off_s']:.1f}s off "
+              f"({stats['overhead_ratio']:.2f}x)")
+    assert elapsed < budget_s, (
+        f"scrub smoke took {elapsed:.1f}s (budget {budget_s:.0f}s)")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
@@ -2490,7 +2750,7 @@ def main() -> int:
                     choices=("apply", "pipeline", "feed", "read",
                              "resolve", "heat", "backup", "scan",
                              "bigkeys", "recover", "mvcc", "compact",
-                             "observe", "mesh", "all"),
+                             "observe", "mesh", "scrub", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -2512,6 +2772,7 @@ def main() -> int:
     ap.add_argument("--observe-budget", type=float,
                     default=OBSERVE_BUDGET_S)
     ap.add_argument("--mesh-budget", type=float, default=MESH_BUDGET_S)
+    ap.add_argument("--scrub-budget", type=float, default=SCRUB_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -2541,6 +2802,8 @@ def main() -> int:
         check_observe(budget_s=args.observe_budget)
     if args.stage in ("mesh", "all"):
         check_mesh(budget_s=args.mesh_budget)
+    if args.stage in ("scrub", "all"):
+        check_scrub(budget_s=args.scrub_budget)
     return 0
 
 
