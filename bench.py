@@ -656,6 +656,31 @@ def run_multi_resolver_phase(quiet: bool) -> dict:
     return res
 
 
+def run_device_plane_phase(quiet: bool) -> dict:
+    """Device-plane A/Bs (ISSUE 18): the sharded read mirror vs the
+    single directory under churn, the verdict-bitmask readback vs the
+    raw-vector twin, and the in-place ring append vs the rebuild twin —
+    in a SUBPROCESS pinned to the 8-virtual-device CPU mesh, because
+    the sharded mirror needs a device-count axis this sandbox's single
+    chip cannot provide (the multi_resolver discipline)."""
+    import json as _json
+    import subprocess
+
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    p = subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.bench.device_plane"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    if p.returncode != 0 or not p.stdout.strip():
+        raise RuntimeError(
+            f"device_plane rc={p.returncode}: {p.stderr.strip()[-300:]}")
+    res = _json.loads(p.stdout.strip().splitlines()[-1])
+    if not quiet:
+        print(f"[device_plane] {res}", file=sys.stderr)
+    return res
+
+
 def run_feed_tail_phase(quiet: bool) -> dict:
     """Change-feed tail stage (ISSUE 4): concurrent writers + a LIVE
     feed consumer over the in-process commit pipeline.  Reports
@@ -1787,6 +1812,15 @@ def main() -> int:
                 args.stage_timeout, out)
             if mr is not None:
                 out["multi_resolver_scaling"] = mr
+
+            # device plane (ISSUE 18): sharded mirror / verdict bitmask /
+            # in-place ring A/Bs on the forced 8-device CPU mesh
+            dp = call_bounded(
+                "device_plane",
+                lambda: run_device_plane_phase(args.quiet),
+                args.stage_timeout, out)
+            if dp is not None:
+                out.update(dp)
 
             # change-feed tail (ISSUE 4): streaming throughput + lag of
             # a live consumer riding the same pipeline

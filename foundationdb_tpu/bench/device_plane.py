@@ -1,0 +1,235 @@
+"""Device-plane bench (ISSUE 18): the sharded read mirror, the
+verdict-bitmask readback, and the Pallas in-place ring append, each A/B'd
+against its verbatim twin.
+
+Three measurements, flat-key JSON on stdout (the BENCH artifact merges
+them verbatim):
+
+1. **Sharded read mirror vs single directory** under tail-localized
+   churn: every round inserts a key span past the existing keyspace
+   (bumping the packed index gen) then probes batched reads.  The twin
+   goes stale on every round and pays a full re-upload + engine
+   fallback; the sharded mirror partial-refreshes only the touched tail
+   shard and keeps serving off the device.  Reports device-served batch
+   counts, keys/s per side, and refresh locality.
+
+2. **Verdict-bitmask readback vs the raw-vector twin**: mostly-clean
+   proxy batches through DevicePipeline on the jax backend with
+   RESOLVER_VERDICT_BITMASK on vs off — readback bytes/txn and txns/s
+   per side, verdicts asserted bit-identical.
+
+3. **In-place ring append vs the rebuild twin**: the same batches with
+   RESOLVER_RING_INPLACE on vs off — txns/s per side, verdicts asserted
+   bit-identical.  On a CPU host the kernel runs in interpret mode, so
+   the ratio is a correctness exercise, not a perf claim; the recorded
+   mode says which.
+
+The sharded mirror needs a multi-device mesh; this sandbox exposes one
+chip, so bench.py runs this module in a SUBPROCESS pinned to the
+8-virtual-device CPU mesh (the multi_resolver discipline).
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python -m foundationdb_tpu.bench.device_plane
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+MIRROR_KEYS = 120_000
+ROUNDS = 12
+CHURN_KEYS = 400
+PROBES = 512
+BATCHES_PER_ROUND = 2
+SHARDS = 4
+VERDICT_BATCHES = 48
+VERDICT_TXNS = 64
+RING_BATCHES = 24
+
+
+def run_mirror() -> dict:
+    import jax
+
+    from foundationdb_tpu.device.read_serve import DeviceReadServer
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.storage.kv_store import OP_SET, MemoryKVStore
+
+    out: dict = {"devplane_devices": len(jax.devices()),
+                 "devplane_shards": SHARDS}
+
+    def side(shards: int) -> tuple[float, int, "DeviceReadServer"]:
+        kv = MemoryKVStore(None, "t")
+        kv._apply([(OP_SET, b"mk%07d" % i, b"v%07d" % i)
+                   for i in range(MIRROR_KEYS)])
+        kv.packed_index._merge()
+        knobs = Knobs().override(STORAGE_DEVICE_READ_MIN_BATCH=4,
+                                 STORAGE_DEVICE_READ_SHARDS=shards)
+        srv = DeviceReadServer(kv, knobs)
+        assert srv.active
+        probe_sets = [
+            sorted({b"mk%07d" % ((r * 104729 + j * 31 + s * 7919)
+                                 % (MIRROR_KEYS + 500))
+                    for j in range(PROBES)})
+            for r in range(ROUNDS) for s in range(BATCHES_PER_ROUND)]
+        warm = probe_sets[0]
+        if srv.get_batch(warm) is None:
+            srv.get_batch(warm)
+        srv.served_batches = 0
+        srv.fallbacks = 0
+        keys_served = 0
+        t0 = time.perf_counter()
+        pi = 0
+        for r in range(ROUNDS):
+            kv._apply([(OP_SET, b"zz%07d" % (r * CHURN_KEYS + j), b"c")
+                       for j in range(CHURN_KEYS)])
+            kv.packed_index._merge()
+            for _ in range(BATCHES_PER_ROUND):
+                keys = probe_sets[pi]
+                pi += 1
+                got = srv.get_batch(keys)
+                if got is None:
+                    got = kv.get_batch(keys)
+                keys_served += len(keys)
+                assert got == kv.get_batch(keys), \
+                    "device read path diverged from the engine"
+        return time.perf_counter() - t0, keys_served, srv
+
+    twin_s, twin_keys, twin_srv = side(0)
+    shard_s, shard_keys, shard_srv = side(SHARDS)
+    m = shard_srv.metrics()
+    out.update({
+        "devplane_mirror_twin_batches": twin_srv.served_batches,
+        "devplane_mirror_sharded_batches": shard_srv.served_batches,
+        "devplane_mirror_served_ratio": round(
+            shard_srv.served_batches / max(twin_srv.served_batches, 1), 2),
+        "devplane_mirror_twin_keys_per_sec": round(twin_keys / twin_s, 1),
+        "devplane_mirror_sharded_keys_per_sec": round(shard_keys / shard_s, 1),
+        "devplane_mirror_shard_refreshes": m["device_read_shard_refreshes"],
+        "devplane_mirror_full_splits": m["device_read_full_splits"],
+    })
+    return out
+
+
+def _proxy_batches(n_batches: int):
+    from foundationdb_tpu.ops.batch import TxnRequest
+
+    batches, versions = [], []
+    v, key = 1_000, 0
+    for i in range(n_batches):
+        txns = []
+        for j in range(VERDICT_TXNS):
+            if i % 12 == 11 and j < 2:
+                # cross-batch collision at a stale snapshot -> CONFLICT,
+                # so the packed planes carry real set bits
+                k = b"dp-hot"
+                txns.append(TxnRequest([(k, k + b"\x00")],
+                                       [(k, k + b"\x00")], v - 200))
+            else:
+                k = b"dp%08d" % key
+                key += 1
+                txns.append(TxnRequest([(k, k + b"\x00")],
+                                       [(k, k + b"\x00")], v - 1))
+        batches.append(txns)
+        versions.append(v)
+        v += 10
+    return batches, versions
+
+
+def _pipeline_pass(knobs, batches, versions) -> tuple[list, float, float]:
+    """One DevicePipeline pass; returns (flat verdicts, elapsed_s,
+    readback bytes/txn)."""
+    from foundationdb_tpu.device.pipeline import DevicePipeline
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+
+    async def run():
+        be = make_conflict_backend(knobs)
+        pipe = DevicePipeline(be, knobs)
+        t0 = time.perf_counter()
+        futs = [pipe.submit(t, v) for t, v in zip(batches, versions)]
+        rows = [await f for f in futs]
+        dt = time.perf_counter() - t0
+        await pipe.close()
+        bpt = be.readback_bytes / max(be.readback_txns, 1)
+        return [x for r in rows for x in r], dt, bpt
+    return asyncio.run(run())
+
+
+def _base_knobs():
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    return Knobs().override(
+        RESOLVER_CONFLICT_BACKEND="tpu",
+        RESOLVER_BATCH_TXNS=VERDICT_TXNS,
+        RESOLVER_RANGES_PER_TXN=2, CONFLICT_RING_CAPACITY=4096,
+        KEY_ENCODE_BYTES=16, CONFLICT_WINDOW_SLOTS=64,
+        MAX_WRITE_TRANSACTION_LIFE_VERSIONS=1_000, RESOLVER_GROUP_MAX=8)
+
+
+def run_verdict_bitmask() -> dict:
+    batches, versions = _proxy_batches(VERDICT_BATCHES)
+    base = _base_knobs()
+    raw, raw_s, raw_bpt = _pipeline_pass(
+        base.override(RESOLVER_VERDICT_BITMASK=False), batches, versions)
+    packed, packed_s, packed_bpt = _pipeline_pass(
+        base.override(RESOLVER_VERDICT_BITMASK=True), batches, versions)
+    n = VERDICT_BATCHES * VERDICT_TXNS
+    return {
+        "devplane_verdict_parity": raw == packed,
+        "devplane_verdict_aborts": sum(1 for x in raw if x != 0),
+        "devplane_verdict_raw_bytes_per_txn": round(raw_bpt, 2),
+        "devplane_verdict_packed_bytes_per_txn": round(packed_bpt, 3),
+        "devplane_verdict_bitmask_ratio": round(
+            raw_bpt / max(packed_bpt, 1e-9), 1),
+        "devplane_verdict_raw_txns_per_sec": round(n / raw_s, 1),
+        "devplane_verdict_packed_txns_per_sec": round(n / packed_s, 1),
+    }
+
+
+def run_ring_inplace() -> dict:
+    import jax
+
+    batches, versions = _proxy_batches(RING_BATCHES)
+    base = _base_knobs()
+    rebuild, rebuild_s, _ = _pipeline_pass(
+        base.override(RESOLVER_RING_INPLACE=False), batches, versions)
+    inplace, inplace_s, _ = _pipeline_pass(
+        base.override(RESOLVER_RING_INPLACE=True), batches, versions)
+    n = RING_BATCHES * VERDICT_TXNS
+    return {
+        "devplane_ring_parity": rebuild == inplace,
+        "devplane_ring_rebuild_txns_per_sec": round(n / rebuild_s, 1),
+        "devplane_ring_inplace_txns_per_sec": round(n / inplace_s, 1),
+        # interpret mode on cpu: correctness exercise, not a perf claim
+        "devplane_ring_mode": jax.devices()[0].platform,
+    }
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_enable_x64", True)   # the mirror wants u64
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:   # noqa: BLE001 — backend already initialized
+        pass
+
+    out: dict = {}
+    out.update(run_mirror())
+    out.update(run_verdict_bitmask())
+    out.update(run_ring_inplace())
+    rc = 0
+    if not out["devplane_verdict_parity"]:
+        print("FATAL: bitmask verdicts diverge from the raw-vector twin",
+              flush=True)
+        rc = 1
+    if not out["devplane_ring_parity"]:
+        print("FATAL: in-place ring verdicts diverge from the rebuild twin",
+              flush=True)
+        rc = 1
+    print(json.dumps(out))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -513,6 +513,33 @@ class CommitProxy:
             if version is not None:
                 await self._repair_chain(prev_version, version, False, False)
 
+    @staticmethod
+    def _join_abort_words(reply, final: list[int],
+                          idx: list[int] | None) -> bool:
+        """Bitmask AND-join (ISSUE 18): fold a reply's packed abort
+        words into ``final`` touching only set bits; ``idx`` maps the
+        reply's positions to batch positions (None = identity, the
+        broadcast twin).  Bit decode is conflict_bit + too_old_bit —
+        exactly the codes pack_abort_words packed, so the result is
+        bit-identical to the per-verdict scatter.  Returns False when
+        the reply carries no words (knob off / old peer) and the caller
+        must run the scatter twin."""
+        words = reply.abort_words
+        if words is None:
+            return False
+        nw = len(words) // 2
+        for w in range(nw):
+            cw = words[w]
+            while cw:
+                b = (cw & -cw).bit_length() - 1
+                cw &= cw - 1
+                i = w * 32 + b
+                v = 1 + ((words[nw + w] >> b) & 1)
+                j = i if idx is None else idx[i]
+                if v > final[j]:
+                    final[j] = v
+        return True
+
     # --- the pipeline (REF: commitBatch) ---
 
     async def _commit_batch(self, batch: list[tuple[CommitTransactionRequest,
@@ -688,17 +715,23 @@ class CommitProxy:
                 # partition never judged contributes COMMITTED there —
                 # identical to broadcasting its empty clip (no ranges,
                 # no conflict).  TOO_OLD dominates, then CONFLICT.
+                # A reply carrying abort_words (RESOLVER_VERDICT_BITMASK)
+                # takes the bitmask join: all-COMMITTED partitions — the
+                # steady-state majority — skip the scatter outright, and
+                # aborting ones touch only their set bits.
                 for reply, idx in zip(replies, index_maps):
-                    for j, v in zip(idx, reply.verdicts):
-                        final[j] = max(final[j], v)
+                    if not self._join_abort_words(reply, final, idx):
+                        for j, v in zip(idx, reply.verdicts):
+                            final[j] = max(final[j], v)
             else:
                 with _span.child_scope(batch_ctx):
                     replies = await asyncio.gather(
                         *(ask(r) for r in self.resolvers))
                 # AND the verdicts: TOO_OLD dominates, then CONFLICT
                 for reply in replies:
-                    for i, v in enumerate(reply.verdicts):
-                        final[i] = max(final[i], v)
+                    if not self._join_abort_words(reply, final, None):
+                        for i, v in enumerate(reply.verdicts):
+                            final[i] = max(final[i], v)
             self.stages.record("resolve", loop.time() - t0)
             resolved = True
             for c in sampled:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 
+from ..device.pipeline import GroupSizeStats
 from ..ops.backends import (make_conflict_backend, resolve_begin,
                             resolve_group_begin)
 from ..ops.batch import COMMITTED, CONFLICT, TOO_OLD, TxnRequest
@@ -58,6 +59,31 @@ class ResolveBatchRequest:
 class ResolveBatchReply:
     verdicts: list[int]   # per-txn COMMITTED/CONFLICT/TOO_OLD
     state_entries: list | None = None   # [(version, MutationBatch)]
+    # RESOLVER_VERDICT_BITMASK (ISSUE 18): the verdicts as 2*nw packed
+    # u32 words — conflict plane (bit i = verdicts[i] != COMMITTED)
+    # then TOO_OLD plane — so the proxy AND-join skips the per-txn
+    # scatter entirely when a partition reports no aborts and touches
+    # only the set bits otherwise.  Trailing-with-default keeps the
+    # wire codec same-version compatible; PROTOCOL_VERSION 719 fences
+    # older peers (their positional decode would crash on the extra
+    # field).  None when the knob is off or the reply is header-only.
+    abort_words: list[int] | None = None
+
+
+def pack_abort_words(verdicts: list[int]) -> list[int]:
+    """Pack a verdict list into the ResolveBatchReply.abort_words form.
+    Decode is conflict_bit + too_old_bit per txn, which reproduces the
+    {COMMITTED, CONFLICT, TOO_OLD} codes exactly — the host-side twin of
+    ops/conflict_jax.pack_verdicts_step's plane layout."""
+    nw = (len(verdicts) + 31) // 32
+    words = [0] * (2 * nw)
+    for i, v in enumerate(verdicts):
+        if v != COMMITTED:
+            w, b = divmod(i, 32)
+            words[w] |= 1 << b
+            if v == TOO_OLD:
+                words[nw + w] |= 1 << b
+    return words
 
 
 class Resolver:
@@ -104,7 +130,7 @@ class Resolver:
         self._dispatch_task: asyncio.Task | None = None
         self._inflight_groups: list[asyncio.Future] = []
         self._last_submitted_version: Version = epoch_begin_version
-        self.group_sizes: list[int] = []    # batches per fused dispatch
+        self.group_sizes = GroupSizeStats()     # batches per fused dispatch
         # --- device commit pipeline (ISSUE 6) ---
         # The encoded backends' dispatch path moves into
         # device/pipeline.py: persistent on-device ConflictState in
@@ -142,9 +168,12 @@ class Resolver:
             # the device pipeline fuses what remains
             s.gauge("SkippedBatches", lambda: self.total_header_batches)
             s.gauge("RoutedBatches", lambda: self.total_batches)
-            s.gauge("FusedGroupMean", lambda: round(
-                sum(self.group_sizes) / len(self.group_sizes), 2)
-                if self.group_sizes else 0.0)
+            s.gauge("FusedGroupMean",
+                    lambda: round(self.group_sizes.mean(), 2))
+            # the full fusion-depth distribution (ISSUE 18 satellite):
+            # rides the registry's interval log like every latency
+            # histogram, so metrics_tool summary can plot it
+            s.histogram(self.group_sizes.hist)
             s.gauge("WindowOccupancy", self.window_occupancy)
             s.gauge("PendingBatches", lambda: len(self._pending))
             s.gauge("DeviceQueueDepth",
@@ -179,9 +208,7 @@ class Resolver:
             "total_txns": self.total_txns,
             "total_conflicts": self.total_conflicts,
             "total_header_batches": self.total_header_batches,
-            "fused_group_mean": round(
-                sum(self.group_sizes) / len(self.group_sizes), 2)
-            if self.group_sizes else 0.0,
+            "fused_group_mean": round(self.group_sizes.mean(), 2),
             "window_occupancy": self.window_occupancy(),
             **self.spans.counters(),
             **(self._pipeline.metrics() if self._pipeline is not None
@@ -341,7 +368,9 @@ class Resolver:
                                        if v != COMMITTED))
         entries = [(v, m) for v, m in self._state_log
                    if req.state_known_version < v <= req.version]
-        return ResolveBatchReply(verdicts, entries or None)
+        words = pack_abort_words(verdicts) \
+            if self.knobs.RESOLVER_VERDICT_BITMASK else None
+        return ResolveBatchReply(verdicts, entries or None, words)
 
     # --- adaptive group fusion path (r5) ---
 
@@ -396,7 +425,9 @@ class Resolver:
                                        if v != COMMITTED))
         entries = [(v, m) for v, m in self._state_log
                    if req.state_known_version < v <= req.version]
-        return ResolveBatchReply(verdicts, entries or None)
+        words = pack_abort_words(verdicts) \
+            if self.knobs.RESOLVER_VERDICT_BITMASK else None
+        return ResolveBatchReply(verdicts, entries or None, words)
 
     async def _dispatch_loop(self) -> None:
         """Drain _pending into fused group submissions, a bounded number
@@ -436,8 +467,7 @@ class Resolver:
                     self.backend, [r.txns for r, _ in group],
                     [r.version for r, _ in group])
                 self.stages.record("submit", loop.time() - t0)
-                if len(self.group_sizes) < 65536:
-                    self.group_sizes.append(len(group))
+                self.group_sizes.append(len(group))
                 gf = loop.create_task(self._finish_group(group, finish),
                                       name="resolver-group-finish")
                 self._inflight_groups.append(gf)

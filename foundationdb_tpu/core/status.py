@@ -319,6 +319,23 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             m.get("device_read_fallbacks", 0) for m in storage_metrics),
         "mirror_uploads": sum(
             m.get("device_read_uploads", 0) for m in storage_metrics),
+        # staleness gauge (ISSUE 18 satellite): worst-case versions any
+        # server's mirror trails its engine tip — a sustained non-zero
+        # here means refreshes aren't keeping up with the write rate
+        "staleness_versions_max": max(
+            (m.get("device_read_staleness_versions", 0)
+             for m in storage_metrics), default=0),
+        # sharded-mirror shape (ISSUE 18 tentpole (a)): per-chip shard
+        # counts and the partial-refresh vs full-split traffic
+        "shards": sum(
+            m.get("device_read_shards", 0) for m in storage_metrics),
+        "shard_refreshes": sum(
+            m.get("device_read_shard_refreshes", 0)
+            for m in storage_metrics),
+        "full_splits": sum(
+            m.get("device_read_full_splits", 0) for m in storage_metrics),
+        "cross_shard_gathers": sum(
+            m.get("device_read_gathers", 0) for m in storage_metrics),
     }
 
     # shard-heat rollup (ISSUE 7): the top-k hottest shards by decayed

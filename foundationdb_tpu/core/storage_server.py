@@ -160,7 +160,10 @@ class StorageServer:
         self._device_reads = None
         if engine is not None and knobs.STORAGE_DEVICE_READ_SERVE:
             from ..device.read_serve import DeviceReadServer
-            srv = DeviceReadServer(engine, knobs)
+            # version_fn feeds the staleness gauge (ISSUE 18 satellite):
+            # how many versions the mirror trails THIS server's tip
+            srv = DeviceReadServer(engine, knobs,
+                                   version_fn=lambda: self.version)
             if srv.active:
                 self._device_reads = srv
 
@@ -339,6 +342,11 @@ class StorageServer:
                     lambda: self.vmap.index_stats().get("resident_bytes", 0))
             s.gauge("DbufMemBytes", lambda: self._dbuf.mem_bytes)
             s.gauge("DbufSpilledBytes", lambda: self._dbuf.spilled_bytes)
+            # device read mirror lag (ISSUE 18 satellite): versions the
+            # mirror trails this server's tip — 0 when fresh or disarmed
+            s.gauge("DeviceReadStaleness",
+                    lambda: (self._device_reads.staleness_versions()
+                             if self._device_reads is not None else 0))
             # engine-side compaction debt (lsm only; 0 elsewhere).
             # NOT named "LsmCompact*": the determinism children count
             # b"LsmCompact" to prove the background compactor ran, and

@@ -67,6 +67,54 @@ class _Item:
         self.barrier = barrier
 
 
+class GroupSizeStats:
+    """Group-fusion depth as a real role metric (ISSUE 18 satellite):
+    a MetricsRegistry ``Histogram`` replaces the ad-hoc capped list, so
+    the distribution shows up in cluster.lag / ``metrics_tool summary``
+    like every other role series.  The trace Histogram clears itself on
+    every log interval, so the running count/total/max (which the
+    FusedGroupMean gauge and the benches read) live here, outside it.
+    A bounded sample buffer keeps the old list-ish read surface
+    (iteration in benches and tests) alive."""
+
+    _SAMPLE_CAP = 65536
+
+    __slots__ = ("hist", "count", "total", "max", "samples")
+
+    def __init__(self) -> None:
+        from ..runtime.trace import Histogram
+        self.hist = Histogram("ResolverDevice", "GroupSize", unit="batches")
+        self.count = 0
+        self.total = 0
+        self.max = 0
+        self.samples: list[int] = []
+
+    def append(self, n: int) -> None:
+        self.hist.sample(n)
+        self.count += 1
+        self.total += n
+        if n > self.max:
+            self.max = n
+        if len(self.samples) < self._SAMPLE_CAP:
+            self.samples.append(n)
+
+    def clear(self) -> None:
+        self.hist.clear()
+        self.count = 0
+        self.total = 0
+        self.max = 0
+        self.samples.clear()
+
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def __len__(self) -> int:
+        return self.count
+
+    def __iter__(self):
+        return iter(self.samples)
+
+
 def supports_pipeline(backend) -> bool:
     """True when ``backend`` can ride the pipeline (encoded backends with
     a group-submit path).  The cpp interval map resolves host-side per
@@ -103,7 +151,7 @@ class DevicePipeline:
         self.readbacks = 0         # dispatches whose verdicts synced back
         self.queue_peak = 0
         self.inflight_peak = 0
-        self.group_sizes: list[int] = []
+        self.group_sizes = GroupSizeStats()
         self._dispatch_s = 0.0     # host time in encode+transfer+dispatch
         self._overlap_s = 0.0      # ...of which with >= 1 dispatch in flight
 
@@ -192,8 +240,7 @@ class DevicePipeline:
                     self._overlap_s += dt
                 self.dispatches += 1
                 self.batches_dispatched += len(group)
-                if len(self.group_sizes) < 65536:
-                    self.group_sizes.append(len(group))
+                self.group_sizes.append(len(group))
                 self.spans.event("CommitDebug", group[0].ctx,
                                  "ResolverDevice.dispatch",
                                  Version=group[-1].version,
@@ -325,6 +372,9 @@ class DevicePipeline:
         self.group_sizes.clear()
         self._dispatch_s = 0.0
         self._overlap_s = 0.0
+        if hasattr(self.backend, "readback_bytes"):
+            self.backend.readback_bytes = 0
+            self.backend.readback_txns = 0
 
     def metrics(self) -> dict:
         """Counters for the resolver's metrics() → cluster.resolver_device
@@ -349,6 +399,13 @@ class DevicePipeline:
             "device_inflight_peak": self.inflight_peak,
             "device_group_mean": round(
                 self.batches_dispatched / max(1, self.dispatches), 2),
+            "device_group_max": self.group_sizes.max,
+            # verdict readback volume (ISSUE 18): what the host actually
+            # synced — the bitmask reduction's bytes/txn win reads here
+            "device_readback_bytes": getattr(self.backend,
+                                             "readback_bytes", 0),
+            "device_readback_txns": getattr(self.backend,
+                                            "readback_txns", 0),
             "device_dispatch_us_per_batch": round(
                 self._dispatch_s / n * 1e6, 1),
             "device_dispatch_p99_ms": disp.get("p99_ms", 0.0),

@@ -124,14 +124,185 @@ class DeviceKeyDirectory:
         return np.asarray(los)[:n], np.asarray(his)[:n]
 
 
+_U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+class ShardedDeviceDirectory:
+    """Per-chip sharded mirror (ISSUE 18, ROADMAP 1 (d)): the base run's
+    u64 prefixes split across ``jax.devices()`` by key range — one shard
+    per chip when the chips exist, round-robin shard replicas on one
+    chip otherwise (the CPU tier-1 shape, forced multi-device via
+    ``--xla_force_host_platform_device_count``).
+
+    Duck-types ``DeviceKeyDirectory`` (fresh/refresh/lookup + upload
+    counters) so ``DeviceReadServer`` treats either as the mirror.  Two
+    things the monolithic mirror cannot do:
+
+    - **Partial refresh.**  Shard boundaries are PREFIX values, so after
+      a base mutation the per-shard slices are recomputed by one
+      searchsorted over the new prefix array, and only the shards whose
+      key range intersects the index's ``changed_since`` spans
+      re-upload — a localized merge re-ships 1/S of the mirror instead
+      of all of it.  An unaccounted gen gap (change log trimmed) falls
+      back to a full re-split.
+    - **Cross-shard batched gathers.**  A batch's probes route host-side
+      by the boundary table (one searchsorted), every touched shard's
+      searchsorted pair dispatches back-to-back (jax dispatch is async,
+      so the per-shard kernels overlap), and the host joins the global
+      (lo, hi) bands by adding each shard's base offset.
+
+    Boundary invariant: every shard starts at the FIRST element of an
+    equal-prefix run (searchsorted-left of the boundary prefix), so a
+    probe routed to shard s resolves the same global band the monolithic
+    searchsorted would — elements before the shard are strictly below
+    its bound, elements after are at or above the next bound.
+    """
+
+    def __init__(self, index, n_shards: int, devices=None) -> None:
+        self._index = index
+        self.n_shards = max(2, int(n_shards))
+        if devices is None:
+            try:
+                import jax
+                devices = list(jax.devices())
+            except Exception:   # noqa: BLE001 — default placement
+                devices = [None]
+        self._devices = devices or [None]
+        self._gen = -1
+        self._bounds: np.ndarray | None = None   # [S] lower prefix bound
+        self._offsets: np.ndarray | None = None  # [S+1] base-run offsets
+        self._shard_dev: list = [None] * self.n_shards
+        self._jfn = None
+        self.uploads = 0            # refresh() calls (twin-compatible)
+        self.uploaded_keys = 0      # prefixes actually re-shipped
+        self.shard_refreshes = 0    # per-shard uploads (S per full split)
+        self.full_splits = 0        # refreshes that re-split everything
+        self.gathers = 0            # per-shard device dispatches
+
+    @property
+    def fresh(self) -> bool:
+        return self._bounds is not None and self._gen == self._index.gen
+
+    def _put(self, arr: np.ndarray, s: int):
+        import jax
+        dev = self._devices[s % len(self._devices)]
+        return jax.device_put(arr, dev) if dev is not None \
+            else jax.device_put(arr)
+
+    def _split_all(self, pfx: np.ndarray) -> None:
+        """Full re-split: equal-share cuts snapped left to equal-prefix
+        run starts, every shard re-uploaded to its device."""
+        n = int(pfx.shape[0])
+        S = self.n_shards
+        cuts = [min(n, round(n * s / S)) for s in range(S)]
+        offs = [0] * (S + 1)
+        offs[S] = n
+        bounds = np.zeros(S, dtype=np.uint64)
+        for s in range(1, S):
+            c = cuts[s]
+            b = pfx[c] if c < n else _U64_MAX
+            offs[s] = int(np.searchsorted(pfx, b, side="left"))
+            bounds[s] = b
+        self._offsets = np.asarray(offs, dtype=np.int64)
+        self._bounds = bounds
+        for s in range(S):
+            seg = pfx[offs[s]:offs[s + 1]]
+            self._shard_dev[s] = self._put(seg, s)
+            self.shard_refreshes += 1
+            self.uploaded_keys += int(seg.shape[0])
+        self.full_splits += 1
+
+    def refresh(self) -> None:
+        """Rebuild freshness after a base mutation.  Partial when the
+        index's change log accounts for every gen bump since the last
+        upload: offsets recompute against the fixed prefix boundaries
+        and only intersecting shards re-ship."""
+        pfx = self._index.base_prefixes()
+        spans = self._index.changed_since(self._gen) \
+            if self._bounds is not None else None
+        self.uploads += 1
+        self._gen = self._index.gen
+        if spans is None:
+            self._split_all(pfx)
+            return
+        n = int(pfx.shape[0])
+        S = self.n_shards
+        offs = np.empty(S + 1, dtype=np.int64)
+        offs[:S] = np.searchsorted(pfx, self._bounds, side="left")
+        offs[0] = 0
+        offs[S] = n
+        self._offsets = offs
+        if not spans:
+            return
+        from ..ops.keycode import encode_prefix_u64
+        span_p = encode_prefix_u64([k for lo_hi in spans for k in lo_hi])
+        for s in range(S):
+            lo_b = self._bounds[s]
+            hi_b = self._bounds[s + 1] if s + 1 < S else _U64_MAX
+            touched = any(
+                not (span_p[2 * i + 1] < lo_b
+                     or (s + 1 < S and span_p[2 * i] >= hi_b))
+                for i in range(len(spans)))
+            if not touched:
+                continue
+            seg = pfx[int(offs[s]):int(offs[s + 1])]
+            self._shard_dev[s] = self._put(seg, s)
+            self.shard_refreshes += 1
+            self.uploaded_keys += int(seg.shape[0])
+
+    def lookup(self, keys: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+        """Global (lo, hi) candidate bands for the whole batch: probes
+        route to shards host-side, every touched shard dispatches its
+        jitted searchsorted pair (async — the transfers and kernels
+        overlap across chips), and the host joins with shard offsets."""
+        import jax
+        from ..ops.keycode import encode_prefix_u64
+        if self._jfn is None:
+            import jax.numpy as jnp
+            self._jfn = jax.jit(lambda pfx, probes: (
+                jnp.searchsorted(pfx, probes, side="left"),
+                jnp.searchsorted(pfx, probes, side="right")))
+        probes = encode_prefix_u64(keys)
+        n = len(probes)
+        sid = np.clip(
+            np.searchsorted(self._bounds, probes, side="right") - 1,
+            0, self.n_shards - 1)
+        los = np.zeros(n, dtype=np.int64)
+        his = np.zeros(n, dtype=np.int64)
+        launched = []
+        for s in np.unique(sid):
+            mask = sid == s
+            sub = probes[mask]
+            m = len(sub)
+            bucket = 1 << max(0, (m - 1).bit_length())
+            if bucket > m:
+                sub = np.concatenate(
+                    [sub, np.full(bucket - m, _U64_MAX, dtype=np.uint64)])
+            lo_d, hi_d = self._jfn(self._shard_dev[int(s)], sub)
+            self.gathers += 1
+            launched.append((int(s), mask, m, lo_d, hi_d))
+        for s, mask, m, lo_d, hi_d in launched:
+            off = int(self._offsets[s])
+            los[mask] = np.asarray(lo_d)[:m] + off
+            his[mask] = np.asarray(hi_d)[:m] + off
+        return los, his
+
+
 class DeviceReadServer:
     """Per-storage-server device read path over the engine's key index.
 
     ``get_batch(keys)`` returns the same list ``engine.get_batch`` would,
     or None to tell the caller to take the engine path (below threshold,
-    stale mirror, engine without a packed index, no usable jax)."""
+    stale mirror, engine without a packed index, no usable jax).
 
-    def __init__(self, engine, knobs: Knobs, device=None) -> None:
+    ``version_fn`` (the hosting server's applied-version tip) turns the
+    boolean stale/fresh flip into a staleness GAUGE: metrics report how
+    many versions the mirror's last refresh trails the engine tip, so a
+    mirror quietly serving off an old upload shows up as a rising
+    number, not a flag nobody polls (ISSUE 18 satellite)."""
+
+    def __init__(self, engine, knobs: Knobs, device=None,
+                 version_fn=None) -> None:
         self.engine = engine
         self.knobs = knobs
         self.min_batch = max(1, knobs.STORAGE_DEVICE_READ_MIN_BATCH)
@@ -141,10 +312,20 @@ class DeviceReadServer:
         # directory + engine.get_batch_located) — see module docstring
         self._mode = getattr(index, "device_mode", "membership")
         self._dir = None
+        self._sharded = False
         if index is not None and knobs.STORAGE_DEVICE_READ_SERVE \
                 and _jax_ready():
-            self._dir = DeviceKeyDirectory(index, device)
+            shards = int(getattr(knobs, "STORAGE_DEVICE_READ_SHARDS", 0))
+            if shards >= 2:
+                self._dir = ShardedDeviceDirectory(
+                    index, shards,
+                    devices=[device] if device is not None else None)
+                self._sharded = True
+            else:
+                self._dir = DeviceKeyDirectory(index, device)
         # --- observability (storage metrics → status rollup) ---
+        self.version_fn = version_fn
+        self.last_refresh_version = 0
         self.served_batches = 0
         self.served_keys = 0
         self.fallbacks = 0      # batches routed to the engine path
@@ -153,6 +334,11 @@ class DeviceReadServer:
     def active(self) -> bool:
         return self._dir is not None
 
+    def _refresh(self) -> None:
+        self._dir.refresh()
+        if self.version_fn is not None:
+            self.last_refresh_version = self.version_fn()
+
     def get_batch(self, keys: list[bytes]):
         if self._dir is None or len(keys) < self.min_batch:
             if self._dir is not None:
@@ -160,12 +346,20 @@ class DeviceReadServer:
             return None
         index = self._dir._index
         if not self._dir.fresh:
-            # stale mirror: serve THIS batch off the engine, refresh so
-            # the next one rides the device (refresh on merge, not per
-            # batch — steady-state reads never pay an upload)
-            self.fallbacks += 1
-            self._dir.refresh()
-            return None
+            if self._sharded:
+                # sharded mirror: a stale shard refreshes PARTIALLY
+                # (only the shards the mutation's key span touched
+                # re-ship) and THIS batch still serves off the device —
+                # device_put returns before the transfer completes, so
+                # the serving path pays the re-slice, not the copy
+                self._refresh()
+            else:
+                # stale mirror: serve THIS batch off the engine, refresh
+                # so the next one rides the device (refresh on merge,
+                # not per batch — steady-state reads never pay an upload)
+                self.fallbacks += 1
+                self._refresh()
+                return None
         base = index.base_run()
         if not len(base):
             # nothing mirrored yet (empty index / no sorted runs):
@@ -198,9 +392,18 @@ class DeviceReadServer:
         self.served_keys += len(keys)
         return out
 
+    def staleness_versions(self) -> int:
+        """Versions the mirror's last refresh trails the engine tip —
+        0 while fresh (a fresh mirror plus host-probed pending overlay
+        serves current data regardless of when it last uploaded)."""
+        if self._dir is None or self.version_fn is None \
+                or self._dir.fresh:
+            return 0
+        return max(0, int(self.version_fn()) - self.last_refresh_version)
+
     def metrics(self) -> dict:
         d = self._dir
-        return {
+        out = {
             "device_read_active": int(self.active),
             "device_read_batches": self.served_batches,
             "device_read_keys": self.served_keys,
@@ -208,4 +411,11 @@ class DeviceReadServer:
             "device_read_uploads": d.uploads if d is not None else 0,
             "device_read_uploaded_keys":
                 d.uploaded_keys if d is not None else 0,
+            "device_read_staleness_versions": self.staleness_versions(),
         }
+        if self._sharded:
+            out["device_read_shards"] = d.n_shards
+            out["device_read_shard_refreshes"] = d.shard_refreshes
+            out["device_read_full_splits"] = d.full_splits
+            out["device_read_gathers"] = d.gathers
+        return out

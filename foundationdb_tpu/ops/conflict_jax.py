@@ -263,9 +263,52 @@ def _chain_pallas(packed, hist_conflict, ok, B: int, nw: int):
     # lowering recurses on the index converts x64 mode inserts, and the
     # axon PJRT x64-rewrite rejects s64 at custom-call boundaries — the
     # kernel is pure int32 either way
-    with jax.enable_x64(False):
+    from jax.experimental import disable_x64
+    with disable_x64():
         conf = _chain_kernel_call(B, nw)(packed, flags)
     return conf.astype(bool)
+
+
+# --------------------------------------------------------------------------
+# the in-place ring append as a Pallas kernel (RESOLVER_RING_INPLACE probe)
+
+
+@functools.cache
+def _ring_append_call(L: int, C: int, S: int, interpret: bool):
+    """Shift-left-by-S + tail-write of one [L, S] slab into an [L, C]
+    lane buffer, with the OPERAND buffer aliased to the output
+    (``input_output_aliases``): XLA may rewrite the ring where it lives
+    instead of materializing the concatenated copy the jnp.concatenate
+    twin allocates every dispatch.  The slab is loaded into values
+    before either store, so the overlapping shift is torn-read safe even
+    when the alias is honored.  ``interpret`` runs the same kernel under
+    the Pallas interpreter — the CPU fallback that lets tier-1 and the
+    determinism children pin the knob both ways off-TPU."""
+    from jax.experimental import pallas as pl
+
+    def kernel(buf_ref, slab_ref, out_ref):
+        kept = buf_ref[:, S:]       # load BEFORE the aliased stores
+        slab = slab_ref[:, :]
+        out_ref[:, :C - S] = kept
+        out_ref[:, C - S:] = slab
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((L, C), jnp.uint32),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )
+
+
+def _ring_append(buf, slab, S: int, pallas: bool):
+    """In-place-aliased ring append of a static-size slab.  u32 lane
+    planes only (hb/he); slot versions are i64 and stay on the XLA
+    concat path — Mosaic's x64 rewrite rejects s64 at the custom-call
+    boundary, and two u32 planes are where the HBM traffic is anyway."""
+    L, C = buf.shape
+    from jax.experimental import disable_x64
+    with disable_x64():
+        return _ring_append_call(L, C, S, not pallas)(buf, slab)
 
 
 # --------------------------------------------------------------------------
@@ -343,7 +386,7 @@ def _slab_from_writes(write_begin, write_end, committed, S_: int, L: int):
 def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
                  write_end, snap, commit_version, *, width: int = DEFAULT_WIDTH,
                  window: int = 0, pallas: bool = False,
-                 points: bool = False):
+                 points: bool = False, ring_inplace: bool = False):
     """One resolve step: (state, batch) -> (state', verdicts[B] int8).
 
     Pure traceable core shared by the single-chip jit (``resolve_step``)
@@ -411,8 +454,16 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
                                        S_, L)
     slab_v = jnp.broadcast_to(jnp.asarray(commit_version, state.hver.dtype),
                               (S_,))
-    shifted_b = jnp.concatenate([state.hb[:, S_:], slab_b], axis=1)
-    shifted_e = jnp.concatenate([state.he[:, S_:], slab_e], axis=1)
+    if ring_inplace:
+        # RESOLVER_RING_INPLACE probe: append via the aliased Pallas
+        # kernel instead of rebuilding the lane planes by concatenation.
+        # Bit-identical output; the is_pad select below still consumes
+        # the pre-append ring, so XLA copies when a pad batch needs both.
+        shifted_b = _ring_append(state.hb, slab_b, S_, pallas)
+        shifted_e = _ring_append(state.he, slab_e, S_, pallas)
+    else:
+        shifted_b = jnp.concatenate([state.hb[:, S_:], slab_b], axis=1)
+        shifted_e = jnp.concatenate([state.he[:, S_:], slab_e], axis=1)
     shifted_v = jnp.concatenate([state.hver[S_:], slab_v])
     floor_s = jnp.maximum(state.floor, jnp.max(state.hver[:S_]))
     hb2 = jnp.where(is_pad, state.hb, shifted_b)
@@ -426,7 +477,8 @@ def resolve_core(state: ConflictState, read_begin, read_end, write_begin,
 def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
                       write_end, snap, commit_versions, *,
                       width: int = DEFAULT_WIDTH, window: int = 0,
-                      pallas: bool = False, points: bool = False):
+                      pallas: bool = False, points: bool = False,
+                      ring_inplace: bool = False):
     """K fused batches in one dispatch: inputs [K,B,R,L] / [K,B] / [K].
 
     Hot/cold structure (r5): the big ring ("cold") stays STATIC for the
@@ -464,7 +516,8 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
             rb, re, wb, we, sn, cv = x
             st2, verdicts = resolve_core(st, rb, re, wb, we, sn, cv,
                                          width=width, window=window,
-                                         pallas=pallas, points=points)
+                                         pallas=pallas, points=points,
+                                         ring_inplace=ring_inplace)
             return st2, verdicts
 
         return lax.scan(body, state, (read_begin, read_end, write_begin,
@@ -553,11 +606,31 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
     # the kept window is exactly cold[n_real*S_:] ++ real slabs.)
     n_real = jnp.sum(commit_versions >= 0).astype(jnp.int32)
     shift = n_real * jnp.int32(S_)
-    extb = jnp.concatenate([state.hb, hotbF[:, 1 + W:]], axis=1)
-    exte = jnp.concatenate([state.he, hoteF[:, 1 + W:]], axis=1)
+    hot_sb = hotbF[:, 1 + W:]
+    hot_se = hoteF[:, 1 + W:]
+    if ring_inplace:
+        # The aliased kernel needs a STATIC slab size; a full group
+        # (n_real == K, the steady-state shape under load) appends all T
+        # slots through it, while a partially-padded group falls back to
+        # the dynamic-slice twin (Pallas cannot load a traced-size
+        # slice).  Both branches produce identical rings.
+        def kern(_):
+            return (_ring_append(state.hb, hot_sb, T, pallas),
+                    _ring_append(state.he, hot_se, T, pallas))
+
+        def dyn(_):
+            eb = jnp.concatenate([state.hb, hot_sb], axis=1)
+            ee = jnp.concatenate([state.he, hot_se], axis=1)
+            return (lax.dynamic_slice(eb, (jnp.int32(0), shift), (L, C)),
+                    lax.dynamic_slice(ee, (jnp.int32(0), shift), (L, C)))
+
+        hb2, he2 = lax.cond(n_real == jnp.int32(K), kern, dyn, None)
+    else:
+        extb = jnp.concatenate([state.hb, hot_sb], axis=1)
+        exte = jnp.concatenate([state.he, hot_se], axis=1)
+        hb2 = lax.dynamic_slice(extb, (jnp.int32(0), shift), (L, C))
+        he2 = lax.dynamic_slice(exte, (jnp.int32(0), shift), (L, C))
     extv = jnp.concatenate([state.hver, hotvF[1 + W:]])
-    hb2 = lax.dynamic_slice(extb, (jnp.int32(0), shift), (L, C))
-    he2 = lax.dynamic_slice(exte, (jnp.int32(0), shift), (L, C))
     hv2 = lax.dynamic_slice(extv, (shift,), (C,))
     # evicted = the n_real*S_ oldest cold slots
     evict_mask = jnp.arange(T) < shift
@@ -567,20 +640,23 @@ def resolve_many_core(state: ConflictState, read_begin, read_end, write_begin,
 
 
 resolve_step = functools.partial(
-    jax.jit, static_argnames=("width", "window", "pallas", "points"),
+    jax.jit, static_argnames=("width", "window", "pallas", "points",
+                              "ring_inplace"),
     donate_argnums=(0,))(resolve_core)
 resolve_many = functools.partial(
-    jax.jit, static_argnames=("width", "window", "pallas", "points"),
+    jax.jit, static_argnames=("width", "window", "pallas", "points",
+                              "ring_inplace"),
     donate_argnums=(0,))(resolve_many_core)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("shape", "width", "window", "pallas",
-                                    "points"),
+                                    "points", "ring_inplace"),
                    donate_argnums=(0,))
 def resolve_many_packed(state: ConflictState, pu32, pi64, *, shape,
                         width: int = DEFAULT_WIDTH, window: int = 0,
-                        pallas: bool = False, points: bool = False):
+                        pallas: bool = False, points: bool = False,
+                        ring_inplace: bool = False):
     """resolve_many on single-buffer inputs.
 
     The axon tunnel moves one big transfer at ~150MB/s but many small ones
@@ -601,17 +677,18 @@ def resolve_many_packed(state: ConflictState, pu32, pi64, *, shape,
     cvs = pi64[K * B:]
     return resolve_many_core(state, rb, re, wb, we, sn, cvs,
                              width=width, window=window, pallas=pallas,
-                             points=points)
+                             points=points, ring_inplace=ring_inplace)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("shape", "width", "window", "compact",
-                                    "pallas", "points"),
+                                    "pallas", "points", "ring_inplace"),
                    donate_argnums=(0, 1))
 def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
                      pi64, *, shape, width: int = DEFAULT_WIDTH,
                      window: int = 0, compact: bool = False,
-                     pallas: bool = False, points: bool = False):
+                     pallas: bool = False, points: bool = False,
+                     ring_inplace: bool = False):
     """resolve_many on dictionary-compressed inputs.
 
     The device keeps every recently-seen range endpoint's lane row in a
@@ -652,18 +729,20 @@ def resolve_many_ids(state: ConflictState, dct, ids, upd_slots, upd_lanes,
     cvs = pi64[K * B:]
     st, verdicts = resolve_many_core(state, rb, re, wb, we, sn, cvs,
                                      width=width, window=window,
-                                     pallas=pallas, points=points)
+                                     pallas=pallas, points=points,
+                                     ring_inplace=ring_inplace)
     return st, dct2, verdicts
 
 
 @functools.partial(jax.jit,
                    static_argnames=("shape", "width", "window", "compact",
-                                    "U", "pallas", "points"),
+                                    "U", "pallas", "points", "ring_inplace"),
                    donate_argnums=(0, 1))
 def resolve_many_fused(state: ConflictState, dct, fused, *, shape,
                        width: int = DEFAULT_WIDTH, window: int = 0,
                        compact: bool = False, U: int = 0,
-                       pallas: bool = False, points: bool = False):
+                       pallas: bool = False, points: bool = False,
+                       ring_inplace: bool = False):
     """resolve_many_ids on ONE fused input buffer.
 
     The axon tunnel charges ~0.5ms fixed per device_put call on top of
@@ -711,7 +790,8 @@ def resolve_many_fused(state: ConflictState, dct, fused, *, shape,
     cvs = pi64[K * B:]
     st, verdicts = resolve_many_core(state, rb, re, wb, we, sn, cvs,
                                      width=width, window=window,
-                                     pallas=pallas, points=points)
+                                     pallas=pallas, points=points,
+                                     ring_inplace=ring_inplace)
     return st, dct2, verdicts
 
 
@@ -759,6 +839,95 @@ def set_oldest_step(state: ConflictState, v) -> ConflictState:
     return state._replace(floor=jnp.maximum(state.floor, v))
 
 
+# --------------------------------------------------------------------------
+# on-device verdict reduction (RESOLVER_VERDICT_BITMASK)
+
+
+def _pack_bits32(m):
+    """[K, nw*32] bool -> [K, nw] u32; bit b of word w = m[:, w*32+b].
+    The explicit dtype pins the words at u32 — x64 mode would otherwise
+    promote the sum to u64 and double the transfer this pack exists to
+    shrink."""
+    K, Bp = m.shape
+    nw = Bp // 32
+    return jnp.sum(
+        m.reshape(K, nw, 32).astype(jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32)[None, None, :], axis=-1,
+        dtype=jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("K", "B"))
+def pack_verdicts_step(verdicts, *, K: int, B: int):
+    """Reduce a [K, B] int8 verdict array on device to the two-transfer
+    bitmask form: ``summary`` [ceil(K/32)] u32 with bit k set iff batch
+    k holds ANY non-COMMITTED verdict, and ``planes`` [2*K*nw] u32 —
+    the per-batch abort bitmask (bit = verdict != COMMITTED) followed
+    by the TOO_OLD plane (bit = verdict == TOO_OLD).  The host syncs
+    the summary always and the planes only when some bit is set, so a
+    conflict-free group reads back a handful of bytes instead of K*B
+    verdict lanes; decode is conflict_bit + too_old_bit, which
+    reproduces the {COMMITTED, CONFLICT, TOO_OLD} codes exactly."""
+    nw = (B + 31) // 32
+    nonc = verdicts != COMMITTED
+    told = verdicts == TOO_OLD
+    pad = nw * 32 - B
+    planes = jnp.concatenate(
+        [_pack_bits32(jnp.pad(nonc, ((0, 0), (0, pad)))).reshape(-1),
+         _pack_bits32(jnp.pad(told, ((0, 0), (0, pad)))).reshape(-1)])
+    ns = (K + 31) // 32
+    anyk = jnp.pad(nonc.any(axis=1), (0, ns * 32 - K))
+    summary = _pack_bits32(anyk[None, :]).reshape(-1)
+    return summary, planes
+
+
+class PackedVerdicts:
+    """Handle on a device-reduced verdict transfer (pack_verdicts_step).
+
+    Ducks as the verdict array wherever the raw [K, B] form flowed:
+    ``np.asarray`` (sim inline sync AND the _DeviceSyncWorker thread
+    both call exactly that) triggers __array__, which syncs the summary
+    word(s), early-outs to an all-COMMITTED array when no bit is set,
+    and only then pulls + unpacks the bit planes.  ``synced_bytes``
+    records what the sync actually moved — the readback accounting the
+    devplane perf gate reads."""
+
+    __slots__ = ("summary", "planes", "K", "B", "synced_bytes")
+
+    def __init__(self, summary, planes, K: int, B: int):
+        self.summary = summary
+        self.planes = planes
+        self.K = K
+        self.B = B
+        self.synced_bytes = 0
+
+    @staticmethod
+    def unpack(summary: np.ndarray, planes: np.ndarray,
+               K: int, B: int) -> np.ndarray:
+        nw = (B + 31) // 32
+        shifts = np.arange(32, dtype=np.uint32)
+
+        def bits(words):
+            m = ((words[:, :, None] >> shifts) & np.uint32(1))
+            return m.reshape(K, nw * 32)[:, :B].astype(np.int8)
+
+        conf = bits(planes[:K * nw].reshape(K, nw))
+        told = bits(planes[K * nw:].reshape(K, nw))
+        return conf + told
+
+    def to_numpy(self) -> np.ndarray:
+        s = np.asarray(self.summary)
+        self.synced_bytes = s.nbytes
+        if not s.any():
+            return np.zeros((self.K, self.B), np.int8)
+        p = np.asarray(self.planes)
+        self.synced_bytes += p.nbytes
+        return self.unpack(s, p, self.K, self.B)
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.to_numpy()
+        return a if dtype is None else a.astype(dtype)
+
+
 # group sizes compiled for resolve_many; a group of k batches is padded up
 # to the next bucket with padding batches (commit_version=-1, sentinel
 # slabs).  256 exists for the r5 hot/cold kernel, whose scan carry no
@@ -785,7 +954,8 @@ class JaxConflictSet:
 
     def __init__(self, capacity: int, width: int = DEFAULT_WIDTH,
                  oldest_version: int = 0, device=None, window: int = 4096,
-                 dict_slots: int = 0):
+                 dict_slots: int = 0, ring_inplace: bool = False,
+                 pack_verdicts: bool = False):
         if not jax.config.jax_enable_x64:
             raise RuntimeError(
                 "JaxConflictSet requires 64-bit versions: set JAX_ENABLE_X64=1 "
@@ -795,6 +965,12 @@ class JaxConflictSet:
         self.device = device
         self.window = window
         self.dict_slots = dict_slots
+        # ISSUE 18 device-plane knobs: the aliased Pallas ring append
+        # (RESOLVER_RING_INPLACE) and the on-device verdict bitmask
+        # reduction (RESOLVER_VERDICT_BITMASK) — both A/B twins of the
+        # verbatim paths, bit-identical by construction
+        self.ring_inplace = ring_inplace
+        self.pack = pack_verdicts
         # pallas chain decided by THIS set's device platform, not the
         # process default (a CPU-placed twin must not trace Mosaic)
         self._pallas = _pallas_for_platform(
@@ -867,6 +1043,20 @@ class JaxConflictSet:
             except Exception:       # noqa: BLE001 — best-effort prefetch
                 pass
 
+    def _finish_submit(self, verdicts, K: int, B: int):
+        """Group-dispatch epilogue: under RESOLVER_VERDICT_BITMASK the
+        [K, B] verdict array is reduced on device to the summary+planes
+        bitmask pair and only those small u32 transfers read back; the
+        d2h copies start eagerly either way (see _start_d2h)."""
+        if self.pack:
+            summary, planes = pack_verdicts_step(verdicts, K=K, B=B)
+            pv = PackedVerdicts(summary, planes, K, B)
+            self._start_d2h(summary)
+            self._start_d2h(planes)
+            return pv
+        self._start_d2h(verdicts)
+        return verdicts
+
     def resolve_encoded_submit(self, eb: EncodedBatch, commit_version: int) -> jax.Array:
         """Dispatch one resolve and return the (not yet synced) verdict
         array.  JAX dispatch is asynchronous, so this returns quickly;
@@ -888,7 +1078,7 @@ class JaxConflictSet:
             put(eb.write_begin), put(eb.write_end),
             put(eb.read_snapshot), jnp.int64(commit_version),
             width=self.width, window=self.window, pallas=self._pallas,
-            points=use_points)
+            points=use_points, ring_inplace=self.ring_inplace)
         self._start_d2h(verdicts)
         return verdicts
 
@@ -929,9 +1119,8 @@ class JaxConflictSet:
         self.state, verdicts = resolve_many_packed(
             self.state, put(pu32), put(pi64), shape=(K, B, R, L),
             width=self.width, window=self.window, pallas=self._pallas,
-            points=use_points)
-        self._start_d2h(verdicts)
-        return verdicts
+            points=use_points, ring_inplace=self.ring_inplace)
+        return self._finish_submit(verdicts, K, B)
 
     def resolve_group_submit_dict(self, ibs: list, commit_versions: list[int],
                                   upd_slots: np.ndarray,
@@ -999,9 +1188,8 @@ class JaxConflictSet:
             put(np.array(upd_lanes[:, :U], copy=True)),
             put(pi64), shape=(K, B, R, L), width=self.width,
             window=self.window, compact=compact, pallas=self._pallas,
-            points=use_points)
-        self._start_d2h(verdicts)
-        return verdicts
+            points=use_points, ring_inplace=self.ring_inplace)
+        return self._finish_submit(verdicts, K, B)
 
     def resolve_group_submit_fused(self, fused: np.ndarray, shape: tuple,
                                    compact: bool, U: int) -> jax.Array:
@@ -1018,9 +1206,9 @@ class JaxConflictSet:
         self.state, self._dct, verdicts = resolve_many_fused(
             self.state, self._dct, dev, shape=(K, B, R, L),
             width=self.width, window=self.window, compact=compact, U=U,
-            pallas=self._pallas, points=use_points)
-        self._start_d2h(verdicts)
-        return verdicts
+            pallas=self._pallas, points=use_points,
+            ring_inplace=self.ring_inplace)
+        return self._finish_submit(verdicts, K, B)
 
     def apply_dict_updates(self, upd_slots: np.ndarray,
                            upd_lanes: np.ndarray, n_upd: int) -> None:

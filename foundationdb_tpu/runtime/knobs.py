@@ -62,6 +62,27 @@ class Knobs:
     # window/frontier.  Off = the broadcast twin, kept verbatim for A/B
     # (same wire shapes either way, so no protocol gate is needed).
     RESOLVER_MESH_ROUTING: bool = True
+    # on-device verdict reduction (ISSUE 18): the encoded backends pack
+    # each fused group's verdicts INTO BITMASKS on device — a per-group
+    # any-conflict summary word vector synced first, and per-batch
+    # conflict/too-old bit planes synced only when the summary says some
+    # batch aborted — so a clean group's readback is ceil(K/32) u32
+    # words instead of K x B x i32 verdict vectors.  The resolver also
+    # piggybacks the packed abort words on ResolveBatchReply so the
+    # proxy's AND-join scatters set bits instead of iterating every
+    # verdict.  Off = the raw-vector twin, kept verbatim for A/B
+    # (bit-identical verdicts either way, asserted in situ by
+    # perf_smoke --stage devplane).
+    RESOLVER_VERDICT_BITMASK: bool = True
+    # Pallas in-place ring write probe (ISSUE 18, ROADMAP 1 (b)): the
+    # conflict ring's append writes the shifted window + new slab into
+    # the donated output buffer via a pallas_call with input/output
+    # aliasing instead of the concat+where / concat+dynamic_slice XLA
+    # rebuild.  Interpret-mode on CPU (tier-1 + determinism children
+    # pin it both ways); bit-identical ring contents by construction.
+    # Default OFF: a probe for the real-TPU gate re-measure (1 (a)) —
+    # flip it when a TPU profile shows the append on the critical path.
+    RESOLVER_RING_INPLACE: bool = False
 
     # --- commit pipeline ---
     COMMIT_BATCH_INTERVAL: float = 0.002      # proxy batching window seconds (REF: COMMIT_TRANSACTION_BATCH_INTERVAL_MIN)
@@ -117,7 +138,13 @@ class Knobs:
     # key range at a pinned read version, pages as packed end-key
     # columns + u32 row counts + 8-byte blake2b digests; a 717 peer
     # cannot decode the struct ids, so the gate fences it
-    PROTOCOL_VERSION: int = 718
+    # 719: resolver verdict bitmasks (ISSUE 18) — ResolveBatchReply
+    # grew a trailing abort_words field (packed per-batch conflict +
+    # too-old bit planes the proxy AND-join consumes directly).  The
+    # codec writes a per-struct field count, but a 718 peer constructs
+    # the reply dataclass positionally and would crash (or silently
+    # drop the words), so the gate fences it
+    PROTOCOL_VERSION: int = 719
     # --- change feeds ---
     # (sealed feed segments at or below the durable floor ALWAYS spill
     # to the DiskQueue side file on durable servers — a durability
@@ -215,6 +242,18 @@ class Knobs:
     # threshold falls back to the engine path (identical results, tested)
     STORAGE_DEVICE_READ_SERVE: bool = True
     STORAGE_DEVICE_READ_MIN_BATCH: int = 64
+    # per-chip sharded mirror (ISSUE 18, ROADMAP 1 (d)): split the
+    # packed key index across this many device shards by key range —
+    # one shard per chip when jax.devices() has that many, round-robin
+    # replicas on one chip otherwise (the CPU tier-1 shape).  A base
+    # mutation then re-uploads ONLY the shards whose key span it
+    # touched (the index's change log names the span), so the mirror
+    # partially refreshes inline and keeps serving where the
+    # single-directory twin falls back to the engine for a full
+    # re-upload.  0/1 = the single DeviceKeyDirectory, kept verbatim
+    # as the A/B twin (byte-identical results either way, asserted in
+    # situ by perf_smoke --stage devplane).
+    STORAGE_DEVICE_READ_SHARDS: int = 0
 
     # --- client read path ---
     # same-tick point-read coalescing: concurrent Transaction.get calls

@@ -58,11 +58,13 @@ _ADD_PENDING_CAP = 8192  # single-key adds merge earlier: insort's memmove
 _NP_MIN = 1 << 14       # numpy prefix fast path needs a base this large...
 _NP_BOUNDS_MIN = 16     # ...and this many bounds to amortize call overhead
 _SMALL_DISCARD = 32     # list mode: below this, per-key del beats a filter
+_CHANGE_LOG_CAP = 64    # retained per-gen change spans; older mutations
+#                         degrade sharded mirrors to a full re-split
 
 
 class PackedKeyIndex:
     __slots__ = ("_base", "_pending", "_list_pfx", "merges", "merge_s",
-                 "gen", "columnar")
+                 "gen", "columnar", "changes")
 
     def __init__(self, columnar: bool = True) -> None:
         self.columnar = columnar
@@ -76,6 +78,12 @@ class PackedKeyIndex:
         # uploaded copy with this and refresh on mismatch; the pending
         # overlay is probed host-side, so inserts alone never stale them
         self.gen = 0
+        # per-gen change spans (ISSUE 18): each base mutation records
+        # (gen, lo_key, hi_key) — the key span it touched (None span =
+        # a gen bump that changed no keys).  The sharded device mirror
+        # reads changed_since() to re-upload ONLY the shards whose key
+        # range a merge/discard intersected.
+        self.changes: list[tuple[int, bytes | None, bytes | None]] = []
 
     def __len__(self) -> int:
         return len(self._base) + len(self._pending)
@@ -133,6 +141,8 @@ class PackedKeyIndex:
 
     def _merge(self) -> None:
         t0 = time.perf_counter()
+        pend = self._pending
+        span = (pend[0], pend[-1]) if pend else (None, None)
         if self.columnar:
             # one vectorized bounds insert + O(overlay) blob stitch
             self._base = self._base.merge_sorted(self._pending)
@@ -145,6 +155,7 @@ class PackedKeyIndex:
         self._list_pfx = None
         self.merges += 1
         self.gen += 1
+        self._note_change(*span)
         self.merge_s += time.perf_counter() - t0
 
     # --- removals ---
@@ -166,6 +177,7 @@ class PackedKeyIndex:
             self._base, removed = self._base.delete_keys(list(dead))
             if removed:
                 self.gen += 1
+                self._note_change(min(dead), max(dead))
             return
         base = self._base
         if len(dead) <= _SMALL_DISCARD:
@@ -178,12 +190,14 @@ class PackedKeyIndex:
             if hit:
                 self._list_pfx = None
                 self.gen += 1
+                self._note_change(min(dead), max(dead))
         else:
             nb = len(base)
             self._base = [k for k in base if k not in dead]
             if len(self._base) != nb:
                 self._list_pfx = None
                 self.gen += 1
+                self._note_change(min(dead), max(dead))
 
     # --- bound queries ---
     #
@@ -291,6 +305,25 @@ class PackedKeyIndex:
         """The base run's keycode-u64 prefixes (the cached array the
         numpy bound path uses — one home for the encoding)."""
         return self._prefixes()
+
+    def _note_change(self, lo: bytes | None, hi: bytes | None) -> None:
+        self.changes.append((self.gen, lo, hi))
+        if len(self.changes) > _CHANGE_LOG_CAP:
+            del self.changes[:len(self.changes) // 2]
+
+    def changed_since(self, gen: int
+                      ) -> list[tuple[bytes, bytes]] | None:
+        """Key spans the base mutations after ``gen`` touched, or None
+        when the log cannot account for EVERY bump since then (trimmed
+        entries, a caller older than the cap) — the sharded mirror then
+        falls back to a full re-split.  Empty-span bumps (a merge with
+        nothing pending) count toward completeness but add no span."""
+        if gen == self.gen:
+            return []
+        recent = [e for e in self.changes if e[0] > gen]
+        if len(recent) != self.gen - gen:
+            return None
+        return [(lo, hi) for _g, lo, hi in recent if lo is not None]
 
     # --- observability ---
 

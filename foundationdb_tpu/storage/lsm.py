@@ -69,6 +69,13 @@ _MEM_RUN_ROWS = 2048       # memtable rows per bulk run (range_runs)
 _CACHE_BLOCKS = 256        # LRU block cache entries (~16MB)
 _FOOTER = b"LSM1"
 _L0_MERGE_MAX = 16         # L0 runs one compaction folds at most
+_L0_MERGE_MAX_BYTES = 64 << 20  # ...and at most this many input bytes
+#                            (ISSUE 18 satellite / ROADMAP 5(h)): a burst
+#                            of fat L0 runs otherwise wedges the single
+#                            compactor in one giant merge while debt at
+#                            deeper levels starves; the pick stays the
+#                            contiguous OLDEST suffix (shadowing safety),
+#                            just a shorter one, and always takes >= 1 run
 _COMPACT_RETRY_S = 0.5     # backoff after a failed (IoError) compaction
 _COMPACT_MAX_RETRIES = 20  # consecutive NON-IoError failures before the
 #                            compactor poisons the store: transient disk
@@ -993,9 +1000,18 @@ class LSMKVStore:
         lvl = best[0]
         l0 = self._levels[0]
         if lvl == 0:
-            # the OLDEST L0 suffix (list is newest-first), bounded: the
-            # remaining newer runs keep shadowing the output correctly
-            sel = list(l0[-min(len(l0), _L0_MERGE_MAX):])
+            # the OLDEST L0 suffix (list is newest-first), bounded by
+            # count AND cumulative bytes: the remaining newer runs keep
+            # shadowing the output correctly
+            n, acc = 0, 0
+            for r in reversed(l0):          # oldest first
+                if n >= _L0_MERGE_MAX:
+                    break
+                acc += r.bytes
+                if n > 0 and acc > _L0_MERGE_MAX_BYTES:
+                    break
+                n += 1
+            sel = list(l0[-n:])
         else:
             runs = self._levels[lvl]
             sel = [max(runs, key=lambda r: (r.bytes, r.path))]

@@ -506,6 +506,356 @@ def test_storage_server_wires_device_reads():
     asyncio.run(main())
 
 
+# --------------------------------------------------------------------------
+# header-only (empty-clip) batches through the pipeline (ISSUE 18 sat. 3)
+#
+# With mesh routing the proxy sends header-only version advances to
+# partitions every txn clipped empty against; the resolver's fast path
+# answers most of them, but keepalives with routing off and state-barrier
+# batches still cross the pipeline with ZERO txns.  The pump, the group
+# encoder (zero chunks for a zero-txn batch), and the poison/drain paths
+# must all treat them as first-class batches.
+
+
+def test_pipeline_header_only_batches_drain_with_real_backends():
+    import sys
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0] + "/tools")
+    import perf_smoke
+
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+
+    knobs = Knobs().override(
+        RESOLVER_BATCH_TXNS=8, RESOLVER_RANGES_PER_TXN=2,
+        CONFLICT_RING_CAPACITY=256, KEY_ENCODE_BYTES=16,
+        MAX_WRITE_TRANSACTION_LIFE_VERSIONS=300, RESOLVER_GROUP_MAX=4)
+    batches, versions = perf_smoke._resolve_workload(12, 8, 2, 31)
+    # every third batch becomes header-only (the empty-clip shape)
+    batches = [([] if i % 3 == 1 else b) for i, b in enumerate(batches)]
+
+    async def run(kind: str):
+        be = make_conflict_backend(
+            knobs.override(RESOLVER_CONFLICT_BACKEND=kind))
+        pipe = DevicePipeline(be, knobs)
+        futs = [pipe.submit(t, v) for t, v in zip(batches, versions)]
+        rows = [await f for f in futs]
+        await pipe.drain()
+        await pipe.close()
+        return rows
+
+    twin = asyncio.run(run("numpy"))
+    dev = asyncio.run(run("tpu"))
+    assert twin == dev          # bit-identical with empties interleaved
+    for i, row in enumerate(twin):
+        assert len(row) == len(batches[i])  # empties yield empty rows
+
+
+def test_pipeline_header_only_batch_as_barrier():
+    async def main():
+        be = FakeBackend()
+        pipe = DevicePipeline(be, _knobs(RESOLVER_GROUP_MAX=8))
+        f0 = pipe.submit(_txns(2), 100)
+        f1 = pipe.submit(_txns(0), 110, barrier=True)   # empty state batch
+        f2 = pipe.submit(_txns(1), 120)
+        assert await f1 == []
+        await f0, await f2
+        await pipe.drain()
+        await pipe.close()
+        # the empty barrier still ends its group
+        assert [vs for vs, _ in be.groups] == [[100, 110], [120]]
+    asyncio.run(main())
+
+
+def test_pipeline_poison_with_header_only_batches_queued():
+    async def main():
+        be = FakeBackend(fail_sync_on_dispatch=1)
+        pipe = DevicePipeline(be, _knobs(RESOLVER_GROUP_MAX=2))
+        futs = [pipe.submit(_txns(0), 100 + i) for i in range(4)]
+        outcomes = []
+        for f in futs:
+            try:
+                outcomes.append(await f)
+            except ResolverFailed:
+                outcomes.append("failed")
+        # the failed dispatch's batches fail; anything already in flight
+        # ahead still delivers its (empty) rows — no hangs, no crash
+        assert outcomes[:2] == ["failed", "failed"]
+        assert all(o in ("failed", []) for o in outcomes)
+        assert pipe.poisoned is not None
+        await pipe.drain()
+        assert pipe._pump_task.done()
+        assert pipe._pump_task.exception() is None
+        with pytest.raises(ResolverFailed):
+            await pipe.submit(_txns(0), 200)
+        await pipe.close()
+    asyncio.run(main())
+
+
+def test_pipeline_close_discard_fails_queued_header_only():
+    async def main():
+        be = FakeBackend()
+        pipe = DevicePipeline(be, _knobs())
+        fut = pipe.submit(_txns(0), 100)
+        await pipe.close(discard=True)
+        with pytest.raises(ResolverFailed):
+            await fut
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------
+# on-device verdict reduction (ISSUE 18 tentpole b)
+
+
+def test_verdict_bitmask_parity_and_readback_cut():
+    """The RESOLVER_VERDICT_BITMASK reduction: verdicts bit-identical to
+    the raw-vector twin through the same pipeline, and the bytes the
+    host actually synced shrink (4-byte summary per clean dispatch vs
+    K*B i32)."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0] + "/tools")
+    import perf_smoke
+
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+
+    base = Knobs().override(
+        RESOLVER_BATCH_TXNS=8, RESOLVER_RANGES_PER_TXN=2,
+        CONFLICT_RING_CAPACITY=256, KEY_ENCODE_BYTES=16,
+        CONFLICT_WINDOW_SLOTS=32,
+        MAX_WRITE_TRANSACTION_LIFE_VERSIONS=300, RESOLVER_GROUP_MAX=4,
+        RESOLVER_CONFLICT_BACKEND="tpu")
+    batches, versions = perf_smoke._resolve_workload(24, 8, 2, 77)
+
+    async def run(knobs):
+        be = make_conflict_backend(knobs)
+        pipe = DevicePipeline(be, knobs)
+        futs = [pipe.submit(t, v) for t, v in zip(batches, versions)]
+        rows = [await f for f in futs]
+        await pipe.close()
+        return [x for r in rows for x in r], be.readback_bytes
+
+    raw, raw_bytes = asyncio.run(run(
+        base.override(RESOLVER_VERDICT_BITMASK=False)))
+    packed, packed_bytes = asyncio.run(run(
+        base.override(RESOLVER_VERDICT_BITMASK=True)))
+    assert raw == packed
+    assert 0 < packed_bytes < raw_bytes
+
+
+def test_verdict_bitmask_wire_words_roundtrip():
+    """Resolver replies carry abort_words matching the verdict vector,
+    and the proxy-side decode (conflict bit + too-old bit) reproduces the
+    codes exactly."""
+    from foundationdb_tpu.core.resolver import Resolver, pack_abort_words
+
+    reqs = _resolve_requests(16, 77)
+    knobs = Knobs().override(
+        RESOLVER_BATCH_TXNS=6, RESOLVER_RANGES_PER_TXN=2,
+        CONFLICT_RING_CAPACITY=256, KEY_ENCODE_BYTES=16,
+        MAX_WRITE_TRANSACTION_LIFE_VERSIONS=300,
+        RESOLVER_VERDICT_BITMASK=True)
+
+    async def main():
+        r = Resolver(knobs)
+        saw_conflict = False
+        for req in reqs:
+            reply = await r.resolve(req)
+            assert reply.abort_words is not None
+            assert reply.abort_words == pack_abort_words(reply.verdicts)
+            nw = (len(reply.verdicts) + 31) // 32
+            for i, v in enumerate(reply.verdicts):
+                w, b = divmod(i, 32)
+                cbit = (reply.abort_words[w] >> b) & 1
+                tbit = (reply.abort_words[nw + w] >> b) & 1
+                assert v == cbit + tbit
+                saw_conflict |= cbit == 1
+        await r.stop()
+        assert saw_conflict, "workload failed to exercise aborts"
+    asyncio.run(main())
+
+
+def test_verdict_bitmask_off_leaves_reply_none():
+    from foundationdb_tpu.core.resolver import Resolver
+
+    reqs = _resolve_requests(3, 5)
+    knobs = Knobs().override(
+        RESOLVER_BATCH_TXNS=6, RESOLVER_RANGES_PER_TXN=2,
+        CONFLICT_RING_CAPACITY=256, KEY_ENCODE_BYTES=16,
+        RESOLVER_VERDICT_BITMASK=False)
+
+    async def main():
+        r = Resolver(knobs)
+        for req in reqs:
+            assert (await r.resolve(req)).abort_words is None
+        await r.stop()
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------------
+# Pallas in-place ring write (ISSUE 18 tentpole c)
+
+
+def test_ring_inplace_parity_through_pipeline():
+    """RESOLVER_RING_INPLACE on (interpret-mode on CPU) vs off: verdicts
+    bit-identical across a workload long enough to wrap the ring."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0] + "/tools")
+    import perf_smoke
+
+    from foundationdb_tpu.ops.backends import make_conflict_backend
+
+    base = Knobs().override(
+        RESOLVER_BATCH_TXNS=8, RESOLVER_RANGES_PER_TXN=2,
+        CONFLICT_RING_CAPACITY=256, KEY_ENCODE_BYTES=16,
+        CONFLICT_WINDOW_SLOTS=32,
+        MAX_WRITE_TRANSACTION_LIFE_VERSIONS=300, RESOLVER_GROUP_MAX=4,
+        RESOLVER_CONFLICT_BACKEND="tpu")
+    batches, versions = perf_smoke._resolve_workload(24, 8, 2, 77)
+
+    async def run(knobs):
+        be = make_conflict_backend(knobs)
+        pipe = DevicePipeline(be, knobs)
+        futs = [pipe.submit(t, v) for t, v in zip(batches, versions)]
+        rows = [await f for f in futs]
+        await pipe.close()
+        return [x for r in rows for x in r]
+
+    off = asyncio.run(run(base.override(RESOLVER_RING_INPLACE=False)))
+    on = asyncio.run(run(base.override(RESOLVER_RING_INPLACE=True)))
+    assert off == on
+
+
+# --------------------------------------------------------------------------
+# group-size histogram (ISSUE 18 satellite 1)
+
+
+def test_group_size_stats_histogram_surface():
+    from foundationdb_tpu.device.pipeline import GroupSizeStats
+    gs = GroupSizeStats()
+    for n in (1, 4, 4, 2):
+        gs.append(n)
+    assert len(gs) == 4
+    assert list(gs) == [1, 4, 4, 2]
+    assert gs.max == 4
+    assert gs.mean() == pytest.approx(11 / 4)
+    # the trace histogram carries the same samples until its log flush
+    assert gs.hist.count == 4 and gs.hist.total == pytest.approx(11)
+    # a log-interval flush clears the Histogram but NOT the running
+    # stats the gauges read
+    gs.hist.clear()
+    assert gs.mean() == pytest.approx(11 / 4) and gs.max == 4
+    gs.clear()
+    assert len(gs) == 0 and gs.mean() == 0.0 and list(gs) == []
+
+
+# --------------------------------------------------------------------------
+# sharded per-chip mirror (ISSUE 18 tentpole a)
+
+
+def _sharded_knobs(shards: int, **over) -> Knobs:
+    return Knobs().override(STORAGE_DEVICE_READ_MIN_BATCH=4,
+                            STORAGE_DEVICE_READ_SHARDS=shards, **over)
+
+
+def test_sharded_directory_matches_engine_and_twin():
+    """The sharded mirror (4 shards over the forced 8 CPU devices)
+    returns byte-identical batches to both the engine path and the
+    single-directory twin."""
+    import jax
+    assert len(jax.devices()) >= 2   # conftest forces 8 host devices
+    kv = _engine_with(800)
+    kv.packed_index._merge()
+    twin = DeviceReadServer(kv, _sharded_knobs(0))
+    srv = DeviceReadServer(kv, _sharded_knobs(4))
+    assert srv.active and srv._sharded and not twin._sharded
+    keys = sorted({b"dk%05d" % (i * 37 % 1100) for i in range(96)}
+                  | {b"aaaa", b"zzzz"})
+    got = srv.get_batch(keys)       # sharded serves inline even from cold
+    assert got is not None
+    assert twin.get_batch(keys) is None     # twin cold start primes only
+    assert got == kv.get_batch(keys) == twin.get_batch(keys)
+    m = srv.metrics()
+    assert m["device_read_shards"] == 4
+    assert m["device_read_full_splits"] == 1
+    assert m["device_read_shard_refreshes"] == 4
+    assert m["device_read_gathers"] >= 2    # batch spanned > 1 shard
+
+
+def test_sharded_directory_partial_refresh_serves_inline():
+    """A localized merge re-ships only the touched shards, and the
+    first post-merge batch is still served by the DEVICE (the
+    single-directory twin falls back to the engine there)."""
+    kv = _engine_with(600)
+    kv.packed_index._merge()
+    srv = DeviceReadServer(kv, _sharded_knobs(4))
+    keys = [b"dk%05d" % i for i in range(32)]
+    srv.get_batch(keys)                     # cold start: full split
+    assert srv.get_batch(keys) is not None
+    refr0 = srv._dir.shard_refreshes
+    # a merge touching only the tail of the key space
+    kv._apply([(OP_SET, b"dk%05d" % (5000 + i), b"nv") for i in range(300)])
+    kv.packed_index._merge()
+    got = srv.get_batch(keys)               # partial refresh + inline serve
+    assert got is not None                  # no engine fallback
+    assert got == kv.get_batch(keys)
+    delta = srv._dir.shard_refreshes - refr0
+    assert 1 <= delta < 4                   # only touched shards re-shipped
+    assert srv.metrics()["device_read_full_splits"] == 1
+
+
+def test_sharded_directory_lsm_blocks_mode(monkeypatch):
+    """Sharded mirror over the lsm merged sparse directory: the routed
+    per-shard searchsorted must locate the same global blocks."""
+    import foundationdb_tpu.storage.lsm as lsm_mod
+    from foundationdb_tpu.runtime.files import SimFileSystem
+    from foundationdb_tpu.runtime.simloop import run_simulation
+    from foundationdb_tpu.storage.lsm import LSMKVStore
+    monkeypatch.setattr(lsm_mod, "_MEMTABLE_BYTES", 1500)
+    monkeypatch.setattr(lsm_mod, "_BLOCK_BYTES", 200)
+    monkeypatch.setattr(lsm_mod, "_MAX_RUNS", 8)
+
+    async def main():
+        import random
+        fs = SimFileSystem()
+        kv = await LSMKVStore.open(fs, "db/lsm")
+        rng = random.Random(9)
+        for round_ in range(8):
+            ops = [(0, b"dk%04d" % rng.randrange(1200),
+                    b"v%06d" % rng.randrange(10 ** 6)) for _ in range(60)]
+            await kv.commit(ops, {"durable_version": round_})
+        srv = DeviceReadServer(kv, _sharded_knobs(4))
+        assert srv.active and srv._sharded
+        probes = sorted({b"dk%04d" % rng.randrange(1400)
+                         for _ in range(150)})
+        got = srv.get_batch(probes)     # sharded serves inline from cold
+        assert got is not None
+        assert got == kv.get_batch(probes)
+
+    run_simulation(main())
+
+
+def test_device_read_staleness_gauge():
+    """The staleness GAUGE: versions the mirror's refresh trails the
+    engine tip — 0 while fresh, the version gap once stale, 0 again
+    after the refresh."""
+    kv = _engine_with(300)
+    kv.packed_index._merge()
+    tip = {"v": 100}
+    knobs = Knobs().override(STORAGE_DEVICE_READ_MIN_BATCH=4)
+    srv = DeviceReadServer(kv, knobs, version_fn=lambda: tip["v"])
+    keys = [b"dk%05d" % i for i in range(16)]
+    srv.get_batch(keys)                     # primes mirror at tip 100
+    assert srv.get_batch(keys) is not None
+    assert srv.staleness_versions() == 0    # fresh: gauge pinned to 0
+    tip["v"] = 500
+    assert srv.staleness_versions() == 0    # still fresh (overlay covers)
+    kv._apply([(OP_SET, b"dk%05d" % (2000 + i), b"nv") for i in range(600)])
+    kv.packed_index._merge()
+    assert srv.staleness_versions() == 500 - 100    # stale: real gap
+    assert srv.metrics()["device_read_staleness_versions"] == 400
+    srv.get_batch(keys)                     # engine serves + refresh
+    assert srv.staleness_versions() == 0
+    assert srv.metrics()["device_read_staleness_versions"] == 0
+
+
 def test_device_read_server_lsm_blocks_mode(monkeypatch):
     """The device gather under the lsm engine (ISSUE 11, ROADMAP item 1
     (e)): the mirror is the MERGED sparse directory, one searchsorted

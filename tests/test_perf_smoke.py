@@ -174,6 +174,19 @@ def test_scrub_consistency_smoke():
     perf_smoke.check_scrub(budget_s=perf_smoke.SCRUB_BUDGET_S)
 
 
+def test_devplane_smoke():
+    """The sharded device plane (ISSUE 18): under tail-localized churn
+    the 4-shard read mirror must keep serving batched reads off the
+    device (partial refresh via the index change log) at >= 1.5x the
+    single-directory twin's device-served batch count on the forced
+    multi-device CPU mesh, results byte-identical to the engine on both
+    sides; and the verdict-bitmask readback must cut device->host
+    verdict bytes/txn >= 4x vs the raw-vector twin with bit-identical
+    verdicts and real aborts present, under the standing hard wedge
+    deadline."""
+    perf_smoke.check_devplane(budget_s=perf_smoke.DEVPLANE_BUDGET_S)
+
+
 def test_apply_metrics_surface():
     """The apply path must publish its observability counters — a silent
     regression is the other half of the r5 incident."""

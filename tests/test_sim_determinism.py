@@ -104,6 +104,15 @@ def _child(path: str, mode: str = "default") -> None:
     # "scrub_on"/"scrub_off" modes instead force the knob each way at a
     # hot cadence, so the audit plane itself carries its own
     # bit-identical proof.
+    # ISSUE 18: the device-plane knobs are pinned explicitly — verdict
+    # bitmask readback ON (its default; the packed-words reply path is
+    # now inside every standing bit-identical proof), the Pallas
+    # in-place ring write OFF (its default) and the sharded read mirror
+    # OFF (shards=0) — so a future default flip on any of the three
+    # cannot silently change what these children prove.  The "devplane"
+    # mode instead forces the OTHER side of each: shards=4, bitmask OFF,
+    # ring_inplace ON (interpret-mode on CPU), so the flipped plane
+    # carries its own bit-identical proof.
     knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
                              RESOLVER_DEVICE_PIPELINE=True,
                              DD_SHARD_HEAT_SPLITS=False,
@@ -119,7 +128,10 @@ def _child(path: str, mode: str = "default") -> None:
                              METRICS_INTERVAL=1.0,
                              RESOLVER_MESH_ROUTING=True,
                              RESOLVER_REBALANCE=False,
-                             SCRUB_ENABLED=False)
+                             SCRUB_ENABLED=False,
+                             RESOLVER_VERDICT_BITMASK=True,
+                             RESOLVER_RING_INPLACE=False,
+                             STORAGE_DEVICE_READ_SHARDS=0)
     durable = False
     n_resolvers = 1
     if mode == "metrics_off":
@@ -165,6 +177,15 @@ def _child(path: str, mode: str = "default") -> None:
                                SCRUB_PAGES_PER_SEC=500.0,
                                SCRUB_PAGE_ROWS=8,
                                SCRUB_MAX_PAGES_PER_REQUEST=4)
+    elif mode == "devplane":
+        # ISSUE 18: every device-plane knob flipped AWAY from its
+        # default at once — a 4-shard read mirror (the forced 8-CPU
+        # device shape), raw-vector verdict readback, and the Pallas
+        # in-place ring append (interpret mode on CPU).  The flipped
+        # plane must replay bit-identically too.
+        knobs = knobs.override(RESOLVER_VERDICT_BITMASK=False,
+                               RESOLVER_RING_INPLACE=True,
+                               STORAGE_DEVICE_READ_SHARDS=4)
     elif mode in ("lsm_on", "lsm_off"):
         # ISSUE 14: durable lsm storage with a tiny memtable/trigger so
         # flushes AND compactions run inside the sim — leveled
@@ -444,6 +465,28 @@ def test_same_seed_sim_trace_bit_identical_mesh_knob_both_ways(tmp_path):
     assert (d3, n3) == (d4, n4), (
         f"same-seed sim trace diverged with the broadcast twin forced: "
         f"run a = {d3} ({n3} events), run b = {d4} ({n4})")
+
+
+def test_same_seed_sim_trace_bit_identical_devplane_knobs_flipped(tmp_path):
+    """ISSUE 18 acceptance: the standing children pin the device-plane
+    knobs at their defaults (verdict bitmask ON, ring in-place OFF,
+    read-mirror shards 0); this pair flips ALL THREE the other way —
+    raw-vector verdict replies (abort_words None on the wire, the
+    proxy's per-txn scatter twin), the in-place ring append, a 4-shard
+    mirror — and must still replay bit-identically across fresh
+    processes.  Together the two sides prove every new knob pinned both
+    ways."""
+    d1, n1, p1, *_ = _run_child(tmp_path, "va", mode="devplane")
+    d2, n2, p2, *_ = _run_child(tmp_path, "vb", mode="devplane")
+    assert n1 > 100, f"trace suspiciously small ({n1} events)"
+    assert p1 > 0, (
+        "no ResolverDevice span events in the devplane child's trace — "
+        "the device pipeline path did not run, so this test proved "
+        "nothing")
+    assert (d1, n1, p1) == (d2, n2, p2), (
+        f"same-seed sim trace diverged with the device-plane knobs "
+        f"flipped (bitmask OFF / ring in-place ON / 4-shard mirror): "
+        f"run a = {d1} ({n1} events), run b = {d2} ({n2})")
 
 
 def test_same_seed_sim_trace_bit_identical_scrub_knob_both_ways(tmp_path):
