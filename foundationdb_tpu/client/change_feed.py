@@ -22,8 +22,9 @@ import asyncio
 from ..core.change_feed import ChangeFeedStreamRequest
 from ..core.data import MutationBatch, Version
 from ..core.system_data import change_feed_key, change_feed_pop_key
-from ..runtime.errors import (ChangeFeedNotRegistered, ChangeFeedPopped,
-                              FdbError, InvertedRange, KeyOutsideLegalRange)
+from ..runtime.errors import (ChangeFeedDestroyed, ChangeFeedNotRegistered,
+                              ChangeFeedPopped, FdbError, InvertedRange,
+                              KeyOutsideLegalRange)
 
 __all__ = ["create_change_feed", "destroy_change_feed", "pop_change_feed",
            "ChangeFeedCursor"]
@@ -148,8 +149,23 @@ class ChangeFeedCursor:
             except FdbError as e:
                 if isinstance(e, ChangeFeedNotRegistered):
                     # racing a range handoff (the destination has not
-                    # applied its REGISTER yet) — or genuinely gone;
-                    # refresh + bounded retry distinguishes the two
+                    # applied its REGISTER yet) — or genuinely destroyed;
+                    # the replicated registration row distinguishes the
+                    # two: a handoff leaves it intact, a destroy clears
+                    # it, so a consumer gets the typed terminal error
+                    # instead of a raw lookup failure after 50 retries
+                    try:
+                        await _feed_range(self._db, self.feed_id)
+                    except ChangeFeedNotRegistered:
+                        raise ChangeFeedDestroyed(
+                            "change feed %r destroyed mid-drain at cursor "
+                            "version %d" % (self.feed_id, self.version)
+                        ) from e
+                    except FdbError as probe:
+                        if not probe.retryable:
+                            raise
+                        # row unreadable right now: stay in the bounded
+                        # handoff retry rather than misclassifying
                     not_registered += 1
                     if not_registered > 50:
                         raise

@@ -83,6 +83,14 @@ class Transaction:
         # SPECIAL_KEY_SPACE_ENABLE_WRITES (REF: the transaction option
         # gating management writes through \xff\xff)
         self.special_key_space_enable_writes = False
+        # layer commit hooks (ISSUE 19): async fn(tr) callables run at
+        # the start of every commit() attempt, BEFORE _committing flips
+        # — so a hook can still read (the pre-write values whose derived
+        # rows it must clear) and write (the replacement derived rows)
+        # into the SAME commit.  Persistent across reset/on_error like
+        # lock_aware: db.run's retry loop re-runs the body, the body
+        # re-buffers its writes, and the hook re-derives from them.
+        self._commit_hooks: list = []
         self.reset()
 
     # --- bounded-failure options (the C API trio) ---
@@ -765,6 +773,65 @@ class Transaction:
     def add_write_conflict_key(self, key: bytes) -> None:
         self.add_write_conflict_range(key, key_after(key))
 
+    # --- layer commit hooks (ISSUE 19) ---
+
+    @property
+    def write_map(self) -> WriteMap:
+        """This transaction's buffered-write state, exposed read-only
+        for commit hooks (layers/index.py walks written keys and
+        cleared spans to derive index-row mutations)."""
+        return self._writes
+
+    def add_commit_hook(self, hook) -> None:
+        """Register an async ``fn(tr)`` run at the start of every
+        commit() attempt while the transaction still accepts reads and
+        writes — the transactional secondary-index mode's atomicity
+        point (layers/index.py is the canonical consumer).  Idempotent:
+        re-adding the same callable is a no-op, so a hook installed
+        inside a ``db.run`` body survives the retry loop without
+        stacking."""
+        if hook not in self._commit_hooks:
+            self._commit_hooks.append(hook)
+
+    async def get_prewrite_multi(self, keys: list[bytes],
+                                 snapshot: bool = False
+                                 ) -> list[bytes | None]:
+        """The values of ``keys`` at this transaction's read version
+        IGNORING buffered writes — the pre-transaction base a commit
+        hook needs (RYW ``get`` would return the buffered value, hiding
+        the derived rows that must be cleared).  Non-snapshot reads add
+        per-key read conflicts, which is what makes hook-maintained
+        derived state serializable: any concurrent writer of the same
+        primary key conflicts here."""
+        self._check_mutable()
+        self._check_deadline()
+        for k in keys:
+            self._check_key(k)
+        if not snapshot:
+            for k in keys:
+                self._read_conflicts.append((k, key_after(k)))
+        version = await self.get_read_version()
+        return list(await self._bounded(asyncio.gather(
+            *(self._storage_read(k, version) for k in keys))))
+
+    async def get_prewrite_range(self, begin: bytes, end: bytes,
+                                 snapshot: bool = False
+                                 ) -> list[tuple[bytes, bytes]]:
+        """All rows of [begin, end) at the read version IGNORING
+        buffered writes — what a commit hook scans to clear the derived
+        rows of a buffered ``clear_range``.  Non-snapshot adds one read
+        conflict over the whole range (a concurrent insert into the
+        cleared span must conflict, or its derived row would leak)."""
+        self._check_mutable()
+        self._check_deadline()
+        if not snapshot and begin < end:
+            self._read_conflicts.append((begin, end))
+        version = await self.get_read_version()
+        out: list[tuple[bytes, bytes]] = []
+        async for k, v in self._snapshot_stream(begin, end, version, False):
+            out.append((bytes(k), bytes(v)))
+        return out
+
     # --- watch ---
 
     async def watch(self, key: bytes) -> asyncio.Future:
@@ -782,6 +849,13 @@ class Transaction:
     async def commit(self) -> Version:
         self._check_mutable()
         self._check_deadline()
+        # layer commit hooks run while the txn is still mutable and only
+        # when there is something to commit: a read-only txn derives
+        # nothing, and hooking it would force a GRV onto the read-only
+        # fast path below
+        if self._commit_hooks and (self._writes or self._write_conflicts):
+            for hook in list(self._commit_hooks):
+                await hook(self)
         if not self._writes and not self._write_conflicts:
             # read-only txn commits trivially at its read version
             self._committed_version = self._read_version if self._read_version is not None else 0

@@ -432,6 +432,53 @@ async def cluster_status(knobs: Knobs, transport: Transport,
     except Exception:   # noqa: BLE001 — partial status beats none
         pass
 
+    # layers rollup (ISSUE 19): every running LayerFeedConsumer
+    # publishes \xff/layers/progress/<name> → encode(stats) on the
+    # backup-progress discipline; read the rows back best-effort so
+    # status shows each consumer's freshness frontier (and its lag vs
+    # the committed version this read pinned) plus whatever per-layer
+    # stats its sinks splat — index row counts, cache hit rate, watch
+    # fire latency — without the layers needing an RPC surface.
+    layers_rollup: dict = {"consumers": [], "active": 0}
+    try:
+        from ..rpc.wire import decode as _decode
+        from .cluster_client import RecoveredClusterView, RefreshingDatabase
+        from .system_data import LAYER_PROGRESS_PREFIX
+        view = RecoveredClusterView(knobs, transport, state)
+        ldb = RefreshingDatabase(view, coordinators)
+        tr = ldb.create_transaction()
+        tr.lock_aware = True
+        now_version = await asyncio.wait_for(tr.get_read_version(),
+                                             timeout=t)
+        rows = await asyncio.wait_for(
+            tr.get_range(LAYER_PROGRESS_PREFIX,
+                         LAYER_PROGRESS_PREFIX + b"\xff",
+                         limit=100, snapshot=True), timeout=t)
+        consumers = []
+        for k, v in rows:
+            try:
+                rec = _decode(bytes(v))
+            except Exception:  # noqa: BLE001 — torn progress blob
+                continue
+            name = bytes(k)[len(LAYER_PROGRESS_PREFIX):].decode(
+                errors="replace")
+            frontier = rec.get("frontier") or 0
+            consumers.append({
+                "name": name,
+                "frontier": frontier,
+                "lag_versions": max(0, now_version - frontier),
+                "entries_delivered": rec.get("entries", 0),
+                "reconnects": rec.get("reconnects", 0),
+                "destroyed": bool(rec.get("destroyed", False)),
+                "sinks": rec.get("sinks", []),
+            })
+        layers_rollup = {
+            "consumers": consumers,
+            "active": sum(1 for c in consumers if not c["destroyed"]),
+        }
+    except Exception:   # noqa: BLE001 — partial status beats none
+        pass
+
     # disk-degradation rollup (ISSUE 12, the gray-failure surface): any
     # disk-bearing role (durable storage, durable TLogs) publishes its
     # machine's decayed per-op disk latency + degraded flag through the
@@ -571,6 +618,7 @@ async def cluster_status(knobs: Knobs, transport: Transport,
             "shard_heat": shard_heat_rollup,
             "hot_moves": hot_moves_rollup,
             "backup": backup_rollup,
+            "layers": layers_rollup,
             "degraded": degraded_rollup,
             "tracing": tracing_rollup,
             "resolver_mesh": resolver_mesh_rollup,

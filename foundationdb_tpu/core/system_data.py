@@ -75,6 +75,20 @@ def backup_progress_key(name: str) -> bytes:
     return BACKUP_PROGRESS_PREFIX + name.encode()
 
 
+# layer progress (ISSUE 19): layer roles are CLIENT-side constructions
+# (index maintainers, read-through caches, watch registries in
+# foundationdb_tpu/layers/) with no cluster RPC surface, so — exactly
+# like backup progress above — each publishes \xff/layers/progress/<name>
+# -> encode({kind, frontier, counters...}) and the ``cluster.layers``
+# status rollup reads the range back best-effort, computing lag against
+# the committed version at read time.
+LAYER_PROGRESS_PREFIX = b"\xff/layers/progress/"
+
+
+def layer_progress_key(name: str) -> bytes:
+    return LAYER_PROGRESS_PREFIX + name.encode()
+
+
 def decode_backup_tags(rows: list[tuple[bytes, bytes]]) -> dict[str, int]:
     """All armed mutation-log tags from a \\xff range read."""
     from ..rpc.wire import decode

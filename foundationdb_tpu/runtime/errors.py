@@ -114,6 +114,17 @@ ChangeFeedNotRegistered = _err(2903, "change_feed_not_registered",
 ChangeFeedPopped = _err(2904, "change_feed_popped",
                         "Requested change-feed data was released by a pop "
                         "(cursor is below the durable low-water mark)")
+ChangeFeedDestroyed = _err(2905, "feed_destroyed",
+                           "The change feed's registration row is gone: it "
+                           "was destroyed while a cursor was draining it.  "
+                           "Unlike change_feed_not_registered (a transient "
+                           "handoff race the cursor retries through), this "
+                           "is a definite terminal outcome — the retained "
+                           "segments were released at the destroy version "
+                           "and no retry can recover them.  NOT retryable "
+                           "(upstream's change_feed_cancelled analog; its "
+                           "exact code was unverifiable this session, 2905 "
+                           "reserved here)")
 
 # 1213 is retryable for idempotent operations (reads, GRV); the commit
 # path converts it to commit_unknown_result (1021) before the client's

@@ -395,6 +395,23 @@ class Knobs:
     SCRUB_WATCHDOG_INTERVAL: float = 2.0      # invariant-check cadence
     SCRUB_MAX_REPORTED_ROWS: int = 16         # ScrubMismatch events per page
 
+    # --- layers (ISSUE 19) ---
+    # the layer ecosystem (foundationdb_tpu/layers/): secondary indexes,
+    # the invalidating read-through cache, and feed-riding key watches,
+    # all client-side constructions over ordinary transactions and the
+    # change-feed cursor.  NOTHING here runs unless a layer object is
+    # constructed — the knobs only tune layers that a client explicitly
+    # builds, so same-seed sim traces with no layers in the workload are
+    # bit-identical regardless of these values (the determinism children
+    # pin them BOTH ways to prove it).
+    LAYER_FEED_POLL_INTERVAL: float = 0.05    # consumer idle re-poll pace
+    LAYER_FEED_POP_LAG_VERSIONS: int = 1_000_000  # pop feed this far behind frontier
+    LAYER_INDEX_TRANSACTIONAL: bool = True    # index mode: same-commit rows vs feed-driven
+    LAYER_CACHE_CAPACITY: int = 4096          # read-through cache entries (LRU)
+    LAYER_WATCH_LIMIT: int = 10_000           # pending watches per registry
+    LAYER_PROGRESS_INTERVAL: float = 1.0      # \xff/layers/progress publish pace
+    LAYER_CHECK_PAGE_ROWS: int = 256          # checker rows per packed page
+
     # --- observability ---
     METRICS_INTERVAL: float = 5.0             # role *Metrics emit period
     # the continuous metrics plane (ISSUE 15): every role registers its

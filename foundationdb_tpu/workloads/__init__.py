@@ -11,4 +11,5 @@ from .workload import (TestWorkload, WorkloadContext, register_workload,
                        make_workload, run_workloads, run_workloads_on)
 from . import (api_fuzz, attrition, change_feed,  # noqa: F401  (register)
                conflict_range, consistency, correctness, cycle, disk_fault,
-               dynamic, increment, ops, ops2, random_rw, serializability)
+               dynamic, increment, layers, ops, ops2, random_rw,
+               serializability)

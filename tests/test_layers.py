@@ -1,0 +1,593 @@
+"""The layer ecosystem (ISSUE 19): indexes, read-through cache, watches.
+
+Functional coverage on an in-process cluster:
+
+- the shared feed consumer's freshness frontier and fan-out;
+- the typed ``feed_destroyed`` terminal error a cursor raises when its
+  feed's registration is destroyed mid-drain (vs the transient
+  handoff race it retries through) — satellite regression;
+- transactional index rows BIT-IDENTICAL to a rebuild-from-scan at a
+  pinned version (the mode's acceptance invariant), including
+  overwrites, deletes, clear_range and atomic-op folds;
+- the async index's freshness frontier: reads never served above it,
+  primary-scan fallback when ``at_least`` outruns it;
+- cache invalidation: a committed write is never served stale past the
+  feed frontier, concurrent fill/invalidate races discard the fill;
+- watch edge cases: fire on first mutation at-or-after the watch
+  version, fire on a ``clear_range`` covering the key, immediate fire
+  when registered past the mutation, survival across a live shard
+  split mid-wait;
+- the layer consistency checker: clean on honest layers, key-exact
+  ``LayerMismatch`` on injected index-row canaries (both flavors:
+  phantom row and missing row).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.client.subspace import Subspace
+from foundationdb_tpu.core.cluster import Cluster, ClusterConfig
+from foundationdb_tpu.layers import (LayerConsistencyChecker,
+                                     LayerFeedConsumer, ReadThroughCache,
+                                     SecondaryIndex, WatchRegistry)
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.simloop import run_simulation
+from foundationdb_tpu.runtime.trace import (Severity, TraceLog,
+                                            get_trace_log, set_trace_log)
+
+# a hot feed-poll cadence so frontier waits settle in sim-milliseconds
+LAYER_KNOBS = dict(LAYER_FEED_POLL_INTERVAL=0.01,
+                   LAYER_PROGRESS_INTERVAL=0.5)
+
+
+@pytest.fixture()
+def captured_trace():
+    events: list[dict] = []
+    sink = TraceLog(min_severity=Severity.INFO)
+    sink.sink = events.append
+    prev = get_trace_log()
+    set_trace_log(sink)
+    yield events
+    set_trace_log(prev)
+
+
+async def _commit(db, fn) -> int:
+    """db.run but returning the COMMIT VERSION (db.run returns fn's
+    result) — layer tests constantly need the version."""
+    import inspect
+    tr = db.create_transaction()
+    try:
+        while True:
+            try:
+                r = fn(tr)
+                if inspect.isawaitable(r):
+                    await r
+                return await tr.commit()
+            except BaseException as e:
+                await tr.on_error(e)
+    finally:
+        tr.reset()
+
+
+def _idx(db, **kw) -> SecondaryIndex:
+    return SecondaryIndex(db, Subspace(raw_prefix=b"idx/"),
+                          primary_begin=b"p/", primary_end=b"q",
+                          **kw)
+
+
+async def _rebuild(db, index, version: int) -> set:
+    """Independent rebuild-from-scan of the expected index row-key set
+    at a pinned version."""
+    tr = db.create_transaction()
+    try:
+        tr.set_read_version(version)
+        rows = await tr.get_range(index.primary_begin, index.primary_end,
+                                  snapshot=True)
+        expected = set()
+        for k, v in rows:
+            for iv in index._extract(bytes(k), bytes(v)):
+                expected.add(index.row_key(iv, bytes(k)))
+        return expected
+    finally:
+        tr.reset()
+
+
+async def _index_rows(db, index, version: int | None = None) -> set:
+    tr = db.create_transaction()
+    try:
+        if version is not None:
+            tr.set_read_version(version)
+        ib, ie = index.index.key(), index.index.range(())[1]
+        rows = await tr.get_range(ib, ie, snapshot=True)
+        return {bytes(k) for k, _v in rows}
+    finally:
+        tr.reset()
+
+
+# --- the feed consumer ---
+
+def test_feed_consumer_frontier_proves_delivery():
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            consumer = LayerFeedConsumer(db, name="t1")
+            seen: list[tuple[int, bytes]] = []
+
+            class Sink:
+                def on_mutations(self, version, batch):
+                    for m in batch:
+                        seen.append((version, bytes(m.param1)))
+            consumer.add_sink(Sink())
+            v0 = await consumer.start()
+            assert consumer.frontier == v0
+            vs = [await _commit(db, lambda tr, i=i:
+                                tr.set(b"fk%02d" % i, b"v"))
+                  for i in range(5)]
+            await consumer.wait_frontier(max(vs))
+            # frontier >= tip proves every commit at or below it was
+            # dispatched to the sink BEFORE the frontier advanced
+            got = sorted(seen)
+            assert got == sorted((v, b"fk%02d" % i)
+                                 for i, v in enumerate(vs)), got
+            assert consumer.stats()["entries"] == 5
+            await consumer.stop(destroy=True)
+    run_simulation(main(), seed=1901)
+
+
+def test_cursor_raises_typed_feed_destroyed_mid_drain():
+    """Satellite regression: a feed destroyed while a cursor drains it
+    surfaces as the TYPED terminal ``feed_destroyed`` error — not as an
+    endless change_feed_not_registered retry loop, and not retryable."""
+    from foundationdb_tpu.runtime.errors import (ChangeFeedDestroyed,
+                                                 FdbError)
+
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs()) as cluster:
+            db = Database(cluster)
+            await db.create_change_feed(b"doomed", b"d", b"e")
+            v1 = await _commit(db, lambda tr: tr.set(b"d1", b"v"))
+            cur = db.read_change_feed(b"doomed")
+            loop = asyncio.get_running_loop()
+            entries = await cur.drain_through(v1,
+                                              deadline=loop.time() + 60)
+            assert [m.param1 for _v, b in entries for m in b] == [b"d1"]
+            await db.destroy_change_feed(b"doomed")
+            await asyncio.sleep(1.0)       # destroy reaches the storages
+            with pytest.raises(ChangeFeedDestroyed) as ei:
+                for _ in range(200):
+                    await cur.next()
+            assert isinstance(ei.value, FdbError)
+            assert ei.value.code == 2905
+            assert ei.value.name == "feed_destroyed"
+            assert not ei.value.retryable, \
+                "feed_destroyed must be terminal, not retryable"
+    run_simulation(main(), seed=1902)
+
+
+def test_consumer_goes_terminal_on_destroyed_feed():
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            consumer = LayerFeedConsumer(db, name="t2")
+            await consumer.start()
+            v = await _commit(db, lambda tr: tr.set(b"x1", b"v"))
+            await consumer.wait_frontier(v)
+            await db.destroy_change_feed(consumer.feed_id)
+            await asyncio.sleep(1.0)
+            for _ in range(400):
+                if consumer.destroyed:
+                    break
+                await asyncio.sleep(0.05)
+            assert consumer.destroyed, \
+                "the pull loop kept running against a destroyed feed"
+            with pytest.raises(Exception):
+                await consumer.wait_frontier(v + 1_000_000, timeout=1.0)
+            await consumer.stop()
+    run_simulation(main(), seed=1903)
+
+
+# --- transactional index ---
+
+def test_transactional_index_bit_identical_to_rebuild(captured_trace):
+    """The mode's acceptance invariant: after sets, overwrites, atomic
+    adds, deletes and a clear_range — all through the commit hook — the
+    index subspace at a pinned version is BIT-IDENTICAL to an
+    independent rebuild-from-scan of the primary range at the same
+    version.  Then the checker agrees (clean), and injected canaries
+    (a phantom row AND a removed row) are each caught key-exactly."""
+    events = captured_trace
+    canary = {}
+
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            index = _idx(db, mode="transactional",
+                         extractor=lambda k, v: [v[:4]])
+
+            async def seed(tr):
+                for i in range(12):
+                    tr.set(b"p/%03d" % i, b"b%02d-val" % (i % 3))
+            await index.run(seed)
+
+            async def churn(tr):
+                tr.set(b"p/001", b"b99-moved")     # ival change
+                tr.clear(b"p/002")                 # delete
+                tr.clear_range(b"p/007", b"p/010")  # span delete
+                tr.set(b"p/100", b"b42-new")       # insert
+                tr.add(b"p/003", b"\x01\x00\x00\x00")
+            await index.run(churn)
+
+            tr = db.create_transaction()
+            pinned = await tr.get_read_version()
+            tr.reset()
+            actual = await _index_rows(db, index, pinned)
+            expected = await _rebuild(db, index, pinned)
+            assert actual == expected and len(actual) == 9, (
+                f"index rows diverge from rebuild at pinned {pinned}: "
+                f"extra={sorted(actual - expected)} "
+                f"missing={sorted(expected - actual)}")
+
+            # lookup serves the contiguous (ival, pkey) range
+            pkeys, _v = await index.lookup(b"b42-")
+            assert pkeys == [b"p/100"]
+
+            checker = LayerConsistencyChecker(db, index=index)
+            verdict = await checker.check()
+            assert verdict["divergences"] == 0, verdict
+            assert not verdict["index"]["refused"], verdict
+
+            # canaries: a phantom row the primary never justified, and
+            # an honest row removed behind the maintainer's back
+            phantom = index.row_key(b"b77-", b"p/ghost")
+            victim = index.row_key(b"b42-", b"p/100")
+            canary["phantom"], canary["victim"] = phantom, victim
+            await _commit(db, lambda tr: tr.set(phantom, b""))
+            await _commit(db, lambda tr: tr.clear(victim))
+            verdict = await checker.check()
+            assert verdict["index"]["divergences"] == 2, verdict
+    run_simulation(main(), seed=1904)
+
+    hits = {e["Key"] for e in events if e.get("Type") == "LayerMismatch"}
+    assert hits == {canary["phantom"].hex(), canary["victim"].hex()}, (
+        f"checker named {sorted(hits)}, expected exactly the two "
+        f"injected canary rows — triage is not key-exact")
+
+
+def test_transactional_index_concurrent_writers_conflict():
+    """Two transactions racing on the SAME primary key cannot both
+    commit stale index math: the hook's pre-write read is conflicted,
+    so the loser retries and folds the winner's row."""
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            index = _idx(db, mode="transactional")
+            await index.run(lambda tr: _set(tr, b"p/k", b"red"))
+
+            async def racer(val: bytes):
+                await index.run(lambda tr: _set(tr, b"p/k", val))
+            await asyncio.gather(racer(b"green"), racer(b"blue"))
+
+            tr = db.create_transaction()
+            pinned = await tr.get_read_version()
+            tr.reset()
+            actual = await _index_rows(db, index, pinned)
+            expected = await _rebuild(db, index, pinned)
+            assert actual == expected and len(actual) == 1, (
+                f"racing writers left {sorted(actual)} vs {sorted(expected)}")
+    run_simulation(main(), seed=1905)
+
+
+async def _set(tr, k, v):
+    tr.set(k, v)
+
+
+# --- async index ---
+
+def test_async_index_frontier_and_fallback():
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            consumer = LayerFeedConsumer(db, name="ai")
+            index = _idx(db, mode="async", consumer=consumer)
+            v0 = await _commit(db, lambda tr: _fill(tr, 8))
+            await consumer.start()
+            await index.start_async()
+            await consumer.wait_frontier(v0)
+            for _ in range(400):
+                if index.checkpoint() is not None:
+                    break
+                await asyncio.sleep(0.05)
+            ck = index.checkpoint()
+            assert ck is not None, "checkpoint never stabilized"
+
+            # served freshness NEVER exceeds the frontier
+            pkeys, served_at = await index.lookup(b"even")
+            assert served_at <= consumer.frontier
+            assert pkeys == [b"p/%03d" % i for i in range(0, 8, 2)]
+
+            # a write the feed has not delivered yet: at_least above the
+            # frontier forces the primary-scan fallback, which sees it
+            v1 = await _commit(db, lambda tr: tr.set(b"p/200", b"even"))
+            before = index.fallback_scans
+            pkeys, served_at = await index.lookup(b"even", at_least=v1 + 1)
+            assert index.fallback_scans == before + 1
+            assert b"p/200" in pkeys and served_at >= v1
+
+            # once the frontier catches up the index itself serves it
+            await consumer.wait_frontier(v1)
+            for _ in range(400):
+                ck = index.checkpoint()
+                if ck is not None and ck[0] >= v1:
+                    break
+                await asyncio.sleep(0.05)
+            pkeys, served_at = await index.lookup(b"even", at_least=v1)
+            assert b"p/200" in pkeys and v1 <= served_at \
+                <= consumer.frontier
+
+            checker = LayerConsistencyChecker(db, index=index)
+            verdict = await checker.check()
+            assert verdict["divergences"] == 0, verdict
+            assert not verdict["index"]["refused"], verdict
+            await consumer.stop(destroy=True)
+    run_simulation(main(), seed=1906)
+
+
+async def _fill(tr, n):
+    for i in range(n):
+        tr.set(b"p/%03d" % i, b"even" if i % 2 == 0 else b"odd")
+
+
+def test_async_index_clear_range_and_atomics_converge():
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            consumer = LayerFeedConsumer(db, name="ai2")
+            index = _idx(db, mode="async", consumer=consumer,
+                         extractor=lambda k, v: [v[:1]])
+            await consumer.start()
+            await index.start_async()
+            await _commit(db, lambda tr: _fill(tr, 6))
+            v = await _commit(db, lambda tr: _mix(tr))
+            await consumer.wait_frontier(v)
+            for _ in range(400):
+                ck = index.checkpoint()
+                if ck is not None and ck[0] >= v:
+                    break
+                await asyncio.sleep(0.05)
+            ck = index.checkpoint()
+            assert ck is not None and ck[0] >= v
+            actual = await _index_rows(db, index)
+            expected = await _rebuild(db, index, ck[0])
+            assert actual == expected, (
+                f"async rows diverge: extra={sorted(actual - expected)} "
+                f"missing={sorted(expected - actual)}")
+            await consumer.stop(destroy=True)
+    run_simulation(main(), seed=1907)
+
+
+async def _mix(tr):
+    tr.clear_range(b"p/001", b"p/004")
+    # the feed carries the atomic OPERAND; the applier must resolve the
+    # folded value at the frontier, not index the operand bytes
+    tr.add(b"p/004", b"\x01\x00\x00\x00")
+    tr.set(b"p/050", b"zz")
+
+
+# --- cache ---
+
+def test_cache_invalidation_never_serves_stale():
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            consumer = LayerFeedConsumer(db, name="c1")
+            cache = ReadThroughCache(db, consumer, capacity=64)
+            await consumer.start()
+            v0 = await _commit(db, lambda tr: tr.set(b"ck", b"one"))
+            await consumer.wait_frontier(v0)
+
+            assert await cache.get(b"ck") == b"one"      # miss, fills
+            assert await cache.get(b"ck") == b"one"      # hit
+            assert (cache.hits, cache.misses) == (1, 1)
+
+            v1 = await _commit(db, lambda tr: tr.set(b"ck", b"two"))
+            await consumer.wait_frontier(v1)
+            assert cache.invalidations == 1
+            value, valid_through = await cache.get_versioned(b"ck")
+            assert value == b"two" and valid_through >= v1
+
+            # at_least above the frontier forces a read-through even on
+            # a cached entry — the no-stale-read contract
+            v2 = await _commit(db, lambda tr: tr.set(b"ck", b"three"))
+            value, valid_through = await cache.get_versioned(
+                b"ck", at_least=v2)
+            assert value == b"three" and valid_through >= v2
+
+            checker = LayerConsistencyChecker(db, cache=cache)
+            verdict = await checker.check()
+            assert verdict["divergences"] == 0, verdict
+            await consumer.stop(destroy=True)
+    run_simulation(main(), seed=1908)
+
+
+def test_cache_clear_range_invalidates_and_lru_bounds():
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            consumer = LayerFeedConsumer(db, name="c2")
+            cache = ReadThroughCache(db, consumer, capacity=4)
+            await consumer.start()
+            v = await _commit(db, lambda tr: _fill_ck(tr))
+            await consumer.wait_frontier(v)
+            for i in range(8):
+                await cache.get(b"ck%02d" % i)
+            assert len(cache) == 4 and cache.evictions == 4
+
+            v1 = await _commit(
+                db, lambda tr: tr.clear_range(b"ck", b"cl"))
+            await consumer.wait_frontier(v1)
+            assert len(cache) == 0
+            assert await cache.get(b"ck05") is None
+            await consumer.stop(destroy=True)
+    run_simulation(main(), seed=1909)
+
+
+async def _fill_ck(tr):
+    for i in range(8):
+        tr.set(b"ck%02d" % i, b"v%02d" % i)
+
+
+# --- watches (satellite edge cases) ---
+
+def test_watch_fires_on_first_mutation_at_or_after_version():
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            consumer = LayerFeedConsumer(db, name="w1")
+            watches = WatchRegistry(db, consumer)
+            await consumer.start()
+            fut = await watches.watch(b"wk")
+            assert not fut.done()
+            v = await _commit(db, lambda tr: tr.set(b"wk", b"new"))
+            fired_at = await asyncio.wait_for(fut, 60)
+            assert fired_at == v
+            assert watches.fired == 1 and watches.pending_count == 0
+            await consumer.stop(destroy=True)
+    run_simulation(main(), seed=1910)
+
+
+def test_watch_fires_when_key_clear_ranged():
+    """Edge case: the watched key is destroyed by a clear_range that
+    never names it — the span fire must still resolve the watch."""
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            consumer = LayerFeedConsumer(db, name="w2")
+            watches = WatchRegistry(db, consumer)
+            v0 = await _commit(db, lambda tr: tr.set(b"wr5", b"x"))
+            await consumer.start()
+            await consumer.wait_frontier(v0)
+            fut = await watches.watch(b"wr5")
+            v = await _commit(db, lambda tr: tr.clear_range(b"wr", b"ws"))
+            fired_at = await asyncio.wait_for(fut, 60)
+            assert fired_at == v
+            await consumer.stop(destroy=True)
+    run_simulation(main(), seed=1911)
+
+
+def test_watch_registered_past_mutation_fires_immediately():
+    """Edge case: registration at a version at or below an
+    already-delivered mutation must fire on the spot — no new feed
+    traffic required."""
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            consumer = LayerFeedConsumer(db, name="w3")
+            watches = WatchRegistry(db, consumer)
+            await consumer.start()
+            tr = db.create_transaction()
+            old = await tr.get_read_version()
+            tr.reset()
+            v = await _commit(db, lambda tr: tr.set(b"wi", b"x"))
+            await consumer.wait_frontier(v)
+            fut = await watches.watch(b"wi", version=old)
+            assert fut.done() and fut.result() >= old
+            assert watches.immediate_fires == 1
+            # and a watch ABOVE the delivered mutation still pends
+            fut2 = await watches.watch(b"wi")
+            assert not fut2.done()
+            await consumer.stop(destroy=True)
+    run_simulation(main(), seed=1912)
+
+
+def test_watch_survives_live_shard_split_mid_wait():
+    """Edge case: a DD split relocates the watched key's shard while
+    the watch pends; the feed cursor re-routes and the mutation
+    committed AFTER the move still fires the watch."""
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+
+    async def main():
+        k = Knobs().override(DD_ENABLED=True, DD_INTERVAL=1.0,
+                             DD_SHARD_SPLIT_BYTES=6_000, **LAYER_KNOBS)
+        sim = SimulatedCluster(k, n_machines=6,
+                               spec=ClusterConfigSpec(min_workers=6))
+        await sim.start()
+        state1 = await sim.wait_epoch(1)
+        n_shards = len(state1["shard_teams"])
+        db = await sim.database()
+        consumer = LayerFeedConsumer(db, name="w4")
+        watches = WatchRegistry(db, consumer)
+        await consumer.start()
+        fut = await watches.watch(b"hot-target")
+        # write volume around the watched key until DD splits the shard
+        stop = asyncio.Event()
+
+        async def writer() -> None:
+            i = 0
+            while not stop.is_set():
+                async def body(tr, i=i):
+                    tr.set(b"hot%05d" % i, b"v" * 40)
+                await db.run(body)
+                i += 1
+                await asyncio.sleep(0.02)
+
+        w = asyncio.ensure_future(writer())
+        await sim.wait_state(lambda s: s.get("seq", 0) > 0
+                             and len(s["shard_teams"]) > n_shards)
+        stop.set()
+        await w
+        assert not fut.done()
+        v = 0
+
+        async def fire(tr):
+            tr.set(b"hot-target", b"after-move")
+        tr = db.create_transaction()
+        while True:
+            try:
+                await fire(tr)
+                v = await tr.commit()
+                break
+            except BaseException as e:
+                await tr.on_error(e)
+        fired_at = await asyncio.wait_for(fut, 120)
+        assert fired_at == v, (fired_at, v)
+        await consumer.stop(destroy=True)
+        await sim.stop()
+    run_simulation(main(), seed=1913)
+
+
+def test_watch_checker_clean_and_limit():
+    from foundationdb_tpu.runtime.errors import ClientInvalidOperation
+
+    async def main():
+        async with Cluster(ClusterConfig(storage_servers=2),
+                           Knobs().override(**LAYER_KNOBS)) as cluster:
+            db = Database(cluster)
+            consumer = LayerFeedConsumer(db, name="w5")
+            watches = WatchRegistry(db, consumer, limit=2)
+            await consumer.start()
+            await watches.watch(b"wa")
+            await watches.watch(b"wb")
+            with pytest.raises(ClientInvalidOperation):
+                await watches.watch(b"wc")
+            checker = LayerConsistencyChecker(db, watches=watches)
+            verdict = await checker.check()
+            assert verdict["divergences"] == 0, verdict
+            await consumer.stop(destroy=True)
+    run_simulation(main(), seed=1914)

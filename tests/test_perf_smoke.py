@@ -187,6 +187,21 @@ def test_devplane_smoke():
     perf_smoke.check_devplane(budget_s=perf_smoke.DEVPLANE_BUDGET_S)
 
 
+def test_layers_smoke():
+    """The layer ecosystem (ISSUE 19): the full client-side layer
+    stack (feed consumer, async secondary index, invalidating
+    read-through cache, watches) on one seeded recruited sim — the
+    zipf-0.99 read tier must hold the cache hit-rate floor with
+    sampled reads re-proved non-stale at their claimed valid-through
+    versions, a pre-armed watch must fire with its key's commit, the
+    consistency checker must reach a zero-divergence verdict on the
+    honest stack, a single index row rotted outside the maintenance
+    path must be caught key-exactly on the next pass, and the catch
+    must surface through cluster.layers, the metrics_tool layers view
+    and the raw trace alike, under the standing hard wedge deadline."""
+    perf_smoke.check_layers(budget_s=perf_smoke.LAYERS_BUDGET_S)
+
+
 def test_apply_metrics_surface():
     """The apply path must publish its observability counters — a silent
     regression is the other half of the r5 incident."""

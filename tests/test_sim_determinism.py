@@ -113,6 +113,16 @@ def _child(path: str, mode: str = "default") -> None:
     # mode instead forces the OTHER side of each: shards=4, bitmask OFF,
     # ring_inplace ON (interpret-mode on CPU), so the flipped plane
     # carries its own bit-identical proof.
+    # ISSUE 19: the layer-ecosystem knobs are pinned at their defaults
+    # explicitly — layers are client-side objects, so nothing runs in
+    # the standing children unless one is constructed, but a future
+    # default flip (a hotter poll cadence, async index mode, a different
+    # progress publish pace) must not silently change what the "layers"
+    # mode below proves.  That mode constructs the REAL stack (feed
+    # consumer + async index + cache + watches) with every layer knob
+    # flipped away from its default at once, and drives it inside the
+    # bit-identical proof; the standing children stay layer-less, so
+    # they also prove layers-off traces carry zero layer traffic.
     knobs = Knobs().override(CLIENT_LATENCY_PROBE_SAMPLE=1.0,
                              RESOLVER_DEVICE_PIPELINE=True,
                              DD_SHARD_HEAT_SPLITS=False,
@@ -131,7 +141,14 @@ def _child(path: str, mode: str = "default") -> None:
                              SCRUB_ENABLED=False,
                              RESOLVER_VERDICT_BITMASK=True,
                              RESOLVER_RING_INPLACE=False,
-                             STORAGE_DEVICE_READ_SHARDS=0)
+                             STORAGE_DEVICE_READ_SHARDS=0,
+                             LAYER_FEED_POLL_INTERVAL=0.05,
+                             LAYER_FEED_POP_LAG_VERSIONS=1_000_000,
+                             LAYER_INDEX_TRANSACTIONAL=True,
+                             LAYER_CACHE_CAPACITY=4096,
+                             LAYER_WATCH_LIMIT=10_000,
+                             LAYER_PROGRESS_INTERVAL=1.0,
+                             LAYER_CHECK_PAGE_ROWS=256)
     durable = False
     n_resolvers = 1
     if mode == "metrics_off":
@@ -186,6 +203,22 @@ def _child(path: str, mode: str = "default") -> None:
         knobs = knobs.override(RESOLVER_VERDICT_BITMASK=False,
                                RESOLVER_RING_INPLACE=True,
                                STORAGE_DEVICE_READ_SHARDS=4)
+    elif mode == "layers":
+        # ISSUE 19: every layer knob flipped AWAY from its default at
+        # once — a hotter feed poll, a tiny pop lag, async index mode,
+        # a small LRU, a tight watch limit, a faster progress publish,
+        # small checker pages — with the real client-side stack
+        # constructed and driven below.  The flipped ecosystem must
+        # replay bit-identically too: the consumer's poll cadence,
+        # progress-publish transactions and flush commits all ride the
+        # virtual clock.
+        knobs = knobs.override(LAYER_FEED_POLL_INTERVAL=0.01,
+                               LAYER_FEED_POP_LAG_VERSIONS=1_000,
+                               LAYER_INDEX_TRANSACTIONAL=False,
+                               LAYER_CACHE_CAPACITY=8,
+                               LAYER_WATCH_LIMIT=4,
+                               LAYER_PROGRESS_INTERVAL=0.25,
+                               LAYER_CHECK_PAGE_ROWS=8)
     elif mode in ("lsm_on", "lsm_off"):
         # ISSUE 14: durable lsm storage with a tiny memtable/trigger so
         # flushes AND compactions run inside the sim — leveled
@@ -225,6 +258,48 @@ def _child(path: str, mode: str = "default") -> None:
             rows = await tr.get_range(b"det-", b"det.", snapshot=True)
             assert len(rows) == 6, rows
         await db.run(scan)
+        if mode == "layers":
+            # ISSUE 19: the real layer stack on one whole-db feed,
+            # driven through registration, zipfless deterministic
+            # reads/writes, a watch fire, an eviction-forcing read run
+            # (capacity 8 over more keys), a checker pass over the
+            # flipped page size, and a clean teardown — all inside the
+            # bit-identical proof
+            from foundationdb_tpu.client.subspace import Subspace
+            from foundationdb_tpu.layers import (LayerConsistencyChecker,
+                                                 LayerFeedConsumer,
+                                                 ReadThroughCache,
+                                                 SecondaryIndex,
+                                                 WatchRegistry)
+            consumer = LayerFeedConsumer(db, name="det")
+            index = SecondaryIndex(db, Subspace(raw_prefix=b"lidx/"),
+                                   primary_begin=b"det-",
+                                   primary_end=b"det.",
+                                   consumer=consumer)
+            assert index.mode == "async", (
+                "LAYER_INDEX_TRANSACTIONAL=False no longer selects "
+                "async mode — the flipped pin proves nothing")
+            cache = ReadThroughCache(db, consumer)
+            watches = WatchRegistry(db, consumer)
+            checker = LayerConsistencyChecker(db, index=index,
+                                              cache=cache,
+                                              watches=watches)
+            await consumer.start()
+            await index.start_async()
+            fut = await watches.watch(b"det-k3")
+            async def mutate(tr):
+                tr.set(b"det-k3", b"layered")
+            await db.run(mutate)
+            await asyncio.wait_for(fut, 60)
+            for i in range(12):        # > capacity 8: evictions run
+                await cache.get(b"det-k%d" % (i % 6))
+            tr = db.create_transaction()
+            tip = await tr.get_read_version()
+            tr.reset()
+            await consumer.wait_frontier(tip, timeout=60)
+            verdict = await checker.check()
+            assert verdict["divergences"] == 0, verdict
+            await consumer.stop(destroy=True)
         if mode in ("lsm_on", "lsm_off"):
             # ISSUE 14: push enough per-replica volume through the
             # tiny-memtable lsm engine that flushes AND compactions
@@ -487,6 +562,35 @@ def test_same_seed_sim_trace_bit_identical_devplane_knobs_flipped(tmp_path):
         f"same-seed sim trace diverged with the device-plane knobs "
         f"flipped (bitmask OFF / ring in-place ON / 4-shard mirror): "
         f"run a = {d1} ({n1} events), run b = {d2} ({n2})")
+
+
+def test_same_seed_sim_trace_bit_identical_layers_knobs_flipped(tmp_path):
+    """ISSUE 19 acceptance: the standing children pin every layer knob
+    at its default (and construct no layers, proving layers-off traces
+    carry zero layer traffic); this pair flips ALL SEVEN the other way
+    — a 0.01s feed poll, a 1k-version pop lag, async index mode, an
+    8-entry LRU, a 4-watch limit, a 0.25s progress publish, 8-row
+    checker pages — while driving the REAL stack (feed consumer, async
+    secondary index, read-through cache with forced evictions, a fired
+    watch, a clean checker pass, a destroy teardown) and must still
+    replay bit-identically across fresh processes.  Together the two
+    sides prove every new knob pinned both ways."""
+    import re
+
+    d1, n1, *_ = _run_child(tmp_path, "ya", mode="layers")
+    d2, n2, *_ = _run_child(tmp_path, "yb", mode="layers")
+    assert n1 > 100, f"trace suspiciously small ({n1} events)"
+    on_trace = _trace_bytes(tmp_path, "ya")
+    assert re.search(rb"layers/det", on_trace), (
+        "no layer feed traffic in the layers child's trace — the stack "
+        "never ran, so this test proved nothing")
+    assert not re.search(rb'"Type":"LayerMismatch"', on_trace), (
+        "LayerMismatch on an honest stack inside the determinism child")
+    assert (d1, n1) == (d2, n2), (
+        f"same-seed sim trace diverged with the layer knobs flipped "
+        f"(hot poll / async index / tiny LRU / hot progress publish): "
+        f"run a = {d1} ({n1} events), run b = {d2} ({n2}) — the layer "
+        f"ecosystem added nondeterminism, not just derived state")
 
 
 def test_same_seed_sim_trace_bit_identical_scrub_knob_both_ways(tmp_path):

@@ -227,6 +227,71 @@ def scrub_report(events: list[dict]) -> dict:
     }
 
 
+def layers_report(events: list[dict]) -> dict:
+    """The layer-ecosystem record from the trace alone (ISSUE 19):
+    every key-exact derived-state divergence (``LayerMismatch``), every
+    checker refusal (``LayerCheckRefused``), feed lifecycle events
+    (``LayerFeedDestroyed``/``LayerFeedReconnect``), and the
+    ``Layer*Metrics`` progress series the registered layer roles emit —
+    index frontier lag, cache hit rate, watch fire latency — the same
+    numbers ``cluster.layers`` serves live, replayable after the fact."""
+    mismatches, refusals, lifecycle = [], [], []
+    series: dict[str, list[dict]] = {"feed": [], "index": [], "cache": [],
+                                     "watch": [], "check": []}
+    kind_of = {"LayerFeedMetrics": "feed", "LayerIndexMetrics": "index",
+               "LayerCacheMetrics": "cache", "LayerWatchMetrics": "watch",
+               "LayerCheckMetrics": "check"}
+    for ev in events:
+        t = ev.get("Type")
+        if t == "LayerMismatch":
+            mismatches.append({
+                "t": ev.get("Time"),
+                "layer": ev.get("Layer"),
+                "key": ev.get("Key"),
+                "version": ev.get("Version"),
+                "expected": ev.get("Expected"),
+                "actual": ev.get("Actual"),
+            })
+        elif t == "LayerCheckRefused":
+            refusals.append({"t": ev.get("Time"),
+                             "layer": ev.get("Layer"),
+                             "why": ev.get("Why")})
+        elif t in ("LayerFeedDestroyed", "LayerFeedReconnect"):
+            lifecycle.append({"t": ev.get("Time"), "event": t,
+                              "name": ev.get("Name"),
+                              "frontier": ev.get("Frontier")})
+        elif t in kind_of:
+            row = {k: v for k, v in ev.items()
+                   if k not in ("Severity", "Type")}
+            row["t"] = row.pop("Time", None)
+            series[kind_of[t]].append(row)
+    for rows in series.values():
+        rows.sort(key=lambda r: r.get("t") or 0.0)
+    mismatches.sort(key=lambda r: r.get("t") or 0.0)
+
+    def last(kind: str) -> dict:
+        return series[kind][-1] if series[kind] else {}
+
+    return {
+        "mismatches": mismatches,
+        "refusals": refusals,
+        "lifecycle": lifecycle,
+        "series": series,
+        "progress_samples": sum(len(v) for v in series.values()),
+        "summary": {
+            "divergences": len(mismatches),
+            "divergent_layers": sorted({m["layer"] for m in mismatches}),
+            "refusals": len(refusals),
+            "feed_frontier": last("feed").get("Frontier"),
+            "index_frontier": last("index").get("FrontierVersion"),
+            "cache_hit_rate": last("cache").get("HitRate"),
+            "watch_fire_latency_ms":
+                last("watch").get("FireLatencyMeanMs"),
+            "checker_passes": last("check").get("Passes"),
+        },
+    }
+
+
 # --- recovery: the version-cut audit trail ---
 
 
@@ -319,7 +384,7 @@ def _load(paths: list[str]) -> list[dict]:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("view", choices=("summary", "lag", "recovery", "scrub",
-                                     "diff"))
+                                     "layers", "diff"))
     ap.add_argument("paths", nargs="+",
                     help="trace JSONL file(s); diff takes exactly two")
     ap.add_argument("--json", action="store_true")
@@ -408,6 +473,29 @@ def main(argv=None) -> int:
             print(f"  VIOLATION {v.get('Invariant')}: "
                   + " ".join(f"{k}={v[k]}" for k in sorted(v)
                              if k not in ("Type", "Time", "Invariant")))
+        return 0
+    if args.view == "layers":
+        rep = layers_report(events)
+        if args.json:
+            print(json.dumps(rep, indent=2, default=str))
+            return 0
+        s = rep["summary"]
+        print(f"divergences={s['divergences']} refusals={s['refusals']} "
+              f"checker_passes={s['checker_passes']} "
+              f"progress_samples={rep['progress_samples']}")
+        print(f"feed_frontier={s['feed_frontier']} "
+              f"index_frontier={s['index_frontier']} "
+              f"cache_hit_rate={s['cache_hit_rate']} "
+              f"watch_fire_latency_ms={s['watch_fire_latency_ms']}")
+        for m in rep["mismatches"]:
+            print(f"  MISMATCH layer={m['layer']} key={m['key']} "
+                  f"v={m['version']} expected={m['expected']} "
+                  f"actual={m['actual']}")
+        for r in rep["refusals"]:
+            print(f"  refused layer={r['layer']}: {r['why']}")
+        for e in rep["lifecycle"]:
+            print(f"  {e['event']} name={e['name']} "
+                  f"frontier={e['frontier']}")
         return 0
     # recovery
     rep = recovery_report(events)

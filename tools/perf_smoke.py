@@ -145,7 +145,26 @@ view, the raw trace); the frontier watchdog must have run with zero
 invariant violations; and a scrub-on vs scrub-off twin-sim overhead
 A/B must hold within a guarded wall-clock ratio.
 
-Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|compact|observe|mesh|scrub|all]
+SIXTEENTH stage (``--stage devplane``, ISSUE 18): the sharded device
+plane — the per-chip read mirrors must out-serve the single-directory
+twin under tail churn (partial shard refreshes, not full re-splits),
+and the verdict-bitmask readback must hold its bytes/txn edge over the
+raw path while staying bit-identical.
+
+SEVENTEENTH stage (``--stage layers``, ISSUE 19): the layer ecosystem
+— a seeded recruited sim running the full client-side layer stack
+(one whole-db feed consumer, an async secondary index, the
+invalidating read-through cache, a key watch) with the layer roles
+registered on a live metrics emitter: a zipf-0.99 read tier through
+the cache must hold the hit-rate floor, the layer consistency checker
+must complete a pass with ZERO divergences on the honest stack (every
+refusal retried to a real verdict), a single index row rotted OUTSIDE
+the maintenance path must be caught key-exactly by the very next
+pass, and the catch must be visible through all three consumer
+surfaces (the ``cluster.layers`` status rollup, ``metrics_tool``'s
+layers view, the raw trace) — all under the standing wedge deadline.
+
+Run directly:  python tools/perf_smoke.py [--stage apply|pipeline|feed|read|resolve|heat|backup|scan|bigkeys|recover|mvcc|compact|observe|mesh|scrub|devplane|layers|all]
 Run in CI:     wired as tests/test_perf_smoke.py (normal tier-1 tests).
 """
 
@@ -264,6 +283,13 @@ DEVPLANE_VERDICT_BATCHES = 48  # proxy batches through the pipeline A/B
 DEVPLANE_VERDICT_TXNS = 64    # txns per batch (B for the run)
 DEVPLANE_BITMASK_FLOOR = 4.0  # raw readback bytes/txn vs packed
 DEVPLANE_BUDGET_S = 240.0     # doubles as the hard wedge deadline
+LAYERS_KEYS = 400             # zipf keyspace behind the read-through cache
+LAYERS_READS = 3000           # zipf-shaped ops through the cache tier
+LAYERS_WRITE_FRACTION = 0.05  # invalidating-writer share of those ops
+LAYERS_ZIPF_S = 0.99          # the acceptance skew (zipf-0.99)
+LAYERS_HIT_RATE_FLOOR = 0.80  # cache hit rate under that skew
+LAYERS_WAIT_S = 120.0         # virtual-clock ceiling per wait phase
+LAYERS_BUDGET_S = 240.0       # doubles as the hard wedge deadline
 
 
 def storage_apply_seconds(n_keys: int = DEFAULT_KEYS,
@@ -2970,6 +2996,258 @@ def check_devplane(budget_s: float = DEVPLANE_BUDGET_S,
     return elapsed
 
 
+def layers_seconds(deadline_s: float | None = None) -> tuple[float, dict]:
+    """The layer-ecosystem smoke (ISSUE 19), one seeded recruited sim:
+
+    - the full client-side layer stack on ONE whole-db feed — consumer,
+      async :class:`SecondaryIndex`, :class:`ReadThroughCache`,
+      :class:`WatchRegistry` — with every layer role registered on a
+      live :class:`MetricsRegistry` emitter so ``Layer*Metrics`` land
+      on the virtual-clock cadence;
+    - a zipf-``LAYERS_ZIPF_S`` read tier (``LAYERS_READS`` ops,
+      ``LAYERS_WRITE_FRACTION`` invalidating writers) through the cache
+      must hold ``LAYERS_HIT_RATE_FLOOR``, with sampled reads re-proved
+      against authoritative reads pinned at the cache's claimed
+      valid-through version (zero stale);
+    - a watch registered before its key's next commit must fire with
+      the commit's version;
+    - the consistency checker must reach a real verdict (refusals
+      retried away) with ZERO divergences on the honest stack; then one
+      index row rotted OUTSIDE the maintenance path must be caught
+      key-exactly on the very next pass;
+    - the catch and the progress series must be visible through the
+      ``cluster.layers`` status rollup and ``metrics_tool``'s layers
+      view alike."""
+    import random
+
+    from foundationdb_tpu.client.subspace import Subspace
+    from foundationdb_tpu.core.cluster_controller import ClusterConfigSpec
+    from foundationdb_tpu.core.status import cluster_status
+    from foundationdb_tpu.layers import (LayerConsistencyChecker,
+                                         LayerFeedConsumer,
+                                         ReadThroughCache, SecondaryIndex,
+                                         WatchRegistry)
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.runtime.metrics import MetricsRegistry
+    from foundationdb_tpu.runtime.simloop import run_simulation
+    from foundationdb_tpu.runtime.trace import (Severity, TraceLog,
+                                                get_trace_log,
+                                                set_trace_log)
+    from foundationdb_tpu.sim.cluster_sim import SimulatedCluster
+    from foundationdb_tpu.workloads.layers import zipf_cdf, zipf_pick
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import metrics_tool
+
+    t_all = time.perf_counter()
+    stats: dict = {}
+    events: list[dict] = []
+    sink = TraceLog(min_severity=Severity.INFO)
+    sink.sink = events.append
+    prev_log = get_trace_log()
+    set_trace_log(sink)
+    status_doc: dict = {}
+    canary_key = b""
+
+    async def sim_main() -> None:
+        knobs = Knobs().override(METRICS_INTERVAL=1.0,
+                                 METRICS_EMITTER=True,
+                                 DD_ENABLED=True,
+                                 STORAGE_DURABILITY_LAG=0.1,
+                                 LAYER_FEED_POLL_INTERVAL=0.05,
+                                 LAYER_PROGRESS_INTERVAL=0.5)
+        sim = SimulatedCluster(knobs, n_machines=5, durable_storage=True,
+                               spec=ClusterConfigSpec(min_workers=5,
+                                                      replication=2))
+        await sim.start()
+        await asyncio.wait_for(sim.wait_epoch(1), 120)
+        db = await sim.database()
+
+        async def wait_for(pred, what: str):
+            for _ in range(int(LAYERS_WAIT_S / 0.25)):
+                if pred():
+                    return
+                await asyncio.sleep(0.25)
+            raise AssertionError(
+                f"layers smoke: {what} did not happen within "
+                f"{LAYERS_WAIT_S:.0f} virtual seconds")
+
+        # the stack: one feed, four layer roles, all on the emitter
+        consumer = LayerFeedConsumer(db, name="smoke")
+        index = SecondaryIndex(db, Subspace(raw_prefix=b"idx/"),
+                               primary_begin=b"tier/",
+                               primary_end=b"tier0",
+                               mode="async", consumer=consumer)
+        cache = ReadThroughCache(db, consumer, capacity=LAYERS_KEYS)
+        watches = WatchRegistry(db, consumer)
+        checker = LayerConsistencyChecker(db, index=index, cache=cache,
+                                          watches=watches)
+        registry = MetricsRegistry()
+        for role in (consumer, index, cache, watches, checker):
+            registry.add_role(role, default_id="smoke")
+        registry.start_emitter(0.5)
+        await consumer.start()
+        await index.start_async()
+
+        keys = [b"tier/%08d" % i for i in range(LAYERS_KEYS)]
+        BATCH = 100
+        for start in range(0, LAYERS_KEYS, BATCH):
+            async def fill(tr, start=start):
+                for i in range(start, min(start + BATCH, LAYERS_KEYS)):
+                    tr.set(keys[i], b"v0-%08d" % i)
+            await db.run(fill)
+
+        # a watch armed BEFORE its key's next commit fires with it
+        fut = await watches.watch(keys[7])
+        async def bump(tr):
+            tr.set(keys[7], b"v1-watched")
+        await db.run(bump)
+        fired_at = await asyncio.wait_for(fut, LAYERS_WAIT_S)
+        assert fired_at > 0, "the watch resolved without a version"
+
+        # the zipf read tier, with a sampled inline staleness proof
+        rng = random.Random(20250807)
+        cdf = zipf_cdf(LAYERS_KEYS, LAYERS_ZIPF_S)
+        stale = reads = writes = 0
+        for n in range(LAYERS_READS):
+            key = keys[zipf_pick(cdf, rng.random())]
+            if rng.random() < LAYERS_WRITE_FRACTION:
+                writes += 1
+                async def body(tr, key=key, n=n):
+                    tr.set(key, b"v%d" % n)
+                await db.run(body)
+            else:
+                reads += 1
+                value, valid_through = await cache.get_versioned(key)
+                if n % 16 == 0:
+                    tr = db.create_transaction()
+                    try:
+                        tr.set_read_version(valid_through)
+                        if await tr.get(key, snapshot=True) != value:
+                            stale += 1
+                    finally:
+                        tr.reset()
+        assert stale == 0, (
+            f"{stale} cached reads diverged from the authoritative "
+            f"value at their claimed valid-through version")
+        stats["reads"] = reads
+        stats["writes"] = writes
+        stats["hit_rate"] = round(cache.hit_rate, 4)
+        assert cache.hit_rate >= LAYERS_HIT_RATE_FLOOR, (
+            f"cache hit rate {cache.hit_rate:.3f} under "
+            f"zipf-{LAYERS_ZIPF_S} fell below the "
+            f"{LAYERS_HIT_RATE_FLOOR:.2f} floor")
+
+        # an honest stack must yield a real verdict with zero
+        # divergences — refusals are retried away, never counted
+        tr = db.create_transaction()
+        tip = await tr.get_read_version()
+        tr.reset()
+        await consumer.wait_frontier(tip, timeout=LAYERS_WAIT_S)
+        verdict = None
+        for _ in range(40):
+            verdict = await checker.check()
+            if not any(verdict[k]["refused"]
+                       for k in ("index", "cache", "watches")):
+                break
+            await asyncio.sleep(0.5)
+        assert verdict["divergences"] == 0, (
+            f"FALSE POSITIVE: the checker reported divergences on an "
+            f"honest layer stack: {verdict}")
+        assert not verdict["index"]["refused"], (
+            "the async index never reached a stable checkpoint")
+        stats["clean_rows_checked"] = verdict["rows_checked"]
+
+        # rot one index row behind the maintainer's back (a direct
+        # write into the index subspace — outside the primary range, so
+        # the feed applier never sees it) and demand a key-exact catch
+        nonlocal canary_key
+        canary_key = index.row_key(b"ROT!", b"tier/no-such-pkey")
+        async def rot(tr):
+            tr.set(canary_key, b"")
+        await db.run(rot)
+        caught = await checker.check()
+        assert caught["index"]["divergences"] == 1, (
+            f"the rotted index row went uncaught: {caught}")
+        stats["passes"] = checker.passes
+
+        # one emitter tick + one progress publish so the consumer
+        # surfaces carry the catch
+        await asyncio.sleep(1.5)
+        nonlocal status_doc
+        t = sim.client_transport()
+        status_doc = await asyncio.wait_for(
+            cluster_status(knobs, t, sim.coordinator_stubs(t)), 60)
+        await registry.stop_emitter()
+        await consumer.stop(destroy=True)
+        await sim.stop()
+
+    try:
+        run_simulation(sim_main(), seed=20250807)
+    finally:
+        set_trace_log(prev_log)
+
+    # the catch is key-exact in the raw trace, and it is the ONLY one
+    hits = [e for e in events if e.get("Type") == "LayerMismatch"]
+    assert [e.get("Key") for e in hits] == [canary_key.hex()], (
+        f"LayerMismatch named {[e.get('Key') for e in hits]}, not "
+        f"exactly the rotted {canary_key.hex()!r}")
+    assert hits[0].get("Layer") == "index" and \
+        hits[0].get("Severity") == 40, hits[0]
+
+    # the status rollup serves the feed's published progress
+    layers = status_doc["cluster"]["layers"]
+    assert layers["active"] >= 1, layers
+    smoke = [c for c in layers["consumers"] if c["name"] == "smoke"]
+    assert smoke and smoke[0]["frontier"] > 0, layers
+    assert smoke[0]["entries_delivered"] > 0, layers
+    assert not smoke[0]["destroyed"], layers
+    stats["status_frontier"] = smoke[0]["frontier"]
+    stats["status_lag"] = smoke[0]["lag_versions"]
+
+    # the tool chain over the recorded events agrees
+    rep = metrics_tool.layers_report(events)
+    assert rep["summary"]["divergences"] == 1, rep["summary"]
+    assert any(m["key"] == canary_key.hex() for m in rep["mismatches"]), (
+        "metrics_tool layers view lost the key-exact mismatch")
+    assert rep["summary"]["cache_hit_rate"] >= LAYERS_HIT_RATE_FLOOR, \
+        rep["summary"]
+    assert rep["summary"]["checker_passes"] >= 2, rep["summary"]
+    assert rep["summary"]["feed_frontier"] > 0, rep["summary"]
+    assert rep["progress_samples"] >= 2, (
+        "no Layer*Metrics progress series — the layer roles never "
+        "joined the metrics emitter")
+    stats["progress_samples"] = rep["progress_samples"]
+
+    elapsed = time.perf_counter() - t_all
+    if deadline_s is not None and elapsed > deadline_s:
+        raise AssertionError(
+            f"layers smoke overran its {deadline_s:.0f}s deadline "
+            f"({elapsed:.1f}s)")
+    return elapsed, stats
+
+
+def check_layers(budget_s: float = LAYERS_BUDGET_S,
+                 quiet: bool = False) -> float:
+    """Run the layer-ecosystem smoke; raises AssertionError on a stale
+    cached read, a hit rate under the zipf floor, a checker false
+    positive, a missed or key-inexact canary catch, or a broken
+    consumer surface (status rollup / metrics_tool / trace)."""
+    elapsed, stats = layers_seconds(deadline_s=budget_s)
+    if not quiet:
+        print(f"[perf_smoke] layers: hit rate {stats['hit_rate']:.3f} "
+              f"over {stats['reads']} zipf reads "
+              f"({stats['writes']} invalidating writes, 0 stale); "
+              f"checker clean over {stats['clean_rows_checked']} rows, "
+              f"rotted row caught key-exactly "
+              f"({stats['passes']} passes); status frontier "
+              f"{stats['status_frontier']} (lag {stats['status_lag']}), "
+              f"{stats['progress_samples']} progress samples")
+    assert elapsed < budget_s, (
+        f"layers smoke took {elapsed:.1f}s (budget {budget_s:.0f}s)")
+    return elapsed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--keys", type=int, default=DEFAULT_KEYS)
@@ -2979,7 +3257,7 @@ def main() -> int:
                              "resolve", "heat", "backup", "scan",
                              "bigkeys", "recover", "mvcc", "compact",
                              "observe", "mesh", "scrub", "devplane",
-                             "all"),
+                             "layers", "all"),
                     default="all")
     ap.add_argument("--txns", type=int, default=PIPE_TXNS)
     ap.add_argument("--pipe-budget", type=float, default=PIPE_BUDGET_S)
@@ -3004,6 +3282,8 @@ def main() -> int:
     ap.add_argument("--scrub-budget", type=float, default=SCRUB_BUDGET_S)
     ap.add_argument("--devplane-budget", type=float,
                     default=DEVPLANE_BUDGET_S)
+    ap.add_argument("--layers-budget", type=float,
+                    default=LAYERS_BUDGET_S)
     args = ap.parse_args()
     if args.stage in ("apply", "all"):
         check(args.keys, args.budget)
@@ -3037,6 +3317,8 @@ def main() -> int:
         check_scrub(budget_s=args.scrub_budget)
     if args.stage in ("devplane", "all"):
         check_devplane(budget_s=args.devplane_budget)
+    if args.stage in ("layers", "all"):
+        check_layers(budget_s=args.layers_budget)
     return 0
 
 
